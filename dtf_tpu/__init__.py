@@ -1,0 +1,27 @@
+"""dtf_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of the TF1
+parameter-server demo ``KimJeongChul/distributed-tensorflow`` (reference at
+``/root/reference``):
+
+* cluster bootstrap & rank dispatch (ref: ``tf.train.ClusterSpec`` /
+  ``tf.train.Server``, tf_distributed.py:9-18) -> :mod:`dtf_tpu.cluster` over
+  ``jax.distributed`` + ``jax.sharding.Mesh``;
+* placement / replication policy (ref: ``tf.train.replica_device_setter``,
+  tf_distributed.py:34-36) -> :mod:`dtf_tpu.parallel` NamedSharding rules;
+* async parameter-server SGD (ref: tf_distributed.py:73-76) -> synchronous
+  data parallelism with ``lax.psum`` gradient all-reduce over ICI;
+* workloads: MNIST MLP (tf_distributed.py:39-89), the 1000x1000 matmul
+  benchmark (tf_distributed_1000Matrix.py:42-48), plus ResNet-50/CIFAR-10 and
+  BERT-base per BASELINE.md;
+* driver loop, eval and the reference's console log contract
+  (tf_distributed.py:100-128) -> :mod:`dtf_tpu.train`.
+
+The reference's capabilities are re-expressed TPU-first, not translated.
+"""
+
+from dtf_tpu.version import __version__
+from dtf_tpu import cluster, config
+from dtf_tpu.parallel import mesh, sharding
+
+__all__ = ["__version__", "cluster", "config", "mesh", "sharding"]

@@ -1,0 +1,108 @@
+"""Cluster bootstrap: topology, process init, mesh construction.
+
+Replaces the reference's L1 layer (SURVEY.md §1): the hardcoded ClusterSpec
+(tf_distributed.py:9-11), the per-task gRPC ``tf.train.Server``
+(tf_distributed.py:18), the ``ps``/``worker`` role dispatch
+(tf_distributed.py:30-32) and the Supervisor's coordinated init
+(tf_distributed.py:92-96).
+
+TPU-native design:
+
+* control plane: ``jax.distributed.initialize`` (coordination service over
+  DCN) instead of a per-task gRPC server;
+* no roles: SPMD runs the same program on every process.  ``--job_name=ps``
+  is accepted for CLI compatibility but the process joins as a peer (there is
+  no parameter-hosting process in an all-reduce design);
+* coordinated init: parameters are initialized identically on every process
+  from the same seed (deterministic SPMD init) — no chief, no polling, no
+  "wait for PS" (the reference's non-chief workers blocked in
+  ``prepare_or_wait_for_session``, tf_distributed.py:96);
+* the device mesh replaces the cluster spec: topology is a mesh-shape string,
+  not host:port lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from dtf_tpu.config import ClusterConfig
+from dtf_tpu.parallel.mesh import MeshSpec, make_mesh
+
+log = logging.getLogger("dtf_tpu")
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass
+class Cluster:
+    """A bootstrapped job: process identity + the global device mesh."""
+
+    config: ClusterConfig
+    mesh: Mesh
+
+    @property
+    def process_id(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Chief election, reference-style ``task_index == 0``
+        (tf_distributed.py:92) — used only to de-duplicate host-side I/O
+        (logging, checkpoint writes), never for init."""
+        return self.process_id == 0
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size
+
+
+def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
+    """Initialize the process and build the global mesh.
+
+    Zero-config single-process mode works out of the box (the reference could
+    not run outside its hardcoded 6-8 host network, tf_distributed.py:9-10).
+    Multi-process mode mirrors the reference's CLI:
+
+        python -m dtf_tpu.workloads.mnist --job_name=worker --task_index=k \
+            --coordinator_address=host:port --num_processes=N
+
+    vs the reference's ``python tf_distributed.py --job_name=worker
+    --task_index=k`` with in-source IP edits.
+    """
+    global _INITIALIZED
+    config = config or ClusterConfig()
+
+    if config.platform:
+        # Env vars are too late if jax was already imported (this image's
+        # sitecustomize does); config.update is the reliable path.
+        jax.config.update("jax_platforms", config.platform)
+
+    if config.num_processes > 1 and not _INITIALIZED:
+        if not config.coordinator_address:
+            raise ValueError("--coordinator_address required when num_processes > 1")
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _INITIALIZED = True
+        log.info("jax.distributed initialized: process %d/%d, coordinator %s",
+                 jax.process_index(), jax.process_count(),
+                 config.coordinator_address)
+
+    mesh = make_mesh(MeshSpec.parse(config.mesh))
+    if jax.process_index() == 0:
+        log.info("mesh: axes=%s shape=%s over %d %s device(s)",
+                 mesh.axis_names, dict(mesh.shape), mesh.size,
+                 jax.devices()[0].platform)
+    return Cluster(config=config, mesh=mesh)

@@ -1,0 +1,163 @@
+"""Configuration system.
+
+The reference had two overlapping, half-dead flag systems (``tf.app.flags``
+with exactly ``job_name``/``task_index`` at tf_distributed.py:14-16, plus a
+vestigial argparse block at tf_distributed.py:133-163 whose parsed host lists
+were never wired into the ClusterSpec) and hardcoded everything else: cluster
+membership (tf_distributed.py:9-10), hyperparameters (batch_size=100,
+learning_rate=0.0005, training_epochs, tf_distributed.py:21-24) and the log
+dir (``/tmp/mnist/1``, tf_distributed.py:24).
+
+Here there is ONE config system built on dataclasses + argparse:
+
+* the reference CLI contract is preserved: ``--job_name`` and ``--task_index``
+  are accepted (BASELINE.json north star).  Under SPMD there are no
+  per-role programs, so ``--job_name`` values map as follows:
+  ``worker`` -> normal participant, ``ps`` -> accepted with a warning (the
+  parameter-server role does not exist in an all-reduce design; the process
+  participates as a peer).  ``--task_index`` resolves to the JAX process
+  index (a mesh coordinate), not a gRPC host:port slot.
+* cluster topology is a flag (``--coordinator_address``, ``--num_processes``)
+  — finishing what the reference's dead argparse block started — instead of
+  hardcoded IPs; zero flags == single-process mode, which the reference could
+  not do at all.
+* hyperparameters live in :class:`TrainConfig` with the reference's values as
+  defaults for the MNIST workload (for comparability).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Optional
+
+log = logging.getLogger("dtf_tpu")
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Where this process sits in the (possibly multi-host) job.
+
+    Replaces the reference's ClusterSpec + Server + flags
+    (tf_distributed.py:9-18).
+    """
+
+    job_name: str = "worker"          # compat: reference tf_distributed.py:14
+    task_index: int = 0               # compat: reference tf_distributed.py:15
+    coordinator_address: Optional[str] = None  # host:port of process 0 (DCN control plane)
+    num_processes: int = 1
+    # Mesh request, e.g. "data=-1" or "data=4,tensor=2"; -1 infers from device count.
+    mesh: str = "data=-1"
+    platform: Optional[str] = None    # force jax platform (cpu/tpu); None = auto
+
+    def __post_init__(self):
+        if self.job_name not in ("ps", "worker"):
+            raise ValueError(
+                f"job_name must be 'ps' or 'worker' (reference CLI contract, "
+                f"tf_distributed.py:14), got {self.job_name!r}")
+        if self.job_name == "ps":
+            log.warning(
+                "--job_name=ps: the parameter-server role does not exist in "
+                "the all-reduce design (SURVEY.md §3.1); this process joins "
+                "as a peer.")
+
+    @property
+    def process_id(self) -> int:
+        """The reference's task_index becomes the SPMD process index."""
+        return self.task_index
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Chief election: reference used ``is_chief=(task_index==0)``
+        (tf_distributed.py:92)."""
+        return self.process_id == 0
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Training hyperparameters.
+
+    Defaults match the reference MNIST run for comparability:
+    batch_size=100, learning_rate=0.0005, epochs=20 (tf_distributed.py:21-23),
+    log frequency 100 steps (tf_distributed.py:25), seed 1
+    (tf_distributed.py:49).
+    """
+
+    batch_size: int = 100             # per-step GLOBAL batch (see note below)
+    learning_rate: float = 0.0005
+    epochs: int = 20
+    log_frequency: int = 100
+    seed: int = 1
+    logdir: str = "/tmp/dtf_tpu"      # ref hardcoded /tmp/mnist/1 (tf_distributed.py:24)
+    # Async->sync semantics note (SURVEY.md §7 "hard parts"): the reference's
+    # async PS applies each worker's 100-sample gradient independently; under
+    # synchronous psum the framework uses a GLOBAL batch of `batch_size`
+    # sharded over the data axis by default (matches the optimization
+    # trajectory of one reference worker).  Set per_device_batch instead to
+    # match per-worker *compute* (global = per_device * num_devices).
+    per_device_batch: Optional[int] = None
+    checkpoint_every: int = 0         # steps; 0 disables (ref had no checkpointing, SURVEY §5.4)
+    resume: bool = False
+    dtype: str = "float32"
+
+
+def _field_type(cls, f: dataclasses.Field) -> type:
+    """Resolve a dataclass field's runtime type (annotations are strings under
+    ``from __future__ import annotations``; unwrap Optional[T])."""
+    import typing
+    hints = typing.get_type_hints(cls)
+    t = hints[f.name]
+    if typing.get_origin(t) is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            t = args[0]
+    return t if isinstance(t, type) else str
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") -> None:
+    for f in dataclasses.fields(cls):
+        if f.name in ("job_name", "task_index"):
+            continue  # added explicitly to preserve reference help text
+        typ = _field_type(cls, f)
+        kwargs = {"default": None}
+        if typ is bool:
+            kwargs["action"] = "store_true"
+        elif typ in (int, float, str):
+            kwargs["type"] = typ
+        else:
+            kwargs["type"] = str
+        parser.add_argument(f"--{prefix}{f.name}", **kwargs)
+
+
+def build_parser(description: str = "dtf_tpu") -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=description)
+    # Reference CLI contract (tf_distributed.py:14-15), semantics re-targeted.
+    parser.add_argument(
+        "--job_name", default="worker",
+        help="Compat with the reference ('ps'|'worker'). SPMD has no PS role; "
+             "'ps' is accepted with a warning and the process joins as a peer.")
+    parser.add_argument(
+        "--task_index", type=int, default=0,
+        help="Compat with the reference; resolves to the JAX process index "
+             "(a mesh coordinate), not a gRPC host:port slot.")
+    _add_dataclass_args(parser, ClusterConfig)
+    _add_dataclass_args(parser, TrainConfig)
+    return parser
+
+
+def _from_namespace(cls, ns: argparse.Namespace):
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        v = getattr(ns, f.name, None)
+        if v is not None:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def parse_args(argv: Optional[list] = None,
+               description: str = "dtf_tpu") -> tuple[ClusterConfig, TrainConfig]:
+    ns = build_parser(description).parse_args(argv)
+    cluster_cfg = _from_namespace(ClusterConfig, ns)  # validates job_name
+    train_cfg = _from_namespace(TrainConfig, ns)
+    return cluster_cfg, train_cfg

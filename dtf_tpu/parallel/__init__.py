@@ -1,0 +1,25 @@
+"""Parallelism layer: device meshes, sharding rules, explicit collectives.
+
+TPU-native replacement for the reference's placement/replication machinery
+(``tf.train.replica_device_setter``, tf_distributed.py:34-36, which pinned
+variables to the PS job and compute to each worker).  Here placement is
+declarative: a named :class:`jax.sharding.Mesh` plus ``NamedSharding`` rules;
+XLA's GSPMD partitioner inserts the collectives the TF runtime used to route
+through gRPC Send/Recv pairs.
+"""
+
+from dtf_tpu.parallel.mesh import (
+    AXES, DATA, FSDP, TENSOR, SEQ, EXPERT, PIPE,
+    MeshSpec, make_mesh, local_mesh,
+)
+from dtf_tpu.parallel.sharding import (
+    named_sharding, replicate, shard_batch, batch_spec, logical_to_spec,
+    apply_rules,
+)
+
+__all__ = [
+    "AXES", "DATA", "FSDP", "TENSOR", "SEQ", "EXPERT", "PIPE",
+    "MeshSpec", "make_mesh", "local_mesh",
+    "named_sharding", "replicate", "shard_batch", "batch_spec",
+    "logical_to_spec", "apply_rules",
+]

@@ -1,0 +1,74 @@
+"""Explicit collectives for shard_map-style SPMD code.
+
+The reference's data plane was implicit gRPC Send/Recv traffic inserted by
+the TF graph partitioner at the PS<->worker cut (SURVEY.md §5.8): every step,
+each worker pulled all parameters and pushed all gradients asynchronously.
+The TPU-native data plane is XLA collectives over ICI, used two ways:
+
+1. implicitly — GSPMD inserts them from sharding annotations (preferred);
+2. explicitly — inside ``jax.shard_map`` per-device code, via these wrappers.
+
+These are thin, named wrappers so framework code reads at the level of the
+design ("all-reduce the gradients over the data axis") and so tests can
+exercise each primitive on a CPU-simulated mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def all_reduce_mean(tree: Any, axis: "str | Sequence[str]") -> Any:
+    """Mean-all-reduce a pytree over mesh axis/axes (gradient sync).
+
+    Replaces the reference's asynchronous per-worker ``apply_gradients`` on
+    the PS (tf_distributed.py:75-76) with a synchronous psum/mean.
+    """
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)
+
+
+def all_reduce_sum(tree: Any, axis: "str | Sequence[str]") -> Any:
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis), tree)
+
+
+def all_gather(x: jax.Array, axis: str, *, tiled_axis: int = 0) -> jax.Array:
+    """Gather shards along a mesh axis, concatenating on ``tiled_axis``."""
+    return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x: jax.Array, axis: str, *, scatter_axis: int = 0) -> jax.Array:
+    """Sum-reduce over the mesh axis, leaving each device its shard."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Send to the next device along a mesh axis ring (ppermute).
+
+    Building block for ring attention / pipeline schedules.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) -> jax.Array:
+    """All-to-all over a mesh axis (Ulysses-style sequence<->head reshard,
+    MoE token dispatch)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def shard_map_fn(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Wrap ``jax.shard_map`` with the framework's mesh conventions."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=check_vma)

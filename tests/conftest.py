@@ -1,0 +1,41 @@
+"""Test rig: simulate an 8-device mesh on CPU.
+
+The reference was untestable — hardcoded cluster IPs (tf_distributed.py:9-10)
+meant it could not run outside its specific 6-8 host network, and it shipped
+zero tests (SURVEY.md §4).  Here every distributed code path runs under
+pytest on a single host via XLA's host-platform device-count simulation.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.  Note: this image's
+# sitecustomize imports jax before conftest runs, so the JAX_PLATFORMS env
+# var is already baked into jax.config — use config.update as well.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    from dtf_tpu.parallel.mesh import make_mesh
+    return make_mesh("data=8")
+
+
+@pytest.fixture()
+def mesh_2d():
+    from dtf_tpu.parallel.mesh import make_mesh
+    return make_mesh("data=4,tensor=2")

@@ -1,0 +1,195 @@
+"""Mesh + sharding layer tests (SURVEY.md §4: sharding specs are unit-tested)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dtf_tpu.parallel import mesh as mesh_lib
+from dtf_tpu.parallel import sharding as sh
+from dtf_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+class TestMeshSpec:
+    def test_parse_single(self):
+        s = MeshSpec.parse("data=-1")
+        assert s.names == ("data",) and s.sizes == (-1,)
+
+    def test_parse_multi(self):
+        s = MeshSpec.parse("data=4,tensor=2")
+        assert s.names == ("data", "tensor") and s.sizes == (4, 2)
+
+    def test_resolve_infers(self):
+        assert MeshSpec.parse("data=-1,tensor=2").resolve(8).sizes == (4, 2)
+
+    def test_resolve_mismatch(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("data=3").resolve(8)
+
+    def test_unknown_axis(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("bogus=2")
+
+    def test_duplicate_axis(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("data=2,data=4")
+
+    def test_two_wildcards(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("data=-1,tensor=-1")
+
+    def test_zero_or_negative_size(self):
+        with pytest.raises(ValueError):
+            MeshSpec.parse("data=-1,tensor=0")
+        with pytest.raises(ValueError):
+            MeshSpec.parse("data=-2")
+
+
+class TestMakeMesh:
+    def test_1d(self, devices):
+        m = make_mesh("data=-1")
+        assert m.axis_names == ("data",) and m.size == 8
+
+    def test_2d(self, devices):
+        m = make_mesh("data=4,tensor=2")
+        assert dict(m.shape) == {"data": 4, "tensor": 2}
+
+    def test_subset_devices(self, devices):
+        m = make_mesh("data=4", devices=devices[:4])
+        assert m.size == 4
+
+
+class TestShardingRules:
+    def test_logical_to_spec_defaults(self):
+        spec = sh.logical_to_spec(("batch", "embed"))
+        assert spec == P("data", None)
+
+    def test_unknown_logical_replicates(self):
+        assert sh.logical_to_spec(("nonesuch",)) == P(None)
+
+    def test_missing_mesh_axis_replicates(self, mesh8):
+        # 'mlp' maps to 'tensor', but mesh8 has no tensor axis -> replicated.
+        assert sh.logical_to_spec(("batch", "mlp"), mesh=mesh8) == P("data", None)
+
+    def test_tensor_axis_used_when_present(self, mesh_2d):
+        assert sh.logical_to_spec(("batch", "mlp"), mesh=mesh_2d) == P("data", "tensor")
+
+    def test_batch_spec_shards_leading(self, mesh8):
+        x = jnp.zeros((16, 4))
+        xs = jax.device_put(x, sh.batch_spec(mesh8, x.ndim))
+        assert xs.sharding.spec == P(("data",), None)
+        # Each device holds 1/8 of the batch.
+        assert xs.addressable_shards[0].data.shape == (2, 4)
+
+    def test_replicate(self, mesh8):
+        x = sh.replicate(mesh8, jnp.ones((3, 3)))
+        assert x.sharding.is_fully_replicated
+
+    def test_shard_batch_handles_scalars(self, mesh8):
+        tree = {"x": jnp.ones((16, 4)), "step": jnp.float32(3.0)}
+        out = sh.shard_batch(mesh8, tree)
+        assert out["step"].sharding.is_fully_replicated
+        assert out["x"].sharding.spec == P(("data",), None)
+
+    def test_apply_rules_tree(self, mesh_2d):
+        logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+        shardings = sh.apply_rules(logical, mesh_2d)
+        assert shardings["w"].spec == P(None, "tensor")
+        assert shardings["b"].spec == P("tensor")
+
+
+class TestCollectives:
+    def test_all_reduce_mean(self, mesh8):
+        from dtf_tpu.parallel import collectives as col
+
+        def f(x):
+            return col.all_reduce_mean(x, "data")
+
+        g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P())
+        x = jnp.arange(8.0)
+        np.testing.assert_allclose(g(x), 3.5)
+
+    def test_ring_permute(self, mesh8):
+        from dtf_tpu.parallel import collectives as col
+
+        def f(x):
+            return col.ring_permute(x, "data")
+
+        g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+        out = g(jnp.arange(8.0))
+        np.testing.assert_allclose(out, jnp.roll(jnp.arange(8.0), 1))
+
+    def test_reduce_scatter(self, mesh8):
+        from dtf_tpu.parallel import collectives as col
+
+        def f(x):
+            return col.reduce_scatter(x, "data", scatter_axis=0)
+
+        g = jax.shard_map(f, mesh=mesh8, in_specs=P(None), out_specs=P("data"))
+        x = jnp.ones((8,))
+        np.testing.assert_allclose(g(x), 8.0 * jnp.ones((8,)))
+
+
+class TestClusterBootstrap:
+    def test_single_process_zero_config(self, devices):
+        from dtf_tpu.cluster import bootstrap
+
+        c = bootstrap()
+        assert c.num_processes == 1
+        assert c.is_coordinator
+        assert c.mesh.size == 8
+
+    def test_ps_job_name_joins_as_peer(self, devices):
+        from dtf_tpu.cluster import bootstrap
+        from dtf_tpu.config import ClusterConfig
+
+        c = bootstrap(ClusterConfig(job_name="ps", mesh="data=-1"))
+        assert c.mesh.size == 8  # no separate PS process
+
+    def test_multiprocess_requires_coordinator(self):
+        from dtf_tpu.cluster import bootstrap
+        from dtf_tpu.config import ClusterConfig
+
+        with pytest.raises(ValueError):
+            bootstrap(ClusterConfig(num_processes=2))
+
+
+class TestConfig:
+    def test_reference_cli_contract(self):
+        """--job_name/--task_index survive (BASELINE.json north star)."""
+        from dtf_tpu.config import parse_args
+
+        cc, tc = parse_args(["--job_name", "worker", "--task_index", "3"])
+        assert cc.job_name == "worker"
+        assert cc.task_index == 3
+        assert cc.process_id == 3
+
+    def test_reference_hyperparam_defaults(self):
+        """Defaults match tf_distributed.py:21-23 for comparability."""
+        from dtf_tpu.config import parse_args
+
+        _, tc = parse_args([])
+        assert tc.batch_size == 100
+        assert tc.learning_rate == 0.0005
+        assert tc.epochs == 20
+        assert tc.seed == 1
+
+    def test_bad_job_name_rejected(self):
+        from dtf_tpu.config import parse_args
+
+        with pytest.raises(ValueError):
+            parse_args(["--job_name", "evaluator"])
+
+    def test_bad_job_name_rejected_programmatically(self):
+        from dtf_tpu.config import ClusterConfig
+
+        with pytest.raises(ValueError):
+            ClusterConfig(job_name="evaluator")
+
+    def test_optional_int_flag_parses_as_int(self):
+        from dtf_tpu.config import parse_args
+
+        _, tc = parse_args(["--per_device_batch", "64"])
+        assert tc.per_device_batch == 64
+        assert isinstance(tc.per_device_batch, int)
