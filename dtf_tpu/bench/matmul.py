@@ -1,0 +1,178 @@
+"""Sharded matmul benchmark — the reference's headline metric, done right.
+
+The reference *intended* a distributed 1000x1000 matmul benchmark
+(``A,B = random_normal([1000,1000])`` on the PS, ``C = tf.matmul(A,B)``,
+tf_distributed_1000Matrix.py:42-48) but its driver loop crashes with a
+NameError before ever executing ``C`` (tf_distributed_1000Matrix.py:74; see
+SURVEY.md §2.9).  Per BASELINE.json the metric is GFLOPs/chip + step-time
+with a >=90%-of-roofline north star on the matmul.
+
+TPU-native design decisions:
+
+* operands live on device, sharded over the mesh with ``NamedSharding``
+  (A row-sharded over ``data``, B column-sharded over ``tensor`` when those
+  axes exist) — no parameter server, no per-step operand transfer (the
+  reference would have pulled 2x4MB over gRPC per step);
+* a *step* is a chain of ``iters_per_step`` dependent matmuls inside one
+  compiled program (``A_{k+1} = A_k @ B``): dependent so XLA cannot CSE or
+  hoist the loop body, chained inside ``lax.fori_loop`` so dispatch overhead
+  is amortised — at N=1000 a single matmul is ~microseconds on one chip and
+  dispatch-bound (SURVEY.md §6.1);
+* bf16 by default (MXU-native), fp32 supported for parity with the
+  reference's fp32 variables; operands are scaled ~N(0, 1/sqrt(N)) so the
+  chain stays numerically bounded;
+* timing via ``block_until_ready`` (utils.timing), never raw ``time.time()``
+  around an async dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu.parallel.mesh import local_mesh
+from dtf_tpu.utils.timing import time_linfit
+
+# Peak dense-matmul FLOP/s per chip, by (device kind substring, dtype).
+# Public figures: v4 275 Tbf16 / 137.5 Tfp32-ish via bf16x3; v5e 197 Tbf16,
+# v5p 459 Tbf16, v6e 918 Tbf16.  fp32 on MXU runs ~1/4-1/2 of bf16 depending
+# on generation; we use bf16 numbers for the roofline target and report the
+# dtype used.
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12,   # aka v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v6p": 4614e12 / 2,  # placeholder; updated when public
+}
+
+
+def peak_flops_per_chip(device: Optional[jax.Device] = None,
+                        dtype: str = "bfloat16") -> Optional[float]:
+    """Best-known peak FLOP/s for the device, or None if unknown (e.g. CPU)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            if dtype in ("float32", "fp32"):
+                return peak / 2
+            return peak
+    return None
+
+
+@dataclasses.dataclass
+class MatmulBenchConfig:
+    n: int = 1000                 # reference shape, tf_distributed_1000Matrix.py:42-44
+    dtype: str = "bfloat16"
+    # Marginal timing: per-matmul device time = least-squares slope of
+    # chain-length -> wall time over a geometric ladder.  The longest chain
+    # is sized so its device time is about ``target_long_s`` (assuming ~50%
+    # of roofline), keeping the ~tens-of-ms relay jitter small relative to
+    # the fit range; fixed iteration counts would drown µs-scale matmuls
+    # (N=1000 is ~20 µs/matmul) in that jitter.
+    target_long_s: float = 1.2
+    ladder_points: int = 4        # chain lengths: L, L/2, L/4, ...
+    max_iters: int = 200_000
+    reps: int = 5                 # timed repetitions of each chain length
+    # Relay jitter is one-sided (only ever adds time), so best-of-reps is the
+    # right estimator and more reps monotonically improves it.
+    seed: int = 1                 # reference seed, tf_distributed.py:49
+    mesh: Optional[Mesh] = None   # default: all local devices on a data axis
+
+
+def _operand_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    """A row-sharded over data-like axes; B column-sharded over tensor."""
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
+    tensor = "tensor" if "tensor" in mesh.axis_names else None
+    return (NamedSharding(mesh, P(data_axes, None)),
+            NamedSharding(mesh, P(None, tensor)))
+
+
+def build_step(mesh: Mesh, n: int, dtype: str, iters: int):
+    """Compile one benchmark step: ``iters`` chained matmuls on the mesh."""
+    a_sh, b_sh = _operand_shardings(mesh)
+
+    @functools.partial(jax.jit, out_shardings=a_sh)
+    def step(a, b):
+        def body(_, acc):
+            return acc @ b
+        return lax.fori_loop(0, iters, body, a)
+
+    return step, a_sh, b_sh
+
+
+def make_operands(mesh: Mesh, n: int, dtype: str, seed: int):
+    a_sh, b_sh = _operand_shardings(mesh)
+    ka, kb = jax.random.split(jax.random.key(seed))
+    scale = 1.0 / (n ** 0.5)  # keep the chained product bounded
+    a = jax.device_put(jax.random.normal(ka, (n, n), jnp.dtype(dtype)) * scale, a_sh)
+    b = jax.device_put(jax.random.normal(kb, (n, n), jnp.dtype(dtype)) * scale, b_sh)
+    return a, b
+
+
+def run_matmul_bench(cfg: MatmulBenchConfig) -> dict:
+    """Run the benchmark; returns a flat dict of results (JSON-friendly)."""
+    mesh = cfg.mesh if cfg.mesh is not None else local_mesh("data=-1")
+    a, b = make_operands(mesh, cfg.n, cfg.dtype, cfg.seed)
+
+    flop = 2.0 * cfg.n ** 3
+    peak = peak_flops_per_chip(mesh.devices.flat[0], cfg.dtype)
+    peak_guess = peak or 100e9
+    longest = int(cfg.target_long_s * 0.5 * peak_guess * mesh.size / flop)
+    longest = max(16, min(longest, cfg.max_iters))
+    ladder = sorted({max(2, longest >> i) for i in range(cfg.ladder_points)})
+
+    steps = {k: build_step(mesh, cfg.n, cfg.dtype, k)[0] for k in ladder}
+    fit = time_linfit(lambda k: (lambda: steps[k](a, b)), ladder, reps=cfg.reps)
+
+    n_chips = mesh.size
+    flops_per_chip = flop / fit.per_iter_s / n_chips
+    return {
+        "n": cfg.n,
+        "dtype": cfg.dtype,
+        "n_chips": n_chips,
+        "device_kind": getattr(mesh.devices.flat[0], "device_kind", "cpu"),
+        "matmul_time_us": fit.per_iter_s * 1e6,
+        "fit_overhead_ms": fit.overhead_s * 1e3,
+        "ladder": [[k, round(t * 1e3, 2)] for k, t in fit.points],
+        "tflops_per_chip": flops_per_chip / 1e12,
+        "peak_tflops_per_chip": (peak / 1e12) if peak else None,
+        "roofline_fraction": (flops_per_chip / peak) if peak else None,
+    }
+
+
+def sweep(ns=(1000, 1024, 2048, 4096, 8192), dtype: str = "bfloat16",
+          mesh: Optional[Mesh] = None, reps: int = 5) -> list[dict]:
+    """N-sweep to find where roofline is reachable (SURVEY.md §6.1: N=1000 is
+    dispatch/HBM-bound; honesty requires showing the curve).  1024 is the
+    128-lane-aligned neighbour of the reference's 1000 — the delta between
+    them is pure padding waste (1000 pads to 1024 on the MXU, a
+    (1000/1024)^3 = 93% intrinsic ceiling)."""
+    out = []
+    for n in ns:
+        cfg = MatmulBenchConfig(n=n, dtype=dtype, mesh=mesh, reps=reps)
+        out.append(run_matmul_bench(cfg))
+    return out
+
+
+def verify_correctness(mesh: Optional[Mesh] = None, n: int = 256,
+                       dtype: str = "float32", seed: int = 1) -> float:
+    """C == A@B check for the sharded matmul (SURVEY.md §4 integration test:
+    'matmul benchmark correctness (C == A@B)').  Returns max abs error vs
+    the unsharded host reference."""
+    import numpy as np
+
+    mesh = mesh if mesh is not None else local_mesh("data=-1")
+    a, b = make_operands(mesh, n, dtype, seed)
+    a_sh, b_sh = _operand_shardings(mesh)
+    c = jax.jit(jnp.matmul, out_shardings=a_sh)(a, b)
+    ref = np.asarray(a, dtype=np.float64) @ np.asarray(b, dtype=np.float64)
+    return float(np.max(np.abs(np.asarray(c, dtype=np.float64) - ref)))

@@ -1,0 +1,1 @@
+from dtf_tpu.utils import timing  # noqa: F401

@@ -1,0 +1,131 @@
+"""Honest timing under JAX's async dispatch.
+
+The reference timed steps with ``time.time()`` around a synchronous
+``sess.run`` (tf_distributed.py:94,100,116-117) — correct for TF1's blocking
+session but wrong for JAX, where dispatch returns before the TPU finishes
+(SURVEY.md §5.1).  Every timer here blocks on device completion
+(``block_until_ready``) before reading the clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+
+def block(tree: Any) -> Any:
+    """Block until every array in a pytree is computed on device.
+
+    On tunneled/relay platforms (e.g. this image's 'axon' TPU relay),
+    ``block_until_ready`` can return before the device finishes; pulling one
+    scalar to the host is the only reliable completion barrier, so we do
+    both.  The scalar pull touches a single element (one shard), not the
+    whole array.
+    """
+    jax.block_until_ready(tree)
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if isinstance(x, jax.Array)]
+    if leaves:
+        x = leaves[0]
+        idx = (0,) * x.ndim
+        np.asarray(jax.device_get(x[idx] if x.ndim else x))
+    return tree
+
+
+@dataclasses.dataclass
+class Timing:
+    """Wall-clock measurements of a device computation, seconds."""
+
+    times_s: tuple
+    warmup_s: float          # first (compile-inclusive) call
+
+    @property
+    def median_s(self) -> float:
+        return statistics.median(self.times_s)
+
+    @property
+    def best_s(self) -> float:
+        return min(self.times_s)
+
+    @property
+    def mean_s(self) -> float:
+        return statistics.fmean(self.times_s)
+
+
+def time_fn(fn: Callable[[], Any], *, iters: int = 10, warmup: int = 1) -> Timing:
+    """Time ``fn`` (a nullary closure over device arrays), blocking each call.
+
+    The first call includes XLA compilation; it is recorded separately as
+    ``warmup_s`` and never mixed into the steady-state stats.
+    """
+    t0 = time.perf_counter()
+    block(fn())
+    warmup_s = time.perf_counter() - t0
+    for _ in range(max(warmup - 1, 0)):
+        block(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        block(fn())
+        times.append(time.perf_counter() - t0)
+    return Timing(times_s=tuple(times), warmup_s=warmup_s)
+
+
+@dataclasses.dataclass
+class LinFit:
+    """Per-iteration device time from a linear fit of chain length -> time."""
+
+    per_iter_s: float        # slope
+    overhead_s: float        # intercept (host/dispatch/relay constant)
+    points: tuple            # (iters, best_time_s) pairs
+
+
+def time_linfit(fn_of_iters: Callable[[int], Callable[[], Any]],
+                iters_ladder: Sequence[int], *, reps: int = 4) -> LinFit:
+    """Marginal per-iteration device time, free of fixed host/dispatch/relay
+    overhead, via least squares over several chain lengths.
+
+    ``fn_of_iters(k)`` must return a nullary closure running ``k`` chained
+    iterations in one compiled program.  For each ladder entry the best of
+    ``reps`` timed calls is kept (the relay's host-sync cost is ~50-80 ms
+    with jitter of the same order, so a simple two-point difference is far
+    too noisy — SURVEY.md §6.1's "honest timing" requirement).
+    """
+    points = []
+    for k in iters_ladder:
+        t = time_fn(fn_of_iters(k), iters=reps, warmup=1).best_s
+        points.append((k, t))
+    xs = np.array([p[0] for p in points], dtype=np.float64)
+    ys = np.array([p[1] for p in points], dtype=np.float64)
+    A = np.vstack([xs, np.ones_like(xs)]).T
+    (slope, intercept), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    return LinFit(per_iter_s=float(max(slope, 1e-12)),
+                  overhead_s=float(intercept), points=tuple(points))
+
+
+class StepTimer:
+    """Running per-step timer reproducing the reference's AvgTime contract.
+
+    The reference printed ``AvgTime: elapsed/frequency`` ms per batch every
+    ``frequency`` steps (tf_distributed.py:116-122) and cumulative
+    ``Total Time`` at the end (:127).
+    """
+
+    def __init__(self) -> None:
+        self.start = time.perf_counter()
+        self._window_start = self.start
+
+    def window_avg_ms(self, steps: int) -> float:
+        """Average ms/step since the last call (the reference's AvgTime)."""
+        now = time.perf_counter()
+        avg = (now - self._window_start) * 1000.0 / max(steps, 1)
+        self._window_start = now
+        return avg
+
+    def total_s(self) -> float:
+        return time.perf_counter() - self.start
