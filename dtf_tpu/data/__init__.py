@@ -1,0 +1,1 @@
+from dtf_tpu.data.datasets import Dataset, DataSplits, load_mnist, load_cifar10, synthetic_text  # noqa: F401

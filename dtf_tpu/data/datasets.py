@@ -1,0 +1,186 @@
+"""Data pipeline.
+
+The reference loaded MNIST via ``input_data.read_data_sets('MNIST_data',
+one_hot=True)`` and batched with ``mnist.train.next_batch(batch_size)``
+through feed_dict (tf_distributed.py:27-28,108) — on *every* process
+including the PS and even in the matmul benchmark that never used it
+(SURVEY.md §2.5).
+
+This module preserves the ``next_batch`` API shape, with fixes:
+
+* loads lazily (only the processes/workloads that need data);
+* reads the standard IDX files from ``MNIST_data/`` if present; in a
+  zero-egress environment it falls back to a deterministic synthetic dataset
+  with the same shapes/dtypes (class-prototype + noise, linearly separable
+  enough to test convergence);
+* deterministic shuffling from a seed, so runs are bitwise reproducible
+  (the reference's async updates were nondeterministic by design,
+  SURVEY.md §7 "determinism").
+
+Sharding note: batches are produced as host numpy arrays for the *global*
+batch; the trainer device_puts them with the batch sharded over the data
+axes.  Under multi-process SPMD each process produces the same global batch
+from the same seed and jax.make_array_from_process_local_data carves out its
+addressable shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Dataset:
+    """In-memory split with the reference's ``next_batch`` contract."""
+
+    images: np.ndarray          # (N, ...) float32
+    labels: np.ndarray          # (N, num_classes) one-hot float32
+    seed: int = 1
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._order = np.arange(len(self.images))
+        self._rng.shuffle(self._order)
+        self._pos = 0
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.images)
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """Sequential batches over a shuffled epoch; reshuffles at the end
+        (mnist.train.next_batch semantics, tf_distributed.py:108)."""
+        if self._pos + batch_size > self.num_examples:
+            self._rng.shuffle(self._order)
+            self._pos = 0
+        idx = self._order[self._pos:self._pos + batch_size]
+        self._pos += batch_size
+        return self.images[idx], self.labels[idx]
+
+    def epoch_batches(self, batch_size: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        for _ in range(self.num_examples // batch_size):
+            yield self.next_batch(batch_size)
+
+
+@dataclasses.dataclass
+class DataSplits:
+    train: Dataset
+    test: Dataset
+    synthetic: bool = False
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _one_hot(y: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((len(y), n), np.float32)
+    out[np.arange(len(y)), y] = 1.0
+    return out
+
+
+def _synthetic_classification(n: int, feat_shape: tuple, num_classes: int,
+                              seed: int, split_seed: int,
+                              noise: float = 0.35) -> tuple:
+    """Deterministic prototype+noise data, shaped like the real dataset.
+
+    Class prototypes come from ``seed`` only, so train and test splits (which
+    differ in ``split_seed``) are samples of the SAME task."""
+    proto_rng = np.random.default_rng(seed)
+    rng = np.random.default_rng((seed, split_seed))
+    dim = int(np.prod(feat_shape))
+    protos = proto_rng.normal(0, 1, (num_classes, dim)).astype(np.float32)
+    y = rng.integers(0, num_classes, n)
+    x = protos[y] * 0.5 + rng.normal(0, noise, (n, dim)).astype(np.float32)
+    x = (x - x.min()) / (x.max() - x.min())   # [0,1] like pixel data
+    return x.reshape((n, *feat_shape)).astype(np.float32), _one_hot(y, num_classes)
+
+
+def load_mnist(data_dir: str = "MNIST_data", seed: int = 1,
+               flat: bool = True) -> DataSplits:
+    """MNIST as the reference consumed it: 784-dim flat float images in
+    [0,1], one-hot labels (tf_distributed.py:27-28,42-46).  Falls back to
+    synthetic data (same shapes) when the IDX files are absent."""
+    names = {
+        "train_x": ("train-images-idx3-ubyte", 0), "train_y": ("train-labels-idx1-ubyte", 0),
+        "test_x": ("t10k-images-idx3-ubyte", 0), "test_y": ("t10k-labels-idx1-ubyte", 0),
+    }
+
+    def find(base):
+        for suffix in ("", ".gz"):
+            p = os.path.join(data_dir, base + suffix)
+            if os.path.exists(p):
+                return p
+        return None
+
+    paths = {k: find(base) for k, (base, _) in names.items()}
+    if all(paths.values()):
+        def imgs(p):
+            x = _read_idx(p).astype(np.float32) / 255.0
+            return x.reshape(len(x), -1) if flat else x[..., None]
+        train = Dataset(imgs(paths["train_x"]), _one_hot(_read_idx(paths["train_y"]), 10), seed)
+        test = Dataset(imgs(paths["test_x"]), _one_hot(_read_idx(paths["test_y"]), 10), seed)
+        return DataSplits(train, test, synthetic=False)
+
+    shape = (784,) if flat else (28, 28, 1)
+    xtr, ytr = _synthetic_classification(12800, shape, 10, seed, split_seed=0)
+    xte, yte = _synthetic_classification(2560, shape, 10, seed, split_seed=1)
+    return DataSplits(Dataset(xtr, ytr, seed), Dataset(xte, yte, seed), synthetic=True)
+
+
+def load_cifar10(data_dir: str = "cifar-10-batches-py", seed: int = 1) -> DataSplits:
+    """CIFAR-10 (32x32x3) from the standard pickle batches if present, else
+    synthetic with identical shapes."""
+    import pickle
+
+    def batch_files():
+        return ([os.path.join(data_dir, f"data_batch_{i}") for i in range(1, 6)],
+                os.path.join(data_dir, "test_batch"))
+
+    train_files, test_file = batch_files()
+    if all(os.path.exists(p) for p in train_files) and os.path.exists(test_file):
+        def load(files):
+            xs, ys = [], []
+            for p in files if isinstance(files, list) else [files]:
+                with open(p, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(np.asarray(d[b"data"], np.float32) / 255.0)
+                ys.append(np.asarray(d[b"labels"]))
+            x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return np.ascontiguousarray(x), _one_hot(np.concatenate(ys), 10)
+        xtr, ytr = load(train_files)
+        xte, yte = load(test_file)
+        return DataSplits(Dataset(xtr, ytr, seed), Dataset(xte, yte, seed), synthetic=False)
+
+    xtr, ytr = _synthetic_classification(12800, (32, 32, 3), 10, seed, split_seed=0)
+    xte, yte = _synthetic_classification(2560, (32, 32, 3), 10, seed, split_seed=1)
+    return DataSplits(Dataset(xtr, ytr, seed), Dataset(xte, yte, seed), synthetic=True)
+
+
+def synthetic_text(n_seqs: int, seq_len: int, vocab_size: int,
+                   seed: int = 1) -> np.ndarray:
+    """Deterministic token streams for LM pretraining benchmarks (BERT-base
+    config, BASELINE.md).  Markov-ish so masked-LM has learnable structure."""
+    rng = np.random.default_rng(seed)
+    # Each token depends on the previous via a sparse transition table.
+    trans = rng.integers(0, vocab_size, (vocab_size, 4))
+    toks = np.empty((n_seqs, seq_len), np.int32)
+    toks[:, 0] = rng.integers(0, vocab_size, n_seqs)
+    for t in range(1, seq_len):
+        choice = rng.integers(0, 4, n_seqs)
+        follow = trans[toks[:, t - 1], choice]
+        noise = rng.integers(0, vocab_size, n_seqs)
+        use_noise = rng.random(n_seqs) < 0.1
+        toks[:, t] = np.where(use_noise, noise, follow)
+    return toks

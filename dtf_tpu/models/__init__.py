@@ -1,0 +1,1 @@
+from dtf_tpu.models.mlp import MnistMLP  # noqa: F401

@@ -1,0 +1,66 @@
+"""The reference's MNIST MLP, as a pure function.
+
+Architecture parity with tf_distributed.py:39-81: 784 -> 100 sigmoid -> 10,
+weights ~ N(0,1) (tf.random_normal default stddev, :50-53), biases zero
+(:55-57), seed 1 (:49).  Two documented numerics deltas (SURVEY.md §7):
+
+* loss: trained with the stable logits-space cross-entropy instead of the
+  reference's ``-sum(y_*log(softmax))`` (:68-70), which can produce
+  log(0)=-inf;  ``naive_loss`` reproduces the reference formula (a *sum*
+  over the batch, not a mean) for comparison/observability parity.
+* the reference applied gradients asynchronously per worker; here gradients
+  are psum-averaged across the data axis each step (sync DP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import Dense
+from dtf_tpu.nn.losses import accuracy, naive_cross_entropy, softmax_cross_entropy
+
+
+@dataclasses.dataclass
+class MnistMLP(Module):
+    in_dim: int = 784           # tf_distributed.py:43
+    hidden: int = 100           # tf_distributed.py:51
+    num_classes: int = 10       # tf_distributed.py:46
+    init_scale: "float | str" = "reference"   # N(0,1) weights like tf.random_normal
+
+    def __post_init__(self):
+        self.l1 = Dense(self.in_dim, self.hidden, init_scale=self.init_scale,
+                        axes_in="embed", axes_out="mlp")
+        self.l2 = Dense(self.hidden, self.num_classes, init_scale=self.init_scale,
+                        axes_in="mlp", axes_out="embed")
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"l1": self.l1.init(k1), "l2": self.l2.init(k2)}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        """Returns logits (softmax applied inside the loss, unlike the
+        reference's explicit softmax output at tf_distributed.py:65)."""
+        h = jax.nn.sigmoid(self.l1.apply(params["l1"], x))   # :61-62
+        return self.l2.apply(params["l2"], h)                # :64-65
+
+    def axes(self):
+        return {"l1": self.l1.axes(), "l2": self.l2.axes()}
+
+    # --- losses/metrics (the graph ops the reference built, :68-81) ---
+
+    def loss(self, params, batch, rng=None, train=True):
+        x, y = batch
+        logits = self.apply(params, x, train=train, rng=rng)
+        loss = softmax_cross_entropy(logits, y)
+        return loss, {"accuracy": accuracy(logits, y),
+                      "naive_cost": naive_cross_entropy(jax.nn.softmax(logits), y)}
+
+    def eval_metrics(self, params, batch):
+        x, y = batch
+        logits = self.apply(params, x, train=False)
+        return {"accuracy": accuracy(logits, y),
+                "loss": softmax_cross_entropy(logits, y)}

@@ -1,0 +1,25 @@
+"""Pure-functional neural-net layer library.
+
+The reference defined its model as TF1 graph ops with variables placed on a
+parameter server (tf_distributed.py:50-65).  Here models are pure functions:
+a module is a static-config object with ``init(key) -> params`` and
+``apply(params, x) -> y``; params are plain pytrees, so every JAX transform
+(jit/grad/shard_map) and every sharding rule applies uniformly.  Each module
+also exposes ``axes() -> pytree`` of logical axis names mirroring its params,
+which :func:`dtf_tpu.parallel.sharding.apply_rules` maps to mesh shardings —
+the declarative replacement for ``replica_device_setter``.
+"""
+
+from dtf_tpu.nn.core import Module, Sequential
+from dtf_tpu.nn.layers import (
+    Dense, Embedding, LayerNorm, BatchNorm, Conv2D, Dropout,
+)
+from dtf_tpu.nn.losses import (
+    softmax_cross_entropy, naive_cross_entropy, accuracy, mse,
+)
+
+__all__ = [
+    "Module", "Sequential", "Dense", "Embedding", "LayerNorm", "BatchNorm",
+    "Conv2D", "Dropout", "softmax_cross_entropy", "naive_cross_entropy",
+    "accuracy", "mse",
+]

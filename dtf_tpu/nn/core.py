@@ -1,0 +1,78 @@
+"""Module protocol: static config, pure init/apply, logical param axes."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+
+class Module:
+    """Base class for pure-functional modules.
+
+    Subclasses implement:
+
+    * ``init(key) -> params``: build a params pytree from a PRNG key;
+    * ``apply(params, x, *, train=False, rng=None) -> y``: pure forward;
+    * ``axes() -> pytree``: logical axis names (tuples of str/None) mirroring
+      the params pytree, consumed by ``parallel.sharding.apply_rules``.
+
+    Modules hold only static Python configuration — never arrays — so they
+    are safe to close over inside ``jit``.
+    """
+
+    def init(self, key: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params: Any, x: Any, *, train: bool = False,
+              rng: Optional[jax.Array] = None) -> Any:
+        raise NotImplementedError
+
+    def axes(self) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, params: Any, x: Any, **kw: Any) -> Any:
+        return self.apply(params, x, **kw)
+
+
+class Sequential(Module):
+    """Compose modules; params/axes are dicts keyed ``"0", "1", ...``.
+
+    Layers that are plain callables (e.g. activation functions) take no
+    params and appear in neither params nor axes.
+    """
+
+    def __init__(self, layers: Sequence["Module | Callable"]):
+        self.layers = list(layers)
+
+    def _param_layers(self):
+        return [(str(i), l) for i, l in enumerate(self.layers)
+                if isinstance(l, Module)]
+
+    def init(self, key: jax.Array) -> dict:
+        named = self._param_layers()
+        keys = jax.random.split(key, max(len(named), 1))
+        return {name: l.init(k) for (name, l), k in zip(named, keys)}
+
+    def apply(self, params: dict, x: Any, *, train: bool = False,
+              rng: Optional[jax.Array] = None) -> Any:
+        i_param = 0
+        named = self._param_layers()
+        for layer in self.layers:
+            if isinstance(layer, Module):
+                name = named[i_param][0]
+                i_param += 1
+                sub_rng = None
+                if rng is not None:
+                    rng, sub_rng = jax.random.split(rng)
+                x = layer.apply(params[name], x, train=train, rng=sub_rng)
+            else:
+                x = layer(x)
+        return x
+
+    def axes(self) -> dict:
+        return {name: l.axes() for name, l in self._param_layers()}
+
+
+def count_params(params: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

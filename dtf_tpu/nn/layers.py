@@ -1,0 +1,215 @@
+"""Standard layers with logical-axis annotations.
+
+Initializers default to fan-in scaling; the reference's MNIST MLP used
+``tf.random_normal`` with stddev 1.0 (tf_distributed.py:50-53), reproducible
+here via ``init_scale="reference"`` on Dense (models/mlp.py uses it for
+parity; the numerics delta is documented there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.core import Module
+
+
+def _fan_in_normal(key, shape, dtype, fan_in):
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fan_in, dtype))
+
+
+@dataclasses.dataclass
+class Dense(Module):
+    """y = x @ W + b.
+
+    ``axes_in``/``axes_out`` are the logical axis names of the weight's two
+    dims (default ``("embed", "mlp")``); pass e.g. ``("mlp", "embed")`` for a
+    projection back, so tensor-parallel rules shard the pair correctly
+    (megatron-style column-then-row).
+    """
+
+    in_dim: int
+    out_dim: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    init_scale: "float | str" = "fan_in"   # "fan_in" | "reference" | float stddev
+    axes_in: Optional[str] = "embed"
+    axes_out: Optional[str] = "mlp"
+
+    def init(self, key):
+        kw, _ = jax.random.split(key)
+        if self.init_scale == "fan_in":
+            w = _fan_in_normal(kw, (self.in_dim, self.out_dim), self.dtype, self.in_dim)
+        elif self.init_scale == "reference":
+            # tf.random_normal default stddev=1.0 (tf_distributed.py:50-53)
+            w = jax.random.normal(kw, (self.in_dim, self.out_dim), self.dtype)
+        else:
+            w = jax.random.normal(kw, (self.in_dim, self.out_dim), self.dtype) * self.init_scale
+        p = {"w": w}
+        if self.use_bias:
+            # biases zero, as the reference (tf_distributed.py:55-57)
+            p["b"] = jnp.zeros((self.out_dim,), self.dtype)
+        return p
+
+    def apply(self, params, x, *, train=False, rng=None):
+        y = x @ params["w"]
+        if self.use_bias:
+            y = y + params["b"]
+        return y
+
+    def axes(self):
+        p = {"w": (self.axes_in, self.axes_out)}
+        if self.use_bias:
+            p["b"] = (self.axes_out,)
+        return p
+
+
+@dataclasses.dataclass
+class Embedding(Module):
+    vocab_size: int
+    dim: int
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return {"table": jax.random.normal(key, (self.vocab_size, self.dim),
+                                           self.dtype) * 0.02}
+
+    def apply(self, params, ids, *, train=False, rng=None):
+        return jnp.take(params["table"], ids, axis=0)
+
+    def attend(self, params, x):
+        """Tied-embedding logits (x @ table.T)."""
+        return x @ params["table"].T
+
+    def axes(self):
+        return {"table": ("vocab", "embed")}
+
+
+@dataclasses.dataclass
+class LayerNorm(Module):
+    dim: int
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return {"scale": jnp.ones((self.dim,), self.dtype),
+                "bias": jnp.zeros((self.dim,), self.dtype)}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        # Compute statistics in fp32 regardless of activation dtype.
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+    def axes(self):
+        return {"scale": ("embed",), "bias": ("embed",)}
+
+
+@dataclasses.dataclass
+class BatchNorm(Module):
+    """Batch normalization with functional running stats.
+
+    Under pjit the batch dim is sharded over ``data``, but ``jnp.mean`` over
+    a sharded axis is a *global* mean — GSPMD inserts the cross-replica
+    all-reduce automatically, so this is synchronized BatchNorm for free (the
+    collective rides ICI).  Running stats are part of a separate ``state``
+    pytree threaded through apply: ``y, new_state = bn.apply_stateful(...)``.
+    """
+
+    dim: int
+    momentum: float = 0.9
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return {"scale": jnp.ones((self.dim,), self.dtype),
+                "bias": jnp.zeros((self.dim,), self.dtype)}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.dim,), jnp.float32),
+                "var": jnp.ones((self.dim,), jnp.float32)}
+
+    def apply_stateful(self, params, state, x, *, train: bool):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
+            new_state = {
+                "mean": self.momentum * state["mean"] + (1 - self.momentum) * mean,
+                "var": self.momentum * state["var"] + (1 - self.momentum) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), new_state
+
+    def apply(self, params, x, *, train=False, rng=None):
+        raise TypeError("BatchNorm is stateful; use apply_stateful")
+
+    def axes(self):
+        return {"scale": ("embed",), "bias": ("embed",)}
+
+
+@dataclasses.dataclass
+class Conv2D(Module):
+    """NHWC conv; lowers to XLA conv -> MXU."""
+
+    in_ch: int
+    out_ch: int
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        kh, kw = self.kernel
+        fan_in = kh * kw * self.in_ch
+        w = _fan_in_normal(key, (kh, kw, self.in_ch, self.out_ch),
+                           self.dtype, fan_in)
+        p = {"w": w}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.out_ch,), self.dtype)
+        return p
+
+    def apply(self, params, x, *, train=False, rng=None):
+        y = jax.lax.conv_general_dilated(
+            x, params["w"], window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["b"]
+        return y
+
+    def axes(self):
+        p = {"w": (None, None, "conv_in", "conv_out")}
+        if self.use_bias:
+            p["b"] = ("conv_out",)
+        return p
+
+
+@dataclasses.dataclass
+class Dropout(Module):
+    rate: float
+
+    def init(self, key):
+        return {}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x
+        if rng is None:
+            raise ValueError("Dropout needs rng when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    def axes(self):
+        return {}

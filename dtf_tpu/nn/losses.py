@@ -1,0 +1,44 @@
+"""Losses and metrics.
+
+The reference's hand-written cross-entropy ``-sum(y_ * log(softmax(y)))``
+(tf_distributed.py:68-70) is numerically unstable — log of a softmax that can
+underflow to 0.  :func:`softmax_cross_entropy` is the stable logits-space
+form (logsumexp); :func:`naive_cross_entropy` reproduces the reference's
+exact math for parity testing, documenting the numerics delta (SURVEY.md §7
+step 4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels_onehot: jax.Array,
+                          reduction: str = "mean") -> jax.Array:
+    """Stable cross-entropy from logits; labels one-hot (reference feeds
+    one-hot labels, tf_distributed.py:27 ``one_hot=True``)."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per_example = -jnp.sum(labels_onehot * log_probs, axis=-1)
+    if reduction == "mean":
+        return jnp.mean(per_example)
+    if reduction == "sum":
+        return jnp.sum(per_example)
+    return per_example
+
+
+def naive_cross_entropy(probs: jax.Array, labels_onehot: jax.Array) -> jax.Array:
+    """The reference's exact (unstable) formula, tf_distributed.py:70:
+    ``-reduce_sum(y_ * log(y))`` over the batch — note: *sum*, not mean."""
+    return -jnp.sum(labels_onehot * jnp.log(probs))
+
+
+def accuracy(logits_or_probs: jax.Array, labels_onehot: jax.Array) -> jax.Array:
+    """Argmax-equality accuracy (tf_distributed.py:78-81)."""
+    pred = jnp.argmax(logits_or_probs, axis=-1)
+    true = jnp.argmax(labels_onehot, axis=-1)
+    return jnp.mean((pred == true).astype(jnp.float32))
+
+
+def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean((pred - target) ** 2)

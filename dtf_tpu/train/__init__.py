@@ -1,0 +1,2 @@
+from dtf_tpu.train.trainer import Trainer, TrainState, make_train_step, put_global_batch  # noqa: F401
+from dtf_tpu.train.metrics import MetricLogger  # noqa: F401

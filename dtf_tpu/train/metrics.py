@@ -1,0 +1,75 @@
+"""Observability: the reference's console contract + a metric writer.
+
+Console parity (golden-output contract, SURVEY.md §4): the reference printed
+every ``frequency`` steps (tf_distributed.py:118-122)
+
+    Step: %d,  Epoch: %2d,  Batch: %3d of %3d,  Cost: %.4f,  AvgTime: %3.2fms
+
+and per epoch (:126-128)
+
+    Test-Accuracy: %2.2f
+    Total Time: %3.2fs
+    Final Cost: %.4f
+
+Metrics are also appended to ``<logdir>/metrics.csv`` (the TensorBoard
+equivalent of the reference's per-step summary writer, :84-88,112 — but
+buffered, not a per-step host sync).  Only the coordinator process writes
+(SPMD: every process runs the same code; the reference instead relied on
+each worker writing to its own local /tmp, :24).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional
+
+
+def format_step_line(step: int, epoch: int, batch: int, batch_count: int,
+                     cost: float, avg_ms: float) -> str:
+    """Byte-identical to the reference's print (tf_distributed.py:118-122,
+    which joins print args with single spaces)."""
+    return ("Step: %d, " % step +
+            " Epoch: %2d, " % epoch +
+            " Batch: %3d of %3d, " % (batch, batch_count) +
+            " Cost: %.4f, " % cost +
+            " AvgTime: %3.2fms" % avg_ms)
+
+
+class MetricLogger:
+    def __init__(self, logdir: Optional[str] = None, is_coordinator: bool = True,
+                 quiet: bool = False):
+        self.is_coordinator = is_coordinator
+        self.quiet = quiet
+        self._csv = None
+        self._writer = None
+        if logdir and is_coordinator:
+            os.makedirs(logdir, exist_ok=True)
+            self._csv = open(os.path.join(logdir, "metrics.csv"), "a", newline="")
+            self._writer = csv.writer(self._csv)
+            if self._csv.tell() == 0:
+                self._writer.writerow(["step", "metric", "value"])
+
+    def print(self, msg: str) -> None:
+        if self.is_coordinator and not self.quiet:
+            print(msg, flush=True)
+
+    def step_line(self, step: int, epoch: int, batch: int, batch_count: int,
+                  cost: float, avg_ms: float) -> None:
+        self.print(format_step_line(step, epoch, batch, batch_count, cost, avg_ms))
+
+    def scalar(self, step: int, name: str, value: float) -> None:
+        if self._writer:
+            self._writer.writerow([step, name, float(value)])
+
+    def epoch_summary(self, test_accuracy: float, total_s: float,
+                      final_cost: float) -> None:
+        """The reference's per-epoch block (tf_distributed.py:126-128)."""
+        self.print("Test-Accuracy: %2.2f" % test_accuracy)
+        self.print("Total Time: %3.2fs" % total_s)
+        self.print("Final Cost: %.4f" % final_cost)
+
+    def close(self) -> None:
+        if self._csv:
+            self._csv.close()
+            self._csv = self._writer = None

@@ -1,0 +1,219 @@
+"""Training driver: jitted sync-DP train step + the reference's epoch loop.
+
+Replaces the reference's L4 layer (Supervisor session + epoch/step loop,
+tf_distributed.py:92-131).  Differences by design (SURVEY.md §2.14, §7):
+
+* the step is ONE compiled XLA program over the whole mesh — forward,
+  backward, gradient all-reduce and update fused; no per-step host round
+  trips for parameters (the reference moved all params+grads over gRPC
+  every step, §3.2);
+* gradient sync is a psum/pmean over the ``data`` axis.  Two interchangeable
+  implementations are provided and tested equal:
+  - ``implicit`` (default): ``jit`` + shardings; GSPMD inserts the
+    all-reduce from the sharded-batch mean;
+  - ``explicit``: ``shard_map`` per-device code calling ``lax.pmean`` — the
+    literal "psum data-parallel" form (BASELINE.json north star);
+* deterministic: same seed -> same params on every process, same batches,
+  same updates (the reference's async PS was nondeterministic by design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu import optim as optim_lib
+from dtf_tpu.cluster import Cluster
+from dtf_tpu.config import TrainConfig
+from dtf_tpu.parallel import sharding as sh
+from dtf_tpu.train.metrics import MetricLogger
+from dtf_tpu.utils.timing import StepTimer, block
+
+TrainState = dict  # {"params": pytree, "opt_state": pytree, "step": i32}
+
+
+def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
+               mesh: Mesh, param_shardings: Optional[Any] = None) -> TrainState:
+    """Deterministic same-seed init on all processes — the SPMD replacement
+    for the reference's chief-runs-init_op + non-chief-polls protocol
+    (tf_distributed.py:92-96; SURVEY.md §2.13 'coordinated init')."""
+    params = model.init(jax.random.key(seed))
+    if param_shardings is None:
+        params = sh.replicate(mesh, params)
+    else:
+        params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
+    opt_state = optimizer.init(params)
+    return {"params": params, "opt_state": opt_state,
+            "step": sh.replicate(mesh, jnp.zeros((), jnp.int32))}
+
+
+def put_global_batch(mesh: Mesh, batch: Any) -> Any:
+    """Place a host global batch onto the mesh, leading dim sharded over the
+    data axes.  Single-process: plain device_put.  Multi-process: each
+    process holds the same global batch and contributes its addressable
+    shards (processes feed disjoint slices by construction since they build
+    identical global batches from the same seed)."""
+    if jax.process_count() == 1:
+        return sh.shard_batch(mesh, batch)
+
+    def put(x):
+        x = np.asarray(x)
+        sharding = (sh.batch_spec(mesh, x.ndim) if np.ndim(x) > 0
+                    else sh.replicate(mesh))
+        return jax.make_array_from_process_local_data(sharding, x)
+    return jax.tree_util.tree_map(put, batch)
+
+
+def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
+                    mesh: Mesh, mode: str = "implicit",
+                    donate: bool = True) -> Callable:
+    """Build the compiled train step: (state, batch, rng) -> (state, metrics).
+
+    ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
+    *means* over the batch dim so both modes agree.
+    """
+
+    def grads_and_update(params, opt_state, step, batch, rng, grad_sync):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, rng)
+        grads, loss, aux = grad_sync(grads, loss, aux)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optim_lib.apply_updates(params, updates)
+        metrics = {"loss": loss, **aux}
+        return {"params": params, "opt_state": opt_state, "step": step + 1}, metrics
+
+    if mode == "implicit":
+        # Global-batch program; the loss mean over the sharded batch makes
+        # GSPMD emit the gradient all-reduce.
+        @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def step_fn(state, batch, rng):
+            return grads_and_update(
+                state["params"], state["opt_state"], state["step"], batch, rng,
+                grad_sync=lambda g, l, a: (g, l, a))
+
+        return step_fn
+
+    if mode == "explicit":
+        # Literal psum data-parallel: per-device code, explicit collectives.
+        data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+        def per_device(state, batch, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index(data_axes[0]))
+
+            def sync(grads, loss, aux):
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, data_axes), grads)
+                loss = lax.pmean(loss, data_axes)
+                aux = jax.tree_util.tree_map(
+                    lambda v: lax.pmean(v, data_axes), aux)
+                return grads, loss, aux
+
+            return grads_and_update(state["params"], state["opt_state"],
+                                    state["step"], batch, rng, sync)
+
+        batch_p = P(data_axes)
+        mapped = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), batch_p, P()), out_specs=(P(), P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    raise ValueError(f"mode must be 'implicit' or 'explicit', got {mode!r}")
+
+
+def make_eval_fn(model, mesh: Mesh) -> Callable:
+    """Batched full-test-set eval (the reference ran the 10k test set in one
+    feed_dict pass on every worker, tf_distributed.py:126; here it is a
+    jitted sharded forward, coordinator reads the scalar)."""
+
+    @jax.jit
+    def eval_batch(params, batch):
+        return model.eval_metrics(params, batch)
+
+    def evaluate(params, dataset, batch_size: int = 2048) -> dict:
+        n = (dataset.num_examples // batch_size) or 1
+        bs = min(batch_size, dataset.num_examples)
+        totals = None
+        for i in range(n):
+            batch = (dataset.images[i * bs:(i + 1) * bs],
+                     dataset.labels[i * bs:(i + 1) * bs])
+            m = eval_batch(params, put_global_batch(mesh, batch))
+            totals = m if totals is None else jax.tree_util.tree_map(
+                jnp.add, totals, m)
+        return {k: float(v) / n for k, v in totals.items()}
+
+    return evaluate
+
+
+@dataclasses.dataclass
+class Trainer:
+    """The reference's training cycle (tf_distributed.py:100-128), driven by
+    a compiled step."""
+
+    cluster: Cluster
+    model: Any
+    optimizer: optim_lib.Optimizer
+    cfg: TrainConfig
+    mode: str = "implicit"
+    logger: Optional[MetricLogger] = None
+
+    def __post_init__(self):
+        mesh = self.cluster.mesh
+        self.logger = self.logger or MetricLogger(
+            self.cfg.logdir, self.cluster.is_coordinator)
+        self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
+                                       mode=self.mode)
+        self.eval_fn = make_eval_fn(self.model, mesh)
+        self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
+
+    @property
+    def global_batch_size(self) -> int:
+        if self.cfg.per_device_batch:
+            return self.cfg.per_device_batch * self.cluster.num_devices
+        return self.cfg.batch_size
+
+    def fit(self, splits, epochs: Optional[int] = None) -> dict:
+        """Epoch loop with the reference's exact console contract."""
+        mesh = self.cluster.mesh
+        cfg = self.cfg
+        epochs = epochs if epochs is not None else cfg.epochs
+        rng = jax.random.key(cfg.seed + 17)
+        bs = self.global_batch_size
+        timer = StepTimer()
+        last_cost = float("nan")
+
+        for epoch in range(epochs):
+            batch_count = splits.train.num_examples // bs   # :104
+            count = 0
+            for i in range(batch_count):
+                batch = put_global_batch(mesh, splits.train.next_batch(bs))
+                rng, step_rng = jax.random.split(rng)
+                self.state, metrics = self.step_fn(self.state, batch, step_rng)
+                count += 1
+                if count % cfg.log_frequency == 0 or i + 1 == batch_count:
+                    # Sync point: read back the metrics (the reference paid
+                    # this every step via sess.run; we pay it only when
+                    # logging).
+                    cost = float(metrics["loss"])
+                    step = int(self.state["step"])
+                    avg_ms = timer.window_avg_ms(count)
+                    self.logger.step_line(step, epoch + 1, i + 1, batch_count,
+                                          cost, avg_ms)
+                    self.logger.scalar(step, "cost", cost)
+                    self.logger.scalar(step, "avg_ms", avg_ms)
+                    count = 0
+                    last_cost = cost
+            ev = self.eval_fn(self.state["params"], splits.test)
+            self.logger.epoch_summary(ev["accuracy"], timer.total_s(), last_cost)
+            self.logger.scalar(int(self.state["step"]), "test_accuracy",
+                               ev["accuracy"])
+        block(self.state)
+        return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
+                "steps": int(self.state["step"]), "total_s": timer.total_s()}

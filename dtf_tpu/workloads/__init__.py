@@ -1,0 +1,5 @@
+"""Runnable workload entry points (the reference's scripts, re-done).
+
+Each preserves the reference CLI (``--job_name``, ``--task_index``) plus the
+framework's topology flags; zero flags runs single-process on local devices.
+"""

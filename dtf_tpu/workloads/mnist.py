@@ -1,0 +1,53 @@
+"""MNIST MLP data-parallel training — the reference's main workload.
+
+Reference: ``python tf_distributed.py --job_name=worker --task_index=k``
+(async PS SGD, 1 PS + 5 workers, tf_distributed.py).  Here:
+
+    python -m dtf_tpu.workloads.mnist [--epochs 20] [--mesh data=-1]
+        [--job_name worker --task_index k --coordinator_address h:p
+         --num_processes N]           # multi-host
+        [--mode explicit]             # literal psum shard_map step
+
+Same architecture/hyperparams (784-100-10 sigmoid/softmax, SGD lr 5e-4,
+batch 100, seed 1) and the same console log contract.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from dtf_tpu import optim
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
+    from dtf_tpu.data import load_mnist
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.train.trainer import Trainer
+
+    parser = build_parser("dtf_tpu MNIST MLP (reference: tf_distributed.py)")
+    parser.add_argument("--mode", choices=["implicit", "explicit"],
+                        default="implicit",
+                        help="gradient sync: GSPMD-inserted (implicit) or "
+                             "shard_map+psum (explicit)")
+    ns = parser.parse_args(argv)
+    cluster_cfg = _from_namespace(ClusterConfig, ns)
+    train_cfg = _from_namespace(TrainConfig, ns)
+
+    cluster = bootstrap(cluster_cfg)
+    splits = load_mnist(seed=train_cfg.seed)
+    if splits.synthetic and cluster.is_coordinator:
+        print("[dtf_tpu] MNIST_data/ not found; using deterministic "
+              "synthetic data (zero-egress environment)")
+
+    model = MnistMLP()
+    trainer = Trainer(cluster, model, optim.sgd(train_cfg.learning_rate),
+                      train_cfg, mode=ns.mode)
+    result = trainer.fit(splits)
+    if cluster.is_coordinator:
+        print("done")   # tf_distributed.py:131
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

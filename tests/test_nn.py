@@ -1,0 +1,155 @@
+"""NN library + optimizer unit tests (SURVEY.md §4: model fwd/loss numerics
+vs closed form, reference math at tf_distributed.py:60-70)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu import optim
+from dtf_tpu.nn import (
+    BatchNorm, Conv2D, Dense, Dropout, Embedding, LayerNorm, Sequential,
+    accuracy, naive_cross_entropy, softmax_cross_entropy,
+)
+from dtf_tpu.models.mlp import MnistMLP
+
+
+class TestLayers:
+    def test_dense_matches_closed_form(self):
+        d = Dense(4, 3)
+        p = d.init(jax.random.key(0))
+        x = jnp.ones((2, 4))
+        np.testing.assert_allclose(d.apply(p, x),
+                                   x @ p["w"] + p["b"], rtol=1e-6)
+
+    def test_dense_reference_init_is_unit_normal(self):
+        d = Dense(784, 100, init_scale="reference")
+        p = d.init(jax.random.key(1))
+        assert abs(float(jnp.std(p["w"])) - 1.0) < 0.02   # tf.random_normal stddev 1
+        assert float(jnp.abs(p["b"]).max()) == 0.0        # zeros, :55-57
+
+    def test_layernorm_normalizes(self):
+        ln = LayerNorm(16)
+        p = ln.init(jax.random.key(0))
+        y = ln.apply(p, jax.random.normal(jax.random.key(1), (4, 16)) * 5 + 3)
+        np.testing.assert_allclose(np.mean(np.asarray(y), -1), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.std(np.asarray(y), -1), 1.0, atol=1e-2)
+
+    def test_conv_shape(self):
+        c = Conv2D(3, 8, (3, 3), strides=(2, 2))
+        p = c.init(jax.random.key(0))
+        assert c.apply(p, jnp.zeros((2, 32, 32, 3))).shape == (2, 16, 16, 8)
+
+    def test_batchnorm_train_stats(self):
+        bn = BatchNorm(4)
+        p, s = bn.init(jax.random.key(0)), bn.init_state()
+        x = jax.random.normal(jax.random.key(1), (64, 4)) * 3 + 1
+        y, s2 = bn.apply_stateful(p, s, x, train=True)
+        np.testing.assert_allclose(np.mean(np.asarray(y), 0), 0.0, atol=1e-4)
+        assert not np.allclose(s2["mean"], s["mean"])   # stats moved
+
+    def test_dropout_train_vs_eval(self):
+        dr = Dropout(0.5)
+        x = jnp.ones((1000,))
+        y = dr.apply({}, x, train=True, rng=jax.random.key(0))
+        assert float(jnp.mean(y == 0)) == pytest.approx(0.5, abs=0.1)
+        np.testing.assert_array_equal(dr.apply({}, x, train=False), x)
+
+    def test_embedding_lookup(self):
+        e = Embedding(10, 4)
+        p = e.init(jax.random.key(0))
+        out = e.apply(p, jnp.array([1, 5]))
+        np.testing.assert_array_equal(out, p["table"][jnp.array([1, 5])])
+
+    def test_sequential_composes_and_axes(self):
+        m = Sequential([Dense(4, 8), jax.nn.relu, Dense(8, 2, axes_in="mlp",
+                                                        axes_out="embed")])
+        p = m.init(jax.random.key(0))
+        assert m.apply(p, jnp.ones((1, 4))).shape == (1, 2)
+        ax = m.axes()
+        assert ax["0"]["w"] == ("embed", "mlp")
+        assert ax["2"]["w"] == ("mlp", "embed")
+
+
+class TestLosses:
+    def test_stable_xent_matches_naive_where_stable(self):
+        logits = jax.random.normal(jax.random.key(0), (8, 10))
+        y = jax.nn.one_hot(jnp.arange(8) % 10, 10)
+        stable = softmax_cross_entropy(logits, y, reduction="sum")
+        naive = naive_cross_entropy(jax.nn.softmax(logits), y)
+        np.testing.assert_allclose(float(stable), float(naive), rtol=1e-5)
+
+    def test_stable_xent_survives_extreme_logits(self):
+        """The reference formula (tf_distributed.py:70) produces inf here."""
+        logits = jnp.array([[1000.0, -1000.0]])
+        y = jnp.array([[0.0, 1.0]])
+        naive = naive_cross_entropy(jax.nn.softmax(logits), y)
+        assert not bool(jnp.isfinite(naive))        # reference math: inf
+        assert bool(jnp.isfinite(softmax_cross_entropy(logits, y)))
+
+    def test_accuracy(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+        y = jnp.array([[1.0, 0.0], [0.0, 1.0], [0.0, 1.0]])
+        assert float(accuracy(logits, y)) == pytest.approx(2 / 3)
+
+
+class TestOptim:
+    def test_sgd_matches_reference_update_rule(self):
+        """w -= lr*g, the reference's GradientDescentOptimizer
+        (tf_distributed.py:73-76)."""
+        opt = optim.sgd(0.0005)
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.full((3,), 2.0)}
+        upd, _ = opt.update(grads, opt.init(params), params)
+        new = optim.apply_updates(params, upd)
+        np.testing.assert_allclose(new["w"], 1.0 - 0.0005 * 2.0, rtol=1e-6)
+
+    def test_momentum(self):
+        opt = optim.momentum(0.1, beta=0.9)
+        p = {"w": jnp.zeros(())}
+        g = {"w": jnp.ones(())}
+        s = opt.init(p)
+        u1, s = opt.update(g, s, p)
+        u2, s = opt.update(g, s, p)
+        assert float(u2["w"]) == pytest.approx(-0.1 * 1.9)
+
+    def test_adam_step_direction(self):
+        opt = optim.adam(1e-3)
+        p = {"w": jnp.zeros((2,))}
+        g = {"w": jnp.array([1.0, -1.0])}
+        s = opt.init(p)
+        u, s = opt.update(g, s, p)
+        # First Adam step is ~ -lr * sign(g).
+        np.testing.assert_allclose(np.asarray(u["w"]), [-1e-3, 1e-3], rtol=1e-3)
+
+    def test_clip_by_global_norm(self):
+        opt = optim.clip_by_global_norm(optim.sgd(1.0), 1.0)
+        g = {"w": jnp.array([3.0, 4.0])}   # norm 5
+        u, _ = opt.update(g, (), None)
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(u["w"])), 1.0,
+                                   rtol=1e-5)
+
+    def test_warmup_cosine_schedule(self):
+        sched = optim.warmup_cosine(1.0, 10, 110)
+        assert float(sched(jnp.asarray(5))) == pytest.approx(0.5)
+        assert float(sched(jnp.asarray(10))) == pytest.approx(1.0, abs=1e-3)
+        assert float(sched(jnp.asarray(110))) == pytest.approx(0.0, abs=1e-6)
+
+
+class TestMnistMLP:
+    def test_forward_shapes_and_seed1_determinism(self):
+        m = MnistMLP()
+        p1 = m.init(jax.random.key(1))
+        p2 = m.init(jax.random.key(1))
+        x = jnp.zeros((5, 784))
+        assert m.apply(p1, x).shape == (5, 10)
+        np.testing.assert_array_equal(p1["l1"]["w"], p2["l1"]["w"])
+
+    def test_loss_returns_aux(self):
+        m = MnistMLP(init_scale="fan_in")
+        p = m.init(jax.random.key(1))
+        x = jax.random.uniform(jax.random.key(0), (4, 784))
+        y = jax.nn.one_hot(jnp.arange(4) % 10, 10)
+        loss, aux = m.loss(p, (x, y))
+        assert jnp.isfinite(loss)
+        assert set(aux) == {"accuracy", "naive_cost"}

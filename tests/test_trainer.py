@@ -1,0 +1,124 @@
+"""Trainer integration tests on the simulated 8-device mesh (SURVEY.md §4:
+end-to-end MNIST convergence; implicit/explicit step equivalence;
+determinism; log-format golden contract)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu import optim
+from dtf_tpu.cluster import Cluster
+from dtf_tpu.config import ClusterConfig, TrainConfig
+from dtf_tpu.data import load_mnist
+from dtf_tpu.models.mlp import MnistMLP
+from dtf_tpu.parallel.mesh import make_mesh
+from dtf_tpu.train.metrics import format_step_line
+from dtf_tpu.train.trainer import (
+    Trainer, init_state, make_train_step, put_global_batch,
+)
+
+
+def make_cluster(mesh):
+    return Cluster(config=ClusterConfig(), mesh=mesh)
+
+
+@pytest.fixture()
+def small_cfg(tmp_path):
+    return TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                       log_frequency=50, seed=1, logdir=str(tmp_path))
+
+
+class TestTrainStep:
+    def test_implicit_explicit_equivalence(self, mesh8):
+        """The GSPMD-inserted all-reduce and the literal shard_map psum must
+        produce identical updates (both are 'psum data-parallel')."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        batch_np = (np.random.default_rng(0).random((16, 784), np.float32),
+                    np.eye(10, dtype=np.float32)[np.arange(16) % 10])
+        rng = jax.random.key(0)
+
+        results = {}
+        for mode in ("implicit", "explicit"):
+            state = init_state(model, opt, seed=1, mesh=mesh8)
+            step = make_train_step(model.loss, opt, mesh8, mode=mode,
+                                   donate=False)
+            batch = put_global_batch(mesh8, batch_np)
+            state, metrics = step(state, batch, rng)
+            results[mode] = (state, metrics)
+
+        pa = results["implicit"][0]["params"]
+        pb = results["explicit"][0]["params"]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                    rtol=2e-5, atol=1e-6),
+            pa, pb)
+        assert float(results["implicit"][1]["loss"]) == pytest.approx(
+            float(results["explicit"][1]["loss"]), rel=2e-5)
+
+    def test_step_is_deterministic(self, mesh8):
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        batch_np = (np.random.default_rng(0).random((16, 784), np.float32),
+                    np.eye(10, dtype=np.float32)[np.arange(16) % 10])
+
+        losses = []
+        for _ in range(2):
+            state = init_state(model, opt, seed=1, mesh=mesh8)
+            step = make_train_step(model.loss, opt, mesh8, donate=False)
+            _, m = step(state, put_global_batch(mesh8, batch_np),
+                        jax.random.key(0))
+            losses.append(float(m["loss"]))
+        assert losses[0] == losses[1]
+
+    def test_global_step_counts_sync_updates(self, mesh8):
+        """global_step semantics: the reference counted every async worker
+        apply (tf_distributed.py:39,75-76); here one step == one global
+        update."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        state = init_state(model, opt, seed=1, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False)
+        batch = put_global_batch(
+            mesh8, (np.zeros((8, 784), np.float32),
+                    np.eye(10, dtype=np.float32)[np.arange(8) % 10]))
+        for i in range(3):
+            state, _ = step(state, batch, jax.random.key(i))
+        assert int(state["step"]) == 3
+
+
+class TestTrainerEndToEnd:
+    def test_mnist_converges_and_logs_contract(self, mesh8, small_cfg, capsys):
+        """End-to-end: synthetic MNIST, 1 epoch, accuracy well above chance,
+        console lines match the reference format."""
+        cluster = make_cluster(mesh8)
+        model = MnistMLP(init_scale="fan_in")
+        trainer = Trainer(cluster, model, optim.sgd(small_cfg.learning_rate),
+                          small_cfg)
+        splits = load_mnist(seed=1)
+        result = trainer.fit(splits)
+        assert result["test_accuracy"] > 0.5     # chance = 0.1
+        out = capsys.readouterr().out
+        assert re.search(r"Step: \d+, {2}Epoch: +\d+, {2}Batch: +\d+ of +\d+, "
+                         r" Cost: \d+\.\d{4}, {2}AvgTime: +\d+\.\d{2}ms", out)
+        assert re.search(r"Test-Accuracy: \d+\.\d{2}", out)
+        assert re.search(r"Total Time: +\d+\.\d{2}s", out)
+        assert re.search(r"Final Cost: \d+\.\d{4}", out)
+
+    def test_metrics_csv_written(self, mesh8, small_cfg, tmp_path):
+        cluster = make_cluster(mesh8)
+        trainer = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                          optim.sgd(0.05), small_cfg)
+        trainer.fit(load_mnist(seed=1), epochs=1)
+        trainer.logger.close()
+        csv_path = tmp_path / "metrics.csv"
+        assert csv_path.exists()
+        content = csv_path.read_text()
+        assert "cost" in content and "test_accuracy" in content
+
+    def test_reference_format_golden(self):
+        line = format_step_line(100, 1, 100, 500, 1.2345, 12.34)
+        assert line == "Step: 100,  Epoch:  1,  Batch: 100 of 500,  Cost: 1.2345,  AvgTime: 12.34ms"
