@@ -1,0 +1,186 @@
+"""BERT-base encoder with masked-LM pretraining objective.
+
+North-star workload "BERT-base data-parallel pretrain" (BASELINE.md; the
+reference itself has no sequence models, SURVEY.md §5.7).  TPU-first design:
+
+* one encoder-layer function scanned over stacked per-layer params
+  (``lax.scan``) — one compiled layer body instead of 12 inlined copies
+  (faster compiles, and the stacked leading axis is the natural pipeline
+  ("stage") axis for pipeline parallelism);
+* logical-axis annotations give megatron tensor parallelism for free via
+  the rule table (QKV column-parallel, output row-parallel, MLP in/out
+  pair) — no model changes per mesh shape;
+* dynamic masking is computed inside the jitted step from the step rng
+  (static shapes: a boolean mask + weighted loss, no gathers of dynamic
+  size);
+* activations bf16-friendly: LayerNorm stats in fp32, loss in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.attention import MultiHeadAttention
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.float32
+    mask_token: int = 103            # [MASK] in the standard vocab
+    mask_rate: float = 0.15
+    attn_impl: Optional[Any] = None  # pluggable (ring attention etc.)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-size config (CPU-mesh friendly)."""
+        d = dict(vocab_size=128, dim=32, num_layers=2, num_heads=4,
+                 mlp_dim=64, max_len=32, mask_token=3)
+        d.update(kw)
+        return cls(**d)
+
+
+class BertEncoderLayer(Module):
+    """Post-LN transformer block (attention -> add&norm -> MLP -> add&norm)."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype,
+                                       attn_impl=cfg.attn_impl)
+        self.ln1 = LayerNorm(cfg.dim)
+        self.ln2 = LayerNorm(cfg.dim)
+        self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
+                         axes_in="embed", axes_out="mlp")
+        self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
+                         axes_in="mlp", axes_out="embed")
+
+    def init(self, key):
+        ka, k1, k2, kf1, kf2 = jax.random.split(key, 5)
+        return {"attn": self.attn.init(ka), "ln1": self.ln1.init(k1),
+                "ln2": self.ln2.init(k2), "fc1": self.fc1.init(kf1),
+                "fc2": self.fc2.init(kf2)}
+
+    def apply(self, params, x, *, mask=None, train=False, rng=None):
+        a = self.attn.apply(params["attn"], x, mask=mask)
+        x = self.ln1.apply(params["ln1"], x + a)
+        h = self.fc2.apply(params["fc2"],
+                           jax.nn.gelu(self.fc1.apply(params["fc1"], x)))
+        return self.ln2.apply(params["ln2"], x + h)
+
+    def axes(self):
+        return {"attn": self.attn.axes(), "ln1": self.ln1.axes(),
+                "ln2": self.ln2.axes(), "fc1": self.fc1.axes(),
+                "fc2": self.fc2.axes()}
+
+
+@dataclasses.dataclass
+class BertMLM(Module):
+    """Embeddings + scanned encoder stack + tied MLM head."""
+
+    cfg: BertConfig
+
+    def __post_init__(self):
+        cfg = self.cfg
+        self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
+        self.pos = Embedding(cfg.max_len, cfg.dim, cfg.dtype)
+        self.ln_emb = LayerNorm(cfg.dim)
+        self.layer = BertEncoderLayer(cfg)
+        self.head_fc = Dense(cfg.dim, cfg.dim, dtype=cfg.dtype,
+                             axes_in="embed", axes_out="embed")
+        self.head_ln = LayerNorm(cfg.dim)
+
+    def init(self, key):
+        kt, kp, kl, ks, kh = jax.random.split(key, 5)
+        layer_keys = jax.random.split(ks, self.cfg.num_layers)
+        stacked = jax.vmap(self.layer.init)(layer_keys)
+        return {
+            "tok": self.tok.init(kt),
+            "pos": self.pos.init(kp),
+            "ln_emb": self.ln_emb.init(kl),
+            "layers": stacked,                       # leading dim: num_layers
+            "head_fc": self.head_fc.init(kh),
+            "head_ln": self.head_ln.init(jax.random.fold_in(kh, 1)),
+            "head_bias": jnp.zeros((self.cfg.vocab_size,), jnp.float32),
+        }
+
+    def encode(self, params, tokens, *, pad_mask=None):
+        """tokens (B, T) int32 -> hidden (B, T, D)."""
+        t = tokens.shape[1]
+        x = (self.tok.apply(params["tok"], tokens)
+             + self.pos.apply(params["pos"], jnp.arange(t)))
+        x = self.ln_emb.apply(params["ln_emb"], x)
+        attn_mask = None
+        if pad_mask is not None:
+            attn_mask = pad_mask[:, None, None, :]   # (B,1,1,Tk)
+
+        def body(carry, layer_params):
+            return self.layer.apply(layer_params, carry, mask=attn_mask), None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        return x
+
+    def apply(self, params, tokens, *, pad_mask=None, train=False, rng=None):
+        """Returns MLM logits (B, T, V) — tied to the token embedding."""
+        x = self.encode(params, tokens, pad_mask=pad_mask)
+        h = jax.nn.gelu(self.head_fc.apply(params["head_fc"], x))
+        h = self.head_ln.apply(params["head_ln"], h)
+        logits = self.tok.attend(params["tok"], h)
+        return logits.astype(jnp.float32) + params["head_bias"]
+
+    def axes(self):
+        layer_axes = jax.tree_util.tree_map(
+            lambda ax: (None, *ax), self.layer.axes(),
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                a is None or isinstance(a, str) for a in x))
+        return {
+            "tok": self.tok.axes(), "pos": {"table": (None, "embed")},
+            "ln_emb": self.ln_emb.axes(), "layers": layer_axes,
+            "head_fc": self.head_fc.axes(), "head_ln": self.head_ln.axes(),
+            "head_bias": ("vocab",),
+        }
+
+    # --- masked-LM objective -------------------------------------------
+
+    def mask_tokens(self, rng, tokens):
+        """BERT dynamic masking, static shapes: select ~15% positions; of
+        those 80% -> [MASK], 10% -> random token, 10% -> unchanged."""
+        cfg = self.cfg
+        r_sel, r_kind, r_rand = jax.random.split(rng, 3)
+        selected = jax.random.uniform(r_sel, tokens.shape) < cfg.mask_rate
+        kind = jax.random.uniform(r_kind, tokens.shape)
+        random_toks = jax.random.randint(r_rand, tokens.shape, 0, cfg.vocab_size)
+        masked = jnp.where(kind < 0.8, cfg.mask_token,
+                           jnp.where(kind < 0.9, random_toks, tokens))
+        inputs = jnp.where(selected, masked, tokens)
+        return inputs, selected
+
+    def loss(self, params, batch, rng=None, train=True):
+        """batch: tokens (B, T) int32 (labels are the tokens themselves)."""
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        if rng is None:
+            rng = jax.random.key(0)
+        inputs, selected = self.mask_tokens(rng, tokens)
+        logits = self.apply(params, inputs, train=train)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+        w = selected.astype(jnp.float32)
+        loss = -jnp.sum(tok_logp * w) / jnp.maximum(jnp.sum(w), 1.0)
+        acc = (jnp.sum((jnp.argmax(logits, -1) == tokens) * w)
+               / jnp.maximum(jnp.sum(w), 1.0))
+        return loss, {"accuracy": acc, "masked_frac": jnp.mean(w)}
+
+    def eval_metrics(self, params, batch):
+        loss, aux = self.loss(params, batch, rng=jax.random.key(123),
+                              train=False)
+        return {"loss": loss, "accuracy": aux["accuracy"]}

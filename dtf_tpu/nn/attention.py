@@ -1,0 +1,81 @@
+"""Multi-head attention with tensor-parallel logical axes.
+
+Not present in the reference (no attention/sequence models anywhere in its
+390 lines — SURVEY.md §5.7); built because the framework's north-star
+workloads include BERT-base (BASELINE.md) and long-context support is a
+first-class design axis (ring attention over the ``seq`` mesh axis lives in
+:mod:`dtf_tpu.ops.ring_attention` and plugs in via ``attn_impl``).
+
+Tensor parallelism follows the megatron pattern expressed as logical axes:
+QKV projections are column-parallel (("embed", "joined_kv") -> sharded over
+``tensor``), the output projection is row-parallel (("joined_kv", "embed")),
+so one all-reduce per attention block is inserted by GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import _fan_in_normal
+
+
+def dot_product_attention(q, k, v, mask=None, scale=None):
+    """Plain softmax attention.  q,k,v: (B, T, H, D); mask broadcastable to
+    (B, H, Tq, Tk), True = attend."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def causal_mask(t: int) -> jax.Array:
+    return jnp.tril(jnp.ones((t, t), bool))[None, None, :, :]
+
+
+@dataclasses.dataclass
+class MultiHeadAttention(Module):
+    dim: int
+    num_heads: int
+    dtype: Any = jnp.float32
+    # Pluggable inner attention: f(q, k, v, mask) -> out.  Defaults to plain
+    # softmax attention; ring/flash implementations swap in here.
+    attn_impl: Optional[Callable] = None
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.num_heads == 0
+        return self.dim // self.num_heads
+
+    def init(self, key):
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        d, h, hd = self.dim, self.num_heads, self.head_dim
+        mk = lambda k: _fan_in_normal(k, (d, h, hd), self.dtype, d)
+        return {
+            "q": {"w": mk(kq), "b": jnp.zeros((h, hd), self.dtype)},
+            "k": {"w": mk(kk), "b": jnp.zeros((h, hd), self.dtype)},
+            "v": {"w": mk(kv), "b": jnp.zeros((h, hd), self.dtype)},
+            "o": {"w": _fan_in_normal(ko, (h, hd, d), self.dtype, d),
+                  "b": jnp.zeros((d,), self.dtype)},
+        }
+
+    def apply(self, params, x, *, mask=None, train=False, rng=None):
+        q = jnp.einsum("btd,dhk->bthk", x, params["q"]["w"]) + params["q"]["b"]
+        k = jnp.einsum("btd,dhk->bthk", x, params["k"]["w"]) + params["k"]["b"]
+        v = jnp.einsum("btd,dhk->bthk", x, params["v"]["w"]) + params["v"]["b"]
+        impl = self.attn_impl or dot_product_attention
+        out = impl(q, k, v, mask)
+        return (jnp.einsum("bthk,hkd->btd", out, params["o"]["w"])
+                + params["o"]["b"])
+
+    def axes(self):
+        proj = {"w": ("embed", "heads", "kv"), "b": ("heads", "kv")}
+        return {"q": dict(proj), "k": dict(proj), "v": dict(proj),
+                "o": {"w": ("heads", "kv", "embed"), "b": ("embed",)}}

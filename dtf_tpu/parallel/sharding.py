@@ -36,6 +36,19 @@ DEFAULT_RULES: tuple[tuple[str, Optional[str]], ...] = (
 )
 
 
+def fsdp_rules(rules: Sequence[tuple[str, Optional[str]]] = DEFAULT_RULES
+               ) -> tuple[tuple[str, Optional[str]], ...]:
+    """Rule table for FSDP/ZeRO-style weight sharding: the ``embed`` logical
+    axis (present in every large weight) shards over the ``fsdp`` mesh axis,
+    so parameters and optimizer state are partitioned there and GSPMD
+    all-gathers weights on use / reduce-scatters grads (weight-update
+    sharding, cf. PAPERS.md).  Composes with tensor rules: e.g. an MLP
+    weight ("embed", "mlp") becomes P("fsdp", "tensor")."""
+    table = dict(rules)
+    table["embed"] = "fsdp"
+    return tuple(table.items())
+
+
 def logical_to_spec(logical_axes: Sequence[Optional[str]],
                     rules: Sequence[tuple[str, Optional[str]]] = DEFAULT_RULES,
                     mesh: Optional[Mesh] = None) -> P:
@@ -43,14 +56,21 @@ def logical_to_spec(logical_axes: Sequence[Optional[str]],
 
     Logical names absent from the rule table (or mapped to a mesh axis the
     mesh doesn't have) become ``None`` (replicated) — so one model definition
-    runs unchanged on any mesh shape.
+    runs unchanged on any mesh shape.  A mesh axis is used at most once per
+    spec (first dim wins): e.g. a square weight ("embed", "embed") under
+    FSDP rules shards dim 0 only, since PartitionSpec forbids duplicates.
     """
     table = dict(rules)
     out = []
+    used = set()
     for name in logical_axes:
         mesh_axis = table.get(name) if name is not None else None
         if mesh is not None and mesh_axis is not None and mesh_axis not in mesh.axis_names:
             mesh_axis = None
+        if mesh_axis in used:
+            mesh_axis = None
+        if mesh_axis is not None:
+            used.add(mesh_axis)
         out.append(mesh_axis)
     return P(*out)
 
