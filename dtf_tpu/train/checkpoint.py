@@ -1,0 +1,71 @@
+"""Checkpoint / resume.
+
+The reference had none: its Supervisor was constructed without a ``logdir``
+so the built-in Saver never ran, and ``global_step`` was never persisted — a
+crash lost everything (tf_distributed.py:92; SURVEY.md §5.4).  Combined with
+the coordination service's fail-fast failure propagation (SURVEY.md §5.3),
+checkpoint+restart is this framework's recovery story.
+
+Orbax-backed: async-capable, multi-host aware (each process writes its own
+shards), preserves shardings on restore via the state template.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger("dtf_tpu")
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Async save; returns True if a save was queued/performed."""
+        saved = self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state), force=force)
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any,
+                step: Optional[int] = None) -> tuple[Any, Optional[int]]:
+        """Restore into the template's shapes/dtypes/shardings.  Returns
+        (state, step) — (template, None) when nothing to restore."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return state_template, None
+        restored = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(state_template))
+        log.info("checkpoint restored from step %d", step)
+        return restored, step
+
+    def wait(self) -> None:
+        """Block until pending async saves land (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()

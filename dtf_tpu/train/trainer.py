@@ -172,6 +172,18 @@ class Trainer:
                                        mode=self.mode)
         self.eval_fn = make_eval_fn(self.model, mesh)
         self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
+        self.ckpt = None
+        if self.cfg.checkpoint_every > 0 or self.cfg.resume:
+            from dtf_tpu.train.checkpoint import CheckpointManager
+            self.ckpt = CheckpointManager(
+                f"{self.cfg.logdir}/checkpoints")
+            if self.cfg.resume:
+                self.state, step = self.ckpt.restore(self.state)
+                if step is not None:
+                    self.logger.print(f"[dtf_tpu] resumed from step {step}")
+        # Host-side mirror of state["step"]: reading the device scalar every
+        # step would sync the async dispatch pipeline.
+        self._host_step = int(self.state["step"])
 
     @property
     def global_batch_size(self) -> int:
@@ -197,6 +209,10 @@ class Trainer:
                 rng, step_rng = jax.random.split(rng)
                 self.state, metrics = self.step_fn(self.state, batch, step_rng)
                 count += 1
+                self._host_step += 1
+                if (self.ckpt is not None and self.cfg.checkpoint_every > 0
+                        and self._host_step % self.cfg.checkpoint_every == 0):
+                    self.ckpt.save(self._host_step, self.state)
                 if count % cfg.log_frequency == 0 or i + 1 == batch_count:
                     # Sync point: read back the metrics (the reference paid
                     # this every step via sess.run; we pay it only when
@@ -215,5 +231,10 @@ class Trainer:
             self.logger.scalar(int(self.state["step"]), "test_accuracy",
                                ev["accuracy"])
         block(self.state)
+        if self.ckpt is not None:
+            if (self.cfg.checkpoint_every > 0
+                    and self.ckpt.latest_step() != self._host_step):
+                self.ckpt.save(self._host_step, self.state, force=True)
+            self.ckpt.wait()
         return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
                 "steps": int(self.state["step"]), "total_s": timer.total_s()}
