@@ -48,6 +48,7 @@ class Dataset:
         self._order = np.arange(len(self.images))
         self._rng.shuffle(self._order)
         self._pos = 0
+        self.batches_consumed = 0
 
     @property
     def num_examples(self) -> int:
@@ -61,7 +62,19 @@ class Dataset:
             self._pos = 0
         idx = self._order[self._pos:self._pos + batch_size]
         self._pos += batch_size
+        self.batches_consumed += 1
         return self.images[idx], self.labels[idx]
+
+    def fast_forward(self, n_batches: int, batch_size: int) -> None:
+        """Advance the shuffle cursor as if ``next_batch`` had been called
+        ``n_batches`` times, without materializing any batch (checkpoint
+        resume: replays only the per-epoch reshuffles + position)."""
+        for _ in range(n_batches):
+            if self._pos + batch_size > self.num_examples:
+                self._rng.shuffle(self._order)
+                self._pos = 0
+            self._pos += batch_size
+        self.batches_consumed += n_batches
 
     def epoch_batches(self, batch_size: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         for _ in range(self.num_examples // batch_size):

@@ -1,1 +1,2 @@
 from dtf_tpu.models.mlp import MnistMLP  # noqa: F401
+from dtf_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401

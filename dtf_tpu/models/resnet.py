@@ -1,0 +1,236 @@
+"""ResNet-50 image classifier (CIFAR-10 / ImageNet stems).
+
+North-star workload "ResNet-50 / CIFAR-10 sync all-reduce" (BASELINE.md; the
+reference itself has no conv models — its only model is the 2-layer MNIST MLP,
+tf_distributed.py:50-65).  TPU-first design:
+
+* NHWC layout throughout — XLA's preferred conv layout on TPU (lowers to MXU
+  convolutions without transposes);
+* within each stage, the first (striding/projecting) block is inlined and the
+  remaining *identical-shape* blocks are executed by one ``lax.scan`` over
+  stacked per-block params — one compiled block body per stage instead of 16
+  inlined bottlenecks (compile time scales with 4 stages, not 16 blocks);
+* BatchNorm running statistics live in a separate ``model_state`` pytree
+  threaded functionally through ``apply_stateful`` — no mutation, jit-safe.
+  Under pjit the batch mean over the ``data``-sharded batch axis is a global
+  mean (GSPMD inserts the all-reduce), i.e. synchronized/cross-replica BN for
+  free, riding ICI;
+* BN statistics accumulate in fp32 even when activations are bf16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import BatchNorm, Conv2D, Dense
+
+
+def max_pool(x, window: int, stride: int, padding: str = "SAME"):
+    """NHWC max pool via reduce_window."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1), padding=padding)
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    num_classes: int = 10
+    stage_sizes: tuple = (3, 4, 6, 3)          # ResNet-50
+    widths: tuple = (64, 128, 256, 512)
+    expansion: int = 4
+    cifar_stem: bool = True                    # 3x3/s1 stem, no maxpool
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet50(cls, num_classes: int = 10, cifar_stem: bool = True, **kw):
+        return cls(num_classes=num_classes, cifar_stem=cifar_stem, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-size config (CPU-mesh friendly): 2 stages, 1+2 blocks."""
+        d = dict(stage_sizes=(2, 3), widths=(8, 16), expansion=2)
+        d.update(kw)
+        return cls(**d)
+
+
+class Bottleneck(Module):
+    """1x1 reduce -> 3x3 (stride) -> 1x1 expand, BN after each conv,
+    projection shortcut when shape changes."""
+
+    def __init__(self, in_ch: int, width: int, stride: int, expansion: int,
+                 dtype=jnp.float32):
+        out_ch = width * expansion
+        self.conv1 = Conv2D(in_ch, width, (1, 1), use_bias=False, dtype=dtype)
+        self.bn1 = BatchNorm(width)
+        self.conv2 = Conv2D(width, width, (3, 3), strides=(stride, stride),
+                            use_bias=False, dtype=dtype)
+        self.bn2 = BatchNorm(width)
+        self.conv3 = Conv2D(width, out_ch, (1, 1), use_bias=False, dtype=dtype)
+        self.bn3 = BatchNorm(out_ch)
+        self.needs_proj = stride != 1 or in_ch != out_ch
+        if self.needs_proj:
+            self.proj = Conv2D(in_ch, out_ch, (1, 1),
+                               strides=(stride, stride), use_bias=False,
+                               dtype=dtype)
+            self.bn_proj = BatchNorm(out_ch)
+
+    def _units(self):
+        units = [("conv1", self.conv1), ("bn1", self.bn1),
+                 ("conv2", self.conv2), ("bn2", self.bn2),
+                 ("conv3", self.conv3), ("bn3", self.bn3)]
+        if self.needs_proj:
+            units += [("proj", self.proj), ("bn_proj", self.bn_proj)]
+        return units
+
+    def init(self, key):
+        units = self._units()
+        keys = jax.random.split(key, len(units))
+        return {name: m.init(k) for (name, m), k in zip(units, keys)}
+
+    def init_model_state(self):
+        return {name: m.init_state() for name, m in self._units()
+                if isinstance(m, BatchNorm)}
+
+    def apply_stateful(self, params, state, x, *, train: bool):
+        ns = {}
+        h = self.conv1.apply(params["conv1"], x)
+        h, ns["bn1"] = self.bn1.apply_stateful(params["bn1"], state["bn1"], h,
+                                               train=train)
+        h = jax.nn.relu(h)
+        h = self.conv2.apply(params["conv2"], h)
+        h, ns["bn2"] = self.bn2.apply_stateful(params["bn2"], state["bn2"], h,
+                                               train=train)
+        h = jax.nn.relu(h)
+        h = self.conv3.apply(params["conv3"], h)
+        h, ns["bn3"] = self.bn3.apply_stateful(params["bn3"], state["bn3"], h,
+                                               train=train)
+        shortcut = x
+        if self.needs_proj:
+            shortcut = self.proj.apply(params["proj"], x)
+            shortcut, ns["bn_proj"] = self.bn_proj.apply_stateful(
+                params["bn_proj"], state["bn_proj"], shortcut, train=train)
+        return jax.nn.relu(h + shortcut), ns
+
+    def axes(self):
+        return {name: m.axes() for name, m in self._units()}
+
+
+@dataclasses.dataclass
+class ResNet(Module):
+    """Stem -> 4 bottleneck stages (first block inlined, rest scanned) ->
+    global average pool -> linear classifier."""
+
+    cfg: ResNetConfig
+
+    def __post_init__(self):
+        cfg = self.cfg
+        stem_in = 3
+        if cfg.cifar_stem:
+            self.stem = Conv2D(stem_in, cfg.widths[0], (3, 3),
+                               use_bias=False, dtype=cfg.dtype)
+        else:
+            self.stem = Conv2D(stem_in, cfg.widths[0], (7, 7),
+                               strides=(2, 2), use_bias=False, dtype=cfg.dtype)
+        self.stem_bn = BatchNorm(cfg.widths[0])
+        self.stages = []
+        in_ch = cfg.widths[0]
+        for i, (n, w) in enumerate(zip(cfg.stage_sizes, cfg.widths)):
+            stride = 1 if i == 0 else 2
+            first = Bottleneck(in_ch, w, stride, cfg.expansion, cfg.dtype)
+            out_ch = w * cfg.expansion
+            rest = Bottleneck(out_ch, w, 1, cfg.expansion, cfg.dtype)
+            self.stages.append((first, rest, n - 1))
+            in_ch = out_ch
+        self.fc = Dense(in_ch, cfg.num_classes, dtype=cfg.dtype,
+                        axes_in="embed", axes_out=None)
+
+    def init(self, key):
+        ks, kbn, kfc, *stage_keys = jax.random.split(key, 3 + len(self.stages))
+        params = {"stem": self.stem.init(ks), "stem_bn": self.stem_bn.init(kbn),
+                  "fc": self.fc.init(kfc)}
+        for i, ((first, rest, n_rest), sk) in enumerate(
+                zip(self.stages, stage_keys)):
+            kf, kr = jax.random.split(sk)
+            params[f"s{i}_first"] = first.init(kf)
+            if n_rest:
+                rest_keys = jax.random.split(kr, n_rest)
+                params[f"s{i}_rest"] = jax.vmap(rest.init)(rest_keys)
+        return params
+
+    def init_model_state(self):
+        state = {"stem_bn": self.stem_bn.init_state()}
+        for i, (first, rest, n_rest) in enumerate(self.stages):
+            state[f"s{i}_first"] = first.init_model_state()
+            if n_rest:
+                one = rest.init_model_state()
+                state[f"s{i}_rest"] = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (n_rest, *x.shape)), one)
+        return state
+
+    def apply_stateful(self, params, state, x, *, train: bool):
+        """x (B, H, W, 3) -> logits (B, num_classes), new model_state."""
+        ns = {}
+        h = self.stem.apply(params["stem"], x)
+        h, ns["stem_bn"] = self.stem_bn.apply_stateful(
+            params["stem_bn"], state["stem_bn"], h, train=train)
+        h = jax.nn.relu(h)
+        if not self.cfg.cifar_stem:
+            h = max_pool(h, 3, 2)
+        for i, (first, rest, n_rest) in enumerate(self.stages):
+            h, ns[f"s{i}_first"] = first.apply_stateful(
+                params[f"s{i}_first"], state[f"s{i}_first"], h, train=train)
+            if n_rest:
+                def body(carry, ps, _rest=rest):
+                    p, s = ps
+                    y, s_new = _rest.apply_stateful(p, s, carry, train=train)
+                    return y, s_new
+                h, ns[f"s{i}_rest"] = lax.scan(
+                    body, h, (params[f"s{i}_rest"], state[f"s{i}_rest"]))
+        h = jnp.mean(h, axis=(1, 2))                   # global average pool
+        logits = self.fc.apply(params["fc"], h)
+        return logits.astype(jnp.float32), ns
+
+    def apply(self, params, x, *, train=False, rng=None, model_state=None):
+        if model_state is None:
+            raise TypeError("ResNet is stateful; pass model_state or use "
+                            "apply_stateful")
+        logits, _ = self.apply_stateful(params, model_state, x, train=train)
+        return logits
+
+    def axes(self):
+        axes = {"stem": self.stem.axes(), "stem_bn": self.stem_bn.axes(),
+                "fc": self.fc.axes()}
+        for i, (first, rest, n_rest) in enumerate(self.stages):
+            axes[f"s{i}_first"] = first.axes()
+            if n_rest:
+                axes[f"s{i}_rest"] = jax.tree_util.tree_map(
+                    lambda ax: (None, *ax), rest.axes(),
+                    is_leaf=lambda x: isinstance(x, tuple) and all(
+                        a is None or isinstance(a, str) for a in x))
+        return axes
+
+    # --- training objective (stateful protocol) -------------------------
+
+    def loss(self, params, model_state, batch, rng=None, train=True):
+        """batch: (images NHWC float32, labels one-hot float32) — the same
+        (x, y_) contract as the MNIST workload (tf_distributed.py:42-46)."""
+        images, labels = batch
+        logits, new_state = self.apply_stateful(params, model_state, images,
+                                                train=train)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(jnp.sum(labels * logp, axis=-1))
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == jnp.argmax(labels, -1)
+             ).astype(jnp.float32))
+        return loss, ({"accuracy": acc}, new_state)
+
+    def eval_metrics(self, params, model_state, batch):
+        loss, (aux, _) = self.loss(params, model_state, batch, train=False)
+        return {"loss": loss, "accuracy": aux["accuracy"]}

@@ -87,6 +87,19 @@ def replicate(mesh: Mesh, tree: Any = None) -> Any:
     return jax.device_put(tree, s)
 
 
+def data_axes(mesh: Mesh) -> tuple:
+    """The mesh axes a batch dim shards over (``data`` and ``fsdp``)."""
+    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Total number of batch shards (product of the data-like axis sizes)."""
+    n = 1
+    for a in data_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
 def batch_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard the leading (batch) dim over every data-like axis present.
 
@@ -94,9 +107,8 @@ def batch_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     (tf_distributed.py:108,111); here one global batch is sharded over the
     ``data`` (and ``fsdp``, if present) axes.
     """
-    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
-    leading = data_axes if data_axes else None
-    return NamedSharding(mesh, P(leading, *([None] * (ndim - 1))))
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, P(axes or None, *([None] * (ndim - 1))))
 
 
 def shard_batch(mesh: Mesh, tree: Any) -> Any:

@@ -43,15 +43,22 @@ def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
                mesh: Mesh, param_shardings: Optional[Any] = None) -> TrainState:
     """Deterministic same-seed init on all processes — the SPMD replacement
     for the reference's chief-runs-init_op + non-chief-polls protocol
-    (tf_distributed.py:92-96; SURVEY.md §2.13 'coordinated init')."""
+    (tf_distributed.py:92-96; SURVEY.md §2.13 'coordinated init').
+
+    Models exposing ``init_model_state()`` (e.g. BatchNorm running stats in
+    ResNet) get a ``model_state`` entry threaded through the train step.
+    """
     params = model.init(jax.random.key(seed))
     if param_shardings is None:
         params = sh.replicate(mesh, params)
     else:
         params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
     opt_state = optimizer.init(params)
-    return {"params": params, "opt_state": opt_state,
-            "step": sh.replicate(mesh, jnp.zeros((), jnp.int32))}
+    state = {"params": params, "opt_state": opt_state,
+             "step": sh.replicate(mesh, jnp.zeros((), jnp.int32))}
+    if hasattr(model, "init_model_state"):
+        state["model_state"] = sh.replicate(mesh, model.init_model_state())
+    return state
 
 
 def put_global_batch(mesh: Mesh, batch: Any) -> Any:
@@ -73,50 +80,65 @@ def put_global_batch(mesh: Mesh, batch: Any) -> Any:
 
 def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     mesh: Mesh, mode: str = "implicit",
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, stateful: bool = False) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
-    *means* over the batch dim so both modes agree.
+    *means* over the batch dim so both modes agree.  With ``stateful=True``
+    the signature is ``loss_fn(params, model_state, batch, rng) ->
+    (loss, (aux_dict, new_model_state))`` and the state threads through
+    ``state["model_state"]``.
+
+    BatchNorm semantics differ between modes by construction: in implicit
+    mode the batch mean over the data-sharded axis is a *global* mean (GSPMD
+    all-reduces it), i.e. synchronized BN; in explicit (shard_map) mode each
+    shard normalizes with its *local* batch statistics (the classic
+    non-sync-BN data-parallel semantics) and the running stats are pmean'd
+    across shards.  The two converge as per-shard batch grows.
     """
 
-    def grads_and_update(params, opt_state, step, batch, rng, grad_sync):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, rng)
-        grads, loss, aux = grad_sync(grads, loss, aux)
+    def grads_and_update(state, batch, rng, sync):
+        params, opt_state, step = state["params"], state["opt_state"], state["step"]
+        if stateful:
+            (loss, (aux, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state["model_state"], batch, rng)
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, rng)
+            new_ms = None
+        grads, loss, aux, new_ms = sync(grads, loss, aux, new_ms)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optim_lib.apply_updates(params, updates)
+        new_state = {"params": params, "opt_state": opt_state, "step": step + 1}
+        if stateful:
+            new_state["model_state"] = new_ms
         metrics = {"loss": loss, **aux}
-        return {"params": params, "opt_state": opt_state, "step": step + 1}, metrics
+        return new_state, metrics
 
     if mode == "implicit":
         # Global-batch program; the loss mean over the sharded batch makes
         # GSPMD emit the gradient all-reduce.
         @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
         def step_fn(state, batch, rng):
-            return grads_and_update(
-                state["params"], state["opt_state"], state["step"], batch, rng,
-                grad_sync=lambda g, l, a: (g, l, a))
+            return grads_and_update(state, batch, rng,
+                                    sync=lambda g, l, a, ms: (g, l, a, ms))
 
         return step_fn
 
     if mode == "explicit":
         # Literal psum data-parallel: per-device code, explicit collectives.
-        data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+        data_axes = sh.data_axes(mesh)
 
         def per_device(state, batch, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(data_axes[0]))
 
-            def sync(grads, loss, aux):
-                grads = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, data_axes), grads)
-                loss = lax.pmean(loss, data_axes)
-                aux = jax.tree_util.tree_map(
-                    lambda v: lax.pmean(v, data_axes), aux)
-                return grads, loss, aux
+            def sync(grads, loss, aux, new_ms):
+                pmean = lambda t: jax.tree_util.tree_map(
+                    lambda v: lax.pmean(v, data_axes), t)
+                return (pmean(grads), pmean(loss), pmean(aux),
+                        pmean(new_ms) if new_ms is not None else None)
 
-            return grads_and_update(state["params"], state["opt_state"],
-                                    state["step"], batch, rng, sync)
+            return grads_and_update(state, batch, rng, sync)
 
         batch_p = P(data_axes)
         mapped = jax.shard_map(
@@ -128,26 +150,49 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     raise ValueError(f"mode must be 'implicit' or 'explicit', got {mode!r}")
 
 
-def make_eval_fn(model, mesh: Mesh) -> Callable:
+def make_eval_fn(model, mesh: Mesh, stateful: bool = False) -> Callable:
     """Batched full-test-set eval (the reference ran the 10k test set in one
     feed_dict pass on every worker, tf_distributed.py:126; here it is a
-    jitted sharded forward, coordinator reads the scalar)."""
+    jitted sharded forward, coordinator reads the scalar).  Takes the full
+    TrainState so stateful models evaluate with their running statistics."""
 
     @jax.jit
-    def eval_batch(params, batch):
-        return model.eval_metrics(params, batch)
+    def eval_batch(state, batch):
+        if stateful:
+            return model.eval_metrics(state["params"], state["model_state"],
+                                      batch)
+        return model.eval_metrics(state["params"], batch)
 
-    def evaluate(params, dataset, batch_size: int = 2048) -> dict:
-        n = (dataset.num_examples // batch_size) or 1
-        bs = min(batch_size, dataset.num_examples)
-        totals = None
-        for i in range(n):
-            batch = (dataset.images[i * bs:(i + 1) * bs],
-                     dataset.labels[i * bs:(i + 1) * bs])
-            m = eval_batch(params, put_global_batch(mesh, batch))
+    data_size = sh.data_axis_size(mesh)
+
+    def evaluate(state, dataset, batch_size: int = 2048) -> dict:
+        """Covers the FULL test set, example-weighted.  Batches are rounded
+        down to a multiple of the data-axis device count and run sharded;
+        only the sub-``data_size`` tail runs *replicated* (same compute on
+        every device, exact result) — one extra compile for its shape,
+        once."""
+        n_total = dataset.num_examples
+        totals, i = None, 0
+        while i < n_total:
+            take = min(batch_size, n_total - i)
+            if take >= data_size:
+                take -= take % data_size
+            batch = (dataset.images[i:i + take], dataset.labels[i:i + take])
+            if take % data_size == 0:
+                batch = put_global_batch(mesh, batch)
+            elif jax.process_count() == 1:
+                batch = sh.replicate(mesh, batch)
+            else:
+                rep = sh.replicate(mesh)
+                batch = jax.tree_util.tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        rep, np.asarray(x)), batch)
+            m = eval_batch(state, batch)
+            m = jax.tree_util.tree_map(lambda v: v * take, m)
             totals = m if totals is None else jax.tree_util.tree_map(
                 jnp.add, totals, m)
-        return {k: float(v) / n for k, v in totals.items()}
+            i += take
+        return {k: float(v) / n_total for k, v in totals.items()}
 
     return evaluate
 
@@ -168,9 +213,10 @@ class Trainer:
         mesh = self.cluster.mesh
         self.logger = self.logger or MetricLogger(
             self.cfg.logdir, self.cluster.is_coordinator)
+        stateful = hasattr(self.model, "init_model_state")
         self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
-                                       mode=self.mode)
-        self.eval_fn = make_eval_fn(self.model, mesh)
+                                       mode=self.mode, stateful=stateful)
+        self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
         self.ckpt = None
         if self.cfg.checkpoint_every > 0 or self.cfg.resume:
@@ -192,21 +238,44 @@ class Trainer:
         return self.cfg.batch_size
 
     def fit(self, splits, epochs: Optional[int] = None) -> dict:
-        """Epoch loop with the reference's exact console contract."""
+        """Epoch loop with the reference's exact console contract.
+
+        Resume-correct: the per-step rng is derived by folding the global
+        step into a base key (not an advancing stream), and on resume the
+        data cursor and epoch budget fast-forward to the restored step, so
+        a resumed run continues the interrupted trajectory instead of
+        re-feeding consumed batches.
+        """
         mesh = self.cluster.mesh
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
-        rng = jax.random.key(cfg.seed + 17)
+        rng_base = jax.random.key(cfg.seed + 17)
         bs = self.global_batch_size
         timer = StepTimer()
         last_cost = float("nan")
 
-        for epoch in range(epochs):
-            batch_count = splits.train.num_examples // bs   # :104
+        batch_count = splits.train.num_examples // bs       # :104
+        start_epoch = (min(self._host_step // batch_count, epochs)
+                       if batch_count else 0)
+        skip_batches = self._host_step % batch_count if batch_count else 0
+        # Fast-forward the shuffle cursor to where it was when the checkpoint
+        # was written — but only by the batches this dataset hasn't already
+        # served (a second fit() on the same dataset must not double-advance).
+        behind = self._host_step - getattr(splits.train, "batches_consumed", 0)
+        if behind > 0 and start_epoch < epochs:
+            if hasattr(splits.train, "fast_forward"):
+                splits.train.fast_forward(behind, bs)
+            else:   # foreign dataset with only the next_batch contract
+                for _ in range(behind):
+                    splits.train.next_batch(bs)
+
+        ev = {"accuracy": float("nan")}
+        for epoch in range(start_epoch, epochs):
             count = 0
-            for i in range(batch_count):
+            first_batch = skip_batches if epoch == start_epoch else 0
+            for i in range(first_batch, batch_count):
                 batch = put_global_batch(mesh, splits.train.next_batch(bs))
-                rng, step_rng = jax.random.split(rng)
+                step_rng = jax.random.fold_in(rng_base, self._host_step)
                 self.state, metrics = self.step_fn(self.state, batch, step_rng)
                 count += 1
                 self._host_step += 1
@@ -226,10 +295,12 @@ class Trainer:
                     self.logger.scalar(step, "avg_ms", avg_ms)
                     count = 0
                     last_cost = cost
-            ev = self.eval_fn(self.state["params"], splits.test)
+            ev = self.eval_fn(self.state, splits.test)
             self.logger.epoch_summary(ev["accuracy"], timer.total_s(), last_cost)
             self.logger.scalar(int(self.state["step"]), "test_accuracy",
                                ev["accuracy"])
+        if start_epoch >= epochs:    # resumed past the budget: report eval
+            ev = self.eval_fn(self.state, splits.test)
         block(self.state)
         if self.ckpt is not None:
             if (self.cfg.checkpoint_every > 0

@@ -1,0 +1,52 @@
+"""ResNet-50 / CIFAR-10 sync all-reduce training (BASELINE.md config row).
+
+The reference has no conv workload; this is the "ResNet-50 / CIFAR-10 sync
+all-reduce" north-star config from BASELINE.json, run with the same driver
+contract as the MNIST workload (console step lines, per-epoch test accuracy):
+
+    python -m dtf_tpu.workloads.cifar [--epochs 10] [--mesh data=-1]
+        [--batch_size 256] [--learning_rate 0.1]
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from dtf_tpu import optim
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
+    from dtf_tpu.data import load_cifar10
+    from dtf_tpu.models.resnet import ResNet, ResNetConfig
+    from dtf_tpu.train.trainer import Trainer
+
+    parser = build_parser("dtf_tpu ResNet-50/CIFAR-10 (BASELINE.json config)")
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--arch", choices=["resnet50", "tiny"],
+                        default="resnet50",
+                        help="tiny = 2-stage test model (CPU-friendly)")
+    parser.set_defaults(batch_size=256, learning_rate=0.1, epochs=10)
+    ns = parser.parse_args(argv)
+    cluster_cfg = _from_namespace(ClusterConfig, ns)
+    train_cfg = _from_namespace(TrainConfig, ns)
+
+    cluster = bootstrap(cluster_cfg)
+    splits = load_cifar10(seed=train_cfg.seed)
+    if splits.synthetic and cluster.is_coordinator:
+        print("[dtf_tpu] cifar-10-batches-py/ not found; using deterministic "
+              "synthetic data (zero-egress environment)")
+
+    model = ResNet(ResNetConfig.resnet50() if ns.arch == "resnet50"
+                   else ResNetConfig.tiny())
+    trainer = Trainer(cluster, model,
+                      optim.momentum(train_cfg.learning_rate, beta=ns.momentum),
+                      train_cfg)
+    trainer.fit(splits)
+    if cluster.is_coordinator:
+        print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
