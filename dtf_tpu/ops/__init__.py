@@ -1,0 +1,6 @@
+"""Pallas TPU kernels and distributed ops (flash attention, ring attention)."""
+
+from dtf_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_impl)
+from dtf_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_impl)

@@ -1,0 +1,322 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+Not present in the reference (it has no attention at all, SURVEY.md §5.7);
+this is the framework's hot-op kernel for the BERT/long-context workloads.
+Memory-efficient attention: O(T) memory instead of the O(T^2) logits tensor,
+with the online-softmax recurrence.
+
+TPU mapping (pallas_guide.md patterns):
+
+* grid ``(B, H, num_q_blocks, num_k_blocks)`` — the innermost (k) dimension
+  iterates sequentially on-core, so the running max/denominator/accumulator
+  live in VMEM scratch that persists across k steps; ``@pl.when(ki == 0)``
+  initializes, ``@pl.when(ki == nk-1)`` finalizes and writes out;
+* all matmuls hit the MXU with ``preferred_element_type=float32``; softmax
+  statistics are kept in fp32 even for bf16 inputs;
+* causal masking skips fully-masked k blocks via ``@pl.when`` (no wasted
+  MXU work past the diagonal) and masks within the diagonal block;
+* backward = two kernels (dq; dk+dv fused) using the saved logsumexp — the
+  standard flash-attention backward, not recompute-the-naive-path.
+
+On CPU (tests / the 8-device simulated mesh) kernels run in interpreter
+mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(t: int, block_q: int, block_k: int) -> tuple:
+    bq, bk = min(block_q, t), min(block_k, t)
+    if t % bq or t % bk:
+        raise ValueError(f"seq len {t} must be divisible by block sizes "
+                         f"({bq}, {bk}); pad the sequence")
+    return bq, bk
+
+
+def _causal_mask_block(s, q_start, k_start):
+    """Mask s (bq, bk) so query row attends only to keys <= its position."""
+    row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(row >= col, s, NEG_INF)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)            # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(                       # (bq, bk) on MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask_block(s, qi * block_q, ki * block_k)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :1] = corr * l_scr[:, :1] + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    if causal:
+        # Skip k blocks entirely above the diagonal.
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+        # lse stored lane-replicated (bq, 128): rank-3 (B,H,T) blocks of
+        # shape (1,1,bq) violate Mosaic's last-two-dims tiling rule on real
+        # TPU (second-to-last block dim 1 != H), so the stats array is
+        # (B,H,T,128) with legal (bq,128) blocks.
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                         lse_ref.shape[2:])
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    bq, bk = _block_sizes(t, block_q, block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max (col 0)
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denom (col 0)
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# backward: dq on grid (B,H,nq,nk); dk,dv fused on grid (B,H,nk,nq)
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   acc, *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)            # (bq, D)
+        do = do_ref[0, 0].astype(jnp.float32)          # (bq, D)
+        lse = lse_ref[0, 0][:, :1]                     # (bq, 1)
+        # delta_i = sum_d dO_id O_id, recomputed per block (elementwise VPU
+        # work, cheaper than a third stats array in HBM)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask_block(s, qi * block_q, ki * block_k)
+        p = jnp.exp(s - lse)                           # (bq, bk)
+        dp = jax.lax.dot_general(                      # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc[:] = acc[:] + jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)            # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)            # (bq, D)
+        do = do_ref[0, 0].astype(jnp.float32)          # (bq, D)
+        lse = lse_ref[0, 0][:, :1].T                   # (1, bq)
+        delta = jnp.sum(do * o, axis=-1)[None, :]      # (1, bq)
+        st = jax.lax.dot_general(                      # K @ Q^T: (bk, bq)
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            # st[i, j]: key ki*bk+i, query qi*bq+j; visible iff q >= k
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
+            st = jnp.where(qpos >= kpos, st, NEG_INF)
+        pt = jnp.exp(st - lse)                         # (bk, bq)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot(
+            pt, do, preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(                     # V @ dO^T: (bk, bq)
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dst = pt * (dpt - delta)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot(
+            dst, q, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    bq, bk = _block_sizes(t, block_q, block_k)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    l_spec = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(b, h, t // bq, t // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, l_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+
+    # Transposed grid: k blocks outer, q blocks inner (sequential on-core).
+    q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    k_spec_t = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
+    l_spec_t = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(b, h, t // bk, t // bq),
+        in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, q_spec_t, l_spec_t],
+        out_specs=[k_spec_t, k_spec_t],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, t, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret=None):
+    """Flash attention over (B, H, T, D) tensors; returns (B, H, T, D).
+
+    Differentiable (custom VJP with the flash backward kernels).  ``scale``
+    defaults to D**-0.5.  T must be divisible by the (clamped) block sizes.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def flash_attention_impl(causal: bool = False, block_q: int = 128,
+                         block_k: int = 128):
+    """Adapter matching MultiHeadAttention's ``attn_impl`` contract:
+    f(q, k, v, mask) with (B, T, H, D) layout.  Only supports mask=None
+    (use causal=True for causal); padding masks fall back to the XLA path
+    in the caller."""
+
+    def impl(q, k, v, mask=None):
+        if mask is not None:
+            raise ValueError("flash_attention_impl supports mask=None only; "
+                             "use causal=True or the XLA attention path")
+        out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), causal=causal,
+                              block_q=block_q, block_k=block_k)
+        return out.transpose(0, 2, 1, 3)
+
+    return impl
