@@ -1,0 +1,111 @@
+"""Ring attention: exact attention over sequences sharded on a ``seq`` axis.
+
+Long-context support is a first-class design axis of this framework (the
+reference has no sequence models at all, SURVEY.md §5.7 — this is new
+capability, not parity).  Each device holds a T/n slice of the sequence;
+K/V chunks rotate around the ring via ``lax.ppermute`` over ICI while every
+device accumulates its queries' attention with the online-softmax
+recurrence — O(T/n) memory per device, exact result, no T×T tensor ever
+materialized.
+
+Design notes:
+
+* implemented as per-device code under ``jax.shard_map`` so the collective
+  schedule is explicit (ppermute ring), composing with the data axes for
+  the batch dim;
+* the ring loop is a ``lax.scan`` over ring steps (static trip count =
+  mesh axis size) carrying (acc, m, l, k_chunk, v_chunk) — reverse-mode
+  differentiable, so the same code trains;
+* masked logits use a large-negative finite constant instead of -inf so
+  fully-masked (future) chunks stay NaN-free through exp;
+* statistics in fp32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_BIG = -1e30   # finite "-inf": keeps exp() NaN-free for all-masked rows
+
+
+def _ring_body(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
+    """Per-device ring attention.  q,k,v: (B, t_loc, H, D) local chunks."""
+    b, t_loc, h, d = q.shape
+    me = lax.axis_index(axis)
+    qf = q.astype(jnp.float32)
+
+    q_pos = me * t_loc + lax.broadcasted_iota(jnp.int32, (t_loc, t_loc), 0)
+
+    def step(carry, s):
+        acc, m, l, kc, vc = carry
+        src = (me - s) % n                     # whose chunk we hold now
+        sblk = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+                          preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * t_loc + lax.broadcasted_iota(
+                jnp.int32, (t_loc, t_loc), 1)
+            sblk = jnp.where((q_pos >= k_pos)[None, None], sblk, NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=-1))          # (B,H,Tq)
+        p = jnp.exp(sblk - m_new[..., None])                    # (B,H,Tq,Tk)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr[..., None] + pv
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return (acc_new, m_new, l_new, kc, vc), None
+
+    acc0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, t_loc), NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    (acc, _, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
+                                    jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]                # (B,H,Tq,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)            # (B,Tq,H,D)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axes: Optional[tuple] = None):
+    """Exact sequence-parallel attention.
+
+    q, k, v: (B, T, H, D) *global* arrays whose T dim is (to be) sharded
+    over ``axis``; returns (B, T, H, D) sharded the same way.  Call inside
+    or outside jit — shard_map composes with the surrounding program.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by "
+                         f"{axis}={n}")
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    if batch_axes is None:
+        from dtf_tpu.parallel.sharding import data_axes as _data_axes
+        batch_axes = _data_axes(mesh)
+    spec = P(batch_axes or None, axis, None, None)
+    body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
+                             scale=scale)
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def ring_attention_impl(mesh: Mesh, axis: str = "seq", causal: bool = False):
+    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D), mask=None)."""
+
+    def impl(q, k, v, mask=None):
+        if mask is not None:
+            raise ValueError("ring_attention_impl supports mask=None only; "
+                             "use causal=True or the XLA attention path")
+        return ring_attention(q, k, v, mesh, axis=axis, causal=causal)
+
+    return impl
