@@ -41,6 +41,11 @@ class BertConfig:
     mask_token: int = 103            # [MASK] in the standard vocab
     mask_rate: float = 0.15
     attn_impl: Optional[Any] = None  # pluggable (ring attention etc.)
+    # Pipeline parallelism: set to a Mesh with a 'pipe' axis to run the
+    # encoder stack as num_layers/pipe_size-layer stages under the GPipe
+    # schedule (parallel/pipeline.py) instead of lax.scan.
+    pipeline_mesh: Optional[Any] = None
+    pipeline_microbatches: int = 2
 
     @classmethod
     def tiny(cls, **kw):
@@ -124,6 +129,31 @@ class BertMLM(Module):
         if pad_mask is not None:
             attn_mask = pad_mask[:, None, None, :]   # (B,1,1,Tk)
 
+        if self.cfg.pipeline_mesh is not None:
+            if pad_mask is not None:
+                raise ValueError("pipelined encoder does not support "
+                                 "pad_mask (microbatching would split it)")
+            from dtf_tpu.parallel.pipeline import pipeline_apply
+            mesh = self.cfg.pipeline_mesh
+            s = mesh.shape["pipe"]
+            n_layers = self.cfg.num_layers
+            if n_layers % s:
+                raise ValueError(f"{n_layers} layers not divisible by "
+                                 f"pipe={s}")
+            grouped = jax.tree_util.tree_map(
+                lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
+                params["layers"])
+
+            def stage(stage_params, h):
+                def body(carry, lp):
+                    return self.layer.apply(lp, carry), None
+                h, _ = jax.lax.scan(body, h, stage_params)
+                return h
+
+            return pipeline_apply(
+                stage, grouped, x, mesh,
+                num_microbatches=self.cfg.pipeline_microbatches)
+
         def body(carry, layer_params):
             return self.layer.apply(layer_params, carry, mask=attn_mask), None
 
@@ -139,8 +169,11 @@ class BertMLM(Module):
         return logits.astype(jnp.float32) + params["head_bias"]
 
     def axes(self):
+        # leading (stacked-layer) dim: the pipeline "stage" logical axis when
+        # pipelined (rule ("stage", "pipe")), replicated for the scan path
+        lead = "stage" if self.cfg.pipeline_mesh is not None else None
         layer_axes = jax.tree_util.tree_map(
-            lambda ax: (None, *ax), self.layer.axes(),
+            lambda ax: (lead, *ax), self.layer.axes(),
             is_leaf=lambda x: isinstance(x, tuple) and all(
                 a is None or isinstance(a, str) for a in x))
         return {

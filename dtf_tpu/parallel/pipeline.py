@@ -1,0 +1,108 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis (GPipe schedule).
+
+Not in the reference (its only parallelism is async-PS data parallelism,
+SURVEY.md §2.14); built because the framework treats pipeline sharding as a
+first-class mesh axis alongside data/fsdp/tensor/seq.
+
+TPU-native design: SPMD, not per-stage processes.  Stage parameters carry a
+leading ``stage`` logical axis sharded over ``pipe`` (rule table
+``("stage", "pipe")``, parallel/sharding.py); execution runs under
+``jax.shard_map`` where each device holds exactly one stage's weights and
+activations hop stage→stage via ``lax.ppermute`` over ICI.  The schedule is
+a ``lax.scan`` over M + S - 1 ticks (M microbatches, S stages, bubble
+fraction (S-1)/(M+S-1)); reverse-mode AD through the scan+ppermute gives the
+backward pipeline automatically, so the same code trains under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   mesh: Mesh, *, num_microbatches: int, axis: str = "pipe",
+                   batch_axes: Optional[tuple] = None) -> jax.Array:
+    """Run ``x`` through S pipeline stages.
+
+    ``stage_fn(params_one_stage, x_mb) -> y_mb`` must preserve the
+    activation shape (e.g. a block of transformer layers).  ``stage_params``
+    is a pytree whose every leaf has leading dim S (the stage axis, sharded
+    over ``axis``).  ``x``: (B, ...) global batch; B must be divisible by
+    ``num_microbatches`` (× the data-axis size, if present).  Returns the
+    last stage's output, (B, ...).
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    s = mesh.shape[axis]
+    m = num_microbatches
+    if x.shape[0] % m:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"num_microbatches={m}")
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    if leaves and leaves[0].shape[0] != s:
+        raise ValueError(f"stage_params leading dim {leaves[0].shape[0]} "
+                         f"!= {axis} axis size {s}")
+    if batch_axes is None:
+        from dtf_tpu.parallel.sharding import data_axes as _data_axes
+        batch_axes = _data_axes(mesh)
+
+    mb = x.shape[0] // m
+    data_size = 1
+    for a in batch_axes:
+        data_size *= mesh.shape[a]
+    if mb % data_size:
+        raise ValueError(f"microbatch size {mb} (batch {x.shape[0]} / "
+                         f"{m} microbatches) not divisible by data-axis "
+                         f"size {data_size}")
+    xs = x.reshape(m, mb, *x.shape[1:])
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    # microbatch dim replicated over pipe; batch dim sharded over data axes
+    x_spec = P(None, batch_axes or None, *([None] * (x.ndim - 1)))
+
+    body = functools.partial(_per_device_pipeline, stage_fn, s=s, m=m,
+                             axis=axis)
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=(param_spec, x_spec),
+                           out_specs=x_spec, check_vma=False)
+    ys = mapped(stage_params, xs)
+    return ys.reshape(x.shape[0], *x.shape[1:])
+
+
+def _per_device_pipeline(stage_fn, stage_params, xs, *, s: int, m: int,
+                         axis: str):
+    """Per-device GPipe loop.  stage_params leaves: (1, ...) — this stage;
+    xs: (M, mb_local, ...) microbatches (same on every pipe rank)."""
+    idx = lax.axis_index(axis)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    is_first = idx == 0
+    is_last = idx == s - 1
+    fwd_perm = [(i, i + 1) for i in range(s - 1)]
+
+    def tick(carry, t):
+        buf, ys = carry
+        # stage 0 injects microbatch t (clamped; ticks >= M are drain-only)
+        x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), axis=0,
+                                        keepdims=False)
+        inp = jnp.where(is_first, x_in, buf)
+        y = stage_fn(params, inp)
+        # collect finished microbatches; warm-up ticks (t < s-1) all write
+        # slot 0 and are overwritten by the first valid write at t = s-1.
+        # Non-last stages accumulate garbage here — masked out by the psum
+        # below, and the where() there also zeroes their cotangents in AD.
+        slot = jnp.maximum(t - (s - 1), 0)
+        ys = lax.dynamic_update_index_in_dim(ys, y, slot, axis=0)
+        buf_next = lax.ppermute(y, axis, fwd_perm)
+        return (buf_next, ys), None
+
+    buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
+    ys0 = jnp.zeros_like(xs)
+    (_, ys), _ = lax.scan(tick, (buf0, ys0), jnp.arange(m + s - 1))
+    # only the last stage holds real outputs; broadcast over the pipe axis
+    ys = lax.psum(jnp.where(is_last, ys, jnp.zeros_like(ys)), axis)
+    return ys
