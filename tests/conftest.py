@@ -22,6 +22,11 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration tests")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

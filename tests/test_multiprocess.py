@@ -1,0 +1,94 @@
+"""Multi-process distributed rig: N local processes, jax.distributed
+coordination service over localhost — the DCN bootstrap path, exercised the
+way the reference exercised its gRPC cluster (SURVEY.md §4 'Multi-process').
+
+Each child process simulates 4 CPU devices, so 2 processes form a global
+8-device mesh; the MNIST workload runs data-parallel across them with the
+reference CLI (--job_name/--task_index + coordinator flags)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child_env(n_local_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local_devices}")
+    # Drop any sitecustomize dirs (e.g. the TPU-relay shim) from the child
+    # path: a sitecustomize that imports jax initializes the backend before
+    # main() runs, which silently breaks jax.distributed.initialize — each
+    # child would come up as a single-process job.
+    inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and "site" not in os.path.basename(p)]
+    env["PYTHONPATH"] = os.pathsep.join([REPO_ROOT, *inherited])
+    return env
+
+
+@pytest.mark.slow
+class TestMultiProcess:
+    def test_two_process_mnist_data_parallel(self, tmp_path):
+        """2 processes x 4 simulated devices: full DP MNIST epoch over the
+        coordination service; both exit 0, coordinator logs eval."""
+        port = free_port()
+        procs = []
+        for task in range(2):
+            cmd = [
+                sys.executable, "-m", "dtf_tpu.workloads.mnist",
+                "--job_name", "worker", "--task_index", str(task),
+                "--coordinator_address", f"localhost:{port}",
+                "--num_processes", "2", "--mesh", "data=-1",
+                "--epochs", "1", "--batch_size", "128",
+                "--log_frequency", "50",
+                "--logdir", str(tmp_path / f"logs{task}"),
+            ]
+            procs.append(subprocess.Popen(
+                cmd, cwd=tmp_path, env=child_env(4),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for task, p in enumerate(procs):
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+            assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+        # coordinator (task 0) owns the console contract
+        assert "Test-Accuracy" in outs[0]
+        assert "done" in outs[0]
+        # non-coordinator stays silent on the log contract (SPMD: only
+        # process 0 prints, SURVEY.md §7 'multi-host SPMD mental model')
+        assert "Test-Accuracy" not in outs[1]
+
+    def test_ps_job_name_compat_shim(self, tmp_path):
+        """--job_name=ps joins as a peer (no PS role in an all-reduce
+        design, cluster.py docstring): the 2-process job still completes
+        with one 'ps' and one 'worker'."""
+        port = free_port()
+        procs = []
+        for task, job in ((0, "worker"), (1, "ps")):
+            cmd = [
+                sys.executable, "-m", "dtf_tpu.workloads.mnist",
+                "--job_name", job, "--task_index", str(task),
+                "--coordinator_address", f"localhost:{port}",
+                "--num_processes", "2", "--mesh", "data=-1",
+                "--epochs", "1", "--batch_size", "512",
+                "--log_frequency", "100",
+                "--logdir", str(tmp_path / f"logs{task}"),
+            ]
+            procs.append(subprocess.Popen(
+                cmd, cwd=tmp_path, env=child_env(2),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        for task, p in enumerate(procs):
+            out, _ = p.communicate(timeout=420)
+            assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
