@@ -1,0 +1,145 @@
+"""Mixture-of-Experts layer with expert parallelism (Switch-style top-1 and
+GShard-style top-2 routing).
+
+Not in the reference (no MoE anywhere in its 390 lines, SURVEY.md §2.14);
+built because expert parallelism is a first-class mesh axis of this
+framework (``expert`` in parallel/mesh.py AXES, rule ("expert", "expert")).
+
+TPU-first design:
+
+* static shapes end to end: capacity-based dispatch via one-hot einsums
+  (the GShard/Switch pattern) — no dynamic gathers, no data-dependent
+  shapes, everything lands on the MXU;
+* grouped routing: each batch row is a routing group with its own capacity,
+  so the position cumsum runs over the (local) sequence axis only — routing
+  is entirely local to a data shard, exactly as GShard prescribes; only the
+  dispatch/combine einsums cross shards;
+* expert weights are stacked on a leading ``expert`` logical axis; under a
+  mesh with an ``expert`` axis GSPMD turns the dispatch/combine einsums into
+  all-to-alls over ICI (batch sharded on data x experts sharded on expert);
+* tokens over capacity are dropped (their combine weight is zero), the
+  residual connection around the layer carries them through unchanged —
+  the standard Switch behavior;
+* auxiliary load-balancing loss (Switch eq. 4): E * sum_e f_e * p_e, with
+  f_e computed from the PRE-capacity assignments so the balancing gradient
+  does not vanish when an overloaded expert truncates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import _fan_in_normal
+
+
+@dataclasses.dataclass
+class MoE(Module):
+    """Token-choice MoE MLP block: router -> dispatch -> expert FFN ->
+    combine.  Apply returns (y, aux_loss)."""
+
+    dim: int
+    mlp_dim: int
+    num_experts: int
+    top_k: int = 1                  # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        kr, k1, k2 = jax.random.split(key, 3)
+        e, d, m = self.num_experts, self.dim, self.mlp_dim
+        return {
+            "router": {"w": _fan_in_normal(kr, (d, e), jnp.float32, d)},
+            "fc1": {"w": jax.vmap(lambda k: _fan_in_normal(k, (d, m),
+                                                           self.dtype, d))(
+                        jax.random.split(k1, e)),
+                    "b": jnp.zeros((e, m), self.dtype)},
+            "fc2": {"w": jax.vmap(lambda k: _fan_in_normal(k, (m, d),
+                                                           self.dtype, m))(
+                        jax.random.split(k2, e)),
+                    "b": jnp.zeros((e, d), self.dtype)},
+        }
+
+    def axes(self):
+        return {
+            "router": {"w": ("embed", None)},
+            "fc1": {"w": ("expert", "embed", "mlp"), "b": ("expert", "mlp")},
+            "fc2": {"w": ("expert", "mlp", "embed"), "b": ("expert", "embed")},
+        }
+
+    def capacity(self, tokens_per_group: int) -> int:
+        """Per-group (per batch row) expert buffer size."""
+        return max(1, int(tokens_per_group * self.capacity_factor
+                          * self.top_k / self.num_experts))
+
+    def apply(self, params, x, *, train=False, rng=None):
+        """x (B, T, D) -> (y (B, T, D), aux_loss scalar).
+
+        Each batch row is a routing group: positions come from a cumsum over
+        the T axis only, so with B sharded over data the routing math is
+        local to the shard.
+        """
+        b, t, d = x.shape
+        e = self.num_experts
+        c = self.capacity(t)
+
+        # --- routing (fp32, per group) ---------------------------------
+        logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32),
+                            params["router"]["w"])
+        probs = jax.nn.softmax(logits, axis=-1)                     # (B,T,E)
+
+        remaining = probs
+        fill = jnp.zeros((b, e), jnp.int32)   # per-group expert fill count
+        gates, dispatch_masks, positions, assign_masks = [], [], [], []
+        for _ in range(self.top_k):
+            gate = jnp.max(remaining, axis=-1)                      # (B,T)
+            idx = jnp.argmax(remaining, axis=-1)                    # (B,T)
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)        # (B,T,E)
+            assign_masks.append(onehot)       # PRE-capacity, for aux loss
+            # position of each token within its expert's per-group buffer
+            pos_in_expert = (jnp.cumsum(onehot, axis=1) - 1
+                             + fill[:, None, :])                    # (B,T,E)
+            pos = jnp.sum(pos_in_expert * onehot, axis=-1)          # (B,T)
+            keep = pos < c
+            gates.append(jnp.where(keep, gate, 0.0))
+            dispatch_masks.append(onehot * keep[..., None].astype(jnp.int32))
+            positions.append(jnp.where(keep, pos, 0))
+            fill = fill + jnp.sum(dispatch_masks[-1], axis=1)
+            remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+        # top-1 (Switch): raw router prob as the gate; top-k (GShard):
+        # renormalize the chosen gates to sum to 1
+        if self.top_k > 1:
+            denom = jnp.maximum(sum(gates), 1e-9)
+            gates = [g / denom for g in gates]
+
+        combine = jnp.zeros((b, t, e, c), jnp.float32)
+        for gate, mask, pos in zip(gates, dispatch_masks, positions):
+            oh_pos = jax.nn.one_hot(pos, c, dtype=jnp.float32)      # (B,T,C)
+            combine = combine + (gate[..., None, None]
+                                 * mask[..., None].astype(jnp.float32)
+                                 * oh_pos[..., None, :])
+
+        dispatch = (combine > 0).astype(x.dtype)                    # (B,T,E,C)
+
+        # --- expert computation (all-to-all under expert sharding) -----
+        expert_in = jnp.einsum("btec,btd->ebcd", dispatch,
+                               x.astype(x.dtype))                   # (E,B,C,D)
+        h = jnp.einsum("ebcd,edm->ebcm", expert_in, params["fc1"]["w"])
+        h = jax.nn.gelu(h + params["fc1"]["b"][:, None, None, :])
+        out = jnp.einsum("ebcm,emd->ebcd", h, params["fc2"]["w"])
+        out = out + params["fc2"]["b"][:, None, None, :]            # (E,B,C,D)
+
+        y = jnp.einsum("btec,ebcd->btd", combine.astype(x.dtype), out)
+
+        # --- load-balancing aux loss (Switch eq. 4), pre-capacity f_e --
+        frac_tokens = jnp.mean(
+            sum(m.astype(jnp.float32) for m in assign_masks), axis=(0, 1))
+        frac_probs = jnp.mean(probs, axis=(0, 1))
+        aux = e * jnp.sum(frac_tokens * frac_probs) / self.top_k
+
+        return y, aux

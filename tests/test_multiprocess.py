@@ -28,12 +28,13 @@ def child_env(n_local_devices: int) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={n_local_devices}")
-    # Drop any sitecustomize dirs (e.g. the TPU-relay shim) from the child
+    # Drop sitecustomize shim dirs (e.g. the TPU-relay shim) from the child
     # path: a sitecustomize that imports jax initializes the backend before
     # main() runs, which silently breaks jax.distributed.initialize — each
     # child would come up as a single-process job.
-    inherited = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                 if p and "site" not in os.path.basename(p)]
+    inherited = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
     env["PYTHONPATH"] = os.pathsep.join([REPO_ROOT, *inherited])
     return env
 
@@ -59,10 +60,15 @@ class TestMultiProcess:
                 cmd, cwd=tmp_path, env=child_env(4),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
         outs = []
-        for task, p in enumerate(procs):
-            out, _ = p.communicate(timeout=420)
-            outs.append(out)
-            assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+        try:
+            for task, p in enumerate(procs):
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+        finally:
+            for p in procs:   # never leak hung distributed workers
+                if p.poll() is None:
+                    p.kill()
         # coordinator (task 0) owns the console contract
         assert "Test-Accuracy" in outs[0]
         assert "done" in outs[0]
@@ -89,6 +95,11 @@ class TestMultiProcess:
             procs.append(subprocess.Popen(
                 cmd, cwd=tmp_path, env=child_env(2),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        for task, p in enumerate(procs):
-            out, _ = p.communicate(timeout=420)
-            assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+        try:
+            for task, p in enumerate(procs):
+                out, _ = p.communicate(timeout=420)
+                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
