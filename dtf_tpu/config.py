@@ -100,6 +100,15 @@ class TrainConfig:
     checkpoint_every: int = 0         # steps; 0 disables (ref had no checkpointing, SURVEY §5.4)
     resume: bool = False
     dtype: str = "float32"
+    # Observability (SURVEY §5.1/§5.2; the reference had wall-clock prints
+    # only).  profile_dir: capture an XLA trace of steps
+    # [profile_start, profile_start + profile_steps).  determinism_every:
+    # every N steps verify all processes hold bitwise-identical metrics
+    # (the SPMD moral equivalent of the reference's absent race detector).
+    profile_dir: Optional[str] = None
+    profile_start: int = 10
+    profile_steps: int = 3
+    determinism_every: int = 0        # 0 disables
 
 
 def _field_type(cls, f: dataclasses.Field) -> type:

@@ -230,6 +230,12 @@ class Trainer:
         # Host-side mirror of state["step"]: reading the device scalar every
         # step would sync the async dispatch pipeline.
         self._host_step = int(self.state["step"])
+        self._profiler = None
+        if self.cfg.profile_dir is not None:
+            from dtf_tpu.utils.profiling import StepWindowProfiler
+            self._profiler = StepWindowProfiler(
+                self.cfg.profile_dir, self.cfg.profile_start,
+                self.cfg.profile_steps)
 
     @property
     def global_batch_size(self) -> int:
@@ -279,6 +285,14 @@ class Trainer:
                 self.state, metrics = self.step_fn(self.state, batch, step_rng)
                 count += 1
                 self._host_step += 1
+                if self._profiler is not None:
+                    self._profiler.after_step(self._host_step, self.state)
+                if (cfg.determinism_every > 0
+                        and self._host_step % cfg.determinism_every == 0):
+                    from dtf_tpu.utils.profiling import assert_replicas_agree
+                    assert_replicas_agree(
+                        {"loss": metrics["loss"], "step": self.state["step"]},
+                        what=f"step {self._host_step} metrics")
                 if (self.ckpt is not None and self.cfg.checkpoint_every > 0
                         and self._host_step % self.cfg.checkpoint_every == 0):
                     self.ckpt.save(self._host_step, self.state)
@@ -301,6 +315,8 @@ class Trainer:
                                ev["accuracy"])
         if start_epoch >= epochs:    # resumed past the budget: report eval
             ev = self.eval_fn(self.state, splits.test)
+        if self._profiler is not None:
+            self._profiler.close(self.state)   # never leak an open trace
         block(self.state)
         if self.ckpt is not None:
             if (self.cfg.checkpoint_every > 0

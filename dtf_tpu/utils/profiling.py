@@ -1,0 +1,130 @@
+"""Profiling and cross-process determinism checks.
+
+Tracing (SURVEY.md §5.1): the reference's only observability was wall-clock
+prints (tf_distributed.py:116-122).  Here the framework exposes the XLA
+profiler: ``trace()`` captures a TensorBoard/Perfetto trace of a step window
+and ``start_server()`` opens the live-capture port.  The trainer hooks these
+via TrainConfig.profile_dir / profile_steps.
+
+Determinism (SURVEY.md §5.2): the reference's async PS *embraced* races
+(stale gradients were the design); SPMD psum is race-free by construction,
+and the moral equivalent of a race detector is checking that every process
+computes bitwise-identical results each step.  ``fingerprint()`` +
+``assert_replicas_agree()`` implement that cross-host check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``logdir`` (TensorBoard's profile
+    plugin / Perfetto read it)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_server(port: int = 9999):
+    """Start the live-capture profiler server (tensorboard can connect)."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a host-side region in the trace (TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepWindowProfiler:
+    """Capture one XLA trace over a window of training steps.
+
+    Owns the start/stop lifecycle so the trainer can't leak an open trace:
+    ``after_step(h)`` starts once h enters [start, start+steps) and stops
+    when it leaves; ``close()`` stops unconditionally (end of training
+    before the window completes).  A resume past the window records
+    nothing; the window never restarts.
+    """
+
+    def __init__(self, logdir: str, start: int, steps: int):
+        self.logdir = logdir
+        self.start = start
+        self.end = start + steps
+        self.active = False
+        self.done = False
+
+    def after_step(self, host_step: int, state: Any = None) -> None:
+        if self.done:
+            return
+        if not self.active and self.start <= host_step < self.end:
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+        elif self.active and host_step >= self.end:
+            self._stop(state)
+
+    def close(self, state: Any = None) -> None:
+        if self.active:
+            self._stop(state)
+        self.done = True
+
+    def _stop(self, state: Any) -> None:
+        if state is not None:
+            jax.block_until_ready(state)   # trace covers real device work
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+
+
+def fingerprint(tree: Any) -> np.ndarray:
+    """Order-stable 32-bit digest of a pytree of arrays.
+
+    Bitwise (CRC over raw bytes, not float sums), so it detects even
+    ULP-level divergence across processes.  For multi-process arrays only
+    the first locally-addressable shard is hashed — meaningful for
+    REPLICATED values (loss, metrics, step, unsharded params), where every
+    process should hold identical bytes; a data/fsdp-sharded leaf holds
+    legitimately different shards per process and must not be passed here.
+    """
+    import zlib
+
+    acc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            a = np.asarray(leaf.addressable_shards[0].data)
+        else:
+            a = np.asarray(leaf)
+        acc = zlib.crc32(np.ascontiguousarray(a).tobytes(), acc)
+    return np.asarray([acc], np.uint32)
+
+
+def assert_replicas_agree(tree: Any, what: str = "state") -> None:
+    """Verify every process holds a bitwise-identical (replicated) ``tree``.
+
+    Single-process: no-op (early return before any device sync, so the
+    async dispatch pipeline is never stalled).  Multi-process: all-gather
+    the digest over the coordination service and compare.  Raises
+    RuntimeError naming the divergent processes.
+    """
+    if jax.process_count() == 1:
+        return
+    digest = fingerprint(tree)
+    from jax.experimental import multihost_utils
+
+    all_digests = np.asarray(
+        multihost_utils.process_allgather(digest))       # (P, 1)
+    if not (all_digests == all_digests[0]).all():
+        bad = [i for i, d in enumerate(all_digests)
+               if int(d[0]) != int(all_digests[0][0])]
+        raise RuntimeError(
+            f"cross-process determinism violation in {what}: processes "
+            f"{bad} diverge from process 0 "
+            f"(digests={[hex(int(d[0])) for d in all_digests]})")

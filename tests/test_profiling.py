@@ -1,0 +1,67 @@
+"""Profiler hook + determinism-check utilities (SURVEY.md §5.1, §5.2)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.utils.profiling import (assert_replicas_agree, fingerprint,
+                                     trace)
+
+
+class TestFingerprint:
+    def test_bitwise_sensitivity(self):
+        a = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        b = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        assert fingerprint(a) == fingerprint(b)
+        # a single-ULP change flips the digest
+        c = {"w": jnp.ones((4, 4)).at[0, 0].set(
+                 np.nextafter(np.float32(1.0), np.float32(2.0))),
+             "b": jnp.zeros((4,))}
+        assert fingerprint(a) != fingerprint(c)
+
+    def test_order_stability_across_dtypes(self):
+        t = {"x": jnp.arange(6, dtype=jnp.int32),
+             "y": jnp.arange(6, dtype=jnp.float32)}
+        assert fingerprint(t) == fingerprint(t)
+        assert fingerprint(t) != fingerprint({"x": t["y"], "y": t["x"]})
+
+    def test_single_process_agree_noop(self):
+        assert_replicas_agree({"loss": jnp.float32(1.5)})   # must not raise
+
+
+class TestTraceHook:
+    def test_trace_writes_profile(self, tmp_path):
+        logdir = str(tmp_path / "prof")
+        with trace(logdir):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+        found = []
+        for root, _, files in os.walk(logdir):
+            found += [f for f in files if f.endswith((".trace.json.gz",
+                                                      ".xplane.pb"))]
+        assert found, f"no trace artifacts under {logdir}"
+
+    def test_trainer_profile_window(self, mesh8, tmp_path):
+        from dtf_tpu import optim
+        from dtf_tpu.cluster import Cluster
+        from dtf_tpu.config import ClusterConfig, TrainConfig
+        from dtf_tpu.data import load_mnist
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.trainer import Trainer
+
+        prof = str(tmp_path / "prof")
+        cfg = TrainConfig(batch_size=512, epochs=1, log_frequency=1000,
+                          seed=1, logdir=str(tmp_path),
+                          profile_dir=prof, profile_start=2, profile_steps=2,
+                          determinism_every=5)
+        cluster = Cluster(config=ClusterConfig(), mesh=mesh8)
+        t = Trainer(cluster, MnistMLP(init_scale="fan_in"), optim.sgd(0.05),
+                    cfg)
+        t.fit(load_mnist(seed=1), epochs=1)
+        found = []
+        for root, _, files in os.walk(prof):
+            found += [f for f in files if f.endswith((".trace.json.gz",
+                                                      ".xplane.pb"))]
+        assert found, "trainer profile window produced no trace"
