@@ -121,10 +121,17 @@ def _synthetic_classification(n: int, feat_shape: tuple, num_classes: int,
 
 
 def load_mnist(data_dir: str = "MNIST_data", seed: int = 1,
-               flat: bool = True) -> DataSplits:
+               flat: bool = True,
+               native_train_batch: Optional[int] = None) -> DataSplits:
     """MNIST as the reference consumed it: 784-dim flat float images in
     [0,1], one-hot labels (tf_distributed.py:27-28,42-46).  Falls back to
-    synthetic data (same shapes) when the IDX files are absent."""
+    synthetic data (same shapes) when the IDX files are absent.
+
+    ``native_train_batch``: serve the TRAIN split through the C++
+    prefetching loader (dtf_tpu/native) at this fixed batch size; falls
+    back silently to the Python loader when the native build or the raw
+    (non-gzip) IDX files are unavailable.
+    """
     names = {
         "train_x": ("train-images-idx3-ubyte", 0), "train_y": ("train-labels-idx1-ubyte", 0),
         "test_x": ("t10k-images-idx3-ubyte", 0), "test_y": ("t10k-labels-idx1-ubyte", 0),
@@ -142,7 +149,33 @@ def load_mnist(data_dir: str = "MNIST_data", seed: int = 1,
         def imgs(p):
             x = _read_idx(p).astype(np.float32) / 255.0
             return x.reshape(len(x), -1) if flat else x[..., None]
-        train = Dataset(imgs(paths["train_x"]), _one_hot(_read_idx(paths["train_y"]), 10), seed)
+        train = None
+        if (native_train_batch and flat
+                and not paths["train_x"].endswith(".gz")
+                and not paths["train_y"].endswith(".gz")):
+            from dtf_tpu.data.native_loader import NativeDataset
+            train = NativeDataset.from_idx(
+                paths["train_x"], paths["train_y"],
+                batch_size=native_train_batch, seed=seed)
+            # Multi-process SPMD requires every process to build IDENTICAL
+            # global batches (see module docstring).  The native loader's
+            # shuffle stream differs from numpy's, so a per-host build/file
+            # failure would silently desynchronize the batch streams.  Use
+            # native only if EVERY process succeeded; otherwise all fall
+            # back together.
+            import jax
+            if jax.process_count() > 1:
+                import numpy as _np
+                from jax.experimental import multihost_utils
+                ok = _np.asarray([1 if train is not None else 0], _np.int32)
+                all_ok = _np.asarray(multihost_utils.process_allgather(ok))
+                if not all_ok.all():
+                    if train is not None:
+                        train.close()
+                    train = None
+        if train is None:
+            train = Dataset(imgs(paths["train_x"]),
+                            _one_hot(_read_idx(paths["train_y"]), 10), seed)
         test = Dataset(imgs(paths["test_x"]), _one_hot(_read_idx(paths["test_y"]), 10), seed)
         return DataSplits(train, test, synthetic=False)
 

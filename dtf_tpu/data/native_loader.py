@@ -1,0 +1,92 @@
+"""Python binding for the native prefetching data loader.
+
+``NativeDataset`` exposes the same ``next_batch``/``num_examples`` contract
+as :class:`dtf_tpu.data.Dataset`, but batch assembly (shuffle, /255
+normalize, one-hot) happens on a C++ background thread with a bounded ring
+buffer — the Python thread only memcpy's finished batches, so input work
+overlaps jit dispatch instead of serializing with it.  Fixed batch size
+(set at construction; the prefetcher owns the shapes).
+
+Falls back cleanly: ``from_idx`` returns None when the native library can't
+build or the files aren't raw IDX (e.g. gzipped) — callers keep the pure
+Python loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from dtf_tpu.native import load_library
+
+
+class NativeDataset:
+    """Prefetched IDX dataset with the Dataset batch contract."""
+
+    def __init__(self, lib, handle: int, batch_size: int, num_classes: int):
+        self._lib = lib
+        self._handle = handle
+        self.batch_size = batch_size
+        self.num_classes = num_classes
+        self._n = lib.dtf_loader_num_examples(handle)
+        self._feat = lib.dtf_loader_feat(handle)
+        self.batches_consumed = 0
+
+    @classmethod
+    def from_idx(cls, images_path: str, labels_path: str, *,
+                 batch_size: int, num_classes: int = 10,
+                 seed: int = 1, queue_depth: int = 4
+                 ) -> "Optional[NativeDataset]":
+        lib = load_library()
+        if lib is None:
+            return None
+        handle = lib.dtf_loader_open(
+            images_path.encode(), labels_path.encode(), num_classes,
+            batch_size, seed, queue_depth)
+        if not handle:
+            return None
+        return cls(lib, handle, batch_size, num_classes)
+
+    @property
+    def num_examples(self) -> int:
+        return self._n
+
+    @property
+    def feature_dim(self) -> int:
+        return self._feat
+
+    def next_batch(self, batch_size: int) -> tuple:
+        if batch_size != self.batch_size:
+            raise ValueError(
+                f"NativeDataset prefetches fixed batches of "
+                f"{self.batch_size}, got request for {batch_size}")
+        imgs = np.empty((self.batch_size, self._feat), np.float32)
+        labs = np.empty((self.batch_size, self.num_classes), np.float32)
+        rc = self._lib.dtf_loader_next(
+            self._handle,
+            imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError("native loader failed")
+        self.batches_consumed += 1
+        return imgs, labs
+
+    def fast_forward(self, n_batches: int, batch_size: int) -> None:
+        """Resume support: drain n batches (the prefetcher computes them
+        anyway; draining keeps the shuffle stream aligned)."""
+        for _ in range(n_batches):
+            self.next_batch(batch_size)
+        # next_batch already counted them
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dtf_loader_close(self._handle)
+            self._handle = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The compute path of this framework is XLA/Pallas on TPU; the host-side
+runtime around it is C++ where the reference delegated to TF's C++ runtime
+(SURVEY.md §2.13).  Components here build on demand with ``g++`` into a
+shared library next to the source, cached by source mtime, and every
+consumer has a pure-Python fallback so the framework works without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("dtf_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dataloader.cpp")
+_LIB = os.path.join(_DIR, "_libdtfdata.so")
+_lock = threading.Lock()
+_lib: "Optional[ctypes.CDLL] | bool" = None   # None=untried, False=failed
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        log.warning("native dataloader build failed (%s); using the Python "
+                    "loader. %s", e, detail.decode(errors="replace")[:500])
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The native dataloader library, building it on first use.  Returns
+    None (and logs once) when no toolchain is available."""
+    global _lib
+    with _lock:
+        if _lib is False:
+            return None
+        if _lib is not None:
+            return _lib
+        stale = (not os.path.exists(_LIB)
+                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            _lib = False
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.dtf_loader_open.restype = ctypes.c_void_p
+        lib.dtf_loader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.dtf_loader_next.restype = ctypes.c_int
+        lib.dtf_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        for name in ("dtf_loader_num_examples", "dtf_loader_feat"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p]
+        lib.dtf_loader_close.restype = None
+        lib.dtf_loader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib

@@ -1,0 +1,192 @@
+// Native data loader: IDX (MNIST-format) parser + threaded prefetch ring.
+//
+// The reference delegated all native work to the TensorFlow C++ runtime
+// (SURVEY.md §2.13); this framework's compute path is XLA/Pallas, and the
+// host-side runtime around it is native where it matters.  Input pipelines
+// are host-bound work that competes with dispatch on the Python thread, so
+// batch assembly (shuffle, normalize, one-hot) runs here on a background
+// thread with a bounded ring buffer; Python only memcpy's finished batches.
+//
+// Contract mirrors dtf_tpu.data.Dataset.next_batch (shuffled epochs,
+// sequential batches, reshuffle at epoch end) with its own xorshift RNG.
+//
+// Build: g++ -O3 -shared -fPIC dataloader.cpp -o _libdtfdata.so  (see
+// dtf_tpu/native/__init__.py, which builds lazily and caches by mtime).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Idx {
+  std::vector<uint8_t> data;
+  std::vector<int> shape;
+};
+
+bool read_idx(const char* path, Idx* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  uint8_t magic[4];
+  if (fread(magic, 1, 4, f) != 4) { fclose(f); return false; }
+  // IDX magic: 0x00 0x00 <dtype> <ndim>; only uint8 (0x08) is supported
+  int ndim = magic[3];
+  if (magic[0] != 0 || magic[1] != 0 || magic[2] != 0x08 ||
+      ndim < 1 || ndim > 4) {
+    fclose(f);
+    return false;
+  }
+  out->shape.assign(ndim, 0);
+  size_t total = 1;
+  constexpr size_t kMaxBytes = size_t{1} << 33;  // 8 GiB sanity cap
+  for (int i = 0; i < ndim; i++) {
+    uint8_t b[4];
+    if (fread(b, 1, 4, f) != 4) { fclose(f); return false; }
+    int dim = (b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3];
+    if (dim <= 0) { fclose(f); return false; }
+    out->shape[i] = dim;
+    total *= static_cast<size_t>(dim);
+    if (total > kMaxBytes) { fclose(f); return false; }
+  }
+  out->data.resize(total);
+  size_t got = fread(out->data.data(), 1, total, f);
+  fclose(f);
+  return got == total;
+}
+
+uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return *s = x;
+}
+
+struct Loader {
+  Idx images, labels;
+  int n = 0, feat = 0, classes = 0, batch = 0, depth = 0;
+  uint64_t rng = 0;
+  std::vector<uint32_t> order;
+  size_t pos = 0;
+
+  // ring buffer of finished batches
+  std::vector<std::vector<float>> img_q, lab_q;
+  size_t head = 0, tail = 0, count = 0;
+  std::mutex mu;
+  std::condition_variable cv_can_produce, cv_can_consume;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  void reshuffle() {  // Fisher-Yates over the index order
+    for (size_t i = order.size() - 1; i > 0; i--) {
+      size_t j = xorshift64(&rng) % (i + 1);
+      std::swap(order[i], order[j]);
+    }
+  }
+
+  void fill_batch(float* img_out, float* lab_out) {
+    // mirror dtf_tpu.data.Dataset.next_batch: reshuffle at batch start
+    // when the whole batch no longer fits in the epoch
+    if (pos + static_cast<size_t>(batch) > static_cast<size_t>(n)) {
+      reshuffle();
+      pos = 0;
+    }
+    for (int b = 0; b < batch; b++) {
+      uint32_t idx = order[pos++];
+      const uint8_t* src = images.data.data() +
+                           static_cast<size_t>(idx) * feat;
+      float* dst = img_out + static_cast<size_t>(b) * feat;
+      for (int k = 0; k < feat; k++) dst[k] = src[k] * (1.0f / 255.0f);
+      float* lab = lab_out + static_cast<size_t>(b) * classes;
+      memset(lab, 0, sizeof(float) * classes);
+      int y = labels.data[idx];
+      if (y >= 0 && y < classes) lab[y] = 1.0f;
+    }
+  }
+
+  void run() {
+    while (!stop.load()) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_can_produce.wait(lk, [&] {
+        return stop.load() || count < static_cast<size_t>(depth);
+      });
+      if (stop.load()) return;
+      size_t slot = head;
+      lk.unlock();
+      fill_batch(img_q[slot].data(), lab_q[slot].data());
+      lk.lock();
+      head = (head + 1) % depth;
+      count++;
+      cv_can_consume.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Loader* dtf_loader_open(const char* images_path, const char* labels_path,
+                        int classes, int batch, uint64_t seed,
+                        int depth) try {
+  Loader* ld = new Loader();
+  if (!read_idx(images_path, &ld->images) ||
+      !read_idx(labels_path, &ld->labels) ||
+      ld->images.shape.empty() || ld->labels.shape.empty() ||
+      ld->images.shape[0] != ld->labels.shape[0] ||
+      batch < 1 || batch > ld->images.shape[0]) {
+    delete ld;
+    return nullptr;
+  }
+  ld->n = ld->images.shape[0];
+  ld->feat = static_cast<int>(ld->images.data.size()) / ld->n;
+  ld->classes = classes;
+  ld->batch = batch;
+  ld->depth = depth < 1 ? 1 : depth;
+  ld->rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  ld->order.resize(ld->n);
+  for (int i = 0; i < ld->n; i++) ld->order[i] = i;
+  ld->reshuffle();
+  ld->img_q.assign(ld->depth, std::vector<float>(
+      static_cast<size_t>(batch) * ld->feat));
+  ld->lab_q.assign(ld->depth, std::vector<float>(
+      static_cast<size_t>(batch) * classes));
+  ld->worker = std::thread([ld] { ld->run(); });
+  return ld;
+} catch (...) {
+  return nullptr;   // never let C++ exceptions cross the C boundary
+}
+
+int dtf_loader_num_examples(Loader* ld) { return ld ? ld->n : -1; }
+int dtf_loader_feat(Loader* ld) { return ld ? ld->feat : -1; }
+
+// Blocking: copies the next prefetched batch into caller buffers.
+int dtf_loader_next(Loader* ld, float* images_out, float* labels_out) {
+  if (!ld) return -1;
+  std::unique_lock<std::mutex> lk(ld->mu);
+  ld->cv_can_consume.wait(lk, [&] { return ld->count > 0; });
+  size_t slot = ld->tail;
+  memcpy(images_out, ld->img_q[slot].data(),
+         ld->img_q[slot].size() * sizeof(float));
+  memcpy(labels_out, ld->lab_q[slot].data(),
+         ld->lab_q[slot].size() * sizeof(float));
+  ld->tail = (ld->tail + 1) % ld->depth;
+  ld->count--;
+  ld->cv_can_produce.notify_one();
+  return 0;
+}
+
+void dtf_loader_close(Loader* ld) {
+  if (!ld) return;
+  ld->stop.store(true);
+  ld->cv_can_produce.notify_all();
+  if (ld->worker.joinable()) ld->worker.join();
+  delete ld;
+}
+
+}  // extern "C"

@@ -30,12 +30,21 @@ def main(argv=None) -> int:
                         default="implicit",
                         help="gradient sync: GSPMD-inserted (implicit) or "
                              "shard_map+psum (explicit)")
+    parser.add_argument("--native_loader", action="store_true",
+                        help="serve train batches through the C++ "
+                             "prefetching loader (dtf_tpu/native)")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
     cluster = bootstrap(cluster_cfg)
-    splits = load_mnist(seed=train_cfg.seed)
+    # The native prefetcher needs the trainer's GLOBAL batch size (fixed
+    # shapes): per_device_batch scales by the device count.
+    global_batch = (train_cfg.per_device_batch * cluster.num_devices
+                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    splits = load_mnist(
+        seed=train_cfg.seed,
+        native_train_batch=global_batch if ns.native_loader else None)
     if splits.synthetic and cluster.is_coordinator:
         print("[dtf_tpu] MNIST_data/ not found; using deterministic "
               "synthetic data (zero-egress environment)")
