@@ -46,6 +46,10 @@ class BertConfig:
     # schedule (parallel/pipeline.py) instead of lax.scan.
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
+    # Rematerialization: recompute encoder-layer activations in the backward
+    # pass instead of storing them (jax.checkpoint) — trades ~30% more FLOPs
+    # for O(num_layers x B x T x D) less HBM, the standard TPU memory lever.
+    remat: bool = False
 
     @classmethod
     def tiny(cls, **kw):
@@ -133,6 +137,12 @@ class BertMLM(Module):
             if pad_mask is not None:
                 raise ValueError("pipelined encoder does not support "
                                  "pad_mask (microbatching would split it)")
+            if self.cfg.attn_impl is not None:
+                raise ValueError(
+                    "pipelined encoder requires the default attention: a "
+                    "shard_map-based attn_impl (ring attention) cannot nest "
+                    "inside the pipeline's shard_map (all mesh axes are "
+                    "Manual there); use PP x DP or SP x DP, not PP x SP")
             from dtf_tpu.parallel.pipeline import pipeline_apply
             mesh = self.cfg.pipeline_mesh
             s = mesh.shape["pipe"]
@@ -154,8 +164,12 @@ class BertMLM(Module):
                 stage, grouped, x, mesh,
                 num_microbatches=self.cfg.pipeline_microbatches)
 
+        layer_fn = lambda lp, h: self.layer.apply(lp, h, mask=attn_mask)
+        if self.cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
         def body(carry, layer_params):
-            return self.layer.apply(layer_params, carry, mask=attn_mask), None
+            return layer_fn(layer_params, carry), None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
         return x

@@ -1,0 +1,279 @@
+"""Decoder-only (GPT-style) causal language model with KV-cache generation.
+
+Not in the reference (no sequence models, SURVEY.md §5.7) — part of this
+framework's first-class long-context support.  TPU-first:
+
+* pre-LN decoder blocks scanned over stacked per-layer params (one compiled
+  body, 'stage' leading axis ready for pipeline sharding);
+* causal attention defaults to the Pallas flash kernel on TPU
+  (ops/flash_attention.py, O(T) memory) and the XLA path elsewhere;
+* generation is a ``lax.scan`` over positions with a static-shape KV cache
+  — per-step attention masks positions beyond the current index instead of
+  dynamic shapes, so decode compiles once;
+* logits tied to the token embedding; LayerNorm stats and loss in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.nn.attention import MultiHeadAttention, dot_product_attention
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
+
+NEG_BIG = -1e30
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.float32
+    use_flash: Optional[bool] = None   # None = flash on TPU, XLA elsewhere
+    remat: bool = False
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, dim=32, num_layers=2, num_heads=4,
+                 mlp_dim=64, max_len=64)
+        d.update(kw)
+        return cls(**d)
+
+    def flash_enabled(self) -> bool:
+        if self.use_flash is None:
+            return jax.default_backend() == "tpu"
+        return self.use_flash
+
+
+class GPTBlock(Module):
+    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x))."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.ln1 = LayerNorm(cfg.dim)
+        self.ln2 = LayerNorm(cfg.dim)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
+        self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
+                         axes_in="embed", axes_out="mlp")
+        self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
+                         axes_in="mlp", axes_out="embed")
+
+    def init(self, key):
+        k1, k2, ka, kf1, kf2 = jax.random.split(key, 5)
+        return {"ln1": self.ln1.init(k1), "ln2": self.ln2.init(k2),
+                "attn": self.attn.init(ka), "fc1": self.fc1.init(kf1),
+                "fc2": self.fc2.init(kf2)}
+
+    def _attn_causal(self, params, x):
+        cfg = self.cfg
+        p = params["attn"]
+        q = jnp.einsum("btd,dhk->bthk", x, p["q"]["w"]) + p["q"]["b"]
+        k = jnp.einsum("btd,dhk->bthk", x, p["k"]["w"]) + p["k"]["b"]
+        v = jnp.einsum("btd,dhk->bthk", x, p["v"]["w"]) + p["v"]["b"]
+        if cfg.flash_enabled():
+            from dtf_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True).transpose(0, 2, 1, 3)
+        else:
+            t = x.shape[1]
+            mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+            out = dot_product_attention(q, k, v, mask=mask)
+        return jnp.einsum("bthk,hkd->btd", out, p["o"]["w"]) + p["o"]["b"]
+
+    def apply(self, params, x, *, train=False, rng=None):
+        x = x + self._attn_causal(params, self.ln1.apply(params["ln1"], x))
+        h = self.ln2.apply(params["ln2"], x)
+        h = self.fc2.apply(params["fc2"],
+                           jax.nn.gelu(self.fc1.apply(params["fc1"], h)))
+        return x + h
+
+    def decode_step(self, params, x_t, cache, pos):
+        """One token through the block with a KV cache.
+
+        x_t: (B, 1, D); cache: {"k","v"}: (B, T_max, H, Dh); pos: scalar
+        index of this token.  Returns (y_t, new_cache).
+        """
+        p = params["attn"]
+        h = self.ln1.apply(params["ln1"], x_t)
+        q = jnp.einsum("btd,dhk->bthk", h, p["q"]["w"]) + p["q"]["b"]
+        k_t = jnp.einsum("btd,dhk->bthk", h, p["k"]["w"]) + p["k"]["b"]
+        v_t = jnp.einsum("btd,dhk->bthk", h, p["v"]["w"]) + p["v"]["b"]
+        cache_k = lax.dynamic_update_slice_in_dim(cache["k"],
+                                                  k_t.astype(cache["k"].dtype),
+                                                  pos, axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(cache["v"],
+                                                  v_t.astype(cache["v"].dtype),
+                                                  pos, axis=1)
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       cache_k.astype(jnp.float32)) * scale    # (B,H,1,Tmax)
+        t_max = cache_k.shape[1]
+        visible = jnp.arange(t_max)[None, None, None, :] <= pos
+        s = jnp.where(visible, s, NEG_BIG)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w,
+                         cache_v.astype(jnp.float32)).astype(x_t.dtype)
+        a = jnp.einsum("bthk,hkd->btd", out, p["o"]["w"]) + p["o"]["b"]
+        x_t = x_t + a
+        h = self.ln2.apply(params["ln2"], x_t)
+        h = self.fc2.apply(params["fc2"],
+                           jax.nn.gelu(self.fc1.apply(params["fc1"], h)))
+        return x_t + h, {"k": cache_k, "v": cache_v}
+
+    def axes(self):
+        return {"ln1": self.ln1.axes(), "ln2": self.ln2.axes(),
+                "attn": self.attn.axes(), "fc1": self.fc1.axes(),
+                "fc2": self.fc2.axes()}
+
+
+@dataclasses.dataclass
+class GPT(Module):
+    """Token+position embeddings -> scanned decoder stack -> tied LM head."""
+
+    cfg: GPTConfig
+
+    def __post_init__(self):
+        cfg = self.cfg
+        self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
+        self.pos = Embedding(cfg.max_len, cfg.dim, cfg.dtype)
+        self.block = GPTBlock(cfg)
+        self.ln_f = LayerNorm(cfg.dim)
+
+    def init(self, key):
+        kt, kp, ks, kl = jax.random.split(key, 4)
+        stacked = jax.vmap(self.block.init)(
+            jax.random.split(ks, self.cfg.num_layers))
+        return {"tok": self.tok.init(kt), "pos": self.pos.init(kp),
+                "layers": stacked, "ln_f": self.ln_f.init(kl)}
+
+    def apply(self, params, tokens, *, train=False, rng=None):
+        """tokens (B, T) -> logits (B, T, V)."""
+        t = tokens.shape[1]
+        x = (self.tok.apply(params["tok"], tokens)
+             + self.pos.apply(params["pos"], jnp.arange(t)))
+
+        block_fn = self.block.apply
+        if self.cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+
+        def body(carry, lp):
+            return block_fn(lp, carry), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = self.ln_f.apply(params["ln_f"], x)
+        return self.tok.attend(params["tok"], x).astype(jnp.float32)
+
+    def axes(self):
+        layer_axes = jax.tree_util.tree_map(
+            lambda ax: (None, *ax), self.block.axes(),
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                a is None or isinstance(a, str) for a in x))
+        return {"tok": self.tok.axes(), "pos": {"table": (None, "embed")},
+                "layers": layer_axes, "ln_f": self.ln_f.axes()}
+
+    # --- training objective -------------------------------------------
+
+    def loss(self, params, batch, rng=None, train=True):
+        """Next-token cross-entropy.  batch: tokens (B, T) int32.
+
+        The forward runs on the FULL sequence and the logits are shifted
+        (not the tokens): T stays a flash-kernel-friendly power-of-two
+        instead of T-1.
+        """
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        logits = self.apply(params, tokens, train=train)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+        loss = -jnp.mean(tok_logp)
+        acc = jnp.mean((jnp.argmax(logits, -1) == targets)
+                       .astype(jnp.float32))
+        return loss, {"accuracy": acc,
+                      "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+    def eval_metrics(self, params, batch):
+        loss, aux = self.loss(params, batch, train=False)
+        return {"loss": loss, **aux}
+
+    # --- autoregressive generation ------------------------------------
+
+    def init_cache(self, batch: int):
+        cfg = self.cfg
+        hd = cfg.dim // cfg.num_heads
+        shape = (cfg.num_layers, batch, cfg.max_len, cfg.num_heads, hd)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+
+    def generate(self, params, prompt, max_new_tokens: int, *,
+                 temperature: float = 1.0, rng=None):
+        """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
+
+        One compiled program: the prompt prefills the cache position by
+        position, then new tokens are sampled; everything is a single
+        ``lax.scan`` over time steps with a static-shape cache.
+        temperature=0 -> greedy.
+        """
+        cfg = self.cfg
+        b, p_len = prompt.shape
+        total = p_len + max_new_tokens
+        if total > cfg.max_len:
+            raise ValueError(f"prompt+new = {total} exceeds max_len "
+                             f"{cfg.max_len}")
+        if rng is None:
+            rng = jax.random.key(0)
+
+        cache = self.init_cache(b)
+        out = jnp.zeros((b, total), jnp.int32)
+        out = lax.dynamic_update_slice(out, prompt, (0, 0))
+
+        def step(carry, pos):
+            out, cache, rng = carry
+            tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
+            x = (self.tok.apply(params["tok"], tok)
+                 + self.pos.apply(params["pos"], pos[None]))
+
+            # thread the per-layer caches through a scan over layers
+            def layer_scan(carry_x, inputs):
+                lp, ck, cv = inputs
+                y, nc = self.block.decode_step(lp, carry_x,
+                                               {"k": ck, "v": cv}, pos)
+                return y, (nc["k"], nc["v"])
+
+            x, (new_k, new_v) = lax.scan(
+                layer_scan, x, (params["layers"], cache["k"], cache["v"]))
+            cache = {"k": new_k, "v": new_v}
+            x = self.ln_f.apply(params["ln_f"], x)
+            logits = self.tok.attend(params["tok"], x)[:, 0, :]  # (B, V)
+
+            rng, sub = jax.random.split(rng)
+            if temperature == 0.0:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(
+                    sub, logits.astype(jnp.float32) / temperature, axis=-1
+                ).astype(jnp.int32)
+            # during prefill (pos+1 < p_len) keep the prompt token
+            keep_prompt = pos + 1 < p_len
+            existing = lax.dynamic_slice(out, (0, pos + 1), (b, 1))[:, 0]
+            nxt = jnp.where(keep_prompt, existing, nxt)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
+            return (out, cache, rng), None
+
+        (out, _, _), _ = lax.scan(step, (out, cache, rng),
+                                  jnp.arange(total - 1))
+        return out

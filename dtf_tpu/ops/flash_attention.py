@@ -39,11 +39,19 @@ def _interpret_default() -> bool:
 
 
 def _block_sizes(t: int, block_q: int, block_k: int) -> tuple:
-    bq, bk = min(block_q, t), min(block_k, t)
-    if t % bq or t % bk:
-        raise ValueError(f"seq len {t} must be divisible by block sizes "
-                         f"({bq}, {bk}); pad the sequence")
-    return bq, bk
+    """Largest divisors of t not exceeding the requested block sizes.
+
+    T = 768 with 512 requested -> 384 (still a lane-friendly multiple of
+    128); T smaller than the request -> T itself.  Never raises — a prime T
+    degrades to block 1 (slow but correct) rather than failing.
+    """
+    def pick(want: int) -> int:
+        b = min(want, t)
+        while b > 1 and t % b:
+            b -= 1
+        return max(b, 1)
+
+    return pick(block_q), pick(block_k)
 
 
 def _causal_mask_block(s, q_start, k_start):
@@ -290,7 +298,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret=None):
     """Flash attention over (B, H, T, D) tensors; returns (B, H, T, D).
 
@@ -303,8 +311,8 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None,
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
-def flash_attention_impl(causal: bool = False, block_q: int = 128,
-                         block_k: int = 128):
+def flash_attention_impl(causal: bool = False, block_q: int = 512,
+                         block_k: int = 512):
     """Adapter matching MultiHeadAttention's ``attn_impl`` contract:
     f(q, k, v, mask) with (B, T, H, D) layout.  Only supports mask=None
     (use causal=True for causal); padding masks fall back to the XLA path

@@ -1,0 +1,83 @@
+"""Shared fixed-step pretrain-benchmark driver for the LM workloads.
+
+One implementation of the mesh/sharding setup, the two-step warmup protocol
+(first compiles, second settles post-step sharding layouts), the windowed
+step timing with ``block_until_ready`` sync points, and the summary line —
+used by ``bert_pretrain`` and ``lm`` so the timing methodology cannot
+drift between workloads.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
+                       steps: int, *, tokens_per_example: int,
+                       throughput_unit: str = "tok") -> tuple:
+    """Run ``steps`` timed train steps over ``toks`` (N, T) int32.
+
+    Returns (state, metrics, ms_per_step).  Prints the reference step-line
+    contract plus a Step-Time/Throughput summary.
+    """
+    from dtf_tpu import optim
+    from dtf_tpu.parallel import sharding as sh
+    from dtf_tpu.train.metrics import format_step_line
+    from dtf_tpu.train.trainer import init_state, make_train_step, put_global_batch
+    from dtf_tpu.utils.timing import block
+
+    mesh = cluster.mesh
+    global_batch = (train_cfg.per_device_batch * cluster.num_devices
+                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
+             else sh.DEFAULT_RULES)
+    shardings = sh.apply_rules(model.axes(), mesh, rules)
+    opt = optim.adam(train_cfg.learning_rate)
+    state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh,
+                       param_shardings=shardings)
+    step_fn = make_train_step(model.loss, opt, mesh)
+
+    n_batches = len(toks) // global_batch
+    rng_base = jax.random.key(train_cfg.seed + 17)
+
+    def batch_at(i):
+        j = (i % n_batches) * global_batch
+        return put_global_batch(mesh, toks[j:j + global_batch])
+
+    # two warmup steps (untimed): first compiles, second runs with the
+    # settled post-step state shardings (a sharding-layout change after
+    # step one can trigger one more compile)
+    metrics = {}
+    for w in range(2):
+        state, metrics = step_fn(state, batch_at(w), jax.random.key(w))
+        block(state)
+
+    t0 = time.perf_counter()
+    window_t, window_n = t0, 0
+    for i in range(steps):
+        state, metrics = step_fn(
+            state, batch_at(i + 1), jax.random.fold_in(rng_base, i))
+        window_n += 1
+        if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == steps:
+            block(state)
+            now = time.perf_counter()
+            avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
+            logger.print(format_step_line(
+                int(state["step"]), 1, i + 1, steps,
+                float(metrics["loss"]), avg_ms))
+            logger.scalar(int(state["step"]), "cost", float(metrics["loss"]))
+            logger.scalar(int(state["step"]), "avg_ms", avg_ms)
+            window_t, window_n = now, 0
+    block(state)
+    total_s = time.perf_counter() - t0
+    ms_per_step = total_s * 1000.0 / steps
+    per_s = steps * global_batch * tokens_per_example / total_s
+    logger.print("Total Time: %3.2fs" % total_s)
+    logger.print(f"Step-Time: {ms_per_step:.2f}ms  "
+                 f"Throughput: {per_s:.1f} {throughput_unit}/s  "
+                 f"(global batch {global_batch}, mesh {dict(mesh.shape)})")
+    return state, metrics, ms_per_step

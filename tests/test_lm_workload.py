@@ -1,0 +1,25 @@
+"""GPT LM workload CLI: pretrain benchmark + generation demo."""
+
+import pytest
+
+from dtf_tpu.workloads.lm import main
+
+
+class TestLMWorkload:
+    def test_runs_with_generation(self, tmp_path, capsys):
+        rc = main(["--preset", "tiny", "--steps", "4", "--batch_size", "16",
+                   "--mesh", "data=4,fsdp=2", "--log_frequency", "2",
+                   "--generate", "8", "--logdir", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Step-Time:" in out
+        assert "Perplexity:" in out
+        assert "Generated:" in out
+        assert "done" in out
+
+    def test_xla_attn_flag(self, tmp_path, capsys):
+        rc = main(["--preset", "tiny", "--steps", "2", "--batch_size", "8",
+                   "--attn", "xla", "--log_frequency", "2",
+                   "--logdir", str(tmp_path)])
+        assert rc == 0
+        assert "Step-Time:" in capsys.readouterr().out
