@@ -50,6 +50,13 @@ class BertConfig:
     # pass instead of storing them (jax.checkpoint) — trades ~30% more FLOPs
     # for O(num_layers x B x T x D) less HBM, the standard TPU memory lever.
     remat: bool = False
+    # Mixture-of-Experts: >0 replaces every layer's dense FFN with a MoE of
+    # that many experts (nn/moe.py; expert-parallel over the 'expert' mesh
+    # axis).  The router's load-balance aux loss is added to the MLM loss
+    # with weight moe_aux_weight.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_aux_weight: float = 0.01
 
     @classmethod
     def tiny(cls, **kw):
@@ -61,7 +68,13 @@ class BertConfig:
 
 
 class BertEncoderLayer(Module):
-    """Post-LN transformer block (attention -> add&norm -> MLP -> add&norm)."""
+    """Post-LN transformer block (attention -> add&norm -> FFN -> add&norm).
+
+    The FFN is dense by default; with cfg.moe_experts > 0 it is a
+    token-choice MoE and ``apply`` additionally returns the router's
+    load-balance aux loss (0.0 for the dense FFN) — callers that scan the
+    stack accumulate it.
+    """
 
     def __init__(self, cfg: BertConfig):
         self.cfg = cfg
@@ -69,28 +82,43 @@ class BertEncoderLayer(Module):
                                        attn_impl=cfg.attn_impl)
         self.ln1 = LayerNorm(cfg.dim)
         self.ln2 = LayerNorm(cfg.dim)
-        self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
-                         axes_in="embed", axes_out="mlp")
-        self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
-                         axes_in="mlp", axes_out="embed")
+        self.moe = None
+        if cfg.moe_experts > 0:
+            from dtf_tpu.nn.moe import MoE
+            self.moe = MoE(cfg.dim, cfg.mlp_dim, cfg.moe_experts,
+                           top_k=cfg.moe_top_k, dtype=cfg.dtype)
+        else:
+            self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
+                             axes_in="embed", axes_out="mlp")
+            self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
+                             axes_in="mlp", axes_out="embed")
+
+    def _ffn_units(self):
+        if self.moe is not None:
+            return [("moe", self.moe)]
+        return [("fc1", self.fc1), ("fc2", self.fc2)]
 
     def init(self, key):
-        ka, k1, k2, kf1, kf2 = jax.random.split(key, 5)
-        return {"attn": self.attn.init(ka), "ln1": self.ln1.init(k1),
-                "ln2": self.ln2.init(k2), "fc1": self.fc1.init(kf1),
-                "fc2": self.fc2.init(kf2)}
+        units = [("attn", self.attn), ("ln1", self.ln1),
+                 ("ln2", self.ln2)] + self._ffn_units()
+        keys = jax.random.split(key, len(units))
+        return {name: m.init(k) for (name, m), k in zip(units, keys)}
 
     def apply(self, params, x, *, mask=None, train=False, rng=None):
         a = self.attn.apply(params["attn"], x, mask=mask)
         x = self.ln1.apply(params["ln1"], x + a)
-        h = self.fc2.apply(params["fc2"],
-                           jax.nn.gelu(self.fc1.apply(params["fc1"], x)))
-        return self.ln2.apply(params["ln2"], x + h)
+        if self.moe is not None:
+            h, aux = self.moe.apply(params["moe"], x)
+        else:
+            h = self.fc2.apply(params["fc2"],
+                               jax.nn.gelu(self.fc1.apply(params["fc1"], x)))
+            aux = jnp.zeros((), jnp.float32)
+        return self.ln2.apply(params["ln2"], x + h), aux
 
     def axes(self):
-        return {"attn": self.attn.axes(), "ln1": self.ln1.axes(),
-                "ln2": self.ln2.axes(), "fc1": self.fc1.axes(),
-                "fc2": self.fc2.axes()}
+        units = [("attn", self.attn), ("ln1", self.ln1),
+                 ("ln2", self.ln2)] + self._ffn_units()
+        return {name: m.axes() for name, m in units}
 
 
 @dataclasses.dataclass
@@ -143,6 +171,10 @@ class BertMLM(Module):
                     "shard_map-based attn_impl (ring attention) cannot nest "
                     "inside the pipeline's shard_map (all mesh axes are "
                     "Manual there); use PP x DP or SP x DP, not PP x SP")
+            if self.cfg.moe_experts > 0:
+                raise ValueError("pipelined encoder does not support MoE "
+                                 "(stage outputs carry activations only, "
+                                 "the router aux loss would be dropped)")
             from dtf_tpu.parallel.pipeline import pipeline_apply
             mesh = self.cfg.pipeline_mesh
             s = mesh.shape["pipe"]
@@ -155,32 +187,44 @@ class BertMLM(Module):
                 params["layers"])
 
             def stage(stage_params, h):
+                lf = lambda lp, c: self.layer.apply(lp, c)[0]
+                if self.cfg.remat:   # honor remat inside pipeline stages too
+                    lf = jax.checkpoint(lf)
+
                 def body(carry, lp):
-                    return self.layer.apply(lp, carry), None
+                    return lf(lp, carry), None
                 h, _ = jax.lax.scan(body, h, stage_params)
                 return h
 
-            return pipeline_apply(
+            out = pipeline_apply(
                 stage, grouped, x, mesh,
                 num_microbatches=self.cfg.pipeline_microbatches)
+            return out, jnp.zeros((), jnp.float32)
 
         layer_fn = lambda lp, h: self.layer.apply(lp, h, mask=attn_mask)
         if self.cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
 
         def body(carry, layer_params):
-            return layer_fn(layer_params, carry), None
+            h, aux = carry
+            y, a = layer_fn(layer_params, h)
+            return (y, aux + a), None
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
-        return x
+        (x, moe_aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        return x, moe_aux
 
-    def apply(self, params, tokens, *, pad_mask=None, train=False, rng=None):
-        """Returns MLM logits (B, T, V) — tied to the token embedding."""
-        x = self.encode(params, tokens, pad_mask=pad_mask)
+    def apply(self, params, tokens, *, pad_mask=None, train=False, rng=None,
+              return_aux: bool = False):
+        """Returns MLM logits (B, T, V) — tied to the token embedding.
+        ``return_aux=True`` additionally returns the summed MoE router aux
+        loss (0.0 for dense FFNs)."""
+        x, moe_aux = self.encode(params, tokens, pad_mask=pad_mask)
         h = jax.nn.gelu(self.head_fc.apply(params["head_fc"], x))
         h = self.head_ln.apply(params["head_ln"], h)
         logits = self.tok.attend(params["tok"], h)
-        return logits.astype(jnp.float32) + params["head_bias"]
+        logits = logits.astype(jnp.float32) + params["head_bias"]
+        return (logits, moe_aux) if return_aux else logits
 
     def axes(self):
         # leading (stacked-layer) dim: the pipeline "stage" logical axis when
@@ -218,14 +262,19 @@ class BertMLM(Module):
         if rng is None:
             rng = jax.random.key(0)
         inputs, selected = self.mask_tokens(rng, tokens)
-        logits = self.apply(params, inputs, train=train)
+        logits, moe_aux = self.apply(params, inputs, train=train,
+                                     return_aux=True)
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_logp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
         w = selected.astype(jnp.float32)
         loss = -jnp.sum(tok_logp * w) / jnp.maximum(jnp.sum(w), 1.0)
         acc = (jnp.sum((jnp.argmax(logits, -1) == tokens) * w)
                / jnp.maximum(jnp.sum(w), 1.0))
-        return loss, {"accuracy": acc, "masked_frac": jnp.mean(w)}
+        metrics = {"accuracy": acc, "masked_frac": jnp.mean(w)}
+        if self.cfg.moe_experts > 0:
+            loss = loss + self.cfg.moe_aux_weight * moe_aux
+            metrics["moe_aux"] = moe_aux
+        return loss, metrics
 
     def eval_metrics(self, params, batch):
         loss, aux = self.loss(params, batch, rng=jax.random.key(123),

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dtf_tpu.nn.attention import MultiHeadAttention, dot_product_attention
+from dtf_tpu.nn.attention import (MultiHeadAttention, causal_mask,
+                                  dot_product_attention)
 from dtf_tpu.nn.core import Module
 from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
 
@@ -58,14 +59,29 @@ class GPTConfig:
         return self.use_flash
 
 
+def _xla_causal_impl(q, k, v, mask=None):
+    """Causal XLA attention as a MultiHeadAttention ``attn_impl``."""
+    return dot_product_attention(q, k, v, mask=causal_mask(q.shape[1]))
+
+
 class GPTBlock(Module):
-    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x))."""
+    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x)).
+
+    Causal attention goes through the MultiHeadAttention ``attn_impl`` seam:
+    the Pallas flash kernel on TPU, the XLA softmax path elsewhere.
+    """
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
+        if cfg.flash_enabled():
+            from dtf_tpu.ops.flash_attention import flash_attention_impl
+            impl = flash_attention_impl(causal=True)
+        else:
+            impl = _xla_causal_impl
         self.ln1 = LayerNorm(cfg.dim)
         self.ln2 = LayerNorm(cfg.dim)
-        self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype,
+                                       attn_impl=impl)
         self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
                          axes_in="embed", axes_out="mlp")
         self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
@@ -77,25 +93,9 @@ class GPTBlock(Module):
                 "attn": self.attn.init(ka), "fc1": self.fc1.init(kf1),
                 "fc2": self.fc2.init(kf2)}
 
-    def _attn_causal(self, params, x):
-        cfg = self.cfg
-        p = params["attn"]
-        q = jnp.einsum("btd,dhk->bthk", x, p["q"]["w"]) + p["q"]["b"]
-        k = jnp.einsum("btd,dhk->bthk", x, p["k"]["w"]) + p["k"]["b"]
-        v = jnp.einsum("btd,dhk->bthk", x, p["v"]["w"]) + p["v"]["b"]
-        if cfg.flash_enabled():
-            from dtf_tpu.ops.flash_attention import flash_attention
-            out = flash_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), causal=True).transpose(0, 2, 1, 3)
-        else:
-            t = x.shape[1]
-            mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
-            out = dot_product_attention(q, k, v, mask=mask)
-        return jnp.einsum("bthk,hkd->btd", out, p["o"]["w"]) + p["o"]["b"]
-
     def apply(self, params, x, *, train=False, rng=None):
-        x = x + self._attn_causal(params, self.ln1.apply(params["ln1"], x))
+        x = x + self.attn.apply(params["attn"],
+                                self.ln1.apply(params["ln1"], x))
         h = self.ln2.apply(params["ln2"], x)
         h = self.fc2.apply(params["fc2"],
                            jax.nn.gelu(self.fc1.apply(params["fc1"], h)))

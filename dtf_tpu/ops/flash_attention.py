@@ -39,17 +39,22 @@ def _interpret_default() -> bool:
 
 
 def _block_sizes(t: int, block_q: int, block_k: int) -> tuple:
-    """Largest divisors of t not exceeding the requested block sizes.
+    """Largest sublane-aligned divisors of t within the requested sizes.
 
-    T = 768 with 512 requested -> 384 (still a lane-friendly multiple of
-    128); T smaller than the request -> T itself.  Never raises — a prime T
-    degrades to block 1 (slow but correct) rather than failing.
+    T = 768 with 512 requested -> 384; T <= 8 -> T itself (single block).
+    Candidates must divide T AND be a multiple of 8 (the fp32 sublane tile
+    — odd block heights fail Mosaic lowering on real TPU), so awkward T
+    (e.g. primes) raise an actionable error instead of degrading silently.
     """
     def pick(want: int) -> int:
-        b = min(want, t)
-        while b > 1 and t % b:
-            b -= 1
-        return max(b, 1)
+        if t <= 8:
+            return t
+        for b in range(min(want, t), 7, -1):
+            if t % b == 0 and b % 8 == 0:
+                return b
+        raise ValueError(
+            f"seq len {t} has no block size that divides it and is a "
+            f"multiple of 8 (<= {want}); pad the sequence")
 
     return pick(block_q), pick(block_k)
 

@@ -16,6 +16,13 @@ import jax
 import numpy as np
 
 
+def global_batch_size(cluster, train_cfg) -> int:
+    """THE global batch formula — workloads size their datasets with this
+    and the driver slices with it, so there is exactly one copy."""
+    return (train_cfg.per_device_batch * cluster.num_devices
+            if train_cfg.per_device_batch else train_cfg.batch_size)
+
+
 def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
                        steps: int, *, tokens_per_example: int,
                        throughput_unit: str = "tok") -> tuple:
@@ -31,8 +38,7 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     from dtf_tpu.utils.timing import block
 
     mesh = cluster.mesh
-    global_batch = (train_cfg.per_device_batch * cluster.num_devices
-                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    global_batch = global_batch_size(cluster, train_cfg)
     rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
              else sh.DEFAULT_RULES)
     shardings = sh.apply_rules(model.axes(), mesh, rules)

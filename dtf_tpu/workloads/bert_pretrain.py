@@ -25,7 +25,7 @@ def main(argv=None) -> int:
     from dtf_tpu.data.datasets import synthetic_text
     from dtf_tpu.models.bert import BertConfig, BertMLM
     from dtf_tpu.train.metrics import MetricLogger
-    from dtf_tpu.workloads._driver import pretrain_benchmark
+    from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
 
     parser = build_parser("dtf_tpu BERT MLM pretrain (BASELINE.json config)")
     parser.add_argument("--preset", choices=["base", "tiny"], default="base")
@@ -40,6 +40,9 @@ def main(argv=None) -> int:
                         help="sequence-parallel ring attention over 'seq'")
     parser.add_argument("--pipeline_microbatches", type=int, default=0,
                         help=">0: pipeline the encoder over the 'pipe' axis")
+    parser.add_argument("--moe_experts", type=int, default=0,
+                        help=">0: MoE FFN with this many experts "
+                             "(expert-parallel over the 'expert' axis)")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -61,12 +64,13 @@ def main(argv=None) -> int:
         kw["pipeline_microbatches"] = ns.pipeline_microbatches
     if ns.remat:
         kw["remat"] = True
+    if ns.moe_experts > 0:
+        kw["moe_experts"] = ns.moe_experts
     cfg = (BertConfig(dtype=dtype, **kw) if ns.preset == "base"
            else BertConfig.tiny(dtype=dtype, **kw))
     model = BertMLM(cfg)
 
-    global_batch = (train_cfg.per_device_batch * cluster.num_devices
-                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    global_batch = global_batch_size(cluster, train_cfg)
     toks = synthetic_text(max(global_batch * 8, 256), cfg.max_len,
                           cfg.vocab_size, seed=train_cfg.seed)
 

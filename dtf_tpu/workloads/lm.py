@@ -25,7 +25,7 @@ def main(argv=None) -> int:
     from dtf_tpu.models.gpt import GPT, GPTConfig
     from dtf_tpu.train.metrics import MetricLogger
     from dtf_tpu.utils.timing import block
-    from dtf_tpu.workloads._driver import pretrain_benchmark
+    from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
 
     parser = build_parser("dtf_tpu GPT causal-LM pretrain")
     parser.add_argument("--preset", choices=["gpt2_small", "tiny"],
@@ -58,8 +58,7 @@ def main(argv=None) -> int:
            else GPTConfig.tiny(**kw))
     model = GPT(cfg)
 
-    global_batch = (train_cfg.per_device_batch * cluster.num_devices
-                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    global_batch = global_batch_size(cluster, train_cfg)
     toks = synthetic_text(max(global_batch * 8, 256), cfg.max_len,
                           cfg.vocab_size, seed=train_cfg.seed)
 
