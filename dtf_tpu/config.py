@@ -97,6 +97,10 @@ class TrainConfig:
     # trajectory of one reference worker).  Set per_device_batch instead to
     # match per-worker *compute* (global = per_device * num_devices).
     per_device_batch: Optional[int] = None
+    # Gradient accumulation: split each global batch into this many
+    # microbatches inside the compiled step (same trajectory, less
+    # activation memory).
+    grad_accum: int = 1
     checkpoint_every: int = 0         # steps; 0 disables (ref had no checkpointing, SURVEY §5.4)
     resume: bool = False
     dtype: str = "float32"

@@ -80,7 +80,8 @@ def put_global_batch(mesh: Mesh, batch: Any) -> Any:
 
 def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     mesh: Mesh, mode: str = "implicit",
-                    donate: bool = True, stateful: bool = False) -> Callable:
+                    donate: bool = True, stateful: bool = False,
+                    grad_accum: int = 1) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
@@ -88,6 +89,14 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     the signature is ``loss_fn(params, model_state, batch, rng) ->
     (loss, (aux_dict, new_model_state))`` and the state threads through
     ``state["model_state"]``.
+
+    ``grad_accum > 1`` splits the batch's leading dim into that many
+    microbatches inside the compiled step (``lax.scan``), averaging
+    gradients/metrics before the single optimizer update — activation
+    memory scales with the microbatch while the optimization trajectory is
+    identical to the full batch (grad of a mean == mean of microbatch
+    grads).  Stateful models thread their running statistics through the
+    microbatches sequentially.
 
     BatchNorm semantics differ between modes by construction: in implicit
     mode the batch mean over the data-sharded axis is a *global* mean (GSPMD
@@ -97,15 +106,54 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     across shards.  The two converge as per-shard batch grows.
     """
 
-    def grads_and_update(state, batch, rng, sync):
-        params, opt_state, step = state["params"], state["opt_state"], state["step"]
+    def value_and_grads(params, model_state, batch, rng):
         if stateful:
             (loss, (aux, new_ms)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, state["model_state"], batch, rng)
+                loss_fn, has_aux=True)(params, model_state, batch, rng)
         else:
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch, rng)
             new_ms = None
+        return loss, aux, new_ms, grads
+
+    def accumulated_grads(params, model_state, batch, rng):
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape(grad_accum, x.shape[0] // grad_accum,
+                                *x.shape[1:]), batch)
+
+        def body(carry, inp):
+            g_sum, l_sum, aux_sum, ms = carry
+            i, mb = inp
+            loss, aux, new_ms, grads = value_and_grads(
+                params, ms, mb, jax.random.fold_in(rng, i))
+            g_sum = jax.tree_util.tree_map(jnp.add, g_sum, grads)
+            aux_sum = (aux if aux_sum is None else
+                       jax.tree_util.tree_map(jnp.add, aux_sum, aux))
+            return (g_sum, l_sum + loss, aux_sum, new_ms), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        first = jax.tree_util.tree_map(lambda x: x[0], micro)
+        loss0, aux0, ms0, grads0 = value_and_grads(
+            params, model_state, first, jax.random.fold_in(rng, 0))
+        g0 = jax.tree_util.tree_map(jnp.add, g0, grads0)
+        rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+        (g_sum, l_sum, aux_sum, ms), _ = lax.scan(
+            body, (g0, loss0, aux0, ms0),
+            (jnp.arange(1, grad_accum), rest))
+        inv = 1.0 / grad_accum
+        scale = lambda t: jax.tree_util.tree_map(lambda x: x * inv, t)
+        return l_sum * inv, scale(aux_sum), ms, scale(g_sum)
+
+    def grads_and_update(state, batch, rng, sync):
+        params, opt_state, step = state["params"], state["opt_state"], state["step"]
+        model_state = state.get("model_state")
+        if grad_accum > 1:
+            loss, aux, new_ms, grads = accumulated_grads(
+                params, model_state, batch, rng)
+        else:
+            loss, aux, new_ms, grads = value_and_grads(
+                params, model_state, batch, rng)
         grads, loss, aux, new_ms = sync(grads, loss, aux, new_ms)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optim_lib.apply_updates(params, updates)
@@ -215,7 +263,8 @@ class Trainer:
             self.cfg.logdir, self.cluster.is_coordinator)
         stateful = hasattr(self.model, "init_model_state")
         self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
-                                       mode=self.mode, stateful=stateful)
+                                       mode=self.mode, stateful=stateful,
+                                       grad_accum=self.cfg.grad_accum)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
         self.ckpt = None

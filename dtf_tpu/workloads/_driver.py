@@ -45,7 +45,8 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     opt = optim.adam(train_cfg.learning_rate)
     state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh,
                        param_shardings=shardings)
-    step_fn = make_train_step(model.loss, opt, mesh)
+    step_fn = make_train_step(model.loss, opt, mesh,
+                              grad_accum=train_cfg.grad_accum)
 
     n_batches = len(toks) // global_batch
     rng_base = jax.random.key(train_cfg.seed + 17)

@@ -122,3 +122,57 @@ class TestTrainerEndToEnd:
     def test_reference_format_golden(self):
         line = format_step_line(100, 1, 100, 500, 1.2345, 12.34)
         assert line == "Step: 100,  Epoch:  1,  Batch: 100 of 500,  Cost: 1.2345,  AvgTime: 12.34ms"
+
+
+class TestGradAccumulation:
+    def test_matches_full_batch_step(self, mesh8):
+        """grad of a mean == mean of microbatch grads: one accumulated step
+        must equal the full-batch step to float tolerance."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+        out = {}
+        for accum in (1, 4):
+            state = init_state(model, opt, seed=1, mesh=mesh8)
+            step = make_train_step(model.loss, opt, mesh8, donate=False,
+                                   grad_accum=accum)
+            batch = put_global_batch(mesh8, (x, y))
+            state, metrics = step(state, batch, jax.random.key(0))
+            out[accum] = (jax.device_get(state["params"]),
+                          float(metrics["loss"]))
+        assert out[1][1] == pytest.approx(out[4][1], abs=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(out[1][0]),
+                        jax.tree_util.tree_leaves(out[4][0])):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_stateful_model_threads_bn_stats(self, mesh8):
+        """ResNet (BatchNorm) with accumulation: runs and updates stats."""
+        from dtf_tpu.models.resnet import ResNet, ResNetConfig
+
+        model = ResNet(ResNetConfig.tiny())
+        opt = optim.sgd(0.05)
+        state = init_state(model, opt, seed=0, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, stateful=True,
+                               donate=False, grad_accum=2)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+        state, metrics = step(state, put_global_batch(mesh8, (x, y)),
+                              jax.random.key(0))
+        assert np.isfinite(float(metrics["loss"]))
+        assert not np.allclose(
+            np.asarray(state["model_state"]["stem_bn"]["mean"]), 0.0)
+
+    def test_indivisible_batch_fails_loudly(self, mesh8):
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        state = init_state(model, opt, seed=1, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False,
+                               grad_accum=3)
+        batch = put_global_batch(
+            mesh8, (np.zeros((64, 784), np.float32),
+                    np.zeros((64, 10), np.float32)))
+        with pytest.raises(Exception):
+            step(state, batch, jax.random.key(0))    # 64 % 3 != 0
