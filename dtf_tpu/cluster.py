@@ -86,6 +86,16 @@ def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
         # Env vars are too late if jax was already imported (this image's
         # sitecustomize does); config.update is the reliable path.
         jax.config.update("jax_platforms", config.platform)
+    if config.simulated_devices > 0:
+        if config.platform not in (None, "cpu"):
+            raise ValueError(
+                f"--simulated_devices runs on CPU; conflicting "
+                f"--platform={config.platform}")
+        # CLI version of the tests' simulated mesh (SURVEY.md §4): N CPU
+        # devices on one host.  config.update works post-import as long as
+        # no backend has been initialized yet.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", config.simulated_devices)
 
     if config.num_processes > 1 and not _INITIALIZED:
         if not config.coordinator_address:

@@ -50,6 +50,10 @@ class ClusterConfig:
     # Mesh request, e.g. "data=-1" or "data=4,tensor=2"; -1 infers from device count.
     mesh: str = "data=-1"
     platform: Optional[str] = None    # force jax platform (cpu/tpu); None = auto
+    # >0: run on N simulated CPU devices (the SURVEY.md §4 test trick,
+    # usable from the CLI: --simulated_devices 8 --mesh data=2,seq=4).
+    # Implies platform=cpu.  Must be set before the first device query.
+    simulated_devices: int = 0
 
     def __post_init__(self):
         if self.job_name not in ("ps", "worker"):

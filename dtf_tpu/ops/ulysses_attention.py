@@ -1,0 +1,115 @@
+"""Ulysses attention: all-to-all sequence parallelism over a ``seq`` axis.
+
+The second of the framework's two long-context strategies (the reference has
+no sequence models at all, SURVEY.md §5.7 — this is new capability, not
+parity).  Complements :mod:`dtf_tpu.ops.ring_attention`:
+
+* **ring**: Q stays put, K/V chunks rotate n times via ``lax.ppermute``;
+  per-device memory O(T/n) in the sequence; attention math is a bespoke
+  online-softmax recurrence.
+* **ulysses** (this module, DeepSpeed-Ulysses style): two ``lax.all_to_all``
+  re-shards — heads->sequence on the way in, sequence->heads on the way
+  out — so each device briefly holds the FULL sequence for H/n of the
+  heads and runs a completely *local, dense* attention there.  That local
+  attention is any single-device implementation, including the Pallas
+  flash kernel (:mod:`dtf_tpu.ops.flash_attention`), so the MXU-optimized
+  kernel and sequence parallelism compose for free.
+
+Trade-offs (why both exist): ulysses does 2 all-to-alls of the activations
+total (O(T·d/n) bytes per device, bandwidth-optimal on ICI) vs ring's n
+ppermutes of K/V overlapped with compute; ulysses' parallel degree is
+bounded by the head count (n must divide H) and its peak memory is O(T)
+in the local attention unless the flash inner kernel is used (then O(T/n)
+again for activations, O(T) only for K/V); ring has no head-count bound.
+
+Implemented as per-device code under ``jax.shard_map`` (explicit collective
+schedule), composing with the data axes for the batch dim, differentiable
+(``all_to_all`` transposes to the opposite all-to-all in reverse mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.nn.attention import causal_mask, dot_product_attention
+
+
+def _ulysses_body(q, k, v, *, axis: str, causal: bool, scale: Optional[float],
+                  inner: Optional[Callable]):
+    """Per-device ulysses attention.  q,k,v: (B, T/n, H, D) local chunks."""
+    # heads -> sequence: (B, T/n, H, D) -> (B, T, H/n, D).  tiled=True splits
+    # the head dim into n blocks and concatenates the gathered chunks along
+    # the sequence dim, so afterwards the device holds the whole sequence
+    # for a contiguous block of heads.
+    a2a_in = lambda x: lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+    qh, kh, vh = a2a_in(q), a2a_in(k), a2a_in(v)
+
+    if inner is not None:
+        out = inner(qh, kh, vh, None)
+    else:
+        mask = causal_mask(qh.shape[1]) if causal else None
+        out = dot_product_attention(qh, kh, vh, mask=mask, scale=scale)
+
+    # sequence -> heads: (B, T, H/n, D) -> (B, T/n, H, D).
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
+                      causal: bool = False, scale: Optional[float] = None,
+                      batch_axes: Optional[tuple] = None,
+                      inner: Optional[Callable] = None):
+    """All-to-all sequence-parallel attention.
+
+    q, k, v: (B, T, H, D) *global* arrays whose T dim is (to be) sharded
+    over ``axis``; returns (B, T, H, D) sharded the same way.  ``inner``
+    optionally supplies the local attention ``f(q, k, v, mask) -> out``
+    run on the post-all-to-all (B, T, H/n, D) arrays — e.g.
+    ``flash_attention_impl(causal=True)`` to fuse with the Pallas kernel;
+    when given, it is responsible for causal masking itself.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses parallelism is bounded by the head count: "
+            f"{q.shape[2]} heads not divisible by {axis}={n} "
+            f"(use ring_attention for head-count-free sequence parallelism)")
+    if inner is not None and (causal or scale is not None):
+        raise ValueError(
+            "when `inner` is supplied it owns masking and scaling — "
+            "construct it causal/scaled (e.g. flash_attention_impl("
+            "causal=True)) instead of passing causal/scale here")
+    if batch_axes is None:
+        from dtf_tpu.parallel.sharding import data_axes as _data_axes
+        batch_axes = _data_axes(mesh)
+    spec = P(batch_axes or None, axis, None, None)
+    body = functools.partial(_ulysses_body, axis=axis, causal=causal,
+                             scale=scale, inner=inner)
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def ulysses_attention_impl(mesh: Mesh, axis: str = "seq",
+                           causal: bool = False,
+                           inner: Optional[Callable] = None):
+    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D), mask=None)."""
+
+    def impl(q, k, v, mask=None):
+        if mask is not None:
+            raise ValueError("ulysses_attention_impl supports mask=None "
+                             "only; use causal=True or the XLA attention "
+                             "path")
+        return ulysses_attention(q, k, v, mesh, axis=axis, causal=causal,
+                                 inner=inner)
+
+    return impl

@@ -93,10 +93,13 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     ``grad_accum > 1`` splits the batch's leading dim into that many
     microbatches inside the compiled step (``lax.scan``), averaging
     gradients/metrics before the single optimizer update — activation
-    memory scales with the microbatch while the optimization trajectory is
-    identical to the full batch (grad of a mean == mean of microbatch
-    grads).  Stateful models thread their running statistics through the
-    microbatches sequentially.
+    memory scales with the microbatch.  For rng-independent stateless
+    losses the optimization trajectory is identical to the full batch
+    (grad of a mean == mean of microbatch grads); losses that consume the
+    rng (e.g. MLM masking, dropout) see per-microbatch ``fold_in`` streams,
+    and stateful models compute per-microbatch batch statistics, so those
+    match the full-batch step only in expectation.  Stateful models thread
+    their running statistics through the microbatches sequentially.
 
     BatchNorm semantics differ between modes by construction: in implicit
     mode the batch mean over the data-sharded axis is a *global* mean (GSPMD
@@ -117,9 +120,16 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         return loss, aux, new_ms, grads
 
     def accumulated_grads(params, model_state, batch, rng):
+        # Strided split (microbatch i = rows i::grad_accum): each device's
+        # contiguous data-sharded rows contribute equally to every
+        # microbatch, so the split is a local slice — a contiguous split
+        # would misalign microbatches with the batch sharding and make
+        # GSPMD reshard inside the step.  Equally correct: the loss is a
+        # mean, so microbatch membership doesn't matter.
         micro = jax.tree_util.tree_map(
-            lambda x: x.reshape(grad_accum, x.shape[0] // grad_accum,
-                                *x.shape[1:]), batch)
+            lambda x: jnp.moveaxis(
+                x.reshape(x.shape[0] // grad_accum, grad_accum,
+                          *x.shape[1:]), 1, 0), batch)
 
         def body(carry, inp):
             g_sum, l_sum, aux_sum, ms = carry
@@ -127,8 +137,7 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             loss, aux, new_ms, grads = value_and_grads(
                 params, ms, mb, jax.random.fold_in(rng, i))
             g_sum = jax.tree_util.tree_map(jnp.add, g_sum, grads)
-            aux_sum = (aux if aux_sum is None else
-                       jax.tree_util.tree_map(jnp.add, aux_sum, aux))
+            aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
             return (g_sum, l_sum + loss, aux_sum, new_ms), None
 
         g0 = jax.tree_util.tree_map(

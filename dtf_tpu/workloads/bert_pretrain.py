@@ -10,8 +10,9 @@ benchmark loop with the reference's console contract and honest
         --mesh data=4,fsdp=2 --per_device_batch 8 --bf16
 
 FSDP weight sharding activates automatically when the mesh has an ``fsdp``
-axis; sequence parallelism via ``--ring_attention`` (requires a ``seq``
-axis); pipeline stages via ``--pipeline_microbatches`` (requires ``pipe``).
+axis; sequence parallelism via ``--ring_attention`` or ``--ulysses``
+(requires a ``seq`` axis); pipeline stages via ``--pipeline_microbatches``
+(requires ``pipe``).
 """
 
 from __future__ import annotations
@@ -38,6 +39,10 @@ def main(argv=None) -> int:
                              "(jax.checkpoint): less HBM, ~30%% more FLOPs")
     parser.add_argument("--ring_attention", action="store_true",
                         help="sequence-parallel ring attention over 'seq'")
+    parser.add_argument("--ulysses", action="store_true",
+                        help="all-to-all (ulysses) sequence parallelism "
+                             "over 'seq'; local attention uses the flash "
+                             "kernel")
     parser.add_argument("--pipeline_microbatches", type=int, default=0,
                         help=">0: pipeline the encoder over the 'pipe' axis")
     parser.add_argument("--moe_experts", type=int, default=0,
@@ -56,9 +61,16 @@ def main(argv=None) -> int:
     kw = {}
     if ns.seq_len:
         kw["max_len"] = ns.seq_len
+    if ns.ring_attention and ns.ulysses:
+        parser.error("--ring_attention and --ulysses are mutually exclusive")
     if ns.ring_attention:
         from dtf_tpu.ops.ring_attention import ring_attention_impl
         kw["attn_impl"] = ring_attention_impl(mesh)
+    if ns.ulysses:
+        from dtf_tpu.ops.flash_attention import flash_attention_impl
+        from dtf_tpu.ops.ulysses_attention import ulysses_attention_impl
+        kw["attn_impl"] = ulysses_attention_impl(
+            mesh, inner=flash_attention_impl())
     if ns.pipeline_microbatches > 0:
         kw["pipeline_mesh"] = mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches
