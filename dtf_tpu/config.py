@@ -117,6 +117,13 @@ class TrainConfig:
     profile_start: int = 10
     profile_steps: int = 3
     determinism_every: int = 0        # 0 disables
+    # Failure detection (SURVEY §5.3; the reference hung forever on a dead
+    # peer): fail the process fast if the train loop makes no progress for
+    # this many seconds.  0 disables.  Size above the worst gap between
+    # logging sync points (compile time included), not above the step time;
+    # eval and checkpoint saves are excluded (the watchdog suspends around
+    # them).
+    hang_timeout_s: float = 0.0
 
 
 def _field_type(cls, f: dataclasses.Field) -> type:

@@ -11,11 +11,13 @@ and per epoch (:126-128)
     Total Time: %3.2fs
     Final Cost: %.4f
 
-Metrics are also appended to ``<logdir>/metrics.csv`` (the TensorBoard
-equivalent of the reference's per-step summary writer, :84-88,112 — but
-buffered, not a per-step host sync).  Only the coordinator process writes
-(SPMD: every process runs the same code; the reference instead relied on
-each worker writing to its own local /tmp, :24).
+Metrics are appended to ``<logdir>/metrics.csv`` AND to a TensorBoard
+event file (``<logdir>/events.out.tfevents.*``, via the dependency-free
+writer in :mod:`dtf_tpu.train.tbevents`) — the equivalent of the
+reference's per-step summary writer (:84-88,112), but buffered, not a
+per-step host sync.  Only the coordinator process writes (SPMD: every
+process runs the same code; the reference instead relied on each worker
+writing to its own local /tmp, :24).
 """
 
 from __future__ import annotations
@@ -43,12 +45,15 @@ class MetricLogger:
         self.quiet = quiet
         self._csv = None
         self._writer = None
+        self._tb = None
         if logdir and is_coordinator:
             os.makedirs(logdir, exist_ok=True)
             self._csv = open(os.path.join(logdir, "metrics.csv"), "a", newline="")
             self._writer = csv.writer(self._csv)
             if self._csv.tell() == 0:
                 self._writer.writerow(["step", "metric", "value"])
+            from dtf_tpu.train.tbevents import TBEventWriter
+            self._tb = TBEventWriter(logdir)
 
     def print(self, msg: str) -> None:
         if self.is_coordinator and not self.quiet:
@@ -61,6 +66,13 @@ class MetricLogger:
     def scalar(self, step: int, name: str, value: float) -> None:
         if self._writer:
             self._writer.writerow([step, name, float(value)])
+            self._csv.flush()
+        if self._tb:
+            self._tb.scalar(step, name, float(value))
+            # Flush eagerly: scalar() is only called at logging sync points,
+            # and a fail-fast os._exit (utils/watchdog.py) skips finalizers —
+            # the post-mortem metrics must already be on disk.
+            self._tb.flush()
 
     def epoch_summary(self, test_accuracy: float, total_s: float,
                       final_cost: float) -> None:
@@ -73,3 +85,6 @@ class MetricLogger:
         if self._csv:
             self._csv.close()
             self._csv = self._writer = None
+        if self._tb:
+            self._tb.close()
+            self._tb = None

@@ -1,0 +1,199 @@
+"""Dependency-free TensorBoard event-file writer (and reader).
+
+The reference merged `cost`/`accuracy` scalar summaries into the graph and
+wrote them to a TensorBoard logdir every step (tf_distributed.py:84-88,97,
+111-112).  This module restores that capability TPU-side without depending
+on TensorFlow: it emits the TFRecord-framed ``events.out.tfevents.*`` format
+directly —
+
+* record framing: ``<Q length, <I masked-crc32c(length), payload,
+  <I masked-crc32c(payload)`` (the TFRecord wire format);
+* payload: a hand-encoded ``tensorboard.Event`` protobuf holding either the
+  ``file_version`` header or ``(wall_time, step, Summary{tag,simple_value})``.
+
+Scalars only — exactly the reference's usage.  Files are readable by any
+stock TensorBoard (validated against tensorboard 2.20's EventFileLoader in
+tests/test_tbevents.py).  A reader for the same subset is included so runs
+can be inspected programmatically without TensorBoard installed.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Iterator, Optional
+
+# ---------------------------------------------------------------- crc32c --
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    """TFRecord's rotated+offset crc32c (guards against crc-of-crc)."""
+    c = _crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------ protobuf encoding --
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _field_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _field_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _field_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _field_varint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def _scalar_event(wall_time: float, step: int, name: str,
+                  value: float) -> bytes:
+    """Event{wall_time=1, step=2, summary=5{value=1{tag=1, simple_value=2}}}"""
+    summary_value = (_field_bytes(1, name.encode()) +
+                     _field_float(2, float(value)))
+    summary = _field_bytes(1, summary_value)
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, summary))
+
+
+def _version_event(wall_time: float) -> bytes:
+    """Event{wall_time=1, file_version=3}: every event file starts with it."""
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+# ------------------------------------------------------------- the writer --
+
+class TBEventWriter:
+    """Append scalar events to ``<logdir>/events.out.tfevents.<ts>.<host>``."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        path = os.path.join(
+            logdir,
+            f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}")
+        self._f = open(path, "ab")
+        self.path = path
+        self._write(_version_event(time.time()))
+
+    def _write(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header + struct.pack("<I", _masked_crc(header)) +
+                      payload + struct.pack("<I", _masked_crc(payload)))
+
+    def scalar(self, step: int, name: str, value: float,
+               wall_time: Optional[float] = None) -> None:
+        self._write(_scalar_event(wall_time or time.time(), step, name,
+                                  value))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+# ------------------------------------------------------------- the reader --
+
+def _read_varint(buf: bytes, i: int) -> tuple:
+    """Decode one varint at ``buf[i:]`` -> (value, next_index)."""
+    v, shift = 0, 0
+    while True:
+        b = buf[i]; i += 1
+        v |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return v, i
+
+
+def _decode_fields(buf: bytes) -> Iterator[tuple]:
+    """Minimal protobuf walk: yields (field_number, wire_type, value)."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+            yield field, wire, v
+        elif wire == 1:
+            yield field, wire, buf[i:i + 8]; i += 8
+        elif wire == 5:
+            yield field, wire, buf[i:i + 4]; i += 4
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            yield field, wire, buf[i:i + ln]; i += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def read_scalars(path: str) -> list:
+    """Parse an event file written by :class:`TBEventWriter` (or TensorFlow)
+    into ``[(step, tag, value), ...]``, verifying every record's crc."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return out
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise ValueError("corrupt record header crc")
+            (ln,) = struct.unpack("<Q", header)
+            payload = f.read(ln)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if pcrc != _masked_crc(payload):
+                raise ValueError("corrupt record payload crc")
+            step, summary = 0, None
+            for field, wire, v in _decode_fields(payload):
+                if field == 2 and wire == 0:
+                    step = v
+                elif field == 5 and wire == 2:
+                    summary = v
+            if summary is None:
+                continue   # file_version header etc.
+            for field, wire, sv in _decode_fields(summary):
+                if field != 1 or wire != 2:
+                    continue
+                tag, value = None, None
+                for f2, w2, vv in _decode_fields(sv):
+                    if f2 == 1 and w2 == 2:
+                        tag = vv.decode()
+                    elif f2 == 2 and w2 == 5:
+                        (value,) = struct.unpack("<f", vv)
+                if tag is not None and value is not None:
+                    out.append((step, tag, value))

@@ -294,6 +294,16 @@ class Trainer:
             self._profiler = StepWindowProfiler(
                 self.cfg.profile_dir, self.cfg.profile_start,
                 self.cfg.profile_steps)
+        # Armed at fit() start, disarmed in its finally (arming here would
+        # let slow pre-fit host work trip a hard exit).
+        self._watchdog = None
+
+    def _suspended_watchdog(self):
+        """Disarm the hang watchdog across a legitimately-slow blocking host
+        call (eval, checkpoint save); no-op when it isn't armed."""
+        import contextlib
+        return (self._watchdog.suspend() if self._watchdog is not None
+                else contextlib.nullcontext())
 
     @property
     def global_batch_size(self) -> int:
@@ -334,45 +344,63 @@ class Trainer:
                     splits.train.next_batch(bs)
 
         ev = {"accuracy": float("nan")}
-        for epoch in range(start_epoch, epochs):
-            count = 0
-            first_batch = skip_batches if epoch == start_epoch else 0
-            for i in range(first_batch, batch_count):
-                batch = put_global_batch(mesh, splits.train.next_batch(bs))
-                step_rng = jax.random.fold_in(rng_base, self._host_step)
-                self.state, metrics = self.step_fn(self.state, batch, step_rng)
-                count += 1
-                self._host_step += 1
-                if self._profiler is not None:
-                    self._profiler.after_step(self._host_step, self.state)
-                if (cfg.determinism_every > 0
-                        and self._host_step % cfg.determinism_every == 0):
-                    from dtf_tpu.utils.profiling import assert_replicas_agree
-                    assert_replicas_agree(
-                        {"loss": metrics["loss"], "step": self.state["step"]},
-                        what=f"step {self._host_step} metrics")
-                if (self.ckpt is not None and self.cfg.checkpoint_every > 0
-                        and self._host_step % self.cfg.checkpoint_every == 0):
-                    self.ckpt.save(self._host_step, self.state)
-                if count % cfg.log_frequency == 0 or i + 1 == batch_count:
-                    # Sync point: read back the metrics (the reference paid
-                    # this every step via sess.run; we pay it only when
-                    # logging).
-                    cost = float(metrics["loss"])
-                    step = int(self.state["step"])
-                    avg_ms = timer.window_avg_ms(count)
-                    self.logger.step_line(step, epoch + 1, i + 1, batch_count,
-                                          cost, avg_ms)
-                    self.logger.scalar(step, "cost", cost)
-                    self.logger.scalar(step, "avg_ms", avg_ms)
-                    count = 0
-                    last_cost = cost
-            ev = self.eval_fn(self.state, splits.test)
-            self.logger.epoch_summary(ev["accuracy"], timer.total_s(), last_cost)
-            self.logger.scalar(int(self.state["step"]), "test_accuracy",
-                               ev["accuracy"])
-        if start_epoch >= epochs:    # resumed past the budget: report eval
-            ev = self.eval_fn(self.state, splits.test)
+        if cfg.hang_timeout_s > 0:
+            from dtf_tpu.utils.watchdog import HangWatchdog
+            self._watchdog = HangWatchdog(cfg.hang_timeout_s)
+        try:
+            for epoch in range(start_epoch, epochs):
+                count = 0
+                first_batch = skip_batches if epoch == start_epoch else 0
+                for i in range(first_batch, batch_count):
+                    batch = put_global_batch(mesh, splits.train.next_batch(bs))
+                    step_rng = jax.random.fold_in(rng_base, self._host_step)
+                    self.state, metrics = self.step_fn(self.state, batch,
+                                                       step_rng)
+                    count += 1
+                    self._host_step += 1
+                    if self._watchdog is not None:
+                        self._watchdog.tick()
+                    if self._profiler is not None:
+                        self._profiler.after_step(self._host_step, self.state)
+                    if (cfg.determinism_every > 0
+                            and self._host_step % cfg.determinism_every == 0):
+                        from dtf_tpu.utils.profiling import assert_replicas_agree
+                        assert_replicas_agree(
+                            {"loss": metrics["loss"],
+                             "step": self.state["step"]},
+                            what=f"step {self._host_step} metrics")
+                    if (self.ckpt is not None and self.cfg.checkpoint_every > 0
+                            and self._host_step % self.cfg.checkpoint_every == 0):
+                        with self._suspended_watchdog():
+                            self.ckpt.save(self._host_step, self.state)
+                    if count % cfg.log_frequency == 0 or i + 1 == batch_count:
+                        # Sync point: read back the metrics (the reference
+                        # paid this every step via sess.run; we pay it only
+                        # when logging).
+                        cost = float(metrics["loss"])
+                        step = int(self.state["step"])
+                        avg_ms = timer.window_avg_ms(count)
+                        self.logger.step_line(step, epoch + 1, i + 1,
+                                              batch_count, cost, avg_ms)
+                        self.logger.scalar(step, "cost", cost)
+                        self.logger.scalar(step, "avg_ms", avg_ms)
+                        count = 0
+                        last_cost = cost
+                with self._suspended_watchdog():
+                    ev = self.eval_fn(self.state, splits.test)
+                self.logger.epoch_summary(ev["accuracy"], timer.total_s(),
+                                          last_cost)
+                self.logger.scalar(int(self.state["step"]), "test_accuracy",
+                                   ev["accuracy"])
+            if start_epoch >= epochs:   # resumed past the budget: report eval
+                with self._suspended_watchdog():
+                    ev = self.eval_fn(self.state, splits.test)
+        finally:
+            # Disarm before post-loop host work — and on ANY exit path: a
+            # raise out of the loop must not leave a daemon thread around to
+            # os._exit(70) the caller's cleanup.
+            if self._watchdog is not None:
+                self._watchdog.close()
         if self._profiler is not None:
             self._profiler.close(self.state)   # never leak an open trace
         block(self.state)

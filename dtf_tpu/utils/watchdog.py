@@ -1,0 +1,107 @@
+"""Failure detection: fail-fast hang watchdog.
+
+The reference had NO failure handling (SURVEY.md §5.3): the PS blocked in
+``server.join()`` forever (tf_distributed.py:31), non-chief workers blocked
+indefinitely in ``prepare_or_wait_for_session`` if the chief or PS died
+(tf_distributed.py:96) — a dead process hung the whole cluster silently.
+
+Here the recovery story is fail-fast + checkpoint/resume (train/checkpoint):
+
+* process death: the ``jax.distributed`` coordination service propagates
+  missing-heartbeat failures and tears the job down (given, not built);
+* silent *hangs* (a wedged collective, a deadlocked host thread, a stuck
+  data loader) are what this module detects: a daemon thread trips when the
+  training loop stops making progress for ``timeout_s`` and kills the
+  process with a loud message, so the job dies (and can be restarted from
+  the last checkpoint) instead of wedging forever like the reference.
+
+Note on async dispatch: the train loop ticks once per *dispatched* step,
+but XLA execution is asynchronous — a device-side deadlock surfaces when
+the loop blocks reading metrics at the next logging sync point.  Size
+``timeout_s`` above the worst expected gap between log syncs (compile time
+included), not above the step time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+def _default_on_hang(what: str, timeout_s: float) -> None:
+    print(f"[dtf_tpu] WATCHDOG: no {what} progress in {timeout_s:g}s — "
+          f"failing fast (the reference would hang forever here, "
+          f"tf_distributed.py:96). Restart resumes from the last "
+          f"checkpoint.", file=sys.stderr, flush=True)
+    # os._exit, not sys.exit: the main thread is wedged (that's the point);
+    # only a hard exit gets the process out of a stuck collective.
+    os._exit(70)   # EX_SOFTWARE
+
+
+class HangWatchdog:
+    """Trips ``on_hang`` when :meth:`tick` isn't called for ``timeout_s``.
+
+    Daemon-threaded; ``close()`` disarms it.  ``on_hang(what, timeout_s)``
+    defaults to printing and hard-exiting the process (fail-fast).
+    """
+
+    def __init__(self, timeout_s: float, what: str = "train step",
+                 on_hang: Optional[Callable[[str, float], None]] = None,
+                 poll_s: Optional[float] = None):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.what = what
+        self._on_hang = on_hang or _default_on_hang
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._suspended = False
+        self._poll = poll_s if poll_s is not None else min(timeout_s / 4, 1.0)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtf_tpu-watchdog")
+        self._thread.start()
+
+    def tick(self) -> None:
+        """Record progress (called once per loop iteration)."""
+        self._last = time.monotonic()
+
+    @contextlib.contextmanager
+    def suspend(self):
+        """Disarm across a legitimately-slow blocking host call (full-set
+        eval, checkpoint save) whose duration shouldn't count as a hang;
+        re-arms with a fresh deadline on exit."""
+        self._suspended = True
+        try:
+            yield
+        finally:
+            self._last = time.monotonic()
+            self._suspended = False
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            if (not self._suspended
+                    and time.monotonic() - self._last > self.timeout_s):
+                self._fired = True
+                self._on_hang(self.what, self.timeout_s)
+                return
+
+    def close(self) -> None:
+        """Disarm and join the watchdog thread."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

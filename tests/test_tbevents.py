@@ -1,0 +1,100 @@
+"""The dependency-free TensorBoard event writer: wire-format correctness
+(validated against stock tensorboard's own EventFileLoader), round-trip via
+the bundled reader, crc integrity, and MetricLogger integration.
+
+The reference wrote cost/accuracy scalar summaries to a TensorBoard logdir
+every step (tf_distributed.py:84-88,97,111-112); this is that capability
+without a TensorFlow dependency.
+"""
+
+import glob
+import struct
+
+import numpy as np
+import pytest
+
+from dtf_tpu.train.metrics import MetricLogger
+from dtf_tpu.train.tbevents import (TBEventWriter, _crc32c, _masked_crc,
+                                    read_scalars)
+
+
+class TestCrc32c:
+    def test_known_vectors(self):
+        # RFC 3720 / kernel test vectors for crc32c (Castagnoli).
+        assert _crc32c(b"") == 0
+        assert _crc32c(b"123456789") == 0xE3069283
+        assert _crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_mask_is_invertible_offset(self):
+        c = _crc32c(b"hello")
+        m = _masked_crc(b"hello")
+        unrot = (m - 0xA282EAD8) & 0xFFFFFFFF
+        assert (((unrot << 15) | (unrot >> 17)) & 0xFFFFFFFF) == c
+
+
+class TestWriterReader:
+    def test_round_trip(self, tmp_path):
+        w = TBEventWriter(str(tmp_path))
+        w.scalar(1, "cost", 2.5)
+        w.scalar(2, "cost", 1.25)
+        w.scalar(2, "accuracy", 0.5)
+        w.close()
+        assert read_scalars(w.path) == [
+            (1, "cost", 2.5), (2, "cost", 1.25), (2, "accuracy", 0.5)]
+
+    def test_corrupt_record_detected(self, tmp_path):
+        w = TBEventWriter(str(tmp_path))
+        w.scalar(1, "cost", 2.5)
+        w.close()
+        data = bytearray(open(w.path, "rb").read())
+        data[-5] ^= 0xFF            # flip a payload byte
+        open(w.path, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="crc"):
+            read_scalars(w.path)
+
+    def test_stock_tensorboard_reads_our_files(self, tmp_path):
+        """The real consumer: tensorboard's EventFileLoader must parse the
+        file and recover every scalar."""
+        loader_mod = pytest.importorskip(
+            "tensorboard.backend.event_processing.event_file_loader")
+        w = TBEventWriter(str(tmp_path))
+        steps = [(1, "cost", 2.5), (100, "cost", 0.125), (100, "acc", 0.75)]
+        for s, tag, v in steps:
+            w.scalar(s, tag, v)
+        w.close()
+
+        got = []
+        for ev in loader_mod.LegacyEventFileLoader(w.path).Load():
+            for val in ev.summary.value:
+                got.append((ev.step, val.tag, val.simple_value))
+        assert got == steps
+
+    def test_reader_reads_tensorboard_written_files(self, tmp_path):
+        """Symmetry: our reader parses files written by the stock tb.summary
+        writer (guards against a writer+reader that agree only with each
+        other)."""
+        tbsw = pytest.importorskip("tensorboard.summary.writer.event_file_writer")
+        ef = tbsw.EventFileWriter(str(tmp_path))
+        from tensorboard.compat.proto import event_pb2, summary_pb2
+        ev = event_pb2.Event(step=7, wall_time=1.0)
+        ev.summary.value.add(tag="loss", simple_value=0.5)
+        ef.add_event(ev)
+        ef.close()
+        (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        assert (7, "loss", 0.5) in read_scalars(path)
+
+
+class TestMetricLoggerIntegration:
+    def test_logger_writes_event_file(self, tmp_path):
+        logger = MetricLogger(str(tmp_path), is_coordinator=True, quiet=True)
+        logger.scalar(1, "cost", 3.0)
+        logger.scalar(2, "cost", 2.0)
+        logger.close()
+        (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        assert read_scalars(path) == [(1, "cost", 3.0), (2, "cost", 2.0)]
+
+    def test_non_coordinator_writes_nothing(self, tmp_path):
+        logger = MetricLogger(str(tmp_path), is_coordinator=False, quiet=True)
+        logger.scalar(1, "cost", 3.0)
+        logger.close()
+        assert glob.glob(str(tmp_path / "events.out.tfevents.*")) == []

@@ -1,0 +1,82 @@
+"""Fail-fast hang watchdog (SURVEY.md §5.3: the reference hung forever on a
+dead peer; here no-progress is detected and the process dies loudly)."""
+
+import time
+
+import pytest
+
+from dtf_tpu.utils.watchdog import HangWatchdog
+
+
+def test_fires_on_no_progress():
+    fired = []
+    wd = HangWatchdog(0.2, what="test loop",
+                      on_hang=lambda what, t: fired.append((what, t)),
+                      poll_s=0.05)
+    try:
+        time.sleep(0.6)
+        assert wd.fired
+        assert fired == [("test loop", 0.2)]
+    finally:
+        wd.close()
+
+
+def test_stays_quiet_while_ticking():
+    fired = []
+    with HangWatchdog(0.3, on_hang=lambda *a: fired.append(a),
+                      poll_s=0.05) as wd:
+        for _ in range(10):
+            time.sleep(0.06)
+            wd.tick()
+        assert not wd.fired and fired == []
+
+
+def test_close_disarms():
+    fired = []
+    wd = HangWatchdog(0.2, on_hang=lambda *a: fired.append(a), poll_s=0.05)
+    wd.close()
+    time.sleep(0.4)
+    assert fired == []
+
+
+def test_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError, match="timeout_s"):
+        HangWatchdog(0.0)
+
+
+def test_trainer_integration_ticks(tmp_path):
+    """A short MNIST run with the watchdog armed completes without firing
+    (ticks flow from the step loop), and the watchdog is disarmed at the
+    end of fit()."""
+    from dtf_tpu.cluster import Cluster
+    from dtf_tpu.config import ClusterConfig, TrainConfig
+    from dtf_tpu.data import load_mnist
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.optim import sgd
+    from dtf_tpu.parallel.mesh import make_mesh
+    from dtf_tpu.train.trainer import Trainer
+
+    cluster = Cluster(config=ClusterConfig(), mesh=make_mesh("data=8"))
+    cfg = TrainConfig(batch_size=64, epochs=1, log_frequency=50,
+                      logdir=str(tmp_path), hang_timeout_s=120.0)
+    trainer = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                      sgd(cfg.learning_rate), cfg)
+    # Not armed until fit(): slow pre-fit host work must not trip it.
+    assert trainer._watchdog is None
+    trainer.fit(load_mnist(seed=1))
+    assert trainer._watchdog is not None and not trainer._watchdog.fired
+    # disarmed: the monitor thread has exited
+    assert not trainer._watchdog._thread.is_alive()
+
+
+def test_suspend_excludes_slow_host_calls():
+    """A blocking call longer than the timeout doesn't fire while wrapped
+    in suspend(), and the deadline restarts fresh afterwards."""
+    fired = []
+    with HangWatchdog(0.2, on_hang=lambda *a: fired.append(a),
+                      poll_s=0.05) as wd:
+        with wd.suspend():
+            time.sleep(0.5)          # e.g. full-test-set eval
+        assert not wd.fired
+        time.sleep(0.1)              # under timeout again: still quiet
+        assert fired == []
