@@ -220,13 +220,15 @@ class GPT(Module):
                 "v": jnp.zeros(shape, cfg.dtype)}
 
     def generate(self, params, prompt, max_new_tokens: int, *,
-                 temperature: float = 1.0, rng=None):
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, rng=None):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         One compiled program: the prompt prefills the cache position by
         position, then new tokens are sampled; everything is a single
         ``lax.scan`` over time steps with a static-shape cache.
-        temperature=0 -> greedy.
+        temperature=0 -> greedy; top_k/top_p filter the distribution
+        (nn/sampling.py).
         """
         cfg = self.cfg
         b, p_len = prompt.shape
@@ -261,12 +263,9 @@ class GPT(Module):
             logits = self.tok.attend(params["tok"], x)[:, 0, :]  # (B, V)
 
             rng, sub = jax.random.split(rng)
-            if temperature == 0.0:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                nxt = jax.random.categorical(
-                    sub, logits.astype(jnp.float32) / temperature, axis=-1
-                ).astype(jnp.int32)
+            from dtf_tpu.nn.sampling import sample_token
+            nxt = sample_token(sub, logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
             # during prefill (pos+1 < p_len) keep the prompt token
             keep_prompt = pos + 1 < p_len
             existing = lax.dynamic_slice(out, (0, pos + 1), (b, 1))[:, 0]

@@ -1,0 +1,110 @@
+"""Token sampling strategies for autoregressive decoding.
+
+Not present in the reference (no sequence models, SURVEY.md §5.7); completes
+the framework's inference story alongside the KV-cache decode loop in
+:meth:`dtf_tpu.models.gpt.GPT.generate`.
+
+All transforms are jit-compatible (static shapes, no data-dependent Python
+control flow — the filters are where/sort masks, not gathers of dynamic
+size), composable, and operate on a (B, V) logits batch:
+
+    temperature -> top-k filter -> top-p (nucleus) filter -> categorical
+
+``temperature=0`` short-circuits to greedy argmax.  fp32 throughout —
+sampling in bf16 visibly distorts the tail of the distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row; set the rest to -inf.
+    ``k <= 0`` or ``k >= V`` is a no-op."""
+    v = logits.shape[-1]
+    if k <= 0 or k >= v:
+        return logits
+    kth = jnp.sort(logits, axis=-1)[..., v - k][..., None]   # kth largest
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _nucleus_cutoff(sorted_desc: jax.Array, p: float) -> jax.Array:
+    """Smallest kept logit for nucleus mass ``p``, given descending-sorted
+    logits.  The argmax is always kept; the token that crosses the
+    threshold is included."""
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    # exclusive cumulative mass: token i stays while the mass *before* it
+    # is < p, so the crossing token is included too.
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < p
+    keep = keep.at[..., 0].set(True)     # argmax survives even p <= 0
+    return jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                   keepdims=True)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest set of tokens whose probability
+    mass reaches ``p`` (always at least the argmax — ``p <= 0`` degrades
+    to greedy, not to an all-masked row).  ``p >= 1`` no-op."""
+    if p >= 1.0:
+        return logits
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    return jnp.where(logits < _nucleus_cutoff(sorted_desc, p), NEG_INF,
+                     logits)
+
+
+def sample_token(rng: jax.Array, logits: jax.Array, *,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0) -> jax.Array:
+    """Sample next-token ids (B,) int32 from (B, V) logits.
+
+    temperature=0 -> greedy argmax (top_k/top_p then irrelevant); otherwise
+    logits/temperature -> top-k -> top-p -> categorical.  When both filters
+    are active they share one descending sort (this runs inside the
+    KV-cache decode scan — the full-vocab sort is the dominant sampling
+    cost).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits / temperature, top_k=top_k, top_p=top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def filter_logits(logits: jax.Array, *, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """``top_p_filter(top_k_filter(x, k), p)`` with ONE descending sort.
+
+    Exactly the sequential semantics (the standard composition): the
+    nucleus is measured on the distribution *renormalized within the
+    top-k*.  That renormalization is recovered from the unfiltered sort —
+    mass(top-k) is the inclusive cumulative probability at position k-1,
+    and a position survives the nucleus iff its exclusive cumulative mass
+    is below ``p * mass(top-k)`` (positions past k are already cut, so
+    their exclusive mass within-k equals the raw one).
+    """
+    v = logits.shape[-1]
+    k_active = 0 < top_k < v
+    p_active = top_p < 1.0
+    if not (k_active or p_active):
+        return logits
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if not p_active:
+        cutoff = sorted_desc[..., top_k - 1:top_k]       # kth largest
+        return jnp.where(logits < cutoff, NEG_INF, logits)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    mass = cum[..., top_k - 1:top_k] if k_active else 1.0
+    keep = (cum - probs) < top_p * mass       # exclusive mass, renormalized
+    if k_active:
+        pos = jnp.arange(v)
+        keep = keep & (pos < top_k)
+    keep = keep.at[..., 0].set(True)          # argmax always survives
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, NEG_INF, logits)

@@ -163,20 +163,30 @@ def _decode_fields(buf: bytes) -> Iterator[tuple]:
 
 def read_scalars(path: str) -> list:
     """Parse an event file written by :class:`TBEventWriter` (or TensorFlow)
-    into ``[(step, tag, value), ...]``, verifying every record's crc."""
+    into ``[(step, tag, value), ...]``, verifying every record's crc.
+
+    A truncated final record (torn tail — e.g. the process was hard-killed
+    mid-write by the fail-fast watchdog) is treated as EOF, like stock
+    TensorBoard does, so the records already on disk survive post-mortem.
+    A crc mismatch on a *complete* record still raises (real corruption).
+    """
     out = []
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
-            if not header:
+            if len(header) < 8:
                 return out
-            (hcrc,) = struct.unpack("<I", f.read(4))
-            if hcrc != _masked_crc(header):
+            hcrc_bytes = f.read(4)
+            if len(hcrc_bytes) < 4:
+                return out
+            if struct.unpack("<I", hcrc_bytes)[0] != _masked_crc(header):
                 raise ValueError("corrupt record header crc")
             (ln,) = struct.unpack("<Q", header)
             payload = f.read(ln)
-            (pcrc,) = struct.unpack("<I", f.read(4))
-            if pcrc != _masked_crc(payload):
+            pcrc_bytes = f.read(4)
+            if len(payload) < ln or len(pcrc_bytes) < 4:
+                return out                      # torn tail: stop at EOF
+            if struct.unpack("<I", pcrc_bytes)[0] != _masked_crc(payload):
                 raise ValueError("corrupt record payload crc")
             step, summary = 0, None
             for field, wire, v in _decode_fields(payload):

@@ -10,6 +10,7 @@ drift between workloads.
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable
 
 import jax
@@ -55,31 +56,46 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
         j = (i % n_batches) * global_batch
         return put_global_batch(mesh, toks[j:j + global_batch])
 
-    # two warmup steps (untimed): first compiles, second runs with the
-    # settled post-step state shardings (a sharding-layout change after
-    # step one can trigger one more compile)
-    metrics = {}
-    for w in range(2):
-        state, metrics = step_fn(state, batch_at(w), jax.random.key(w))
-        block(state)
+    # Fail-fast watchdog (--hang_timeout_s), same contract as Trainer.fit:
+    # armed only for the loop, suspended across the compile-heavy warmup.
+    watchdog = None
+    if train_cfg.hang_timeout_s > 0:
+        from dtf_tpu.utils.watchdog import HangWatchdog
+        watchdog = HangWatchdog(train_cfg.hang_timeout_s)
 
-    t0 = time.perf_counter()
-    window_t, window_n = t0, 0
-    for i in range(steps):
-        state, metrics = step_fn(
-            state, batch_at(i + 1), jax.random.fold_in(rng_base, i))
-        window_n += 1
-        if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == steps:
-            block(state)
-            now = time.perf_counter()
-            avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
-            logger.print(format_step_line(
-                int(state["step"]), 1, i + 1, steps,
-                float(metrics["loss"]), avg_ms))
-            logger.scalar(int(state["step"]), "cost", float(metrics["loss"]))
-            logger.scalar(int(state["step"]), "avg_ms", avg_ms)
-            window_t, window_n = now, 0
-    block(state)
+    try:
+        # two warmup steps (untimed): first compiles, second runs with the
+        # settled post-step state shardings (a sharding-layout change after
+        # step one can trigger one more compile)
+        metrics = {}
+        with (watchdog.suspend() if watchdog is not None
+              else _nullcontext()):
+            for w in range(2):
+                state, metrics = step_fn(state, batch_at(w), jax.random.key(w))
+                block(state)
+
+        t0 = time.perf_counter()
+        window_t, window_n = t0, 0
+        for i in range(steps):
+            state, metrics = step_fn(
+                state, batch_at(i + 1), jax.random.fold_in(rng_base, i))
+            window_n += 1
+            if watchdog is not None:
+                watchdog.tick()
+            if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == steps:
+                block(state)
+                now = time.perf_counter()
+                avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
+                logger.print(format_step_line(
+                    int(state["step"]), 1, i + 1, steps,
+                    float(metrics["loss"]), avg_ms))
+                logger.scalar(int(state["step"]), "cost", float(metrics["loss"]))
+                logger.scalar(int(state["step"]), "avg_ms", avg_ms)
+                window_t, window_n = now, 0
+        block(state)
+    finally:
+        if watchdog is not None:
+            watchdog.close()
     total_s = time.perf_counter() - t0
     ms_per_step = total_s * 1000.0 / steps
     per_s = steps * global_batch * tokens_per_example / total_s

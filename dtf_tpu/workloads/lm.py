@@ -39,8 +39,14 @@ def main(argv=None) -> int:
                         help="inner attention: pallas flash kernel vs XLA "
                              "softmax attention (auto = flash on TPU)")
     parser.add_argument("--generate", type=int, default=0, metavar="N",
-                        help="after training, greedily generate N tokens "
-                             "from a held-out prompt (KV-cache decode)")
+                        help="after training, generate N tokens from a "
+                             "held-out prompt (KV-cache decode)")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="sampling temperature (0 = greedy)")
+    parser.add_argument("--top_k", type=int, default=0,
+                        help="keep only the k most likely tokens (0 = all)")
+    parser.add_argument("--top_p", type=float, default=1.0,
+                        help="nucleus sampling mass (1.0 = all)")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -71,7 +77,8 @@ def main(argv=None) -> int:
         prompt = jnp.asarray(toks[:1, :8])
         t0 = time.perf_counter()
         out = model.generate(state["params"], prompt, ns.generate,
-                             temperature=0.0)
+                             temperature=ns.temperature, top_k=ns.top_k,
+                             top_p=ns.top_p)
         block(out)
         dt = time.perf_counter() - t0
         logger.print(f"Generated: {np.asarray(out[0]).tolist()}")

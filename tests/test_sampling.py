@@ -1,0 +1,131 @@
+"""Sampling strategies (nn/sampling.py): filter exactness, distribution
+restrictions (forbidden tokens never sampled), greedy short-circuit, and
+the GPT.generate integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.nn.sampling import NEG_INF, sample_token, top_k_filter, top_p_filter
+
+
+def logits_row(vals):
+    return jnp.asarray([vals], jnp.float32)
+
+
+class TestTopK:
+    def test_keeps_exactly_k(self):
+        out = top_k_filter(logits_row([1.0, 4.0, 2.0, 3.0]), 2)
+        np.testing.assert_array_equal(
+            out[0], [NEG_INF, 4.0, NEG_INF, 3.0])
+
+    def test_noop_for_k_zero_or_full(self):
+        l = logits_row([1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(top_k_filter(l, 0), l)
+        np.testing.assert_array_equal(top_k_filter(l, 3), l)
+        np.testing.assert_array_equal(top_k_filter(l, 99), l)
+
+    def test_per_row_independent(self):
+        l = jnp.asarray([[5.0, 1.0, 0.0], [0.0, 1.0, 5.0]], jnp.float32)
+        out = top_k_filter(l, 1)
+        assert out[0, 0] == 5.0 and out[0, 1] == NEG_INF
+        assert out[1, 2] == 5.0 and out[1, 0] == NEG_INF
+
+
+class TestTopP:
+    def test_keeps_nucleus(self):
+        # probs ~ [0.643, 0.237, 0.087, 0.032]: p=0.7 keeps the first two
+        # (the crossing token is included).
+        l = logits_row([4.0, 3.0, 2.0, 1.0])
+        out = top_p_filter(l, 0.7)
+        np.testing.assert_array_equal(
+            out[0], [4.0, 3.0, NEG_INF, NEG_INF])
+
+    def test_always_keeps_argmax(self):
+        out = top_p_filter(logits_row([10.0, 0.0, 0.0]), 1e-6)
+        assert out[0, 0] == 10.0
+        assert out[0, 1] == NEG_INF and out[0, 2] == NEG_INF
+
+    def test_noop_for_p_one(self):
+        l = logits_row([1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(top_p_filter(l, 1.0), l)
+
+    def test_p_zero_degrades_to_greedy_not_all_masked(self):
+        """p <= 0 must keep the argmax (an all-masked row would make
+        categorical degenerate to always-token-0)."""
+        l = logits_row([0.0, 7.0, 1.0])
+        out = top_p_filter(l, 0.0)
+        assert out[0, 1] == 7.0
+        assert out[0, 0] == NEG_INF and out[0, 2] == NEG_INF
+        samples = {int(sample_token(jax.random.key(i), l, temperature=1.0,
+                                    top_p=0.0)[0]) for i in range(10)}
+        assert samples == {1}
+
+
+class TestSampleToken:
+    def test_greedy(self):
+        l = jnp.asarray([[0.0, 5.0, 1.0], [9.0, 0.0, 1.0]], jnp.float32)
+        out = sample_token(jax.random.key(0), l, temperature=0.0)
+        np.testing.assert_array_equal(out, [1, 0])
+        assert out.dtype == jnp.int32
+
+    def test_filtered_tokens_never_sampled(self):
+        l = jnp.tile(logits_row([3.0, 2.9, -1.0, -2.0]), (64, 1))
+        keys = jax.random.split(jax.random.key(1), 50)
+        for k in keys[:10]:
+            out = sample_token(k, l, temperature=1.0, top_k=2)
+            assert set(np.asarray(out)) <= {0, 1}
+        for k in keys[10:20]:
+            out = sample_token(k, l, temperature=1.0, top_p=0.5)
+            assert set(np.asarray(out)) <= {0, 1}   # 0.5 mass => top-2
+
+    def test_high_temperature_flattens(self):
+        """With T>>1 the sampled distribution approaches uniform; with T<<1
+        it concentrates on the argmax."""
+        l = jnp.tile(logits_row([2.0, 1.0, 0.0, -1.0]), (512, 1))
+        hot = sample_token(jax.random.key(2), l, temperature=100.0)
+        cold = sample_token(jax.random.key(2), l, temperature=0.01)
+        assert len(set(np.asarray(hot))) == 4        # all tokens appear
+        assert set(np.asarray(cold)) == {0}          # argmax only
+
+    def test_fused_filter_equals_sequential_filters(self):
+        """filter_logits (one sort) must match top_k_filter then
+        top_p_filter (the standard composition, nucleus renormalized
+        within the top-k)."""
+        from dtf_tpu.nn.sampling import filter_logits
+        l = jax.random.normal(jax.random.key(7), (8, 64), jnp.float32) * 3
+        for k, p in [(8, 0.9), (0, 0.5), (5, 1.0), (3, 0.2), (64, 0.7),
+                     (1, 0.99), (0, 1.0)]:
+            seq = top_p_filter(top_k_filter(l, k), p)
+            fused = filter_logits(l, top_k=k, top_p=p)
+            np.testing.assert_array_equal(np.asarray(fused), np.asarray(seq),
+                                          err_msg=f"k={k} p={p}")
+
+    def test_jit_compatible(self):
+        l = jnp.tile(logits_row([1.0, 2.0, 3.0, 4.0]), (4, 1))
+        f = jax.jit(lambda k, l: sample_token(k, l, temperature=0.8,
+                                              top_k=3, top_p=0.9))
+        out = f(jax.random.key(3), l)
+        assert out.shape == (4,)
+        assert set(np.asarray(out)) <= {1, 2, 3}     # token 0 cut by top_k/p
+
+
+class TestGenerateIntegration:
+    @pytest.mark.parametrize("kw", [
+        {"temperature": 0.0},
+        {"temperature": 0.9, "top_k": 8},
+        {"temperature": 0.9, "top_p": 0.9},
+    ])
+    def test_gpt_generate_with_sampling(self, kw):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig.tiny()
+        model = GPT(cfg)
+        params = model.init(jax.random.key(0))
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 4)),
+            jnp.int32)
+        out = model.generate(params, prompt, 6, rng=jax.random.key(1), **kw)
+        assert out.shape == (2, 10)
+        np.testing.assert_array_equal(out[:, :4], prompt)  # prompt preserved
+        assert ((0 <= out) & (out < cfg.vocab_size)).all()

@@ -42,6 +42,18 @@ class TestWriterReader:
         assert read_scalars(w.path) == [
             (1, "cost", 2.5), (2, "cost", 1.25), (2, "accuracy", 0.5)]
 
+    def test_torn_tail_returns_parsed_prefix(self, tmp_path):
+        """A record truncated mid-write (hard kill during flush) reads as
+        EOF — the scalars already on disk survive for post-mortem."""
+        w = TBEventWriter(str(tmp_path))
+        w.scalar(1, "cost", 2.5)
+        w.scalar(2, "cost", 1.25)
+        w.close()
+        data = open(w.path, "rb").read()
+        for cut in (3, 7, 11):     # mid-header, mid-crc, mid-payload
+            open(w.path, "wb").write(data[:-cut])
+            assert read_scalars(w.path) == [(1, "cost", 2.5)]
+
     def test_corrupt_record_detected(self, tmp_path):
         w = TBEventWriter(str(tmp_path))
         w.scalar(1, "cost", 2.5)
