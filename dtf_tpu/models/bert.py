@@ -151,6 +151,23 @@ class BertMLM(Module):
             "head_bias": jnp.zeros((self.cfg.vocab_size,), jnp.float32),
         }
 
+    def active_param_count(self, params) -> int:
+        """Params doing FLOPs per token, for MFU accounting
+        (workloads/_driver.py): with MoE, each token runs top_k of the E
+        experts, so only that fraction of the expert FFN weights counts
+        (the always-on router counts fully)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        total = sum(int(x.size) for x in leaves)
+        if self.cfg.moe_experts == 0:
+            return total
+        expert = sum(
+            int(leaf.size)
+            for name, sub in params["layers"]["moe"].items()
+            if name != "router"
+            for leaf in jax.tree_util.tree_leaves(sub))
+        frac = min(self.cfg.moe_top_k, self.cfg.moe_experts) / self.cfg.moe_experts
+        return total - int(expert * (1.0 - frac))
+
     def encode(self, params, tokens, *, pad_mask=None):
         """tokens (B, T) int32 -> hidden (B, T, D)."""
         t = tokens.shape[1]

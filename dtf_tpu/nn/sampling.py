@@ -99,11 +99,18 @@ def filter_logits(logits: jax.Array, *, top_k: int = 0,
         return jnp.where(logits < cutoff, NEG_INF, logits)
     probs = jax.nn.softmax(sorted_desc, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    mass = cum[..., top_k - 1:top_k] if k_active else 1.0
-    keep = (cum - probs) < top_p * mass       # exclusive mass, renormalized
     if k_active:
-        pos = jnp.arange(v)
-        keep = keep & (pos < top_k)
+        # top_k_filter keeps value-ties with the kth logit, so the survivor
+        # count can exceed k; the nucleus renormalizer must be the mass of
+        # ALL survivors or ties at the boundary diverge from the sequential
+        # composition.
+        kth = sorted_desc[..., top_k - 1:top_k]
+        n_kept = jnp.sum(sorted_desc >= kth, axis=-1, keepdims=True)
+        mass = jnp.take_along_axis(cum, n_kept - 1, axis=-1)
+        in_k = jnp.arange(v) < n_kept                    # first n_kept slots
+    else:
+        mass, in_k = 1.0, True
+    keep = ((cum - probs) < top_p * mass) & in_k
     keep = keep.at[..., 0].set(True)          # argmax always survives
     cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
                      keepdims=True)

@@ -30,7 +30,12 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     """Run ``steps`` timed train steps over ``toks`` (N, T) int32.
 
     Returns (state, metrics, ms_per_step).  Prints the reference step-line
-    contract plus a Step-Time/Throughput summary.
+    contract plus a Step-Time/Throughput summary, and — when the chip's
+    peak is known — the model FLOPs utilization (MFU) via the standard
+    ``6 · params · tokens`` train-step approximation (fwd 2PT + bwd 4PT;
+    attention's quadratic term and the embedding gather are ignored, so
+    this slightly *understates* at long sequence lengths — remat recompute
+    is correctly NOT counted as useful work).
     """
     from dtf_tpu import optim
     from dtf_tpu.parallel import sharding as sh
@@ -74,6 +79,16 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
                 state, metrics = step_fn(state, batch_at(w), jax.random.key(w))
                 block(state)
 
+        # Active params: MoE models route each token through top_k of E
+        # experts, so only a fraction of expert weights do FLOPs per token —
+        # models expose active_param_count; dense models use the total.
+        if hasattr(model, "active_param_count"):
+            n_params = int(model.active_param_count(state["params"]))
+        else:
+            n_params = sum(int(x.size) for x in
+                           jax.tree_util.tree_leaves(state["params"]))
+        model_flops = 6.0 * n_params * global_batch * toks.shape[1]
+
         t0 = time.perf_counter()
         window_t, window_n = t0, 0
         for i in range(steps):
@@ -103,4 +118,14 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     logger.print(f"Step-Time: {ms_per_step:.2f}ms  "
                  f"Throughput: {per_s:.1f} {throughput_unit}/s  "
                  f"(global batch {global_batch}, mesh {dict(mesh.shape)})")
+    tflops_chip = model_flops / mesh.size / (ms_per_step / 1e3) / 1e12
+    from dtf_tpu.bench.matmul import peak_flops_per_chip
+    # Peak denominator follows the model's compute dtype, not a CLI flag.
+    dtype_str = np.dtype(getattr(model.cfg, "dtype", np.float32)).name
+    peak = peak_flops_per_chip(mesh.devices.flat[0], dtype_str)
+    mfu = (f"  MFU: {tflops_chip * 1e12 / peak * 100.0:.1f}% of "
+           f"{dtype_str} peak" if peak else "")
+    logger.print(f"Model-Compute: {tflops_chip:.1f} TFLOP/s/chip "
+                 f"(6·P·T, {n_params / 1e6:.1f}M active params){mfu}")
+    logger.scalar(int(state["step"]), "model_tflops_per_chip", tflops_chip)
     return state, metrics, ms_per_step

@@ -141,3 +141,33 @@ class TestMoE:
 
         g = jax.grad(loss_fn)(params)
         assert float(jnp.sum(jnp.abs(g["router"]["w"]))) > 0
+
+
+class TestActiveParamCount:
+    def test_moe_counts_only_routed_experts(self):
+        """MFU accounting (workloads/_driver.py): top-1 of E experts means
+        only 1/E of the expert FFN weights are active per token; the
+        router and all dense weights count fully."""
+        from dtf_tpu.models.bert import BertConfig, BertMLM
+
+        cfg = BertConfig.tiny(moe_experts=4, moe_top_k=1)
+        model = BertMLM(cfg)
+        params = model.init(jax.random.key(0))
+        total = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+        expert = sum(
+            int(leaf.size)
+            for name, sub in params["layers"]["moe"].items()
+            if name != "router"
+            for leaf in jax.tree_util.tree_leaves(sub))
+        active = model.active_param_count(params)
+        assert active == total - int(expert * 0.75)
+        assert active < total
+
+    def test_dense_equals_total(self):
+        from dtf_tpu.models.bert import BertConfig, BertMLM
+
+        cfg = BertConfig.tiny()
+        model = BertMLM(cfg)
+        params = model.init(jax.random.key(0))
+        total = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+        assert model.active_param_count(params) == total

@@ -92,7 +92,9 @@ class TestSampleToken:
     def test_fused_filter_equals_sequential_filters(self):
         """filter_logits (one sort) must match top_k_filter then
         top_p_filter (the standard composition, nucleus renormalized
-        within the top-k)."""
+        within the top-k).  Continuous fixed-seed logits: thresholds are
+        deterministically far from any cumsum boundary on the test
+        backend."""
         from dtf_tpu.nn.sampling import filter_logits
         l = jax.random.normal(jax.random.key(7), (8, 64), jnp.float32) * 3
         for k, p in [(8, 0.9), (0, 0.5), (5, 1.0), (3, 0.2), (64, 0.7),
@@ -101,6 +103,21 @@ class TestSampleToken:
             fused = filter_logits(l, top_k=k, top_p=p)
             np.testing.assert_array_equal(np.asarray(fused), np.asarray(seq),
                                           err_msg=f"k={k} p={p}")
+
+    def test_fused_filter_handles_boundary_ties(self):
+        """top_k_filter keeps value-ties with the kth logit; the fused
+        nucleus renormalizer must include them (logits [3,2,2,0], k=2:
+        three survivors, so p=0.73 keeps [3,2,2] — a k-sized mass would
+        wrongly cut both 2s)."""
+        from dtf_tpu.nn.sampling import filter_logits
+        l = logits_row([3.0, 2.0, 2.0, 0.0])
+        for p in (0.73, 0.5, 0.95, 0.2):
+            seq = top_p_filter(top_k_filter(l, 2), p)
+            fused = filter_logits(l, top_k=2, top_p=p)
+            np.testing.assert_array_equal(np.asarray(fused), np.asarray(seq),
+                                          err_msg=f"p={p}")
+        out = filter_logits(l, top_k=2, top_p=0.73)
+        np.testing.assert_array_equal(out[0], [3.0, 2.0, 2.0, NEG_INF])
 
     def test_jit_compatible(self):
         l = jnp.tile(logits_row([1.0, 2.0, 3.0, 4.0]), (4, 1))
