@@ -107,6 +107,10 @@ class TrainConfig:
     grad_accum: int = 1
     checkpoint_every: int = 0         # steps; 0 disables (ref had no checkpointing, SURVEY §5.4)
     resume: bool = False
+    # SIGTERM (TPU preemption / spot reclamation) -> checkpoint at the next
+    # step boundary and exit cleanly.  Active whenever checkpointing is
+    # configured (checkpoint_every > 0 or resume).
+    preemption_save: bool = True
     dtype: str = "float32"
     # Observability (SURVEY §5.1/§5.2; the reference had wall-clock prints
     # only).  profile_dir: capture an XLA trace of steps

@@ -156,8 +156,8 @@ class BertMLM(Module):
         (workloads/_driver.py): with MoE, each token runs top_k of the E
         experts, so only that fraction of the expert FFN weights counts
         (the always-on router counts fully)."""
-        leaves = jax.tree_util.tree_leaves(params)
-        total = sum(int(x.size) for x in leaves)
+        from dtf_tpu.nn.core import count_params
+        total = int(count_params(params))
         if self.cfg.moe_experts == 0:
             return total
         expert = sum(

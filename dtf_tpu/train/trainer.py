@@ -67,6 +67,14 @@ def put_global_batch(mesh: Mesh, batch: Any) -> Any:
     process holds the same global batch and contributes its addressable
     shards (processes feed disjoint slices by construction since they build
     identical global batches from the same seed)."""
+    data_size = sh.data_axis_size(mesh)
+    for x in jax.tree_util.tree_leaves(batch):
+        if np.ndim(x) > 0 and x.shape[0] % data_size:
+            raise ValueError(
+                f"global batch dim {x.shape[0]} is not divisible by the "
+                f"mesh's data-axis size {data_size}; pick --batch_size as "
+                f"a multiple of {data_size}, or use --per_device_batch "
+                f"(global = per_device x devices by construction)")
     if jax.process_count() == 1:
         return sh.shard_batch(mesh, batch)
 
@@ -347,6 +355,11 @@ class Trainer:
         if cfg.hang_timeout_s > 0:
             from dtf_tpu.utils.watchdog import HangWatchdog
             self._watchdog = HangWatchdog(cfg.hang_timeout_s)
+        preempt = None
+        if self.ckpt is not None and cfg.preemption_save:
+            from dtf_tpu.utils.preemption import PreemptionHandler
+            preempt = PreemptionHandler()
+        preempted = False
         try:
             for epoch in range(start_epoch, epochs):
                 count = 0
@@ -373,7 +386,27 @@ class Trainer:
                             and self._host_step % self.cfg.checkpoint_every == 0):
                         with self._suspended_watchdog():
                             self.ckpt.save(self._host_step, self.state)
-                    if count % cfg.log_frequency == 0 or i + 1 == batch_count:
+                    # Preemption decision: single-process polls the local
+                    # flag every step; multi-process agrees via allgather
+                    # only at the logging sync boundaries (deterministic,
+                    # identical on every process), because the save and the
+                    # next step are both collectives — hosts must pick the
+                    # SAME boundary or they deadlock (utils/preemption.py).
+                    at_sync = (count % cfg.log_frequency == 0
+                               or i + 1 == batch_count)
+                    if preempt is not None and (
+                            preempt.triggered if jax.process_count() == 1
+                            else (at_sync and preempt.agreed())):
+                        with self._suspended_watchdog():
+                            self.ckpt.save(self._host_step, self.state,
+                                           force=True)
+                        self.logger.print(
+                            f"[dtf_tpu] preempted: checkpointed step "
+                            f"{self._host_step}; exiting (resume with "
+                            f"--resume)")
+                        preempted = True
+                        break
+                    if at_sync:
                         # Sync point: read back the metrics (the reference
                         # paid this every step via sess.run; we pay it only
                         # when logging).
@@ -386,6 +419,8 @@ class Trainer:
                         self.logger.scalar(step, "avg_ms", avg_ms)
                         count = 0
                         last_cost = cost
+                if preempted:
+                    break
                 with self._suspended_watchdog():
                     ev = self.eval_fn(self.state, splits.test)
                 self.logger.epoch_summary(ev["accuracy"], timer.total_s(),
@@ -396,6 +431,8 @@ class Trainer:
                 with self._suspended_watchdog():
                     ev = self.eval_fn(self.state, splits.test)
         finally:
+            if preempt is not None:
+                preempt.restore()
             # Disarm before post-loop host work — and on ANY exit path: a
             # raise out of the loop must not leave a daemon thread around to
             # os._exit(70) the caller's cleanup.
@@ -405,9 +442,10 @@ class Trainer:
             self._profiler.close(self.state)   # never leak an open trace
         block(self.state)
         if self.ckpt is not None:
-            if (self.cfg.checkpoint_every > 0
+            if (not preempted and self.cfg.checkpoint_every > 0
                     and self.ckpt.latest_step() != self._host_step):
                 self.ckpt.save(self._host_step, self.state, force=True)
             self.ckpt.wait()
         return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
-                "steps": int(self.state["step"]), "total_s": timer.total_s()}
+                "steps": int(self.state["step"]), "total_s": timer.total_s(),
+                "preempted": preempted}

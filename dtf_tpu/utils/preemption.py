@@ -1,0 +1,85 @@
+"""Preemption-safe training: SIGTERM -> checkpoint at the next step boundary.
+
+The reference lost all state on any interruption (no Saver, SURVEY.md §5.4).
+TPU VMs are routinely preempted (maintenance events, spot reclamation) with
+a SIGTERM and a grace window; this handler turns that into a clean
+checkpoint+exit instead of a kill, completing the fail-fast + resume
+recovery story (utils/watchdog.py, train/checkpoint.py).
+
+Signal-async-safe by design: the handler only sets a flag; the training
+loop polls it at step boundaries and does the actual (non-reentrant) orbax
+save there.
+
+Multi-host: SIGTERM delivery is not synchronized across hosts, and the
+orbax save and the train step are both collectives — hosts deciding to
+save at *different* step boundaries would deadlock (one blocks in the save
+barrier, another in the next step's gradient psum).  :meth:`agreed` is the
+race-free decision: an allgather of the local flags, called at boundaries
+every process already reaches together (the trainer uses its logging sync
+points), so either ALL processes save at that boundary or none do.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Iterable
+
+
+class PreemptionHandler:
+    """Installs handlers for ``signals``; :attr:`triggered` flips at the
+    first delivery.  ``restore()`` reinstates the previous handlers.
+
+    Signal handlers are a main-thread-only facility; constructed from any
+    other thread the handler stays disarmed (``triggered`` always False)
+    and says so, rather than crashing the trainer.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._flag = threading.Event()
+        self._prev = {}
+        try:
+            for s in signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+        except ValueError:   # not the main thread
+            self.restore()
+            print("[dtf_tpu] preemption handler disabled: signals can only "
+                  "be installed from the main thread", file=sys.stderr,
+                  flush=True)
+
+    def _on_signal(self, signum, frame) -> None:
+        self._flag.set()
+        # print() is not strictly async-signal-safe but CPython serializes
+        # handler execution on the main thread; keep it one short line.
+        print(f"[dtf_tpu] signal {signum}: preemption — will checkpoint at "
+              f"the next sync boundary and exit", file=sys.stderr, flush=True)
+
+    @property
+    def triggered(self) -> bool:
+        """This process's local flag (race-free only single-process; use
+        :meth:`agreed` across hosts)."""
+        return self._flag.is_set()
+
+    def agreed(self) -> bool:
+        """True iff ANY process has been signalled — same answer on every
+        process.  Call at a boundary all processes reach together (host
+        sync: one small allgather over DCN); single-process it is just the
+        local flag."""
+        import jax
+        if jax.process_count() == 1:
+            return self.triggered
+        import numpy as np
+        from jax.experimental import multihost_utils
+        local = np.asarray([1 if self.triggered else 0], np.int32)
+        return bool(np.asarray(
+            multihost_utils.process_allgather(local)).any())
+
+    def restore(self) -> None:
+        for s, prev in self._prev.items():
+            # signal.signal returned None when the previous handler was not
+            # installed from Python (e.g. a C extension's); there is nothing
+            # restorable — leave ours in place rather than TypeError.
+            if prev is not None:
+                signal.signal(s, prev)
+        self._prev = {}

@@ -85,8 +85,8 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
         if hasattr(model, "active_param_count"):
             n_params = int(model.active_param_count(state["params"]))
         else:
-            n_params = sum(int(x.size) for x in
-                           jax.tree_util.tree_leaves(state["params"]))
+            from dtf_tpu.nn.core import count_params
+            n_params = int(count_params(state["params"]))
         model_flops = 6.0 * n_params * global_batch * toks.shape[1]
 
         t0 = time.perf_counter()

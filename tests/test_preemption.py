@@ -1,0 +1,123 @@
+"""Preemption-safe training (utils/preemption.py): SIGTERM mid-run ->
+checkpoint at the step boundary + clean exit; a --resume run continues
+from the preemption step.  Also the topology-change restore path: a
+checkpoint written under one mesh restores onto a differently-factored
+mesh (the template's shardings win)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dtf_tpu.utils.preemption import PreemptionHandler
+
+
+class TestHandler:
+    def test_flag_flips_on_signal(self):
+        h = PreemptionHandler(signals=(signal.SIGUSR1,))
+        try:
+            assert not h.triggered
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0.05)
+            assert h.triggered
+        finally:
+            h.restore()
+
+    def test_restore_reinstates_previous_handler(self):
+        calls = []
+        prev = signal.signal(signal.SIGUSR1, lambda *a: calls.append(1))
+        try:
+            h = PreemptionHandler(signals=(signal.SIGUSR1,))
+            h.restore()
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0.05)
+            assert calls == [1]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+
+
+@pytest.mark.slow
+class TestPreemptedRun:
+    def test_sigterm_checkpoints_and_resume_continues(self, tmp_path):
+        """Drive the real mnist CLI in a subprocess, SIGTERM it mid-epoch,
+        then resume: the second run must pick up from the preemption step."""
+        # --simulated_devices (config.update), NOT env vars: this image's
+        # sitecustomize imports jax first, and the axon TPU plugin would win
+        # over JAX_PLATFORMS=cpu in a fresh subprocess.
+        env = dict(os.environ)
+        args = [sys.executable, "-m", "dtf_tpu.workloads.mnist",
+                "--simulated_devices", "8",
+                "--epochs", "50", "--batch_size", "200",
+                "--logdir", str(tmp_path),
+                "--checkpoint_every", "1000000",   # only preemption saves
+                "--log_frequency", "5"]
+        p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        # wait until training demonstrably progresses, then preempt
+        deadline = time.time() + 300
+        lines = []
+        for line in p.stdout:
+            lines.append(line)
+            if line.startswith("Step: ") or time.time() > deadline:
+                break
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=300)
+        lines.append(out)
+        text = "".join(lines)
+        assert p.returncode == 0, f"preempted run failed:\n{text[-3000:]}"
+        assert "preempted: checkpointed step" in text, text[-3000:]
+
+        ckpts = os.listdir(str(tmp_path / "checkpoints"))
+        steps = [int(d) for d in ckpts if d.isdigit()]
+        assert steps, f"no checkpoint written: {ckpts}"
+
+        # synthetic MNIST: 12800 train examples / batch 200 = 64 steps/epoch
+        resume = subprocess.run(
+            args + ["--resume", "--epochs", str(max(steps) // 64 + 1)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert resume.returncode == 0, resume.stdout[-3000:]
+        assert f"resumed from step {max(steps)}" in resume.stdout
+
+
+class TestTopologyChangeRestore:
+    def test_restore_onto_different_mesh_factoring(self, tmp_path):
+        """Save under data=8, restore under data=4 x tensor=2: values equal,
+        shardings follow the new template (elastic topology resume)."""
+        from dtf_tpu import optim
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.parallel import sharding as sh
+        from dtf_tpu.parallel.mesh import make_mesh
+        from dtf_tpu.train.checkpoint import CheckpointManager
+        from dtf_tpu.train.trainer import init_state, make_train_step, put_global_batch
+
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+
+        mesh_a = make_mesh("data=8")
+        state = init_state(model, opt, seed=1, mesh=mesh_a)
+        step = make_train_step(model.loss, opt, mesh_a, donate=False)
+        batch = put_global_batch(
+            mesh_a, (np.random.default_rng(0).random((16, 784), np.float32),
+                     np.eye(10, dtype=np.float32)[np.arange(16) % 10]))
+        state, _ = step(state, batch, jax.random.key(0))
+        ckpt = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+        ckpt.save(1, state, force=True)
+        ckpt.wait()
+
+        mesh_b = make_mesh("data=4,tensor=2")
+        rules = sh.apply_rules(model.axes(), mesh_b)
+        template = init_state(model, opt, seed=99, mesh=mesh_b,
+                              param_shardings=rules)
+        restored, at = CheckpointManager(str(tmp_path / "ck")).restore(template)
+        assert at == 1
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            restored["params"], state["params"])
+        w1 = restored["params"]["l1"]["w"]
+        assert w1.sharding.mesh.shape == mesh_b.shape
