@@ -93,13 +93,17 @@ class GPTBlock(Module):
                 "attn": self.attn.init(ka), "fc1": self.fc1.init(kf1),
                 "fc2": self.fc2.init(kf2)}
 
-    def apply(self, params, x, *, train=False, rng=None):
-        x = x + self.attn.apply(params["attn"],
-                                self.ln1.apply(params["ln1"], x))
+    def _mlp_residual(self, params, x):
+        """x + MLP(ln2(x)) — shared by the train/prefill/decode paths."""
         h = self.ln2.apply(params["ln2"], x)
         h = self.fc2.apply(params["fc2"],
                            jax.nn.gelu(self.fc1.apply(params["fc1"], h)))
         return x + h
+
+    def apply(self, params, x, *, train=False, rng=None):
+        x = x + self.attn.apply(params["attn"],
+                                self.ln1.apply(params["ln1"], x))
+        return self._mlp_residual(params, x)
 
     def decode_step(self, params, x_t, cache, pos):
         """One token through the block with a KV cache.
@@ -109,9 +113,7 @@ class GPTBlock(Module):
         """
         p = params["attn"]
         h = self.ln1.apply(params["ln1"], x_t)
-        q = jnp.einsum("btd,dhk->bthk", h, p["q"]["w"]) + p["q"]["b"]
-        k_t = jnp.einsum("btd,dhk->bthk", h, p["k"]["w"]) + p["k"]["b"]
-        v_t = jnp.einsum("btd,dhk->bthk", h, p["v"]["w"]) + p["v"]["b"]
+        q, k_t, v_t = self.attn.qkv(p, h)
         cache_k = lax.dynamic_update_slice_in_dim(cache["k"],
                                                   k_t.astype(cache["k"].dtype),
                                                   pos, axis=1)
@@ -127,12 +129,19 @@ class GPTBlock(Module):
         w = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", w,
                          cache_v.astype(jnp.float32)).astype(x_t.dtype)
-        a = jnp.einsum("bthk,hkd->btd", out, p["o"]["w"]) + p["o"]["b"]
-        x_t = x_t + a
-        h = self.ln2.apply(params["ln2"], x_t)
-        h = self.fc2.apply(params["fc2"],
-                           jax.nn.gelu(self.fc1.apply(params["fc1"], h)))
-        return x_t + h, {"k": cache_k, "v": cache_v}
+        x_t = x_t + self.attn.out_proj(p, out)
+        return self._mlp_residual(params, x_t), {"k": cache_k, "v": cache_v}
+
+    def prefill(self, params, x):
+        """Full-prompt forward that also returns this block's K/V for the
+        cache: one MXU-batched pass instead of per-token decode steps.
+        x: (B, P, D) -> (y, k, v) with k,v (B, P, H, Dh)."""
+        p = params["attn"]
+        h = self.ln1.apply(params["ln1"], x)
+        q, k, v = self.attn.qkv(p, h)
+        impl = self.attn.attn_impl or _xla_causal_impl
+        x = x + self.attn.out_proj(p, impl(q, k, v, None))
+        return self._mlp_residual(params, x), k, v
 
     def axes(self):
         return {"ln1": self.ln1.axes(), "ln2": self.ln2.axes(),
@@ -224,25 +233,64 @@ class GPT(Module):
                  top_p: float = 1.0, rng=None):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
-        One compiled program: the prompt prefills the cache position by
-        position, then new tokens are sampled; everything is a single
-        ``lax.scan`` over time steps with a static-shape cache.
+        Two phases, one compiled program:
+
+        * **prefill**: the whole prompt runs through ONE full forward pass
+          (large batched matmuls on the MXU, flash attention) that fills
+          the KV cache for all P positions at once — not P sequential
+          decode steps;
+        * **decode**: a ``lax.scan`` over the new positions with the
+          static-shape cache; per-step attention masks positions beyond
+          the current index so decode compiles once.
+
         temperature=0 -> greedy; top_k/top_p filter the distribution
         (nn/sampling.py).
         """
+        from dtf_tpu.nn.sampling import sample_token
+
         cfg = self.cfg
         b, p_len = prompt.shape
         total = p_len + max_new_tokens
         if total > cfg.max_len:
             raise ValueError(f"prompt+new = {total} exceeds max_len "
                              f"{cfg.max_len}")
+        if max_new_tokens == 0:
+            return prompt
         if rng is None:
             rng = jax.random.key(0)
 
-        cache = self.init_cache(b)
+        # ---- prefill: one batched forward over the prompt fills the cache.
+        # Pad the prompt to a multiple of 8 so the flash kernel always has
+        # a valid block size (causal attention: real positions never see
+        # the zero-padded tail, whose K/V and outputs are discarded).
+        p_pad = -(-p_len // 8) * 8
+        padded = (prompt if p_pad == p_len else jnp.pad(
+            prompt, ((0, 0), (0, p_pad - p_len))))
+        x = (self.tok.apply(params["tok"], padded)
+             + self.pos.apply(params["pos"], jnp.arange(p_pad)))
+
+        def prefill_layer(carry_x, lp):
+            y, k, v = self.block.prefill(lp, carry_x)
+            return y, (k, v)
+
+        x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
+        cache = self.init_cache(b)          # (L, B, Tmax, H, Dh)
+        cache = {"k": cache["k"].at[:, :, :p_len].set(
+                     ks[:, :, :p_len].astype(cache["k"].dtype)),
+                 "v": cache["v"].at[:, :, :p_len].set(
+                     vs[:, :, :p_len].astype(cache["v"].dtype))}
+        x = self.ln_f.apply(params["ln_f"], x)
+        logits = self.tok.attend(params["tok"], x)[:, p_len - 1, :]  # (B, V)
+        rng, sub = jax.random.split(rng)
+        first = sample_token(sub, logits, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+
         out = jnp.zeros((b, total), jnp.int32)
         out = lax.dynamic_update_slice(out, prompt, (0, 0))
+        out = out.at[:, p_len].set(first)
 
+        # ---- decode: scan positions p_len..total-2, each reading the token
+        # it just wrote and emitting the next one.
         def step(carry, pos):
             out, cache, rng = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
@@ -263,16 +311,11 @@ class GPT(Module):
             logits = self.tok.attend(params["tok"], x)[:, 0, :]  # (B, V)
 
             rng, sub = jax.random.split(rng)
-            from dtf_tpu.nn.sampling import sample_token
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
-            # during prefill (pos+1 < p_len) keep the prompt token
-            keep_prompt = pos + 1 < p_len
-            existing = lax.dynamic_slice(out, (0, pos + 1), (b, 1))[:, 0]
-            nxt = jnp.where(keep_prompt, existing, nxt)
             out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
             return (out, cache, rng), None
 
         (out, _, _), _ = lax.scan(step, (out, cache, rng),
-                                  jnp.arange(total - 1))
+                                  jnp.arange(p_len, total - 1))
         return out

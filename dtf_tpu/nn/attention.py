@@ -66,14 +66,24 @@ class MultiHeadAttention(Module):
                   "b": jnp.zeros((d,), self.dtype)},
         }
 
-    def apply(self, params, x, *, mask=None, train=False, rng=None):
+    def qkv(self, params, x):
+        """Project (B, T, D) -> q, k, v each (B, T, H, Dh).  The single
+        definition of the input projections — apply(), and the GPT block's
+        prefill/decode paths, all route through here."""
         q = jnp.einsum("btd,dhk->bthk", x, params["q"]["w"]) + params["q"]["b"]
         k = jnp.einsum("btd,dhk->bthk", x, params["k"]["w"]) + params["k"]["b"]
         v = jnp.einsum("btd,dhk->bthk", x, params["v"]["w"]) + params["v"]["b"]
-        impl = self.attn_impl or dot_product_attention
-        out = impl(q, k, v, mask)
+        return q, k, v
+
+    def out_proj(self, params, out):
+        """(B, T, H, Dh) attention output -> (B, T, D)."""
         return (jnp.einsum("bthk,hkd->btd", out, params["o"]["w"])
                 + params["o"]["b"])
+
+    def apply(self, params, x, *, mask=None, train=False, rng=None):
+        q, k, v = self.qkv(params, x)
+        impl = self.attn_impl or dot_product_attention
+        return self.out_proj(params, impl(q, k, v, mask))
 
     def axes(self):
         proj = {"w": ("embed", "heads", "kv"), "b": ("heads", "kv")}

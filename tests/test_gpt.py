@@ -103,3 +103,28 @@ class TestGeneration:
     def test_overflow_raises(self, tiny, tiny_params):
         with pytest.raises(ValueError, match="max_len"):
             tiny.generate(tiny_params, jnp.zeros((1, 60), jnp.int32), 10)
+
+
+class TestGenerateEdges:
+    def test_max_new_tokens_zero_returns_prompt(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny())
+        params = model.init(jax.random.key(0))
+        prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+        np.testing.assert_array_equal(model.generate(params, prompt, 0),
+                                      prompt)
+
+    def test_awkward_prompt_length_under_flash(self):
+        """Prompt lengths with no 8-multiple divisor (e.g. 10) must prefill
+        fine through the flash kernel (generate pads to a multiple of 8;
+        causality keeps real positions unaffected by the pad tail)."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        flash = GPT(GPTConfig.tiny(use_flash=True))
+        xla = GPT(GPTConfig.tiny(use_flash=False))
+        params = flash.init(jax.random.key(0))
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (2, 10)), jnp.int32)
+        out_f = flash.generate(params, prompt, 4, temperature=0.0)
+        out_x = xla.generate(params, prompt, 4, temperature=0.0)
+        assert out_f.shape == (2, 14)
+        np.testing.assert_array_equal(out_f, out_x)   # pad tail is invisible
