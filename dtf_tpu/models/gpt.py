@@ -41,10 +41,22 @@ class GPTConfig:
     dtype: Any = jnp.float32
     use_flash: Optional[bool] = None   # None = flash on TPU, XLA elsewhere
     remat: bool = False
+    # LLaMA-family options (beyond-parity model breadth):
+    rope: bool = False                 # rotary positions instead of a table
+    num_kv_heads: Optional[int] = None # GQA: KV cache shrinks by H/KVH
+    mlp_act: str = "gelu"              # "gelu" | "swiglu"
 
     @classmethod
     def gpt2_small(cls, **kw):
         return cls(**kw)
+
+    @classmethod
+    def llama_style(cls, **kw):
+        """LLaMA-family block wiring at GPT-2-small scale: RoPE + GQA(4) +
+        SwiGLU (mlp_dim scaled by 2/3 to hold the param count)."""
+        d = dict(rope=True, num_kv_heads=4, mlp_act="swiglu", mlp_dim=2048)
+        d.update(kw)
+        return cls(**d)
 
     @classmethod
     def tiny(cls, **kw):
@@ -81,72 +93,107 @@ class GPTBlock(Module):
         self.ln1 = LayerNorm(cfg.dim)
         self.ln2 = LayerNorm(cfg.dim)
         self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype,
-                                       attn_impl=impl)
+                                       attn_impl=impl,
+                                       num_kv_heads=cfg.num_kv_heads)
+        # SwiGLU: gate and up are SEPARATE column-parallel projections, not
+        # one packed matmul split at the midpoint — under the "mlp"->tensor
+        # sharding rule a midpoint split would land gate and up on different
+        # shards and force a reshard before silu(gate)*up; two projections
+        # keep the elementwise product local on every tensor shard.
         self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
                          axes_in="embed", axes_out="mlp")
+        self.fc_gate = (Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
+                              axes_in="embed", axes_out="mlp")
+                        if cfg.mlp_act == "swiglu" else None)
         self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
                          axes_in="mlp", axes_out="embed")
 
     def init(self, key):
-        k1, k2, ka, kf1, kf2 = jax.random.split(key, 5)
-        return {"ln1": self.ln1.init(k1), "ln2": self.ln2.init(k2),
-                "attn": self.attn.init(ka), "fc1": self.fc1.init(kf1),
-                "fc2": self.fc2.init(kf2)}
+        k1, k2, ka, kf1, kf2, kg = jax.random.split(key, 6)
+        out = {"ln1": self.ln1.init(k1), "ln2": self.ln2.init(k2),
+               "attn": self.attn.init(ka), "fc1": self.fc1.init(kf1),
+               "fc2": self.fc2.init(kf2)}
+        if self.fc_gate is not None:
+            out["fc_gate"] = self.fc_gate.init(kg)
+        return out
 
     def _mlp_residual(self, params, x):
         """x + MLP(ln2(x)) — shared by the train/prefill/decode paths."""
         h = self.ln2.apply(params["ln2"], x)
-        h = self.fc2.apply(params["fc2"],
-                           jax.nn.gelu(self.fc1.apply(params["fc1"], h)))
-        return x + h
+        u = self.fc1.apply(params["fc1"], h)
+        if self.fc_gate is not None:
+            u = jax.nn.silu(self.fc_gate.apply(params["fc_gate"], h)) * u
+        else:
+            u = jax.nn.gelu(u)
+        return x + self.fc2.apply(params["fc2"], u)
+
+    def prefill(self, params, x):
+        """Full-sequence forward that also returns this block's K/V for the
+        cache (one MXU-batched pass); apply() is this minus the K/V.
+        x: (B, T, D) -> (y, k, v) with k,v (B, T, KVH, Dh) — k rotated when
+        RoPE is on (the cache stores post-rotation keys)."""
+        p = params["attn"]
+        h = self.ln1.apply(params["ln1"], x)
+        q, k, v = self.attn.qkv(p, h)
+        if self.cfg.rope:
+            from dtf_tpu.nn.rope import apply_rope
+            positions = jnp.arange(x.shape[1])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+        impl = self.attn.attn_impl or _xla_causal_impl
+        out = impl(q, self.attn.expand_kv(k), self.attn.expand_kv(v), None)
+        x = x + self.attn.out_proj(p, out)
+        return self._mlp_residual(params, x), k, v
 
     def apply(self, params, x, *, train=False, rng=None):
-        x = x + self.attn.apply(params["attn"],
-                                self.ln1.apply(params["ln1"], x))
-        return self._mlp_residual(params, x)
+        y, _, _ = self.prefill(params, x)
+        return y
 
     def decode_step(self, params, x_t, cache, pos):
         """One token through the block with a KV cache.
 
-        x_t: (B, 1, D); cache: {"k","v"}: (B, T_max, H, Dh); pos: scalar
-        index of this token.  Returns (y_t, new_cache).
+        x_t: (B, 1, D); cache: {"k","v"}: (B, T_max, KVH, Dh); pos: scalar
+        index of this token.  Returns (y_t, new_cache).  Grouped-query
+        attention runs on the grouped cache directly (no head broadcast of
+        the T_max-sized cache in the hot decode loop).
         """
         p = params["attn"]
         h = self.ln1.apply(params["ln1"], x_t)
         q, k_t, v_t = self.attn.qkv(p, h)
+        if self.cfg.rope:
+            from dtf_tpu.nn.rope import apply_rope
+            q = apply_rope(q, pos[None])
+            k_t = apply_rope(k_t, pos[None])
         cache_k = lax.dynamic_update_slice_in_dim(cache["k"],
                                                   k_t.astype(cache["k"].dtype),
                                                   pos, axis=1)
         cache_v = lax.dynamic_update_slice_in_dim(cache["v"],
                                                   v_t.astype(cache["v"].dtype),
                                                   pos, axis=1)
-        scale = q.shape[-1] ** -0.5
-        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                       cache_k.astype(jnp.float32)) * scale    # (B,H,1,Tmax)
+        b, _, h_all, hd = q.shape
+        kvh = cache_k.shape[2]
+        g = h_all // kvh
+        qg = q.reshape(b, kvh, g, hd)                 # T=1 folded away
+        scale = hd ** -0.5
+        s = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+                       cache_k.astype(jnp.float32)) * scale  # (B,KVH,G,Tmax)
         t_max = cache_k.shape[1]
         visible = jnp.arange(t_max)[None, None, None, :] <= pos
         s = jnp.where(visible, s, NEG_BIG)
         w = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", w,
+        out = jnp.einsum("bkgt,btkd->bkgd", w,
                          cache_v.astype(jnp.float32)).astype(x_t.dtype)
+        out = out.reshape(b, 1, h_all, hd)
         x_t = x_t + self.attn.out_proj(p, out)
         return self._mlp_residual(params, x_t), {"k": cache_k, "v": cache_v}
 
-    def prefill(self, params, x):
-        """Full-prompt forward that also returns this block's K/V for the
-        cache: one MXU-batched pass instead of per-token decode steps.
-        x: (B, P, D) -> (y, k, v) with k,v (B, P, H, Dh)."""
-        p = params["attn"]
-        h = self.ln1.apply(params["ln1"], x)
-        q, k, v = self.attn.qkv(p, h)
-        impl = self.attn.attn_impl or _xla_causal_impl
-        x = x + self.attn.out_proj(p, impl(q, k, v, None))
-        return self._mlp_residual(params, x), k, v
-
     def axes(self):
-        return {"ln1": self.ln1.axes(), "ln2": self.ln2.axes(),
-                "attn": self.attn.axes(), "fc1": self.fc1.axes(),
-                "fc2": self.fc2.axes()}
+        out = {"ln1": self.ln1.axes(), "ln2": self.ln2.axes(),
+               "attn": self.attn.axes(), "fc1": self.fc1.axes(),
+               "fc2": self.fc2.axes()}
+        if self.fc_gate is not None:
+            out["fc_gate"] = self.fc_gate.axes()
+        return out
 
 
 @dataclasses.dataclass
@@ -158,7 +205,9 @@ class GPT(Module):
     def __post_init__(self):
         cfg = self.cfg
         self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
-        self.pos = Embedding(cfg.max_len, cfg.dim, cfg.dtype)
+        # RoPE rotates q/k inside the blocks; no position table then.
+        self.pos = None if cfg.rope else Embedding(cfg.max_len, cfg.dim,
+                                                   cfg.dtype)
         self.block = GPTBlock(cfg)
         self.ln_f = LayerNorm(cfg.dim)
 
@@ -166,14 +215,23 @@ class GPT(Module):
         kt, kp, ks, kl = jax.random.split(key, 4)
         stacked = jax.vmap(self.block.init)(
             jax.random.split(ks, self.cfg.num_layers))
-        return {"tok": self.tok.init(kt), "pos": self.pos.init(kp),
-                "layers": stacked, "ln_f": self.ln_f.init(kl)}
+        out = {"tok": self.tok.init(kt), "layers": stacked,
+               "ln_f": self.ln_f.init(kl)}
+        if self.pos is not None:
+            out["pos"] = self.pos.init(kp)
+        return out
+
+    def _embed(self, params, tokens, positions):
+        """Token embedding (+ position table unless RoPE)."""
+        x = self.tok.apply(params["tok"], tokens)
+        if self.pos is not None:
+            x = x + self.pos.apply(params["pos"], positions)
+        return x
 
     def apply(self, params, tokens, *, train=False, rng=None):
         """tokens (B, T) -> logits (B, T, V)."""
         t = tokens.shape[1]
-        x = (self.tok.apply(params["tok"], tokens)
-             + self.pos.apply(params["pos"], jnp.arange(t)))
+        x = self._embed(params, tokens, jnp.arange(t))
 
         block_fn = self.block.apply
         if self.cfg.remat:
@@ -191,8 +249,11 @@ class GPT(Module):
             lambda ax: (None, *ax), self.block.axes(),
             is_leaf=lambda x: isinstance(x, tuple) and all(
                 a is None or isinstance(a, str) for a in x))
-        return {"tok": self.tok.axes(), "pos": {"table": (None, "embed")},
-                "layers": layer_axes, "ln_f": self.ln_f.axes()}
+        out = {"tok": self.tok.axes(), "layers": layer_axes,
+               "ln_f": self.ln_f.axes()}
+        if self.pos is not None:
+            out["pos"] = {"table": (None, "embed")}
+        return out
 
     # --- training objective -------------------------------------------
 
@@ -224,7 +285,8 @@ class GPT(Module):
     def init_cache(self, batch: int):
         cfg = self.cfg
         hd = cfg.dim // cfg.num_heads
-        shape = (cfg.num_layers, batch, cfg.max_len, cfg.num_heads, hd)
+        kvh = cfg.num_kv_heads or cfg.num_heads    # GQA: H/KVH smaller cache
+        shape = (cfg.num_layers, batch, cfg.max_len, kvh, hd)
         return {"k": jnp.zeros(shape, cfg.dtype),
                 "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -266,15 +328,14 @@ class GPT(Module):
         p_pad = -(-p_len // 8) * 8
         padded = (prompt if p_pad == p_len else jnp.pad(
             prompt, ((0, 0), (0, p_pad - p_len))))
-        x = (self.tok.apply(params["tok"], padded)
-             + self.pos.apply(params["pos"], jnp.arange(p_pad)))
+        x = self._embed(params, padded, jnp.arange(p_pad))
 
         def prefill_layer(carry_x, lp):
             y, k, v = self.block.prefill(lp, carry_x)
             return y, (k, v)
 
         x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
-        cache = self.init_cache(b)          # (L, B, Tmax, H, Dh)
+        cache = self.init_cache(b)          # (L, B, Tmax, KVH, Dh)
         cache = {"k": cache["k"].at[:, :, :p_len].set(
                      ks[:, :, :p_len].astype(cache["k"].dtype)),
                  "v": cache["v"].at[:, :, :p_len].set(
@@ -294,8 +355,7 @@ class GPT(Module):
         def step(carry, pos):
             out, cache, rng = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
-            x = (self.tok.apply(params["tok"], tok)
-                 + self.pos.apply(params["pos"], pos[None]))
+            x = self._embed(params, tok, pos[None])
 
             # thread the per-layer caches through a scan over layers
             def layer_scan(carry_x, inputs):

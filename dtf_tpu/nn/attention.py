@@ -48,32 +48,50 @@ class MultiHeadAttention(Module):
     # Pluggable inner attention: f(q, k, v, mask) -> out.  Defaults to plain
     # softmax attention; ring/flash implementations swap in here.
     attn_impl: Optional[Callable] = None
+    # Grouped-query attention: K/V get this many heads (must divide
+    # num_heads); queries share each KV head in groups.  None = classic MHA.
+    # Shrinks the KV cache (and its HBM traffic) by num_heads/num_kv_heads.
+    num_kv_heads: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         assert self.dim % self.num_heads == 0
         return self.dim // self.num_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kvh = self.num_kv_heads or self.num_heads
+        assert self.num_heads % kvh == 0, (
+            f"num_kv_heads {kvh} must divide num_heads {self.num_heads}")
+        return kvh
+
     def init(self, key):
         kq, kk, kv, ko = jax.random.split(key, 4)
         d, h, hd = self.dim, self.num_heads, self.head_dim
-        mk = lambda k: _fan_in_normal(k, (d, h, hd), self.dtype, d)
+        kvh = self.kv_heads
+        mk = lambda k, nh: _fan_in_normal(k, (d, nh, hd), self.dtype, d)
         return {
-            "q": {"w": mk(kq), "b": jnp.zeros((h, hd), self.dtype)},
-            "k": {"w": mk(kk), "b": jnp.zeros((h, hd), self.dtype)},
-            "v": {"w": mk(kv), "b": jnp.zeros((h, hd), self.dtype)},
+            "q": {"w": mk(kq, h), "b": jnp.zeros((h, hd), self.dtype)},
+            "k": {"w": mk(kk, kvh), "b": jnp.zeros((kvh, hd), self.dtype)},
+            "v": {"w": mk(kv, kvh), "b": jnp.zeros((kvh, hd), self.dtype)},
             "o": {"w": _fan_in_normal(ko, (h, hd, d), self.dtype, d),
                   "b": jnp.zeros((d,), self.dtype)},
         }
 
     def qkv(self, params, x):
-        """Project (B, T, D) -> q, k, v each (B, T, H, Dh).  The single
-        definition of the input projections — apply(), and the GPT block's
-        prefill/decode paths, all route through here."""
+        """Project (B, T, D) -> q (B, T, H, Dh), k/v (B, T, KVH, Dh).  The
+        single definition of the input projections — apply(), and the GPT
+        block's prefill/decode paths, all route through here."""
         q = jnp.einsum("btd,dhk->bthk", x, params["q"]["w"]) + params["q"]["b"]
         k = jnp.einsum("btd,dhk->bthk", x, params["k"]["w"]) + params["k"]["b"]
         v = jnp.einsum("btd,dhk->bthk", x, params["v"]["w"]) + params["v"]["b"]
         return q, k, v
+
+    def expand_kv(self, kv):
+        """Broadcast grouped KV heads up to num_heads for an inner attention
+        that expects equal head counts (flash/ring/ulysses/XLA)."""
+        reps = self.num_heads // kv.shape[2]
+        return kv if reps == 1 else jnp.repeat(kv, reps, axis=2)
 
     def out_proj(self, params, out):
         """(B, T, H, Dh) attention output -> (B, T, D)."""
@@ -83,7 +101,8 @@ class MultiHeadAttention(Module):
     def apply(self, params, x, *, mask=None, train=False, rng=None):
         q, k, v = self.qkv(params, x)
         impl = self.attn_impl or dot_product_attention
-        return self.out_proj(params, impl(q, k, v, mask))
+        return self.out_proj(params, impl(q, self.expand_kv(k),
+                                          self.expand_kv(v), mask))
 
     def axes(self):
         proj = {"w": ("embed", "heads", "kv"), "b": ("heads", "kv")}

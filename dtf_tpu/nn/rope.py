@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE).
+
+Not in the reference (no sequence models, SURVEY.md §5.7); extends the GPT
+family to LLaMA-style architectures (RoPE + GQA + SwiGLU, models/gpt.py).
+
+Split-half convention (rotate the first half of the head dim against the
+second): out = [x1*cos - x2*sin, x1*sin + x2*cos].  Angles in fp32
+regardless of activation dtype — bf16 position angles visibly degrade long
+sequences.  TPU note: this is pure elementwise work that XLA fuses into the
+surrounding projections; no custom kernel is warranted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, head_dim: int,
+                theta: float = 10000.0) -> tuple:
+    """cos/sin tables for ``positions`` (any shape) -> each
+    ``positions.shape + (head_dim // 2,)``, fp32."""
+    half = head_dim // 2
+    inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotate q or k.  x: (B, T, H, D) with D even; positions: (T,) token
+    indices (shared across the batch).  Returns x's dtype."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {d}")
+    cos, sin = rope_angles(positions, d, theta)       # (T, D/2)
+    cos = cos[None, :, None, :]                       # (1, T, 1, D/2)
+    sin = sin[None, :, None, :]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)

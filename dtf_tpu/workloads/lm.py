@@ -28,8 +28,10 @@ def main(argv=None) -> int:
     from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
 
     parser = build_parser("dtf_tpu GPT causal-LM pretrain")
-    parser.add_argument("--preset", choices=["gpt2_small", "tiny"],
-                        default="gpt2_small")
+    parser.add_argument("--preset", choices=["gpt2_small", "llama", "tiny"],
+                        default="gpt2_small",
+                        help="llama = GPT-2-small scale with RoPE + GQA(4) "
+                             "+ SwiGLU")
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--seq_len", type=int, default=None)
     parser.add_argument("--bf16", action="store_true")
@@ -60,8 +62,9 @@ def main(argv=None) -> int:
         kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:
         kw["max_len"] = ns.seq_len
-    cfg = (GPTConfig.gpt2_small(**kw) if ns.preset == "gpt2_small"
-           else GPTConfig.tiny(**kw))
+    cfg = {"gpt2_small": GPTConfig.gpt2_small,
+           "llama": GPTConfig.llama_style,
+           "tiny": GPTConfig.tiny}[ns.preset](**kw)
     model = GPT(cfg)
 
     global_batch = global_batch_size(cluster, train_cfg)

@@ -1,0 +1,153 @@
+"""LLaMA-family options on the GPT model (RoPE + GQA + SwiGLU): rotation
+math, causality, KV-cache decode consistency with the parallel forward,
+cache-size reduction, and a train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.models.gpt import GPT, GPTConfig
+from dtf_tpu.nn.rope import apply_rope
+
+
+def llama_tiny(**kw):
+    d = dict(rope=True, num_kv_heads=2, mlp_act="swiglu")
+    d.update(kw)
+    return GPTConfig.tiny(**d)
+
+
+class TestRope:
+    def test_preserves_norm(self):
+        """Rotation is orthogonal: per-pair vector norms are unchanged."""
+        x = jax.random.normal(jax.random.key(0), (2, 16, 4, 8))
+        y = apply_rope(x, jnp.arange(16))
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+
+    def test_position_zero_is_identity(self):
+        x = jax.random.normal(jax.random.key(1), (1, 1, 2, 8))
+        np.testing.assert_allclose(apply_rope(x, jnp.zeros((1,), jnp.int32)),
+                                   x, atol=1e-6)
+
+    def test_relative_property(self):
+        """<rope(q,m), rope(k,n)> depends only on m-n: shifting both
+        positions by a constant leaves the dot product unchanged."""
+        q = jax.random.normal(jax.random.key(2), (1, 1, 1, 16))
+        k = jax.random.normal(jax.random.key(3), (1, 1, 1, 16))
+
+        def dot(m, n, shift):
+            qm = apply_rope(q, jnp.asarray([m + shift]))
+            kn = apply_rope(k, jnp.asarray([n + shift]))
+            return float(jnp.sum(qm * kn))
+
+        assert dot(7, 3, 0) == pytest.approx(dot(7, 3, 11), abs=1e-4)
+        assert dot(7, 3, 0) != pytest.approx(dot(8, 3, 0), abs=1e-4)
+
+    def test_odd_head_dim_raises(self):
+        with pytest.raises(ValueError, match="even"):
+            apply_rope(jnp.zeros((1, 2, 1, 7)), jnp.arange(2))
+
+
+class TestLlamaStyleModel:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return GPT(llama_tiny())
+
+    @pytest.fixture(scope="class")
+    def params(self, model):
+        return model.init(jax.random.key(0))
+
+    def test_no_position_table(self, params):
+        assert "pos" not in params
+
+    def test_causality(self, model, params):
+        rng = np.random.default_rng(0)
+        a = rng.integers(0, 128, (1, 16)).astype(np.int32)
+        b = a.copy()
+        b[0, 10:] = rng.integers(0, 128, 6)
+        la = model.apply(params, jnp.asarray(a))
+        lb = model.apply(params, jnp.asarray(b))
+        np.testing.assert_allclose(la[0, :10], lb[0, :10], atol=1e-5)
+        assert not np.allclose(la[0, 10:], lb[0, 10:])
+
+    def test_gqa_cache_is_smaller(self, model):
+        cache = model.init_cache(2)
+        # 2 KV heads instead of 4: half the MHA cache
+        assert cache["k"].shape[3] == 2
+        mha_cache = GPT(GPTConfig.tiny()).init_cache(2)
+        assert cache["k"].size == mha_cache["k"].size // 2
+
+    def test_greedy_decode_matches_parallel_forward(self, model, params):
+        """The KV-cache decode path (grouped attention + RoPE at dynamic
+        positions) must reproduce the parallel forward's argmax."""
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, 128, (2, 10)), jnp.int32)
+        out = model.generate(params, prompt, max_new_tokens=6,
+                             temperature=0.0)
+        assert out.shape == (2, 16)
+        np.testing.assert_array_equal(out[:, :10], prompt)
+        for t in range(10, 16):
+            logits = model.apply(params, out[:, :t])
+            np.testing.assert_array_equal(
+                np.asarray(jnp.argmax(logits[:, -1], -1), np.int32),
+                np.asarray(out[:, t]))
+
+    def test_trains(self, model, mesh8):
+        from dtf_tpu import optim
+        from dtf_tpu.data.datasets import synthetic_text
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+
+        opt = optim.adam(1e-3)
+        state = init_state(model, opt, seed=0, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False)
+        toks = synthetic_text(16, 32, 128, seed=1)
+        losses = []
+        for i in range(6):
+            state, m = step(state, put_global_batch(mesh8, toks),
+                            jax.random.key(i))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_swiglu_param_shapes(self, model, params):
+        # gate and up are separate column-parallel projections (TP-local
+        # elementwise product), each (dim, mlp_dim)
+        assert params["layers"]["fc1"]["w"].shape == (2, 32, 64)
+        assert params["layers"]["fc_gate"]["w"].shape == (2, 32, 64)
+        assert params["layers"]["fc2"]["w"].shape == (2, 64, 32)
+
+    def test_tensor_parallel_train_step(self):
+        """The llama-style block under a data x tensor mesh: one sharded
+        train step (gate/up column-parallel, GQA heads sharded)."""
+        from dtf_tpu import optim
+        from dtf_tpu.data.datasets import synthetic_text
+        from dtf_tpu.parallel import sharding as sh
+        from dtf_tpu.parallel.mesh import make_mesh
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+
+        mesh = make_mesh("data=4,tensor=2")
+        model = GPT(llama_tiny())
+        shardings = sh.apply_rules(model.axes(), mesh)
+        opt = optim.adam(1e-3)
+        state = init_state(model, opt, seed=0, mesh=mesh,
+                           param_shardings=shardings)
+        step = make_train_step(model.loss, opt, mesh, donate=False)
+        toks = synthetic_text(8, 32, 128, seed=2)
+        state, m = step(state, put_global_batch(mesh, toks),
+                        jax.random.key(0))
+        assert np.isfinite(float(m["loss"]))
+        assert "tensor" in str(state["params"]["layers"]["fc_gate"]["w"]
+                               .sharding.spec)
+
+    def test_remat_matches(self):
+        ma = GPT(llama_tiny())
+        mb = GPT(llama_tiny(remat=True))
+        params = ma.init(jax.random.key(1))
+        toks = jnp.asarray(
+            np.random.default_rng(2).integers(0, 128, (2, 16)), jnp.int32)
+        la, _ = ma.loss(params, toks)
+        lb, _ = mb.loss(params, toks)
+        assert float(la) == pytest.approx(float(lb), abs=1e-6)
