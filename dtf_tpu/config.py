@@ -90,6 +90,9 @@ class TrainConfig:
 
     batch_size: int = 100             # per-step GLOBAL batch (see note below)
     learning_rate: float = 0.0005
+    # Optimizer for the pretrain-benchmark workloads (mnist keeps the
+    # reference's SGD); valid names are optim.BY_NAME's keys.
+    optimizer: str = "adam"
     epochs: int = 20
     log_frequency: int = 100
     seed: int = 1

@@ -31,6 +31,16 @@ class Optimizer(NamedTuple):
     update: Callable[[Any, Any, Any], tuple]   # (grads, state, params) -> (updates, state)
 
 
+class _Pair:
+    """(update, slot) carrier that is deliberately NOT a pytree node, so
+    tree_map treats it as a leaf when unzipping adafactor's results."""
+
+    __slots__ = ("u", "slot")
+
+    def __init__(self, u, slot):
+        self.u, self.slot = u, slot
+
+
 def apply_updates(params: Any, updates: Any) -> Any:
     return jax.tree_util.tree_map(lambda p, u: (p + u).astype(p.dtype), params, updates)
 
@@ -104,6 +114,107 @@ def adamw(lr, weight_decay: float = 0.01, **kw) -> Optimizer:
     return adam(lr, weight_decay=weight_decay, **kw)
 
 
+def adafactor(lr: "float | Callable" = 1e-2, eps: float = 1e-30,
+              clip_threshold: float = 1.0, decay_rate: float = 0.8,
+              min_dim_size_to_factor: int = 128) -> Optimizer:
+    """Adafactor (Shazeer & Stern 2018) — the TPU-classic memory-efficient
+    optimizer (T5/PaLM lineage): for matrices, the second moment is stored
+    FACTORED as one row vector + one column vector (O(n+m) state instead of
+    Adam's O(nm) ``v``), reconstructed as the rank-1 outer product scaled
+    by the row mean.  Vectors/scalars and small matrices keep the full
+    second moment.  No first moment at all.
+
+    State per (n, m) matrix: ``vr`` (n,), ``vc`` (m,) — with FSDP sharding
+    rules the factored state shrinks optimizer HBM by ~mlp_dim/2 per dense
+    layer.  Update clipping by RMS (``clip_threshold``) replaces momentum
+    for stability; ``decay_rate`` anneals beta2 as 1 - step^-0.8 per the
+    paper.
+    """
+
+    def factored(p) -> bool:
+        return (p.ndim >= 2
+                and p.shape[-1] >= min_dim_size_to_factor
+                and p.shape[-2] >= min_dim_size_to_factor)
+
+    def init(params):
+        def per_leaf(p):
+            if factored(p):
+                return {"vr": jnp.zeros(p.shape[:-1], jnp.float32),
+                        "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:],
+                                        jnp.float32)}
+            return {"v": jnp.zeros(p.shape, jnp.float32)}
+        return {"slots": jax.tree_util.tree_map(per_leaf, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        beta2 = 1.0 - t ** (-decay_rate)
+        lr_t = lr(step) if callable(lr) else lr
+
+        def per_leaf(g, slot):
+            g = g.astype(jnp.float32)
+            g2 = jnp.square(g) + eps
+            if "vr" in slot:
+                vr = beta2 * slot["vr"] + (1 - beta2) * jnp.mean(g2, axis=-1)
+                vc = beta2 * slot["vc"] + (1 - beta2) * jnp.mean(g2, axis=-2)
+                # rank-1 reconstruction: v ~= vr vc^T / mean(vr)
+                denom = jnp.maximum(jnp.mean(vr, axis=-1, keepdims=True),
+                                    eps)
+                rsqrt_v = (jax.lax.rsqrt(vr / denom)[..., None]
+                           * jax.lax.rsqrt(vc)[..., None, :])
+                u = g * rsqrt_v
+                new = {"vr": vr, "vc": vc}
+            else:
+                v = beta2 * slot["v"] + (1 - beta2) * g2
+                u = g * jax.lax.rsqrt(v)
+                new = {"v": v}
+            # update clipping: cap the RMS of the scaled update at
+            # clip_threshold (the paper's momentum-free stabilizer)
+            rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms / clip_threshold)
+            return -lr_t * u, new
+
+        # tree_map flattens up to the grad leaves, handing per_leaf each
+        # grad array with its (deeper) slot subtree.  Results ride in
+        # _Pair, which is NOT a registered pytree node, so the unzip
+        # cannot confuse a tuple/list container inside the grads tree for
+        # a result pair.
+        flat = jax.tree_util.tree_map(
+            lambda g, s: _Pair(*per_leaf(g, s)), grads, state["slots"])
+        updates = jax.tree_util.tree_map(lambda pr: pr.u, flat)
+        slots = jax.tree_util.tree_map(lambda pr: pr.slot, flat)
+        return updates, {"slots": slots, "step": step}
+
+    return Optimizer(init, update)
+
+
+def lamb(lr: "float | Callable", b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-6, weight_decay: float = 0.01) -> Optimizer:
+    """LAMB (You et al. 2020): Adam with per-layer trust-ratio scaling —
+    the large-batch BERT optimizer (the BASELINE.json BERT config's path
+    to big global batches on wide meshes)."""
+    inner = adam(1.0, b1=b1, b2=b2, eps=eps)   # raw Adam direction
+
+    def update(grads, state, params):
+        dirs, state = inner.update(grads, state, None)
+        lr_t = lr(state["step"]) if callable(lr) else lr
+
+        def per_leaf(d, p):
+            # adamized direction (+ decoupled weight decay), then scale by
+            # ||p|| / ||update|| per parameter tensor
+            u = -d + weight_decay * p.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+            un = jnp.sqrt(jnp.sum(jnp.square(u)))
+            trust = jnp.where((pn > 0) & (un > 0), pn / jnp.maximum(un, eps),
+                              1.0)
+            return -lr_t * trust * u
+
+        return jax.tree_util.tree_map(per_leaf, dirs, params), state
+
+    return Optimizer(inner.init, update)
+
+
 def clip_by_global_norm(opt: Optimizer, max_norm: float) -> Optimizer:
     """Wrap an optimizer with global-norm gradient clipping."""
 
@@ -116,6 +227,21 @@ def clip_by_global_norm(opt: Optimizer, max_norm: float) -> Optimizer:
         return opt.update(grads, state, params)
 
     return Optimizer(opt.init, update)
+
+
+#: Single source of the optimizer-name registry (the --optimizer CLI flag
+#: and anything else resolving optimizers by name go through get()).
+BY_NAME = {"sgd": sgd, "momentum": momentum, "adam": adam, "adamw": adamw,
+           "adafactor": adafactor, "lamb": lamb}
+
+
+def get(name: str) -> Callable[..., Optimizer]:
+    """Optimizer constructor by name; raises with the valid names."""
+    try:
+        return BY_NAME[name]
+    except KeyError:
+        raise ValueError(f"--optimizer must be one of {sorted(BY_NAME)}, "
+                         f"got {name!r}") from None
 
 
 def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,

@@ -48,7 +48,7 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
              else sh.DEFAULT_RULES)
     shardings = sh.apply_rules(model.axes(), mesh, rules)
-    opt = optim.adam(train_cfg.learning_rate)
+    opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
     state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh,
                        param_shardings=shardings)
     step_fn = make_train_step(model.loss, opt, mesh,

@@ -1,0 +1,146 @@
+"""Optimizers beyond the reference's plain SGD: adafactor's factored
+second-moment state (memory) and convergence, LAMB's trust-ratio updates,
+and both inside a sharded train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu import optim
+
+
+def quad_grads(params):
+    """Gradient of 0.5*||p - target||^2 per leaf (target = 3)."""
+    return jax.tree_util.tree_map(lambda p: p - 3.0, params)
+
+
+class TestAdafactor:
+    def test_factored_state_is_small(self):
+        params = {"w": jnp.zeros((256, 512)), "b": jnp.zeros((512,))}
+        opt = optim.adafactor(1e-2)
+        state = opt.init(params)
+        slot_w = state["slots"]["w"]
+        assert set(slot_w) == {"vr", "vc"}
+        assert slot_w["vr"].shape == (256,)
+        assert slot_w["vc"].shape == (512,)
+        # vs Adam's v: 256*512 floats -> 256+512
+        assert (slot_w["vr"].size + slot_w["vc"].size) == 768
+        # small/1-D tensors keep the full second moment
+        assert state["slots"]["b"]["v"].shape == (512,)
+
+    def test_small_matrix_unfactored(self):
+        params = {"w": jnp.zeros((16, 16))}
+        state = optim.adafactor(1e-2).init(params)
+        assert "v" in state["slots"]["w"]
+
+    def test_stacked_layer_dims_factor_trailing_two(self):
+        params = {"w": jnp.zeros((4, 256, 512))}      # (layers, in, out)
+        state = optim.adafactor(1e-2).init(params)
+        assert state["slots"]["w"]["vr"].shape == (4, 256)
+        assert state["slots"]["w"]["vc"].shape == (4, 512)
+
+    def test_converges_on_quadratic(self):
+        params = {"w": jnp.full((256, 256), 10.0), "b": jnp.zeros((8,))}
+        opt = optim.adafactor(0.3)
+        state = opt.init(params)
+        for _ in range(60):
+            upd, state = opt.update(quad_grads(params), state, params)
+            params = optim.apply_updates(params, upd)
+        err = float(jnp.max(jnp.abs(params["w"] - 3.0)))
+        assert err < 0.5, err
+        assert float(jnp.max(jnp.abs(params["b"] - 3.0))) < 0.5
+
+    def test_trains_mlp(self, mesh8):
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.adafactor(1e-2)
+        state = init_state(model, opt, seed=1, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False)
+        rng = np.random.default_rng(0)
+        batch = put_global_batch(
+            mesh8, (rng.random((64, 784), np.float32),
+                    np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]))
+        losses = []
+        for i in range(10):
+            state, m = step(state, batch, jax.random.key(i))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+
+class TestLamb:
+    def test_trust_ratio_scales_per_tensor(self):
+        """Layers with different weight norms get different effective step
+        sizes (that is the point of LAMB)."""
+        params = {"big": jnp.full((32, 32), 10.0),
+                  "small": jnp.full((32, 32), 0.1)}
+        opt = optim.lamb(1e-2, weight_decay=0.0)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        upd, _ = opt.update(grads, state, params)
+        step_big = float(jnp.mean(jnp.abs(upd["big"])))
+        step_small = float(jnp.mean(jnp.abs(upd["small"])))
+        assert step_big > step_small * 10     # ~ ||p|| ratio (100x)
+
+    def test_converges_on_quadratic(self):
+        params = {"w": jnp.full((64, 64), 10.0)}
+        opt = optim.lamb(0.05, weight_decay=0.0)
+        state = opt.init(params)
+        for _ in range(200):
+            upd, state = opt.update(quad_grads(params), state, params)
+            params = optim.apply_updates(params, upd)
+        assert float(jnp.max(jnp.abs(params["w"] - 3.0))) < 0.5
+
+    def test_trains_bert_tiny(self, mesh8):
+        from dtf_tpu.data.datasets import synthetic_text
+        from dtf_tpu.models.bert import BertConfig, BertMLM
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+        model = BertMLM(BertConfig.tiny())
+        opt = optim.lamb(1e-2, weight_decay=0.0)
+        state = init_state(model, opt, seed=0, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False)
+        toks = synthetic_text(16, 32, 128, seed=1)
+        losses = []
+        for _ in range(10):
+            # fixed rng: same MLM mask each step, so the descent signal
+            # isn't buried in per-step masking noise
+            state, m = step(state, put_global_batch(mesh8, toks),
+                            jax.random.key(0))
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+
+class TestSchedulesStillCompose:
+    def test_adafactor_with_schedule(self):
+        sched = optim.warmup_cosine(0.1, 5, 50)
+        params = {"w": jnp.full((256, 256), 10.0)}
+        opt = optim.adafactor(sched)
+        state = opt.init(params)
+        upd, state = opt.update(quad_grads(params), state, params)
+        assert np.isfinite(float(jnp.sum(upd["w"])))
+
+
+class TestTupleContainers:
+    def test_adafactor_handles_tuple_param_trees(self):
+        """Tuple containers in the params pytree must not be mistaken for
+        internal (update, slot) pairs during the unzip."""
+        params = ({"w": jnp.full((256, 256), 10.0)},
+                  {"w": jnp.full((256, 256), 10.0)})
+        opt = optim.adafactor(0.3)
+        state = opt.init(params)
+        upd, state = opt.update(quad_grads(params), state, params)
+        assert isinstance(upd, tuple) and len(upd) == 2
+        assert upd[0]["w"].shape == (256, 256)
+        assert upd[1]["w"].shape == (256, 256)
+        new = optim.apply_updates(params, upd)   # structure must match
+        assert new[1]["w"].shape == (256, 256)
+
+
+class TestRegistry:
+    def test_get_known_and_unknown(self):
+        assert optim.get("adafactor") is optim.adafactor
+        with pytest.raises(ValueError, match="adafactor.*nadam"):
+            optim.get("nadam")
