@@ -290,6 +290,48 @@ class GPT(Module):
         return {"k": jnp.zeros(shape, cfg.dtype),
                 "v": jnp.zeros(shape, cfg.dtype)}
 
+    def _prefill_cache(self, params, prompt):
+        """One batched forward over the prompt -> (filled cache, logits at
+        the last prompt position).  The prompt is padded to a multiple of 8
+        so the flash kernel always has a valid block size (causal
+        attention: real positions never see the zero-padded tail, whose
+        K/V and outputs are discarded)."""
+        b, p_len = prompt.shape
+        p_pad = -(-p_len // 8) * 8
+        padded = (prompt if p_pad == p_len else jnp.pad(
+            prompt, ((0, 0), (0, p_pad - p_len))))
+        x = self._embed(params, padded, jnp.arange(p_pad))
+
+        def prefill_layer(carry_x, lp):
+            y, k, v = self.block.prefill(lp, carry_x)
+            return y, (k, v)
+
+        x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
+        cache = self.init_cache(b)          # (L, B, Tmax, KVH, Dh)
+        cache = {"k": cache["k"].at[:, :, :p_len].set(
+                     ks[:, :, :p_len].astype(cache["k"].dtype)),
+                 "v": cache["v"].at[:, :, :p_len].set(
+                     vs[:, :, :p_len].astype(cache["v"].dtype))}
+        x = self.ln_f.apply(params["ln_f"], x)
+        return cache, self.tok.attend(params["tok"], x)[:, p_len - 1, :]
+
+    def _decode_logits(self, params, cache, tok, pos):
+        """One decode step: token (B', 1) at position ``pos`` through the
+        layer stack with the KV cache -> (logits (B', V), new cache)."""
+        x = self._embed(params, tok, pos[None])
+
+        def layer_scan(carry_x, inputs):
+            lp, ck, cv = inputs
+            y, nc = self.block.decode_step(lp, carry_x,
+                                           {"k": ck, "v": cv}, pos)
+            return y, (nc["k"], nc["v"])
+
+        x, (new_k, new_v) = lax.scan(
+            layer_scan, x, (params["layers"], cache["k"], cache["v"]))
+        x = self.ln_f.apply(params["ln_f"], x)
+        logits = self.tok.attend(params["tok"], x)[:, 0, :]
+        return logits, {"k": new_k, "v": new_v}
+
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, rng=None):
@@ -321,27 +363,7 @@ class GPT(Module):
         if rng is None:
             rng = jax.random.key(0)
 
-        # ---- prefill: one batched forward over the prompt fills the cache.
-        # Pad the prompt to a multiple of 8 so the flash kernel always has
-        # a valid block size (causal attention: real positions never see
-        # the zero-padded tail, whose K/V and outputs are discarded).
-        p_pad = -(-p_len // 8) * 8
-        padded = (prompt if p_pad == p_len else jnp.pad(
-            prompt, ((0, 0), (0, p_pad - p_len))))
-        x = self._embed(params, padded, jnp.arange(p_pad))
-
-        def prefill_layer(carry_x, lp):
-            y, k, v = self.block.prefill(lp, carry_x)
-            return y, (k, v)
-
-        x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
-        cache = self.init_cache(b)          # (L, B, Tmax, KVH, Dh)
-        cache = {"k": cache["k"].at[:, :, :p_len].set(
-                     ks[:, :, :p_len].astype(cache["k"].dtype)),
-                 "v": cache["v"].at[:, :, :p_len].set(
-                     vs[:, :, :p_len].astype(cache["v"].dtype))}
-        x = self.ln_f.apply(params["ln_f"], x)
-        logits = self.tok.attend(params["tok"], x)[:, p_len - 1, :]  # (B, V)
+        cache, logits = self._prefill_cache(params, prompt)
         rng, sub = jax.random.split(rng)
         first = sample_token(sub, logits, temperature=temperature,
                              top_k=top_k, top_p=top_p)
@@ -355,21 +377,7 @@ class GPT(Module):
         def step(carry, pos):
             out, cache, rng = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
-            x = self._embed(params, tok, pos[None])
-
-            # thread the per-layer caches through a scan over layers
-            def layer_scan(carry_x, inputs):
-                lp, ck, cv = inputs
-                y, nc = self.block.decode_step(lp, carry_x,
-                                               {"k": ck, "v": cv}, pos)
-                return y, (nc["k"], nc["v"])
-
-            x, (new_k, new_v) = lax.scan(
-                layer_scan, x, (params["layers"], cache["k"], cache["v"]))
-            cache = {"k": new_k, "v": new_v}
-            x = self.ln_f.apply(params["ln_f"], x)
-            logits = self.tok.attend(params["tok"], x)[:, 0, :]  # (B, V)
-
+            logits, cache = self._decode_logits(params, cache, tok, pos)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
@@ -379,3 +387,95 @@ class GPT(Module):
         (out, _, _), _ = lax.scan(step, (out, cache, rng),
                                   jnp.arange(p_len, total - 1))
         return out
+
+    def beam_search(self, params, prompt, max_new_tokens: int, *,
+                    beam_size: int = 4, eos_id: Optional[int] = None,
+                    length_penalty: float = 0.0):
+        """Deterministic beam decoding.  prompt (B, P) int32 ->
+        (sequences (B, W, P+max_new), scores (B, W)), beams sorted best
+        first.
+
+        Same two-phase structure as :meth:`generate` (batched MXU prefill,
+        then a ``lax.scan`` decode) with W beams folded into the batch dim;
+        between steps the top-W of the W·V continuations are kept and the
+        KV cache rows are reordered to follow their beams.  With ``eos_id``
+        a finished beam is frozen (its only zero-cost continuation is
+        ``eos_id``, so its score stops changing); ``length_penalty`` > 0
+        applies the GNMT ``((5+len)/6)^alpha`` normalization to the final
+        ranking.
+        """
+        cfg = self.cfg
+        b, p_len = prompt.shape
+        w = beam_size
+        total = p_len + max_new_tokens
+        if total > cfg.max_len:
+            raise ValueError(f"prompt+new = {total} exceeds max_len "
+                             f"{cfg.max_len}")
+        if max_new_tokens == 0:
+            return (jnp.repeat(prompt[:, None], w, axis=1),
+                    jnp.zeros((b, w), jnp.float32))
+        v_size = cfg.vocab_size
+
+        cache, logits = self._prefill_cache(params, prompt)
+        logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        scores, first = lax.top_k(logp0, w)                  # (B, W)
+
+        out = jnp.zeros((b, w, total), jnp.int32)
+        out = out.at[:, :, :p_len].set(prompt[:, None])
+        out = out.at[:, :, p_len].set(first)
+        alive = (first != eos_id) if eos_id is not None else \
+            jnp.ones((b, w), bool)
+
+        # all W beams share the prompt: tile the cache into the batch dim
+        def tile(c):
+            return jnp.repeat(c[:, :, None], w, axis=2).reshape(
+                c.shape[0], b * w, *c.shape[2:])
+        cache = jax.tree_util.tree_map(tile, cache)
+
+        def reorder_cache(c, beam_idx):
+            """Gather cache rows (L, B*W, ...) to follow the chosen beams."""
+            cv = c.reshape(c.shape[0], b, w, *c.shape[2:])
+            idx = beam_idx.reshape(1, b, w, *([1] * (cv.ndim - 3)))
+            return jnp.take_along_axis(cv, idx, axis=2).reshape(c.shape)
+
+        def step(carry, pos):
+            out, cache, scores, alive = carry
+            tok = lax.dynamic_slice(out, (0, 0, pos),
+                                    (b, w, 1)).reshape(b * w, 1)
+            logits, cache = self._decode_logits(params, cache, tok, pos)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = logp.reshape(b, w, v_size)
+            if eos_id is not None:
+                # finished beams continue only with eos at zero cost
+                frozen = jnp.full((v_size,), -1e30,
+                                  jnp.float32).at[eos_id].set(0.0)
+                logp = jnp.where(alive[..., None], logp, frozen)
+            flat = (scores[..., None] + logp).reshape(b, w * v_size)
+            scores, idx = lax.top_k(flat, w)                 # (B, W)
+            beam_idx, tok_idx = idx // v_size, idx % v_size
+            out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
+            out = lax.dynamic_update_slice(
+                out, tok_idx[:, :, None].astype(jnp.int32), (0, 0, pos + 1))
+            alive = jnp.take_along_axis(alive, beam_idx, axis=1)
+            if eos_id is not None:
+                alive = alive & (tok_idx != eos_id)
+            cache = jax.tree_util.tree_map(
+                lambda c: reorder_cache(c, beam_idx), cache)
+            return (out, cache, scores, alive), None
+
+        (out, _, scores, _), _ = lax.scan(
+            step, (out, cache, scores, alive), jnp.arange(p_len, total - 1))
+
+        if eos_id is not None and length_penalty > 0:
+            gen = out[:, :, p_len:]
+            has_eos = jnp.any(gen == eos_id, axis=-1)
+            first_eos = jnp.argmax(gen == eos_id, axis=-1)
+            lengths = jnp.where(has_eos, first_eos + 1,
+                                max_new_tokens).astype(jnp.float32)
+            norm = ((5.0 + lengths) / 6.0) ** length_penalty
+            ranked = scores / norm
+        else:
+            ranked = scores
+        order = jnp.argsort(-ranked, axis=-1)
+        out = jnp.take_along_axis(out, order[:, :, None], axis=1)
+        return out, jnp.take_along_axis(ranked, order, axis=1)

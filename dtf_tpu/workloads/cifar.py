@@ -39,9 +39,12 @@ def main(argv=None) -> int:
 
     model = ResNet(ResNetConfig.resnet50() if ns.arch == "resnet50"
                    else ResNetConfig.tiny())
-    trainer = Trainer(cluster, model,
-                      optim.momentum(train_cfg.learning_rate, beta=ns.momentum),
-                      train_cfg)
+    # --optimizer overrides this workload's default (SGD+momentum).
+    if ns.optimizer:
+        opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
+    else:
+        opt = optim.momentum(train_cfg.learning_rate, beta=ns.momentum)
+    trainer = Trainer(cluster, model, opt, train_cfg)
     trainer.fit(splits)
     if cluster.is_coordinator:
         print("done")

@@ -49,6 +49,9 @@ def main(argv=None) -> int:
                         help="keep only the k most likely tokens (0 = all)")
     parser.add_argument("--top_p", type=float, default=1.0,
                         help="nucleus sampling mass (1.0 = all)")
+    parser.add_argument("--beam_size", type=int, default=0,
+                        help=">1: deterministic beam search instead of "
+                             "sampling")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -80,9 +83,13 @@ def main(argv=None) -> int:
         import jax
 
         prompt = jnp.asarray(toks[:1, :8])
-        gen = jax.jit(lambda p, pr, key: model.generate(
-            p, pr, ns.generate, temperature=ns.temperature, top_k=ns.top_k,
-            top_p=ns.top_p, rng=key))
+        if ns.beam_size > 1:
+            gen = jax.jit(lambda p, pr, key: model.beam_search(
+                p, pr, ns.generate, beam_size=ns.beam_size)[0][:, 0])
+        else:
+            gen = jax.jit(lambda p, pr, key: model.generate(
+                p, pr, ns.generate, temperature=ns.temperature,
+                top_k=ns.top_k, top_p=ns.top_p, rng=key))
         t0 = time.perf_counter()
         out = gen(state["params"], prompt, jax.random.key(0))
         block(out)

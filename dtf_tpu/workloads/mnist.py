@@ -50,8 +50,10 @@ def main(argv=None) -> int:
               "synthetic data (zero-egress environment)")
 
     model = MnistMLP()
-    trainer = Trainer(cluster, model, optim.sgd(train_cfg.learning_rate),
-                      train_cfg, mode=ns.mode)
+    # --optimizer overrides the reference's SGD (tf_distributed.py:73).
+    opt = (optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
+           if ns.optimizer else optim.sgd(train_cfg.learning_rate))
+    trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode)
     result = trainer.fit(splits)
     if cluster.is_coordinator:
         print("done")   # tf_distributed.py:131
