@@ -64,6 +64,73 @@ def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) ->
                           tiled=True)
 
 
+def _quantize_int8(v: jax.Array) -> tuple:
+    """Symmetric per-chunk int8 quantization: (q int8, scale f32)."""
+    scale = jnp.max(jnp.abs(v)) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(v / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale
+
+
+def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
+    """Mean-all-reduce with an int8 wire format (EQuARX-style, cf.
+    PAPERS.md "Efficient Quantized AllReduce in XLA"): a hand-scheduled
+    ring — reduce-scatter then all-gather over ``ppermute`` — where every
+    hop ships int8 payloads + one f32 scale instead of f32 tensors, ~4x
+    less ICI traffic for bandwidth-bound gradient syncs.
+
+    Per-device code (call inside ``shard_map``).  Deterministic and
+    identical on every device (the gather phase distributes each reduced
+    chunk through the same quantize/dequantize path to all ranks, so no
+    rank-dependent rounding survives).  Quantization noise: one
+    round-to-nearest per reduce hop (n-1 of them) plus one on the gather —
+    relative error ~1e-2 on typical gradients; use exact ``pmean`` when
+    that matters more than bandwidth.
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    me = lax.axis_index(axis)
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    m = -(-flat.size // n)
+    buf = jnp.pad(flat, (0, n * m - flat.size)).reshape(n, m)
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter: after n-1 hops, rank i owns the full sum of chunk
+    # (i+1) mod n.  Each hop ships the partial sum quantized.
+    for s in range(n - 1):
+        send_idx = (me - s) % n
+        recv_idx = (me - s - 1) % n
+        q, scale = _quantize_int8(jnp.take(buf, send_idx, axis=0))
+        q = lax.ppermute(q, axis, fwd)
+        scale = lax.ppermute(scale, axis, fwd)
+        buf = buf.at[recv_idx].add(_dequantize_int8(q, scale))
+
+    # broadcast each finished chunk through ONE shared quantization so all
+    # ranks (including the owner) hold bitwise-identical values.
+    own_idx = (me + 1) % n
+    q, scale = _quantize_int8(jnp.take(buf, own_idx, axis=0))
+    buf = buf.at[own_idx].set(_dequantize_int8(q, scale))
+
+    # all-gather: circulate the quantized chunks n-1 hops — each rank just
+    # forwards the (q, scale) it received last hop, nothing is re-read
+    # from buf on the send side.
+    for s in range(n - 1):
+        recv_idx = (me - s) % n
+        q = lax.ppermute(q, axis, fwd)
+        scale = lax.ppermute(scale, axis, fwd)
+        buf = buf.at[recv_idx].set(_dequantize_int8(q, scale))
+
+    out = buf.reshape(-1)[: flat.size].reshape(shape) / n
+    return out.astype(dtype)
+
+
 def axis_index(axis: str) -> jax.Array:
     return lax.axis_index(axis)
 

@@ -89,7 +89,8 @@ def put_global_batch(mesh: Mesh, batch: Any) -> Any:
 def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     mesh: Mesh, mode: str = "implicit",
                     donate: bool = True, stateful: bool = False,
-                    grad_accum: int = 1) -> Callable:
+                    grad_accum: int = 1,
+                    grad_compression: Optional[str] = None) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
@@ -116,6 +117,18 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     non-sync-BN data-parallel semantics) and the running stats are pmean'd
     across shards.  The two converge as per-shard batch grows.
     """
+
+    if grad_compression not in (None, "int8"):
+        raise ValueError(f"grad_compression must be None or 'int8', got "
+                         f"{grad_compression!r}")
+    if grad_compression and mode != "explicit":
+        raise ValueError("grad_compression requires mode='explicit' (the "
+                         "quantized ring is a hand-scheduled collective; "
+                         "GSPMD owns the collectives in implicit mode)")
+    if grad_compression and len(sh.data_axes(mesh)) != 1:
+        raise ValueError(
+            f"grad_compression='int8' runs its ring over a single data "
+            f"axis; mesh has data axes {sh.data_axes(mesh)}")
 
     def value_and_grads(params, model_state, batch, rng):
         if stateful:
@@ -200,7 +213,18 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             def sync(grads, loss, aux, new_ms):
                 pmean = lambda t: jax.tree_util.tree_map(
                     lambda v: lax.pmean(v, data_axes), t)
-                return (pmean(grads), pmean(loss), pmean(aux),
+                if grad_compression == "int8":
+                    # int8-wire ring all-reduce for the bandwidth-heavy
+                    # gradients; scalars stay exact.  (Single data axis
+                    # validated at make_train_step entry.)
+                    from dtf_tpu.parallel.collectives import (
+                        quantized_ring_all_reduce_mean)
+                    g = jax.tree_util.tree_map(
+                        lambda v: quantized_ring_all_reduce_mean(
+                            v, data_axes[0]), grads)
+                else:
+                    g = pmean(grads)
+                return (g, pmean(loss), pmean(aux),
                         pmean(new_ms) if new_ms is not None else None)
 
             return grads_and_update(state, batch, rng, sync)
@@ -272,6 +296,7 @@ class Trainer:
     optimizer: optim_lib.Optimizer
     cfg: TrainConfig
     mode: str = "implicit"
+    grad_compression: Optional[str] = None   # "int8" (explicit mode only)
     logger: Optional[MetricLogger] = None
 
     def __post_init__(self):
@@ -281,7 +306,8 @@ class Trainer:
         stateful = hasattr(self.model, "init_model_state")
         self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
                                        mode=self.mode, stateful=stateful,
-                                       grad_accum=self.cfg.grad_accum)
+                                       grad_accum=self.cfg.grad_accum,
+                                       grad_compression=self.grad_compression)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
         self.ckpt = None

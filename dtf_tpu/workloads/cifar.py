@@ -39,8 +39,9 @@ def main(argv=None) -> int:
 
     model = ResNet(ResNetConfig.resnet50() if ns.arch == "resnet50"
                    else ResNetConfig.tiny())
-    # --optimizer overrides this workload's default (SGD+momentum).
-    if ns.optimizer:
+    # --optimizer overrides this workload's default (SGD+momentum); the
+    # momentum path always honors --momentum.
+    if ns.optimizer and ns.optimizer != "momentum":
         opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
     else:
         opt = optim.momentum(train_cfg.learning_rate, beta=ns.momentum)

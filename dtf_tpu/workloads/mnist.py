@@ -33,6 +33,9 @@ def main(argv=None) -> int:
     parser.add_argument("--native_loader", action="store_true",
                         help="serve train batches through the C++ "
                              "prefetching loader (dtf_tpu/native)")
+    parser.add_argument("--grad_compression", choices=["int8"], default=None,
+                        help="int8-wire ring all-reduce for gradient sync "
+                             "(requires --mode explicit)")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -53,7 +56,8 @@ def main(argv=None) -> int:
     # --optimizer overrides the reference's SGD (tf_distributed.py:73).
     opt = (optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
            if ns.optimizer else optim.sgd(train_cfg.learning_rate))
-    trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode)
+    trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode,
+                      grad_compression=ns.grad_compression)
     result = trainer.fit(splits)
     if cluster.is_coordinator:
         print("done")   # tf_distributed.py:131
