@@ -1,0 +1,353 @@
+"""Encoder-decoder (T5-style) sequence-to-sequence transformer.
+
+Third transformer family beside BERT (encoder-only) and GPT (decoder-only);
+not in the reference (no sequence models at all, SURVEY.md §5.7).  The
+decoder adds the one genuinely new mechanism: **cross-attention** over the
+encoder output (q from the decoder stream, k/v from the context — the
+``kv_input`` seam on :class:`dtf_tpu.nn.attention.MultiHeadAttention`).
+
+TPU-first structure mirrors models/gpt.py: pre-LN blocks scanned over
+stacked per-layer params, static shapes, KV-cache greedy/sampled decoding
+where the encoder runs ONCE and each decoder layer's cross K/V are
+projected ONCE (generation cost is decoder-side only).  Architectural
+deltas from published T5 (documented, not accidental): LayerNorm instead
+of RMSNorm, learned absolute positions instead of relative position
+buckets, gelu FFN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.nn.attention import MultiHeadAttention, causal_mask
+from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
+
+NEG_BIG = -1e30
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32000
+    dim: int = 512
+    enc_layers: int = 6
+    dec_layers: int = 6
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    max_src_len: int = 512
+    max_tgt_len: int = 512
+    dtype: Any = jnp.float32
+    remat: bool = False
+    pad_id: int = 0           # also the loss mask
+    bos_id: int = 1           # decoder start token
+
+    @classmethod
+    def small(cls, **kw):
+        return cls(**kw)      # T5-small dims are the defaults above
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=64, dim=32, enc_layers=2, dec_layers=2,
+                 num_heads=4, mlp_dim=64, max_src_len=32, max_tgt_len=32)
+        d.update(kw)
+        return cls(**d)
+
+
+class _FFN(Module):
+    def __init__(self, cfg: T5Config):
+        self.ln = LayerNorm(cfg.dim)
+        self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
+                         axes_in="embed", axes_out="mlp")
+        self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
+                         axes_in="mlp", axes_out="embed")
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {"ln": self.ln.init(k1), "fc1": self.fc1.init(k2),
+                "fc2": self.fc2.init(k3)}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        h = self.ln.apply(params["ln"], x)
+        return x + self.fc2.apply(params["fc2"],
+                                  jax.nn.gelu(self.fc1.apply(params["fc1"],
+                                                             h)))
+
+    def axes(self):
+        return {"ln": self.ln.axes(), "fc1": self.fc1.axes(),
+                "fc2": self.fc2.axes()}
+
+
+class T5EncoderLayer(Module):
+    """Pre-LN bidirectional block: x + selfattn(ln(x)); FFN."""
+
+    def __init__(self, cfg: T5Config):
+        self.cfg = cfg
+        self.ln = LayerNorm(cfg.dim)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
+        self.ffn = _FFN(cfg)
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {"ln": self.ln.init(k1), "attn": self.attn.init(k2),
+                "ffn": self.ffn.init(k3)}
+
+    def apply(self, params, x, *, pad_mask=None, train=False, rng=None):
+        h = self.ln.apply(params["ln"], x)
+        x = x + self.attn.apply(params["attn"], h, mask=pad_mask)
+        return self.ffn.apply(params["ffn"], x)
+
+    def axes(self):
+        return {"ln": self.ln.axes(), "attn": self.attn.axes(),
+                "ffn": self.ffn.axes()}
+
+
+class T5DecoderLayer(Module):
+    """Pre-LN causal self-attention -> cross-attention -> FFN."""
+
+    def __init__(self, cfg: T5Config):
+        self.cfg = cfg
+        self.ln_self = LayerNorm(cfg.dim)
+        self.ln_cross = LayerNorm(cfg.dim)
+        self.self_attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
+        self.cross_attn = MultiHeadAttention(cfg.dim, cfg.num_heads,
+                                             cfg.dtype)
+        self.ffn = _FFN(cfg)
+
+    def init(self, key):
+        ks = jax.random.split(key, 5)
+        return {"ln_self": self.ln_self.init(ks[0]),
+                "self_attn": self.self_attn.init(ks[1]),
+                "ln_cross": self.ln_cross.init(ks[2]),
+                "cross_attn": self.cross_attn.init(ks[3]),
+                "ffn": self.ffn.init(ks[4])}
+
+    def apply(self, params, x, ctx, *, ctx_mask=None, train=False, rng=None):
+        t = x.shape[1]
+        h = self.ln_self.apply(params["ln_self"], x)
+        x = x + self.self_attn.apply(params["self_attn"], h,
+                                     mask=causal_mask(t))
+        h = self.ln_cross.apply(params["ln_cross"], x)
+        x = x + self.cross_attn.apply(params["cross_attn"], h, kv_input=ctx,
+                                      mask=ctx_mask)
+        return self.ffn.apply(params["ffn"], x)
+
+    def decode_step(self, params, x_t, cache, cross_k, cross_v, pos,
+                    ctx_mask=None):
+        """One token: causal self-attn over the KV cache + cross-attn over
+        the PRE-PROJECTED encoder K/V (computed once per generate call).
+        x_t (B, 1, D); cache {"k","v"} (B, Tmax, H, Dh); cross_k/v
+        (B, S, H, Dh)."""
+        p = params["self_attn"]
+        h = self.ln_self.apply(params["ln_self"], x_t)
+        q, k_t, v_t = self.self_attn.qkv(p, h)
+        cache_k = lax.dynamic_update_slice_in_dim(
+            cache["k"], k_t.astype(cache["k"].dtype), pos, axis=1)
+        cache_v = lax.dynamic_update_slice_in_dim(
+            cache["v"], v_t.astype(cache["v"].dtype), pos, axis=1)
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       cache_k.astype(jnp.float32)) * scale
+        visible = jnp.arange(cache_k.shape[1])[None, None, None, :] <= pos
+        s = jnp.where(visible, s, NEG_BIG)
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
+                         cache_v.astype(jnp.float32)).astype(x_t.dtype)
+        x_t = x_t + self.self_attn.out_proj(p, out)
+
+        pc = params["cross_attn"]
+        h = self.ln_cross.apply(params["ln_cross"], x_t)
+        qc = jnp.einsum("btd,dhk->bthk", h, pc["q"]["w"]) + pc["q"]["b"]
+        sc = jnp.einsum("bqhd,bkhd->bhqk", qc.astype(jnp.float32),
+                        cross_k.astype(jnp.float32)) * scale
+        if ctx_mask is not None:
+            sc = jnp.where(ctx_mask, sc, NEG_BIG)
+        outc = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, axis=-1),
+                          cross_v.astype(jnp.float32)).astype(x_t.dtype)
+        x_t = x_t + self.cross_attn.out_proj(pc, outc)
+        return self.ffn.apply(params["ffn"], x_t), {"k": cache_k,
+                                                    "v": cache_v}
+
+    def axes(self):
+        return {"ln_self": self.ln_self.axes(),
+                "self_attn": self.self_attn.axes(),
+                "ln_cross": self.ln_cross.axes(),
+                "cross_attn": self.cross_attn.axes(),
+                "ffn": self.ffn.axes()}
+
+
+@dataclasses.dataclass
+class T5(Module):
+    """Shared token embedding -> encoder stack -> decoder stack (causal +
+    cross) -> tied LM head."""
+
+    cfg: T5Config
+
+    def __post_init__(self):
+        cfg = self.cfg
+        self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
+        self.pos_enc = Embedding(cfg.max_src_len, cfg.dim, cfg.dtype)
+        self.pos_dec = Embedding(cfg.max_tgt_len, cfg.dim, cfg.dtype)
+        self.enc_layer = T5EncoderLayer(cfg)
+        self.dec_layer = T5DecoderLayer(cfg)
+        self.ln_enc = LayerNorm(cfg.dim)
+        self.ln_dec = LayerNorm(cfg.dim)
+
+    def init(self, key):
+        ks = jax.random.split(key, 7)
+        enc = jax.vmap(self.enc_layer.init)(
+            jax.random.split(ks[0], self.cfg.enc_layers))
+        dec = jax.vmap(self.dec_layer.init)(
+            jax.random.split(ks[1], self.cfg.dec_layers))
+        return {"tok": self.tok.init(ks[2]),
+                "pos_enc": self.pos_enc.init(ks[3]),
+                "pos_dec": self.pos_dec.init(ks[4]),
+                "enc_layers": enc, "dec_layers": dec,
+                "ln_enc": self.ln_enc.init(ks[5]),
+                "ln_dec": self.ln_dec.init(ks[6])}
+
+    def axes(self):
+        wrap = lambda ax_tree: jax.tree_util.tree_map(
+            lambda ax: (None, *ax), ax_tree,
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                a is None or isinstance(a, str) for a in x))
+        return {"tok": self.tok.axes(),
+                "pos_enc": {"table": (None, "embed")},
+                "pos_dec": {"table": (None, "embed")},
+                "enc_layers": wrap(self.enc_layer.axes()),
+                "dec_layers": wrap(self.dec_layer.axes()),
+                "ln_enc": self.ln_enc.axes(),
+                "ln_dec": self.ln_dec.axes()}
+
+    # --- forward ------------------------------------------------------
+
+    def _pad_mask(self, src):
+        """(B, S) -> broadcastable (B, 1, 1, S), True = attend."""
+        return (src != self.cfg.pad_id)[:, None, None, :]
+
+    def encode(self, params, src):
+        """src (B, S) int32 -> (hidden (B, S, D), attend-mask)."""
+        mask = self._pad_mask(src)
+        x = (self.tok.apply(params["tok"], src)
+             + self.pos_enc.apply(params["pos_enc"], jnp.arange(src.shape[1])))
+
+        fn = self.enc_layer.apply
+        if self.cfg.remat:
+            fn = jax.checkpoint(fn)
+
+        def body(carry, lp):
+            return fn(lp, carry, pad_mask=mask), None
+
+        x, _ = lax.scan(body, x, params["enc_layers"])
+        return self.ln_enc.apply(params["ln_enc"], x), mask
+
+    def decode(self, params, tgt_in, ctx, ctx_mask):
+        """Teacher-forced decoder pass: tgt_in (B, T) -> logits (B, T, V)."""
+        x = (self.tok.apply(params["tok"], tgt_in)
+             + self.pos_dec.apply(params["pos_dec"],
+                                  jnp.arange(tgt_in.shape[1])))
+
+        fn = self.dec_layer.apply
+        if self.cfg.remat:
+            fn = jax.checkpoint(fn)
+
+        def body(carry, lp):
+            return fn(lp, carry, ctx, ctx_mask=ctx_mask), None
+
+        x, _ = lax.scan(body, x, params["dec_layers"])
+        x = self.ln_dec.apply(params["ln_dec"], x)
+        return self.tok.attend(params["tok"], x).astype(jnp.float32)
+
+    def apply(self, params, batch, *, train=False, rng=None):
+        src, tgt_in = batch
+        ctx, mask = self.encode(params, src)
+        return self.decode(params, tgt_in, ctx, mask)
+
+    def _shift_right(self, tgt):
+        return jnp.concatenate(
+            [jnp.full((tgt.shape[0], 1), self.cfg.bos_id, tgt.dtype),
+             tgt[:, :-1]], axis=1)
+
+    def loss(self, params, batch, rng=None, train=True):
+        """batch: {"src": (B, S), "tgt": (B, T)} int32.  Cross-entropy on
+        the decoder's next-token predictions, pad positions masked out."""
+        src, tgt = batch["src"], batch["tgt"]
+        logits = self.apply(params, (src, self._shift_right(tgt)),
+                            train=train, rng=rng)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        weight = (tgt != self.cfg.pad_id).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(weight), 1.0)
+        loss = -jnp.sum(tok_logp * weight) / denom
+        acc = jnp.sum((jnp.argmax(logits, -1) == tgt) * weight) / denom
+        return loss, {"accuracy": acc}
+
+    def eval_metrics(self, params, batch):
+        loss, aux = self.loss(params, batch, train=False)
+        return {"loss": loss, **aux}
+
+    # --- generation ---------------------------------------------------
+
+    def generate(self, params, src, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, rng=None):
+        """src (B, S) -> generated target (B, max_new_tokens), starting
+        from BOS.  The encoder runs once; each decoder layer's cross K/V
+        are projected once; decode is a ``lax.scan`` with a self KV cache.
+        """
+        from dtf_tpu.nn.sampling import sample_token
+
+        cfg = self.cfg
+        if max_new_tokens > cfg.max_tgt_len:
+            raise ValueError(f"{max_new_tokens} exceeds max_tgt_len "
+                             f"{cfg.max_tgt_len}")
+        b = src.shape[0]
+        if rng is None:
+            rng = jax.random.key(0)
+        ctx, ctx_mask = self.encode(params, src)
+
+        # pre-project every decoder layer's cross K/V from the context
+        def cross_kv(lp):
+            return self.dec_layer.cross_attn.kv_proj(lp["cross_attn"], ctx)
+        cross_k, cross_v = jax.vmap(cross_kv, in_axes=0)(params["dec_layers"])
+
+        hd = cfg.dim // cfg.num_heads
+        cache = {"k": jnp.zeros((cfg.dec_layers, b, cfg.max_tgt_len,
+                                 cfg.num_heads, hd), cfg.dtype),
+                 "v": jnp.zeros((cfg.dec_layers, b, cfg.max_tgt_len,
+                                 cfg.num_heads, hd), cfg.dtype)}
+        out = jnp.zeros((b, max_new_tokens + 1), jnp.int32)
+        out = out.at[:, 0].set(cfg.bos_id)
+
+        def step(carry, pos):
+            out, cache, rng = carry
+            tok = lax.dynamic_slice(out, (0, pos), (b, 1))
+            x = (self.tok.apply(params["tok"], tok)
+                 + self.pos_dec.apply(params["pos_dec"], pos[None]))
+
+            def layer_scan(carry_x, inputs):
+                lp, ck, cv, xk, xv = inputs
+                y, nc = self.dec_layer.decode_step(
+                    lp, carry_x, {"k": ck, "v": cv}, xk, xv, pos,
+                    ctx_mask=ctx_mask)
+                return y, (nc["k"], nc["v"])
+
+            x, (nk, nv) = lax.scan(
+                layer_scan, x,
+                (params["dec_layers"], cache["k"], cache["v"],
+                 cross_k, cross_v))
+            cache = {"k": nk, "v": nv}
+            x = self.ln_dec.apply(params["ln_dec"], x)
+            logits = self.tok.attend(params["tok"], x)[:, 0, :]
+            rng, sub = jax.random.split(rng)
+            nxt = sample_token(sub, logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
+            return (out, cache, rng), None
+
+        (out, _, _), _ = lax.scan(step, (out, cache, rng),
+                                  jnp.arange(max_new_tokens))
+        return out[:, 1:]     # drop BOS

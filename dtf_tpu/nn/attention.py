@@ -78,14 +78,22 @@ class MultiHeadAttention(Module):
                   "b": jnp.zeros((d,), self.dtype)},
         }
 
-    def qkv(self, params, x):
-        """Project (B, T, D) -> q (B, T, H, Dh), k/v (B, T, KVH, Dh).  The
-        single definition of the input projections — apply(), and the GPT
-        block's prefill/decode paths, all route through here."""
+    def qkv(self, params, x, kv_input=None):
+        """Project q from ``x`` (B, Tq, D) and k/v from ``kv_input`` (B,
+        Tkv, D; defaults to ``x`` — self-attention).  Returns q (B, Tq, H,
+        Dh), k/v (B, Tkv, KVH, Dh).  The single definition of the input
+        projections — apply(), cross-attention, and the GPT block's
+        prefill/decode paths all route through here."""
         q = jnp.einsum("btd,dhk->bthk", x, params["q"]["w"]) + params["q"]["b"]
-        k = jnp.einsum("btd,dhk->bthk", x, params["k"]["w"]) + params["k"]["b"]
-        v = jnp.einsum("btd,dhk->bthk", x, params["v"]["w"]) + params["v"]["b"]
+        k, v = self.kv_proj(params, x if kv_input is None else kv_input)
         return q, k, v
+
+    def kv_proj(self, params, s):
+        """Project only k/v from ``s`` (B, T, D) — for cross-attention
+        caches where q is not needed."""
+        k = jnp.einsum("btd,dhk->bthk", s, params["k"]["w"]) + params["k"]["b"]
+        v = jnp.einsum("btd,dhk->bthk", s, params["v"]["w"]) + params["v"]["b"]
+        return k, v
 
     def expand_kv(self, kv):
         """Broadcast grouped KV heads up to num_heads for an inner attention
@@ -98,8 +106,11 @@ class MultiHeadAttention(Module):
         return (jnp.einsum("bthk,hkd->btd", out, params["o"]["w"])
                 + params["o"]["b"])
 
-    def apply(self, params, x, *, mask=None, train=False, rng=None):
-        q, k, v = self.qkv(params, x)
+    def apply(self, params, x, *, kv_input=None, mask=None, train=False,
+              rng=None):
+        """Self-attention over ``x``, or cross-attention when ``kv_input``
+        (the encoder context) is given."""
+        q, k, v = self.qkv(params, x, kv_input)
         impl = self.attn_impl or dot_product_attention
         return self.out_proj(params, impl(q, self.expand_kv(k),
                                           self.expand_kv(v), mask))

@@ -1,0 +1,108 @@
+"""T5 encoder-decoder workload: synthetic copy/reverse seq2seq task.
+
+Third model family's runnable entry point (BERT: bert_pretrain, GPT: lm).
+Zero-egress: the task is algorithmic (copy or reverse a random token
+sequence), so convergence and generation exact-match are measurable
+without any dataset.
+
+    python -m dtf_tpu.workloads.seq2seq --task reverse --steps 400
+    python -m dtf_tpu.workloads.seq2seq --preset small --bf16 \
+        --per_device_batch 16 --mesh data=-1
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    import jax
+    import numpy as np
+
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
+    from dtf_tpu.models.t5 import T5, T5Config
+    from dtf_tpu.train.metrics import MetricLogger
+    from dtf_tpu.train.trainer import init_state, make_train_step, put_global_batch
+    from dtf_tpu.utils.timing import block
+    from dtf_tpu.workloads._driver import global_batch_size
+    from dtf_tpu import optim
+
+    parser = build_parser("dtf_tpu T5 seq2seq (synthetic copy/reverse)")
+    parser.add_argument("--preset", choices=["small", "tiny"], default="tiny")
+    parser.add_argument("--task", choices=["copy", "reverse"],
+                        default="reverse")
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--seq_len", type=int, default=12)
+    parser.add_argument("--bf16", action="store_true")
+    parser.add_argument("--eval_examples", type=int, default=32,
+                        help="held-out sources to decode for exact-match")
+    parser.set_defaults(learning_rate=3e-3)   # task-suited default
+    ns = parser.parse_args(argv)
+    cluster_cfg = _from_namespace(ClusterConfig, ns)
+    train_cfg = _from_namespace(TrainConfig, ns)
+
+    cluster = bootstrap(cluster_cfg)
+    mesh = cluster.mesh
+    logger = MetricLogger(train_cfg.logdir, cluster.is_coordinator)
+
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if ns.bf16 else jnp.float32
+    kw = dict(dtype=dtype, max_src_len=max(ns.seq_len, 16),
+              max_tgt_len=max(ns.seq_len, 16))
+    cfg = (T5Config.small(**kw) if ns.preset == "small"
+           else T5Config.tiny(**kw))
+    model = T5(cfg)
+
+    opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
+    state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh)
+    step = make_train_step(model.loss, opt, mesh,
+                           grad_accum=train_cfg.grad_accum)
+
+    bs = global_batch_size(cluster, train_cfg)
+    rng = np.random.default_rng(train_cfg.seed)
+
+    def make_batch():
+        src = rng.integers(2, cfg.vocab_size, (bs, ns.seq_len)).astype(
+            np.int32)
+        tgt = src[:, ::-1].copy() if ns.task == "reverse" else src
+        return {"src": src, "tgt": tgt}
+
+    t0 = time.perf_counter()
+    window_t, window_n, m = t0, 0, {}
+    for i in range(ns.steps):
+        state, m = step(state, put_global_batch(mesh, make_batch()),
+                        jax.random.key(i))
+        window_n += 1
+        if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == ns.steps:
+            block(state)
+            now = time.perf_counter()
+            avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
+            logger.step_line(int(state["step"]), 1, i + 1, ns.steps,
+                             float(m["loss"]), avg_ms)
+            logger.scalar(int(state["step"]), "cost", float(m["loss"]))
+            window_t, window_n = now, 0
+    block(state)
+    total = time.perf_counter() - t0
+    logger.print("Total Time: %3.2fs" % total)
+    logger.print(f"Teacher-forced accuracy: {float(m['accuracy']):.4f}")
+
+    # held-out generation: exact sequence match
+    n_eval = ns.eval_examples
+    src = rng.integers(2, cfg.vocab_size, (n_eval, ns.seq_len)).astype(
+        np.int32)
+    want = src[:, ::-1] if ns.task == "reverse" else src
+    gen_fn = jax.jit(lambda p, s: model.generate(p, s, ns.seq_len,
+                                                 temperature=0.0))
+    gen = gen_fn(state["params"], jnp.asarray(src))
+    exact = float((np.asarray(gen) == want).all(axis=1).mean())
+    logger.print(f"Generation exact-match: {exact:.2f} "
+                 f"({n_eval} held-out {ns.task} sequences)")
+    if cluster.is_coordinator:
+        print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

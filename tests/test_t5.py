@@ -1,0 +1,133 @@
+"""T5-style encoder-decoder: decoder causality, cross-attention
+connectivity, pad masking, KV-cache generation consistency with the
+teacher-forced forward, loss masking, and an end-to-end copy-task
+convergence run on the simulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.models.t5 import T5, T5Config
+
+
+@pytest.fixture(scope="module")
+def model():
+    return T5(T5Config.tiny())
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0))
+
+
+def rand_tokens(key, shape, vocab=64, lo=2):
+    return jnp.asarray(
+        np.random.default_rng(key).integers(lo, vocab, shape), jnp.int32)
+
+
+class TestForward:
+    def test_logits_shape(self, model, params):
+        src, tgt_in = rand_tokens(0, (2, 12)), rand_tokens(1, (2, 9))
+        logits = model.apply(params, (src, tgt_in))
+        assert logits.shape == (2, 9, 64)
+        assert logits.dtype == jnp.float32
+
+    def test_decoder_causality(self, model, params):
+        """Changing a future decoder token must not change past logits."""
+        src = rand_tokens(2, (1, 10))
+        a = np.asarray(rand_tokens(3, (1, 12)))
+        b = a.copy()
+        b[0, 8:] = np.asarray(rand_tokens(4, (4,)))
+        la = model.apply(params, (src, jnp.asarray(a)))
+        lb = model.apply(params, (src, jnp.asarray(b)))
+        np.testing.assert_allclose(la[0, :8], lb[0, :8], atol=1e-5)
+        assert not np.allclose(la[0, 8:], lb[0, 8:])
+
+    def test_cross_attention_connects_encoder(self, model, params):
+        """Changing the SOURCE changes every decoder position's logits —
+        the cross-attention path is live."""
+        tgt_in = rand_tokens(5, (1, 8))
+        la = model.apply(params, (rand_tokens(6, (1, 10)), tgt_in))
+        lb = model.apply(params, (rand_tokens(7, (1, 10)), tgt_in))
+        assert not np.allclose(la, lb)
+
+    def test_padded_source_equals_short_source(self, model, params):
+        """A source with a padded tail must produce the same decoder
+        logits as the unpadded short source: encoder self-attention and
+        decoder cross-attention both mask pad positions, so the pads are
+        invisible end to end."""
+        short = np.asarray(rand_tokens(8, (1, 6)))
+        padded = np.concatenate(
+            [short, np.zeros((1, 4), np.int32)], axis=1)   # pad_id tail
+        tgt_in = rand_tokens(9, (1, 6))
+        la = model.apply(params, (jnp.asarray(short), tgt_in))
+        lb = model.apply(params, (jnp.asarray(padded), tgt_in))
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   atol=1e-5)
+
+    def test_loss_ignores_pad_targets(self, model, params):
+        src = rand_tokens(10, (2, 10))
+        tgt = np.asarray(rand_tokens(11, (2, 8)))
+        tgt_padded = tgt.copy()
+        tgt_padded[:, 6:] = 0
+        l_full, _ = model.loss(params, {"src": src,
+                                        "tgt": jnp.asarray(tgt_padded)})
+        # manually: loss over only the first 6 positions
+        logits = model.apply(
+            params, (src, model._shift_right(jnp.asarray(tgt_padded))))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tl = np.asarray(jnp.take_along_axis(
+            logp, jnp.asarray(tgt_padded)[..., None], axis=-1))[..., 0]
+        manual = -tl[:, :6].mean()
+        assert float(l_full) == pytest.approx(float(manual), rel=1e-5)
+
+
+class TestGeneration:
+    def test_greedy_matches_teacher_forced(self, model, params):
+        """KV-cache decode (+ pre-projected cross K/V) must reproduce the
+        teacher-forced forward's argmax chain."""
+        src = rand_tokens(12, (2, 10))
+        gen = model.generate(params, src, 6, temperature=0.0)
+        assert gen.shape == (2, 6)
+        # replay with the parallel decoder
+        cur = jnp.full((2, 1), 1, jnp.int32)        # BOS
+        for t in range(6):
+            logits = model.apply(params, (src, cur))
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            np.testing.assert_array_equal(np.asarray(nxt),
+                                          np.asarray(gen[:, t]),
+                                          err_msg=f"t={t}")
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+
+    def test_sampling_deterministic_per_key(self, model, params):
+        src = rand_tokens(13, (1, 8))
+        a = model.generate(params, src, 5, temperature=1.0,
+                           rng=jax.random.key(3))
+        b = model.generate(params, src, 5, temperature=1.0,
+                           rng=jax.random.key(3))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestTraining:
+    def test_learns_copy_task(self, mesh8):
+        """End-to-end: tiny T5 learns to copy the source sequence (the
+        canonical seq2seq smoke test) well above chance in 40 steps."""
+        from dtf_tpu import optim
+        from dtf_tpu.parallel.mesh import make_mesh
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+
+        mesh = make_mesh("data=8")
+        model = T5(T5Config.tiny())
+        opt = optim.adam(3e-3)
+        state = init_state(model, opt, seed=0, mesh=mesh)
+        step = make_train_step(model.loss, opt, mesh, donate=False)
+        rng = np.random.default_rng(0)
+        accs = []
+        for i in range(350):     # ~0.48 acc by 300, ~0.99 by 400
+            toks = rng.integers(2, 64, (16, 12)).astype(np.int32)
+            batch = put_global_batch(mesh, {"src": toks, "tgt": toks})
+            state, m = step(state, batch, jax.random.key(i))
+            accs.append(float(m["accuracy"]))
+        assert accs[-1] > 0.6, accs[-5:]    # chance ~ 1/62
