@@ -160,7 +160,7 @@ class T5DecoderLayer(Module):
 
         pc = params["cross_attn"]
         h = self.ln_cross.apply(params["ln_cross"], x_t)
-        qc = jnp.einsum("btd,dhk->bthk", h, pc["q"]["w"]) + pc["q"]["b"]
+        qc = self.cross_attn.q_proj(pc, h)
         sc = jnp.einsum("bqhd,bkhd->bhqk", qc.astype(jnp.float32),
                         cross_k.astype(jnp.float32)) * scale
         if ctx_mask is not None:

@@ -84,9 +84,15 @@ class MultiHeadAttention(Module):
         Dh), k/v (B, Tkv, KVH, Dh).  The single definition of the input
         projections — apply(), cross-attention, and the GPT block's
         prefill/decode paths all route through here."""
-        q = jnp.einsum("btd,dhk->bthk", x, params["q"]["w"]) + params["q"]["b"]
+        q = self.q_proj(params, x)
         k, v = self.kv_proj(params, x if kv_input is None else kv_input)
         return q, k, v
+
+    def q_proj(self, params, x):
+        """Project only q from ``x`` (B, T, D) — for cross-attention decode
+        where k/v come from a precomputed cache."""
+        return (jnp.einsum("btd,dhk->bthk", x, params["q"]["w"])
+                + params["q"]["b"])
 
     def kv_proj(self, params, s):
         """Project only k/v from ``s`` (B, T, D) — for cross-attention

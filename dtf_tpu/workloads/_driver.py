@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from contextlib import nullcontext as _nullcontext
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -24,10 +24,17 @@ def global_batch_size(cluster, train_cfg) -> int:
             if train_cfg.per_device_batch else train_cfg.batch_size)
 
 
-def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
+def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
                        steps: int, *, tokens_per_example: int,
-                       throughput_unit: str = "tok") -> tuple:
-    """Run ``steps`` timed train steps over ``toks`` (N, T) int32.
+                       throughput_unit: str = "tok",
+                       flops_tokens_per_example: Optional[int] = None) -> tuple:
+    """Run ``steps`` timed train steps.
+
+    ``toks`` is either an (N, T) int32 array sliced into global batches, or
+    a callable ``i -> host batch`` (any pytree the model's loss accepts) —
+    the seam that lets every workload share ONE timing methodology
+    (two-step untimed compile warmup, windowed ``block_until_ready``
+    timing, watchdog, sharding rules).
 
     Returns (state, metrics, ms_per_step).  Prints the reference step-line
     contract plus a Step-Time/Throughput summary, and — when the chip's
@@ -36,6 +43,9 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     attention's quadratic term and the embedding gather are ignored, so
     this slightly *understates* at long sequence lengths — remat recompute
     is correctly NOT counted as useful work).
+    ``flops_tokens_per_example`` overrides the per-example token count in
+    that formula (defaults to the array's T; REQUIRED for callable
+    ``toks`` — e.g. src_len + tgt_len for an encoder-decoder).
     """
     from dtf_tpu import optim
     from dtf_tpu.parallel import sharding as sh
@@ -54,12 +64,21 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
     step_fn = make_train_step(model.loss, opt, mesh,
                               grad_accum=train_cfg.grad_accum)
 
-    n_batches = len(toks) // global_batch
     rng_base = jax.random.key(train_cfg.seed + 17)
 
-    def batch_at(i):
-        j = (i % n_batches) * global_batch
-        return put_global_batch(mesh, toks[j:j + global_batch])
+    if callable(toks):
+        if flops_tokens_per_example is None:
+            raise ValueError("flops_tokens_per_example is required when "
+                             "toks is a batch-producing callable")
+
+        def batch_at(i):
+            return put_global_batch(mesh, toks(i))
+    else:
+        n_batches = len(toks) // global_batch
+
+        def batch_at(i):
+            j = (i % n_batches) * global_batch
+            return put_global_batch(mesh, toks[j:j + global_batch])
 
     # Fail-fast watchdog (--hang_timeout_s), same contract as Trainer.fit:
     # armed only for the loop, suspended across the compile-heavy warmup.
@@ -87,7 +106,9 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks: np.ndarray,
         else:
             from dtf_tpu.nn.core import count_params
             n_params = int(count_params(state["params"]))
-        model_flops = 6.0 * n_params * global_batch * toks.shape[1]
+        flops_tokens = (flops_tokens_per_example if flops_tokens_per_example
+                        is not None else toks.shape[1])
+        model_flops = 6.0 * n_params * global_batch * flops_tokens
 
         t0 = time.perf_counter()
         window_t, window_n = t0, 0

@@ -13,7 +13,6 @@ without any dataset.
 from __future__ import annotations
 
 import sys
-import time
 
 
 def main(argv=None) -> int:
@@ -24,10 +23,7 @@ def main(argv=None) -> int:
     from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
     from dtf_tpu.models.t5 import T5, T5Config
     from dtf_tpu.train.metrics import MetricLogger
-    from dtf_tpu.train.trainer import init_state, make_train_step, put_global_batch
-    from dtf_tpu.utils.timing import block
-    from dtf_tpu.workloads._driver import global_batch_size
-    from dtf_tpu import optim
+    from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
 
     parser = build_parser("dtf_tpu T5 seq2seq (synthetic copy/reverse)")
     parser.add_argument("--preset", choices=["small", "tiny"], default="tiny")
@@ -55,38 +51,25 @@ def main(argv=None) -> int:
            else T5Config.tiny(**kw))
     model = T5(cfg)
 
-    opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
-    state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh)
-    step = make_train_step(model.loss, opt, mesh,
-                           grad_accum=train_cfg.grad_accum)
-
     bs = global_batch_size(cluster, train_cfg)
-    rng = np.random.default_rng(train_cfg.seed)
 
-    def make_batch():
-        src = rng.integers(2, cfg.vocab_size, (bs, ns.seq_len)).astype(
+    def batch_at(i):
+        # per-index rng: deterministic, identical on every process (the
+        # multi-host contract of put_global_batch)
+        r = np.random.default_rng(train_cfg.seed * 100003 + i)
+        src = r.integers(2, cfg.vocab_size, (bs, ns.seq_len)).astype(
             np.int32)
         tgt = src[:, ::-1].copy() if ns.task == "reverse" else src
         return {"src": src, "tgt": tgt}
 
-    t0 = time.perf_counter()
-    window_t, window_n, m = t0, 0, {}
-    for i in range(ns.steps):
-        state, m = step(state, put_global_batch(mesh, make_batch()),
-                        jax.random.key(i))
-        window_n += 1
-        if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == ns.steps:
-            block(state)
-            now = time.perf_counter()
-            avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
-            logger.step_line(int(state["step"]), 1, i + 1, ns.steps,
-                             float(m["loss"]), avg_ms)
-            logger.scalar(int(state["step"]), "cost", float(m["loss"]))
-            window_t, window_n = now, 0
-    block(state)
-    total = time.perf_counter() - t0
-    logger.print("Total Time: %3.2fs" % total)
+    # shared timing/warmup/sharding methodology (workloads/_driver.py);
+    # enc sees seq_len tokens and dec seq_len more -> 2x for the MFU formula
+    state, m, _ = pretrain_benchmark(
+        cluster, logger, model, train_cfg, batch_at, ns.steps,
+        tokens_per_example=ns.seq_len, throughput_unit="seq",
+        flops_tokens_per_example=2 * ns.seq_len)
     logger.print(f"Teacher-forced accuracy: {float(m['accuracy']):.4f}")
+    rng = np.random.default_rng(train_cfg.seed + 999)
 
     # held-out generation: exact sequence match
     n_eval = ns.eval_examples
