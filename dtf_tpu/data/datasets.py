@@ -80,6 +80,18 @@ class Dataset:
         for _ in range(self.num_examples // batch_size):
             yield self.next_batch(batch_size)
 
+    def shard(self, process_index: int, process_count: int) -> "Dataset":
+        """Disjoint per-host partition for true multi-host data loading
+        (pair with ``put_process_batch``): process k keeps examples
+        ``k::process_count`` — strided, so class structure survives sorted
+        storage — with a per-shard shuffle seed.  The trailing remainder
+        (< process_count examples) is dropped so every shard has equal
+        length (collectives need equal local batch sizes)."""
+        n = (self.num_examples // process_count) * process_count
+        sel = np.arange(process_index, n, process_count)
+        return Dataset(self.images[sel], self.labels[sel],
+                       seed=self.seed + 7919 * process_index)
+
 
 @dataclasses.dataclass
 class DataSplits:

@@ -86,6 +86,46 @@ def put_global_batch(mesh: Mesh, batch: Any) -> Any:
     return jax.tree_util.tree_map(put, batch)
 
 
+def put_process_batch(mesh: Mesh, local_batch: Any) -> Any:
+    """True multi-host data loading: each process contributes ITS OWN
+    disjoint slice of the global batch (leading dim = global/process_count)
+    instead of redundantly materializing the whole global batch everywhere
+    (:func:`put_global_batch`'s identical-batches contract).  Rank-0 leaves
+    are replicated from the local value (callers must pass identical
+    scalars).  Pair with :meth:`dtf_tpu.data.datasets.Dataset.shard` so
+    each host reads only its partition.
+
+    Assumes the data axis tiles the processes (process k's addressable
+    devices hold a contiguous 1/nproc of the batch dim — the default
+    device order for a leading ``data`` axis); the local leading dim must
+    be divisible by this process's share of the data-axis size."""
+    nproc = jax.process_count()
+    data_size = sh.data_axis_size(mesh)
+    local_share = max(data_size // nproc, 1)
+    for x in jax.tree_util.tree_leaves(local_batch):
+        if np.ndim(x) > 0 and np.shape(x)[0] % local_share:
+            raise ValueError(
+                f"local batch dim {np.shape(x)[0]} is not divisible by "
+                f"this process's share of the data axis "
+                f"({data_size}/{nproc} = {local_share}); pick a local "
+                f"batch that is a multiple of {local_share}")
+
+    def put(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            if nproc == 1:
+                return sh.replicate(mesh, x)
+            return jax.make_array_from_process_local_data(
+                sh.replicate(mesh), x)
+        sharding = sh.batch_spec(mesh, x.ndim)
+        global_shape = (x.shape[0] * nproc, *x.shape[1:])
+        if nproc == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x,
+                                                      global_shape)
+    return jax.tree_util.tree_map(put, local_batch)
+
+
 def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     mesh: Mesh, mode: str = "implicit",
                     donate: bool = True, stateful: bool = False,

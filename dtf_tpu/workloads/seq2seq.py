@@ -66,7 +66,7 @@ def main(argv=None) -> int:
     # enc sees seq_len tokens and dec seq_len more -> 2x for the MFU formula
     state, m, _ = pretrain_benchmark(
         cluster, logger, model, train_cfg, batch_at, ns.steps,
-        tokens_per_example=ns.seq_len, throughput_unit="seq",
+        tokens_per_example=1, throughput_unit="seq",
         flops_tokens_per_example=2 * ns.seq_len)
     logger.print(f"Teacher-forced accuracy: {float(m['accuracy']):.4f}")
     rng = np.random.default_rng(train_cfg.seed + 999)

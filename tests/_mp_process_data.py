@@ -1,0 +1,43 @@
+"""Child script for test_process_data.py: 2-process job where each host
+feeds only ITS OWN slice of a deterministic global batch through
+put_process_batch, then runs one explicit-mode train step and prints the
+loss (which must equal the single-process full-batch loss)."""
+
+import sys
+
+import jax
+import numpy as np
+
+
+def main() -> int:
+    task, coord = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                               process_id=task)
+
+    from dtf_tpu import optim
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.parallel.mesh import make_mesh
+    from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                       put_process_batch)
+
+    mesh = make_mesh("data=-1")
+    model = MnistMLP(init_scale="fan_in")
+    opt = optim.sgd(0.1)
+    state = init_state(model, opt, seed=1, mesh=mesh)
+    step = make_train_step(model.loss, opt, mesh, mode="explicit",
+                           donate=False)
+
+    # deterministic GLOBAL batch; this process materializes only its slice
+    rng = np.random.default_rng(42)
+    gx = rng.random((32, 784), np.float32)
+    gy = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+    lo, hi = task * 16, (task + 1) * 16
+    batch = put_process_batch(mesh, (gx[lo:hi], gy[lo:hi]))
+
+    state, m = step(state, batch, jax.random.key(0))
+    print(f"LOSS={float(m['loss']):.10f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,36 +39,43 @@ def child_env(n_local_devices: int) -> dict:
     return env
 
 
+def run_workers(cmds, *, n_local_devices: int, cwd=None,
+                timeout: int = 420) -> list:
+    """Spawn one child per command, wait for all, assert every exit code is
+    0, always kill stragglers.  Returns each task's combined output."""
+    procs = [subprocess.Popen(
+        cmd, cwd=cwd, env=child_env(n_local_devices),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for cmd in cmds]
+    outs = []
+    try:
+        for task, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
+    finally:
+        for p in procs:   # never leak hung distributed workers
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
 @pytest.mark.slow
 class TestMultiProcess:
     def test_two_process_mnist_data_parallel(self, tmp_path):
         """2 processes x 4 simulated devices: full DP MNIST epoch over the
         coordination service; both exit 0, coordinator logs eval."""
         port = free_port()
-        procs = []
-        for task in range(2):
-            cmd = [
-                sys.executable, "-m", "dtf_tpu.workloads.mnist",
-                "--job_name", "worker", "--task_index", str(task),
-                "--coordinator_address", f"localhost:{port}",
-                "--num_processes", "2", "--mesh", "data=-1",
-                "--epochs", "1", "--batch_size", "128",
-                "--log_frequency", "50",
-                "--logdir", str(tmp_path / f"logs{task}"),
-            ]
-            procs.append(subprocess.Popen(
-                cmd, cwd=tmp_path, env=child_env(4),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        try:
-            for task, p in enumerate(procs):
-                out, _ = p.communicate(timeout=420)
-                outs.append(out)
-                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
-        finally:
-            for p in procs:   # never leak hung distributed workers
-                if p.poll() is None:
-                    p.kill()
+        outs = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.mnist",
+              "--job_name", "worker", "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "data=-1",
+              "--epochs", "1", "--batch_size", "128",
+              "--log_frequency", "50",
+              "--logdir", str(tmp_path / f"logs{task}")]
+             for task in range(2)],
+            n_local_devices=4, cwd=tmp_path)
         # coordinator (task 0) owns the console contract
         assert "Test-Accuracy" in outs[0]
         assert "done" in outs[0]
@@ -80,60 +87,32 @@ class TestMultiProcess:
         """The quantized ring's ppermute hops span the 2-process mesh: the
         explicit int8 gradient sync must work over the DCN path too."""
         port = free_port()
-        procs = []
-        for task in range(2):
-            cmd = [
-                sys.executable, "-m", "dtf_tpu.workloads.mnist",
-                "--job_name", "worker", "--task_index", str(task),
-                "--coordinator_address", f"localhost:{port}",
-                "--num_processes", "2", "--mesh", "data=-1",
-                "--mode", "explicit", "--grad_compression", "int8",
-                "--epochs", "1", "--batch_size", "512",
-                "--log_frequency", "100",
-                "--logdir", str(tmp_path / f"logs{task}"),
-            ]
-            procs.append(subprocess.Popen(
-                cmd, cwd=tmp_path, env=child_env(2),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        try:
-            for task, p in enumerate(procs):
-                out, _ = p.communicate(timeout=420)
-                outs.append(out)
-                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        outs = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.mnist",
+              "--job_name", "worker", "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "data=-1",
+              "--mode", "explicit", "--grad_compression", "int8",
+              "--epochs", "1", "--batch_size", "512",
+              "--log_frequency", "100",
+              "--logdir", str(tmp_path / f"logs{task}")]
+             for task in range(2)],
+            n_local_devices=2, cwd=tmp_path)
         assert "Test-Accuracy" in outs[0]
 
     def test_sequence_parallel_spans_processes(self, tmp_path):
         """A data=2 x seq=2 mesh over 2 processes: ulysses all-to-alls run
         across the process boundary inside the BERT train step."""
         port = free_port()
-        procs = []
-        for task in range(2):
-            cmd = [
-                sys.executable, "-m", "dtf_tpu.workloads.bert_pretrain",
-                "--task_index", str(task),
-                "--coordinator_address", f"localhost:{port}",
-                "--num_processes", "2", "--mesh", "data=2,seq=2",
-                "--preset", "tiny", "--steps", "3", "--batch_size", "8",
-                "--ulysses", "--logdir", str(tmp_path / f"logs{task}"),
-            ]
-            procs.append(subprocess.Popen(
-                cmd, cwd=tmp_path, env=child_env(2),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = []
-        try:
-            for task, p in enumerate(procs):
-                out, _ = p.communicate(timeout=420)
-                outs.append(out)
-                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        outs = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.bert_pretrain",
+              "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "data=2,seq=2",
+              "--preset", "tiny", "--steps", "3", "--batch_size", "8",
+              "--ulysses", "--logdir", str(tmp_path / f"logs{task}")]
+             for task in range(2)],
+            n_local_devices=2, cwd=tmp_path)
         assert "Step-Time" in outs[0]
 
     def test_preemption_agrees_across_processes(self, tmp_path):
@@ -199,25 +178,13 @@ class TestMultiProcess:
         design, cluster.py docstring): the 2-process job still completes
         with one 'ps' and one 'worker'."""
         port = free_port()
-        procs = []
-        for task, job in ((0, "worker"), (1, "ps")):
-            cmd = [
-                sys.executable, "-m", "dtf_tpu.workloads.mnist",
-                "--job_name", job, "--task_index", str(task),
-                "--coordinator_address", f"localhost:{port}",
-                "--num_processes", "2", "--mesh", "data=-1",
-                "--epochs", "1", "--batch_size", "512",
-                "--log_frequency", "100",
-                "--logdir", str(tmp_path / f"logs{task}"),
-            ]
-            procs.append(subprocess.Popen(
-                cmd, cwd=tmp_path, env=child_env(2),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        try:
-            for task, p in enumerate(procs):
-                out, _ = p.communicate(timeout=420)
-                assert p.returncode == 0, f"task {task} failed:\n{out[-3000:]}"
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.mnist",
+              "--job_name", job, "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "data=-1",
+              "--epochs", "1", "--batch_size", "512",
+              "--log_frequency", "100",
+              "--logdir", str(tmp_path / f"logs{task}")]
+             for task, job in ((0, "worker"), (1, "ps"))],
+            n_local_devices=2, cwd=tmp_path)
