@@ -100,8 +100,18 @@ def put_process_batch(mesh: Mesh, local_batch: Any) -> Any:
     device order for a leading ``data`` axis); the local leading dim must
     be divisible by this process's share of the data-axis size."""
     nproc = jax.process_count()
+    if nproc == 1:
+        # local == global by definition; keep single-process placement
+        # policy in exactly one place.
+        return put_global_batch(mesh, local_batch)
     data_size = sh.data_axis_size(mesh)
-    local_share = max(data_size // nproc, 1)
+    if data_size % nproc:
+        raise ValueError(
+            f"put_process_batch requires the data axis (size {data_size}) "
+            f"to tile the {nproc} processes (each process owns "
+            f"data_size/nproc contiguous shards); re-factor the mesh or "
+            f"use put_global_batch")
+    local_share = data_size // nproc
     for x in jax.tree_util.tree_leaves(local_batch):
         if np.ndim(x) > 0 and np.shape(x)[0] % local_share:
             raise ValueError(
@@ -113,14 +123,10 @@ def put_process_batch(mesh: Mesh, local_batch: Any) -> Any:
     def put(x):
         x = np.asarray(x)
         if x.ndim == 0:
-            if nproc == 1:
-                return sh.replicate(mesh, x)
             return jax.make_array_from_process_local_data(
                 sh.replicate(mesh), x)
         sharding = sh.batch_spec(mesh, x.ndim)
         global_shape = (x.shape[0] * nproc, *x.shape[1:])
-        if nproc == 1:
-            return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x,
                                                       global_shape)
     return jax.tree_util.tree_map(put, local_batch)

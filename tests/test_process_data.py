@@ -5,8 +5,6 @@ full-batch loss exactly."""
 
 import os
 import re
-import socket
-import subprocess
 import sys
 
 import jax
@@ -16,7 +14,7 @@ import pytest
 from dtf_tpu.data.datasets import Dataset
 from dtf_tpu.train.trainer import put_global_batch, put_process_batch
 
-from tests.test_multiprocess import REPO_ROOT, child_env, free_port
+from tests.test_multiprocess import REPO_ROOT, free_port, run_workers
 
 
 class TestSingleProcess:
@@ -73,20 +71,11 @@ class TestTwoProcess:
 
         port = free_port()
         script = os.path.join(REPO_ROOT, "tests", "_mp_process_data.py")
-        procs = [subprocess.Popen(
-            [sys.executable, script, str(task), f"localhost:{port}"],
-            env=child_env(4), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True) for task in range(2)]
-        losses = []
-        try:
-            for task, p in enumerate(procs):
-                out, _ = p.communicate(timeout=300)
-                assert p.returncode == 0, f"task {task}:\n{out[-3000:]}"
-                (val,) = re.findall(r"LOSS=([0-9.]+)", out)
-                losses.append(float(val))
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        outs = run_workers(
+            [[sys.executable, script, str(task), f"localhost:{port}"]
+             for task in range(2)],
+            n_local_devices=4, timeout=300)
+        losses = [float(re.findall(r"LOSS=([0-9.]+)", out)[0])
+                  for out in outs]
         assert losses[0] == losses[1]                       # SPMD agree
         assert losses[0] == pytest.approx(ref, abs=1e-5)    # == full batch
