@@ -12,16 +12,39 @@ parameter-server demo ``KimJeongChul/distributed-tensorflow`` (reference at
 * async parameter-server SGD (ref: tf_distributed.py:73-76) -> synchronous
   data parallelism with ``lax.psum`` gradient all-reduce over ICI;
 * workloads: MNIST MLP (tf_distributed.py:39-89), the 1000x1000 matmul
-  benchmark (tf_distributed_1000Matrix.py:42-48), plus ResNet-50/CIFAR-10 and
-  BERT-base per BASELINE.md;
+  benchmark (tf_distributed_1000Matrix.py:42-48), plus ResNet-50/CIFAR-10,
+  BERT-base MLM, GPT (LLaMA-style options), and a T5-style encoder-decoder
+  per BASELINE.md;
 * driver loop, eval and the reference's console log contract
   (tf_distributed.py:100-128) -> :mod:`dtf_tpu.train`.
 
 The reference's capabilities are re-expressed TPU-first, not translated.
+
+Typical use::
+
+    import dtf_tpu
+
+    cluster = dtf_tpu.bootstrap()          # mesh from flags/defaults
+    opt = dtf_tpu.optim.adam(1e-3)
+    state = dtf_tpu.init_state(model, opt, seed=0, mesh=cluster.mesh)
+    step = dtf_tpu.make_train_step(model.loss, opt, cluster.mesh)
+    state, metrics = step(state, dtf_tpu.put_global_batch(cluster.mesh, b),
+                          rng)
 """
 
 from dtf_tpu.version import __version__
-from dtf_tpu import cluster, config
+from dtf_tpu import cluster, config, optim
+from dtf_tpu.cluster import Cluster, bootstrap
+from dtf_tpu.config import ClusterConfig, TrainConfig, parse_args
 from dtf_tpu.parallel import mesh, sharding
+from dtf_tpu.parallel.mesh import make_mesh
+from dtf_tpu.train.trainer import (Trainer, init_state, make_eval_fn,
+                                   make_train_step, put_global_batch,
+                                   put_process_batch)
 
-__all__ = ["__version__", "cluster", "config", "mesh", "sharding"]
+__all__ = [
+    "__version__", "cluster", "config", "mesh", "sharding", "optim",
+    "Cluster", "bootstrap", "ClusterConfig", "TrainConfig", "parse_args",
+    "make_mesh", "Trainer", "init_state", "make_eval_fn", "make_train_step",
+    "put_global_batch", "put_process_batch",
+]
