@@ -93,6 +93,12 @@ class TrainConfig:
     # Optimizer for the pretrain-benchmark workloads (mnist keeps the
     # reference's SGD); valid names are optim.BY_NAME's keys.
     optimizer: str = "adam"
+    # LR schedule for the pretrain benchmarks: "constant" or "cosine"
+    # (optim.warmup_cosine: linear warmup over warmup_steps, cosine decay
+    # to lr_final_frac * learning_rate by the end of the run).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    lr_final_frac: float = 0.0
     epochs: int = 20
     log_frequency: int = 100
     seed: int = 1

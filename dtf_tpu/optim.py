@@ -45,29 +45,45 @@ def apply_updates(params: Any, updates: Any) -> Any:
     return jax.tree_util.tree_map(lambda p, u: (p + u).astype(p.dtype), params, updates)
 
 
-def sgd(lr: float) -> Optimizer:
-    """Plain SGD — the reference's optimizer (lr 0.0005, tf_distributed.py:73)."""
+def sgd(lr: "float | Callable") -> Optimizer:
+    """Plain SGD — the reference's optimizer (lr 0.0005, tf_distributed.py:73).
+    ``lr`` may be a schedule (step -> lr); a step counter is carried in the
+    state only then."""
 
     def init(params):
-        return ()
+        return {"step": jnp.zeros((), jnp.int32)} if callable(lr) else ()
 
     def update(grads, state, params=None):
-        return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+        if callable(lr):
+            step = state["step"] + 1
+            lr_t, state = lr(step), {"step": step}
+        else:
+            lr_t = lr
+        return jax.tree_util.tree_map(lambda g: -lr_t * g, grads), state
 
     return Optimizer(init, update)
 
 
-def momentum(lr: float, beta: float = 0.9, nesterov: bool = False) -> Optimizer:
+def momentum(lr: "float | Callable", beta: float = 0.9,
+             nesterov: bool = False) -> Optimizer:
     def init(params):
-        return {"m": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        state = {"m": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        if callable(lr):
+            state["step"] = jnp.zeros((), jnp.int32)
+        return state
 
     def update(grads, state, params=None):
+        if callable(lr):
+            step = state["step"] + 1
+            lr_t, extra = lr(step), {"step": step}
+        else:
+            lr_t, extra = lr, {}
         m = jax.tree_util.tree_map(lambda m_, g: beta * m_ + g, state["m"], grads)
         if nesterov:
-            upd = jax.tree_util.tree_map(lambda m_, g: -lr * (beta * m_ + g), m, grads)
+            upd = jax.tree_util.tree_map(lambda m_, g: -lr_t * (beta * m_ + g), m, grads)
         else:
-            upd = jax.tree_util.tree_map(lambda m_: -lr * m_, m)
-        return upd, {"m": m}
+            upd = jax.tree_util.tree_map(lambda m_: -lr_t * m_, m)
+        return upd, {"m": m, **extra}
 
     return Optimizer(init, update)
 
@@ -242,6 +258,20 @@ def get(name: str) -> Callable[..., Optimizer]:
     except KeyError:
         raise ValueError(f"--optimizer must be one of {sorted(BY_NAME)}, "
                          f"got {name!r}") from None
+
+
+def schedule_from_config(train_cfg, total_steps: int):
+    """Resolve TrainConfig's lr fields into a float or schedule — the ONE
+    place --lr_schedule is interpreted, shared by every workload.
+    ``total_steps`` must count every optimizer update the run will perform
+    (benchmark drivers include their compile-warmup steps)."""
+    if train_cfg.lr_schedule == "constant":
+        return train_cfg.learning_rate
+    if train_cfg.lr_schedule == "cosine":
+        return warmup_cosine(train_cfg.learning_rate, train_cfg.warmup_steps,
+                             total_steps, final_frac=train_cfg.lr_final_frac)
+    raise ValueError(f"--lr_schedule must be 'constant' or 'cosine', got "
+                     f"{train_cfg.lr_schedule!r}")
 
 
 def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,

@@ -58,7 +58,10 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
     rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
              else sh.DEFAULT_RULES)
     shardings = sh.apply_rules(model.axes(), mesh, rules)
-    opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
+    # +2: the two untimed compile-warmup steps below also advance the
+    # optimizer's schedule counter.
+    lr = optim.schedule_from_config(train_cfg, steps + 2)
+    opt = optim.get(train_cfg.optimizer)(lr)
     state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh,
                        param_shardings=shardings)
     step_fn = make_train_step(model.loss, opt, mesh,

@@ -39,12 +39,16 @@ def main(argv=None) -> int:
 
     model = ResNet(ResNetConfig.resnet50() if ns.arch == "resnet50"
                    else ResNetConfig.tiny())
+    bs = (train_cfg.per_device_batch * cluster.num_devices
+          if train_cfg.per_device_batch else train_cfg.batch_size)
+    total_steps = (splits.train.num_examples // bs) * train_cfg.epochs
+    lr = optim.schedule_from_config(train_cfg, total_steps)
     # --optimizer overrides this workload's default (SGD+momentum); the
     # momentum path always honors --momentum.
     if ns.optimizer and ns.optimizer != "momentum":
-        opt = optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
+        opt = optim.get(train_cfg.optimizer)(lr)
     else:
-        opt = optim.momentum(train_cfg.learning_rate, beta=ns.momentum)
+        opt = optim.momentum(lr, beta=ns.momentum)
     trainer = Trainer(cluster, model, opt, train_cfg)
     trainer.fit(splits)
     if cluster.is_coordinator:

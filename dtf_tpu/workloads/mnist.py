@@ -53,9 +53,11 @@ def main(argv=None) -> int:
               "synthetic data (zero-egress environment)")
 
     model = MnistMLP()
+    total_steps = (splits.train.num_examples // global_batch) * train_cfg.epochs
+    lr = optim.schedule_from_config(train_cfg, total_steps)
     # --optimizer overrides the reference's SGD (tf_distributed.py:73).
-    opt = (optim.get(train_cfg.optimizer)(train_cfg.learning_rate)
-           if ns.optimizer else optim.sgd(train_cfg.learning_rate))
+    opt = (optim.get(train_cfg.optimizer)(lr) if ns.optimizer
+           else optim.sgd(lr))
     trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode,
                       grad_compression=ns.grad_compression)
     result = trainer.fit(splits)

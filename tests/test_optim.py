@@ -144,3 +144,27 @@ class TestRegistry:
         assert optim.get("adafactor") is optim.adafactor
         with pytest.raises(ValueError, match="adafactor.*nadam"):
             optim.get("nadam")
+
+
+class TestSchedulesEverywhere:
+    @pytest.mark.parametrize("name", sorted(optim.BY_NAME))
+    def test_every_optimizer_accepts_a_schedule(self, name):
+        """--lr_schedule cosine must work with every --optimizer choice."""
+        sched = optim.warmup_cosine(0.1, 2, 20)
+        params = {"w": jnp.full((8, 8), 10.0)}
+        opt = optim.BY_NAME[name](sched)
+        state = opt.init(params)
+        p = params
+        for _ in range(3):
+            upd, state = opt.update(quad_grads(p), state, p)
+            p = optim.apply_updates(p, upd)
+        assert np.isfinite(float(jnp.sum(p["w"])))
+        assert not np.array_equal(np.asarray(p["w"]), np.asarray(params["w"]))
+
+    def test_warmup_actually_ramps(self):
+        sched = optim.warmup_cosine(1.0, warmup_steps=10, total_steps=100)
+        import jax.numpy as _jnp
+        lrs = [float(sched(_jnp.asarray(s))) for s in (1, 5, 10, 55, 100)]
+        assert lrs[0] < lrs[1] < lrs[2]          # ramp
+        assert lrs[2] == pytest.approx(1.0, abs=0.1)
+        assert lrs[3] < lrs[2] and lrs[4] < lrs[3]   # cosine decay
