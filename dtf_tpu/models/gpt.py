@@ -334,7 +334,8 @@ class GPT(Module):
 
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: int = 0,
-                 top_p: float = 1.0, rng=None):
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 rng=None):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         Two phases, one compiled program:
@@ -348,7 +349,10 @@ class GPT(Module):
           the current index so decode compiles once.
 
         temperature=0 -> greedy; top_k/top_p filter the distribution
-        (nn/sampling.py).
+        (nn/sampling.py).  With ``eos_id``, every position after a
+        sequence's first EOS is forced to ``eos_id`` (static shapes mean
+        no early exit — finished rows keep stepping but their output is
+        pinned).
         """
         from dtf_tpu.nn.sampling import sample_token
 
@@ -371,21 +375,25 @@ class GPT(Module):
         out = jnp.zeros((b, total), jnp.int32)
         out = lax.dynamic_update_slice(out, prompt, (0, 0))
         out = out.at[:, p_len].set(first)
+        done = (first == eos_id) if eos_id is not None else None
 
         # ---- decode: scan positions p_len..total-2, each reading the token
         # it just wrote and emitting the next one.
         def step(carry, pos):
-            out, cache, rng = carry
+            out, cache, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
             logits, cache = self._decode_logits(params, cache, tok, pos)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
+            if eos_id is not None:
+                nxt = jnp.where(done, eos_id, nxt)   # pin finished rows
+                done = done | (nxt == eos_id)
             out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
-            return (out, cache, rng), None
+            return (out, cache, rng, done), None
 
-        (out, _, _), _ = lax.scan(step, (out, cache, rng),
-                                  jnp.arange(p_len, total - 1))
+        (out, _, _, _), _ = lax.scan(step, (out, cache, rng, done),
+                                     jnp.arange(p_len, total - 1))
         return out
 
     def beam_search(self, params, prompt, max_new_tokens: int, *,

@@ -39,8 +39,8 @@ def main(argv=None) -> int:
 
     model = ResNet(ResNetConfig.resnet50() if ns.arch == "resnet50"
                    else ResNetConfig.tiny())
-    bs = (train_cfg.per_device_batch * cluster.num_devices
-          if train_cfg.per_device_batch else train_cfg.batch_size)
+    from dtf_tpu.workloads._driver import global_batch_size
+    bs = global_batch_size(cluster, train_cfg)
     total_steps = (splits.train.num_examples // bs) * train_cfg.epochs
     lr = optim.schedule_from_config(train_cfg, total_steps)
     # --optimizer overrides this workload's default (SGD+momentum); the

@@ -40,11 +40,12 @@ def main(argv=None) -> int:
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
+    from dtf_tpu.workloads._driver import global_batch_size
+
     cluster = bootstrap(cluster_cfg)
     # The native prefetcher needs the trainer's GLOBAL batch size (fixed
     # shapes): per_device_batch scales by the device count.
-    global_batch = (train_cfg.per_device_batch * cluster.num_devices
-                    if train_cfg.per_device_batch else train_cfg.batch_size)
+    global_batch = global_batch_size(cluster, train_cfg)
     splits = load_mnist(
         seed=train_cfg.seed,
         native_train_batch=global_batch if ns.native_loader else None)

@@ -128,3 +128,23 @@ class TestGenerateEdges:
         out_x = xla.generate(params, prompt, 4, temperature=0.0)
         assert out_f.shape == (2, 14)
         np.testing.assert_array_equal(out_f, out_x)   # pad tail is invisible
+
+    def test_eos_pins_finished_sequences(self):
+        """With eos_id, every position after a row's first EOS is EOS.
+        Small vocab makes EOS certain by construction: P(no EOS in 8x24
+        uniform-ish draws over 8 tokens) ~ (7/8)^192 ~ 8e-12."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny(vocab_size=8))
+        params = model.init(jax.random.key(0))
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(1, 8, (8, 4)), jnp.int32)
+        out = model.generate(params, prompt, 24, temperature=1.0,
+                             eos_id=0, rng=jax.random.key(2))
+        gen = np.asarray(out[:, 4:])
+        hit = False
+        for row in gen:
+            eos_pos = np.where(row == 0)[0]
+            if len(eos_pos):
+                assert (row[eos_pos[0]:] == 0).all()
+                hit = True
+        assert hit, "no sequence sampled EOS (vocab 8, 24 tokens, 8 rows)"
