@@ -1,2 +1,5 @@
 from dtf_tpu.models.mlp import MnistMLP  # noqa: F401
 from dtf_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
+from dtf_tpu.models.bert import BertConfig, BertMLM  # noqa: F401
+from dtf_tpu.models.gpt import GPT, GPTConfig  # noqa: F401
+from dtf_tpu.models.t5 import T5, T5Config  # noqa: F401
