@@ -45,6 +45,7 @@ class GPTConfig:
     rope: bool = False                 # rotary positions instead of a table
     num_kv_heads: Optional[int] = None # GQA: KV cache shrinks by H/KVH
     mlp_act: str = "gelu"              # "gelu" | "swiglu"
+    label_smoothing: float = 0.0       # eps of uniform mass in the CE loss
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -258,23 +259,30 @@ class GPT(Module):
     # --- training objective -------------------------------------------
 
     def loss(self, params, batch, rng=None, train=True):
-        """Next-token cross-entropy.  batch: tokens (B, T) int32.
+        """Next-token cross-entropy (optionally label-smoothed, see
+        GPTConfig.label_smoothing).  batch: tokens (B, T) int32.
 
         The forward runs on the FULL sequence and the logits are shifted
         (not the tokens): T stays a flash-kernel-friendly power-of-two
         instead of T-1.
         """
+        from dtf_tpu.nn.losses import smooth_token_logp
+
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
         logits = self.apply(params, tokens, train=train)[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_logp = jnp.take_along_axis(logp, targets[..., None],
                                        axis=-1)[..., 0]
-        loss = -jnp.mean(tok_logp)
+        # perplexity stays exp(true NLL), comparable across smoothing
+        # settings; only the optimized loss is smoothed.
+        nll = -jnp.mean(tok_logp)
+        loss = -jnp.mean(smooth_token_logp(logp, tok_logp,
+                                           self.cfg.label_smoothing))
         acc = jnp.mean((jnp.argmax(logits, -1) == targets)
                        .astype(jnp.float32))
         return loss, {"accuracy": acc,
-                      "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+                      "perplexity": jnp.exp(jnp.minimum(nll, 20.0))}
 
     def eval_metrics(self, params, batch):
         loss, aux = self.loss(params, batch, train=False)

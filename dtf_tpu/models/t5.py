@@ -45,6 +45,7 @@ class T5Config:
     remat: bool = False
     pad_id: int = 0           # also the loss mask
     bos_id: int = 1           # decoder start token
+    label_smoothing: float = 0.0   # eps of uniform mass in the CE loss
 
     @classmethod
     def small(cls, **kw):
@@ -277,8 +278,12 @@ class T5(Module):
         src, tgt = batch["src"], batch["tgt"]
         logits = self.apply(params, (src, self._shift_right(tgt)),
                             train=train, rng=rng)
+        from dtf_tpu.nn.losses import smooth_token_logp
+
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_logp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        tok_logp = smooth_token_logp(logp, tok_logp,
+                                     self.cfg.label_smoothing)
         weight = (tgt != self.cfg.pad_id).astype(jnp.float32)
         denom = jnp.maximum(jnp.sum(weight), 1.0)
         loss = -jnp.sum(tok_logp * weight) / denom

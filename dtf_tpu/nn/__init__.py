@@ -16,10 +16,11 @@ from dtf_tpu.nn.layers import (
 )
 from dtf_tpu.nn.losses import (
     softmax_cross_entropy, naive_cross_entropy, accuracy, mse,
+    smooth_token_logp,
 )
 
 __all__ = [
     "Module", "Sequential", "Dense", "Embedding", "LayerNorm", "BatchNorm",
     "Conv2D", "Dropout", "softmax_cross_entropy", "naive_cross_entropy",
-    "accuracy", "mse",
+    "accuracy", "mse", "smooth_token_logp",
 ]

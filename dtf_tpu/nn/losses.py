@@ -42,3 +42,17 @@ def accuracy(logits_or_probs: jax.Array, labels_onehot: jax.Array) -> jax.Array:
 
 def mse(pred: jax.Array, target: jax.Array) -> jax.Array:
     return jnp.mean((pred - target) ** 2)
+
+
+def smooth_token_logp(logp: jax.Array, tok_logp: jax.Array,
+                      eps: float) -> jax.Array:
+    """Label-smoothed target log-likelihood: mix ``eps`` of uniform mass
+    into the one-hot target — ``(1-eps)·logp[target] + eps·mean(logp)``.
+    The ONE definition used by every LM loss (gpt.py, t5.py); validates
+    ``0 <= eps < 1`` (eps >= 1 would flip the objective's sign on the true
+    target — a typo like 1.5-for-0.15 must error, not train wrong)."""
+    if not 0.0 <= eps < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got {eps}")
+    if eps == 0.0:
+        return tok_logp
+    return (1.0 - eps) * tok_logp + eps * jnp.mean(logp, axis=-1)

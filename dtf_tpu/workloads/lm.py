@@ -52,6 +52,8 @@ def main(argv=None) -> int:
     parser.add_argument("--beam_size", type=int, default=0,
                         help=">1: deterministic beam search instead of "
                              "sampling")
+    parser.add_argument("--label_smoothing", type=float, default=0.0,
+                        help="eps of uniform mass in the CE loss")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -60,7 +62,7 @@ def main(argv=None) -> int:
     logger = MetricLogger(train_cfg.logdir, cluster.is_coordinator)
 
     kw = {"dtype": jnp.bfloat16 if ns.bf16 else jnp.float32,
-          "remat": ns.remat}
+          "remat": ns.remat, "label_smoothing": ns.label_smoothing}
     if ns.attn != "auto":
         kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:

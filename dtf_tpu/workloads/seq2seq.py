@@ -34,6 +34,8 @@ def main(argv=None) -> int:
     parser.add_argument("--bf16", action="store_true")
     parser.add_argument("--eval_examples", type=int, default=32,
                         help="held-out sources to decode for exact-match")
+    parser.add_argument("--label_smoothing", type=float, default=0.0,
+                        help="eps of uniform mass in the CE loss")
     parser.set_defaults(learning_rate=3e-3)   # task-suited default
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
@@ -46,7 +48,8 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if ns.bf16 else jnp.float32
     kw = dict(dtype=dtype, max_src_len=max(ns.seq_len, 16),
-              max_tgt_len=max(ns.seq_len, 16))
+              max_tgt_len=max(ns.seq_len, 16),
+              label_smoothing=ns.label_smoothing)
     cfg = (T5Config.small(**kw) if ns.preset == "small"
            else T5Config.tiny(**kw))
     model = T5(cfg)
