@@ -114,6 +114,11 @@ class TrainConfig:
     # microbatches inside the compiled step (same trajectory, less
     # activation memory).
     grad_accum: int = 1
+    # Multi-process data path: each host feeds only ITS contiguous slice of
+    # every global batch (Dataset.process_shard + put_process_batch —
+    # bitwise-identical trajectory to the global-batch path).  Disable to
+    # fall back to every host materializing the full global batch.
+    shard_data: bool = True
     checkpoint_every: int = 0         # steps; 0 disables (ref had no checkpointing, SURVEY §5.4)
     resume: bool = False
     # SIGTERM (TPU preemption / spot reclamation) -> checkpoint at the next
@@ -159,7 +164,9 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") 
         typ = _field_type(cls, f)
         kwargs = {"default": None}
         if typ is bool:
-            kwargs["action"] = "store_true"
+            # default-True bools need an off switch (--no-<flag>)
+            kwargs["action"] = (argparse.BooleanOptionalAction
+                                if f.default is True else "store_true")
         elif typ in (int, float, str):
             kwargs["type"] = typ
         else:

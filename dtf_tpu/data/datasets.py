@@ -35,35 +35,35 @@ from typing import Iterator, Optional
 import numpy as np
 
 
-@dataclasses.dataclass
-class Dataset:
-    """In-memory split with the reference's ``next_batch`` contract."""
+class _ShuffledSplit:
+    """Shared shuffle-cursor machinery behind the ``next_batch`` contract.
 
-    images: np.ndarray          # (N, ...) float32
-    labels: np.ndarray          # (N, num_classes) one-hot float32
-    seed: int = 1
+    Subclasses store the payload and implement ``take(idx)`` (gather rows)
+    and ``examples(lo, hi)`` (sequential rows for eval — the generic
+    accessor the trainer's eval loop uses so it never touches
+    ``.images``/``.labels`` directly)."""
 
-    def __post_init__(self):
+    def _init_cursor(self):
         self._rng = np.random.default_rng(self.seed)
-        self._order = np.arange(len(self.images))
+        self._order = np.arange(self.num_examples)
         self._rng.shuffle(self._order)
         self._pos = 0
         self.batches_consumed = 0
 
-    @property
-    def num_examples(self) -> int:
-        return len(self.images)
-
-    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
-        """Sequential batches over a shuffled epoch; reshuffles at the end
+    def _advance(self, batch_size: int) -> np.ndarray:
+        """Shuffled row indices for the next batch; reshuffles at epoch end
         (mnist.train.next_batch semantics, tf_distributed.py:108)."""
         if self._pos + batch_size > self.num_examples:
             self._rng.shuffle(self._order)
             self._pos = 0
         idx = self._order[self._pos:self._pos + batch_size]
         self._pos += batch_size
+        return idx
+
+    def next_batch(self, batch_size: int):
+        idx = self._advance(batch_size)
         self.batches_consumed += 1
-        return self.images[idx], self.labels[idx]
+        return self.take(idx)
 
     def fast_forward(self, n_batches: int, batch_size: int) -> None:
         """Advance the shuffle cursor as if ``next_batch`` had been called
@@ -76,17 +76,47 @@ class Dataset:
             self._pos += batch_size
         self.batches_consumed += n_batches
 
+    def process_shard(self, process_index: int,
+                      process_count: int) -> "ProcessShard":
+        """Per-host view for true multi-host loading: serves this process's
+        contiguous rows of each *global* batch (pair with
+        ``put_process_batch``)."""
+        return ProcessShard(self, process_index, process_count)
+
+
+@dataclasses.dataclass
+class Dataset(_ShuffledSplit):
+    """In-memory split with the reference's ``next_batch`` contract."""
+
+    images: np.ndarray          # (N, ...) float32
+    labels: np.ndarray          # (N, num_classes) one-hot float32
+    seed: int = 1
+
+    def __post_init__(self):
+        self._init_cursor()
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.images)
+
+    def take(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        return self.images[idx], self.labels[idx]
+
+    def examples(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:
+        return self.images[lo:hi], self.labels[lo:hi]
+
     def epoch_batches(self, batch_size: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         for _ in range(self.num_examples // batch_size):
             yield self.next_batch(batch_size)
 
     def shard(self, process_index: int, process_count: int) -> "Dataset":
-        """Disjoint per-host partition for true multi-host data loading
-        (pair with ``put_process_batch``): process k keeps examples
-        ``k::process_count`` — strided, so class structure survives sorted
-        storage — with a per-shard shuffle seed.  The trailing remainder
-        (< process_count examples) is dropped so every shard has equal
-        length (collectives need equal local batch sizes)."""
+        """Disjoint per-host partition with an independent shuffle stream:
+        process k keeps examples ``k::process_count`` — strided, so class
+        structure survives sorted storage — with a per-shard shuffle seed.
+        The trailing remainder (< process_count examples) is dropped so
+        every shard has equal length (collectives need equal local batch
+        sizes).  Unlike :meth:`process_shard` the resulting trajectory
+        differs from the global-batch path (different batch composition)."""
         n = (self.num_examples // process_count) * process_count
         sel = np.arange(process_index, n, process_count)
         return Dataset(self.images[sel], self.labels[sel],
@@ -94,10 +124,112 @@ class Dataset:
 
 
 @dataclasses.dataclass
+class TokenDataset(_ShuffledSplit):
+    """Token sequences (N, T) int32 under the same ``next_batch`` contract,
+    producing ``{"tokens": (B, T)}`` batches — the LM/seq2seq counterpart of
+    :class:`Dataset`, so the ONE trainer loop (checkpoint/resume/preemption/
+    watchdog) drives every model family."""
+
+    tokens: np.ndarray
+    seed: int = 1
+
+    def __post_init__(self):
+        self.tokens = np.asarray(self.tokens, np.int32)
+        self._init_cursor()
+
+    @property
+    def num_examples(self) -> int:
+        return len(self.tokens)
+
+    def take(self, idx: np.ndarray) -> dict:
+        return {"tokens": self.tokens[idx]}
+
+    def examples(self, lo: int, hi: int) -> dict:
+        return {"tokens": self.tokens[lo:hi]}
+
+    def shard(self, process_index: int, process_count: int) -> "TokenDataset":
+        n = (self.num_examples // process_count) * process_count
+        sel = np.arange(process_index, n, process_count)
+        return TokenDataset(self.tokens[sel],
+                            seed=self.seed + 7919 * process_index)
+
+
+class ProcessShard:
+    """Per-host view of a split for true multi-host data loading.
+
+    Serves this process's CONTIGUOUS rows of each global batch — the rows
+    ``put_process_batch`` expects process k to contribute — by advancing the
+    SAME shuffle stream as the global path and gathering only its own slice.
+    The union of all processes' slices at step i is exactly the global batch
+    at step i, so the optimization trajectory is bitwise-identical to
+    ``put_global_batch`` while each host materializes 1/nproc of the data.
+    """
+
+    def __init__(self, base: _ShuffledSplit, process_index: int,
+                 process_count: int):
+        self.base = base
+        self.k = process_index
+        self.n = process_count
+        # Mirror the base's consumption so resume bookkeeping (trainer's
+        # `behind` computation) survives wrapping mid-stream.
+        self.batches_consumed = base.batches_consumed
+
+    @property
+    def num_examples(self) -> int:
+        # Global count: batch_count math must match the global path.
+        return self.base.num_examples
+
+    def next_batch(self, local_batch: int):
+        idx = self.base._advance(local_batch * self.n)
+        self.base.batches_consumed += 1
+        self.batches_consumed += 1
+        return self.base.take(idx[self.k * local_batch:
+                                  (self.k + 1) * local_batch])
+
+    def fast_forward(self, n_batches: int, local_batch: int) -> None:
+        self.base.fast_forward(n_batches, local_batch * self.n)
+        self.batches_consumed += n_batches
+
+    def examples(self, lo: int, hi: int):
+        return self.base.examples(lo, hi)
+
+
+@dataclasses.dataclass
 class DataSplits:
-    train: Dataset
-    test: Dataset
+    train: "Dataset"
+    test: Optional["Dataset"] = None     # None: trainer skips evaluation
     synthetic: bool = False
+
+
+class CallableDataset:
+    """Adapter giving a ``batch_index -> host batch`` callable the
+    ``next_batch`` contract (benchmark workloads that synthesize batches on
+    the fly, e.g. seq2seq source/target pairs).  Fixed batch size; no
+    shuffling of its own (the callable owns batch composition)."""
+
+    def __init__(self, fn, batch_size: int, num_batches: int):
+        self.fn = fn
+        self.batch_size = batch_size
+        self.num_batches = num_batches
+        self._i = 0
+        self.batches_consumed = 0
+
+    @property
+    def num_examples(self) -> int:
+        return self.batch_size * self.num_batches
+
+    def next_batch(self, batch_size: int):
+        if batch_size != self.batch_size:
+            raise ValueError(f"CallableDataset serves fixed batches of "
+                             f"{self.batch_size}, asked for {batch_size}")
+        out = self.fn(self._i)
+        self._i += 1
+        self.batches_consumed += 1
+        return out
+
+    def fast_forward(self, n_batches: int, batch_size: int) -> None:
+        self._i += n_batches
+        self.batches_consumed += n_batches
 
 
 def _read_idx(path: str) -> np.ndarray:

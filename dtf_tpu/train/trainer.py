@@ -54,6 +54,15 @@ def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
     else:
         params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
     opt_state = optimizer.init(params)
+    # Per-param leaves (m/v/...) inherit the params' committed shardings,
+    # but fresh scalar leaves (e.g. adam's step counter) are uncommitted
+    # single-device arrays — a checkpoint restore would pin them to device
+    # 0 (the template's sharding) and poison the next step_fn call with
+    # mixed device sets.  Commit every uncommitted leaf as mesh-replicated.
+    rep = sh.replicate(mesh)
+    opt_state = jax.tree_util.tree_map(
+        lambda x: x if getattr(x, "committed", False)
+        else jax.device_put(x, rep), opt_state)
     state = {"params": params, "opt_state": opt_state,
              "step": sh.replicate(mesh, jnp.zeros((), jnp.int32))}
     if hasattr(model, "init_model_state"):
@@ -136,7 +145,8 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     mesh: Mesh, mode: str = "implicit",
                     donate: bool = True, stateful: bool = False,
                     grad_accum: int = 1,
-                    grad_compression: Optional[str] = None) -> Callable:
+                    grad_compression: Optional[str] = None,
+                    grads_fn: Optional[Callable] = None) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
@@ -164,6 +174,12 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     across shards.  The two converge as per-shard batch grows.
     """
 
+    if grads_fn is not None and (mode != "implicit" or grad_accum != 1
+                                 or stateful):
+        raise ValueError(
+            "grads_fn (a model that produces its own gradients, e.g. the "
+            "1F1B pipeline schedule) requires implicit mode, grad_accum=1, "
+            "and a stateless model — the schedule owns the backward pass")
     if grad_compression not in (None, "int8"):
         raise ValueError(f"grad_compression must be None or 'int8', got "
                          f"{grad_compression!r}")
@@ -224,7 +240,10 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     def grads_and_update(state, batch, rng, sync):
         params, opt_state, step = state["params"], state["opt_state"], state["step"]
         model_state = state.get("model_state")
-        if grad_accum > 1:
+        if grads_fn is not None:
+            loss, aux, grads = grads_fn(params, batch, rng)
+            new_ms = None
+        elif grad_accum > 1:
             loss, aux, new_ms, grads = accumulated_grads(
                 params, model_state, batch, rng)
         else:
@@ -305,14 +324,20 @@ def make_eval_fn(model, mesh: Mesh, stateful: bool = False) -> Callable:
         down to a multiple of the data-axis device count and run sharded;
         only the sub-``data_size`` tail runs *replicated* (same compute on
         every device, exact result) — one extra compile for its shape,
-        once."""
+        once.  Datasets expose sequential rows via ``examples(lo, hi)``
+        (any batch pytree the model's eval accepts); the legacy
+        ``.images``/``.labels`` pair is a fallback."""
         n_total = dataset.num_examples
         totals, i = None, 0
         while i < n_total:
             take = min(batch_size, n_total - i)
             if take >= data_size:
                 take -= take % data_size
-            batch = (dataset.images[i:i + take], dataset.labels[i:i + take])
+            if hasattr(dataset, "examples"):
+                batch = dataset.examples(i, i + take)
+            else:
+                batch = (dataset.images[i:i + take],
+                         dataset.labels[i:i + take])
             if take % data_size == 0:
                 batch = put_global_batch(mesh, batch)
             elif jax.process_count() == 1:
@@ -350,12 +375,34 @@ class Trainer:
         self.logger = self.logger or MetricLogger(
             self.cfg.logdir, self.cluster.is_coordinator)
         stateful = hasattr(self.model, "init_model_state")
+        # Models that must produce their own gradients (1F1B pipeline
+        # schedules interleave fwd/bwd and cannot be expressed as jax.grad
+        # of a forward pass) expose custom_grads_fn.
+        grads_fn = getattr(self.model, "custom_grads_fn", None)
         self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
                                        mode=self.mode, stateful=stateful,
                                        grad_accum=self.cfg.grad_accum,
-                                       grad_compression=self.grad_compression)
+                                       grad_compression=self.grad_compression,
+                                       grads_fn=grads_fn)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
-        self.state = init_state(self.model, self.optimizer, self.cfg.seed, mesh)
+        # Parameter placement from the model's logical axes: FSDP when the
+        # mesh has an 'fsdp' axis, tensor/expert/... sharding per the rule
+        # table; pure-data meshes resolve every axis to None = replicated
+        # (the previous behavior).  Explicit shard_map mode keeps fully
+        # replicated params (its per-device code assumes P() params).
+        shardings = None
+        if self.mode == "implicit":
+            rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
+                     else sh.DEFAULT_RULES)
+            try:
+                shardings = sh.apply_rules(self.model.axes(), mesh, rules)
+            except NotImplementedError:   # model without logical axes
+                pass
+        self.state = init_state(self.model, self.optimizer, self.cfg.seed,
+                                mesh, param_shardings=shardings)
+        # Last train-step metrics (device values; reading defers the sync
+        # to the caller) — benchmark drivers report these after fit().
+        self.last_metrics: dict = {}
         self.ckpt = None
         if self.cfg.checkpoint_every > 0 or self.cfg.resume:
             from dtf_tpu.train.checkpoint import CheckpointManager
@@ -391,7 +438,8 @@ class Trainer:
             return self.cfg.per_device_batch * self.cluster.num_devices
         return self.cfg.batch_size
 
-    def fit(self, splits, epochs: Optional[int] = None) -> dict:
+    def fit(self, splits, epochs: Optional[int] = None,
+            max_steps: Optional[int] = None) -> dict:
         """Epoch loop with the reference's exact console contract.
 
         Resume-correct: the per-step rng is derived by folding the global
@@ -399,6 +447,13 @@ class Trainer:
         data cursor and epoch budget fast-forward to the restored step, so
         a resumed run continues the interrupted trajectory instead of
         re-feeding consumed batches.
+
+        ``max_steps`` caps total optimizer steps across epochs (the
+        benchmark workloads' fixed-step budget).  ``splits.test=None``
+        skips evaluation.  Multi-process with ``cfg.shard_data`` (default):
+        each host feeds only its contiguous slice of every global batch via
+        ``Dataset.process_shard`` + ``put_process_batch`` — same trajectory
+        as the global-batch path, 1/nproc the host-side data.
         """
         mesh = self.cluster.mesh
         cfg = self.cfg
@@ -408,20 +463,29 @@ class Trainer:
         timer = StepTimer()
         last_cost = float("nan")
 
-        batch_count = splits.train.num_examples // bs       # :104
+        train, feed_bs, put = splits.train, bs, put_global_batch
+        nproc = jax.process_count()
+        if (cfg.shard_data and nproc > 1
+                and hasattr(splits.train, "process_shard")
+                and bs % nproc == 0
+                and sh.data_axis_size(mesh) % nproc == 0):
+            train = splits.train.process_shard(jax.process_index(), nproc)
+            feed_bs, put = bs // nproc, put_process_batch
+
+        batch_count = train.num_examples // bs              # :104
         start_epoch = (min(self._host_step // batch_count, epochs)
                        if batch_count else 0)
         skip_batches = self._host_step % batch_count if batch_count else 0
         # Fast-forward the shuffle cursor to where it was when the checkpoint
         # was written — but only by the batches this dataset hasn't already
         # served (a second fit() on the same dataset must not double-advance).
-        behind = self._host_step - getattr(splits.train, "batches_consumed", 0)
+        behind = self._host_step - getattr(train, "batches_consumed", 0)
         if behind > 0 and start_epoch < epochs:
-            if hasattr(splits.train, "fast_forward"):
-                splits.train.fast_forward(behind, bs)
+            if hasattr(train, "fast_forward"):
+                train.fast_forward(behind, feed_bs)
             else:   # foreign dataset with only the next_batch contract
                 for _ in range(behind):
-                    splits.train.next_batch(bs)
+                    train.next_batch(feed_bs)
 
         ev = {"accuracy": float("nan")}
         if cfg.hang_timeout_s > 0:
@@ -433,14 +497,19 @@ class Trainer:
             preempt = PreemptionHandler()
         preempted = False
         try:
+            hit_cap = False
             for epoch in range(start_epoch, epochs):
                 count = 0
                 first_batch = skip_batches if epoch == start_epoch else 0
                 for i in range(first_batch, batch_count):
-                    batch = put_global_batch(mesh, splits.train.next_batch(bs))
+                    if max_steps is not None and self._host_step >= max_steps:
+                        hit_cap = True
+                        break
+                    batch = put(mesh, train.next_batch(feed_bs))
                     step_rng = jax.random.fold_in(rng_base, self._host_step)
                     self.state, metrics = self.step_fn(self.state, batch,
                                                        step_rng)
+                    self.last_metrics = metrics
                     count += 1
                     self._host_step += 1
                     if self._watchdog is not None:
@@ -491,15 +560,17 @@ class Trainer:
                         self.logger.scalar(step, "avg_ms", avg_ms)
                         count = 0
                         last_cost = cost
-                if preempted:
+                if preempted or hit_cap:
                     break
-                with self._suspended_watchdog():
-                    ev = self.eval_fn(self.state, splits.test)
-                self.logger.epoch_summary(ev["accuracy"], timer.total_s(),
-                                          last_cost)
-                self.logger.scalar(int(self.state["step"]), "test_accuracy",
-                                   ev["accuracy"])
-            if start_epoch >= epochs:   # resumed past the budget: report eval
+                if splits.test is not None:
+                    with self._suspended_watchdog():
+                        ev = self.eval_fn(self.state, splits.test)
+                    self.logger.epoch_summary(ev["accuracy"], timer.total_s(),
+                                              last_cost)
+                    self.logger.scalar(int(self.state["step"]),
+                                       "test_accuracy", ev["accuracy"])
+            if start_epoch >= epochs and splits.test is not None:
+                # resumed past the budget: report eval
                 with self._suspended_watchdog():
                     ev = self.eval_fn(self.state, splits.test)
         finally:

@@ -1,17 +1,20 @@
-"""Shared fixed-step pretrain-benchmark driver for the LM workloads.
+"""Shared pretrain-benchmark driver: Trainer-backed fixed-step runs with
+timing/MFU reporting.
 
-One implementation of the mesh/sharding setup, the two-step warmup protocol
-(first compiles, second settles post-step sharding layouts), the windowed
-step timing with ``block_until_ready`` sync points, and the summary line —
-used by ``bert_pretrain`` and ``lm`` so the timing methodology cannot
-drift between workloads.
+The training loop itself is :class:`dtf_tpu.train.trainer.Trainer` — ONE
+loop for every model family, so the LM/seq2seq benchmarks get checkpoint/
+resume, preemption saves, the hang watchdog, and per-host data sharding
+exactly like the MNIST/CIFAR workloads.  This module adds only what a
+benchmark needs on top: the two-step untimed compile warmup (first step
+compiles, second settles post-step sharding layouts), wall-clock step
+timing around ``fit``, and the throughput / model-FLOPs-utilization
+summary.
 """
 
 from __future__ import annotations
 
 import time
-from contextlib import nullcontext as _nullcontext
-from typing import Any, Callable, Optional
+from typing import Optional
 
 import jax
 import numpy as np
@@ -28,13 +31,11 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
                        steps: int, *, tokens_per_example: int,
                        throughput_unit: str = "tok",
                        flops_tokens_per_example: Optional[int] = None) -> tuple:
-    """Run ``steps`` timed train steps.
+    """Run ``steps`` timed train steps through the Trainer.
 
-    ``toks`` is either an (N, T) int32 array sliced into global batches, or
-    a callable ``i -> host batch`` (any pytree the model's loss accepts) —
-    the seam that lets every workload share ONE timing methodology
-    (two-step untimed compile warmup, windowed ``block_until_ready``
-    timing, watchdog, sharding rules).
+    ``toks`` is either an (N, T) int32 array (wrapped in a TokenDataset —
+    shuffled epochs, per-host sharding in multi-process runs) or a callable
+    ``i -> host batch`` (any pytree the model's loss accepts).
 
     Returns (state, metrics, ms_per_step).  Prints the reference step-line
     contract plus a Step-Time/Throughput summary, and — when the chip's
@@ -48,96 +49,78 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
     ``toks`` — e.g. src_len + tgt_len for an encoder-decoder).
     """
     from dtf_tpu import optim
-    from dtf_tpu.parallel import sharding as sh
-    from dtf_tpu.train.metrics import format_step_line
-    from dtf_tpu.train.trainer import init_state, make_train_step, put_global_batch
+    from dtf_tpu.data.datasets import (CallableDataset, DataSplits,
+                                       TokenDataset)
+    from dtf_tpu.train.trainer import Trainer, put_global_batch
     from dtf_tpu.utils.timing import block
 
     mesh = cluster.mesh
     global_batch = global_batch_size(cluster, train_cfg)
-    rules = (sh.fsdp_rules() if "fsdp" in mesh.axis_names
-             else sh.DEFAULT_RULES)
-    shardings = sh.apply_rules(model.axes(), mesh, rules)
     # +2: the two untimed compile-warmup steps below also advance the
     # optimizer's schedule counter.
-    lr = optim.schedule_from_config(train_cfg, steps + 2)
+    budget = steps + 2
+    lr = optim.schedule_from_config(train_cfg, budget)
     opt = optim.get(train_cfg.optimizer)(lr)
-    state = init_state(model, opt, seed=train_cfg.seed, mesh=mesh,
-                       param_shardings=shardings)
-    step_fn = make_train_step(model.loss, opt, mesh,
-                              grad_accum=train_cfg.grad_accum)
-
-    rng_base = jax.random.key(train_cfg.seed + 17)
 
     if callable(toks):
         if flops_tokens_per_example is None:
             raise ValueError("flops_tokens_per_example is required when "
                              "toks is a batch-producing callable")
-
-        def batch_at(i):
-            return put_global_batch(mesh, toks(i))
+        train = CallableDataset(toks, global_batch, budget)
     else:
-        n_batches = len(toks) // global_batch
+        train = TokenDataset(toks, seed=train_cfg.seed)
+    splits = DataSplits(train=train, test=None)
+    batch_count = max(train.num_examples // global_batch, 1)
+    epochs = -(-budget // batch_count)          # ceil: enough epochs for all
 
-        def batch_at(i):
-            j = (i % n_batches) * global_batch
-            return put_global_batch(mesh, toks[j:j + global_batch])
+    trainer = Trainer(cluster, model, opt, train_cfg, logger=logger)
 
-    # Fail-fast watchdog (--hang_timeout_s), same contract as Trainer.fit:
-    # armed only for the loop, suspended across the compile-heavy warmup.
-    watchdog = None
-    if train_cfg.hang_timeout_s > 0:
-        from dtf_tpu.utils.watchdog import HangWatchdog
-        watchdog = HangWatchdog(train_cfg.hang_timeout_s)
+    # Warmup (fresh runs only — a --resume continuation is already
+    # compiled-shaped by its restored state and must not re-feed batches):
+    # two real trajectory steps, untimed, same per-step rng derivation as
+    # Trainer.fit so the overall batch/rng stream is identical to one
+    # uninterrupted run.
+    rng_base = jax.random.key(train_cfg.seed + 17)
+    if trainer._host_step == 0:
+        for _ in range(2):
+            batch = put_global_batch(mesh, train.next_batch(global_batch))
+            step_rng = jax.random.fold_in(rng_base, trainer._host_step)
+            trainer.state, trainer.last_metrics = trainer.step_fn(
+                trainer.state, batch, step_rng)
+            trainer._host_step += 1
+            block(trainer.state)
 
-    try:
-        # two warmup steps (untimed): first compiles, second runs with the
-        # settled post-step state shardings (a sharding-layout change after
-        # step one can trigger one more compile)
-        metrics = {}
-        with (watchdog.suspend() if watchdog is not None
-              else _nullcontext()):
-            for w in range(2):
-                state, metrics = step_fn(state, batch_at(w), jax.random.key(w))
-                block(state)
-
-        # Active params: MoE models route each token through top_k of E
-        # experts, so only a fraction of expert weights do FLOPs per token —
-        # models expose active_param_count; dense models use the total.
-        if hasattr(model, "active_param_count"):
-            n_params = int(model.active_param_count(state["params"]))
-        else:
-            from dtf_tpu.nn.core import count_params
-            n_params = int(count_params(state["params"]))
+    if hasattr(model, "active_param_count"):
+        n_params = int(model.active_param_count(trainer.state["params"]))
+    else:
+        from dtf_tpu.nn.core import count_params
+        n_params = int(count_params(trainer.state["params"]))
+    if hasattr(model, "train_flops_per_example"):
+        # Model-accounted FLOPs (e.g. BERT's K-position MLM head runs the
+        # vocab projection on K < T positions — 6·P·T would overcount).
+        model_flops = (model.train_flops_per_example(trainer.state["params"])
+                       * global_batch)
+    else:
         flops_tokens = (flops_tokens_per_example if flops_tokens_per_example
                         is not None else toks.shape[1])
         model_flops = 6.0 * n_params * global_batch * flops_tokens
 
-        t0 = time.perf_counter()
-        window_t, window_n = t0, 0
-        for i in range(steps):
-            state, metrics = step_fn(
-                state, batch_at(i + 1), jax.random.fold_in(rng_base, i))
-            window_n += 1
-            if watchdog is not None:
-                watchdog.tick()
-            if (i + 1) % train_cfg.log_frequency == 0 or i + 1 == steps:
-                block(state)
-                now = time.perf_counter()
-                avg_ms = (now - window_t) * 1000.0 / max(window_n, 1)
-                logger.print(format_step_line(
-                    int(state["step"]), 1, i + 1, steps,
-                    float(metrics["loss"]), avg_ms))
-                logger.scalar(int(state["step"]), "cost", float(metrics["loss"]))
-                logger.scalar(int(state["step"]), "avg_ms", avg_ms)
-                window_t, window_n = now, 0
-        block(state)
-    finally:
-        if watchdog is not None:
-            watchdog.close()
+    pre_fit = trainer._host_step
+    t0 = time.perf_counter()
+    trainer.fit(splits, epochs=epochs, max_steps=budget)
     total_s = time.perf_counter() - t0
-    ms_per_step = total_s * 1000.0 / steps
-    per_s = steps * global_batch * tokens_per_example / total_s
+    steps_run = max(trainer._host_step - pre_fit, 1)
+
+    metrics = trainer.last_metrics
+    if not metrics:
+        # Resumed at/past the step budget: no step ran this invocation.
+        # Report eval-computed metrics so callers' summary lines still work.
+        logger.print(f"[dtf_tpu] resumed at step {trainer._host_step} >= "
+                     f"budget {budget}; no further training steps")
+        batch = put_global_batch(mesh, train.next_batch(global_batch))
+        metrics = jax.jit(model.eval_metrics)(trainer.state["params"], batch)
+    ms_per_step = total_s * 1000.0 / steps_run
+    per_s = steps_run * global_batch * tokens_per_example / total_s
     logger.print("Total Time: %3.2fs" % total_s)
     logger.print(f"Step-Time: {ms_per_step:.2f}ms  "
                  f"Throughput: {per_s:.1f} {throughput_unit}/s  "
@@ -151,5 +134,6 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
            f"{dtype_str} peak" if peak else "")
     logger.print(f"Model-Compute: {tflops_chip:.1f} TFLOP/s/chip "
                  f"(6·P·T, {n_params / 1e6:.1f}M active params){mfu}")
-    logger.scalar(int(state["step"]), "model_tflops_per_chip", tflops_chip)
-    return state, metrics, ms_per_step
+    logger.scalar(int(trainer.state["step"]), "model_tflops_per_chip",
+                  tflops_chip)
+    return trainer.state, metrics, ms_per_step

@@ -83,6 +83,40 @@ class TestMultiProcess:
         # process 0 prints, SURVEY.md §7 'multi-host SPMD mental model')
         assert "Test-Accuracy" not in outs[1]
 
+    def test_sharded_data_trajectory_matches_single_process(self, tmp_path):
+        """cfg.shard_data (the multi-process default): each host feeds only
+        its contiguous slice of every global batch (ProcessShard +
+        put_process_batch).  The optimization trajectory must be IDENTICAL
+        to one process feeding full global batches — same final cost and
+        test accuracy to every printed digit."""
+        import re
+
+        single = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.mnist",
+              "--epochs", "1", "--batch_size", "128",
+              "--log_frequency", "50",
+              "--logdir", str(tmp_path / "single")]],
+            n_local_devices=8, cwd=tmp_path)
+        port = free_port()
+        duo = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.mnist",
+              "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "data=-1",
+              "--epochs", "1", "--batch_size", "128",
+              "--log_frequency", "50",
+              "--logdir", str(tmp_path / f"duo{task}")]
+             for task in range(2)],
+            n_local_devices=4, cwd=tmp_path)
+
+        def metrics(out):
+            cost = re.search(r"Final Cost: ([0-9.]+)", out)
+            acc = re.search(r"Test-Accuracy: ([0-9.]+)", out)
+            assert cost and acc, out[-2000:]
+            return cost.group(1), acc.group(1)
+
+        assert metrics(single[0]) == metrics(duo[0])
+
     def test_int8_ring_crosses_process_boundary(self, tmp_path):
         """The quantized ring's ppermute hops span the 2-process mesh: the
         explicit int8 gradient sync must work over the DCN path too."""

@@ -47,6 +47,49 @@ class TestDatasetShard:
         assert a != b
 
 
+class TestProcessShard:
+    """process_shard: contiguous slices of the SAME shuffle stream, so the
+    union of all hosts' slices at step i IS the global batch at step i
+    (bitwise-identical trajectory to put_global_batch)."""
+
+    def _mk(self, seed=3):
+        n = 64
+        imgs = np.arange(n, dtype=np.float32)[:, None]
+        labels = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+        return Dataset(imgs, labels, seed=seed)
+
+    def test_slices_reassemble_global_batches(self):
+        ds_global = self._mk()
+        views = [self._mk().process_shard(k, 2) for k in range(2)]
+        for _ in range(10):   # crosses an epoch reshuffle at 64/16
+            gx, gy = ds_global.next_batch(16)
+            parts = [v.next_batch(8) for v in views]
+            np.testing.assert_array_equal(
+                np.concatenate([p[0] for p in parts]), gx)
+            np.testing.assert_array_equal(
+                np.concatenate([p[1] for p in parts]), gy)
+
+    def test_fast_forward_stays_aligned(self):
+        ds_global = self._mk()
+        view = self._mk().process_shard(1, 2)
+        for _ in range(3):
+            ds_global.next_batch(16)
+        view.fast_forward(3, 8)
+        gx, _ = ds_global.next_batch(16)
+        vx, _ = view.next_batch(8)
+        np.testing.assert_array_equal(vx, gx[8:])
+
+    def test_token_dataset_shards_too(self):
+        from dtf_tpu.data.datasets import TokenDataset
+        toks = np.arange(32 * 4, dtype=np.int32).reshape(32, 4)
+        g = TokenDataset(toks, seed=5)
+        views = [TokenDataset(toks, seed=5).process_shard(k, 2)
+                 for k in range(2)]
+        gb = g.next_batch(8)["tokens"]
+        parts = [v.next_batch(4)["tokens"] for v in views]
+        np.testing.assert_array_equal(np.concatenate(parts), gb)
+
+
 @pytest.mark.slow
 class TestTwoProcess:
     def test_loss_equals_full_batch(self, mesh8):
