@@ -24,7 +24,7 @@ from jax import lax
 
 from dtf_tpu.nn.attention import (MultiHeadAttention, causal_mask,
                                   dot_product_attention)
-from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.core import Module, remat
 from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
 
 NEG_BIG = -1e30
@@ -46,6 +46,14 @@ class GPTConfig:
     num_kv_heads: Optional[int] = None # GQA: KV cache shrinks by H/KVH
     mlp_act: str = "gelu"              # "gelu" | "swiglu"
     label_smoothing: float = 0.0       # eps of uniform mass in the CE loss
+    # Checkpoint policy when remat is on: "full" | "dots" (nn/core.remat).
+    remat_policy: str = "full"
+    # >0: compute the CE loss in sequence chunks of this size under
+    # jax.checkpoint, so the (B, T, V) fp32 logits tensor — at GPT-2 scale
+    # the single largest activation (B=32, T=1024: 6.6 GB) — is never
+    # materialized; backward recomputes each chunk's logits from its
+    # (B, C, D) hidden slice.  0 = one dense head pass.
+    loss_chunk: int = 0
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -150,17 +158,36 @@ class GPTBlock(Module):
         y, _, _ = self.prefill(params, x)
         return y
 
-    def decode_step(self, params, x_t, cache, pos):
+    def decode_step(self, params, x_t, cache, pos, packed=None,
+                    visible_bias=None):
         """One token through the block with a KV cache.
 
-        x_t: (B, 1, D); cache: {"k","v"}: (B, T_max, KVH, Dh); pos: scalar
+        x_t: (B, 1, D); cache: {"k","v"}: (B, T_cache, KVH, Dh); pos: scalar
         index of this token.  Returns (y_t, new_cache).  Grouped-query
         attention runs on the grouped cache directly (no head broadcast of
-        the T_max-sized cache in the hot decode loop).
+        the cache in the hot decode loop), and the cache stays in its
+        storage dtype end to end — the MXU accumulates in fp32 via
+        ``preferred_element_type``, so there is no fp32 materialization of
+        the whole cache per token (that copy was ~3x the cache's HBM
+        traffic).  Decode is HBM-bound: the caller bounds T_cache to the
+        actual generation length (init_cache ``length=``), not max_len.
+
+        ``packed`` ({"w": (D, (H+2KVH)·Dh), "b"}): the q/k/v projections
+        pre-concatenated into ONE matmul (GPT._packed_qkv) — decode at
+        B~1 is op-latency-bound, so fewer, wider matmuls win.
         """
         p = params["attn"]
         h = self.ln1.apply(params["ln1"], x_t)
-        q, k_t, v_t = self.attn.qkv(p, h)
+        if packed is not None:
+            hd = self.cfg.dim // self.cfg.num_heads
+            nh, kvh = self.cfg.num_heads, self.attn.kv_heads
+            qkv = jnp.einsum("btd,dp->btp", h, packed["w"]) + packed["b"]
+            bsz = x_t.shape[0]
+            q = qkv[..., :nh * hd].reshape(bsz, 1, nh, hd)
+            k_t = qkv[..., nh * hd:(nh + kvh) * hd].reshape(bsz, 1, kvh, hd)
+            v_t = qkv[..., (nh + kvh) * hd:].reshape(bsz, 1, kvh, hd)
+        else:
+            q, k_t, v_t = self.attn.qkv(p, h)
         if self.cfg.rope:
             from dtf_tpu.nn.rope import apply_rope
             q = apply_rope(q, pos[None])
@@ -174,17 +201,21 @@ class GPTBlock(Module):
         b, _, h_all, hd = q.shape
         kvh = cache_k.shape[2]
         g = h_all // kvh
-        qg = q.reshape(b, kvh, g, hd)                 # T=1 folded away
+        qg = q.reshape(b, kvh, g, hd).astype(cache_k.dtype)  # T=1 folded away
         scale = hd ** -0.5
-        s = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
-                       cache_k.astype(jnp.float32)) * scale  # (B,KVH,G,Tmax)
-        t_max = cache_k.shape[1]
-        visible = jnp.arange(t_max)[None, None, None, :] <= pos
-        s = jnp.where(visible, s, NEG_BIG)
-        w = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bkgt,btkd->bkgd", w,
-                         cache_v.astype(jnp.float32)).astype(x_t.dtype)
-        out = out.reshape(b, 1, h_all, hd)
+        s = jnp.einsum("bkgd,btkd->bkgt", qg, cache_k,
+                       preferred_element_type=jnp.float32)
+        s = s * scale                                 # (B, KVH, G, T_cache)
+        if visible_bias is None:                      # hoistable: pos-only
+            t_cache = cache_k.shape[1]
+            visible_bias = jnp.where(
+                jnp.arange(t_cache)[None, None, None, :] <= pos, 0.0,
+                NEG_BIG)
+        s = s + visible_bias
+        w = jax.nn.softmax(s, axis=-1)                # fp32 stats
+        out = jnp.einsum("bkgt,btkd->bkgd", w.astype(cache_v.dtype), cache_v,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(b, 1, h_all, hd).astype(x_t.dtype)
         x_t = x_t + self.attn.out_proj(p, out)
         return self._mlp_residual(params, x_t), {"k": cache_k, "v": cache_v}
 
@@ -229,21 +260,25 @@ class GPT(Module):
             x = x + self.pos.apply(params["pos"], positions)
         return x
 
-    def apply(self, params, tokens, *, train=False, rng=None):
-        """tokens (B, T) -> logits (B, T, V)."""
+    def _hidden(self, params, tokens, *, train=False):
+        """tokens (B, T) -> final hidden states (B, T, D) (pre-head)."""
         t = tokens.shape[1]
         x = self._embed(params, tokens, jnp.arange(t))
 
         block_fn = self.block.apply
         if self.cfg.remat:
-            block_fn = jax.checkpoint(block_fn)
+            block_fn = remat(block_fn, self.cfg.remat_policy)
 
         def body(carry, lp):
             return block_fn(lp, carry), None
 
         x, _ = lax.scan(body, x, params["layers"])
-        x = self.ln_f.apply(params["ln_f"], x)
-        return self.tok.attend(params["tok"], x).astype(jnp.float32)
+        return self.ln_f.apply(params["ln_f"], x)
+
+    def apply(self, params, tokens, *, train=False, rng=None):
+        """tokens (B, T) -> logits (B, T, V)."""
+        h = self._hidden(params, tokens, train=train)
+        return self.tok.attend(params["tok"], h).astype(jnp.float32)
 
     def axes(self):
         layer_axes = jax.tree_util.tree_map(
@@ -258,6 +293,49 @@ class GPT(Module):
 
     # --- training objective -------------------------------------------
 
+    def _loss_chunked(self, params, tokens, train):
+        """CE loss scanned over T-chunks (cfg.loss_chunk) of the hidden
+        states: per chunk, logits -> log-softmax -> gather, all under
+        jax.checkpoint so backward recomputes them from the (B, C, D)
+        hidden slice instead of saving (B, T, V) fp32 logits."""
+        from dtf_tpu.nn.losses import smooth_token_logp
+
+        cfg = self.cfg
+        h = self._hidden(params, tokens, train=train)[:, :-1]
+        targets = tokens[:, 1:]
+        b, t1, d = h.shape
+        c = min(cfg.loss_chunk, t1)
+        pad = (-t1) % c
+        if pad:
+            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+            targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        w = (jnp.arange(t1 + pad) < t1).astype(jnp.float32)
+        n = (t1 + pad) // c
+        hs = h.reshape(b, n, c, d).swapaxes(0, 1)          # (n, B, C, D)
+        ts = targets.reshape(b, n, c).swapaxes(0, 1)       # (n, B, C)
+        ws = w.reshape(n, c)
+
+        def chunk(carry, inp):
+            hc, tc, wc = inp
+            nll, sm, acc = carry
+            logits = self.tok.attend(params["tok"], hc).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tl = jnp.take_along_axis(logp, tc[..., None], -1)[..., 0]
+            sl = smooth_token_logp(logp, tl, cfg.label_smoothing)
+            wcb = wc[None, :]
+            nll = nll - jnp.sum(tl * wcb)
+            sm = sm - jnp.sum(sl * wcb)
+            acc = acc + jnp.sum((jnp.argmax(logits, -1) == tc) * wcb)
+            return (nll, sm, acc), None
+
+        zero = jnp.zeros((), jnp.float32)
+        (nll, sm, acc), _ = lax.scan(jax.checkpoint(chunk),
+                                     (zero, zero, zero), (hs, ts, ws))
+        denom = b * t1
+        nll = nll / denom
+        return sm / denom, {"accuracy": acc / denom,
+                            "perplexity": jnp.exp(jnp.minimum(nll, 20.0))}
+
     def loss(self, params, batch, rng=None, train=True):
         """Next-token cross-entropy (optionally label-smoothed, see
         GPTConfig.label_smoothing).  batch: tokens (B, T) int32.
@@ -269,6 +347,8 @@ class GPT(Module):
         from dtf_tpu.nn.losses import smooth_token_logp
 
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        if self.cfg.loss_chunk > 0:
+            return self._loss_chunked(params, tokens, train)
         logits = self.apply(params, tokens, train=train)[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -290,15 +370,24 @@ class GPT(Module):
 
     # --- autoregressive generation ------------------------------------
 
-    def init_cache(self, batch: int):
+    def _cache_len(self, total: int) -> int:
+        """Lane-aligned live cache length for a prompt+new total: decode
+        HBM traffic scales with the cache, so both decode entry points size
+        it to the generation actually requested, not max_len."""
+        return min(-(-total // 128) * 128, self.cfg.max_len)
+
+    def init_cache(self, batch: int, length: int | None = None):
+        """KV cache sized to ``length`` (default cfg.max_len).  Decode HBM
+        traffic scales with the cache length, so generate() sizes it to the
+        actual prompt+new total instead of always paying for max_len."""
         cfg = self.cfg
         hd = cfg.dim // cfg.num_heads
         kvh = cfg.num_kv_heads or cfg.num_heads    # GQA: H/KVH smaller cache
-        shape = (cfg.num_layers, batch, cfg.max_len, kvh, hd)
+        shape = (cfg.num_layers, batch, length or cfg.max_len, kvh, hd)
         return {"k": jnp.zeros(shape, cfg.dtype),
                 "v": jnp.zeros(shape, cfg.dtype)}
 
-    def _prefill_cache(self, params, prompt):
+    def _prefill_cache(self, params, prompt, cache_len=None):
         """One batched forward over the prompt -> (filled cache, logits at
         the last prompt position).  The prompt is padded to a multiple of 8
         so the flash kernel always has a valid block size (causal
@@ -315,7 +404,7 @@ class GPT(Module):
             return y, (k, v)
 
         x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
-        cache = self.init_cache(b)          # (L, B, Tmax, KVH, Dh)
+        cache = self.init_cache(b, cache_len)  # (L, B, T_cache, KVH, Dh)
         cache = {"k": cache["k"].at[:, :, :p_len].set(
                      ks[:, :, :p_len].astype(cache["k"].dtype)),
                  "v": cache["v"].at[:, :, :p_len].set(
@@ -323,19 +412,52 @@ class GPT(Module):
         x = self.ln_f.apply(params["ln_f"], x)
         return cache, self.tok.attend(params["tok"], x)[:, p_len - 1, :]
 
-    def _decode_logits(self, params, cache, tok, pos):
+    def _packed_qkv(self, params):
+        """Concatenate every layer's q/k/v projection weights into one
+        (L, D, (H+2KVH)·Dh) matmul operand for the decode hot loop (see
+        GPTBlock.decode_step).  Computed once per generate call, outside
+        the decode scan."""
+        attn = params["layers"]["attn"]
+        n_layers, d = self.cfg.num_layers, self.cfg.dim
+        flat_w = lambda t: t["w"].reshape(n_layers, d, -1)
+        flat_b = lambda t: t["b"].reshape(n_layers, -1)
+        return {
+            "w": jnp.concatenate(
+                [flat_w(attn["q"]), flat_w(attn["k"]), flat_w(attn["v"])],
+                axis=-1),
+            "b": jnp.concatenate(
+                [flat_b(attn["q"]), flat_b(attn["k"]), flat_b(attn["v"])],
+                axis=-1),
+        }
+
+    def _decode_logits(self, params, cache, tok, pos, packed=None):
         """One decode step: token (B', 1) at position ``pos`` through the
-        layer stack with the KV cache -> (logits (B', V), new cache)."""
+        layer stack with the KV cache -> (logits (B', V), new cache).
+
+        The layer scan is fully unrolled: decode is HBM-latency-bound
+        (every op is tiny at B~1), and unrolling lets XLA overlap one
+        layer's weight streaming with the previous layer's compute instead
+        of serializing 12 scan iterations."""
         x = self._embed(params, tok, pos[None])
+        xs = (params["layers"], cache["k"], cache["v"])
+        if packed is not None:
+            xs = xs + (packed,)
+        # the attention visibility bias depends only on pos: one compute
+        # for all layers instead of one per layer
+        t_cache = cache["k"].shape[2]
+        visible_bias = jnp.where(
+            jnp.arange(t_cache)[None, None, None, :] <= pos, 0.0, NEG_BIG)
 
         def layer_scan(carry_x, inputs):
-            lp, ck, cv = inputs
+            lp, ck, cv = inputs[:3]
+            pk = inputs[3] if packed is not None else None
             y, nc = self.block.decode_step(lp, carry_x,
-                                           {"k": ck, "v": cv}, pos)
+                                           {"k": ck, "v": cv}, pos,
+                                           packed=pk,
+                                           visible_bias=visible_bias)
             return y, (nc["k"], nc["v"])
 
-        x, (new_k, new_v) = lax.scan(
-            layer_scan, x, (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = lax.scan(layer_scan, x, xs, unroll=True)
         x = self.ln_f.apply(params["ln_f"], x)
         logits = self.tok.attend(params["tok"], x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v}
@@ -375,7 +497,9 @@ class GPT(Module):
         if rng is None:
             rng = jax.random.key(0)
 
-        cache, logits = self._prefill_cache(params, prompt)
+        # Cache bounded to the live total (lane-aligned), not max_len.
+        cache, logits = self._prefill_cache(params, prompt,
+                                            self._cache_len(total))
         rng, sub = jax.random.split(rng)
         first = sample_token(sub, logits, temperature=temperature,
                              top_k=top_k, top_p=top_p)
@@ -385,12 +509,15 @@ class GPT(Module):
         out = out.at[:, p_len].set(first)
         done = (first == eos_id) if eos_id is not None else None
 
+        packed = self._packed_qkv(params)
+
         # ---- decode: scan positions p_len..total-2, each reading the token
         # it just wrote and emitting the next one.
         def step(carry, pos):
             out, cache, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))      # (B, 1)
-            logits, cache = self._decode_logits(params, cache, tok, pos)
+            logits, cache = self._decode_logits(params, cache, tok, pos,
+                                                packed)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
@@ -432,7 +559,8 @@ class GPT(Module):
                     jnp.zeros((b, w), jnp.float32))
         v_size = cfg.vocab_size
 
-        cache, logits = self._prefill_cache(params, prompt)
+        cache, logits = self._prefill_cache(params, prompt,
+                                            self._cache_len(total))
         logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         scores, first = lax.top_k(logp0, w)                  # (B, W)
 
@@ -454,11 +582,14 @@ class GPT(Module):
             idx = beam_idx.reshape(1, b, w, *([1] * (cv.ndim - 3)))
             return jnp.take_along_axis(cv, idx, axis=2).reshape(c.shape)
 
+        packed = self._packed_qkv(params)
+
         def step(carry, pos):
             out, cache, scores, alive = carry
             tok = lax.dynamic_slice(out, (0, 0, pos),
                                     (b, w, 1)).reshape(b * w, 1)
-            logits, cache = self._decode_logits(params, cache, tok, pos)
+            logits, cache = self._decode_logits(params, cache, tok, pos,
+                                                packed)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             logp = logp.reshape(b, w, v_size)
             if eos_id is not None:

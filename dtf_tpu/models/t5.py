@@ -9,10 +9,13 @@ encoder output (q from the decoder stream, k/v from the context — the
 TPU-first structure mirrors models/gpt.py: pre-LN blocks scanned over
 stacked per-layer params, static shapes, KV-cache greedy/sampled decoding
 where the encoder runs ONCE and each decoder layer's cross K/V are
-projected ONCE (generation cost is decoder-side only).  Architectural
-deltas from published T5 (documented, not accidental): LayerNorm instead
-of RMSNorm, learned absolute positions instead of relative position
-buckets, gelu FFN.
+projected ONCE (generation cost is decoder-side only).  The family's
+signature mechanisms are in: **RMSNorm** (``norm``, default) and **bucketed
+relative position biases** (``positions="relative"``, default — one shared
+bidirectional table for the encoder, one unidirectional for the decoder,
+none on cross-attention, nn/relpos.py); learned absolute positions and
+LayerNorm remain as config options.  Remaining documented delta from
+published T5: gelu FFN instead of relu.
 """
 
 from __future__ import annotations
@@ -24,9 +27,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dtf_tpu.nn.attention import MultiHeadAttention, causal_mask
+from dtf_tpu.nn.attention import (MultiHeadAttention, causal_mask,
+                                  dot_product_attention)
 from dtf_tpu.nn.core import Module
-from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
+from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm, RMSNorm
+from dtf_tpu.nn.relpos import RelativePositionBias
 
 NEG_BIG = -1e30
 
@@ -46,6 +51,13 @@ class T5Config:
     pad_id: int = 0           # also the loss mask
     bos_id: int = 1           # decoder start token
     label_smoothing: float = 0.0   # eps of uniform mass in the CE loss
+    # Position mechanism: "relative" (T5's bucketed relative position
+    # biases, the default) or "absolute" (learned position tables).
+    positions: str = "relative"
+    relpos_buckets: int = 32
+    relpos_max_distance: int = 128
+    # Normalization: "rmsnorm" (T5's, the default) or "layernorm".
+    norm: str = "rmsnorm"
 
     @classmethod
     def small(cls, **kw):
@@ -58,10 +70,18 @@ class T5Config:
         d.update(kw)
         return cls(**d)
 
+    def make_norm(self):
+        if self.norm == "rmsnorm":
+            return RMSNorm(self.dim)
+        if self.norm == "layernorm":
+            return LayerNorm(self.dim)
+        raise ValueError(f"norm must be 'rmsnorm' or 'layernorm', "
+                         f"got {self.norm!r}")
+
 
 class _FFN(Module):
     def __init__(self, cfg: T5Config):
-        self.ln = LayerNorm(cfg.dim)
+        self.ln = cfg.make_norm()
         self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
                          axes_in="embed", axes_out="mlp")
         self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
@@ -84,11 +104,14 @@ class _FFN(Module):
 
 
 class T5EncoderLayer(Module):
-    """Pre-LN bidirectional block: x + selfattn(ln(x)); FFN."""
+    """Pre-LN bidirectional block: x + selfattn(ln(x)); FFN.
+
+    ``bias`` is the stack-shared relative-position bias (1, H, T, T),
+    added to the attention logits (None under absolute positions)."""
 
     def __init__(self, cfg: T5Config):
         self.cfg = cfg
-        self.ln = LayerNorm(cfg.dim)
+        self.ln = cfg.make_norm()
         self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
         self.ffn = _FFN(cfg)
 
@@ -97,9 +120,13 @@ class T5EncoderLayer(Module):
         return {"ln": self.ln.init(k1), "attn": self.attn.init(k2),
                 "ffn": self.ffn.init(k3)}
 
-    def apply(self, params, x, *, pad_mask=None, train=False, rng=None):
+    def apply(self, params, x, *, pad_mask=None, bias=None, train=False,
+              rng=None):
         h = self.ln.apply(params["ln"], x)
-        x = x + self.attn.apply(params["attn"], h, mask=pad_mask)
+        p = params["attn"]
+        q, k, v = self.attn.qkv(p, h)
+        o = dot_product_attention(q, k, v, mask=pad_mask, bias=bias)
+        x = x + self.attn.out_proj(p, o)
         return self.ffn.apply(params["ffn"], x)
 
     def axes(self):
@@ -108,12 +135,15 @@ class T5EncoderLayer(Module):
 
 
 class T5DecoderLayer(Module):
-    """Pre-LN causal self-attention -> cross-attention -> FFN."""
+    """Pre-LN causal self-attention -> cross-attention -> FFN.
+
+    ``self_bias`` is the decoder stack's shared unidirectional relative-
+    position bias; cross-attention carries no position bias (as in T5)."""
 
     def __init__(self, cfg: T5Config):
         self.cfg = cfg
-        self.ln_self = LayerNorm(cfg.dim)
-        self.ln_cross = LayerNorm(cfg.dim)
+        self.ln_self = cfg.make_norm()
+        self.ln_cross = cfg.make_norm()
         self.self_attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype)
         self.cross_attn = MultiHeadAttention(cfg.dim, cfg.num_heads,
                                              cfg.dtype)
@@ -127,22 +157,27 @@ class T5DecoderLayer(Module):
                 "cross_attn": self.cross_attn.init(ks[3]),
                 "ffn": self.ffn.init(ks[4])}
 
-    def apply(self, params, x, ctx, *, ctx_mask=None, train=False, rng=None):
+    def apply(self, params, x, ctx, *, ctx_mask=None, self_bias=None,
+              train=False, rng=None):
         t = x.shape[1]
         h = self.ln_self.apply(params["ln_self"], x)
-        x = x + self.self_attn.apply(params["self_attn"], h,
-                                     mask=causal_mask(t))
+        p = params["self_attn"]
+        q, k, v = self.self_attn.qkv(p, h)
+        o = dot_product_attention(q, k, v, mask=causal_mask(t),
+                                  bias=self_bias)
+        x = x + self.self_attn.out_proj(p, o)
         h = self.ln_cross.apply(params["ln_cross"], x)
         x = x + self.cross_attn.apply(params["cross_attn"], h, kv_input=ctx,
                                       mask=ctx_mask)
         return self.ffn.apply(params["ffn"], x)
 
     def decode_step(self, params, x_t, cache, cross_k, cross_v, pos,
-                    ctx_mask=None):
+                    ctx_mask=None, self_bias=None):
         """One token: causal self-attn over the KV cache + cross-attn over
         the PRE-PROJECTED encoder K/V (computed once per generate call).
         x_t (B, 1, D); cache {"k","v"} (B, Tmax, H, Dh); cross_k/v
-        (B, S, H, Dh)."""
+        (B, S, H, Dh); self_bias (1, H, 1, Tmax) — this position's row of
+        the decoder relative-position bias."""
         p = params["self_attn"]
         h = self.ln_self.apply(params["ln_self"], x_t)
         q, k_t, v_t = self.self_attn.qkv(p, h)
@@ -153,6 +188,8 @@ class T5DecoderLayer(Module):
         scale = q.shape[-1] ** -0.5
         s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                        cache_k.astype(jnp.float32)) * scale
+        if self_bias is not None:
+            s = s + self_bias
         visible = jnp.arange(cache_k.shape[1])[None, None, None, :] <= pos
         s = jnp.where(visible, s, NEG_BIG)
         out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
@@ -189,13 +226,27 @@ class T5(Module):
 
     def __post_init__(self):
         cfg = self.cfg
+        if cfg.positions not in ("relative", "absolute"):
+            raise ValueError(f"positions must be 'relative' or 'absolute', "
+                             f"got {cfg.positions!r}")
+        self.relative = cfg.positions == "relative"
         self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
-        self.pos_enc = Embedding(cfg.max_src_len, cfg.dim, cfg.dtype)
-        self.pos_dec = Embedding(cfg.max_tgt_len, cfg.dim, cfg.dtype)
+        if self.relative:
+            # One table per stack, shared across its layers (T5): encoder
+            # bidirectional, decoder unidirectional; none on cross-attn.
+            self.relpos_enc = RelativePositionBias(
+                cfg.num_heads, cfg.relpos_buckets, cfg.relpos_max_distance,
+                bidirectional=True, dtype=cfg.dtype)
+            self.relpos_dec = RelativePositionBias(
+                cfg.num_heads, cfg.relpos_buckets, cfg.relpos_max_distance,
+                bidirectional=False, dtype=cfg.dtype)
+        else:
+            self.pos_enc = Embedding(cfg.max_src_len, cfg.dim, cfg.dtype)
+            self.pos_dec = Embedding(cfg.max_tgt_len, cfg.dim, cfg.dtype)
         self.enc_layer = T5EncoderLayer(cfg)
         self.dec_layer = T5DecoderLayer(cfg)
-        self.ln_enc = LayerNorm(cfg.dim)
-        self.ln_dec = LayerNorm(cfg.dim)
+        self.ln_enc = cfg.make_norm()
+        self.ln_dec = cfg.make_norm()
 
     def init(self, key):
         ks = jax.random.split(key, 7)
@@ -203,25 +254,35 @@ class T5(Module):
             jax.random.split(ks[0], self.cfg.enc_layers))
         dec = jax.vmap(self.dec_layer.init)(
             jax.random.split(ks[1], self.cfg.dec_layers))
-        return {"tok": self.tok.init(ks[2]),
-                "pos_enc": self.pos_enc.init(ks[3]),
-                "pos_dec": self.pos_dec.init(ks[4]),
-                "enc_layers": enc, "dec_layers": dec,
-                "ln_enc": self.ln_enc.init(ks[5]),
-                "ln_dec": self.ln_dec.init(ks[6])}
+        out = {"tok": self.tok.init(ks[2]),
+               "enc_layers": enc, "dec_layers": dec,
+               "ln_enc": self.ln_enc.init(ks[5]),
+               "ln_dec": self.ln_dec.init(ks[6])}
+        if self.relative:
+            out["relpos_enc"] = self.relpos_enc.init(ks[3])
+            out["relpos_dec"] = self.relpos_dec.init(ks[4])
+        else:
+            out["pos_enc"] = self.pos_enc.init(ks[3])
+            out["pos_dec"] = self.pos_dec.init(ks[4])
+        return out
 
     def axes(self):
         wrap = lambda ax_tree: jax.tree_util.tree_map(
             lambda ax: (None, *ax), ax_tree,
             is_leaf=lambda x: isinstance(x, tuple) and all(
                 a is None or isinstance(a, str) for a in x))
-        return {"tok": self.tok.axes(),
-                "pos_enc": {"table": (None, "embed")},
-                "pos_dec": {"table": (None, "embed")},
-                "enc_layers": wrap(self.enc_layer.axes()),
-                "dec_layers": wrap(self.dec_layer.axes()),
-                "ln_enc": self.ln_enc.axes(),
-                "ln_dec": self.ln_dec.axes()}
+        out = {"tok": self.tok.axes(),
+               "enc_layers": wrap(self.enc_layer.axes()),
+               "dec_layers": wrap(self.dec_layer.axes()),
+               "ln_enc": self.ln_enc.axes(),
+               "ln_dec": self.ln_dec.axes()}
+        if self.relative:
+            out["relpos_enc"] = self.relpos_enc.axes()
+            out["relpos_dec"] = self.relpos_dec.axes()
+        else:
+            out["pos_enc"] = {"table": (None, "embed")}
+            out["pos_dec"] = {"table": (None, "embed")}
+        return out
 
     # --- forward ------------------------------------------------------
 
@@ -232,31 +293,42 @@ class T5(Module):
     def encode(self, params, src):
         """src (B, S) int32 -> (hidden (B, S, D), attend-mask)."""
         mask = self._pad_mask(src)
-        x = (self.tok.apply(params["tok"], src)
-             + self.pos_enc.apply(params["pos_enc"], jnp.arange(src.shape[1])))
+        s = src.shape[1]
+        x = self.tok.apply(params["tok"], src)
+        bias = None
+        if self.relative:
+            pos = jnp.arange(s)
+            bias = self.relpos_enc.apply(params["relpos_enc"], pos, pos)
+        else:
+            x = x + self.pos_enc.apply(params["pos_enc"], jnp.arange(s))
 
         fn = self.enc_layer.apply
         if self.cfg.remat:
             fn = jax.checkpoint(fn)
 
         def body(carry, lp):
-            return fn(lp, carry, pad_mask=mask), None
+            return fn(lp, carry, pad_mask=mask, bias=bias), None
 
         x, _ = lax.scan(body, x, params["enc_layers"])
         return self.ln_enc.apply(params["ln_enc"], x), mask
 
     def decode(self, params, tgt_in, ctx, ctx_mask):
         """Teacher-forced decoder pass: tgt_in (B, T) -> logits (B, T, V)."""
-        x = (self.tok.apply(params["tok"], tgt_in)
-             + self.pos_dec.apply(params["pos_dec"],
-                                  jnp.arange(tgt_in.shape[1])))
+        t = tgt_in.shape[1]
+        x = self.tok.apply(params["tok"], tgt_in)
+        bias = None
+        if self.relative:
+            pos = jnp.arange(t)
+            bias = self.relpos_dec.apply(params["relpos_dec"], pos, pos)
+        else:
+            x = x + self.pos_dec.apply(params["pos_dec"], jnp.arange(t))
 
         fn = self.dec_layer.apply
         if self.cfg.remat:
             fn = jax.checkpoint(fn)
 
         def body(carry, lp):
-            return fn(lp, carry, ctx, ctx_mask=ctx_mask), None
+            return fn(lp, carry, ctx, ctx_mask=ctx_mask, self_bias=bias), None
 
         x, _ = lax.scan(body, x, params["dec_layers"])
         x = self.ln_dec.apply(params["ln_dec"], x)
@@ -330,14 +402,20 @@ class T5(Module):
         def step(carry, pos):
             out, cache, rng = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
-            x = (self.tok.apply(params["tok"], tok)
-                 + self.pos_dec.apply(params["pos_dec"], pos[None]))
+            x = self.tok.apply(params["tok"], tok)
+            self_bias = None
+            if self.relative:
+                self_bias = self.relpos_dec.apply(
+                    params["relpos_dec"], pos[None],
+                    jnp.arange(cfg.max_tgt_len))      # (1, H, 1, Tmax)
+            else:
+                x = x + self.pos_dec.apply(params["pos_dec"], pos[None])
 
             def layer_scan(carry_x, inputs):
                 lp, ck, cv, xk, xv = inputs
                 y, nc = self.dec_layer.decode_step(
                     lp, carry_x, {"k": ck, "v": cv}, xk, xv, pos,
-                    ctx_mask=ctx_mask)
+                    ctx_mask=ctx_mask, self_bias=self_bias)
                 return y, (nc["k"], nc["v"])
 
             x, (nk, nv) = lax.scan(

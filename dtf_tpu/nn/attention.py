@@ -24,12 +24,15 @@ from dtf_tpu.nn.core import Module
 from dtf_tpu.nn.layers import _fan_in_normal
 
 
-def dot_product_attention(q, k, v, mask=None, scale=None):
+def dot_product_attention(q, k, v, mask=None, scale=None, bias=None):
     """Plain softmax attention.  q,k,v: (B, T, H, D); mask broadcastable to
-    (B, H, Tq, Tk), True = attend."""
+    (B, H, Tq, Tk), True = attend; ``bias`` an additive fp32 logit term of
+    the same broadcast shape (e.g. T5 relative position biases)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
     if mask is not None:
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)

@@ -1,0 +1,93 @@
+"""T5 relative position biases.
+
+The T5 family's signature position mechanism (used instead of absolute
+position embeddings; the reference has no sequence models at all,
+SURVEY.md §5.7): every attention logit gets a learned per-head scalar bias
+indexed by a BUCKETED relative position ``key_pos - query_pos``.  Half the
+buckets hold exact small distances; the other half are log-spaced out to
+``max_distance``, beyond which all distances share the last bucket — so
+arbitrarily long sequences reuse a tiny (buckets x heads) table.
+
+TPU notes: the bucket computation is pure integer/VPU work on a (Tq, Tk)
+iota — no gathers of dynamic size — and the resulting (1, H, Tq, Tk) bias
+adds onto the attention logits before softmax, which XLA fuses into the
+existing attention elementwise chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.nn.core import Module
+
+
+def relative_position_bucket(rel, *, bidirectional: bool = True,
+                             num_buckets: int = 32,
+                             max_distance: int = 128):
+    """Bucket relative positions ``rel = key_pos - query_pos`` (int array).
+
+    Bidirectional (encoder): buckets [0, n/2) cover key<=query, [n/2, n)
+    cover key>query, each half split exact/log as below.  Unidirectional
+    (decoder): future keys (rel > 0) all map to bucket 0 (they are masked
+    anyway); past distances use all ``num_buckets``.  Within a direction,
+    distances < n_dir/2 get exact buckets; larger ones are log-spaced up to
+    ``max_distance`` and clamp to the last bucket beyond it.
+    """
+    rel = jnp.asarray(rel, jnp.int32)
+    n = num_buckets
+    if bidirectional:
+        n = n // 2
+        offset = jnp.where(rel > 0, n, 0)
+        dist = jnp.abs(rel)
+    else:
+        offset = jnp.zeros_like(rel)
+        dist = jnp.maximum(-rel, 0)
+    max_exact = n // 2
+    is_small = dist < max_exact
+    # log-spaced branch; clamp the argument so the unused small-branch
+    # lanes never hit log(0)
+    d = jnp.maximum(dist, max_exact).astype(jnp.float32)
+    log_bucket = max_exact + (
+        jnp.log(d / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (n - max_exact)).astype(jnp.int32)
+    log_bucket = jnp.minimum(log_bucket, n - 1)
+    return offset + jnp.where(is_small, dist, log_bucket)
+
+
+@dataclasses.dataclass
+class RelativePositionBias(Module):
+    """Learned (num_buckets, num_heads) table -> (1, H, Tq, Tk) fp32 bias.
+
+    One instance per stack (shared across layers, as in T5): the encoder's
+    is bidirectional, the decoder's unidirectional; cross-attention carries
+    no position bias.
+    """
+
+    num_heads: int
+    num_buckets: int = 32
+    max_distance: int = 128
+    bidirectional: bool = True
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        scale = self.num_buckets ** -0.5
+        return {"table": jax.random.normal(
+            key, (self.num_buckets, self.num_heads), self.dtype) * scale}
+
+    def apply(self, params, q_positions, k_positions, *, train=False,
+              rng=None):
+        """q_positions (Tq,), k_positions (Tk,) int32 -> (1, H, Tq, Tk)."""
+        rel = k_positions[None, :] - q_positions[:, None]
+        bucket = relative_position_bucket(
+            rel, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance)
+        bias = params["table"][bucket]               # (Tq, Tk, H)
+        return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+
+    def axes(self):
+        return {"table": (None, "heads")}

@@ -32,6 +32,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+# Additive value for padding masks.  Finite on purpose: a k block that is
+# entirely padded then yields s = -1e30 everywhere and a *finite* running
+# max, so p = exp(0) = 1 briefly over-counts — and the very next block with
+# any visible key applies corr = exp(-1e30 - m_real) = 0, zeroing the bogus
+# contribution.  -inf would instead produce exp(-inf - -inf) = nan.  Rows
+# whose keys are ALL padded are undefined (callers guarantee >=1 visible
+# key per row, true for any non-empty sequence).
+MASK_VALUE = -1e30
 
 
 def _interpret_default() -> bool:
@@ -70,8 +78,12 @@ def _causal_mask_block(s, q_start, k_start):
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
-                *, scale, causal, block_q, block_k):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+        mask_ref = None
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -90,6 +102,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask_block(s, qi * block_q, ki * block_k)
+        if mask_ref is not None:
+            s = s + mask_ref[0][:1, :]                 # (1, bk) key bias
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -117,19 +131,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
                                          lse_ref.shape[2:])
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _mask_bias(kv_mask, t):
+    """(B, Tk) bool -> (B, 8, Tk) fp32 additive bias (0 / MASK_VALUE).
+
+    Sublane-replicated to 8 rows so rank-3 blocks (1, 8, bk) satisfy
+    Mosaic's last-two-dims tiling rule (same trick as the (bq, 128)
+    lane-replicated lse stats)."""
+    assert kv_mask.shape[-1] == t, (kv_mask.shape, t)
+    bias = jnp.where(kv_mask, 0.0, MASK_VALUE).astype(jnp.float32)
+    return jnp.broadcast_to(bias[:, None, :], (kv_mask.shape[0], 8, t))
+
+
+def _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     b, h, t, d = q.shape
     bq, bk = _block_sizes(t, block_q, block_k)
+    has_mask = bias is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk)
+                               block_q=bq, block_k=bk, has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b_, h_, qi, ki: (b_, 0, ki)))
+        args.append(bias)
     return pl.pallas_call(
         kernel,
         grid=(b, h, t // bq, t // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
@@ -144,15 +176,19 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((bq, 128), jnp.float32),   # running denom (col 0)
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
 # --------------------------------------------------------------------------
 # backward: dq on grid (B,H,nq,nk); dk,dv fused on grid (B,H,nk,nq)
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                   acc, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+    if has_mask:
+        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref, dq_ref, acc = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc = refs
+        mask_ref = None
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -175,6 +211,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask_block(s, qi * block_q, ki * block_k)
+        if mask_ref is not None:
+            s = s + mask_ref[0][:1, :]                 # (1, bk)
         p = jnp.exp(s - lse)                           # (bq, bk)
         dp = jax.lax.dot_general(                      # dO @ V^T
             do, v, (((1,), (1,)), ((), ())),
@@ -193,9 +231,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        mask_ref = None
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -220,6 +263,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
             qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
             st = jnp.where(qpos >= kpos, st, NEG_INF)
+        if mask_ref is not None:
+            st = st + mask_ref[0][:1, :].T             # (bk, 1) key bias
         pt = jnp.exp(st - lse)                         # (bk, bq)
         dv_acc[:] = dv_acc[:] + jax.lax.dot(
             pt, do, preferred_element_type=jnp.float32)
@@ -241,41 +286,55 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, bias, do, causal, scale, block_q, block_k,
+         interpret):
     b, h, t, d = q.shape
     bq, bk = _block_sizes(t, block_q, block_k)
+    has_mask = bias is not None
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
     k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
     l_spec = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    m_spec = pl.BlockSpec((1, 8, bk), lambda b_, h_, qi, ki: (b_, 0, ki))
 
+    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, q_spec, l_spec]
+    dq_args = [q, k, v, o, do, lse]
+    if has_mask:
+        dq_in_specs.append(m_spec)
+        dq_args.append(bias)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, has_mask=has_mask),
         grid=(b, h, t // bq, t // bk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, l_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*dq_args)
 
     # Transposed grid: k blocks outer, q blocks inner (sequential on-core).
     q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
     k_spec_t = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
     l_spec_t = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    m_spec_t = pl.BlockSpec((1, 8, bk), lambda b_, h_, ki, qi: (b_, 0, ki))
+    dkv_in_specs = [q_spec_t, k_spec_t, k_spec_t, q_spec_t, q_spec_t, l_spec_t]
+    dkv_args = [q, k, v, o, do, lse]
+    if has_mask:
+        dkv_in_specs.append(m_spec_t)
+        dkv_args.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, has_mask=has_mask),
         grid=(b, h, t // bk, t // bq),
-        in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, q_spec_t, l_spec_t],
+        in_specs=dkv_in_specs,
         out_specs=[k_spec_t, k_spec_t],
         out_shape=[jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, t, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -283,52 +342,83 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
 # public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse, bias)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k, interpret)
+    q, k, v, o, lse, bias = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, bias, g, causal, scale, block_q,
+                      block_k, interpret)
+    # bias is a 0/-1e30 mask, not a learnable input: zero cotangent (must
+    # still match the primal's pytree structure, so zeros, not None).
+    return dq, dk, dv, None if bias is None else jnp.zeros_like(bias)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False, scale=None,
-                    block_q: int = 512, block_k: int = 512,
+def flash_attention(q, k, v, *, causal: bool = False, kv_mask=None,
+                    scale=None, block_q: int = 512, block_k: int = 512,
                     interpret=None):
     """Flash attention over (B, H, T, D) tensors; returns (B, H, T, D).
 
     Differentiable (custom VJP with the flash backward kernels).  ``scale``
     defaults to D**-0.5.  T must be divisible by the (clamped) block sizes.
+    ``kv_mask`` (B, Tk) bool, True = key visible, masks padded keys for
+    every query (composable with ``causal``); rows must keep >=1 visible
+    key.  The mask is not differentiated.
     """
     if interpret is None:
         interpret = _interpret_default()
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    bias = None if kv_mask is None else _mask_bias(kv_mask, q.shape[2])
+    return _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+
+
+def _as_kv_mask(mask, b, tq, tk):
+    """Recognize a key-padding mask broadcastable to (B, H, Tq, Tk) whose
+    value depends only on the key position -> (B, Tk) bool, else None."""
+    if mask.ndim != 4 or mask.shape[-1] != tk:
+        return None
+    if mask.shape[1] != 1 or mask.shape[2] != 1:
+        return None                       # varies per head or per query
+    if mask.shape[0] not in (1, b):
+        return None
+    return jnp.broadcast_to(mask[:, 0, 0, :], (b, tk))
 
 
 def flash_attention_impl(causal: bool = False, block_q: int = 512,
                          block_k: int = 512):
     """Adapter matching MultiHeadAttention's ``attn_impl`` contract:
-    f(q, k, v, mask) with (B, T, H, D) layout.  Only supports mask=None
-    (use causal=True for causal); padding masks fall back to the XLA path
-    in the caller."""
+    f(q, k, v, mask) with (B, T, H, D) layout.
+
+    mask=None and key-padding masks (shape (B|1, 1, 1, Tk) — BERT's
+    ``pad_mask[:, None, None, :]``) run on the Pallas kernel; a general
+    per-query mask falls back to the XLA path (the kernel's only mask
+    primitives are the causal flag and a per-key bias)."""
 
     def impl(q, k, v, mask=None):
+        kv_mask = None
         if mask is not None:
-            raise ValueError("flash_attention_impl supports mask=None only; "
-                             "use causal=True or the XLA attention path")
+            kv_mask = _as_kv_mask(mask, q.shape[0], q.shape[1], k.shape[1])
+            if kv_mask is None:
+                from dtf_tpu.nn.attention import dot_product_attention
+                if causal:
+                    t = q.shape[1]
+                    tri = jnp.tril(jnp.ones((t, t), bool))[None, None]
+                    mask = mask & tri
+                return dot_product_attention(q, k, v, mask)
         out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                               v.transpose(0, 2, 1, 3), causal=causal,
+                              kv_mask=kv_mask,
                               block_q=block_q, block_k=block_k)
         return out.transpose(0, 2, 1, 3)
 

@@ -36,6 +36,14 @@ def main(argv=None) -> int:
     parser.add_argument("--seq_len", type=int, default=None)
     parser.add_argument("--bf16", action="store_true")
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--remat_policy", choices=["full", "dots"],
+                        default="full",
+                        help="with --remat: 'dots' saves matmul outputs, "
+                             "recomputing only elementwise work")
+    parser.add_argument("--loss_chunk", type=int, default=0,
+                        help=">0: compute the CE loss in T-chunks of this "
+                             "size (never materializes the (B,T,V) fp32 "
+                             "logits; backward recomputes per chunk)")
     parser.add_argument("--attn", choices=["auto", "flash", "xla"],
                         default="auto",
                         help="inner attention: pallas flash kernel vs XLA "
@@ -62,7 +70,9 @@ def main(argv=None) -> int:
     logger = MetricLogger(train_cfg.logdir, cluster.is_coordinator)
 
     kw = {"dtype": jnp.bfloat16 if ns.bf16 else jnp.float32,
-          "remat": ns.remat, "label_smoothing": ns.label_smoothing}
+          "remat": ns.remat, "remat_policy": ns.remat_policy,
+          "label_smoothing": ns.label_smoothing,
+          "loss_chunk": ns.loss_chunk}
     if ns.attn != "auto":
         kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:

@@ -37,6 +37,28 @@ class TestGPTModel:
         np.testing.assert_allclose(la[0, :10], lb[0, :10], atol=1e-5)
         assert not np.allclose(la[0, 10:], lb[0, 10:])
 
+    @pytest.mark.parametrize("chunk,smoothing", [(8, 0.0), (7, 0.1)])
+    def test_chunked_loss_matches_dense(self, tiny_params, chunk, smoothing):
+        """loss_chunk must be a pure memory optimization: loss, metrics,
+        AND gradients identical to the dense head (chunk 7 exercises the
+        pad/weight path on T-1 = 15)."""
+        dense = GPT(GPTConfig.tiny(label_smoothing=smoothing))
+        chunked = GPT(GPTConfig.tiny(label_smoothing=smoothing,
+                                     loss_chunk=chunk))
+        toks = jnp.asarray(np.random.default_rng(3).integers(
+            0, 128, (4, 16)), jnp.int32)
+        (l_d, m_d), g_d = jax.value_and_grad(
+            lambda p: dense.loss(p, toks), has_aux=True)(tiny_params)
+        (l_c, m_c), g_c = jax.value_and_grad(
+            lambda p: chunked.loss(p, toks), has_aux=True)(tiny_params)
+        np.testing.assert_allclose(l_c, l_d, rtol=1e-6)
+        for k in m_d:
+            np.testing.assert_allclose(m_c[k], m_d[k], rtol=1e-5,
+                                       err_msg=k)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            g_c, g_d)
+
     def test_loss_decreases_in_training(self, tiny, mesh8):
         from dtf_tpu import optim
         from dtf_tpu.data.datasets import synthetic_text

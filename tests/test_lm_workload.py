@@ -23,3 +23,24 @@ class TestLMWorkload:
                    "--logdir", str(tmp_path)])
         assert rc == 0
         assert "Step-Time:" in capsys.readouterr().out
+
+    def test_checkpoint_resume_continues_run(self, tmp_path, capsys):
+        """The LM benchmark now runs on the ONE Trainer loop, so it
+        checkpoints and resumes mid-run like every other workload: a second
+        invocation with --resume restores the saved step and continues to
+        the (larger) step budget instead of restarting from zero."""
+        args = ["--preset", "tiny", "--batch_size", "8",
+                "--log_frequency", "2", "--checkpoint_every", "2",
+                "--logdir", str(tmp_path)]
+        rc = main(args + ["--steps", "4"])
+        assert rc == 0
+        first = capsys.readouterr().out
+        # budget = steps + 2 warmup = 6 optimizer steps, final save forced
+        assert "Step: 6" in first
+
+        rc = main(args + ["--steps", "8", "--resume"])
+        assert rc == 0
+        second = capsys.readouterr().out
+        assert "resumed from step 6" in second
+        assert "Step: 10" in second          # continued 6 -> 10, not 0 -> 10
+        assert "Step: 2" not in second       # no replay of early steps

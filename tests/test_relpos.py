@@ -1,0 +1,91 @@
+"""T5 relative position bucketing vs closed-form values, the bias module,
+and RMSNorm numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtf_tpu.nn.layers import RMSNorm
+from dtf_tpu.nn.relpos import RelativePositionBias, relative_position_bucket
+
+
+class TestBucketing:
+    """Hand-computed values of the canonical T5 scheme (num_buckets=32,
+    max_distance=128).  rel = key_pos - query_pos."""
+
+    def test_bidirectional_closed_form(self):
+        # n = 16 per direction, max_exact = 8:
+        #   rel<=0 -> buckets [0,16), rel>0 -> [16,32)
+        #   |rel| < 8 exact; 8..127 log-spaced 8..15; >=128 clamps to 15
+        cases = {
+            0: 0, -1: 1, -7: 7,
+            -8: 8,                        # first log bucket == max_exact
+            -127: 15, -128: 15, -10000: 15,
+            1: 17, 7: 23, 8: 24, 127: 31, 10000: 31,
+        }
+        rel = jnp.asarray(list(cases.keys()))
+        got = relative_position_bucket(rel, bidirectional=True,
+                                       num_buckets=32, max_distance=128)
+        np.testing.assert_array_equal(got, list(cases.values()))
+
+    def test_unidirectional_closed_form(self):
+        # n = 32, max_exact = 16; future keys (rel > 0) all -> bucket 0
+        cases = {
+            5: 0, 1: 0, 0: 0,
+            -1: 1, -15: 15,
+            -16: 16,                      # first log bucket
+            -127: 31, -1000: 31,
+        }
+        rel = jnp.asarray(list(cases.keys()))
+        got = relative_position_bucket(rel, bidirectional=False,
+                                       num_buckets=32, max_distance=128)
+        np.testing.assert_array_equal(got, list(cases.values()))
+
+    def test_log_buckets_monotone_nondecreasing(self):
+        d = -jnp.arange(0, 4096)
+        b = relative_position_bucket(d, bidirectional=False)
+        assert bool(jnp.all(jnp.diff(b) >= 0))
+        assert int(b.max()) == 31
+
+
+class TestBiasModule:
+    def test_shape_and_sharing(self):
+        m = RelativePositionBias(num_heads=4)
+        p = m.init(jax.random.key(0))
+        q = jnp.arange(8)
+        bias = m.apply(p, q, q)
+        assert bias.shape == (1, 4, 8, 8)
+        # same relative offset -> same bias (diagonal bands constant)
+        band0 = np.asarray(bias[0, 0]).diagonal()
+        assert np.allclose(band0, band0[0])
+
+    def test_decode_row_matches_full_matrix(self):
+        """The (1, H, 1, T) bias generate() computes per position must be
+        the matching row of the full (1, H, T, T) teacher-forced bias."""
+        m = RelativePositionBias(num_heads=2, bidirectional=False)
+        p = m.init(jax.random.key(1))
+        pos = jnp.arange(12)
+        full = m.apply(p, pos, pos)
+        for q in (0, 5, 11):
+            row = m.apply(p, jnp.asarray([q]), pos)
+            np.testing.assert_array_equal(row[0, :, 0], full[0, :, q])
+
+
+class TestRMSNorm:
+    def test_matches_formula(self):
+        m = RMSNorm(dim=16)
+        p = m.init(jax.random.key(0))
+        p = {"scale": p["scale"] * 2.0}
+        x = jax.random.normal(jax.random.key(1), (3, 16)) * 5 + 1
+        got = m.apply(p, x)
+        want = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True)
+                           + 1e-6) * 2.0
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_no_mean_subtraction(self):
+        # constant input keeps its sign/scale (unlike LayerNorm -> 0)
+        m = RMSNorm(dim=8)
+        p = m.init(jax.random.key(0))
+        x = jnp.full((1, 8), 3.0)
+        np.testing.assert_allclose(m.apply(p, x), jnp.ones((1, 8)),
+                                   rtol=1e-4)
