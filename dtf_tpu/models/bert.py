@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from dtf_tpu.nn.attention import MultiHeadAttention
-from dtf_tpu.nn.core import Module
+from dtf_tpu.nn.core import Module, remat
 from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
 
 
@@ -40,16 +40,39 @@ class BertConfig:
     dtype: Any = jnp.float32
     mask_token: int = 103            # [MASK] in the standard vocab
     mask_rate: float = 0.15
+    # >0: predict a FIXED number of masked positions per sequence (the
+    # standard BERT max_predictions_per_seq recipe).  The MLM head + vocab
+    # projection then run on K gathered positions instead of all T — at
+    # T=512, K=80 that removes ~85% of the head FLOPs and the (B, T, V)
+    # fp32 logits tensor, the single largest activation.  0 = dense head
+    # over every position (binomial ~mask_rate masking).
+    mlm_predictions: int = 0
     attn_impl: Optional[Any] = None  # pluggable (ring attention etc.)
+    # Inner attention when attn_impl is None: the Pallas flash kernel
+    # (mask-capable: BERT's key-padding masks run on the kernel) on TPU,
+    # the XLA softmax path elsewhere; use_flash forces either.
+    use_flash: Optional[bool] = None
     # Pipeline parallelism: set to a Mesh with a 'pipe' axis to run the
-    # encoder stack as num_layers/pipe_size-layer stages under the GPipe
-    # schedule (parallel/pipeline.py) instead of lax.scan.
+    # encoder stack as num_layers/pipe_size-layer stages
+    # (parallel/pipeline.py) instead of lax.scan.
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
+    # "gpipe": forward pipeline + AD backward (composes with any loss, all
+    # M microbatch activations live).  "1f1b": interleaved fwd/bwd
+    # (PipeDream-flush) via BertMLM.pipeline_loss_and_grads — O(S)
+    # activations; requires mlm_predictions > 0 (per-microbatch losses
+    # must average exactly).
+    pipeline_schedule: str = "gpipe"
     # Rematerialization: recompute encoder-layer activations in the backward
     # pass instead of storing them (jax.checkpoint) — trades ~30% more FLOPs
     # for O(num_layers x B x T x D) less HBM, the standard TPU memory lever.
     remat: bool = False
+    # Checkpoint policy when remat is on: "full" recomputes everything
+    # (max memory savings, ~30% extra FLOPs); "dots" saves matmul outputs
+    # and recomputes only elementwise work (most of the memory win at a
+    # few % recompute — matmuls are the FLOPs, elementwise is the bulk of
+    # the activation bytes).
+    remat_policy: str = "full"
     # Mixture-of-Experts: >0 replaces every layer's dense FFN with a MoE of
     # that many experts (nn/moe.py; expert-parallel over the 'expert' mesh
     # axis).  The router's load-balance aux loss is added to the MLM loss
@@ -78,8 +101,15 @@ class BertEncoderLayer(Module):
 
     def __init__(self, cfg: BertConfig):
         self.cfg = cfg
+        impl = cfg.attn_impl
+        if impl is None:
+            use_flash = (jax.default_backend() == "tpu"
+                         if cfg.use_flash is None else cfg.use_flash)
+            if use_flash:
+                from dtf_tpu.ops.flash_attention import flash_attention_impl
+                impl = flash_attention_impl(causal=False)
         self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype,
-                                       attn_impl=cfg.attn_impl)
+                                       attn_impl=impl)
         self.ln1 = LayerNorm(cfg.dim)
         self.ln2 = LayerNorm(cfg.dim)
         self.moe = None
@@ -168,6 +198,43 @@ class BertMLM(Module):
         frac = min(self.cfg.moe_top_k, self.cfg.moe_experts) / self.cfg.moe_experts
         return total - int(expert * (1.0 - frac))
 
+    def _grouped_layers(self, params):
+        """(L, ...) stacked layer params -> (S, L/S, ...) pipeline stages."""
+        s = self.cfg.pipeline_mesh.shape["pipe"]
+        n_layers = self.cfg.num_layers
+        if n_layers % s:
+            raise ValueError(f"{n_layers} layers not divisible by pipe={s}")
+        return jax.tree_util.tree_map(
+            lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
+            params["layers"])
+
+    def _stage_fn(self):
+        """Pipeline stage: a block of encoder layers under the schedule
+        contract ``(stage_params, h, ctx) -> (h, aux)``.  ``ctx`` may carry
+        a per-row key-padding mask (``"pad"``); MoE router aux accumulates
+        across the stage's layers.  Expert weights are replicated within a
+        stage here (all mesh axes are Manual inside the pipeline's
+        shard_map, so the ``expert``-axis GSPMD sharding does not apply)."""
+
+        def stage(stage_params, h, ctx):
+            mask = None
+            if "pad" in ctx:
+                mask = ctx["pad"][:, None, None, :]
+            lf = lambda lp, c: self.layer.apply(lp, c, mask=mask)
+            if self.cfg.remat:   # honor remat inside pipeline stages too
+                lf = remat(lf, self.cfg.remat_policy)
+
+            def body(carry, lp):
+                hh, aux = carry
+                y, a = lf(lp, hh)
+                return (y, aux + a), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (h, jnp.zeros((), jnp.float32)), stage_params)
+            return h, aux
+
+        return stage
+
     def encode(self, params, tokens, *, pad_mask=None):
         """tokens (B, T) int32 -> hidden (B, T, D)."""
         t = tokens.shape[1]
@@ -179,48 +246,26 @@ class BertMLM(Module):
             attn_mask = pad_mask[:, None, None, :]   # (B,1,1,Tk)
 
         if self.cfg.pipeline_mesh is not None:
-            if pad_mask is not None:
-                raise ValueError("pipelined encoder does not support "
-                                 "pad_mask (microbatching would split it)")
             if self.cfg.attn_impl is not None:
                 raise ValueError(
                     "pipelined encoder requires the default attention: a "
                     "shard_map-based attn_impl (ring attention) cannot nest "
                     "inside the pipeline's shard_map (all mesh axes are "
                     "Manual there); use PP x DP or SP x DP, not PP x SP")
-            if self.cfg.moe_experts > 0:
-                raise ValueError("pipelined encoder does not support MoE "
-                                 "(stage outputs carry activations only, "
-                                 "the router aux loss would be dropped)")
             from dtf_tpu.parallel.pipeline import pipeline_apply
             mesh = self.cfg.pipeline_mesh
-            s = mesh.shape["pipe"]
-            n_layers = self.cfg.num_layers
-            if n_layers % s:
-                raise ValueError(f"{n_layers} layers not divisible by "
-                                 f"pipe={s}")
-            grouped = jax.tree_util.tree_map(
-                lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
-                params["layers"])
-
-            def stage(stage_params, h):
-                lf = lambda lp, c: self.layer.apply(lp, c)[0]
-                if self.cfg.remat:   # honor remat inside pipeline stages too
-                    lf = jax.checkpoint(lf)
-
-                def body(carry, lp):
-                    return lf(lp, carry), None
-                h, _ = jax.lax.scan(body, h, stage_params)
-                return h
-
-            out = pipeline_apply(
-                stage, grouped, x, mesh,
-                num_microbatches=self.cfg.pipeline_microbatches)
-            return out, jnp.zeros((), jnp.float32)
+            grouped = self._grouped_layers(params)
+            ctx = {} if pad_mask is None else {"pad": pad_mask}
+            out, moe_aux = pipeline_apply(
+                self._stage_fn(), grouped, x, mesh,
+                num_microbatches=self.cfg.pipeline_microbatches, ctx=ctx)
+            # aux_sum is summed over microbatches (each a per-mb mean);
+            # divide by M to match the non-pipelined per-batch mean.
+            return out, moe_aux / self.cfg.pipeline_microbatches
 
         layer_fn = lambda lp, h: self.layer.apply(lp, h, mask=attn_mask)
         if self.cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn)
+            layer_fn = remat(layer_fn, self.cfg.remat_policy)
 
         def body(carry, layer_params):
             h, aux = carry
@@ -273,11 +318,164 @@ class BertMLM(Module):
         inputs = jnp.where(selected, masked, tokens)
         return inputs, selected
 
+    def mask_tokens_fixed(self, rng, tokens):
+        """Fixed-K masking: select exactly cfg.mlm_predictions positions
+        per sequence (top-K of per-position uniform scores — distinct by
+        construction), 80/10/10 mask/random/keep.  Returns (inputs,
+        idx (B, K), targets (B, K))."""
+        cfg = self.cfg
+        k = cfg.mlm_predictions
+        r_sel, r_kind, r_rand = jax.random.split(rng, 3)
+        scores = jax.random.uniform(r_sel, tokens.shape)
+        _, idx = jax.lax.top_k(scores, k)                    # (B, K)
+        targets = jnp.take_along_axis(tokens, idx, axis=1)
+        kind = jax.random.uniform(r_kind, idx.shape)
+        random_toks = jax.random.randint(r_rand, idx.shape, 0,
+                                         cfg.vocab_size)
+        masked = jnp.where(kind < 0.8, cfg.mask_token,
+                           jnp.where(kind < 0.9, random_toks, targets))
+        inputs = tokens.at[jnp.arange(tokens.shape[0])[:, None], idx].set(
+            masked)
+        return inputs, idx, targets
+
+    def _loss_fixed_k(self, params, tokens, rng, train):
+        """MLM loss with the K-position head: encoder over all T, head +
+        vocab projection over the K gathered positions only."""
+        inputs, idx, targets = self.mask_tokens_fixed(rng, tokens)
+        x, moe_aux = self.encode(params, inputs)
+        h = jnp.take_along_axis(x, idx[..., None], axis=1)   # (B, K, D)
+        h = jax.nn.gelu(self.head_fc.apply(params["head_fc"], h))
+        h = self.head_ln.apply(params["head_ln"], h)
+        logits = self.tok.attend(params["tok"], h)
+        logits = logits.astype(jnp.float32) + params["head_bias"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+        loss = -jnp.mean(tok_logp)
+        acc = jnp.mean((jnp.argmax(logits, -1) == targets)
+                       .astype(jnp.float32))
+        metrics = {"accuracy": acc,
+                   "masked_frac": jnp.float32(self.cfg.mlm_predictions
+                                              / tokens.shape[1])}
+        if self.cfg.moe_experts > 0:
+            loss = loss + self.cfg.moe_aux_weight * moe_aux
+            metrics["moe_aux"] = moe_aux
+        return loss, metrics
+
+    def train_flops_per_example(self, params) -> float:
+        """Actual per-example train FLOPs under the 6·P·T convention: the
+        encoder runs on all T positions, the MLM head (head_fc D^2 + tied
+        vocab projection D·V) only on the K predicted positions.  Keeps
+        the benchmark's MFU honest when mlm_predictions shrinks the head
+        instead of silently inflating it with FLOPs that never ran."""
+        cfg = self.cfg
+        p_active = self.active_param_count(params)
+        p_head = cfg.dim * cfg.vocab_size + cfg.dim * cfg.dim
+        t = cfg.max_len
+        k = cfg.mlm_predictions or t
+        return 6.0 * ((p_active - p_head) * t + p_head * k)
+
+    # --- 1F1B pipelined training (loss + grads in one schedule) --------
+
+    @property
+    def custom_grads_fn(self):
+        """The trainer's seam for models that must produce their own
+        gradients: 1F1B interleaves forward and backward microbatches
+        inside one schedule, so ``jax.grad`` over a forward pass cannot
+        express it.  None unless configured for 1F1B."""
+        cfg = self.cfg
+        if cfg.pipeline_mesh is None or cfg.pipeline_schedule != "1f1b":
+            return None
+        if cfg.mlm_predictions <= 0:
+            raise ValueError(
+                "1f1b needs mlm_predictions > 0: its loss is the mean of "
+                "per-microbatch means, which equals the dense path's "
+                "weighted mean only when every row predicts the same "
+                "fixed K positions")
+        return self.pipeline_loss_and_grads
+
+    def _head_loss_mb(self, head_params, y_mb, ctx_mb):
+        """Per-microbatch MLM loss on the K gathered positions — the
+        ``loss_fn`` the 1F1B schedule runs inside the last stage."""
+        h = jnp.take_along_axis(y_mb, ctx_mb["idx"][..., None], axis=1)
+        h = jax.nn.gelu(self.head_fc.apply(head_params["head_fc"], h))
+        h = self.head_ln.apply(head_params["head_ln"], h)
+        logits = self.tok.attend(head_params["tok"], h)
+        logits = logits.astype(jnp.float32) + head_params["head_bias"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(
+            logp, ctx_mb["targets"][..., None], axis=-1)[..., 0]
+        return -jnp.mean(tok_logp)
+
+    def pipeline_loss_and_grads(self, params, batch, rng):
+        """1F1B training pass: (loss, metrics, grads) in one interleaved
+        pipeline schedule (parallel/pipeline.py::pipeline_train_1f1b).
+
+        The embedding layers run outside the pipeline under ``jax.vjp``
+        (their cotangent is the schedule's dx output); the MLM head runs
+        inside the last stage.  The tied token table gets gradient from
+        BOTH paths (input embedding + head projection) — summed here.
+        """
+        from dtf_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        cfg = self.cfg
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        if rng is None:
+            rng = jax.random.key(0)
+        inputs, idx, targets = self.mask_tokens_fixed(rng, tokens)
+
+        emb_params = {"tok": params["tok"], "pos": params["pos"],
+                      "ln_emb": params["ln_emb"]}
+
+        def embed(ep):
+            t = inputs.shape[1]
+            x = (self.tok.apply(ep["tok"], inputs)
+                 + self.pos.apply(ep["pos"], jnp.arange(t)))
+            return self.ln_emb.apply(ep["ln_emb"], x)
+
+        x0, embed_vjp = jax.vjp(embed, emb_params)
+
+        head_params = {"head_fc": params["head_fc"],
+                       "head_ln": params["head_ln"],
+                       "head_bias": params["head_bias"],
+                       "tok": params["tok"]}
+        ctx = {"idx": idx, "targets": targets}
+        aux_w = cfg.moe_aux_weight if cfg.moe_experts > 0 else 0.0
+        loss, sgrads, hgrads, dx0 = pipeline_train_1f1b(
+            self._stage_fn(), self._head_loss_mb, self._grouped_layers(params),
+            head_params, x0, ctx, cfg.pipeline_mesh,
+            num_microbatches=cfg.pipeline_microbatches, aux_weight=aux_w)
+        (demb,) = embed_vjp(dx0.astype(x0.dtype))
+
+        n_layers = cfg.num_layers
+        layer_grads = jax.tree_util.tree_map(
+            lambda g: g.reshape(n_layers, *g.shape[2:]), sgrads)
+        grads = {
+            "tok": jax.tree_util.tree_map(jnp.add, demb["tok"],
+                                          hgrads["tok"]),
+            "pos": demb["pos"],
+            "ln_emb": demb["ln_emb"],
+            "layers": layer_grads,
+            "head_fc": hgrads["head_fc"],
+            "head_ln": hgrads["head_ln"],
+            "head_bias": hgrads["head_bias"],
+        }
+        # grads in param dtype (value_and_grad convention the optimizer
+        # states were built around)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        metrics = {"accuracy": jnp.float32(float("nan")),
+                   "masked_frac": jnp.float32(cfg.mlm_predictions
+                                              / tokens.shape[1])}
+        return loss, metrics, grads
+
     def loss(self, params, batch, rng=None, train=True):
         """batch: tokens (B, T) int32 (labels are the tokens themselves)."""
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
         if rng is None:
             rng = jax.random.key(0)
+        if self.cfg.mlm_predictions > 0:
+            return self._loss_fixed_k(params, tokens, rng, train)
         inputs, selected = self.mask_tokens(rng, tokens)
         logits, moe_aux = self.apply(params, inputs, train=train,
                                      return_aux=True)

@@ -64,32 +64,45 @@ def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) ->
                           tiled=True)
 
 
+# Quantization granularity: one f32 scale per this many values.  A single
+# outlier then only inflates the step size of its own block instead of the
+# whole chunk (~an order of magnitude less error on heavy-tailed gradient
+# distributions), for 4 bytes of scale overhead per 256 int8 payload bytes
+# (~1.6% extra wire traffic).
+_QBLOCK = 256
+
+
 def _quantize_int8(v: jax.Array) -> tuple:
-    """Symmetric per-chunk int8 quantization: (q int8, scale f32)."""
-    scale = jnp.max(jnp.abs(v)) / 127.0
+    """Symmetric per-block int8 quantization of a flat (m,) chunk whose m
+    is a _QBLOCK multiple: (q int8 (nb, B), scales f32 (nb, 1))."""
+    vb = v.reshape(-1, _QBLOCK)
+    scale = jnp.max(jnp.abs(vb), axis=1, keepdims=True) / 127.0
     safe = jnp.maximum(scale, 1e-30)
-    q = jnp.clip(jnp.round(v / safe), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(vb / safe), -127, 127).astype(jnp.int8)
     return q, scale
 
 
 def _dequantize_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
-    return q.astype(jnp.float32) * scale
+    return (q.astype(jnp.float32) * scale).reshape(-1)
 
 
 def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
     """Mean-all-reduce with an int8 wire format (EQuARX-style, cf.
     PAPERS.md "Efficient Quantized AllReduce in XLA"): a hand-scheduled
     ring — reduce-scatter then all-gather over ``ppermute`` — where every
-    hop ships int8 payloads + one f32 scale instead of f32 tensors, ~4x
-    less ICI traffic for bandwidth-bound gradient syncs.
+    hop ships int8 payloads + per-block f32 scales (one per _QBLOCK
+    values) instead of f32 tensors, ~4x less ICI traffic for
+    bandwidth-bound gradient syncs.
 
     Per-device code (call inside ``shard_map``).  Deterministic and
     identical on every device (the gather phase distributes each reduced
     chunk through the same quantize/dequantize path to all ranks, so no
     rank-dependent rounding survives).  Quantization noise: one
-    round-to-nearest per reduce hop (n-1 of them) plus one on the gather —
-    relative error ~1e-2 on typical gradients; use exact ``pmean`` when
-    that matters more than bandwidth.
+    round-to-nearest per reduce hop (n-1 of them) plus one on the gather,
+    each bounded by its block's own max — relative error ~1e-3 on typical
+    gradients (see tests/test_quantized_allreduce.py's measured bound and
+    convergence A/B); use exact ``pmean`` when that matters more than
+    bandwidth.
     """
     n = lax.axis_size(axis)
     if n == 1:
@@ -98,6 +111,7 @@ def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
     shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     m = -(-flat.size // n)
+    m = -(-m // _QBLOCK) * _QBLOCK          # per-block scales need full blocks
     buf = jnp.pad(flat, (0, n * m - flat.size)).reshape(n, m)
 
     fwd = [(i, (i + 1) % n) for i in range(n)]

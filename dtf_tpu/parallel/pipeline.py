@@ -1,4 +1,4 @@
-"""Pipeline parallelism over a ``pipe`` mesh axis (GPipe schedule).
+"""Pipeline parallelism over a ``pipe`` mesh axis (GPipe + 1F1B schedules).
 
 Not in the reference (its only parallelism is async-PS data parallelism,
 SURVEY.md §2.14); built because the framework treats pipeline sharding as a
@@ -8,10 +8,24 @@ TPU-native design: SPMD, not per-stage processes.  Stage parameters carry a
 leading ``stage`` logical axis sharded over ``pipe`` (rule table
 ``("stage", "pipe")``, parallel/sharding.py); execution runs under
 ``jax.shard_map`` where each device holds exactly one stage's weights and
-activations hop stage→stage via ``lax.ppermute`` over ICI.  The schedule is
-a ``lax.scan`` over M + S - 1 ticks (M microbatches, S stages, bubble
-fraction (S-1)/(M+S-1)); reverse-mode AD through the scan+ppermute gives the
-backward pipeline automatically, so the same code trains under jit.
+activations hop stage→stage via ``lax.ppermute`` over ICI.
+
+Two schedules:
+
+* :func:`pipeline_apply` — GPipe: a ``lax.scan`` over M + S - 1 forward
+  ticks; reverse-mode AD through the scan+ppermute gives the backward
+  pipeline automatically.  Simple and composes with any outer loss, but AD
+  stores ALL M microbatch activations per stage.
+* :func:`pipeline_train_1f1b` — PipeDream-flush (1F1B): forward and
+  backward microbatches interleave on one global tick clock, so a stage
+  holds at most S in-flight activations instead of M — the schedule that
+  lets M grow (and the bubble fraction (S-1)/(M+S-1) shrink) without the
+  GPipe activation blow-up.  The loss runs INSIDE the last stage (that is
+  what makes interleaving possible), so this primitive returns gradients
+  directly rather than composing with an outer ``jax.grad``.  Stage inputs
+  are re-materialized from the stashed stage INPUT during each backward
+  tick (remat-style), which is what bounds the stash at S small input
+  buffers.
 """
 
 from __future__ import annotations
@@ -25,22 +39,10 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
-                   mesh: Mesh, *, num_microbatches: int, axis: str = "pipe",
-                   batch_axes: Optional[tuple] = None) -> jax.Array:
-    """Run ``x`` through S pipeline stages.
-
-    ``stage_fn(params_one_stage, x_mb) -> y_mb`` must preserve the
-    activation shape (e.g. a block of transformer layers).  ``stage_params``
-    is a pytree whose every leaf has leading dim S (the stage axis, sharded
-    over ``axis``).  ``x``: (B, ...) global batch; B must be divisible by
-    ``num_microbatches`` (× the data-axis size, if present).  Returns the
-    last stage's output, (B, ...).
-    """
+def _validate(mesh, axis, stage_params, x, m, batch_axes):
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
     s = mesh.shape[axis]
-    m = num_microbatches
     if x.shape[0] % m:
         raise ValueError(f"batch {x.shape[0]} not divisible by "
                          f"num_microbatches={m}")
@@ -51,7 +53,6 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
     if batch_axes is None:
         from dtf_tpu.parallel.sharding import data_axes as _data_axes
         batch_axes = _data_axes(mesh)
-
     mb = x.shape[0] // m
     data_size = 1
     for a in batch_axes:
@@ -60,22 +61,61 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
         raise ValueError(f"microbatch size {mb} (batch {x.shape[0]} / "
                          f"{m} microbatches) not divisible by data-axis "
                          f"size {data_size}")
+    return s, mb, tuple(batch_axes)
+
+
+def _mb_spec(batch_axes, ndim):
+    """Spec for an (M, mb, ...) microbatched array: M replicated, batch dim
+    sharded over the data axes."""
+    return P(None, batch_axes or None, *([None] * (ndim - 2)))
+
+
+def _ctx_at(ctx, k):
+    return jax.tree_util.tree_map(
+        lambda c: lax.dynamic_index_in_dim(c, k, axis=0, keepdims=False),
+        ctx)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   mesh: Mesh, *, num_microbatches: int, axis: str = "pipe",
+                   batch_axes: Optional[tuple] = None,
+                   ctx: Any = None) -> tuple:
+    """Run ``x`` through S pipeline stages (GPipe schedule).
+
+    ``stage_fn(params_one_stage, x_mb, ctx_mb) -> (y_mb, aux_scalar)`` must
+    preserve the activation shape (e.g. a block of transformer layers);
+    ``aux_scalar`` carries differentiable per-stage side losses (MoE router
+    aux; return 0.0 when unused).  ``stage_params`` is a pytree whose every
+    leaf has leading dim S (the stage axis, sharded over ``axis``).
+    ``x``: (B, ...) global batch; B must be divisible by
+    ``num_microbatches`` (× the data-axis size, if present).  ``ctx``: an
+    optional pytree of per-example side inputs with leading dim B (padding
+    masks etc.), microbatched alongside ``x`` and fed to every stage.
+    Returns ``(y, aux_sum)`` — the last stage's output (B, ...) and the sum
+    of every stage's aux over all microbatches.
+    """
+    m = num_microbatches
+    s, mb, batch_axes = _validate(mesh, axis, stage_params, x, m, batch_axes)
     xs = x.reshape(m, mb, *x.shape[1:])
+    ctx = jax.tree_util.tree_map(
+        lambda c: c.reshape(m, mb, *c.shape[1:]), ctx)
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    # microbatch dim replicated over pipe; batch dim sharded over data axes
-    x_spec = P(None, batch_axes or None, *([None] * (x.ndim - 1)))
+    x_spec = _mb_spec(batch_axes, xs.ndim)
+    ctx_spec = jax.tree_util.tree_map(lambda c: _mb_spec(batch_axes, c.ndim),
+                                      ctx)
 
     body = functools.partial(_per_device_pipeline, stage_fn, s=s, m=m,
-                             axis=axis)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=(param_spec, x_spec),
-                           out_specs=x_spec, check_vma=False)
-    ys = mapped(stage_params, xs)
-    return ys.reshape(x.shape[0], *x.shape[1:])
+                             axis=axis, data_axes=batch_axes)
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(param_spec, x_spec, ctx_spec),
+        out_specs=(x_spec, P()), check_vma=False)
+    ys, aux = mapped(stage_params, xs, ctx)
+    return ys.reshape(x.shape[0], *x.shape[1:]), aux
 
 
-def _per_device_pipeline(stage_fn, stage_params, xs, *, s: int, m: int,
-                         axis: str):
+def _per_device_pipeline(stage_fn, stage_params, xs, ctx, *, s: int, m: int,
+                         axis: str, data_axes: tuple):
     """Per-device GPipe loop.  stage_params leaves: (1, ...) — this stage;
     xs: (M, mb_local, ...) microbatches (same on every pipe rank)."""
     idx = lax.axis_index(axis)
@@ -85,24 +125,232 @@ def _per_device_pipeline(stage_fn, stage_params, xs, *, s: int, m: int,
     fwd_perm = [(i, i + 1) for i in range(s - 1)]
 
     def tick(carry, t):
-        buf, ys = carry
-        # stage 0 injects microbatch t (clamped; ticks >= M are drain-only)
+        buf, ys, aux_sum = carry
+        # stage i processes microbatch t - i; clamp covers warmup/drain
+        k = jnp.clip(t - idx, 0, m - 1)
         x_in = lax.dynamic_index_in_dim(xs, jnp.minimum(t, m - 1), axis=0,
                                         keepdims=False)
         inp = jnp.where(is_first, x_in, buf)
-        y = stage_fn(params, inp)
+        y, aux = stage_fn(params, inp, _ctx_at(ctx, k))
         # collect finished microbatches; warm-up ticks (t < s-1) all write
         # slot 0 and are overwritten by the first valid write at t = s-1.
         # Non-last stages accumulate garbage here — masked out by the psum
         # below, and the where() there also zeroes their cotangents in AD.
         slot = jnp.maximum(t - (s - 1), 0)
         ys = lax.dynamic_update_index_in_dim(ys, y, slot, axis=0)
+        # aux is garbage outside this stage's active window — mask it
+        valid = (t >= idx) & (t - idx < m)
+        aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         buf_next = lax.ppermute(y, axis, fwd_perm)
-        return (buf_next, ys), None
+        return (buf_next, ys, aux_sum), None
 
     buf0 = jnp.zeros(xs.shape[1:], xs.dtype)
     ys0 = jnp.zeros_like(xs)
-    (_, ys), _ = lax.scan(tick, (buf0, ys0), jnp.arange(m + s - 1))
+    (_, ys, aux_sum), _ = lax.scan(
+        tick, (buf0, ys0, jnp.zeros((), jnp.float32)),
+        jnp.arange(m + s - 1))
     # only the last stage holds real outputs; broadcast over the pipe axis
     ys = lax.psum(jnp.where(is_last, ys, jnp.zeros_like(ys)), axis)
-    return ys
+    # per-stage aux: sum over pipe ranks; mean over data ranks (aux is a
+    # per-token mean within each shard's rows)
+    aux_sum = lax.psum(aux_sum, axis)
+    if data_axes:
+        aux_sum = lax.pmean(aux_sum, data_axes)
+    return ys, aux_sum
+
+
+# --------------------------------------------------------------------------
+# 1F1B (PipeDream-flush)
+# --------------------------------------------------------------------------
+
+def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
+                        stage_params: Any, head_params: Any, x: jax.Array,
+                        ctx: Any, mesh: Mesh, *, num_microbatches: int,
+                        axis: str = "pipe", aux_weight: float = 0.0,
+                        batch_axes: Optional[tuple] = None) -> tuple:
+    """One pipelined forward+backward pass under the 1F1B schedule.
+
+    Schedule (global tick clock, S stages, M microbatches): stage ``i``
+    runs the forward of microbatch ``k`` at tick ``2k + i`` and its
+    backward at tick ``2k + 2S - 1 - i`` — forwards and backwards
+    interleave, so at most ``S - i`` microbatches are ever in flight at
+    stage ``i`` (vs all M under GPipe-by-AD).  Total ticks 2(M + S - 1);
+    bubble fraction (S-1)/(M+S-1), same per-M as GPipe — the win is that
+    the O(S) activation footprint lets M grow until the bubble is
+    negligible.  Each backward tick re-materializes the stage forward from
+    the stashed stage INPUT (remat-style; the stash holds S small input
+    buffers, not full per-layer activations).
+
+    Contracts:
+
+    * ``stage_fn(params_one_stage, x_mb, ctx_mb) -> (y_mb, aux_scalar)``
+      — shape-preserving; ``aux_scalar`` differentiable (MoE router loss);
+    * ``loss_fn(head_params, y_mb, ctx_mb) -> scalar`` — the LAST stage
+      maps its output straight to the training loss (mean over the
+      microbatch rows); running the loss inside the pipeline is what makes
+      fwd/bwd interleaving possible;
+    * ``ctx``: pytree of per-example side inputs, leading dim B (labels,
+      masks); not differentiated.
+
+    Total objective: ``mean_k loss_k + aux_weight * sum_{stage,k} aux / M``.
+
+    Returns ``(loss_mean, stage_grads, head_grads, dx)`` — grads for the
+    S-stacked stage params, the head/loss params, and the cotangent of
+    ``x`` (flows back into pre-pipeline embedding layers; differentiate
+    those with an outer ``jax.vjp`` around the embedding computation).
+    Grads are already pmean'd over the data axes.
+    """
+    m = num_microbatches
+    s, mb, batch_axes = _validate(mesh, axis, stage_params, x, m, batch_axes)
+    xs = x.reshape(m, mb, *x.shape[1:])
+    ctx = jax.tree_util.tree_map(
+        lambda c: c.reshape(m, mb, *c.shape[1:]), ctx)
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    head_spec = jax.tree_util.tree_map(lambda _: P(), head_params)
+    x_spec = _mb_spec(batch_axes, xs.ndim)
+    ctx_spec = jax.tree_util.tree_map(lambda c: _mb_spec(batch_axes, c.ndim),
+                                      ctx)
+
+    body = functools.partial(_per_device_1f1b, stage_fn, loss_fn, s=s, m=m,
+                             axis=axis, aux_weight=aux_weight,
+                             data_axes=batch_axes)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, head_spec, x_spec, ctx_spec),
+        out_specs=(P(), param_spec, head_spec, x_spec), check_vma=False)
+    loss, sgrads, hgrads, dxs = mapped(stage_params, head_params, xs, ctx)
+    return loss, sgrads, hgrads, dxs.reshape(x.shape)
+
+
+def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
+                     *, s: int, m: int, axis: str, aux_weight: float,
+                     data_axes: tuple):
+    """Per-device 1F1B loop (see pipeline_train_1f1b for the schedule)."""
+    idx = lax.axis_index(axis)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    is_first = idx == 0
+    is_last = idx == s - 1
+    fwd_perm = [(i, i + 1) for i in range(s - 1)]
+    bwd_perm = [(i, i - 1) for i in range(1, s)]
+    f32 = functools.partial(jax.tree_util.tree_map,
+                            lambda p: jnp.zeros(p.shape, jnp.float32))
+
+    act_shape = xs.shape[1:]
+
+    def fwd_compute(x_in, ctx_k):
+        y, aux = stage_fn(params, x_in, ctx_k)
+        return y, jnp.asarray(aux, jnp.float32)
+
+    def bwd_last(x_res, ctx_k, _dy):
+        def f(p, hp, xx):
+            y, aux = stage_fn(p, xx, ctx_k)
+            l = loss_fn(hp, y, ctx_k)
+            # differentiate the total; report the pure loss (aux is a
+            # regularizer, not the training metric)
+            return l + aux_weight * jnp.asarray(aux, jnp.float32), l
+        _, vjp, l_pure = jax.vjp(f, params, head_params, x_res,
+                                 has_aux=True)
+        dp, dhp, dx = vjp(jnp.asarray(1.0 / m, jnp.float32))
+        return dp, dhp, dx, l_pure
+
+    def bwd_mid(x_res, ctx_k, dy):
+        def f(p, xx):
+            return stage_fn(p, xx, ctx_k)
+        _, vjp = jax.vjp(f, params, x_res)
+        dp, dx = vjp((dy, jnp.asarray(aux_weight / m, jnp.float32)))
+        return dp, jax.tree_util.tree_map(jnp.zeros_like, head_params), \
+            dx, jnp.zeros((), jnp.float32)
+
+    def tick(carry, t):
+        buf_f, buf_b, stash, gsum, hsum, dxs, loss_sum = carry
+
+        # ---- forward slot: stage i, microbatch kf at tick 2*kf + i
+        kf = (t - idx) // 2
+        do_f = ((t - idx) % 2 == 0) & (kf >= 0) & (kf < m)
+        kfc = jnp.clip(kf, 0, m - 1)
+        x_in = jnp.where(
+            is_first,
+            lax.dynamic_index_in_dim(xs, kfc, axis=0, keepdims=False),
+            buf_f)
+        y_send = lax.cond(
+            do_f, lambda: fwd_compute(x_in, _ctx_at(ctx, kfc))[0],
+            lambda: jnp.zeros(act_shape, xs.dtype))
+        stash = lax.cond(
+            do_f,
+            lambda: lax.dynamic_update_index_in_dim(stash, x_in, kfc % s,
+                                                    axis=0),
+            lambda: stash)
+
+        # ---- backward slot: stage i, microbatch kb at tick 2*kb + 2S-1-i
+        tb = t - (2 * s - 1 - idx)
+        kb = tb // 2
+        do_b = (tb % 2 == 0) & (kb >= 0) & (kb < m)
+        kbc = jnp.clip(kb, 0, m - 1)
+        x_res = lax.dynamic_index_in_dim(stash, kbc % s, axis=0,
+                                         keepdims=False)
+
+        def run_bwd():
+            dp, dhp, dx, l = lax.cond(
+                is_last,
+                lambda: bwd_last(x_res, _ctx_at(ctx, kbc), buf_b),
+                lambda: bwd_mid(x_res, _ctx_at(ctx, kbc), buf_b))
+            return dp, dhp, dx, l
+
+        def skip_bwd():
+            return (jax.tree_util.tree_map(jnp.zeros_like, params),
+                    jax.tree_util.tree_map(jnp.zeros_like, head_params),
+                    jnp.zeros(act_shape, xs.dtype),
+                    jnp.zeros((), jnp.float32))
+
+        dp, dhp, dx_send, l = lax.cond(do_b, run_bwd, skip_bwd)
+        gsum = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), gsum, dp)
+        hsum = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), hsum, dhp)
+        loss_sum = loss_sum + l
+        dxs = lax.cond(
+            do_b & is_first,
+            lambda: lax.dynamic_update_index_in_dim(dxs, dx_send, kbc,
+                                                    axis=0),
+            lambda: dxs)
+
+        # unconditional collectives: every device participates every tick
+        buf_f = lax.ppermute(y_send, axis, fwd_perm)
+        buf_b = lax.ppermute(dx_send, axis, bwd_perm)
+        return (buf_f, buf_b, stash, gsum, hsum, dxs, loss_sum), None
+
+    carry0 = (jnp.zeros(act_shape, xs.dtype),
+              jnp.zeros(act_shape, xs.dtype),
+              jnp.zeros((s, *act_shape), xs.dtype),
+              f32(params), f32(head_params),
+              jnp.zeros_like(xs), jnp.zeros((), jnp.float32))
+    (_, _, _, gsum, hsum, dxs, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(2 * (m + s - 1)))
+
+    # head grads / loss live on the last stage, dxs on the first: share
+    hsum = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), hsum)
+    loss_mean = lax.psum(loss_sum, axis) / m
+    dxs = lax.psum(jnp.where(is_first, dxs, jnp.zeros_like(dxs)), axis)
+    if data_axes:
+        pm = lambda g: lax.pmean(g, data_axes)
+        gsum = jax.tree_util.tree_map(pm, gsum)
+        hsum = jax.tree_util.tree_map(pm, hsum)
+        loss_mean = pm(loss_mean)
+        # loss_fn averaged over the LOCAL shard's rows; per-row input
+        # cotangents must reflect the GLOBAL per-microbatch mean (grads
+        # handle this via the pmean above — dxs rows are per-shard)
+        dsize = 1
+        for a in data_axes:
+            dsize *= lax.axis_size(a)
+        dxs = dxs / dsize
+    # re-add the stacked stage dim so out_specs P(axis) reassembles (S, ...)
+    gsum = jax.tree_util.tree_map(lambda g: g[None], gsum)
+    return loss_mean, gsum, hsum, dxs
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the pipeline: (S-1)/(M+S-1) for both schedules —
+    1F1B's O(S) activation memory is what lets M grow to shrink this."""
+    s, m = num_stages, num_microbatches
+    return (s - 1) / (m + s - 1)

@@ -37,6 +37,15 @@ def main(argv=None) -> int:
     parser.add_argument("--remat", action="store_true",
                         help="recompute encoder activations in backward "
                              "(jax.checkpoint): less HBM, ~30%% more FLOPs")
+    parser.add_argument("--remat_policy", choices=["full", "dots"],
+                        default="full",
+                        help="with --remat: 'dots' saves matmul outputs and "
+                             "recomputes only elementwise work (most of the "
+                             "memory win at a few %% recompute)")
+    parser.add_argument("--attn", choices=["auto", "flash", "xla"],
+                        default="auto",
+                        help="inner attention: pallas flash kernel (mask-"
+                             "capable) vs XLA softmax (auto = flash on TPU)")
     parser.add_argument("--ring_attention", action="store_true",
                         help="sequence-parallel ring attention over 'seq'")
     parser.add_argument("--ulysses", action="store_true",
@@ -45,9 +54,21 @@ def main(argv=None) -> int:
                              "kernel")
     parser.add_argument("--pipeline_microbatches", type=int, default=0,
                         help=">0: pipeline the encoder over the 'pipe' axis")
+    parser.add_argument("--pipeline_schedule", choices=["gpipe", "1f1b"],
+                        default="gpipe",
+                        help="gpipe: fwd pipeline + AD backward; 1f1b: "
+                             "interleaved fwd/bwd (O(stages) activations; "
+                             "needs --mlm_predictions > 0)")
     parser.add_argument("--moe_experts", type=int, default=0,
                         help=">0: MoE FFN with this many experts "
                              "(expert-parallel over the 'expert' axis)")
+    parser.add_argument("--mlm_predictions", type=int, default=None,
+                        help="fixed masked positions per sequence (the "
+                             "standard max_predictions_per_seq recipe: "
+                             "head + vocab projection run on K, not T, "
+                             "positions).  Default: ~15%% of seq_len "
+                             "rounded to 8 for preset base; 0 = dense "
+                             "head over every position")
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
@@ -59,6 +80,8 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if ns.bf16 else jnp.float32
     kw = {}
+    if ns.attn != "auto":
+        kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:
         kw["max_len"] = ns.seq_len
     if ns.ring_attention and ns.ulysses:
@@ -74,10 +97,18 @@ def main(argv=None) -> int:
     if ns.pipeline_microbatches > 0:
         kw["pipeline_mesh"] = mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches
+        kw["pipeline_schedule"] = ns.pipeline_schedule
     if ns.remat:
         kw["remat"] = True
+        kw["remat_policy"] = ns.remat_policy
     if ns.moe_experts > 0:
         kw["moe_experts"] = ns.moe_experts
+    if ns.mlm_predictions is not None:
+        kw["mlm_predictions"] = ns.mlm_predictions
+    elif ns.preset == "base":
+        # standard BERT recipe: ~15% of positions, lane-friendly multiple
+        seq = ns.seq_len or 512
+        kw["mlm_predictions"] = max(8, int(seq * 0.15) // 8 * 8)
     cfg = (BertConfig(dtype=dtype, **kw) if ns.preset == "base"
            else BertConfig.tiny(dtype=dtype, **kw))
     model = BertMLM(cfg)

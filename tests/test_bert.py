@@ -70,6 +70,42 @@ class TestBert:
                           / jnp.sum(selected))
         assert mask_frac == pytest.approx(0.8, abs=0.1)
 
+    def test_fixed_k_masking_exact_count(self):
+        cfg = BertConfig.tiny(mlm_predictions=4)
+        m = BertMLM(cfg)
+        toks = jnp.ones((16, 32), jnp.int32) * 7
+        inputs, idx, targets = m.mask_tokens_fixed(jax.random.key(0), toks)
+        assert idx.shape == (16, 4)
+        # exactly K distinct positions per row
+        for row in np.asarray(idx):
+            assert len(set(row.tolist())) == 4
+        np.testing.assert_array_equal(targets, np.full((16, 4), 7))
+        # ~80% of the K selections became [MASK]
+        sel_vals = jnp.take_along_axis(inputs, idx, axis=1)
+        frac = float(jnp.mean(sel_vals == cfg.mask_token))
+        assert frac == pytest.approx(0.8, abs=0.12)
+
+    def test_fixed_k_loss_trains(self):
+        """K-position head: finite loss, gradients flow to every param
+        (incl. the head), accounted FLOPs < dense FLOPs."""
+        cfg = BertConfig.tiny(mlm_predictions=4)
+        m = BertMLM(cfg)
+        p = m.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                                  cfg.vocab_size)
+        (loss, aux), grads = jax.value_and_grad(
+            lambda pp: m.loss(pp, toks, rng=jax.random.key(2)),
+            has_aux=True)(p)
+        assert bool(jnp.isfinite(loss))
+        assert float(aux["masked_frac"]) == pytest.approx(4 / 32)
+        gnorms = [float(jnp.abs(g).sum())
+                  for g in jax.tree_util.tree_leaves(grads)]
+        assert all(np.isfinite(gnorms))
+        assert sum(1 for g in gnorms if g > 0) > len(gnorms) * 0.8
+        dense = BertMLM(BertConfig.tiny())
+        assert (m.train_flops_per_example(p)
+                < dense.train_flops_per_example(p))
+
     def test_param_axes_mirror_params(self):
         cfg = BertConfig.tiny()
         m = BertMLM(cfg)
