@@ -1,0 +1,77 @@
+"""Real-format dataset fixture writers.
+
+The reference trained on real MNIST bytes (IDX files read by
+``input_data.read_data_sets``, tf_distributed.py:27-28).  This image has
+zero egress, so the real datasets cannot be downloaded — but the FORMATS
+can still be exercised end to end: these writers emit deterministic
+synthetic data in the genuine on-disk formats (IDX for MNIST, the python
+pickle batches for CIFAR-10), so ``load_mnist``/``load_cifar10`` take their
+real-bytes parsing path (magic numbers, big-endian dims, gzip variants,
+uint8 -> float scaling) instead of the in-memory fallback.  Drop real
+dataset files in the same directories and nothing changes but the bytes.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from dtf_tpu.data.datasets import _synthetic_classification
+
+
+def _to_uint8_images(x: np.ndarray) -> np.ndarray:
+    """[0,1] float -> uint8 pixel bytes."""
+    return np.clip(np.round(x * 255.0), 0, 255).astype(np.uint8)
+
+
+def write_mnist_idx(data_dir: str, n_train: int = 4096, n_test: int = 1024,
+                    seed: int = 1, compress: bool = False) -> None:
+    """Write train/test image+label IDX files (optionally .gz) into
+    ``data_dir`` using the exact header layout of the published files
+    (magic 0x803 for rank-3 images, 0x801 for rank-1 labels, big-endian
+    dims)."""
+    os.makedirs(data_dir, exist_ok=True)
+
+    def dump(path, arr, magic):
+        op = gzip.open if compress else open
+        with op(path + (".gz" if compress else ""), "wb") as f:
+            f.write(struct.pack(">I", magic))
+            f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+            f.write(arr.tobytes())
+
+    for split, n, split_seed in (("train", n_train, 0), ("t10k", n_test, 1)):
+        x, y1h = _synthetic_classification(n, (28, 28), 10, seed,
+                                           split_seed=split_seed)
+        imgs = _to_uint8_images(x)
+        labels = np.argmax(y1h, axis=1).astype(np.uint8)
+        dump(os.path.join(data_dir, f"{split}-images-idx3-ubyte"),
+             imgs, 0x803)
+        dump(os.path.join(data_dir, f"{split}-labels-idx1-ubyte"),
+             labels, 0x801)
+
+
+def write_cifar_batches(data_dir: str, n_per_batch: int = 800,
+                        n_test: int = 800, seed: int = 1) -> None:
+    """Write data_batch_1..5 + test_batch pickles into ``data_dir`` in the
+    published CIFAR-10 python layout (dict with b"data" (N, 3072) uint8
+    row-major RGB planes and b"labels")."""
+    os.makedirs(data_dir, exist_ok=True)
+
+    def dump(path, x, y):
+        # (N, 32, 32, 3) [0,1] -> (N, 3072) uint8 channel-planar
+        planar = _to_uint8_images(x).transpose(0, 3, 1, 2).reshape(len(x), -1)
+        with open(path, "wb") as f:
+            pickle.dump({b"data": planar, b"labels": y.tolist()}, f)
+
+    for i in range(1, 6):
+        x, y1h = _synthetic_classification(n_per_batch, (32, 32, 3), 10,
+                                           seed, split_seed=i * 10)
+        dump(os.path.join(data_dir, f"data_batch_{i}"), x,
+             np.argmax(y1h, axis=1))
+    x, y1h = _synthetic_classification(n_test, (32, 32, 3), 10, seed,
+                                       split_seed=99)
+    dump(os.path.join(data_dir, "test_batch"), x, np.argmax(y1h, axis=1))

@@ -26,13 +26,17 @@ def main(argv=None) -> int:
     parser.add_argument("--arch", choices=["resnet50", "tiny"],
                         default="resnet50",
                         help="tiny = 2-stage test model (CPU-friendly)")
+    parser.add_argument("--data_dir", default="cifar-10-batches-py",
+                        help="directory with the CIFAR-10 pickle batches "
+                             "(real or dtf_tpu.data.fixtures-written); "
+                             "synthetic fallback when absent")
     parser.set_defaults(batch_size=256, learning_rate=0.1, epochs=10)
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
     cluster = bootstrap(cluster_cfg)
-    splits = load_cifar10(seed=train_cfg.seed)
+    splits = load_cifar10(ns.data_dir, seed=train_cfg.seed)
     if splits.synthetic and cluster.is_coordinator:
         print("[dtf_tpu] cifar-10-batches-py/ not found; using deterministic "
               "synthetic data (zero-egress environment)")

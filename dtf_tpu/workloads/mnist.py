@@ -33,6 +33,10 @@ def main(argv=None) -> int:
     parser.add_argument("--native_loader", action="store_true",
                         help="serve train batches through the C++ "
                              "prefetching loader (dtf_tpu/native)")
+    parser.add_argument("--data_dir", default="MNIST_data",
+                        help="directory with the IDX files (real MNIST or "
+                             "dtf_tpu.data.fixtures-written); synthetic "
+                             "fallback when absent")
     parser.add_argument("--grad_compression", choices=["int8"], default=None,
                         help="int8-wire ring all-reduce for gradient sync "
                              "(requires --mode explicit)")
@@ -47,7 +51,7 @@ def main(argv=None) -> int:
     # shapes): per_device_batch scales by the device count.
     global_batch = global_batch_size(cluster, train_cfg)
     splits = load_mnist(
-        seed=train_cfg.seed,
+        ns.data_dir, seed=train_cfg.seed,
         native_train_batch=global_batch if ns.native_loader else None)
     if splits.synthetic and cluster.is_coordinator:
         print("[dtf_tpu] MNIST_data/ not found; using deterministic "

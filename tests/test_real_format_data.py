@@ -1,0 +1,81 @@
+"""Real-bytes data path, end to end: fixture writers emit the genuine
+on-disk formats (IDX, CIFAR pickle batches), the loaders parse them
+through their real-file code paths (not the synthetic fallback), and
+MNIST trains to >=95% test accuracy on those bytes."""
+
+import jax
+import numpy as np
+import pytest
+
+from dtf_tpu.data.datasets import load_cifar10, load_mnist
+from dtf_tpu.data.fixtures import write_cifar_batches, write_mnist_idx
+
+
+class TestMnistIdx:
+    def test_round_trip_plain_and_gzip(self, tmp_path):
+        for compress in (False, True):
+            d = tmp_path / ("gz" if compress else "plain")
+            write_mnist_idx(str(d), n_train=256, n_test=64,
+                            compress=compress)
+            splits = load_mnist(str(d))
+            assert not splits.synthetic          # real-file path taken
+            assert splits.train.images.shape == (256, 784)
+            assert splits.test.images.shape == (64, 784)
+            assert splits.train.images.dtype == np.float32
+            assert 0.0 <= splits.train.images.min()
+            assert splits.train.images.max() <= 1.0
+            assert splits.train.labels.shape == (256, 10)
+            assert np.all(splits.train.labels.sum(axis=1) == 1.0)
+
+    def test_trains_to_95_percent(self, tmp_path, mesh8):
+        """The reference's observable: real-bytes MNIST reaching high test
+        accuracy (tf_distributed.py:126).  Adam for a CPU-friendly step
+        budget; the task is the deterministic prototype+noise synthetic in
+        real IDX clothing."""
+        from dtf_tpu import optim
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+
+        write_mnist_idx(str(tmp_path), n_train=2048, n_test=512)
+        splits = load_mnist(str(tmp_path))
+        assert not splits.synthetic
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.adam(1e-3)
+        state = init_state(model, opt, seed=1, mesh=mesh8)
+        step = make_train_step(model.loss, opt, mesh8, donate=False)
+        for i in range(300):
+            batch = put_global_batch(mesh8, splits.train.next_batch(128))
+            state, _ = step(state, batch, jax.random.key(i))
+        import jax.numpy as jnp
+        logits = model.apply(state["params"],
+                             jnp.asarray(splits.test.images))
+        acc = float(np.mean(np.argmax(logits, -1)
+                            == np.argmax(splits.test.labels, -1)))
+        assert acc >= 0.95, acc
+
+
+class TestCifarPickles:
+    def test_round_trip(self, tmp_path):
+        write_cifar_batches(str(tmp_path), n_per_batch=64, n_test=32)
+        splits = load_cifar10(str(tmp_path))
+        assert not splits.synthetic
+        assert splits.train.images.shape == (320, 32, 32, 3)
+        assert splits.test.images.shape == (32, 32, 32, 3)
+        assert 0.0 <= splits.train.images.min()
+        assert splits.train.images.max() <= 1.0
+        assert splits.train.labels.shape == (320, 10)
+
+    def test_channel_layout_preserved(self, tmp_path):
+        """The pickle rows are channel-planar (R plane, G plane, B plane);
+        the loader must unscramble them back to (H, W, C)."""
+        import pickle
+
+        write_cifar_batches(str(tmp_path), n_per_batch=8, n_test=8)
+        with open(tmp_path / "data_batch_1", "rb") as f:
+            raw = pickle.load(f, encoding="bytes")
+        row = np.asarray(raw[b"data"][0], np.float32) / 255.0
+        want = row.reshape(3, 32, 32).transpose(1, 2, 0)
+        splits = load_cifar10(str(tmp_path))
+        np.testing.assert_allclose(splits.train.images[0], want,
+                                   atol=1e-6)
