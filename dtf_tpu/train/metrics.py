@@ -63,6 +63,13 @@ class MetricLogger:
                   cost: float, avg_ms: float) -> None:
         self.print(format_step_line(step, epoch, batch, batch_count, cost, avg_ms))
 
+    def graph(self, params, root: str = "model") -> None:
+        """Write the model-structure GraphDef event once (the reference
+        wrote its graph at Supervisor startup, tf_distributed.py:97)."""
+        if self._tb:
+            self._tb.graph_from_params(params, root)
+            self._tb.flush()
+
     def scalar(self, step: int, name: str, value: float) -> None:
         if self._writer:
             self._writer.writerow([step, name, float(value)])

@@ -95,6 +95,26 @@ def _version_event(wall_time: float) -> bytes:
     return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
 
 
+def _node_def(name: str, op: str, inputs=(), device: str = "") -> bytes:
+    """NodeDef{name=1, op=2, input=3*, device=4}."""
+    out = _field_bytes(1, name.encode()) + _field_bytes(2, op.encode())
+    for i in inputs:
+        out += _field_bytes(3, i.encode())
+    if device:
+        out += _field_bytes(4, device.encode())
+    return out
+
+
+def _graph_event(wall_time: float, nodes) -> bytes:
+    """Event{wall_time=1, graph_def=4}: the reference wrote its graph once
+    at Supervisor startup (tf_distributed.py:97).  ``nodes``: iterable of
+    (name, op, inputs) tuples; slash-separated names become TensorBoard's
+    graph-tab name scopes.  GraphDef{node=1*, versions=4{producer=1}}."""
+    gd = b"".join(_field_bytes(1, _node_def(*n)) for n in nodes)
+    gd += _field_bytes(4, _field_varint(1, 22))     # VersionDef.producer
+    return _field_double(1, wall_time) + _field_bytes(4, gd)
+
+
 # ------------------------------------------------------------- the writer --
 
 class TBEventWriter:
@@ -119,6 +139,29 @@ class TBEventWriter:
         self._write(_scalar_event(wall_time or time.time(), step, name,
                                   value))
 
+    def graph(self, nodes, wall_time: Optional[float] = None) -> None:
+        """Write a GraphDef event (once, at startup — the reference's
+        ``writer.add_graph`` usage).  ``nodes``: [(name, op, inputs)]."""
+        self._write(_graph_event(wall_time or time.time(), list(nodes)))
+
+    def graph_from_params(self, params, root: str = "model") -> None:
+        """Model-structure graph from a params pytree: every leaf becomes a
+        Parameter node under its tree path; interior dicts become name
+        scopes; ``root`` gathers the top level.  Enough for TensorBoard's
+        graph tab to render the module hierarchy."""
+        import jax
+
+        nodes = []
+        tops = set()
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+            parts = [_keystr(p) for p in path]
+            name = "/".join([root] + parts)
+            shape = "x".join(str(d) for d in getattr(leaf, "shape", ()))
+            nodes.append((name, f"Parameter[{shape}]", ()))
+            tops.add(f"{root}/{parts[0]}" if parts else name)
+        nodes.append((root, "Model", sorted(tops)))
+        self.graph(nodes)
+
     def flush(self) -> None:
         self._f.flush()
 
@@ -126,6 +169,14 @@ class TBEventWriter:
         if self._f:
             self._f.close()
             self._f = None
+
+
+def _keystr(entry) -> str:
+    """One pytree path entry -> a name-scope segment."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
 
 
 # ------------------------------------------------------------- the reader --

@@ -400,6 +400,10 @@ class Trainer:
                 pass
         self.state = init_state(self.model, self.optimizer, self.cfg.seed,
                                 mesh, param_shardings=shardings)
+        # Model-structure graph to TensorBoard, once at startup — the
+        # reference's writer.add_graph (tf_distributed.py:97).
+        self.logger.graph(self.state["params"],
+                          root=type(self.model).__name__)
         # Last train-step metrics (device values; reading defers the sync
         # to the caller) — benchmark drivers report these after fit().
         self.last_metrics: dict = {}

@@ -81,6 +81,44 @@ class TestWriterReader:
                 got.append((ev.step, val.tag, val.simple_value))
         assert got == steps
 
+    def test_stock_tensorboard_parses_our_graph_event(self, tmp_path):
+        """The GraphDef event (reference: writer.add_graph at Supervisor
+        startup, tf_distributed.py:97) must decode into a real GraphDef
+        with our node names, ops, and inputs."""
+        pytest.importorskip(
+            "tensorboard.backend.event_processing.event_file_loader")
+        from tensorboard.backend.event_processing import event_file_loader
+        from tensorboard.compat.proto import graph_pb2
+
+        w = TBEventWriter(str(tmp_path))
+        w.graph([("model/layer0/w", "Parameter[4x8]", ()),
+                 ("model", "Model", ("model/layer0/w",))])
+        w.scalar(1, "cost", 0.5)
+        w.close()
+
+        graphs = []
+        for ev in event_file_loader.LegacyEventFileLoader(w.path).Load():
+            if ev.HasField("graph_def"):
+                gd = graph_pb2.GraphDef()
+                gd.ParseFromString(ev.graph_def)
+                graphs.append(gd)
+        assert len(graphs) == 1
+        by_name = {n.name: n for n in graphs[0].node}
+        assert by_name["model/layer0/w"].op == "Parameter[4x8]"
+        assert list(by_name["model"].input) == ["model/layer0/w"]
+
+    def test_graph_from_params_covers_every_leaf(self, tmp_path):
+        import numpy as np
+
+        w = TBEventWriter(str(tmp_path))
+        params = {"enc": {"w": np.zeros((2, 3)), "b": np.zeros((3,))},
+                  "head": np.zeros((3, 4))}
+        w.graph_from_params(params, root="m")
+        w.close()
+        data = open(w.path, "rb").read()
+        assert b"m/enc/w" in data and b"m/enc/b" in data
+        assert b"m/head" in data and b"Parameter[2x3]" in data
+
     def test_reader_reads_tensorboard_written_files(self, tmp_path):
         """Symmetry: our reader parses files written by the stock tb.summary
         writer (guards against a writer+reader that agree only with each
