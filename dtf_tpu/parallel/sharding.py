@@ -100,6 +100,34 @@ def data_axis_size(mesh: Mesh) -> int:
     return n
 
 
+def data_axis_tiles_processes(mesh: Mesh) -> bool:
+    """True iff process k's addressable devices hold exactly the k-th
+    contiguous 1/nproc block of linear data-axis indices — the layout
+    ``put_process_batch`` assumes.  Holds for a leading ``data`` axis;
+    fails e.g. for ``pipe=2,data=4`` over 2 processes, where every process
+    spans the whole data axis (each host must then feed the full global
+    batch)."""
+    import numpy as np
+
+    names = mesh.axis_names
+    axes = data_axes(mesh)
+    if not axes:
+        return False
+    per: dict = {}
+    for idx in np.ndindex(*mesh.devices.shape):
+        dlin = 0
+        for a in axes:
+            dlin = dlin * mesh.shape[a] + idx[names.index(a)]
+        per.setdefault(mesh.devices[idx].process_index, set()).add(dlin)
+    total = data_axis_size(mesh)
+    nproc = len(per)
+    if total % nproc:
+        return False
+    share = total // nproc
+    return all(s == set(range(k * share, (k + 1) * share))
+               for k, s in sorted(per.items()))
+
+
 def batch_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard the leading (batch) dim over every data-like axis present.
 

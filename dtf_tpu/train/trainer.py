@@ -472,7 +472,7 @@ class Trainer:
         if (cfg.shard_data and nproc > 1
                 and hasattr(splits.train, "process_shard")
                 and bs % nproc == 0
-                and sh.data_axis_size(mesh) % nproc == 0):
+                and sh.data_axis_tiles_processes(mesh)):
             train = splits.train.process_shard(jax.process_index(), nproc)
             feed_bs, put = bs // nproc, put_process_batch
 

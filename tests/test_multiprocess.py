@@ -134,6 +134,25 @@ class TestMultiProcess:
             n_local_devices=2, cwd=tmp_path)
         assert "Test-Accuracy" in outs[0]
 
+    def test_pipeline_spans_processes(self, tmp_path):
+        """A pipe=2 x data=4 mesh over 2 processes, pipe as the SLOWEST
+        axis so each process holds one full stage: the pipeline's
+        stage-to-stage ppermute hops cross the process boundary (DCN path)
+        inside the BERT train step."""
+        port = free_port()
+        outs = run_workers(
+            [[sys.executable, "-m", "dtf_tpu.workloads.bert_pretrain",
+              "--task_index", str(task),
+              "--coordinator_address", f"localhost:{port}",
+              "--num_processes", "2", "--mesh", "pipe=2,data=4",
+              "--preset", "tiny", "--steps", "4", "--batch_size", "16",
+              "--pipeline_microbatches", "2", "--log_frequency", "2",
+              "--logdir", str(tmp_path / f"logs{task}")]
+             for task in range(2)],
+            n_local_devices=4, cwd=tmp_path)
+        assert "Step-Time" in outs[0]
+        assert "done" in outs[0]
+
     def test_sequence_parallel_spans_processes(self, tmp_path):
         """A data=2 x seq=2 mesh over 2 processes: ulysses all-to-alls run
         across the process boundary inside the BERT train step."""
