@@ -54,6 +54,11 @@ class GPTConfig:
     # materialized; backward recomputes each chunk's logits from its
     # (B, C, D) hidden slice.  0 = one dense head pass.
     loss_chunk: int = 0
+    # Pipeline parallelism: a Mesh with a 'pipe' axis runs the decoder
+    # stack as layer-group stages under the GPipe schedule
+    # (parallel/pipeline.py) instead of lax.scan.
+    pipeline_mesh: Optional[Any] = None
+    pipeline_microbatches: int = 2
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -269,6 +274,29 @@ class GPT(Module):
         if self.cfg.remat:
             block_fn = remat(block_fn, self.cfg.remat_policy)
 
+        if self.cfg.pipeline_mesh is not None:
+            from dtf_tpu.parallel.pipeline import pipeline_apply
+            mesh = self.cfg.pipeline_mesh
+            s = mesh.shape["pipe"]
+            n_layers = self.cfg.num_layers
+            if n_layers % s:
+                raise ValueError(f"{n_layers} layers not divisible by "
+                                 f"pipe={s}")
+            grouped = jax.tree_util.tree_map(
+                lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
+                params["layers"])
+
+            def stage(stage_params, h, ctx):
+                def body(carry, lp):
+                    return block_fn(lp, carry), None
+                h, _ = lax.scan(body, h, stage_params)
+                return h, jnp.zeros((), jnp.float32)
+
+            x, _ = pipeline_apply(
+                stage, grouped, x, mesh,
+                num_microbatches=self.cfg.pipeline_microbatches)
+            return self.ln_f.apply(params["ln_f"], x)
+
         def body(carry, lp):
             return block_fn(lp, carry), None
 
@@ -281,8 +309,11 @@ class GPT(Module):
         return self.tok.attend(params["tok"], h).astype(jnp.float32)
 
     def axes(self):
+        # leading (stacked-layer) dim: the pipeline "stage" logical axis
+        # when pipelined, replicated for the scan path (cf. models/bert.py)
+        lead = "stage" if self.cfg.pipeline_mesh is not None else None
         layer_axes = jax.tree_util.tree_map(
-            lambda ax: (None, *ax), self.block.axes(),
+            lambda ax: (lead, *ax), self.block.axes(),
             is_leaf=lambda x: isinstance(x, tuple) and all(
                 a is None or isinstance(a, str) for a in x))
         out = {"tok": self.tok.axes(), "layers": layer_axes,

@@ -44,6 +44,9 @@ def main(argv=None) -> int:
                         help=">0: compute the CE loss in T-chunks of this "
                              "size (never materializes the (B,T,V) fp32 "
                              "logits; backward recomputes per chunk)")
+    parser.add_argument("--pipeline_microbatches", type=int, default=0,
+                        help=">0: pipeline the decoder stack over the "
+                             "'pipe' mesh axis (GPipe)")
     parser.add_argument("--attn", choices=["auto", "flash", "xla"],
                         default="auto",
                         help="inner attention: pallas flash kernel vs XLA "
@@ -77,6 +80,9 @@ def main(argv=None) -> int:
         kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:
         kw["max_len"] = ns.seq_len
+    if ns.pipeline_microbatches > 0:
+        kw["pipeline_mesh"] = cluster.mesh
+        kw["pipeline_microbatches"] = ns.pipeline_microbatches
     cfg = {"gpt2_small": GPTConfig.gpt2_small,
            "llama": GPTConfig.llama_style,
            "tiny": GPTConfig.tiny}[ns.preset](**kw)
