@@ -55,10 +55,13 @@ class GPTConfig:
     # (B, C, D) hidden slice.  0 = one dense head pass.
     loss_chunk: int = 0
     # Pipeline parallelism: a Mesh with a 'pipe' axis runs the decoder
-    # stack as layer-group stages under the GPipe schedule
-    # (parallel/pipeline.py) instead of lax.scan.
+    # stack as layer-group stages (parallel/pipeline.py) instead of
+    # lax.scan.  "gpipe": forward pipeline + AD backward; "1f1b":
+    # interleaved fwd/bwd via GPT.pipeline_loss_and_grads (O(stages)
+    # activation memory).
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
+    pipeline_schedule: str = "gpipe"
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -276,24 +279,9 @@ class GPT(Module):
 
         if self.cfg.pipeline_mesh is not None:
             from dtf_tpu.parallel.pipeline import pipeline_apply
-            mesh = self.cfg.pipeline_mesh
-            s = mesh.shape["pipe"]
-            n_layers = self.cfg.num_layers
-            if n_layers % s:
-                raise ValueError(f"{n_layers} layers not divisible by "
-                                 f"pipe={s}")
-            grouped = jax.tree_util.tree_map(
-                lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
-                params["layers"])
-
-            def stage(stage_params, h, ctx):
-                def body(carry, lp):
-                    return block_fn(lp, carry), None
-                h, _ = lax.scan(body, h, stage_params)
-                return h, jnp.zeros((), jnp.float32)
-
             x, _ = pipeline_apply(
-                stage, grouped, x, mesh,
+                self._stage_fn(), self._grouped_layers(params), x,
+                self.cfg.pipeline_mesh,
                 num_microbatches=self.cfg.pipeline_microbatches)
             return self.ln_f.apply(params["ln_f"], x)
 
@@ -321,6 +309,101 @@ class GPT(Module):
         if self.pos is not None:
             out["pos"] = {"table": (None, "embed")}
         return out
+
+    # --- 1F1B pipelined training (loss + grads in one schedule) --------
+
+    @property
+    def custom_grads_fn(self):
+        """Trainer seam for self-gradient models (cf. models/bert.py):
+        1F1B cannot be expressed as jax.grad of a forward pass."""
+        cfg = self.cfg
+        if cfg.pipeline_mesh is None or cfg.pipeline_schedule != "1f1b":
+            return None
+        return self.pipeline_loss_and_grads
+
+    def _grouped_layers(self, params):
+        """(L, ...) stacked block params -> (S, L/S, ...) pipeline stages."""
+        s = self.cfg.pipeline_mesh.shape["pipe"]
+        n_layers = self.cfg.num_layers
+        if n_layers % s:
+            raise ValueError(f"{n_layers} layers not divisible by pipe={s}")
+        return jax.tree_util.tree_map(
+            lambda p: p.reshape(s, n_layers // s, *p.shape[1:]),
+            params["layers"])
+
+    def _stage_fn(self):
+        """Pipeline stage: a block group under the schedule contract
+        ``(stage_params, h, ctx) -> (h, aux)``."""
+        block_fn = self.block.apply
+        if self.cfg.remat:
+            block_fn = remat(block_fn, self.cfg.remat_policy)
+
+        def stage(stage_params, h, ctx):
+            def body(carry, lp):
+                return block_fn(lp, carry), None
+            h, _ = lax.scan(body, h, stage_params)
+            return h, jnp.zeros((), jnp.float32)
+
+        return stage
+
+    def _head_loss_mb(self, head_params, y_mb, ctx_mb):
+        """Per-microbatch next-token CE on the pre-ln_f hidden states —
+        the ``loss_fn`` the 1F1B schedule runs inside the last stage.
+        Every position weighs equally, so the mean of per-microbatch means
+        equals the dense path's global mean."""
+        from dtf_tpu.nn.losses import smooth_token_logp
+
+        h = self.ln_f.apply(head_params["ln_f"], y_mb)[:, :-1]
+        logits = self.tok.attend(head_params["tok"], h).astype(jnp.float32)
+        targets = ctx_mb["tokens"][:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+        sl = smooth_token_logp(logp, tok_logp, self.cfg.label_smoothing)
+        return -jnp.mean(sl)
+
+    def pipeline_loss_and_grads(self, params, batch, rng=None):
+        """1F1B training pass (loss, metrics, grads) — embeddings under an
+        outer jax.vjp, decoder stages on the tick schedule, ln_f + tied
+        head inside the last stage; the token table sums gradient from
+        both its embedding and head uses."""
+        from dtf_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        cfg = self.cfg
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        emb_params = {"tok": params["tok"]}
+        if self.pos is not None:
+            emb_params["pos"] = params["pos"]
+
+        def embed(ep):
+            x = self.tok.apply(ep["tok"], tokens)
+            if self.pos is not None:
+                x = x + self.pos.apply(ep["pos"],
+                                       jnp.arange(tokens.shape[1]))
+            return x
+
+        x0, embed_vjp = jax.vjp(embed, emb_params)
+        head_params = {"ln_f": params["ln_f"], "tok": params["tok"]}
+
+        loss, sgrads, hgrads, dx0 = pipeline_train_1f1b(
+            self._stage_fn(), self._head_loss_mb,
+            self._grouped_layers(params), head_params,
+            x0, {"tokens": tokens}, cfg.pipeline_mesh,
+            num_microbatches=cfg.pipeline_microbatches)
+        (demb,) = embed_vjp(dx0.astype(x0.dtype))
+
+        layer_grads = jax.tree_util.tree_map(
+            lambda g: g.reshape(cfg.num_layers, *g.shape[2:]), sgrads)
+        grads = {"tok": jax.tree_util.tree_map(jnp.add, demb["tok"],
+                                               hgrads["tok"]),
+                 "layers": layer_grads, "ln_f": hgrads["ln_f"]}
+        if self.pos is not None:
+            grads["pos"] = demb["pos"]
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        metrics = {"accuracy": jnp.float32(float("nan")),
+                   "perplexity": jnp.float32(float("nan"))}
+        return loss, metrics, grads
 
     # --- training objective -------------------------------------------
 

@@ -79,6 +79,30 @@ class TestGPTModel:
             lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
             g_p, g_s)
 
+    def test_1f1b_grads_match_dense_path(self, tiny_params):
+        """GPT's 1F1B pipeline (pipeline_loss_and_grads) must reproduce
+        the dense jax.grad loss and gradients."""
+        from dtf_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh("data=4,pipe=2")
+        pp = GPT(GPTConfig.tiny(pipeline_mesh=mesh,
+                                pipeline_microbatches=4,
+                                pipeline_schedule="1f1b"))
+        dense = GPT(GPTConfig.tiny())
+        toks = jnp.asarray(np.random.default_rng(5).integers(
+            0, 128, (16, 16)), jnp.int32)
+        loss1, _, g1 = pp.pipeline_loss_and_grads(tiny_params,
+                                                  {"tokens": toks})
+        (loss2, _), g2 = jax.value_and_grad(
+            lambda p: dense.loss(p, toks), has_aux=True)(tiny_params)
+        np.testing.assert_allclose(loss1, loss2, rtol=1e-5)
+        flat1 = jax.tree_util.tree_leaves_with_path(g1)
+        flat2 = dict(jax.tree_util.tree_leaves_with_path(g2))
+        for path, leaf in flat1:
+            np.testing.assert_allclose(
+                leaf, flat2[path], atol=3e-5,
+                err_msg=jax.tree_util.keystr(path))
+
     def test_loss_decreases_in_training(self, tiny, mesh8):
         from dtf_tpu import optim
         from dtf_tpu.data.datasets import synthetic_text
