@@ -21,7 +21,7 @@ published T5: gelu FFN instead of relu.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +58,14 @@ class T5Config:
     relpos_max_distance: int = 128
     # Normalization: "rmsnorm" (T5's, the default) or "layernorm".
     norm: str = "rmsnorm"
+    # Pipeline parallelism (GPipe): a Mesh with a 'pipe' axis runs BOTH
+    # stacks as layer-group stages — encoder pipeline, then decoder
+    # pipeline (cross-attention context rides the per-microbatch ctx).
+    # The shared relative-position table is tiled into every stage's
+    # params and the bias recomputed per stage (it cannot ride ctx: its
+    # leading dim is 1, not B).
+    pipeline_mesh: Optional[Any] = None
+    pipeline_microbatches: int = 2
 
     @classmethod
     def small(cls, **kw):
@@ -267,8 +275,11 @@ class T5(Module):
         return out
 
     def axes(self):
+        # leading (stacked-layer) dim: the pipeline "stage" logical axis
+        # when pipelined, replicated for the scan path
+        lead = "stage" if self.cfg.pipeline_mesh is not None else None
         wrap = lambda ax_tree: jax.tree_util.tree_map(
-            lambda ax: (None, *ax), ax_tree,
+            lambda ax: (lead, *ax), ax_tree,
             is_leaf=lambda x: isinstance(x, tuple) and all(
                 a is None or isinstance(a, str) for a in x))
         out = {"tok": self.tok.axes(),
@@ -290,6 +301,33 @@ class T5(Module):
         """(B, S) -> broadcastable (B, 1, 1, S), True = attend."""
         return (src != self.cfg.pad_id)[:, None, None, :]
 
+    def _grouped_stack(self, layer_params, table):
+        """(L, ...) stacked layer params -> {"layers": (S, L/S, ...)}
+        pipeline stages, with the shared relpos ``table`` tiled per stage
+        (None under absolute positions)."""
+        sp = self.cfg.pipeline_mesh.shape["pipe"]
+        n = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+        if n % sp:
+            raise ValueError(f"{n} layers not divisible by pipe={sp}")
+        grouped = {"layers": jax.tree_util.tree_map(
+            lambda p: p.reshape(sp, n // sp, *p.shape[1:]), layer_params)}
+        if table is not None:
+            grouped["table"] = jnp.broadcast_to(table[None],
+                                                (sp, *table.shape))
+        return grouped
+
+    def _stage_bias(self, stage_params, t, bidirectional):
+        """Recompute the stack-shared relpos bias inside a pipeline stage
+        from the tiled table (ctx can't carry it: leading dim 1, not B)."""
+        if "table" not in stage_params:
+            return None
+        from dtf_tpu.nn.relpos import relpos_bias
+        pos = jnp.arange(t)
+        return relpos_bias(stage_params["table"], pos, pos,
+                           bidirectional=bidirectional,
+                           num_buckets=self.cfg.relpos_buckets,
+                           max_distance=self.cfg.relpos_max_distance)
+
     def encode(self, params, src):
         """src (B, S) int32 -> (hidden (B, S, D), attend-mask)."""
         mask = self._pad_mask(src)
@@ -305,6 +343,29 @@ class T5(Module):
         fn = self.enc_layer.apply
         if self.cfg.remat:
             fn = jax.checkpoint(fn)
+
+        if self.cfg.pipeline_mesh is not None:
+            from dtf_tpu.parallel.pipeline import pipeline_apply
+            grouped = self._grouped_stack(
+                params["enc_layers"],
+                params["relpos_enc"]["table"] if self.relative else None)
+
+            def stage(sp_params, h, c):
+                b = self._stage_bias(sp_params, h.shape[1],
+                                     bidirectional=True)
+                m4 = c["pad"][:, None, None, :]
+
+                def body(carry, lp):
+                    return fn(lp, carry, pad_mask=m4, bias=b), None
+
+                h, _ = lax.scan(body, h, sp_params["layers"])
+                return h, jnp.zeros((), jnp.float32)
+
+            x, _ = pipeline_apply(
+                stage, grouped, x, self.cfg.pipeline_mesh,
+                num_microbatches=self.cfg.pipeline_microbatches,
+                ctx={"pad": src != self.cfg.pad_id})
+            return self.ln_enc.apply(params["ln_enc"], x), mask
 
         def body(carry, lp):
             return fn(lp, carry, pad_mask=mask, bias=bias), None
@@ -326,6 +387,31 @@ class T5(Module):
         fn = self.dec_layer.apply
         if self.cfg.remat:
             fn = jax.checkpoint(fn)
+
+        if self.cfg.pipeline_mesh is not None:
+            from dtf_tpu.parallel.pipeline import pipeline_apply
+            grouped = self._grouped_stack(
+                params["dec_layers"],
+                params["relpos_dec"]["table"] if self.relative else None)
+
+            def stage(sp_params, h, c):
+                b = self._stage_bias(sp_params, h.shape[1],
+                                     bidirectional=False)
+                m4 = c["ctx_valid"][:, None, None, :]
+
+                def body(carry, lp):
+                    return fn(lp, carry, c["ctx"], ctx_mask=m4,
+                              self_bias=b), None
+
+                h, _ = lax.scan(body, h, sp_params["layers"])
+                return h, jnp.zeros((), jnp.float32)
+
+            x, _ = pipeline_apply(
+                stage, grouped, x, self.cfg.pipeline_mesh,
+                num_microbatches=self.cfg.pipeline_microbatches,
+                ctx={"ctx": ctx, "ctx_valid": ctx_mask[:, 0, 0, :]})
+            x = self.ln_dec.apply(params["ln_dec"], x)
+            return self.tok.attend(params["tok"], x).astype(jnp.float32)
 
         def body(carry, lp):
             return fn(lp, carry, ctx, ctx_mask=ctx_mask, self_bias=bias), None

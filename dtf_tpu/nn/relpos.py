@@ -59,6 +59,19 @@ def relative_position_bucket(rel, *, bidirectional: bool = True,
     return offset + jnp.where(is_small, dist, log_bucket)
 
 
+def relpos_bias(table, q_positions, k_positions, *, bidirectional: bool,
+                num_buckets: int = 32, max_distance: int = 128):
+    """Pure-function form: (buckets, H) table -> (1, H, Tq, Tk) fp32 bias.
+    Used directly by pipelined stacks, where the shared table is tiled
+    into stage params and the bias recomputed per stage."""
+    rel = k_positions[None, :] - q_positions[:, None]
+    bucket = relative_position_bucket(
+        rel, bidirectional=bidirectional, num_buckets=num_buckets,
+        max_distance=max_distance)
+    bias = table[bucket]                             # (Tq, Tk, H)
+    return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+
+
 @dataclasses.dataclass
 class RelativePositionBias(Module):
     """Learned (num_buckets, num_heads) table -> (1, H, Tq, Tk) fp32 bias.
@@ -82,12 +95,10 @@ class RelativePositionBias(Module):
     def apply(self, params, q_positions, k_positions, *, train=False,
               rng=None):
         """q_positions (Tq,), k_positions (Tk,) int32 -> (1, H, Tq, Tk)."""
-        rel = k_positions[None, :] - q_positions[:, None]
-        bucket = relative_position_bucket(
-            rel, bidirectional=self.bidirectional,
-            num_buckets=self.num_buckets, max_distance=self.max_distance)
-        bias = params["table"][bucket]               # (Tq, Tk, H)
-        return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+        return relpos_bias(params["table"], q_positions, k_positions,
+                           bidirectional=self.bidirectional,
+                           num_buckets=self.num_buckets,
+                           max_distance=self.max_distance)
 
     def axes(self):
         return {"table": (None, "heads")}

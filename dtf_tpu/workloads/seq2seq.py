@@ -36,6 +36,9 @@ def main(argv=None) -> int:
                         help="held-out sources to decode for exact-match")
     parser.add_argument("--label_smoothing", type=float, default=0.0,
                         help="eps of uniform mass in the CE loss")
+    parser.add_argument("--pipeline_microbatches", type=int, default=0,
+                        help=">0: pipeline both stacks over the 'pipe' "
+                             "mesh axis (GPipe)")
     parser.set_defaults(learning_rate=3e-3)   # task-suited default
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
@@ -50,6 +53,9 @@ def main(argv=None) -> int:
     kw = dict(dtype=dtype, max_src_len=max(ns.seq_len, 16),
               max_tgt_len=max(ns.seq_len, 16),
               label_smoothing=ns.label_smoothing)
+    if ns.pipeline_microbatches > 0:
+        kw["pipeline_mesh"] = mesh
+        kw["pipeline_microbatches"] = ns.pipeline_microbatches
     cfg = (T5Config.small(**kw) if ns.preset == "small"
            else T5Config.tiny(**kw))
     model = T5(cfg)
