@@ -15,6 +15,9 @@ TPU mapping (pallas_guide.md patterns):
   statistics are kept in fp32 even for bf16 inputs;
 * causal masking skips fully-masked k blocks via ``@pl.when`` (no wasted
   MXU work past the diagonal) and masks within the diagonal block;
+* per-key padding masks (``kv_mask``) enter as a sublane-replicated
+  (B, 8, T) additive fp32 bias with a finite mask value — see MASK_VALUE —
+  so BERT-style variable-length batches run on the kernel, not a fallback;
 * backward = two kernels (dq; dk+dv fused) using the saved logsumexp — the
   standard flash-attention backward, not recompute-the-naive-path.
 
