@@ -487,7 +487,9 @@ class GPT(Module):
     def _cache_len(self, total: int) -> int:
         """Lane-aligned live cache length for a prompt+new total: decode
         HBM traffic scales with the cache, so both decode entry points size
-        it to the generation actually requested, not max_len."""
+        it to the generation actually requested, not max_len.  128 beats
+        finer alignments in measurement (64-multiples gave XLA worse
+        layouts: ~900 vs ~960 tok/s single-stream)."""
         return min(-(-total // 128) * 128, self.cfg.max_len)
 
     def init_cache(self, batch: int, length: int | None = None):

@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     parser.add_argument("--generate", type=int, default=0, metavar="N",
                         help="after training, generate N tokens from a "
                              "held-out prompt (KV-cache decode)")
+    parser.add_argument("--gen_batch", type=int, default=1,
+                        help="decode this many streams at once (the "
+                             "serving-throughput axis: weights stream "
+                             "once per step regardless of batch)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top_k", type=int, default=0,
@@ -100,7 +104,7 @@ def main(argv=None) -> int:
     if ns.generate > 0:
         import jax
 
-        prompt = jnp.asarray(toks[:1, :8])
+        prompt = jnp.asarray(toks[:ns.gen_batch, :8])
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size)[0][:, 0])
@@ -117,7 +121,10 @@ def main(argv=None) -> int:
         block(out)
         dt = time.perf_counter() - t0
         logger.print(f"Generated: {np.asarray(out[0]).tolist()}")
-        logger.print(f"Decode: {ns.generate / dt:.1f} tok/s steady-state "
+        agg = ns.generate * prompt.shape[0] / dt
+        per = (f" ({agg / prompt.shape[0]:.1f}/stream x "
+               f"{prompt.shape[0]} streams)" if prompt.shape[0] > 1 else "")
+        logger.print(f"Decode: {agg:.1f} tok/s steady-state{per} "
                      f"(first call incl. compile: {compile_s:.1f}s)")
     if cluster.is_coordinator:
         print("done")
