@@ -34,8 +34,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_BIG = -1e30   # finite "-inf": keeps exp() NaN-free for all-masked rows
 
 
-def _ring_body(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
-    """Per-device ring attention.  q,k,v: (B, t_loc, H, D) local chunks."""
+def _ring_body(q, k, v, *rest, axis: str, n: int, causal: bool,
+               scale: float, has_mask: bool):
+    """Per-device ring attention.  q,k,v: (B, t_loc, H, D) local chunks;
+    with ``has_mask`` a (B, t_loc) key-validity chunk rotates around the
+    ring alongside its K/V chunk (a padded key must stay masked no matter
+    which device currently holds it)."""
+    mask = rest[0] if has_mask else None
     b, t_loc, h, d = q.shape
     me = lax.axis_index(axis)
     qf = q.astype(jnp.float32)
@@ -43,7 +48,7 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
     q_pos = me * t_loc + lax.broadcasted_iota(jnp.int32, (t_loc, t_loc), 0)
 
     def step(carry, s):
-        acc, m, l, kc, vc = carry
+        acc, m, l, kc, vc, mc = carry
         src = (me - s) % n                     # whose chunk we hold now
         sblk = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
                           preferred_element_type=jnp.float32) * scale
@@ -51,6 +56,8 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
             k_pos = src * t_loc + lax.broadcasted_iota(
                 jnp.int32, (t_loc, t_loc), 1)
             sblk = jnp.where((q_pos >= k_pos)[None, None], sblk, NEG_BIG)
+        if mc is not None:
+            sblk = jnp.where(mc[:, None, None, :], sblk, NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(sblk, axis=-1))          # (B,H,Tq)
         p = jnp.exp(sblk - m_new[..., None])                    # (B,H,Tq,Tk)
         corr = jnp.exp(m - m_new)
@@ -61,25 +68,29 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool, scale: float):
         perm = [(i, (i + 1) % n) for i in range(n)]
         kc = lax.ppermute(kc, axis, perm)
         vc = lax.ppermute(vc, axis, perm)
-        return (acc_new, m_new, l_new, kc, vc), None
+        if mc is not None:
+            mc = lax.ppermute(mc, axis, perm)
+        return (acc_new, m_new, l_new, kc, vc, mc), None
 
     acc0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
     m0 = jnp.full((b, h, t_loc), NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, t_loc), jnp.float32)
-    (acc, _, l, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v),
-                                    jnp.arange(n))
+    (acc, _, l, _, _, _), _ = lax.scan(step, (acc0, m0, l0, k, v, mask),
+                                       jnp.arange(n))
     out = acc / jnp.maximum(l, 1e-30)[..., None]                # (B,H,Tq,D)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)            # (B,Tq,H,D)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
-                   batch_axes: Optional[tuple] = None):
+                   batch_axes: Optional[tuple] = None, kv_mask=None):
     """Exact sequence-parallel attention.
 
     q, k, v: (B, T, H, D) *global* arrays whose T dim is (to be) sharded
     over ``axis``; returns (B, T, H, D) sharded the same way.  Call inside
     or outside jit — shard_map composes with the surrounding program.
+    ``kv_mask`` (B, T) bool, True = key visible (padding masks); its
+    chunks rotate with the K/V chunks.  Rows must keep >=1 visible key.
     """
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
@@ -92,20 +103,38 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
         from dtf_tpu.parallel.sharding import data_axes as _data_axes
         batch_axes = _data_axes(mesh)
     spec = P(batch_axes or None, axis, None, None)
+    has_mask = kv_mask is not None
     body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
-                             scale=scale)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             scale=scale, has_mask=has_mask)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(P(batch_axes or None, axis))
+        args.append(kv_mask)
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=spec, check_vma=False)
-    return mapped(q, k, v)
+    return mapped(*args)
 
 
 def ring_attention_impl(mesh: Mesh, axis: str = "seq", causal: bool = False):
-    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D), mask=None)."""
+    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D) layout).
+
+    mask=None and key-padding masks ((B|1, 1, 1, Tk) — BERT's
+    ``pad_mask[:, None, None, :]``) are supported; the validity chunks
+    rotate around the ring with their K/V.  General per-query masks are
+    rejected (they cannot ride the ring as per-key state)."""
 
     def impl(q, k, v, mask=None):
+        kv_mask = None
         if mask is not None:
-            raise ValueError("ring_attention_impl supports mask=None only; "
-                             "use causal=True or the XLA attention path")
-        return ring_attention(q, k, v, mesh, axis=axis, causal=causal)
+            from dtf_tpu.ops.flash_attention import _as_kv_mask
+            kv_mask = _as_kv_mask(mask, q.shape[0], q.shape[1], k.shape[1])
+            if kv_mask is None:
+                raise ValueError(
+                    "ring_attention_impl supports mask=None or key-padding "
+                    "masks of shape (B|1, 1, 1, Tk); per-query masks "
+                    "cannot ride the K/V ring")
+        return ring_attention(q, k, v, mesh, axis=axis, causal=causal,
+                              kv_mask=kv_mask)
 
     return impl

@@ -39,9 +39,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dtf_tpu.nn.attention import causal_mask, dot_product_attention
 
 
-def _ulysses_body(q, k, v, *, axis: str, causal: bool, scale: Optional[float],
-                  inner: Optional[Callable]):
-    """Per-device ulysses attention.  q,k,v: (B, T/n, H, D) local chunks."""
+def _ulysses_body(q, k, v, *rest, axis: str, causal: bool,
+                  scale: Optional[float], inner: Optional[Callable],
+                  has_mask: bool):
+    """Per-device ulysses attention.  q,k,v: (B, T/n, H, D) local chunks;
+    with ``has_mask`` a (B, T/n) key-validity chunk is all-gathered to the
+    full (B, T) mask every local attention needs (tiny next to the K/V
+    all-to-alls)."""
+    kv_mask = rest[0] if has_mask else None
     # heads -> sequence: (B, T/n, H, D) -> (B, T, H/n, D).  tiled=True splits
     # the head dim into n blocks and concatenates the gathered chunks along
     # the sequence dim, so afterwards the device holds the whole sequence
@@ -49,11 +54,17 @@ def _ulysses_body(q, k, v, *, axis: str, causal: bool, scale: Optional[float],
     a2a_in = lambda x: lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
                                       tiled=True)
     qh, kh, vh = a2a_in(q), a2a_in(k), a2a_in(v)
+    mask4 = None
+    if kv_mask is not None:
+        full = lax.all_gather(kv_mask, axis, axis=1, tiled=True)  # (B, T)
+        mask4 = full[:, None, None, :]
 
     if inner is not None:
-        out = inner(qh, kh, vh, None)
+        out = inner(qh, kh, vh, mask4)
     else:
         mask = causal_mask(qh.shape[1]) if causal else None
+        if mask4 is not None:
+            mask = mask4 if mask is None else (mask & mask4)
         out = dot_product_attention(qh, kh, vh, mask=mask, scale=scale)
 
     # sequence -> heads: (B, T, H/n, D) -> (B, T/n, H, D).
@@ -63,7 +74,7 @@ def _ulysses_body(q, k, v, *, axis: str, causal: bool, scale: Optional[float],
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
                       causal: bool = False, scale: Optional[float] = None,
                       batch_axes: Optional[tuple] = None,
-                      inner: Optional[Callable] = None):
+                      inner: Optional[Callable] = None, kv_mask=None):
     """All-to-all sequence-parallel attention.
 
     q, k, v: (B, T, H, D) *global* arrays whose T dim is (to be) sharded
@@ -71,7 +82,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
     optionally supplies the local attention ``f(q, k, v, mask) -> out``
     run on the post-all-to-all (B, T, H/n, D) arrays — e.g.
     ``flash_attention_impl(causal=True)`` to fuse with the Pallas kernel;
-    when given, it is responsible for causal masking itself.
+    when given, it is responsible for causal masking itself.  ``kv_mask``
+    (B, T) bool, True = key visible (padding masks); passed through to
+    the local attention as a per-key mask.
     """
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
@@ -92,24 +105,39 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
         from dtf_tpu.parallel.sharding import data_axes as _data_axes
         batch_axes = _data_axes(mesh)
     spec = P(batch_axes or None, axis, None, None)
+    has_mask = kv_mask is not None
     body = functools.partial(_ulysses_body, axis=axis, causal=causal,
-                             scale=scale, inner=inner)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             scale=scale, inner=inner, has_mask=has_mask)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(P(batch_axes or None, axis))
+        args.append(kv_mask)
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=spec, check_vma=False)
-    return mapped(q, k, v)
+    return mapped(*args)
 
 
 def ulysses_attention_impl(mesh: Mesh, axis: str = "seq",
                            causal: bool = False,
                            inner: Optional[Callable] = None):
-    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D), mask=None)."""
+    """MultiHeadAttention ``attn_impl`` adapter ((B,T,H,D) layout).
+
+    mask=None and key-padding masks ((B|1, 1, 1, Tk)) are supported — the
+    validity chunks all-gather to the full per-key mask, which the flash
+    inner kernel consumes directly.  General per-query masks are rejected.
+    """
 
     def impl(q, k, v, mask=None):
+        kv_mask = None
         if mask is not None:
-            raise ValueError("ulysses_attention_impl supports mask=None "
-                             "only; use causal=True or the XLA attention "
-                             "path")
+            from dtf_tpu.ops.flash_attention import _as_kv_mask
+            kv_mask = _as_kv_mask(mask, q.shape[0], q.shape[1], k.shape[1])
+            if kv_mask is None:
+                raise ValueError(
+                    "ulysses_attention_impl supports mask=None or "
+                    "key-padding masks of shape (B|1, 1, 1, Tk)")
         return ulysses_attention(q, k, v, mesh, axis=axis, causal=causal,
-                                 inner=inner)
+                                 inner=inner, kv_mask=kv_mask)
 
     return impl
