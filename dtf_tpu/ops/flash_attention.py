@@ -398,6 +398,21 @@ def _as_kv_mask(mask, b, tq, tk):
     return jnp.broadcast_to(mask[:, 0, 0, :], (b, tk))
 
 
+def require_kv_mask(mask, q, k, impl_name: str):
+    """Shared adapter guard: convert an attn_impl ``mask`` to the (B, Tk)
+    key-padding form or raise — so every distributed attention impl
+    (ring/ulysses) accepts exactly the same mask shapes with the same
+    wording.  (flash_attention_impl instead falls back to the XLA path for
+    general masks, since it has a local dense equivalent to fall back TO.)
+    """
+    kv_mask = _as_kv_mask(mask, q.shape[0], q.shape[1], k.shape[1])
+    if kv_mask is None:
+        raise ValueError(
+            f"{impl_name} supports mask=None or key-padding masks of "
+            f"shape (B|1, 1, 1, Tk); per-query masks are not supported")
+    return kv_mask
+
+
 def flash_attention_impl(causal: bool = False, block_q: int = 512,
                          block_k: int = 512):
     """Adapter matching MultiHeadAttention's ``attn_impl`` contract:

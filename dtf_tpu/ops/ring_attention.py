@@ -127,13 +127,8 @@ def ring_attention_impl(mesh: Mesh, axis: str = "seq", causal: bool = False):
     def impl(q, k, v, mask=None):
         kv_mask = None
         if mask is not None:
-            from dtf_tpu.ops.flash_attention import _as_kv_mask
-            kv_mask = _as_kv_mask(mask, q.shape[0], q.shape[1], k.shape[1])
-            if kv_mask is None:
-                raise ValueError(
-                    "ring_attention_impl supports mask=None or key-padding "
-                    "masks of shape (B|1, 1, 1, Tk); per-query masks "
-                    "cannot ride the K/V ring")
+            from dtf_tpu.ops.flash_attention import require_kv_mask
+            kv_mask = require_kv_mask(mask, q, k, "ring_attention_impl")
         return ring_attention(q, k, v, mesh, axis=axis, causal=causal,
                               kv_mask=kv_mask)
 

@@ -76,6 +76,8 @@ class TestUlyssesAttention:
         out = impl(q, k, v, valid[:, None, None, :])
         ref = dot_product_attention(q, k, v, valid[:, None, None, :])
         np.testing.assert_allclose(out, ref, atol=2e-5)
+        with pytest.raises(ValueError, match="per-query"):
+            impl(q, k, v, jnp.ones((2, 1, 32, 32), bool))
 
     def test_under_jit_stays_seq_sharded(self, seq_mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
