@@ -30,6 +30,26 @@ from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
 NEG_BIG = -1e30
 
 
+def _quantize_cols(w):
+    """Symmetric per-output-channel (last dim) int8 weight quantization:
+    (..., K, N) -> (int8 same shape, fp32 scale (..., 1, N))."""
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                    keepdims=True) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / safe), -127,
+                 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant_matmul(x, w8, scale, dtype):
+    """y = (x @ dequant(w8)): the int8 operand streams from HBM at half
+    the bf16 bytes and widens in-register (int8 values are exact in
+    bf16); the per-channel scale folds into the fp32 output."""
+    y = jnp.einsum("btd,dp->btp", x, w8.astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    return (y * scale).astype(dtype)
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50257
@@ -180,16 +200,24 @@ class GPTBlock(Module):
         traffic).  Decode is HBM-bound: the caller bounds T_cache to the
         actual generation length (init_cache ``length=``), not max_len.
 
-        ``packed`` ({"w": (D, (H+2KVH)·Dh), "b"}): the q/k/v projections
-        pre-concatenated into ONE matmul (GPT._packed_qkv) — decode at
-        B~1 is op-latency-bound, so fewer, wider matmuls win.
+        ``packed``: this layer's slice of GPT._decode_pack's container —
+        {"qkv": {"w", "b"[, "scale"]}} at minimum (the q/k/v projections
+        pre-concatenated into ONE matmul; decode at B~1 is
+        op-latency-bound, so fewer, wider matmuls win), plus optional
+        int8-quantized "o"/"fc1"/"fc_gate"/"fc2" entries ({"w" int8,
+        "scale"}) that halve the per-token HBM weight traffic.
         """
         p = params["attn"]
         h = self.ln1.apply(params["ln1"], x_t)
         if packed is not None:
             hd = self.cfg.dim // self.cfg.num_heads
             nh, kvh = self.cfg.num_heads, self.attn.kv_heads
-            qkv = jnp.einsum("btd,dp->btp", h, packed["w"]) + packed["b"]
+            pq = packed["qkv"]
+            if "scale" in pq:
+                qkv = _dequant_matmul(h, pq["w"], pq["scale"],
+                                      h.dtype) + pq["b"]
+            else:
+                qkv = jnp.einsum("btd,dp->btp", h, pq["w"]) + pq["b"]
             bsz = x_t.shape[0]
             q = qkv[..., :nh * hd].reshape(bsz, 1, nh, hd)
             k_t = qkv[..., nh * hd:(nh + kvh) * hd].reshape(bsz, 1, kvh, hd)
@@ -224,8 +252,33 @@ class GPTBlock(Module):
         out = jnp.einsum("bkgt,btkd->bkgd", w.astype(cache_v.dtype), cache_v,
                          preferred_element_type=jnp.float32)
         out = out.reshape(b, 1, h_all, hd).astype(x_t.dtype)
-        x_t = x_t + self.attn.out_proj(p, out)
+        if packed is not None and "o" in packed:
+            flat = out.reshape(b, 1, h_all * hd)
+            x_t = x_t + _dequant_matmul(flat, packed["o"]["w"],
+                                        packed["o"]["scale"],
+                                        x_t.dtype) + p["o"]["b"]
+        else:
+            x_t = x_t + self.attn.out_proj(p, out)
+        if packed is not None and "fc1" in packed:
+            return (self._mlp_residual_q(params, x_t, packed),
+                    {"k": cache_k, "v": cache_v})
         return self._mlp_residual(params, x_t), {"k": cache_k, "v": cache_v}
+
+    def _mlp_residual_q(self, params, x, packed):
+        """x + MLP(ln2(x)) on int8-quantized decode weights."""
+        h = self.ln2.apply(params["ln2"], x)
+        u = _dequant_matmul(h, packed["fc1"]["w"], packed["fc1"]["scale"],
+                            h.dtype) + params["fc1"]["b"]
+        if self.fc_gate is not None:
+            g = _dequant_matmul(h, packed["fc_gate"]["w"],
+                                packed["fc_gate"]["scale"],
+                                h.dtype) + params["fc_gate"]["b"]
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        y = _dequant_matmul(u, packed["fc2"]["w"], packed["fc2"]["scale"],
+                            x.dtype) + params["fc2"]["b"]
+        return x + y
 
     def axes(self):
         out = {"ln1": self.ln1.axes(), "ln2": self.ln2.axes(),
@@ -528,16 +581,22 @@ class GPT(Module):
         x = self.ln_f.apply(params["ln_f"], x)
         return cache, self.tok.attend(params["tok"], x)[:, p_len - 1, :]
 
-    def _packed_qkv(self, params):
+    def _packed_qkv(self, params, int8: bool = False):
         """Concatenate every layer's q/k/v projection weights into one
         (L, D, (H+2KVH)·Dh) matmul operand for the decode hot loop (see
         GPTBlock.decode_step).  Computed once per generate call, outside
-        the decode scan."""
+        the decode scan.
+
+        ``int8``: symmetric per-output-channel weight quantization —
+        decode streams every weight from HBM each token, so int8 halves
+        the dominant traffic; the matmul runs on dequantized tiles
+        (y = (x @ w8) * scale), exact up to the ~0.4% per-channel
+        rounding."""
         attn = params["layers"]["attn"]
         n_layers, d = self.cfg.num_layers, self.cfg.dim
         flat_w = lambda t: t["w"].reshape(n_layers, d, -1)
         flat_b = lambda t: t["b"].reshape(n_layers, -1)
-        return {
+        out = {
             "w": jnp.concatenate(
                 [flat_w(attn["q"]), flat_w(attn["k"]), flat_w(attn["v"])],
                 axis=-1),
@@ -545,6 +604,31 @@ class GPT(Module):
                 [flat_b(attn["q"]), flat_b(attn["k"]), flat_b(attn["v"])],
                 axis=-1),
         }
+        if int8:
+            out["w"], out["scale"] = _quantize_cols(out["w"])
+        return out
+
+    def _decode_pack(self, params, int8: bool = False):
+        """The decode loop's weight container: packed q/k/v always; with
+        ``int8`` every decode matmul operand (qkv, out proj, MLP, tied
+        head) is int8-quantized per output channel — decode streams all
+        weights from HBM each token, so this halves the dominant traffic
+        for ~0.4%-per-channel rounding error."""
+        cfg = self.cfg
+        layers = {"qkv": self._packed_qkv(params, int8=int8)}
+        head = None
+        if int8:
+            lay = params["layers"]
+            n_layers, d = cfg.num_layers, cfg.dim
+            ow = lay["attn"]["o"]["w"].reshape(n_layers, -1, d)
+            q8 = lambda w: dict(zip(("w", "scale"), _quantize_cols(w)))
+            layers["o"] = q8(ow)
+            layers["fc1"] = q8(lay["fc1"]["w"])
+            layers["fc2"] = q8(lay["fc2"]["w"])
+            if self.block.fc_gate is not None:
+                layers["fc_gate"] = q8(lay["fc_gate"]["w"])
+            head = q8(params["tok"]["table"].T)      # (D, V) per-vocab
+        return {"layers": layers, "head": head}
 
     def _decode_logits(self, params, cache, tok, pos, packed=None):
         """One decode step: token (B', 1) at position ``pos`` through the
@@ -557,7 +641,7 @@ class GPT(Module):
         x = self._embed(params, tok, pos[None])
         xs = (params["layers"], cache["k"], cache["v"])
         if packed is not None:
-            xs = xs + (packed,)
+            xs = xs + (packed["layers"],)
         # the attention visibility bias depends only on pos: one compute
         # for all layers instead of one per layer
         t_cache = cache["k"].shape[2]
@@ -575,13 +659,18 @@ class GPT(Module):
 
         x, (new_k, new_v) = lax.scan(layer_scan, x, xs, unroll=True)
         x = self.ln_f.apply(params["ln_f"], x)
-        logits = self.tok.attend(params["tok"], x)[:, 0, :]
+        if packed is not None and packed.get("head") is not None:
+            hq = packed["head"]
+            logits = _dequant_matmul(x, hq["w"], hq["scale"],
+                                     jnp.float32)[:, 0, :]
+        else:
+            logits = self.tok.attend(params["tok"], x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v}
 
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
-                 rng=None):
+                 rng=None, int8_weights: bool = False):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         Two phases, one compiled program:
@@ -625,7 +714,7 @@ class GPT(Module):
         out = out.at[:, p_len].set(first)
         done = (first == eos_id) if eos_id is not None else None
 
-        packed = self._packed_qkv(params)
+        packed = self._decode_pack(params, int8=int8_weights)
 
         # ---- decode: scan positions p_len..total-2, each reading the token
         # it just wrote and emitting the next one.
@@ -649,7 +738,8 @@ class GPT(Module):
 
     def beam_search(self, params, prompt, max_new_tokens: int, *,
                     beam_size: int = 4, eos_id: Optional[int] = None,
-                    length_penalty: float = 0.0):
+                    length_penalty: float = 0.0,
+                    int8_weights: bool = False):
         """Deterministic beam decoding.  prompt (B, P) int32 ->
         (sequences (B, W, P+max_new), scores (B, W)), beams sorted best
         first.
@@ -698,7 +788,7 @@ class GPT(Module):
             idx = beam_idx.reshape(1, b, w, *([1] * (cv.ndim - 3)))
             return jnp.take_along_axis(cv, idx, axis=2).reshape(c.shape)
 
-        packed = self._packed_qkv(params)
+        packed = self._decode_pack(params, int8=int8_weights)
 
         def step(carry, pos):
             out, cache, scores, alive = carry

@@ -58,6 +58,10 @@ def main(argv=None) -> int:
                         help="decode this many streams at once (the "
                              "serving-throughput axis: weights stream "
                              "once per step regardless of batch)")
+    parser.add_argument("--decode_int8", action="store_true",
+                        help="int8-quantize the decode weights (per "
+                             "output channel): half the HBM weight "
+                             "traffic per token")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top_k", type=int, default=0,
@@ -107,11 +111,13 @@ def main(argv=None) -> int:
         prompt = jnp.asarray(toks[:ns.gen_batch, :8])
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
-                p, pr, ns.generate, beam_size=ns.beam_size)[0][:, 0])
+                p, pr, ns.generate, beam_size=ns.beam_size,
+                int8_weights=ns.decode_int8)[0][:, 0])
         else:
             gen = jax.jit(lambda p, pr, key: model.generate(
                 p, pr, ns.generate, temperature=ns.temperature,
-                top_k=ns.top_k, top_p=ns.top_p, rng=key))
+                top_k=ns.top_k, top_p=ns.top_p, rng=key,
+                int8_weights=ns.decode_int8))
         t0 = time.perf_counter()
         out = gen(state["params"], prompt, jax.random.key(0))
         block(out)

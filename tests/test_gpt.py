@@ -79,6 +79,52 @@ class TestGPTModel:
             lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
             g_p, g_s)
 
+    def test_int8_decode_weights_close_to_fp(self, tiny, tiny_params):
+        """Per-channel int8 decode weights: quantization error bounded and
+        the greedy generation stays token-identical to fp on a tiny model
+        (a well-separated argmax survives ~0.4%-per-channel rounding)."""
+        from dtf_tpu.models.gpt import _quantize_cols
+
+        w = tiny_params["layers"]["fc1"]["w"]
+        q, scale = _quantize_cols(w)
+        deq = q.astype(jnp.float32) * scale
+        err = jnp.max(jnp.abs(deq - w)) / jnp.max(jnp.abs(w))
+        assert float(err) < 0.005, float(err)
+
+        prompt = jnp.asarray(np.random.default_rng(6).integers(
+            0, 128, (2, 8)), jnp.int32)
+        fp = tiny.generate(tiny_params, prompt, 16, temperature=0.0)
+        q8 = tiny.generate(tiny_params, prompt, 16, temperature=0.0,
+                           int8_weights=True)
+        agree = float(jnp.mean((fp == q8).astype(jnp.float32)))
+        assert agree > 0.9, agree      # rare argmax ties may flip
+
+    def test_int8_decode_llama_options(self):
+        """The int8 path through the SwiGLU gate, GQA o-proj reshape, and
+        RoPE: per-step decode logits nearly identical to fp.  (Trajectory
+        agreement is the wrong metric at random init — near-tied argmax
+        flips once and the continuation diverges chaotically.)"""
+        model = GPT(GPTConfig.tiny(rope=True, num_kv_heads=2,
+                                   mlp_act="swiglu"))
+        p = model.init(jax.random.key(9))
+        prompt = jnp.asarray(np.random.default_rng(10).integers(
+            0, 128, (2, 8)), jnp.int32)
+        cache, _ = model._prefill_cache(p, prompt, model._cache_len(32))
+        tok = prompt[:, -1:]
+        pos = jnp.int32(8)
+        lf, _ = model._decode_logits(p, cache, tok, pos,
+                                     model._decode_pack(p))
+        lq, _ = model._decode_logits(p, cache, tok, pos,
+                                     model._decode_pack(p, int8=True))
+        cos = (jnp.sum(lf * lq, -1)
+               / (jnp.linalg.norm(lf, axis=-1)
+                  * jnp.linalg.norm(lq, axis=-1)))
+        assert float(cos.min()) > 0.999, np.asarray(cos)
+        # beam search takes the same container end to end
+        _, scores = model.beam_search(p, prompt, 8, beam_size=2,
+                                      int8_weights=True)
+        assert bool(jnp.all(jnp.isfinite(scores)))
+
     def test_1f1b_grads_match_dense_path(self, tiny_params):
         """GPT's 1F1B pipeline (pipeline_loss_and_grads) must reproduce
         the dense jax.grad loss and gradients."""
