@@ -53,6 +53,11 @@ class _ShuffledSplit:
     def _advance(self, batch_size: int) -> np.ndarray:
         """Shuffled row indices for the next batch; reshuffles at epoch end
         (mnist.train.next_batch semantics, tf_distributed.py:108)."""
+        if batch_size > self.num_examples:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the split's "
+                f"{self.num_examples} examples; shrink the (global) batch "
+                f"or provide more data")
         if self._pos + batch_size > self.num_examples:
             self._rng.shuffle(self._order)
             self._pos = 0
@@ -69,6 +74,11 @@ class _ShuffledSplit:
         """Advance the shuffle cursor as if ``next_batch`` had been called
         ``n_batches`` times, without materializing any batch (checkpoint
         resume: replays only the per-epoch reshuffles + position)."""
+        if n_batches and batch_size > self.num_examples:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the split's "
+                f"{self.num_examples} examples; shrink the (global) batch "
+                f"or provide more data")
         for _ in range(n_batches):
             if self._pos + batch_size > self.num_examples:
                 self._rng.shuffle(self._order)
@@ -191,7 +201,10 @@ class ProcessShard:
         self.batches_consumed += n_batches
 
     def examples(self, lo: int, hi: int):
-        return self.base.examples(lo, hi)
+        raise NotImplementedError(
+            "ProcessShard is a train-only per-host view; eval should read "
+            "sequential rows from the unwrapped split (splits.test) so each "
+            "host sees its own disjoint share, not the global rows")
 
 
 @dataclasses.dataclass

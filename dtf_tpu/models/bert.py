@@ -305,12 +305,16 @@ class BertMLM(Module):
 
     # --- masked-LM objective -------------------------------------------
 
-    def mask_tokens(self, rng, tokens):
+    def mask_tokens(self, rng, tokens, pad_mask=None):
         """BERT dynamic masking, static shapes: select ~15% positions; of
-        those 80% -> [MASK], 10% -> random token, 10% -> unchanged."""
+        those 80% -> [MASK], 10% -> random token, 10% -> unchanged.
+        ``pad_mask`` (B, T) bool True=real: padded positions are never
+        selected for prediction."""
         cfg = self.cfg
         r_sel, r_kind, r_rand = jax.random.split(rng, 3)
         selected = jax.random.uniform(r_sel, tokens.shape) < cfg.mask_rate
+        if pad_mask is not None:
+            selected = selected & pad_mask
         kind = jax.random.uniform(r_kind, tokens.shape)
         random_toks = jax.random.randint(r_rand, tokens.shape, 0, cfg.vocab_size)
         masked = jnp.where(kind < 0.8, cfg.mask_token,
@@ -318,15 +322,19 @@ class BertMLM(Module):
         inputs = jnp.where(selected, masked, tokens)
         return inputs, selected
 
-    def mask_tokens_fixed(self, rng, tokens):
+    def mask_tokens_fixed(self, rng, tokens, pad_mask=None):
         """Fixed-K masking: select exactly cfg.mlm_predictions positions
         per sequence (top-K of per-position uniform scores — distinct by
         construction), 80/10/10 mask/random/keep.  Returns (inputs,
-        idx (B, K), targets (B, K))."""
+        idx (B, K), targets (B, K)).  ``pad_mask`` (B, T) bool True=real:
+        padded positions score -1 so they are never selected (requires at
+        least K real positions per row)."""
         cfg = self.cfg
         k = cfg.mlm_predictions
         r_sel, r_kind, r_rand = jax.random.split(rng, 3)
         scores = jax.random.uniform(r_sel, tokens.shape)
+        if pad_mask is not None:
+            scores = jnp.where(pad_mask, scores, -1.0)
         _, idx = jax.lax.top_k(scores, k)                    # (B, K)
         targets = jnp.take_along_axis(tokens, idx, axis=1)
         kind = jax.random.uniform(r_kind, idx.shape)
@@ -338,11 +346,11 @@ class BertMLM(Module):
             masked)
         return inputs, idx, targets
 
-    def _loss_fixed_k(self, params, tokens, rng, train):
+    def _loss_fixed_k(self, params, tokens, rng, train, pad_mask=None):
         """MLM loss with the K-position head: encoder over all T, head +
         vocab projection over the K gathered positions only."""
-        inputs, idx, targets = self.mask_tokens_fixed(rng, tokens)
-        x, moe_aux = self.encode(params, inputs)
+        inputs, idx, targets = self.mask_tokens_fixed(rng, tokens, pad_mask)
+        x, moe_aux = self.encode(params, inputs, pad_mask=pad_mask)
         h = jnp.take_along_axis(x, idx[..., None], axis=1)   # (B, K, D)
         h = jax.nn.gelu(self.head_fc.apply(params["head_fc"], h))
         h = self.head_ln.apply(params["head_ln"], h)
@@ -420,6 +428,11 @@ class BertMLM(Module):
 
         cfg = self.cfg
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        if isinstance(batch, dict) and batch.get("pad_mask") is not None:
+            raise NotImplementedError(
+                "pad_mask is not threaded through the 1F1B schedule yet; "
+                "use the GPipe schedule (which carries it as stage ctx) "
+                "or full-length batches")
         if rng is None:
             rng = jax.random.key(0)
         inputs, idx, targets = self.mask_tokens_fixed(rng, tokens)
@@ -464,21 +477,24 @@ class BertMLM(Module):
         # states were built around)
         grads = jax.tree_util.tree_map(
             lambda g, p: g.astype(p.dtype), grads, params)
-        metrics = {"accuracy": jnp.float32(float("nan")),
-                   "masked_frac": jnp.float32(cfg.mlm_predictions
+        # accuracy is not computed inside the 1F1B schedule (the last
+        # stage only reduces the loss); omit the key rather than emit a
+        # NaN sentinel a CSV consumer could read as divergence.
+        metrics = {"masked_frac": jnp.float32(cfg.mlm_predictions
                                               / tokens.shape[1])}
         return loss, metrics, grads
 
     def loss(self, params, batch, rng=None, train=True):
         """batch: tokens (B, T) int32 (labels are the tokens themselves)."""
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        pad_mask = batch.get("pad_mask") if isinstance(batch, dict) else None
         if rng is None:
             rng = jax.random.key(0)
         if self.cfg.mlm_predictions > 0:
-            return self._loss_fixed_k(params, tokens, rng, train)
-        inputs, selected = self.mask_tokens(rng, tokens)
-        logits, moe_aux = self.apply(params, inputs, train=train,
-                                     return_aux=True)
+            return self._loss_fixed_k(params, tokens, rng, train, pad_mask)
+        inputs, selected = self.mask_tokens(rng, tokens, pad_mask)
+        logits, moe_aux = self.apply(params, inputs, pad_mask=pad_mask,
+                                     train=train, return_aux=True)
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_logp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
         w = selected.astype(jnp.float32)

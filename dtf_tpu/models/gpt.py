@@ -30,15 +30,9 @@ from dtf_tpu.nn.layers import Dense, Embedding, LayerNorm
 NEG_BIG = -1e30
 
 
-def _quantize_cols(w):
-    """Symmetric per-output-channel (last dim) int8 weight quantization:
-    (..., K, N) -> (int8 same shape, fp32 scale (..., 1, N))."""
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
-                    keepdims=True) / 127.0
-    safe = jnp.maximum(scale, 1e-30)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / safe), -127,
-                 127).astype(jnp.int8)
-    return q, scale
+# ONE int8 quantizer shared with the fused decode kernel, so fused and
+# unfused --decode_int8 stay bit-compatible.
+from dtf_tpu.ops.decode_kernel import quantize_cols as _quantize_cols  # noqa: E402
 
 
 def _dequant_matmul(x, w8, scale, dtype):
@@ -454,9 +448,10 @@ class GPT(Module):
             grads["pos"] = demb["pos"]
         grads = jax.tree_util.tree_map(
             lambda g, p: g.astype(p.dtype), grads, params)
-        metrics = {"accuracy": jnp.float32(float("nan")),
-                   "perplexity": jnp.float32(float("nan"))}
-        return loss, metrics, grads
+        # accuracy/perplexity are not computed inside the 1F1B schedule
+        # (the last stage only reduces the loss); omit the keys rather
+        # than emit NaN sentinels a CSV consumer could read as divergence.
+        return loss, {}, grads
 
     # --- training objective -------------------------------------------
 
@@ -670,7 +665,8 @@ class GPT(Module):
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
-                 rng=None, int8_weights: bool = False):
+                 rng=None, int8_weights: bool = False,
+                 fused: bool = False):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         Two phases, one compiled program:
@@ -688,6 +684,11 @@ class GPT(Module):
         sequence's first EOS is forced to ``eos_id`` (static shapes mean
         no early exit — finished rows keep stepping but their output is
         pinned).
+
+        ``fused=True`` routes each decode token through the single-
+        ``pallas_call`` stack kernel (ops/decode_kernel.py) instead of the
+        op-per-op layer scan — single-stream (B=1) only; composes with
+        ``int8_weights``.
         """
         from dtf_tpu.nn.sampling import sample_token
 
@@ -701,6 +702,11 @@ class GPT(Module):
             return prompt
         if rng is None:
             rng = jax.random.key(0)
+        if fused:
+            return self._generate_fused(
+                params, prompt, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_id=eos_id, rng=rng,
+                int8_weights=int8_weights)
 
         # Cache bounded to the live total (lane-aligned), not max_len.
         cache, logits = self._prefill_cache(params, prompt,
@@ -734,6 +740,80 @@ class GPT(Module):
 
         (out, _, _, _), _ = lax.scan(step, (out, cache, rng, done),
                                      jnp.arange(p_len, total - 1))
+        return out
+
+    def _generate_fused(self, params, prompt, max_new_tokens: int, *,
+                        temperature, top_k, top_p, eos_id, rng,
+                        int8_weights):
+        """generate()'s decode loop with the whole layer stack fused into
+        ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
+        op count drops from ~170 to ~12, attacking the measured
+        op-latency floor of the unfused loop (BASELINE.md round 2).
+        Single-stream (B=1); the cache runs head-major (L, KVH, T, Dh) and
+        the kernel's k/v outputs are written back with one
+        ``dynamic_update_slice`` per token."""
+        from dtf_tpu.nn.sampling import sample_token
+        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
+                                               fused_decode_step)
+
+        cfg = self.cfg
+        b, p_len = prompt.shape
+        if b != 1:
+            raise ValueError(f"fused decode is single-stream (B=1); got "
+                             f"batch {b} — use the default path (the "
+                             f"batched loop already amortizes weight "
+                             f"streaming)")
+        if cfg.rope:
+            raise ValueError("fused decode does not support RoPE yet; "
+                             "use fused=False")
+        if cfg.pipeline_mesh is not None:
+            raise ValueError("fused decode does not compose with pipeline "
+                             "parallelism")
+        total = p_len + max_new_tokens
+
+        cache, logits = self._prefill_cache(params, prompt,
+                                            self._cache_len(total))
+        # single-stream row-major cache: (L, 1, T, KVH, Dh) -> (L, T, KVH·Dh)
+        n_l, _, t_c = cache["k"].shape[:3]
+        ck = cache["k"][:, 0].reshape(n_l, t_c, -1)
+        cv = cache["v"][:, 0].reshape(n_l, t_c, -1)
+
+        rng, sub = jax.random.split(rng)
+        first = sample_token(sub, logits, temperature=temperature,
+                             top_k=top_k, top_p=top_p)
+        out = jnp.zeros((b, total), jnp.int32)
+        out = lax.dynamic_update_slice(out, prompt, (0, 0))
+        out = out.at[:, p_len].set(first)
+        done = (first == eos_id) if eos_id is not None else None
+
+        pack = fused_decode_pack(params, cfg, int8=int8_weights)
+        head_q = (_quantize_cols(params["tok"]["table"].T)
+                  if int8_weights else None)
+
+        def step(carry, pos):
+            out, ck, cv, rng, done = carry
+            tok = lax.dynamic_slice(out, (0, pos), (b, 1))
+            x = self._embed(params, tok, pos[None])[:, 0, :]     # (1, D)
+            x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg)
+            ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0))
+            cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0))
+            h = self.ln_f.apply(params["ln_f"], x[:, None, :])
+            if head_q is not None:
+                logits = _dequant_matmul(h, head_q[0], head_q[1],
+                                         jnp.float32)[:, 0, :]
+            else:
+                logits = self.tok.attend(params["tok"], h)[:, 0, :]
+            rng, sub = jax.random.split(rng)
+            nxt = sample_token(sub, logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            if eos_id is not None:
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
+            return (out, ck, cv, rng, done), None
+
+        (out, _, _, _, _), _ = lax.scan(step, (out, ck, cv, rng, done),
+                                        jnp.arange(p_len, total - 1))
         return out
 
     def beam_search(self, params, prompt, max_new_tokens: int, *,

@@ -1,0 +1,297 @@
+"""Fused single-stream decode: the whole transformer stack as ONE Pallas
+kernel per token.
+
+Why: KV-cache decode at B=1 is op-latency-bound, not bandwidth-bound — the
+unfused loop issues ~170 tiny XLA ops per token (measured ~1.04 ms/token vs
+~0.36 ms of HBM weight traffic on GPT-2-small, BASELINE.md round 2).  The
+reference has no decode path at all (it is a TF1 parameter-server MNIST
+demo, `/root/reference/tf_distributed.py`); this kernel exists to push the
+framework's serving headline past the dispatch floor the op-per-op design
+hits.
+
+Design (all control flow static — Mosaic-friendly):
+
+* ``grid=(num_layers,)`` — TPU grids run **sequentially**, so the residual
+  stream lives in a VMEM scratch that carries across grid steps; layer
+  ``l``'s weights are that grid step's blocks (Pallas double-buffers the
+  HBM->VMEM streaming of layer l+1 behind layer l's compute).
+* FIVE matmuls per layer (packed qkv, o-proj, 2-3 MLP) — a first cut with
+  per-head matmul loops measured ~1.0 ms/token on GPT-2-small, i.e. the
+  in-kernel latency of ~900 M=1 matmuls re-created the dispatch floor it
+  was built to kill.  Attention instead runs in **lane-segment
+  arithmetic**: scores are an elementwise ``q ⊙ K`` over the (T, H·Dh)
+  cache block followed by a per-64-lane-segment reduction to (T, H), the
+  softmax reduces over the sublane (T) dim, and ``P·V`` is the reverse
+  broadcast-multiply reduced over T — all VPU work on arrays that already
+  sit in VMEM, no per-head slicing of matmul operands.
+* The KV cache is read-only input, row-major (L, T, KVH·Dh).  The current
+  token's k/v never touch the cache inside the kernel: its attention term
+  is folded in online-softmax style (separate self-score joined at the
+  max/denominator), and the (L, 1, KVH·Dh) k/v outputs are written into
+  the cache by ONE ``dynamic_update_slice`` per token outside — writing
+  only the row instead of round-tripping an aliased cache block.
+* int8 mode: every matmul operand streams from HBM as int8 with a
+  per-output-channel fp32 scale and widens to bf16 in VMEM — same
+  quantization contract as ``GPT._decode_pack`` (models/gpt.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dtf_tpu.ops.flash_attention import _interpret_default
+
+NEG_BIG = -1e30
+
+
+def quantize_cols(w):
+    """Symmetric per-output-channel (last dim) int8 weight quantization:
+    (..., K, N) -> (int8 same shape, fp32 scale (..., 1, N)).  The ONE
+    definition shared by this kernel's pack and GPT._decode_pack, so the
+    fused and unfused --decode_int8 paths stay bit-compatible."""
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                    keepdims=True) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / safe), -127,
+                 127).astype(jnp.int8)
+    return q, scale
+
+
+def fused_decode_pack(params, cfg, int8: bool = False) -> dict:
+    """Repack GPT params for the fused kernel (once per generate call).
+
+    Returns a dict of stacked arrays with static key order (see
+    ``_PACK_KEYS``); head-owning weights get the head dim LEADING so the
+    kernel indexes heads on an untiled dim.
+    """
+    lay = params["layers"]
+    attn = lay["attn"]
+    n_layers = lay["fc1"]["w"].shape[0]
+    d = cfg.dim
+    flat_w = lambda t: t["w"].reshape(n_layers, d, -1)
+    flat_b = lambda t: t["b"].reshape(n_layers, 1, -1)
+    # Per-layer vectors get a singleton middle dim — Mosaic requires the
+    # last two block dims to be (8|full, 128|full), and a (1, D) block of
+    # an (L, D) array satisfies neither; (L, 1, D) with block (1, 1, D)
+    # does.  The kernel reads them as ``ref[0]`` -> (1, D).
+    vec = lambda a: a[:, None, :]
+    # Dtypes stay as stored (bf16 in the decode benchmarks; fp32 in the
+    # CPU parity tests, where the kernel then computes in fp32 too).
+    pack = {
+        "ln1_s": vec(lay["ln1"]["scale"]), "ln1_b": vec(lay["ln1"]["bias"]),
+        "ln2_s": vec(lay["ln2"]["scale"]), "ln2_b": vec(lay["ln2"]["bias"]),
+        # ONE (D, (H+2·KVH)·Dh) projection operand per layer — same
+        # concatenation as GPT._packed_qkv, so the int8 per-column scales
+        # match the unfused --decode_int8 path exactly.
+        "w_qkv": jnp.concatenate(
+            [flat_w(attn["q"]), flat_w(attn["k"]), flat_w(attn["v"])],
+            axis=-1),
+        "b_qkv": jnp.concatenate(
+            [flat_b(attn["q"]), flat_b(attn["k"]), flat_b(attn["v"])],
+            axis=-1),
+        "w_o": attn["o"]["w"].reshape(n_layers, -1, d),   # (L, H·Dh, D)
+        "b_o": vec(attn["o"]["b"]),                       # (L, 1, D)
+        "w_fc1": lay["fc1"]["w"], "b_fc1": vec(lay["fc1"]["b"]),
+        "w_fc2": lay["fc2"]["w"], "b_fc2": vec(lay["fc2"]["b"]),
+    }
+    if cfg.mlp_act == "swiglu":
+        pack["w_gate"] = lay["fc_gate"]["w"]
+        pack["b_gate"] = vec(lay["fc_gate"]["b"])
+    if int8:
+        for key in ("w_qkv", "w_o", "w_fc1", "w_fc2", "w_gate"):
+            if key in pack:
+                pack[key], pack[key + "_sc"] = quantize_cols(pack[key])
+    return pack
+
+
+def _ln(x, scale_ref, bias_ref, eps=1e-6):
+    """LayerNorm of (1, D) fp32 x with (1, 1, D) param refs."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale_ref[0].astype(jnp.float32)
+            + bias_ref[0].astype(jnp.float32))
+
+
+def _mm(x_c, w_ref, sc_ref, idx, compute_dtype):
+    """x (1, K) @ weight block ``w_ref[idx]`` in ``compute_dtype`` with
+    fp32 MXU accumulation; int8 weights widen in VMEM and fold their
+    per-output-channel scale into the fp32 output."""
+    w = w_ref[idx] if idx is not None else w_ref[...]
+    y = jax.lax.dot_general(
+        x_c, w.astype(compute_dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if sc_ref is not None:
+        sc = sc_ref[idx] if idx is not None else sc_ref[...]
+        y = y * sc
+    return y
+
+
+def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
+                   mlp_act, compute_dtype, cache_dtype, out_dtype, eps):
+    n_in = len(keys)
+    r = dict(zip(keys, refs[:n_in]))
+    x_out, k_new, v_new = refs[n_in:n_in + 3]
+    x_s = refs[n_in + 3]
+    l = pl.program_id(0)
+    g = num_heads // kv_heads
+    scale = head_dim ** -0.5
+    pos = r["pos"][0]
+    cd = compute_dtype
+
+    @pl.when(l == 0)
+    def _init():
+        x_s[...] = r["x"][...].astype(jnp.float32)
+
+    x = x_s[...]                                       # (1, D) f32
+    sc = lambda name: r.get(name + "_sc")
+    mm = lambda h, name: _mm(h, r[name], sc(name), 0, cd)
+    f32 = jnp.float32
+    hn, kn = num_heads * head_dim, kv_heads * head_dim
+
+    # --- attention (lane-segment arithmetic; see module docstring) ----
+    hb = _ln(x, r["ln1_s"], r["ln1_b"], eps).astype(cd)
+    t_cache = r["kc"].shape[1]
+    qkv = mm(hb, "w_qkv") + r["b_qkv"][0].astype(f32)  # (1, (H+2KVH)·Dh)
+    q_row = qkv[:, :hn]
+    k_t = qkv[:, hn:hn + kn]
+    v_t = qkv[:, hn + kn:]
+    k_new[0] = k_t.astype(cache_dtype)
+    v_new[0] = v_t.astype(cache_dtype)
+
+    # Segment arithmetic via constant 0/1 matmuls (Mosaic does not lower
+    # lane-splitting reshapes like (T, H·Dh)->(T, H, Dh)):
+    #   reduce per head:     a (·, H·Dh) @ segm (H·Dh, H) -> (·, H)
+    #   broadcast per head:  a (·, H)    @ segb (H, H·Dh) -> (·, H·Dh)
+    #   GQA lane expand:     a (·, KVH·Dh) @ expm (KVH·Dh, H·Dh)
+    mmc = lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+    segm, segb = r["segm"][...], r["segb"][...]
+    expand = ((lambda a: a) if g == 1
+              else (lambda a: mmc(a, r["expm"][...]).astype(cd)))
+
+    kc = expand(r["kc"][0].astype(cd))                 # (T, H·Dh)
+    vc = expand(r["vc"][0].astype(cd))
+    q_c = q_row.astype(cd)
+    s = mmc(kc * q_c, segm) * scale                    # (T, H) f32
+    visible = (jax.lax.broadcasted_iota(jnp.int32, (t_cache, 1), 0)
+               < pos)                                  # strictly-older rows
+    s = jnp.where(visible, s, NEG_BIG)
+    s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale    # (1, H)
+    m = jnp.maximum(jnp.max(s, axis=0, keepdims=True), s_self)
+    p = jnp.exp(s - m)                                 # (T, H) f32
+    p_self = jnp.exp(s_self - m)
+    denom = jnp.sum(p, axis=0, keepdims=True) + p_self # (1, H)
+    pv = mmc(p.astype(cd), segb).astype(cd) * vc       # (T, H·Dh)
+    o_row = jnp.sum(pv, axis=0, keepdims=True, dtype=f32)
+    o_row = o_row + mmc(p_self.astype(cd), segb) * expand(v_t.astype(cd))
+    o_row = o_row * mmc((1.0 / denom).astype(cd), segb)
+    x = x + mm(o_row.astype(cd), "w_o") + r["b_o"][0].astype(f32)
+
+    # --- MLP ---------------------------------------------------------
+    h2 = _ln(x, r["ln2_s"], r["ln2_b"], eps).astype(cd)
+    u = mm(h2, "w_fc1") + r["b_fc1"][0].astype(f32)
+    if mlp_act == "swiglu":
+        gate = mm(h2, "w_gate") + r["b_gate"][0].astype(f32)
+        u = jax.nn.silu(gate) * u
+    else:
+        u = jax.nn.gelu(u)
+    y = mm(u.astype(cd), "w_fc2") + r["b_fc2"][0].astype(f32)
+    x = x + y
+
+    x_s[...] = x
+    x_out[...] = x.astype(out_dtype)
+
+
+def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
+                      interpret=None):
+    """One token through the whole layer stack as a single ``pallas_call``.
+
+    pack: ``fused_decode_pack`` output; cache_k/v: row-major
+    (L, T, KVH·Dh) in the cache dtype; x: (1, D) embedded token; pos:
+    scalar int32 position of this token (its row in the cache is written by
+    the CALLER from the returned k/v — the kernel only reads strictly-older
+    rows and folds the current token in online-softmax style).
+
+    Returns (x_out (1, D), k_new (L, 1, KVH·Dh), v_new (L, 1, KVH·Dh)).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    n_layers, t_cache, kn = cache_k.shape
+    nh = cfg.num_heads
+    kvh = cfg.num_kv_heads or nh
+    hd = kn // kvh
+    d = cfg.dim
+    if x.shape != (1, d):
+        raise ValueError(f"fused decode is single-stream: x must be (1, "
+                         f"{d}), got {x.shape}")
+
+    compute_dtype = pack["ln1_s"].dtype
+    hn = nh * hd
+    g = nh // kvh
+    # Constant 0/1 segment matrices (see kernel docstring); grid-invariant
+    # inputs, so they stream to VMEM once.
+    lane = lambda shape, dim: jax.lax.broadcasted_iota(jnp.int32, shape,
+                                                       dim)
+    segm = (lane((hn, nh), 0) // hd == lane((hn, nh), 1)).astype(
+        compute_dtype)
+    segb = segm.T
+    keys, args, in_specs = ["pos", "x", "kc", "vc", "segm", "segb"], [
+        jnp.asarray(pos, jnp.int32).reshape(1), x, cache_k, cache_v,
+        segm, segb], [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, d), lambda l: (0, 0)),
+        pl.BlockSpec((1, t_cache, kn), lambda l: (l, 0, 0)),
+        pl.BlockSpec((1, t_cache, kn), lambda l: (l, 0, 0)),
+        pl.BlockSpec((hn, nh), lambda l: (0, 0)),
+        pl.BlockSpec((nh, hn), lambda l: (0, 0)),
+    ]
+    if g > 1:
+        i, j = lane((kn, hn), 0), lane((kn, hn), 1)
+        expm = (i == (j // (g * hd)) * hd + j % hd).astype(compute_dtype)
+        keys.append("expm")
+        args.append(expm)
+        in_specs.append(pl.BlockSpec((kn, hn), lambda l: (0, 0)))
+    for name, arr in pack.items():
+        keys.append(name)
+        args.append(arr)
+        blk = (1, *arr.shape[1:])
+        in_specs.append(pl.BlockSpec(
+            blk, lambda l, _n=len(arr.shape): (l,) + (0,) * (_n - 1)))
+
+    # Compute in the packed weights' dtype (bf16 in the benchmarks, fp32
+    # in CPU parity tests); int8-packed weights widen to the LN params'
+    # dtype, which the int8 pack leaves unquantized.
+    kernel = functools.partial(
+        _decode_kernel, keys=tuple(keys), num_layers=n_layers,
+        num_heads=nh, kv_heads=kvh, head_dim=hd, mlp_act=cfg.mlp_act,
+        compute_dtype=compute_dtype, cache_dtype=cache_k.dtype,
+        out_dtype=x.dtype, eps=1e-6)
+
+    x_out, k_new, v_new = pl.pallas_call(
+        kernel,
+        grid=(n_layers,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, d), lambda l: (0, 0)),
+            pl.BlockSpec((1, 1, kn), lambda l: (l, 0, 0)),
+            pl.BlockSpec((1, 1, kn), lambda l: (l, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, d), x.dtype),
+            jax.ShapeDtypeStruct((n_layers, 1, kn), cache_k.dtype),
+            jax.ShapeDtypeStruct((n_layers, 1, kn), cache_k.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        # Double-buffered layer weights (~2x14 MB at GPT-2-small) exceed
+        # the 16 MB default scoped-vmem limit; v5e has 128 MB VMEM.
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)
+    return x_out, k_new, v_new

@@ -140,7 +140,10 @@ def _mask_bias(kv_mask, t):
     Sublane-replicated to 8 rows so rank-3 blocks (1, 8, bk) satisfy
     Mosaic's last-two-dims tiling rule (same trick as the (bq, 128)
     lane-replicated lse stats)."""
-    assert kv_mask.shape[-1] == t, (kv_mask.shape, t)
+    if kv_mask.shape[-1] != t:
+        raise ValueError(
+            f"kv_mask last dim {kv_mask.shape[-1]} must equal the key "
+            f"length Tk={t} (kv_mask shape {kv_mask.shape})")
     bias = jnp.where(kv_mask, 0.0, MASK_VALUE).astype(jnp.float32)
     return jnp.broadcast_to(bias[:, None, :], (kv_mask.shape[0], 8, t))
 
@@ -378,11 +381,19 @@ def flash_attention(q, k, v, *, causal: bool = False, kv_mask=None,
     ``kv_mask`` (B, Tk) bool, True = key visible, masks padded keys for
     every query (composable with ``causal``); rows must keep >=1 visible
     key.  The mask is not differentiated.
+
+    Self-attention only: the kernel's grid tiles one sequence length, so
+    Tq must equal Tk (cross-attention uses the XLA path in nn.attention).
     """
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"flash_attention is self-attention only (Tq {q.shape[2]} != "
+            f"Tk {k.shape[2]}); use nn.attention.dot_product_attention "
+            f"for cross-attention")
     if interpret is None:
         interpret = _interpret_default()
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
-    bias = None if kv_mask is None else _mask_bias(kv_mask, q.shape[2])
+    bias = None if kv_mask is None else _mask_bias(kv_mask, k.shape[2])
     return _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret)
 
 

@@ -270,6 +270,17 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
 
     if mode == "explicit":
         # Literal psum data-parallel: per-device code, explicit collectives.
+        # Params stay fully replicated in this mode, so a mesh with model
+        # axes (fsdp/tensor/pipe/expert/...) would silently degrade to
+        # replicated compute — reject it up front (README: "Implicit vs
+        # explicit mode").
+        model_axes = [a for a in mesh.axis_names
+                      if a != "data" and mesh.shape[a] > 1]
+        if model_axes:
+            raise ValueError(
+                f"mode='explicit' is data-parallel only (params replicated "
+                f"under shard_map); mesh axes {model_axes} require the "
+                f"implicit (GSPMD) mode")
         data_axes = sh.data_axes(mesh)
 
         def per_device(state, batch, rng):

@@ -46,7 +46,12 @@ def main(argv=None) -> int:
                              "logits; backward recomputes per chunk)")
     parser.add_argument("--pipeline_microbatches", type=int, default=0,
                         help=">0: pipeline the decoder stack over the "
-                             "'pipe' mesh axis (GPipe)")
+                             "'pipe' mesh axis")
+    parser.add_argument("--pipeline_schedule", choices=["gpipe", "1f1b"],
+                        default="gpipe",
+                        help="gpipe: forward pipeline + AD backward; "
+                             "1f1b: interleaved fwd/bwd, O(stages) "
+                             "activation memory")
     parser.add_argument("--attn", choices=["auto", "flash", "xla"],
                         default="auto",
                         help="inner attention: pallas flash kernel vs XLA "
@@ -58,6 +63,11 @@ def main(argv=None) -> int:
                         help="decode this many streams at once (the "
                              "serving-throughput axis: weights stream "
                              "once per step regardless of batch)")
+    parser.add_argument("--decode_fused", action="store_true",
+                        help="single-stream decode through the fused "
+                             "stack kernel (ops/decode_kernel.py): ONE "
+                             "pallas_call per token instead of the "
+                             "op-per-op layer scan (requires gen_batch 1)")
     parser.add_argument("--decode_int8", action="store_true",
                         help="int8-quantize the decode weights (per "
                              "output channel): half the HBM weight "
@@ -91,6 +101,7 @@ def main(argv=None) -> int:
     if ns.pipeline_microbatches > 0:
         kw["pipeline_mesh"] = cluster.mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches
+        kw["pipeline_schedule"] = ns.pipeline_schedule
     cfg = {"gpt2_small": GPTConfig.gpt2_small,
            "llama": GPTConfig.llama_style,
            "tiny": GPTConfig.tiny}[ns.preset](**kw)
@@ -103,12 +114,16 @@ def main(argv=None) -> int:
     state, metrics, _ = pretrain_benchmark(
         cluster, logger, model, train_cfg, toks, ns.steps,
         tokens_per_example=cfg.max_len - 1, throughput_unit="tok")
-    logger.print(f"Perplexity: {float(metrics['perplexity']):.2f}")
+    if "perplexity" in metrics:   # 1F1B reduces only the loss
+        logger.print(f"Perplexity: {float(metrics['perplexity']):.2f}")
 
     if ns.generate > 0:
         import jax
 
         prompt = jnp.asarray(toks[:ns.gen_batch, :8])
+        if ns.decode_fused and ns.beam_size > 1:
+            parser.error("--decode_fused is single-stream sampling only; "
+                         "it does not compose with --beam_size")
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size,
@@ -117,7 +132,7 @@ def main(argv=None) -> int:
             gen = jax.jit(lambda p, pr, key: model.generate(
                 p, pr, ns.generate, temperature=ns.temperature,
                 top_k=ns.top_k, top_p=ns.top_p, rng=key,
-                int8_weights=ns.decode_int8))
+                int8_weights=ns.decode_int8, fused=ns.decode_fused))
         t0 = time.perf_counter()
         out = gen(state["params"], prompt, jax.random.key(0))
         block(out)

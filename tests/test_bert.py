@@ -85,6 +85,25 @@ class TestBert:
         frac = float(jnp.mean(sel_vals == cfg.mask_token))
         assert frac == pytest.approx(0.8, abs=0.12)
 
+    def test_masking_respects_pad_mask(self):
+        """Padded positions are never selected for prediction, in both the
+        dynamic and the fixed-K path (ADVICE r2: fixed-K previously chose
+        over ALL T positions)."""
+        cfg = BertConfig.tiny(mlm_predictions=4)
+        m = BertMLM(cfg)
+        toks = jnp.ones((16, 32), jnp.int32) * 7
+        pad = jnp.arange(32)[None, :] < 10       # only 10 real positions
+        pad = jnp.broadcast_to(pad, toks.shape)
+        _, idx, _ = m.mask_tokens_fixed(jax.random.key(0), toks, pad)
+        assert int(jnp.max(idx)) < 10
+        _, selected = m.mask_tokens(jax.random.key(1), toks, pad)
+        assert not bool(jnp.any(selected & ~pad))
+        # and the loss path accepts a dict batch carrying the pad mask
+        p = m.init(jax.random.key(2))
+        loss, _ = m.loss(p, {"tokens": toks, "pad_mask": pad},
+                         rng=jax.random.key(3))
+        assert bool(jnp.isfinite(loss))
+
     def test_fixed_k_loss_trains(self):
         """K-position head: finite loss, gradients flow to every param
         (incl. the head), accounted FLOPs < dense FLOPs."""

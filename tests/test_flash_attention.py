@@ -176,3 +176,22 @@ class TestMHAIntegration:
         impl = flash_attention_impl(block_q=16, block_k=16)
         np.testing.assert_allclose(impl(q, k, v),
                                    dot_product_attention(q, k, v), atol=2e-5)
+
+
+class TestValidation:
+    def test_cross_attention_rejected(self):
+        """The kernel grid tiles one sequence length: Tq != Tk must raise
+        a descriptive error, not an opaque kernel failure (ADVICE r2)."""
+        q, _, _ = rand_qkv(jax.random.key(0), (1, 16, 2, 8))
+        k, _, _ = rand_qkv(jax.random.key(1), (1, 32, 2, 8))
+        v = k
+        with pytest.raises(ValueError, match="self-attention only"):
+            flash_attention(q.transpose(0, 2, 1, 3),
+                            k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3))
+
+    def test_kv_mask_wrong_length_rejected(self):
+        q, k, v = rand_qkv(jax.random.key(2), (1, 2, 16, 8))  # (B,H,T,D)
+        bad = jnp.ones((1, 8), bool)
+        with pytest.raises(ValueError, match="key .*length|Tk"):
+            flash_attention(q, k, v, kv_mask=bad)

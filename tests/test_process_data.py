@@ -90,6 +90,26 @@ class TestProcessShard:
         np.testing.assert_array_equal(np.concatenate(parts), gb)
 
 
+class TestValidation:
+    def test_oversized_batch_rejected(self):
+        """batch_size > num_examples raises up front instead of silently
+        truncating into a later divisibility error (ADVICE r2)."""
+        imgs = np.zeros((8, 3), np.float32)
+        labels = np.eye(2, dtype=np.float32)[np.zeros(8, np.int64)]
+        ds = Dataset(imgs, labels, seed=1)
+        with pytest.raises(ValueError, match="exceeds"):
+            ds.next_batch(16)
+        with pytest.raises(ValueError, match="exceeds"):
+            ds.fast_forward(2, 16)
+
+    def test_process_shard_examples_is_train_only(self):
+        imgs = np.zeros((8, 3), np.float32)
+        labels = np.eye(2, dtype=np.float32)[np.zeros(8, np.int64)]
+        view = Dataset(imgs, labels, seed=1).process_shard(0, 2)
+        with pytest.raises(NotImplementedError):
+            view.examples(0, 4)
+
+
 @pytest.mark.slow
 class TestTwoProcess:
     def test_loss_equals_full_batch(self, mesh8):

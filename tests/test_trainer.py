@@ -32,6 +32,15 @@ def small_cfg(tmp_path):
 
 
 class TestTrainStep:
+    def test_explicit_mode_rejects_model_axes(self, mesh_2d):
+        """Explicit (shard_map) mode keeps params replicated, so a mesh
+        with model axes must fail loudly instead of silently degrading to
+        replicated compute (README: 'Implicit vs explicit mode')."""
+        model = MnistMLP()
+        with pytest.raises(ValueError, match="data-parallel only"):
+            make_train_step(model.loss, optim.sgd(0.1), mesh_2d,
+                            mode="explicit")
+
     def test_implicit_explicit_equivalence(self, mesh8):
         """The GSPMD-inserted all-reduce and the literal shard_map psum must
         produce identical updates (both are 'psum data-parallel')."""
