@@ -1,0 +1,207 @@
+"""Transformer train-step time breakdown — where the non-MFU time goes.
+
+The reference's only benchmark apparatus was a wall-clock print around
+``sess.run`` (`/root/reference/tf_distributed.py:116-122`); it could never
+say WHERE a step's time went.  This module ladder-times (time_linfit — the
+only honest method through the axon relay, see BASELINE.md round 3) each
+component of a transformer layer at the exact benchmark shapes, so MFU
+claims decompose into per-kernel facts:
+
+* the three matmul families (qkv/attn-proj, fc1, fc2) in isolation,
+* LayerNorm / GELU elementwise passes,
+* flash attention forward and forward+backward,
+* one full block forward, forward+backward, and the complete train step.
+
+Each row reports achieved TFLOP/s (for FLOP-carrying ops) or GB/s (for
+bandwidth-bound ops) against the device's roofline, plus the implied
+fraction of a layer's step time.  Usage::
+
+    python -m dtf_tpu.bench.breakdown --family bert   # B=64 T=512 (base)
+    python -m dtf_tpu.bench.breakdown --family gpt    # B=32 T=1024 (small)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.bench.matmul import peak_flops_per_chip
+from dtf_tpu.utils.timing import time_linfit
+
+# chain lengths for the marginal-timing fit; long enough that per-iter
+# device time dominates the fit range against ~100 ms relay jitter.
+# Every ladder point is a separate XLA compile (~20-40 s at these
+# shapes), so the ladder stays short: 3 points x ~10 rows.
+LADDER = (2, 8, 24)
+
+
+def _chain(fn, n, x0):
+    """n dependent applications of fn inside one jit (no CSE/hoist)."""
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return fn(c), None
+        out, _ = lax.scan(body, x, None, length=n)
+        return out
+    return lambda: run(x0)
+
+
+def _time(fn, x0, reps=4):
+    fit = time_linfit(lambda n: _chain(fn, n, x0), LADDER, reps=reps)
+    return fit.per_iter_s
+
+
+@dataclasses.dataclass
+class Row:
+    name: str
+    seconds: float
+    flops: float = 0.0          # per application
+    bytes_moved: float = 0.0    # per application (HBM, approximate)
+
+    def line(self, peak: Optional[float]) -> str:
+        cols = [f"{self.name:<34}", f"{self.seconds * 1e6:9.0f} us"]
+        if self.flops:
+            tf = self.flops / self.seconds / 1e12
+            cols.append(f"{tf:7.1f} TF/s")
+            if peak:
+                cols.append(f"{tf * 1e12 / peak * 100:5.1f}% peak")
+        elif self.bytes_moved:
+            cols.append(f"{self.bytes_moved / self.seconds / 1e9:7.0f} GB/s")
+        return "  ".join(cols)
+
+
+def breakdown(family: str = "bert", batch: Optional[int] = None,
+              seq: Optional[int] = None) -> list[Row]:
+    if family == "bert":
+        b, t, d, f, h = batch or 64, seq or 512, 768, 3072, 12
+        causal = False
+    else:
+        b, t, d, f, h = batch or 32, seq or 1024, 768, 3072, 12
+        causal = True
+    bt = b * t
+    key = jax.random.key(0)
+    mk = lambda k, shape: jax.random.normal(jax.random.key(k), shape,
+                                            jnp.bfloat16)
+    rows: list[Row] = []
+
+    # --- isolated matmuls at the layer's shapes ----------------------
+    for name, (m, k_, n) in [("matmul qkv (BT,D)x(D,3D)", (bt, d, 3 * d)),
+                             ("matmul fc1 (BT,D)x(D,F)", (bt, d, f))]:
+        w = mk(1, (k_, n))
+        # chain through a slice so output feeds the next input
+        def mm(x, w=w, k_=k_):
+            y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+            return y[:, :k_].astype(jnp.bfloat16)
+        s = _time(mm, mk(2, (m, k_)))
+        rows.append(Row(name, s, flops=2.0 * m * k_ * n))
+    # fc2 shrinks (BT,F)->(BT,D), so it cannot chain alone; time the
+    # full matmul-only MLP pair (fc1 -> gelu -> fc2), the shape that a
+    # fused kernel would have to beat.
+    w1, w2 = mk(12, (d, f)), mk(13, (f, d))
+    def mlp(x):
+        u = jax.nn.gelu(jnp.dot(x, w1, preferred_element_type=jnp.float32))
+        return jnp.dot(u.astype(jnp.bfloat16), w2,
+                       preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+    s = _time(mlp, mk(14, (bt, d)))
+    rows.append(Row("mlp pair fc1+gelu+fc2", s, flops=4.0 * bt * d * f))
+
+    # --- elementwise / normalization ---------------------------------
+    from dtf_tpu.nn.layers import LayerNorm
+    ln = LayerNorm(d)
+    lnp = ln.init(jax.random.key(3))
+    s = _time(lambda x: ln.apply(lnp, x), mk(4, (b, t, d)))
+    rows.append(Row("layernorm (B,T,D)", s, bytes_moved=2.0 * bt * d * 2))
+    s = _time(lambda x: jax.nn.gelu(x), mk(5, (b, t, f)))
+    rows.append(Row("gelu (B,T,F)", s, bytes_moved=2.0 * bt * f * 2))
+
+    # --- attention ----------------------------------------------------
+    from dtf_tpu.ops.flash_attention import flash_attention, _block_sizes
+    hd = d // h
+    q = mk(6, (b, h, t, hd))
+    attn_flops = 4.0 * b * h * t * t * hd          # qk + pv
+    if causal:
+        # the kernel skips blocks above the diagonal: of nb^2 block pairs
+        # only nb(nb+1)/2 execute (diagonal blocks half-masked but still
+        # computed, so credit them fully)
+        nb = t // _block_sizes(t, 512, 512)[0]
+        attn_flops *= (nb + 1) / (2 * nb)
+    fa = functools.partial(flash_attention, causal=causal)
+    s = _time(lambda x: fa(x, q, q).astype(jnp.bfloat16), q)
+    rows.append(Row("flash attention fwd", s, flops=attn_flops))
+
+    def fa_grad(x):
+        g = jax.grad(lambda y: jnp.sum(fa(y, q, q) * 1e-6))(x)
+        return g.astype(jnp.bfloat16)
+    s = _time(fa_grad, q)
+    rows.append(Row("flash attention fwd+bwd", s, flops=3.5 * attn_flops))
+
+    # --- one whole block: fwd, then fwd+bwd --------------------------
+    from dtf_tpu.models.gpt import GPTBlock, GPTConfig
+    cfg = GPTConfig(dim=d, num_heads=h, mlp_dim=f, max_len=t,
+                    dtype=jnp.bfloat16, vocab_size=1024)
+    block = GPTBlock(cfg)
+    bp = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), block.init(jax.random.key(7)))
+    # 6·p_layer·(per-token) convention: params ≈ 12 D² per layer
+    p_layer = sum(x.size for x in jax.tree_util.tree_leaves(bp))
+    blk_fwd_flops = 2.0 * p_layer * bt + attn_flops
+    s = _time(lambda x: block.apply(bp, x), mk(8, (b, t, d)))
+    rows.append(Row("block fwd", s, flops=blk_fwd_flops))
+
+    def blk_grad(x):
+        g = jax.grad(lambda y: jnp.sum(block.apply(bp, y)
+                                       .astype(jnp.float32)) * 1e-6)(x)
+        return g.astype(jnp.bfloat16)
+    s = _time(blk_grad, mk(9, (b, t, d)))
+    # grad wrt x alone never computes the dW matmuls: dx costs ~1x the
+    # forward matmul FLOPs, so the executed total is ~2x fwd, not 3x.
+    rows.append(Row("block fwd+bwd (x-grad only)", s,
+                    flops=2.0 * blk_fwd_flops))
+
+    def blk_grad_w(x):
+        gp, gx = jax.grad(
+            lambda pp, y: jnp.sum(block.apply(pp, y)
+                                  .astype(jnp.float32)) * 1e-6,
+            argnums=(0, 1))(bp, x)
+        return gx.astype(jnp.bfloat16)
+    s = _time(blk_grad_w, mk(10, (b, t, d)))
+    rows.append(Row("block fwd+bwd (x+w grads)", s,
+                    flops=3.0 * blk_fwd_flops))
+
+    def blk_grad_remat(x):
+        fn = jax.checkpoint(lambda y: block.apply(bp, y))
+        gx = jax.grad(lambda y: jnp.sum(fn(y).astype(jnp.float32))
+                      * 1e-6)(x)
+        return gx.astype(jnp.bfloat16)
+    s = _time(blk_grad_remat, mk(11, (b, t, d)))
+    # x-grad only (see above) + one full recompute: ~3x fwd executed.
+    rows.append(Row("block fwd+bwd x-grad, full remat", s,
+                    flops=3.0 * blk_fwd_flops))
+
+    return rows
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--family", choices=["bert", "gpt"], default="bert")
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--seq", type=int, default=None)
+    ns = parser.parse_args(argv)
+    peak = peak_flops_per_chip()
+    rows = breakdown(ns.family, ns.batch, ns.seq)
+    print(f"# {ns.family} layer breakdown "
+          f"(peak {peak / 1e12 if peak else float('nan'):.0f} TF/s bf16)")
+    for r in rows:
+        print(r.line(peak))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,11 +29,13 @@ def _to_uint8_images(x: np.ndarray) -> np.ndarray:
 
 
 def write_mnist_idx(data_dir: str, n_train: int = 4096, n_test: int = 1024,
-                    seed: int = 1, compress: bool = False) -> None:
+                    seed: int = 1, compress: bool = False,
+                    **task_kw) -> None:
     """Write train/test image+label IDX files (optionally .gz) into
     ``data_dir`` using the exact header layout of the published files
     (magic 0x803 for rank-3 images, 0x801 for rank-1 labels, big-endian
-    dims)."""
+    dims).  ``task_kw`` forwards to ``_synthetic_classification`` (e.g.
+    ``spread=0.09`` for the BASELINE stress row)."""
     os.makedirs(data_dir, exist_ok=True)
 
     def dump(path, arr, magic):
@@ -45,7 +47,7 @@ def write_mnist_idx(data_dir: str, n_train: int = 4096, n_test: int = 1024,
 
     for split, n, split_seed in (("train", n_train, 0), ("t10k", n_test, 1)):
         x, y1h = _synthetic_classification(n, (28, 28), 10, seed,
-                                           split_seed=split_seed)
+                                           split_seed=split_seed, **task_kw)
         imgs = _to_uint8_images(x)
         labels = np.argmax(y1h, axis=1).astype(np.uint8)
         dump(os.path.join(data_dir, f"{split}-images-idx3-ubyte"),
@@ -55,7 +57,8 @@ def write_mnist_idx(data_dir: str, n_train: int = 4096, n_test: int = 1024,
 
 
 def write_cifar_batches(data_dir: str, n_per_batch: int = 800,
-                        n_test: int = 800, seed: int = 1) -> None:
+                        n_test: int = 800, seed: int = 1,
+                        **task_kw) -> None:
     """Write data_batch_1..5 + test_batch pickles into ``data_dir`` in the
     published CIFAR-10 python layout (dict with b"data" (N, 3072) uint8
     row-major RGB planes and b"labels")."""
@@ -69,9 +72,10 @@ def write_cifar_batches(data_dir: str, n_per_batch: int = 800,
 
     for i in range(1, 6):
         x, y1h = _synthetic_classification(n_per_batch, (32, 32, 3), 10,
-                                           seed, split_seed=i * 10)
+                                           seed, split_seed=i * 10,
+                                           **task_kw)
         dump(os.path.join(data_dir, f"data_batch_{i}"), x,
              np.argmax(y1h, axis=1))
     x, y1h = _synthetic_classification(n_test, (32, 32, 3), 10, seed,
-                                       split_seed=99)
+                                       split_seed=99, **task_kw)
     dump(os.path.join(data_dir, "test_batch"), x, np.argmax(y1h, axis=1))

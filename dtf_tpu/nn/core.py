@@ -91,6 +91,12 @@ def remat(fn: Callable, policy: str = "full") -> Callable:
     if policy == "full":
         return jax.checkpoint(fn)
     if policy == "dots":
+        # Matmul outputs + the flash kernel's named outputs (out, lse):
+        # without the names, the backward pass recomputes the whole flash
+        # forward just to rebuild its residuals (ops/flash_attention.py).
         return jax.checkpoint(
-            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            fn, policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse")))
     raise ValueError(f"remat policy must be 'full' or 'dots', got {policy!r}")

@@ -18,8 +18,17 @@ TPU mapping (pallas_guide.md patterns):
 * per-key padding masks (``kv_mask``) enter as a sublane-replicated
   (B, 8, T) additive fp32 bias with a finite mask value — see MASK_VALUE —
   so BERT-style variable-length batches run on the kernel, not a fallback;
-* backward = two kernels (dq; dk+dv fused) using the saved logsumexp — the
-  standard flash-attention backward, not recompute-the-naive-path.
+* backward = ONE fused kernel producing dq+dk+dv on grid (B, H, nk, nq),
+  sharing a single s/p/ds recompute per block pair (the earlier two-kernel
+  split recomputed them twice and re-streamed every operand); dq
+  accumulates across the outer k loop in a (T, D) fp32 VMEM scratch, so
+  differentiable flash has a T-proportional VMEM term (16 MB at T=64k,
+  D=64 — the bwd call raises the scoped-vmem limit accordingly);
+* softmax statistics are stored lane-slim as (B, H, T, 8) fp32 (a 128-wide
+  stats array was ~200 MB of pure replication traffic per BERT-base layer)
+  and the kernel outputs carry ``checkpoint_name``s ("flash_out",
+  "flash_lse") so the framework's "dots" remat policy saves them instead
+  of recomputing the whole forward inside the backward pass.
 
 On CPU (tests / the 8-device simulated mesh) kernels run in interpreter
 mode automatically.
@@ -126,10 +135,13 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
     def _finalize():
         l = l_scr[:, :1]
         o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
-        # lse stored lane-replicated (bq, 128): rank-3 (B,H,T) blocks of
+        # lse stored lane-replicated (bq, 8): rank-3 (B,H,T) blocks of
         # shape (1,1,bq) violate Mosaic's last-two-dims tiling rule on real
         # TPU (second-to-last block dim 1 != H), so the stats array is
-        # (B,H,T,128) with legal (bq,128) blocks.
+        # (B,H,T,8) with legal full-lane-dim (bq,8) blocks.  8 lanes, not
+        # 128: at BERT-base shapes a 128-wide stats array was 201 MB/layer
+        # of pure replication traffic (written fwd, read bwd, and saved
+        # under the remat policy).
         lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
                                          lse_ref.shape[2:])
 
@@ -138,7 +150,7 @@ def _mask_bias(kv_mask, t):
     """(B, Tk) bool -> (B, 8, Tk) fp32 additive bias (0 / MASK_VALUE).
 
     Sublane-replicated to 8 rows so rank-3 blocks (1, 8, bk) satisfy
-    Mosaic's last-two-dims tiling rule (same trick as the (bq, 128)
+    Mosaic's last-two-dims tiling rule (same trick as the (bq, 8)
     lane-replicated lse stats)."""
     if kv_mask.shape[-1] != t:
         raise ValueError(
@@ -170,11 +182,11 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 8), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
@@ -186,70 +198,43 @@ def _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
 
 
 # --------------------------------------------------------------------------
-# backward: dq on grid (B,H,nq,nk); dk,dv fused on grid (B,H,nk,nq)
+# backward: ONE fused dq+dk+dv kernel on grid (B, H, nk, nq)
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, has_mask):
-    if has_mask:
-        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref, dq_ref, acc = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc = refs
-        mask_ref = None
-    qi, ki = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
+def _bwd_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+    """Fused dq+dk+dv backward: ONE kernel on grid (b, h, nk, nq).
 
-    @pl.when(ki == 0)
-    def _init():
-        acc[:] = jnp.zeros_like(acc)
+    The two-kernel version recomputed s/p twice and re-streamed every
+    operand twice; at T=512 (single 512-block per head) that meant 2x768
+    latency-bound programs and a measured ~28 TF/s backward.  Here every
+    cotangent comes from one (bq, bk)-oriented s/p/ds via dot_general
+    dimension numbers (no transposes):
 
-    def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        o = o_ref[0, 0].astype(jnp.float32)            # (bq, D)
-        do = do_ref[0, 0].astype(jnp.float32)          # (bq, D)
-        lse = lse_ref[0, 0][:, :1]                     # (bq, 1)
-        # delta_i = sum_d dO_id O_id, recomputed per block (elementwise VPU
-        # work, cheaper than a third stats array in HBM)
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask_block(s, qi * block_q, ki * block_k)
-        if mask_ref is not None:
-            s = s + mask_ref[0][:1, :]                 # (1, bk)
-        p = jnp.exp(s - lse)                           # (bq, bk)
-        dp = jax.lax.dot_general(                      # dO @ V^T
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        acc[:] = acc[:] + jax.lax.dot(
-            ds, k, preferred_element_type=jnp.float32) * scale
+        dq[qi] += ds @ k          dk = ds^T q = dot(ds, q, contract bq)
+        dv = p^T dO = dot(p, do, contract bq)
 
-    if causal:
-        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(compute)
-    else:
-        compute()
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        dq_ref[0, 0] = acc[:].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
+    dq accumulates across the OUTER ki loop, so it lives in a full (T, D)
+    f32 scratch (131 KB at T=512, 1 MB at T=4096) indexed at the qi
+    block; every (ki==nk-1) pass rewrites the dq output blocks with the
+    final accumulator (earlier passes emit dead writes — the last pass
+    wins, nk is 1 for T <= block_q anyway).
+    """
     if has_mask:
         (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+         dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc) = refs
     else:
         (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+         dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc) = refs
         mask_ref = None
     ki, qi = pl.program_id(2), pl.program_id(3)
-    nq = pl.num_programs(3)
+    nk, nq = pl.num_programs(2), pl.num_programs(3)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
     @pl.when(qi == 0)
-    def _init():
+    def _init_dkv():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
@@ -259,35 +244,44 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, has_mask):
         v = v_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)            # (bq, D)
         do = do_ref[0, 0].astype(jnp.float32)          # (bq, D)
-        lse = lse_ref[0, 0][:, :1].T                   # (1, bq)
-        delta = jnp.sum(do * o, axis=-1)[None, :]      # (1, bq)
-        st = jax.lax.dot_general(                      # K @ Q^T: (bk, bq)
-            k, q, (((1,), (1,)), ((), ())),
+        lse = lse_ref[0, 0][:, :1]                     # (bq, 1)
+        # delta_i = sum_d dO_id O_id, recomputed per block (elementwise VPU
+        # work, cheaper than a third stats array in HBM)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(                       # Q @ K^T: (bq, bk)
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            # st[i, j]: key ki*bk+i, query qi*bq+j; visible iff q >= k
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, st.shape, 0)
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, st.shape, 1)
-            st = jnp.where(qpos >= kpos, st, NEG_INF)
+            s = _causal_mask_block(s, qi * block_q, ki * block_k)
         if mask_ref is not None:
-            st = st + mask_ref[0][:1, :].T             # (bk, 1) key bias
-        pt = jnp.exp(st - lse)                         # (bk, bq)
-        dv_acc[:] = dv_acc[:] + jax.lax.dot(
-            pt, do, preferred_element_type=jnp.float32)
-        dpt = jax.lax.dot_general(                     # V @ dO^T: (bk, bq)
-            v, do, (((1,), (1,)), ((), ())),
+            s = s + mask_ref[0][:1, :]                 # (1, bk)
+        p = jnp.exp(s - lse)                           # (bq, bk)
+        dp = jax.lax.dot_general(                      # dO @ V^T: (bq, bk)
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dst = pt * (dpt - delta)
-        dk_acc[:] = dk_acc[:] + jax.lax.dot(
-            dst, q, preferred_element_type=jnp.float32) * scale
+        ds = p * (dp - delta)
+        row = pl.ds(qi * block_q, block_q)
+        dq_acc[row, :] = dq_acc[row, :] + jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(   # ds^T @ Q: (bk, D)
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(   # p^T @ dO: (bk, D)
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         pl.when(qi * block_q + block_q - 1 >= ki * block_k)(compute)
     else:
         compute()
 
+    @pl.when(ki == nk - 1)
+    def _write_dq():
+        dq_ref[0, 0] = dq_acc[pl.ds(qi * block_q, block_q), :].astype(
+            dq_ref.dtype)
+
     @pl.when(qi == nq - 1)
-    def _finalize():
+    def _write_dkv():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
@@ -298,49 +292,37 @@ def _bwd(q, k, v, o, lse, bias, do, causal, scale, block_q, block_k,
     bq, bk = _block_sizes(t, block_q, block_k)
     has_mask = bias is not None
 
-    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
-    k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
-    l_spec = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
-    m_spec = pl.BlockSpec((1, 8, bk), lambda b_, h_, qi, ki: (b_, 0, ki))
+    # ki outer, qi inner (sequential on-core): dk/dv accumulate over the
+    # inner loop; dq accumulates across the outer loop in the (T, D)
+    # scratch.
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
+    l_spec = pl.BlockSpec((1, 1, bq, 8), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    m_spec = pl.BlockSpec((1, 8, bk), lambda b_, h_, ki, qi: (b_, 0, ki))
 
-    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, q_spec, l_spec]
-    dq_args = [q, k, v, o, do, lse]
+    in_specs = [q_spec, k_spec, k_spec, q_spec, q_spec, l_spec]
+    args = [q, k, v, o, do, lse]
     if has_mask:
-        dq_in_specs.append(m_spec)
-        dq_args.append(bias)
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, has_mask=has_mask),
-        grid=(b, h, t // bq, t // bk),
-        in_specs=dq_in_specs,
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(*dq_args)
-
-    # Transposed grid: k blocks outer, q blocks inner (sequential on-core).
-    q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
-    k_spec_t = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0))
-    l_spec_t = pl.BlockSpec((1, 1, bq, 128), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
-    m_spec_t = pl.BlockSpec((1, 8, bk), lambda b_, h_, ki, qi: (b_, 0, ki))
-    dkv_in_specs = [q_spec_t, k_spec_t, k_spec_t, q_spec_t, q_spec_t, l_spec_t]
-    dkv_args = [q, k, v, o, do, lse]
-    if has_mask:
-        dkv_in_specs.append(m_spec_t)
-        dkv_args.append(bias)
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        in_specs.append(m_spec)
+        args.append(bias)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, has_mask=has_mask),
         grid=(b, h, t // bk, t // bq),
-        in_specs=dkv_in_specs,
-        out_specs=[k_spec_t, k_spec_t],
-        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+        in_specs=in_specs,
+        out_specs=[q_spec, k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, t, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        # The (T, D) dq accumulator exceeds the 16 MB default scoped-vmem
+        # limit for very long sequences (T=64k, D=64 -> 16 MB + blocks).
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(*dkv_args)
+    )(*args)
     return dq, dk, dv
 
 
@@ -356,6 +338,13 @@ def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     out, lse = _fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+    # Named so a remat policy can SAVE the kernel's outputs: without these,
+    # jax.checkpoint recomputes the whole flash forward inside the backward
+    # pass to re-produce lse/out (~0.8 ms/layer at BERT-base shapes).  The
+    # slim (B,H,T,8) lse makes saving both nearly free.
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse, bias)
 
 

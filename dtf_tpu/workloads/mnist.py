@@ -37,6 +37,14 @@ def main(argv=None) -> int:
                         help="directory with the IDX files (real MNIST or "
                              "dtf_tpu.data.fixtures-written); synthetic "
                              "fallback when absent")
+    parser.add_argument("--init", choices=["reference", "fan_in"],
+                        default="reference",
+                        help="weight init: the reference's N(0,1) "
+                             "(tf.random_normal — saturates the sigmoid "
+                             "layer, which freezes it into a random-"
+                             "feature model that cannot learn the "
+                             "multimodal synthetic task) or fan-in "
+                             "scaled")
     parser.add_argument("--grad_compression", choices=["int8"], default=None,
                         help="int8-wire ring all-reduce for gradient sync "
                              "(requires --mode explicit)")
@@ -57,7 +65,7 @@ def main(argv=None) -> int:
         print("[dtf_tpu] MNIST_data/ not found; using deterministic "
               "synthetic data (zero-egress environment)")
 
-    model = MnistMLP()
+    model = MnistMLP(init_scale=ns.init)
     total_steps = (splits.train.num_examples // global_batch) * train_cfg.epochs
     lr = optim.schedule_from_config(train_cfg, total_steps)
     # --optimizer overrides the reference's SGD (tf_distributed.py:73).

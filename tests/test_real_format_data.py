@@ -1,7 +1,8 @@
 """Real-bytes data path, end to end: fixture writers emit the genuine
 on-disk formats (IDX, CIFAR pickle batches), the loaders parse them
 through their real-file code paths (not the synthetic fallback), and
-MNIST trains to >=95% test accuracy on those bytes."""
+MNIST trains into a falsifiable sub-1.0 accuracy band on those bytes
+(the synthetic content carries label noise, so 1.00 is unreachable)."""
 
 import jax
 import numpy as np
@@ -27,11 +28,15 @@ class TestMnistIdx:
             assert splits.train.labels.shape == (256, 10)
             assert np.all(splits.train.labels.sum(axis=1) == 1.0)
 
-    def test_trains_to_95_percent(self, tmp_path, mesh8):
+    def test_trains_into_falsifiable_band(self, tmp_path, mesh8):
         """The reference's observable: real-bytes MNIST reaching high test
         accuracy (tf_distributed.py:126).  Adam for a CPU-friendly step
-        budget; the task is the deterministic prototype+noise synthetic in
-        real IDX clothing."""
+        budget; the content is the UNSATURABLE multimodal/label-noise
+        synthetic task in real IDX clothing — the asserted band has a
+        ceiling BELOW 1.0 (the 8% label flips cap accuracy at ~0.93), so
+        this number can regress in either direction: a broken optimizer
+        falls out the bottom, an accidentally-trivial task breaks the
+        top."""
         from dtf_tpu import optim
         from dtf_tpu.models.mlp import MnistMLP
         from dtf_tpu.train.trainer import (init_state, make_train_step,
@@ -52,7 +57,7 @@ class TestMnistIdx:
                              jnp.asarray(splits.test.images))
         acc = float(np.mean(np.argmax(logits, -1)
                             == np.argmax(splits.test.labels, -1)))
-        assert acc >= 0.95, acc
+        assert 0.80 <= acc <= 0.96, acc   # measured 0.908 at n=2048
 
 
 class TestCifarPickles:

@@ -101,15 +101,17 @@ class TestTrainStep:
 
 class TestTrainerEndToEnd:
     def test_mnist_converges_and_logs_contract(self, mesh8, small_cfg, capsys):
-        """End-to-end: synthetic MNIST, 1 epoch, accuracy well above chance,
-        console lines match the reference format."""
+        """End-to-end: synthetic MNIST in a falsifiable band (the
+        multimodal/label-noise task caps at ~0.93, so both bounds can
+        trip), console lines match the reference format.  Three adam
+        epochs — plain 1-epoch SGD no longer saturates the hard task,
+        which is the point of it."""
         cluster = make_cluster(mesh8)
         model = MnistMLP(init_scale="fan_in")
-        trainer = Trainer(cluster, model, optim.sgd(small_cfg.learning_rate),
-                          small_cfg)
+        trainer = Trainer(cluster, model, optim.adam(1e-3), small_cfg)
         splits = load_mnist(seed=1)
-        result = trainer.fit(splits)
-        assert result["test_accuracy"] > 0.5     # chance = 0.1
+        result = trainer.fit(splits, epochs=3)
+        assert 0.60 < result["test_accuracy"] < 0.96   # measured 0.927
         out = capsys.readouterr().out
         assert re.search(r"Step: \d+, {2}Epoch: +\d+, {2}Batch: +\d+ of +\d+, "
                          r" Cost: \d+\.\d{4}, {2}AvgTime: +\d+\.\d{2}ms", out)
