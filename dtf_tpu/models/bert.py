@@ -47,6 +47,11 @@ class BertConfig:
     # fp32 logits tensor, the single largest activation.  0 = dense head
     # over every position (binomial ~mask_rate masking).
     mlm_predictions: int = 0
+    # "scan": lax.scan over stacked layer params (fast compile).
+    # "unroll": python loop — XLA keeps each layer's remat saves as plain
+    # buffers instead of scan-stacked dynamic-update-slices; measured
+    # ~15% faster steps at BERT-base on v5e for slower compiles.
+    layer_loop: str = "scan"
     attn_impl: Optional[Any] = None  # pluggable (ring attention etc.)
     # Inner attention when attn_impl is None: the Pallas flash kernel
     # (mask-capable: BERT's key-padding masks run on the kernel) on TPU,
@@ -159,6 +164,12 @@ class BertMLM(Module):
 
     def __post_init__(self):
         cfg = self.cfg
+        if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
+                             f"'1f1b', got {cfg.pipeline_schedule!r}")
+        if cfg.layer_loop not in ("scan", "unroll"):
+            raise ValueError(f"layer_loop must be 'scan' or 'unroll', "
+                             f"got {cfg.layer_loop!r}")
         self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
         self.pos = Embedding(cfg.max_len, cfg.dim, cfg.dtype)
         self.ln_emb = LayerNorm(cfg.dim)
@@ -266,6 +277,20 @@ class BertMLM(Module):
         layer_fn = lambda lp, h: self.layer.apply(lp, h, mask=attn_mask)
         if self.cfg.remat:
             layer_fn = remat(layer_fn, self.cfg.remat_policy)
+
+        if self.cfg.layer_loop == "unroll":
+            # Python-unrolled layer loop: XLA manages each layer's saved
+            # residuals as plain buffers.  The scanned form stacks them
+            # through dynamic-update-slice fusions that run far below HBM
+            # peak — measured ~15% whole-step win at BERT-base shapes
+            # (BASELINE.md round 3) for a compile-time cost.
+            moe_aux = jnp.zeros((), jnp.float32)
+            for l in range(self.cfg.num_layers):
+                lp = jax.tree_util.tree_map(lambda a: a[l],
+                                            params["layers"])
+                x, a = layer_fn(lp, x)
+                moe_aux = moe_aux + a
+            return x, moe_aux
 
         def body(carry, layer_params):
             h, aux = carry

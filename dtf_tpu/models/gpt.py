@@ -54,6 +54,8 @@ class GPTConfig:
     max_len: int = 1024
     dtype: Any = jnp.float32
     use_flash: Optional[bool] = None   # None = flash on TPU, XLA elsewhere
+    # "scan" | "unroll" layer loop — see models/bert.py BertConfig.
+    layer_loop: str = "scan"
     remat: bool = False
     # LLaMA-family options (beyond-parity model breadth):
     rope: bool = False                 # rotary positions instead of a table
@@ -291,6 +293,12 @@ class GPT(Module):
 
     def __post_init__(self):
         cfg = self.cfg
+        if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
+                             f"'1f1b', got {cfg.pipeline_schedule!r}")
+        if cfg.layer_loop not in ("scan", "unroll"):
+            raise ValueError(f"layer_loop must be 'scan' or 'unroll', "
+                             f"got {cfg.layer_loop!r}")
         self.tok = Embedding(cfg.vocab_size, cfg.dim, cfg.dtype)
         # RoPE rotates q/k inside the blocks; no position table then.
         self.pos = None if cfg.rope else Embedding(cfg.max_len, cfg.dim,
@@ -330,6 +338,15 @@ class GPT(Module):
                 self._stage_fn(), self._grouped_layers(params), x,
                 self.cfg.pipeline_mesh,
                 num_microbatches=self.cfg.pipeline_microbatches)
+            return self.ln_f.apply(params["ln_f"], x)
+
+        if self.cfg.layer_loop == "unroll":
+            # see models/bert.py encode: plain buffers beat scan-stacked
+            # remat saves at large shapes
+            for l in range(self.cfg.num_layers):
+                lp = jax.tree_util.tree_map(lambda a: a[l],
+                                            params["layers"])
+                x = block_fn(lp, x)
             return self.ln_f.apply(params["ln_f"], x)
 
         def body(carry, lp):

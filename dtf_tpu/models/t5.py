@@ -66,6 +66,12 @@ class T5Config:
     # leading dim is 1, not B).
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
+    # "gpipe": forward pipelines + AD backward for both stacks.  "1f1b":
+    # the DECODER stack runs the interleaved 1F1B schedule (O(stages)
+    # activation memory; the encoder output rides the schedule's
+    # differentiable ctx) while the encoder keeps GPipe-by-AD — see
+    # T5.pipeline_loss_and_grads.
+    pipeline_schedule: str = "gpipe"
 
     @classmethod
     def small(cls, **kw):
@@ -234,6 +240,9 @@ class T5(Module):
 
     def __post_init__(self):
         cfg = self.cfg
+        if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
+                             f"'1f1b', got {cfg.pipeline_schedule!r}")
         if cfg.positions not in ("relative", "absolute"):
             raise ValueError(f"positions must be 'relative' or 'absolute', "
                              f"got {cfg.positions!r}")
@@ -451,6 +460,124 @@ class T5(Module):
     def eval_metrics(self, params, batch):
         loss, aux = self.loss(params, batch, train=False)
         return {"loss": loss, **aux}
+
+    # --- 1F1B pipelined training --------------------------------------
+
+    @property
+    def custom_grads_fn(self):
+        """Trainer seam for models that produce their own gradients (cf.
+        models/bert.py): non-None when configured for the 1F1B decoder
+        schedule."""
+        if (self.cfg.pipeline_mesh is None
+                or self.cfg.pipeline_schedule != "1f1b"):
+            return None
+        return self.pipeline_loss_and_grads
+
+    def pipeline_loss_and_grads(self, params, batch, rng=None):
+        """Two-stack pipelined training pass: (loss, metrics, grads).
+
+        The DECODER stack runs the interleaved 1F1B schedule — its
+        activation footprint is O(stages), and every decoder stage's
+        cross-attention reads the encoder output through the schedule's
+        *differentiable ctx*, whose summed cotangent comes back as
+        ``d_ctx``.  The ENCODER (plus both embeddings) runs under an
+        outer ``jax.vjp`` with its own GPipe forward pipeline
+        (pipeline_apply is AD-differentiable), consuming ``d_ctx`` and
+        the schedule's ``dx``.  The tied token table gets gradient from
+        all three uses (source embedding, target embedding, logits
+        head); the decoder relpos table is tiled per stage and the stage
+        grads summed back.
+
+        Loss semantics: the schedule averages per-microbatch losses, and
+        each microbatch's CE is weighted by ITS OWN pad count — equal to
+        the dense path's global weighted mean only when every microbatch
+        carries the same number of non-pad targets (always true for the
+        benchmark's full-length batches).  Padded targets still train
+        correctly, just under a per-microbatch reweighting.
+        """
+        from dtf_tpu.parallel.pipeline import pipeline_train_1f1b
+        from dtf_tpu.nn.losses import smooth_token_logp
+
+        cfg = self.cfg
+        src, tgt = batch["src"], batch["tgt"]
+        tgt_in = self._shift_right(tgt)
+        t = tgt_in.shape[1]
+
+        outer_keys = ["tok", "enc_layers", "ln_enc"]
+        outer_keys += (["relpos_enc"] if self.relative
+                       else ["pos_enc", "pos_dec"])
+        outer = {k: params[k] for k in outer_keys}
+
+        def embed_and_encode(op):
+            ctx, _ = self.encode({**params, **op}, src)
+            x = self.tok.apply(op["tok"], tgt_in)
+            if not self.relative:
+                x = x + self.pos_dec.apply(op["pos_dec"], jnp.arange(t))
+            return x, ctx
+
+        (x0, enc_out), outer_vjp = jax.vjp(embed_and_encode, outer)
+
+        grouped = self._grouped_stack(
+            params["dec_layers"],
+            params["relpos_dec"]["table"] if self.relative else None)
+        head_params = {"ln_dec": params["ln_dec"], "tok": params["tok"]}
+
+        fn = self.dec_layer.apply
+        if cfg.remat:
+            fn = jax.checkpoint(fn)
+
+        def stage(sp_params, h, c):
+            b = self._stage_bias(sp_params, h.shape[1],
+                                 bidirectional=False)
+            m4 = c["ctx_valid"][:, None, None, :]
+
+            def body(carry, lp):
+                return fn(lp, carry, c["ctx"], ctx_mask=m4,
+                          self_bias=b), None
+
+            h, _ = lax.scan(body, h, sp_params["layers"])
+            return h, jnp.zeros((), jnp.float32)
+
+        def head_loss(hp, y, c):
+            x = self.ln_dec.apply(hp["ln_dec"], y)
+            logits = self.tok.attend(hp["tok"], x).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tok_logp = jnp.take_along_axis(
+                logp, c["tgt"][..., None], axis=-1)[..., 0]
+            tok_logp = smooth_token_logp(logp, tok_logp,
+                                         cfg.label_smoothing)
+            weight = (c["tgt"] != cfg.pad_id).astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(weight), 1.0)
+            return -jnp.sum(tok_logp * weight) / denom
+
+        ctx_valid = (src != cfg.pad_id)
+        loss, sgrads, hgrads, dx0, ddctx = pipeline_train_1f1b(
+            stage, head_loss, grouped, head_params, x0,
+            {"ctx_valid": ctx_valid, "tgt": tgt}, cfg.pipeline_mesh,
+            num_microbatches=cfg.pipeline_microbatches,
+            diff_ctx={"ctx": enc_out})
+
+        (douter,) = outer_vjp((dx0.astype(x0.dtype),
+                               ddctx["ctx"].astype(enc_out.dtype)))
+
+        n_dec = cfg.dec_layers
+        dec_grads = jax.tree_util.tree_map(
+            lambda g: g.reshape(n_dec, *g.shape[2:]), sgrads["layers"])
+        grads = {k: douter[k] for k in outer_keys if k != "tok"}
+        grads["tok"] = jax.tree_util.tree_map(jnp.add, douter["tok"],
+                                              hgrads["tok"])
+        grads["dec_layers"] = dec_grads
+        grads["ln_dec"] = hgrads["ln_dec"]
+        if self.relative:
+            grads["relpos_dec"] = {"table": jnp.sum(sgrads["table"],
+                                                    axis=0)}
+        missing = set(params) - set(grads)
+        assert not missing, f"grads missing for params: {missing}"
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        # accuracy is not computed inside the 1F1B schedule (the last
+        # stage only reduces the loss); the key is omitted (cf. bert.py).
+        return loss, {}, grads
 
     # --- generation ---------------------------------------------------
 

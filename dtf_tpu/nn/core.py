@@ -99,4 +99,18 @@ def remat(fn: Callable, policy: str = "full") -> Callable:
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 jax.checkpoint_policies.save_only_these_names(
                     "flash_out", "flash_lse")))
-    raise ValueError(f"remat policy must be 'full' or 'dots', got {policy!r}")
+    if policy == "attn":
+        # Save ONLY the flash kernel's outputs; recompute every matmul in
+        # the backward pass.  Counter-intuitively this is the FASTEST
+        # measured policy at BERT-base shapes on v5e (BASELINE.md round
+        # 3): attention is the one op whose recompute is expensive
+        # relative to its save (the fwd kernel runs at ~60 TF/s vs ~165
+        # for the MLP matmuls), while "dots" pays more in saved-residual
+        # HBM traffic than the matmul recompute costs.  Also the
+        # memory-lightest option after "full" (~100 MB/layer saved at
+        # BERT-base mb64 vs ~480 MB for "dots").
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
+    raise ValueError(
+        f"remat policy must be 'full', 'dots', or 'attn', got {policy!r}")

@@ -167,7 +167,8 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
                         stage_params: Any, head_params: Any, x: jax.Array,
                         ctx: Any, mesh: Mesh, *, num_microbatches: int,
                         axis: str = "pipe", aux_weight: float = 0.0,
-                        batch_axes: Optional[tuple] = None) -> tuple:
+                        batch_axes: Optional[tuple] = None,
+                        diff_ctx: Optional[dict] = None) -> tuple:
     """One pipelined forward+backward pass under the 1F1B schedule.
 
     Schedule (global tick clock, S stages, M microbatches): stage ``i``
@@ -190,7 +191,12 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
       microbatch rows); running the loss inside the pipeline is what makes
       fwd/bwd interleaving possible;
     * ``ctx``: pytree of per-example side inputs, leading dim B (labels,
-      masks); not differentiated.
+      masks); not differentiated;
+    * ``diff_ctx``: optional dict of per-example side inputs that ARE
+      differentiated — e.g. the encoder output every T5 decoder stage
+      cross-attends to.  Stage/loss fns see them merged into their ctx
+      dict; each stage's backward contributes that microbatch's cotangent
+      and the contributions are summed over the pipe axis.
 
     Total objective: ``mean_k loss_k + aux_weight * sum_{stage,k} aux / M``.
 
@@ -198,34 +204,49 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     S-stacked stage params, the head/loss params, and the cotangent of
     ``x`` (flows back into pre-pipeline embedding layers; differentiate
     those with an outer ``jax.vjp`` around the embedding computation).
-    Grads are already pmean'd over the data axes.
+    With ``diff_ctx``, a fifth element ``d_diff_ctx`` (same structure /
+    batch shape as ``diff_ctx``) is appended.  Grads are already pmean'd
+    over the data axes.
     """
     m = num_microbatches
     s, mb, batch_axes = _validate(mesh, axis, stage_params, x, m, batch_axes)
     xs = x.reshape(m, mb, *x.shape[1:])
     ctx = jax.tree_util.tree_map(
         lambda c: c.reshape(m, mb, *c.shape[1:]), ctx)
+    dctx_in = diff_ctx
+    if dctx_in is not None:
+        dctx_in = jax.tree_util.tree_map(
+            lambda c: c.reshape(m, mb, *c.shape[1:]), dctx_in)
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     head_spec = jax.tree_util.tree_map(lambda _: P(), head_params)
     x_spec = _mb_spec(batch_axes, xs.ndim)
     ctx_spec = jax.tree_util.tree_map(lambda c: _mb_spec(batch_axes, c.ndim),
                                       ctx)
+    dctx_spec = jax.tree_util.tree_map(
+        lambda c: _mb_spec(batch_axes, c.ndim), dctx_in)
 
     body = functools.partial(_per_device_1f1b, stage_fn, loss_fn, s=s, m=m,
                              axis=axis, aux_weight=aux_weight,
-                             data_axes=batch_axes)
+                             data_axes=batch_axes,
+                             has_dctx=dctx_in is not None)
     mapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(param_spec, head_spec, x_spec, ctx_spec),
-        out_specs=(P(), param_spec, head_spec, x_spec), check_vma=False)
-    loss, sgrads, hgrads, dxs = mapped(stage_params, head_params, xs, ctx)
-    return loss, sgrads, hgrads, dxs.reshape(x.shape)
+        in_specs=(param_spec, head_spec, x_spec, ctx_spec, dctx_spec),
+        out_specs=(P(), param_spec, head_spec, x_spec, dctx_spec),
+        check_vma=False)
+    loss, sgrads, hgrads, dxs, ddctx = mapped(stage_params, head_params,
+                                              xs, ctx, dctx_in)
+    if dctx_in is None:
+        return loss, sgrads, hgrads, dxs.reshape(x.shape)
+    ddctx = jax.tree_util.tree_map(
+        lambda g, c: g.reshape(c.shape), ddctx, diff_ctx)
+    return loss, sgrads, hgrads, dxs.reshape(x.shape), ddctx
 
 
 def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
-                     *, s: int, m: int, axis: str, aux_weight: float,
-                     data_axes: tuple):
+                     dctx, *, s: int, m: int, axis: str, aux_weight: float,
+                     data_axes: tuple, has_dctx: bool):
     """Per-device 1F1B loop (see pipeline_train_1f1b for the schedule)."""
     idx = lax.axis_index(axis)
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
@@ -237,33 +258,40 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
                             lambda p: jnp.zeros(p.shape, jnp.float32))
 
     act_shape = xs.shape[1:]
+    if not has_dctx:
+        dctx = {}
+    dc0 = _ctx_at(dctx, 0)        # zero-cotangent template per microbatch
 
-    def fwd_compute(x_in, ctx_k):
-        y, aux = stage_fn(params, x_in, ctx_k)
+    def merged(ctx_k, dc_k):
+        return {**ctx_k, **dc_k} if has_dctx else ctx_k
+
+    def fwd_compute(x_in, ctx_k, dc_k):
+        y, aux = stage_fn(params, x_in, merged(ctx_k, dc_k))
         return y, jnp.asarray(aux, jnp.float32)
 
-    def bwd_last(x_res, ctx_k, _dy):
-        def f(p, hp, xx):
-            y, aux = stage_fn(p, xx, ctx_k)
-            l = loss_fn(hp, y, ctx_k)
+    def bwd_last(x_res, ctx_k, dc_k, _dy):
+        def f(p, hp, xx, dc):
+            c = merged(ctx_k, dc)
+            y, aux = stage_fn(p, xx, c)
+            l = loss_fn(hp, y, c)
             # differentiate the total; report the pure loss (aux is a
             # regularizer, not the training metric)
             return l + aux_weight * jnp.asarray(aux, jnp.float32), l
-        _, vjp, l_pure = jax.vjp(f, params, head_params, x_res,
+        _, vjp, l_pure = jax.vjp(f, params, head_params, x_res, dc_k,
                                  has_aux=True)
-        dp, dhp, dx = vjp(jnp.asarray(1.0 / m, jnp.float32))
-        return dp, dhp, dx, l_pure
+        dp, dhp, dx, ddc = vjp(jnp.asarray(1.0 / m, jnp.float32))
+        return dp, dhp, dx, ddc, l_pure
 
-    def bwd_mid(x_res, ctx_k, dy):
-        def f(p, xx):
-            return stage_fn(p, xx, ctx_k)
-        _, vjp = jax.vjp(f, params, x_res)
-        dp, dx = vjp((dy, jnp.asarray(aux_weight / m, jnp.float32)))
+    def bwd_mid(x_res, ctx_k, dc_k, dy):
+        def f(p, xx, dc):
+            return stage_fn(p, xx, merged(ctx_k, dc))
+        _, vjp = jax.vjp(f, params, x_res, dc_k)
+        dp, dx, ddc = vjp((dy, jnp.asarray(aux_weight / m, jnp.float32)))
         return dp, jax.tree_util.tree_map(jnp.zeros_like, head_params), \
-            dx, jnp.zeros((), jnp.float32)
+            dx, ddc, jnp.zeros((), jnp.float32)
 
     def tick(carry, t):
-        buf_f, buf_b, stash, gsum, hsum, dxs, loss_sum = carry
+        buf_f, buf_b, stash, gsum, hsum, dxs, dcs, loss_sum = carry
 
         # ---- forward slot: stage i, microbatch kf at tick 2*kf + i
         kf = (t - idx) // 2
@@ -274,7 +302,8 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
             lax.dynamic_index_in_dim(xs, kfc, axis=0, keepdims=False),
             buf_f)
         y_send = lax.cond(
-            do_f, lambda: fwd_compute(x_in, _ctx_at(ctx, kfc))[0],
+            do_f, lambda: fwd_compute(x_in, _ctx_at(ctx, kfc),
+                                      _ctx_at(dctx, kfc))[0],
             lambda: jnp.zeros(act_shape, xs.dtype))
         stash = lax.cond(
             do_f,
@@ -291,19 +320,22 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
                                          keepdims=False)
 
         def run_bwd():
-            dp, dhp, dx, l = lax.cond(
+            dp, dhp, dx, ddc, l = lax.cond(
                 is_last,
-                lambda: bwd_last(x_res, _ctx_at(ctx, kbc), buf_b),
-                lambda: bwd_mid(x_res, _ctx_at(ctx, kbc), buf_b))
-            return dp, dhp, dx, l
+                lambda: bwd_last(x_res, _ctx_at(ctx, kbc),
+                                 _ctx_at(dctx, kbc), buf_b),
+                lambda: bwd_mid(x_res, _ctx_at(ctx, kbc),
+                                _ctx_at(dctx, kbc), buf_b))
+            return dp, dhp, dx, ddc, l
 
         def skip_bwd():
             return (jax.tree_util.tree_map(jnp.zeros_like, params),
                     jax.tree_util.tree_map(jnp.zeros_like, head_params),
                     jnp.zeros(act_shape, xs.dtype),
+                    jax.tree_util.tree_map(jnp.zeros_like, dc0),
                     jnp.zeros((), jnp.float32))
 
-        dp, dhp, dx_send, l = lax.cond(do_b, run_bwd, skip_bwd)
+        dp, dhp, dx_send, ddc, l = lax.cond(do_b, run_bwd, skip_bwd)
         gsum = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), gsum, dp)
         hsum = jax.tree_util.tree_map(
@@ -314,24 +346,39 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
             lambda: lax.dynamic_update_index_in_dim(dxs, dx_send, kbc,
                                                     axis=0),
             lambda: dxs)
+        # every stage contributes its cross-attention cotangent for kb;
+        # each device hits each microbatch once, so a read-add-update is
+        # an exact accumulate (summed over stages by the psum below)
+        dcs = lax.cond(
+            do_b,
+            lambda: jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, kbc, axis=0,
+                                             keepdims=False)
+                    + g.astype(jnp.float32), kbc, axis=0),
+                dcs, ddc),
+            lambda: dcs)
 
         # unconditional collectives: every device participates every tick
         buf_f = lax.ppermute(y_send, axis, fwd_perm)
         buf_b = lax.ppermute(dx_send, axis, bwd_perm)
-        return (buf_f, buf_b, stash, gsum, hsum, dxs, loss_sum), None
+        return (buf_f, buf_b, stash, gsum, hsum, dxs, dcs, loss_sum), None
 
     carry0 = (jnp.zeros(act_shape, xs.dtype),
               jnp.zeros(act_shape, xs.dtype),
               jnp.zeros((s, *act_shape), xs.dtype),
               f32(params), f32(head_params),
-              jnp.zeros_like(xs), jnp.zeros((), jnp.float32))
-    (_, _, _, gsum, hsum, dxs, loss_sum), _ = lax.scan(
+              jnp.zeros_like(xs), f32(dctx),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, gsum, hsum, dxs, dcs, loss_sum), _ = lax.scan(
         tick, carry0, jnp.arange(2 * (m + s - 1)))
 
     # head grads / loss live on the last stage, dxs on the first: share
     hsum = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), hsum)
     loss_mean = lax.psum(loss_sum, axis) / m
     dxs = lax.psum(jnp.where(is_first, dxs, jnp.zeros_like(dxs)), axis)
+    dcs = jax.tree_util.tree_map(lambda g: lax.psum(g, axis), dcs)
     if data_axes:
         pm = lambda g: lax.pmean(g, data_axes)
         gsum = jax.tree_util.tree_map(pm, gsum)
@@ -344,9 +391,12 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
         for a in data_axes:
             dsize *= lax.axis_size(a)
         dxs = dxs / dsize
+        dcs = jax.tree_util.tree_map(lambda g: g / dsize, dcs)
     # re-add the stacked stage dim so out_specs P(axis) reassembles (S, ...)
     gsum = jax.tree_util.tree_map(lambda g: g[None], gsum)
-    return loss_mean, gsum, hsum, dxs
+    dcs_out = (jax.tree_util.tree_map(
+        lambda g, c: g.astype(c.dtype), dcs, dctx) if has_dctx else None)
+    return loss_mean, gsum, hsum, dxs, dcs_out
 
 
 def bubble_fraction(num_stages: int, num_microbatches: int) -> float:

@@ -174,12 +174,11 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     across shards.  The two converge as per-shard batch grows.
     """
 
-    if grads_fn is not None and (mode != "implicit" or grad_accum != 1
-                                 or stateful):
+    if grads_fn is not None and (mode != "implicit" or stateful):
         raise ValueError(
             "grads_fn (a model that produces its own gradients, e.g. the "
-            "1F1B pipeline schedule) requires implicit mode, grad_accum=1, "
-            "and a stateless model — the schedule owns the backward pass")
+            "1F1B pipeline schedule) requires implicit mode and a "
+            "stateless model — the schedule owns the backward pass")
     if grad_compression not in (None, "int8"):
         raise ValueError(f"grad_compression must be None or 'int8', got "
                          f"{grad_compression!r}")
@@ -202,36 +201,43 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             new_ms = None
         return loss, aux, new_ms, grads
 
-    def accumulated_grads(params, model_state, batch, rng):
-        # Strided split (microbatch i = rows i::grad_accum): each device's
-        # contiguous data-sharded rows contribute equally to every
-        # microbatch, so the split is a local slice — a contiguous split
-        # would misalign microbatches with the batch sharding and make
-        # GSPMD reshard inside the step.  Equally correct: the loss is a
-        # mean, so microbatch membership doesn't matter.
+    def accumulated(step_of_mb, model_state, batch, rng):
+        """THE grad-accumulation skeleton, shared by the value_and_grad
+        and custom-grads_fn paths: ``step_of_mb(ms, mb, rng) -> (loss,
+        aux, new_ms, grads)`` runs per microbatch; gradients accumulate
+        in FLOAT32 regardless of param dtype (bf16 summation rounds away
+        small contributions as grad_accum grows).
+
+        Strided split (microbatch i = rows i::grad_accum): each device's
+        contiguous data-sharded rows contribute equally to every
+        microbatch, so the split is a local slice — a contiguous split
+        would misalign microbatches with the batch sharding and make
+        GSPMD reshard inside the step.  Equally correct: the loss is a
+        mean, so microbatch membership doesn't matter.
+        """
         micro = jax.tree_util.tree_map(
             lambda x: jnp.moveaxis(
                 x.reshape(x.shape[0] // grad_accum, grad_accum,
                           *x.shape[1:]), 1, 0), batch)
+        f32 = lambda t: jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), t)
 
         def body(carry, inp):
             g_sum, l_sum, aux_sum, ms = carry
             i, mb = inp
-            loss, aux, new_ms, grads = value_and_grads(
-                params, ms, mb, jax.random.fold_in(rng, i))
-            g_sum = jax.tree_util.tree_map(jnp.add, g_sum, grads)
+            loss, aux, new_ms, grads = step_of_mb(
+                ms, mb, jax.random.fold_in(rng, i))
+            g_sum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), g_sum, grads)
             aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
             return (g_sum, l_sum + loss, aux_sum, new_ms), None
 
-        g0 = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
         first = jax.tree_util.tree_map(lambda x: x[0], micro)
-        loss0, aux0, ms0, grads0 = value_and_grads(
-            params, model_state, first, jax.random.fold_in(rng, 0))
-        g0 = jax.tree_util.tree_map(jnp.add, g0, grads0)
+        loss0, aux0, ms0, grads0 = step_of_mb(
+            model_state, first, jax.random.fold_in(rng, 0))
         rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
         (g_sum, l_sum, aux_sum, ms), _ = lax.scan(
-            body, (g0, loss0, aux0, ms0),
+            body, (f32(grads0), loss0, aux0, ms0),
             (jnp.arange(1, grad_accum), rest))
         inv = 1.0 / grad_accum
         scale = lambda t: jax.tree_util.tree_map(lambda x: x * inv, t)
@@ -241,11 +247,22 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         params, opt_state, step = state["params"], state["opt_state"], state["step"]
         model_state = state.get("model_state")
         if grads_fn is not None:
-            loss, aux, grads = grads_fn(params, batch, rng)
+            if grad_accum > 1:
+                # the schedule owns each microbatch's backward; the
+                # accumulation happens OUTSIDE it (mean of per-microbatch
+                # grads == grads of the mean loss)
+                def gf_step(ms, mb, r):
+                    loss, aux, grads = grads_fn(params, mb, r)
+                    return loss, aux, ms, grads
+                loss, aux, _, grads = accumulated(
+                    gf_step, None, batch, rng)
+            else:
+                loss, aux, grads = grads_fn(params, batch, rng)
             new_ms = None
         elif grad_accum > 1:
-            loss, aux, new_ms, grads = accumulated_grads(
-                params, model_state, batch, rng)
+            loss, aux, new_ms, grads = accumulated(
+                lambda ms, mb, r: value_and_grads(params, ms, mb, r),
+                model_state, batch, rng)
         else:
             loss, aux, new_ms, grads = value_and_grads(
                 params, model_state, batch, rng)

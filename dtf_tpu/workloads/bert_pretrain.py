@@ -37,11 +37,20 @@ def main(argv=None) -> int:
     parser.add_argument("--remat", action="store_true",
                         help="recompute encoder activations in backward "
                              "(jax.checkpoint): less HBM, ~30%% more FLOPs")
-    parser.add_argument("--remat_policy", choices=["full", "dots"],
+    parser.add_argument("--remat_policy",
+                        choices=["full", "dots", "attn"],
                         default="full",
                         help="with --remat: 'dots' saves matmul outputs and "
                              "recomputes only elementwise work (most of the "
-                             "memory win at a few %% recompute)")
+                             "memory win at a few %% recompute); 'attn' "
+                             "saves only the flash kernel outputs — the "
+                             "fastest measured policy at BERT-base on "
+                             "v5e (BASELINE.md round 3)")
+    parser.add_argument("--layer_loop", choices=["scan", "unroll"],
+                        default="scan",
+                        help="'unroll' trades compile time for ~15%% "
+                             "faster steps (remat saves become plain "
+                             "buffers instead of scan-stacked slices)")
     parser.add_argument("--attn", choices=["auto", "flash", "xla"],
                         default="auto",
                         help="inner attention: pallas flash kernel (mask-"
@@ -101,6 +110,8 @@ def main(argv=None) -> int:
     if ns.remat:
         kw["remat"] = True
         kw["remat_policy"] = ns.remat_policy
+    if ns.layer_loop != "scan":
+        kw["layer_loop"] = ns.layer_loop
     if ns.moe_experts > 0:
         kw["moe_experts"] = ns.moe_experts
     if ns.mlm_predictions is not None:

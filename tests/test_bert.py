@@ -125,6 +125,26 @@ class TestBert:
         assert (m.train_flops_per_example(p)
                 < dense.train_flops_per_example(p))
 
+    def test_unrolled_layer_loop_matches_scan(self):
+        """layer_loop='unroll' + remat_policy='attn' is the measured-fast
+        path; loss and grads must equal the scanned default."""
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+        rng = jax.random.key(2)
+        ms = BertMLM(BertConfig.tiny())
+        mu = BertMLM(BertConfig.tiny(layer_loop="unroll", remat=True,
+                                     remat_policy="attn"))
+        p = ms.init(jax.random.key(0))
+        (ls, _), gs = jax.value_and_grad(
+            lambda q: ms.loss(q, toks, rng=rng), has_aux=True)(p)
+        (lu, _), gu = jax.value_and_grad(
+            lambda q: mu.loss(q, toks, rng=rng), has_aux=True)(p)
+        assert float(ls) == pytest.approx(float(lu), rel=1e-6)
+        for (path, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(gs),
+                jax.tree_util.tree_leaves_with_path(gu)):
+            np.testing.assert_allclose(a, b, atol=1e-5,
+                                       err_msg=jax.tree_util.keystr(path))
+
     def test_param_axes_mirror_params(self):
         cfg = BertConfig.tiny()
         m = BertMLM(cfg)

@@ -265,6 +265,56 @@ class TestBert1F1B:
                 leaf, flat2[path], atol=3e-4,
                 err_msg=jax.tree_util.keystr(path))
 
+    def test_grad_accum_composes_with_grads_fn(self):
+        """grad_accum atop the 1F1B schedule: the trainer accumulates
+        per-microbatch grads OUTSIDE the schedule; must equal the mean of
+        the schedule's grads over the strided microbatch split (rng folded
+        per microbatch, same as the value_and_grad path)."""
+        from dtf_tpu.models.bert import BertConfig, BertMLM
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+        from dtf_tpu import optim
+
+        mesh = make_mesh("data=4,pipe=2")
+        kw = dict(mlm_predictions=4, pipeline_mesh=mesh,
+                  pipeline_microbatches=2, pipeline_schedule="1f1b")
+        m = BertMLM(BertConfig.tiny(**kw))
+        params = m.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (16, 32), 4, 128)
+        rng = jax.random.key(2)
+
+        # manual accumulation: strided halves, fold_in(rng, i)
+        micro = np.moveaxis(
+            np.asarray(toks).reshape(8, 2, 32), 1, 0)
+        losses, grads = [], []
+        for i in range(2):
+            li, _, gi = m.pipeline_loss_and_grads(
+                params, {"tokens": jnp.asarray(micro[i])},
+                jax.random.fold_in(rng, i))
+            losses.append(float(li))
+            grads.append(gi)
+        want = jax.tree_util.tree_map(lambda a, b: (a + b) / 2, *grads)
+
+        # trainer path with grad_accum=2: inspect via a sgd(1.0) step
+        # (params' change == -grads)
+        opt = optim.sgd(1.0)
+        state = init_state(m, opt, seed=0, mesh=mesh)
+        state["params"] = params
+        step = make_train_step(m.loss, opt, mesh, grad_accum=2,
+                               grads_fn=m.pipeline_loss_and_grads,
+                               donate=False)
+        new_state, metrics = step(state, put_global_batch(mesh, {"tokens": toks}),
+                                  rng)
+        assert float(metrics["loss"]) == pytest.approx(
+            (losses[0] + losses[1]) / 2, abs=1e-5)
+        got = jax.tree_util.tree_map(lambda a, b: a - b,
+                                     state["params"], new_state["params"])
+        flat_w = dict(jax.tree_util.tree_leaves_with_path(want))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(got):
+            np.testing.assert_allclose(
+                np.asarray(leaf, np.float32), flat_w[path], atol=3e-4,
+                err_msg=jax.tree_util.keystr(path))
+
     def test_activation_memory_flat_in_microbatches(self):
         """The point of 1F1B: compiled temp (activation) memory stays O(S)
         as M grows, while GPipe-by-AD stores all M microbatch activations
