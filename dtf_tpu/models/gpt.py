@@ -780,9 +780,6 @@ class GPT(Module):
                              f"batch {b} — use the default path (the "
                              f"batched loop already amortizes weight "
                              f"streaming)")
-        if cfg.rope:
-            raise ValueError("fused decode does not support RoPE yet; "
-                             "use fused=False")
         if cfg.pipeline_mesh is not None:
             raise ValueError("fused decode does not compose with pipeline "
                              "parallelism")
@@ -811,7 +808,13 @@ class GPT(Module):
             out, ck, cv, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
             x = self._embed(params, tok, pos[None])[:, 0, :]     # (1, D)
-            x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg)
+            rope_kw = {}
+            if cfg.rope:
+                from dtf_tpu.nn.rope import rope_angles
+                cos, sin = rope_angles(pos, cfg.dim // cfg.num_heads)
+                rope_kw = {"rope_cos": cos, "rope_sin": sin}
+            x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
+                                                **rope_kw)
             ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0))
             cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0))
             h = self.ln_f.apply(params["ln_f"], x[:, None, :])

@@ -152,6 +152,8 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     sc = lambda name: r.get(name + "_sc")
     mm = lambda h, name: _mm(h, r[name], sc(name), 0, cd)
     f32 = jnp.float32
+    mmc = lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=f32)
     hn, kn = num_heads * head_dim, kv_heads * head_dim
 
     # --- attention (lane-segment arithmetic; see module docstring) ----
@@ -161,6 +163,20 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     q_row = qkv[:, :hn]
     k_t = qkv[:, hn:hn + kn]
     v_t = qkv[:, hn + kn:]
+    if "rope_cos_q" in r:
+        # RoPE as lane arithmetic: rope(x) = x ⊙ [cos,cos] +
+        # swap_halves(x) ⊙ [sin,sin], where swap_halves is the constant
+        # per-head [[0, I], [-I, 0]] matmul (r["rope_swap_*"]) — the same
+        # no-lane-reshape trick as the segment matrices.  Without GQA the
+        # k tables are byte-identical to the q tables, so they are only
+        # passed (and streamed) separately when KVH != H.
+        q_row = (q_row * r["rope_cos_q"][...]
+                 + mmc(q_row.astype(cd), r["rope_swap_q"][...])
+                 * r["rope_sin_q"][...])
+        side = "k" if "rope_cos_k" in r else "q"
+        k_t = (k_t * r[f"rope_cos_{side}"][...]
+               + mmc(k_t.astype(cd), r[f"rope_swap_{side}"][...])
+               * r[f"rope_sin_{side}"][...])
     k_new[0] = k_t.astype(cache_dtype)
     v_new[0] = v_t.astype(cache_dtype)
 
@@ -169,8 +185,6 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     #   reduce per head:     a (·, H·Dh) @ segm (H·Dh, H) -> (·, H)
     #   broadcast per head:  a (·, H)    @ segb (H, H·Dh) -> (·, H·Dh)
     #   GQA lane expand:     a (·, KVH·Dh) @ expm (KVH·Dh, H·Dh)
-    mmc = lambda a, b: jax.lax.dot_general(
-        a, b, (((1,), (0,)), ((), ())), preferred_element_type=f32)
     segm, segb = r["segm"][...], r["segb"][...]
     expand = ((lambda a: a) if g == 1
               else (lambda a: mmc(a, r["expm"][...]).astype(cd)))
@@ -209,7 +223,7 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
 
 
 def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
-                      interpret=None):
+                      rope_cos=None, rope_sin=None, interpret=None):
     """One token through the whole layer stack as a single ``pallas_call``.
 
     pack: ``fused_decode_pack`` output; cache_k/v: row-major
@@ -217,6 +231,9 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     scalar int32 position of this token (its row in the cache is written by
     the CALLER from the returned k/v — the kernel only reads strictly-older
     rows and folds the current token in online-softmax style).
+    ``rope_cos``/``rope_sin``: fp32 (Dh//2,) angle tables for THIS position
+    (``nn.rope.rope_angles(pos, Dh)``) — when given, q and the new k are
+    rotated in-kernel (split-half convention, matching ``apply_rope``).
 
     Returns (x_out (1, D), k_new (L, 1, KVH·Dh), v_new (L, 1, KVH·Dh)).
     """
@@ -257,6 +274,32 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         keys.append("expm")
         args.append(expm)
         in_specs.append(pl.BlockSpec((kn, hn), lambda l: (0, 0)))
+    if rope_cos is not None:
+        half = hd // 2
+        # per-head swap-halves with sign: out[h·Dh+i] = -x[h·Dh+i+half]
+        # for i < half, +x[h·Dh+i-half] for i >= half
+        def swap_matrix(n_lanes):
+            i, j = lane((n_lanes, n_lanes), 0), lane((n_lanes, n_lanes), 1)
+            same_head = (i // hd) == (j // hd)
+            ii, jj = i % hd, j % hd
+            up = same_head & (jj < half) & (ii == jj + half)     # -x2 -> x1'
+            lo = same_head & (jj >= half) & (ii == jj - half)    # +x1 -> x2'
+            return (jnp.where(lo, 1.0, 0.0)
+                    - jnp.where(up, 1.0, 0.0)).astype(compute_dtype)
+
+        doubled = jnp.concatenate([rope_cos, rope_cos]).astype(jnp.float32)
+        sdoubled = jnp.concatenate([rope_sin, rope_sin]).astype(jnp.float32)
+        sides = [("q", nh)] + ([("k", kvh)] if kvh != nh else [])
+        for suffix, reps in sides:
+            keys += [f"rope_cos_{suffix}", f"rope_sin_{suffix}",
+                     f"rope_swap_{suffix}"]
+            args += [jnp.tile(doubled, reps)[None],
+                     jnp.tile(sdoubled, reps)[None],
+                     swap_matrix(reps * hd)]
+            n_l = reps * hd
+            in_specs += [pl.BlockSpec((1, n_l), lambda l: (0, 0)),
+                         pl.BlockSpec((1, n_l), lambda l: (0, 0)),
+                         pl.BlockSpec((n_l, n_l), lambda l: (0, 0))]
     for name, arr in pack.items():
         keys.append(name)
         args.append(arr)

@@ -51,15 +51,19 @@ _SLOW_TESTS = (
     "tests/test_bert.py::TestBert::test_fixed_k_loss_trains",
     "tests/test_bert.py::TestBert::test_loss_decreases",
     "tests/test_bert.py::TestBert::test_masking_respects_pad_mask",
+    "tests/test_bert.py::TestBert::test_unrolled_layer_loop",
     "tests/test_bert_pretrain.py::TestBertPretrainCLI",
     "tests/test_bert_pretrain.py::TestRemat",
     "tests/test_checkpoint.py::TestTrainerResume::test_crash_resume",
+    "tests/test_checkpoint.py::TestTrainerResume::test_resume_past",
     "tests/test_checkpoint.py::TestTrainerResume::test_second_fit",
     "tests/test_decode_kernel.py::TestFusedDecode::test_gqa_swiglu",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_greedy_matches",
     "tests/test_decode_kernel.py::TestFusedDecode::test_int8_fused",
     "tests/test_decode_kernel.py::TestFusedDecode::test_sampled_matches",
     "tests/test_gpt.py::TestGPTModel::test_1f1b_grads_match_dense_path",
     "tests/test_gpt.py::TestGPTModel::test_chunked_loss_matches_dense",
+    "tests/test_gpt.py::TestGPTModel::test_remat_matches",
     "tests/test_gpt.py::TestGPTModel::test_int8_decode",
     "tests/test_gpt.py::TestGPTModel::test_loss_decreases_in_training",
     "tests/test_gpt.py::TestGPTModel::test_pipelined_decoder_matches_scan",
@@ -68,6 +72,7 @@ _SLOW_TESTS = (
     "tests/test_gpt.py::TestGeneration::test_sampling_deterministic",
     "tests/test_llama_style.py::TestLabelSmoothing",
     "tests/test_llama_style.py::TestLlamaStyleModel::test_greedy_decode",
+    "tests/test_llama_style.py::TestLlamaStyleModel::test_remat_matches",
     "tests/test_llama_style.py::TestLlamaStyleModel::test_tensor_parallel",
     "tests/test_llama_style.py::TestLlamaStyleModel::test_trains",
     "tests/test_moe.py::TestMoE::test_balanced_router_aux_near_one",
@@ -82,17 +87,32 @@ _SLOW_TESTS = (
     "tests/test_pipeline.py::Test1F1B::test_matches_unpipelined_grads",
     "tests/test_pipeline.py::TestBert1F1B",
     "tests/test_pipeline.py::TestPipeline::test_backward_pipeline_grads",
+    "tests/test_pipeline.py::TestPipeline::test_composes_with_data_axis",
+    "tests/test_pipeline.py::TestPipeline::test_ctx_routes",
     "tests/test_pipeline.py::TestPipeline::test_matches_sequential",
     "tests/test_preemption.py::TestPreemptedRun::test_sigterm_checkpoints",
+    "tests/test_ring_attention.py::TestRingAttention::test_bf16",
+    "tests/test_ring_attention.py::TestRingAttention::test_composes",
     "tests/test_ring_attention.py::TestRingAttention::test_grads_flow",
-    "tests/test_ring_attention.py::TestRingInMHA::test_bert_with_ring",
+    "tests/test_ring_attention.py::TestRingAttention::test_impl_accepts",
+    "tests/test_ring_attention.py::TestRingAttention"
+    "::test_kv_mask_matches_full_attention",
+    "tests/test_ring_attention.py::TestRingAttention"
+    "::test_matches_full_attention",
+    "tests/test_ring_attention.py::TestRingInMHA",
     "tests/test_sampling.py::TestGenerateIntegration",
+    "tests/test_t5.py::Test1F1B",
     "tests/test_t5.py::TestGeneration::test_greedy_matches_teacher",
+    "tests/test_t5.py::TestGeneration::test_sampling_deterministic",
     "tests/test_t5.py::TestPipelined",
     "tests/test_t5.py::TestTraining",
     "tests/test_trainer.py::TestGradAccumulation::test_stateful_model",
     "tests/test_trainer.py::TestTrainerEndToEnd::test_metrics_csv",
+    "tests/test_ulysses_attention.py::TestUlyssesAttention::test_bf16",
     "tests/test_ulysses_attention.py::TestUlyssesAttention::test_grads",
+    "tests/test_ulysses_attention.py::TestUlyssesAttention::test_impl",
+    "tests/test_ulysses_attention.py::TestUlyssesAttention"
+    "::test_matches_full_attention",
     "tests/test_ulysses_attention.py::TestUlyssesInModels",
 )
 

@@ -83,8 +83,11 @@ class TestFusedDecode:
         with pytest.raises(ValueError, match="single-stream"):
             m.generate(p, pr, 4, fused=True)
 
-    def test_rope_rejected(self):
-        m, p = mk(rope=True)
+    def test_rope_llama_style_matches_unfused(self):
+        """Full LLaMA-style wiring (RoPE in-kernel via the swap-halves
+        constant matmul + GQA + SwiGLU) through the fused kernel."""
+        m, p = mk(rope=True, num_kv_heads=2, mlp_act="swiglu")
         pr = prompt_of(m)
-        with pytest.raises(ValueError, match="RoPE"):
-            m.generate(p, pr, 4, fused=True)
+        a = m.generate(p, pr, 10, temperature=0.0)
+        b = m.generate(p, pr, 10, temperature=0.0, fused=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
