@@ -766,7 +766,7 @@ class GPT(Module):
         ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
         op count drops from ~170 to ~12, attacking the measured
         op-latency floor of the unfused loop (BASELINE.md round 2).
-        Single-stream (B=1); the cache runs head-major (L, KVH, T, Dh) and
+        Single-stream (B=1); the cache runs row-major (L, T, KVH·Dh) and
         the kernel's k/v outputs are written back with one
         ``dynamic_update_slice`` per token."""
         from dtf_tpu.nn.sampling import sample_token

@@ -461,6 +461,28 @@ class T5(Module):
         loss, aux = self.loss(params, batch, train=False)
         return {"loss": loss, **aux}
 
+    def train_flops_per_example(self, params) -> float:
+        """Honest 6·P·tokens for an encoder-decoder: each stack's params
+        only process THEIR side's tokens (6·P_total·(S+T) would roughly
+        double-count).  Split: encoder params × S, decoder params × T —
+        except the cross-attention K/V projections, which run on the S
+        encoder positions — plus the tied vocab head × T.  Uses the
+        configured max lengths (the benchmark drives full-length
+        batches)."""
+        from dtf_tpu.nn.core import count_params
+        cfg = self.cfg
+        s_len, t_len = cfg.max_src_len, cfg.max_tgt_len
+        p_enc = count_params(params["enc_layers"]) + count_params(
+            params["ln_enc"])
+        dec = params["dec_layers"]
+        p_cross_kv = count_params(dec["cross_attn"]["k"]) + count_params(
+            dec["cross_attn"]["v"])
+        p_dec = (count_params(dec) - p_cross_kv
+                 + count_params(params["ln_dec"]))
+        p_head = cfg.dim * cfg.vocab_size        # tied table as the head
+        return 6.0 * (p_enc * s_len + p_cross_kv * s_len
+                      + (p_dec + p_head) * t_len)
+
     # --- 1F1B pipelined training --------------------------------------
 
     @property

@@ -70,19 +70,32 @@ def main(argv=None) -> int:
 
     def batch_at(i):
         # per-index rng: deterministic, identical on every process (the
-        # multi-host contract of put_global_batch)
+        # multi-host contract of put_global_batch).  Sequences are padded
+        # to the model's max length so the FLOPs accounting
+        # (T5.train_flops_per_example, billed at max_src/tgt_len) matches
+        # the positions actually processed — pads are masked in the loss
+        # and the encoder attention but still run through the matmuls.
         r = np.random.default_rng(train_cfg.seed * 100003 + i)
         src = r.integers(2, cfg.vocab_size, (bs, ns.seq_len)).astype(
             np.int32)
         tgt = src[:, ::-1].copy() if ns.task == "reverse" else src
+        pad = cfg.max_src_len - ns.seq_len
+        if pad:
+            src = np.pad(src, ((0, 0), (0, pad)),
+                         constant_values=cfg.pad_id)
+            tgt = np.pad(tgt, ((0, 0), (0, pad)),
+                         constant_values=cfg.pad_id)
         return {"src": src, "tgt": tgt}
 
-    # shared timing/warmup/sharding methodology (workloads/_driver.py);
-    # enc sees seq_len tokens and dec seq_len more -> 2x for the MFU formula
+    # shared timing/warmup/sharding methodology (workloads/_driver.py).
+    # MFU accounting comes from T5.train_flops_per_example (each stack's
+    # params x its own side's tokens — 6·P_total·2T would double-count);
+    # the flops_tokens value below is only the fallback for models
+    # without the method.
     state, m, _ = pretrain_benchmark(
         cluster, logger, model, train_cfg, batch_at, ns.steps,
         tokens_per_example=1, throughput_unit="seq",
-        flops_tokens_per_example=2 * ns.seq_len)
+        flops_tokens_per_example=ns.seq_len)
     if "accuracy" in m:           # 1F1B reduces only the loss
         logger.print(f"Teacher-forced accuracy: {float(m['accuracy']):.4f}")
     rng = np.random.default_rng(train_cfg.seed + 999)

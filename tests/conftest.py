@@ -59,6 +59,7 @@ _SLOW_TESTS = (
     "tests/test_checkpoint.py::TestTrainerResume::test_second_fit",
     "tests/test_decode_kernel.py::TestFusedDecode::test_gqa_swiglu",
     "tests/test_decode_kernel.py::TestFusedDecode::test_greedy_matches",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_rope_llama",
     "tests/test_decode_kernel.py::TestFusedDecode::test_int8_fused",
     "tests/test_decode_kernel.py::TestFusedDecode::test_sampled_matches",
     "tests/test_gpt.py::TestGPTModel::test_1f1b_grads_match_dense_path",
