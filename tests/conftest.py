@@ -65,6 +65,7 @@ _SLOW_TESTS = (
     "tests/test_gpt.py::TestGPTModel::test_1f1b_grads_match_dense_path",
     "tests/test_gpt.py::TestGPTModel::test_chunked_loss_matches_dense",
     "tests/test_gpt.py::TestGPTModel::test_remat_matches",
+    "tests/test_gpt.py::TestGPTModel::test_unrolled_layer_loop",
     "tests/test_gpt.py::TestGPTModel::test_int8_decode",
     "tests/test_gpt.py::TestGPTModel::test_loss_decreases_in_training",
     "tests/test_gpt.py::TestGPTModel::test_pipelined_decoder_matches_scan",

@@ -167,6 +167,27 @@ class TestGPTModel:
         assert losses[-1] < losses[0]
         assert np.isfinite(m["perplexity"])
 
+    def test_unrolled_layer_loop_matches_scan(self):
+        """GPT's layer_loop='unroll' + remat_policy='attn' (the
+        benchmark-fast path) must produce the scanned default's loss and
+        gradients."""
+        ms = GPT(GPTConfig.tiny())
+        mu = GPT(GPTConfig.tiny(layer_loop="unroll", remat=True,
+                                remat_policy="attn"))
+        p = ms.init(jax.random.key(0))
+        toks = jnp.asarray(
+            np.random.default_rng(3).integers(0, 128, (2, 16)), jnp.int32)
+        (ls, _), gs = jax.value_and_grad(
+            lambda q: ms.loss(q, toks), has_aux=True)(p)
+        (lu, _), gu = jax.value_and_grad(
+            lambda q: mu.loss(q, toks), has_aux=True)(p)
+        assert float(ls) == pytest.approx(float(lu), rel=1e-6)
+        for (path, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(gs),
+                jax.tree_util.tree_leaves_with_path(gu)):
+            np.testing.assert_allclose(a, b, atol=1e-5,
+                                       err_msg=jax.tree_util.keystr(path))
+
     def test_remat_matches(self):
         cfg_a, cfg_b = GPTConfig.tiny(), GPTConfig.tiny(remat=True)
         ma, mb = GPT(cfg_a), GPT(cfg_b)
