@@ -84,6 +84,64 @@ class StepWindowProfiler:
         self.done = True
 
 
+def summarize_trace(logdir: str, top: int = 20) -> list:
+    """Aggregate device-op wall time from a captured XLA trace.
+
+    Reads the ``*.trace.json.gz`` Chrome-trace file that
+    ``jax.profiler.stop_trace`` leaves under
+    ``logdir/plugins/profile/<run>/`` and returns ``[(op_name,
+    total_seconds), ...]`` for device-side ops, largest first — the tool
+    that located round 3's MFU eaters (the scan-stacked
+    dynamic-update-slice fusions; BASELINE.md).  Durations are summed
+    over all occurrences and all device lanes, so a multi-step window
+    reports per-window totals (divide by the step count yourself).
+
+    The reference's only observability was wall-clock prints around
+    ``sess.run`` (tf_distributed.py:116-122); this closes the loop from
+    "the step is slow" to "THIS op is slow".
+    """
+    import glob
+    import gzip
+    import json
+    import os
+    from collections import defaultdict
+
+    paths = sorted(glob.glob(
+        os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {logdir}/plugins/profile/ — did the "
+            f"trace window run and stop_trace() execute?")
+    run_dir = os.path.dirname(paths[-1])     # newest run, EVERY host's file
+    total = defaultdict(float)
+    for path in (p for p in paths if os.path.dirname(p) == run_dir):
+        with gzip.open(path) as f:
+            tr = json.load(f)
+        events = tr.get("traceEvents", [])
+        device_pids, op_lanes = set(), set()
+        for e in events:
+            if e.get("ph") != "M":
+                continue
+            label = e.get("args", {}).get("name", "")
+            if (e.get("name") == "process_name"
+                    and ("TPU" in label or "/device" in label)):
+                device_pids.add(e["pid"])
+            # jax device traces stack several lanes per pid whose spans
+            # COVER each other ("Steps" ⊃ "XLA Modules" ⊃ "XLA Ops");
+            # summing all of them would double-count 2-3x, so restrict to
+            # the per-op lane when the trace labels one.
+            if e.get("name") == "thread_name" and "XLA Ops" in label:
+                op_lanes.add((e["pid"], e.get("tid")))
+        for e in events:
+            if (e.get("ph") != "X" or "dur" not in e
+                    or e.get("pid") not in device_pids):
+                continue
+            if op_lanes and (e["pid"], e.get("tid")) not in op_lanes:
+                continue
+            total[e.get("name", "?")] += e["dur"] / 1e6
+    return sorted(total.items(), key=lambda kv: -kv[1])[:top]
+
+
 def fingerprint(tree: Any) -> np.ndarray:
     """Order-stable 32-bit digest of a pytree of arrays.
 

@@ -65,3 +65,54 @@ class TestTraceHook:
             found += [f for f in files if f.endswith((".trace.json.gz",
                                                       ".xplane.pb"))]
         assert found, "trainer profile window produced no trace"
+
+
+class TestSummarizeTrace:
+    def test_aggregates_device_ops(self, tmp_path):
+        """summarize_trace sums device-pid op durations and ignores host
+        events — validated on a synthetic Chrome-trace file in the layout
+        jax.profiler writes."""
+        import gzip
+        import json
+
+        from dtf_tpu.utils.profiling import summarize_trace
+
+        run = tmp_path / "plugins" / "profile" / "2026_01_01"
+        run.mkdir(parents=True)
+        events = [
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "pid": 7, "name": "process_name"},  # no args: skip
+            # device pid stacks covering lanes; only "XLA Ops" counts
+            {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "M", "pid": 3, "tid": 2, "name": "thread_name",
+             "args": {"name": "XLA Modules"}},
+            {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1",
+             "dur": 2_000_000},
+            {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1",
+             "dur": 1_000_000},
+            {"ph": "X", "pid": 3, "tid": 1, "name": "copy.2",
+             "dur": 500_000},
+            {"ph": "X", "pid": 3, "tid": 2, "name": "jit_step",
+             "dur": 3_500_000},              # module span covers the ops
+            {"ph": "X", "pid": 9, "name": "host_thing", "dur": 9_000_000},
+        ]
+        with gzip.open(run / "vm.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+        rows = summarize_trace(str(tmp_path))
+        assert rows[0] == ("fusion.1", 3.0)
+        assert rows[1] == ("copy.2", 0.5)
+        names = [n for n, _ in rows]
+        assert "host_thing" not in names       # host pid excluded
+        assert "jit_step" not in names         # covering lane excluded
+
+    def test_missing_trace_raises(self, tmp_path):
+        import pytest as _pytest
+
+        from dtf_tpu.utils.profiling import summarize_trace
+        with _pytest.raises(FileNotFoundError, match="trace.json.gz"):
+            summarize_trace(str(tmp_path))
