@@ -131,7 +131,23 @@ def run_matmul_bench(cfg: MatmulBenchConfig) -> dict:
     ladder = sorted({max(2, longest >> i) for i in range(cfg.ladder_points)})
 
     steps = {k: build_step(mesh, cfg.n, cfg.dtype, k)[0] for k in ladder}
-    fit = time_linfit(lambda k: (lambda: steps[k](a, b)), ladder, reps=cfg.reps)
+
+    # Vary the operand each call: the axon relay MEMOIZES repeat
+    # executions with bitwise-identical inputs (returns ~instantly,
+    # discovered round 3 — BASELINE.md "timing methodology correction"),
+    # which would corrupt best-of-reps timing.  The factor must be
+    # EXACTLY representable in the operand dtype or the cast makes it a
+    # bitwise no-op (bf16 rounds 1 + k·1e-7 back to 1.0): 1 + k/64 is
+    # exact in bf16/fp32 and distinct for 63 consecutive calls.  The
+    # scale is a separate eagerly-dispatched op whose constant cost the
+    # linfit intercept absorbs.
+    counter = [0]
+
+    def call(k):
+        counter[0] += 1
+        return steps[k](a * (1.0 + (counter[0] % 63) * 2.0 ** -6), b)
+
+    fit = time_linfit(lambda k: (lambda: call(k)), ladder, reps=cfg.reps)
 
     n_chips = mesh.size
     flops_per_chip = flop / fit.per_iter_s / n_chips

@@ -93,8 +93,11 @@ def summarize_trace(logdir: str, top: int = 20) -> list:
     total_seconds), ...]`` for device-side ops, largest first — the tool
     that located round 3's MFU eaters (the scan-stacked
     dynamic-update-slice fusions; BASELINE.md).  Durations are summed
-    over all occurrences and all device lanes, so a multi-step window
-    reports per-window totals (divide by the step count yourself).
+    over all occurrences and every host's file in the run, restricted to
+    each device pid's "XLA Ops" lane when the trace labels one (the
+    Steps/Modules lanes cover the same wall time and would double-count
+    2-3x); a multi-step window reports per-window totals (divide by the
+    step count yourself).
 
     The reference's only observability was wall-clock prints around
     ``sess.run`` (tf_distributed.py:116-122); this closes the loop from
@@ -132,11 +135,15 @@ def summarize_trace(logdir: str, top: int = 20) -> list:
             # the per-op lane when the trace labels one.
             if e.get("name") == "thread_name" and "XLA Ops" in label:
                 op_lanes.add((e["pid"], e.get("tid")))
+        # lane filter is PER PID: a device pid without a labeled op lane
+        # keeps all its events (don't let one labeled pid hide another)
+        lane_pids = {pid for pid, _ in op_lanes}
         for e in events:
             if (e.get("ph") != "X" or "dur" not in e
                     or e.get("pid") not in device_pids):
                 continue
-            if op_lanes and (e["pid"], e.get("tid")) not in op_lanes:
+            if (e["pid"] in lane_pids
+                    and (e["pid"], e.get("tid")) not in op_lanes):
                 continue
             total[e.get("name", "?")] += e["dur"] / 1e6
     return sorted(total.items(), key=lambda kv: -kv[1])[:top]
