@@ -1,0 +1,138 @@
+"""fp-vs-int8 decode-quality measurement (BASELINE.md round 3).
+
+Applies the decode path's per-output-channel int8 quantization
+(`ops.decode_kernel.quantize_cols`, the one definition shared by fused and
+unfused ``--decode_int8``) to a dequantized copy of the GPT weights, then
+reports the teacher-forced perplexity ratio and the greedy-decode
+agreement against the fp weights.  The quantization-noise numbers are
+device-independent — the same dequantized weights produce the same
+logits — so this runs anywhere; the throughput rows in BASELINE.md are
+what need the chip.
+
+This harness is a conservative UPPER BOUND on the deployed path's
+damage, for two documented reasons: (a) the q·scale product is re-rounded
+to the param dtype (one extra bf16 rounding the deployed
+``(x @ w8)·fp32_scale`` form avoids), and (b) quantizing the tied token
+table also perturbs the input-embedding lookup, which the deployed path
+keeps in fp (only the head-side copy is quantized in ``_decode_pack``).
+Both effects ADD noise here, so a near-1.0 perplexity ratio from this
+harness implies at-least-as-good deployed quality.
+
+    python -m dtf_tpu.bench.int8_quality [--preset gpt2_small]
+        [--batch 8] [--seq 512] [--gen 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def dequantized_params(params):
+    """params with every decode-quantized operand replaced by its
+    dequantize(quantize(w)) round trip: qkv / o / fc1 / fc2(, gate) and
+    the tied vocab head, per ``GPT._decode_pack``'s contract (see the
+    module docstring for the two upper-bound caveats)."""
+    import jax.numpy as jnp
+
+    from dtf_tpu.ops.decode_kernel import quantize_cols
+
+    def dq(w):
+        q, s = quantize_cols(w)
+        return (q.astype(jnp.float32) * s).astype(w.dtype)
+
+    lay = dict(params["layers"])
+    attn = dict(lay["attn"])
+    for k in ("q", "k", "v"):
+        e = dict(attn[k])
+        n_l, d = e["w"].shape[0], e["w"].shape[1]
+        e["w"] = dq(e["w"].reshape(n_l, d, -1)).reshape(e["w"].shape)
+        attn[k] = e
+    e = dict(attn["o"])
+    n_l, d = e["w"].shape[0], e["w"].shape[-1]
+    e["w"] = dq(e["w"].reshape(n_l, -1, d)).reshape(e["w"].shape)
+    attn["o"] = e
+    lay["attn"] = attn
+    for k in ("fc1", "fc2", "fc_gate"):
+        if k in lay:
+            e = dict(lay[k])
+            e["w"] = dq(e["w"])
+            lay[k] = e
+    out = dict(params)
+    out["layers"] = lay
+    tok = dict(out["tok"])
+    tok["table"] = dq(tok["table"].T).T
+    out["tok"] = tok
+    return out
+
+
+def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
+        gen: int = 256, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtf_tpu.data.datasets import synthetic_text
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = {"gpt2_small": GPTConfig.gpt2_small,
+           "llama": GPTConfig.llama_style,
+           "tiny": GPTConfig.tiny}[preset](dtype=jnp.bfloat16,
+                                           max_len=max(seq, gen + 8))
+    model = GPT(cfg)
+    params = model.init(jax.random.key(seed))
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
+                                    params)
+    p8 = jax.jit(dequantized_params)(params)
+
+    toks = jnp.asarray(synthetic_text(batch, seq, cfg.vocab_size,
+                                      seed=seed + 9))
+    loss_fn = jax.jit(lambda p, t: model.loss(p, {"tokens": t})[0])
+    l_fp = float(loss_fn(params, toks))
+    l_i8 = float(loss_fn(p8, toks))
+
+    prompt = toks[:1, :8]
+    g = jax.jit(lambda p, pr: model.generate(p, pr, gen, temperature=0.0))
+    a = np.asarray(g(params, prompt))
+    b = np.asarray(g(p8, prompt))
+    agree = float((a[0, 8:] == b[0, 8:]).mean())
+    div = int(np.argmax(a[0, 8:] != b[0, 8:])) if agree < 1.0 else gen
+    return {
+        "tokens_scored": batch * (seq - 1),
+        "loss_fp": l_fp, "loss_int8": l_i8,
+        "ppl_ratio": float(np.exp(l_i8 - l_fp)),
+        "greedy_agreement": agree,
+        "first_divergence": div,
+        "gen_tokens": gen,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--preset", default="gpt2_small",
+                        choices=["gpt2_small", "llama", "tiny"])
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=512)
+    parser.add_argument("--gen", type=int, default=256)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (reliable even when "
+                             "a TPU plugin is registered: jax.config "
+                             "beats the env var — see "
+                             ".claude/skills/verify)")
+    ns = parser.parse_args(argv)
+    if ns.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    r = run(ns.preset, ns.batch, ns.seq, ns.gen, ns.seed)
+    print(f"tokens scored: {r['tokens_scored']}")
+    print(f"fp loss {r['loss_fp']:.6f}   int8 loss {r['loss_int8']:.6f}")
+    print(f"perplexity ratio {r['ppl_ratio']:.6f} "
+          f"({(r['ppl_ratio'] - 1) * 100:+.4f}%)")
+    print(f"greedy agreement over {r['gen_tokens']}: "
+          f"{r['greedy_agreement']:.4f} "
+          f"(first divergence at {r['first_divergence']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,3 +46,16 @@ class TestMatmulBench:
 
     def test_peak_table_unknown_device_none(self):
         assert peak_flops_per_chip(jax.devices()[0]) is None  # CPU
+
+
+class TestInt8Quality:
+    def test_tiny_ppl_ratio_near_one(self):
+        """The decode quantization's perplexity damage is bounded: ratio
+        within ±2% on the tiny preset (measured ~0.9998; a broken
+        scale/dequant path lands far outside)."""
+        from dtf_tpu.bench.int8_quality import run
+
+        r = run("tiny", batch=4, seq=64, gen=16)
+        assert 0.98 < r["ppl_ratio"] < 1.02
+        assert r["tokens_scored"] == 4 * 63
+        assert 0.0 <= r["greedy_agreement"] <= 1.0
