@@ -704,7 +704,7 @@ class GPT(Module):
 
         ``fused=True`` routes each decode token through the single-
         ``pallas_call`` stack kernel (ops/decode_kernel.py) instead of the
-        op-per-op layer scan — single-stream (B=1) only; composes with
+        op-per-op layer scan — batches up to 8 streams; composes with
         ``int8_weights``.
         """
         from dtf_tpu.nn.sampling import sample_token
@@ -766,7 +766,7 @@ class GPT(Module):
         ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
         op count drops from ~170 to ~12, attacking the measured
         op-latency floor of the unfused loop (BASELINE.md round 2).
-        Single-stream (B=1); the cache runs row-major (L, T, KVH·Dh) and
+        Up to 8 streams; the cache runs row-major (L, B, T, KVH·Dh) and
         the kernel's k/v outputs are written back with one
         ``dynamic_update_slice`` per token."""
         from dtf_tpu.nn.sampling import sample_token
@@ -775,11 +775,12 @@ class GPT(Module):
 
         cfg = self.cfg
         b, p_len = prompt.shape
-        if b != 1:
-            raise ValueError(f"fused decode is single-stream (B=1); got "
-                             f"batch {b} — use the default path (the "
-                             f"batched loop already amortizes weight "
-                             f"streaming)")
+        if b > 8:
+            raise ValueError(f"fused decode batches at most 8 streams "
+                             f"(got {b}) — use the default path beyond "
+                             f"that (the op-per-op loop already "
+                             f"amortizes weight streaming at large "
+                             f"batch)")
         if cfg.pipeline_mesh is not None:
             raise ValueError("fused decode does not compose with pipeline "
                              "parallelism")
@@ -787,10 +788,10 @@ class GPT(Module):
 
         cache, logits = self._prefill_cache(params, prompt,
                                             self._cache_len(total))
-        # single-stream row-major cache: (L, 1, T, KVH, Dh) -> (L, T, KVH·Dh)
+        # row-major cache: (L, B, T, KVH, Dh) -> (L, B, T, KVH·Dh)
         n_l, _, t_c = cache["k"].shape[:3]
-        ck = cache["k"][:, 0].reshape(n_l, t_c, -1)
-        cv = cache["v"][:, 0].reshape(n_l, t_c, -1)
+        ck = cache["k"].reshape(n_l, b, t_c, -1)
+        cv = cache["v"].reshape(n_l, b, t_c, -1)
 
         rng, sub = jax.random.split(rng)
         first = sample_token(sub, logits, temperature=temperature,
@@ -807,7 +808,7 @@ class GPT(Module):
         def step(carry, pos):
             out, ck, cv, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
-            x = self._embed(params, tok, pos[None])[:, 0, :]     # (1, D)
+            x = self._embed(params, tok, pos[None])[:, 0, :]     # (B, D)
             rope_kw = {}
             if cfg.rope:
                 from dtf_tpu.nn.rope import rope_angles
@@ -815,8 +816,10 @@ class GPT(Module):
                 rope_kw = {"rope_cos": cos, "rope_sin": sin}
             x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
                                                 **rope_kw)
-            ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0))
-            cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0))
+            ck = lax.dynamic_update_slice(ck, k_new[:, :, None, :],
+                                          (0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(cv, v_new[:, :, None, :],
+                                          (0, 0, pos, 0))
             h = self.ln_f.apply(params["ln_f"], x[:, None, :])
             if head_q is not None:
                 logits = _dequant_matmul(h, head_q[0], head_q[1],

@@ -1,5 +1,5 @@
-"""Fused single-stream decode: the whole transformer stack as ONE Pallas
-kernel per token.
+"""Fused decode: the whole transformer stack as ONE Pallas kernel per
+token, for 1-8 simultaneous streams.
 
 Why: KV-cache decode at B=1 is op-latency-bound, not bandwidth-bound — the
 unfused loop issues ~170 tiny XLA ops per token (measured ~1.04 ms/token vs
@@ -24,10 +24,10 @@ Design (all control flow static — Mosaic-friendly):
   softmax reduces over the sublane (T) dim, and ``P·V`` is the reverse
   broadcast-multiply reduced over T — all VPU work on arrays that already
   sit in VMEM, no per-head slicing of matmul operands.
-* The KV cache is read-only input, row-major (L, T, KVH·Dh).  The current
+* The KV cache is read-only input, row-major (L, B, T, KVH·Dh).  The current
   token's k/v never touch the cache inside the kernel: its attention term
   is folded in online-softmax style (separate self-score joined at the
-  max/denominator), and the (L, 1, KVH·Dh) k/v outputs are written into
+  max/denominator), and the (L, B, KVH·Dh) k/v outputs are written into
   the cache by ONE ``dynamic_update_slice`` per token outside — writing
   only the row instead of round-tripping an aliased cache block.
 * int8 mode: every matmul operand streams from HBM as int8 with a
@@ -110,7 +110,7 @@ def fused_decode_pack(params, cfg, int8: bool = False) -> dict:
 
 
 def _ln(x, scale_ref, bias_ref, eps=1e-6):
-    """LayerNorm of (1, D) fp32 x with (1, 1, D) param refs."""
+    """LayerNorm of (B, D) fp32 x (row-wise) with (1, 1, D) param refs."""
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
@@ -133,7 +133,8 @@ def _mm(x_c, w_ref, sc_ref, idx, compute_dtype):
 
 
 def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
-                   mlp_act, compute_dtype, cache_dtype, out_dtype, eps):
+                   batch, mlp_act, compute_dtype, cache_dtype, out_dtype,
+                   eps):
     n_in = len(keys)
     r = dict(zip(keys, refs[:n_in]))
     x_out, k_new, v_new = refs[n_in:n_in + 3]
@@ -148,7 +149,7 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     def _init():
         x_s[...] = r["x"][...].astype(jnp.float32)
 
-    x = x_s[...]                                       # (1, D) f32
+    x = x_s[...]                                       # (B, D) f32
     sc = lambda name: r.get(name + "_sc")
     mm = lambda h, name: _mm(h, r[name], sc(name), 0, cd)
     f32 = jnp.float32
@@ -158,8 +159,8 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
 
     # --- attention (lane-segment arithmetic; see module docstring) ----
     hb = _ln(x, r["ln1_s"], r["ln1_b"], eps).astype(cd)
-    t_cache = r["kc"].shape[1]
-    qkv = mm(hb, "w_qkv") + r["b_qkv"][0].astype(f32)  # (1, (H+2KVH)·Dh)
+    t_cache = r["kc"].shape[2]
+    qkv = mm(hb, "w_qkv") + r["b_qkv"][0].astype(f32)  # (B, (H+2KVH)·Dh)
     q_row = qkv[:, :hn]
     k_t = qkv[:, hn:hn + kn]
     v_t = qkv[:, hn + kn:]
@@ -188,23 +189,52 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     segm, segb = r["segm"][...], r["segb"][...]
     expand = ((lambda a: a) if g == 1
               else (lambda a: mmc(a, r["expm"][...]).astype(cd)))
-
-    kc = expand(r["kc"][0].astype(cd))                 # (T, H·Dh)
-    vc = expand(r["vc"][0].astype(cd))
     q_c = q_row.astype(cd)
-    s = mmc(kc * q_c, segm) * scale                    # (T, H) f32
-    visible = (jax.lax.broadcasted_iota(jnp.int32, (t_cache, 1), 0)
-               < pos)                                  # strictly-older rows
-    s = jnp.where(visible, s, NEG_BIG)
-    s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale    # (1, H)
-    m = jnp.maximum(jnp.max(s, axis=0, keepdims=True), s_self)
-    p = jnp.exp(s - m)                                 # (T, H) f32
-    p_self = jnp.exp(s_self - m)
-    denom = jnp.sum(p, axis=0, keepdims=True) + p_self # (1, H)
-    pv = mmc(p.astype(cd), segb).astype(cd) * vc       # (T, H·Dh)
-    o_row = jnp.sum(pv, axis=0, keepdims=True, dtype=f32)
-    o_row = o_row + mmc(p_self.astype(cd), segb) * expand(v_t.astype(cd))
-    o_row = o_row * mmc((1.0 / denom).astype(cd), segb)
+    s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale    # (B, H)
+
+    if batch == 1:
+        # Deliberate specialization for the single-stream latency headline:
+        # rank-2 arrays, no (B·T) reshape round-trips.  Keep in sync with
+        # the general branch below (tests cover both at every config).
+        kc = expand(r["kc"][0, 0].astype(cd))          # (T, H·Dh)
+        vc = expand(r["vc"][0, 0].astype(cd))
+        s = mmc(kc * q_c, segm) * scale                # (T, H) f32
+        visible = (jax.lax.broadcasted_iota(jnp.int32, (t_cache, 1), 0)
+                   < pos)                              # strictly-older rows
+        s = jnp.where(visible, s, NEG_BIG)
+        m = jnp.maximum(jnp.max(s, axis=0, keepdims=True), s_self)
+        p = jnp.exp(s - m)                             # (T, H) f32
+        p_self = jnp.exp(s_self - m)
+        denom = jnp.sum(p, axis=0, keepdims=True) + p_self     # (1, H)
+        pv = mmc(p.astype(cd), segb).astype(cd) * vc   # (T, H·Dh)
+        o_row = jnp.sum(pv, axis=0, keepdims=True, dtype=f32)
+        o_row = (o_row
+                 + mmc(p_self.astype(cd), segb) * expand(v_t.astype(cd)))
+        o_row = o_row * mmc((1.0 / denom).astype(cd), segb)
+    else:
+        # Batched rows ride the leading (untiled) dims: per-row caches
+        # collapse (B, T, ·) -> (B·T, ·) for the segment matmuls and
+        # split back for the per-row softmax reductions — major-dim
+        # reshapes only, the lane dim never splits.
+        b = batch
+        kc2 = expand(r["kc"][0].astype(cd).reshape(b * t_cache, kn))
+        vc2 = expand(r["vc"][0].astype(cd).reshape(b * t_cache, kn))
+        q_rep = jnp.broadcast_to(
+            q_c[:, None, :], (b, t_cache, hn)).reshape(b * t_cache, hn)
+        s = mmc(kc2 * q_rep, segm).reshape(b, t_cache, num_heads) * scale
+        visible = (jax.lax.broadcasted_iota(
+            jnp.int32, (1, t_cache, 1), 1) < pos)
+        s = jnp.where(visible, s, NEG_BIG)
+        m = jnp.maximum(jnp.max(s, axis=1), s_self)    # (B, H)
+        p = jnp.exp(s - m[:, None, :])                 # (B, T, H)
+        p_self = jnp.exp(s_self - m)
+        denom = jnp.sum(p, axis=1) + p_self            # (B, H)
+        pv = (mmc(p.reshape(b * t_cache, num_heads).astype(cd), segb)
+              .astype(cd) * vc2)                       # (B·T, H·Dh)
+        o_row = jnp.sum(pv.reshape(b, t_cache, hn), axis=1, dtype=f32)
+        o_row = (o_row
+                 + mmc(p_self.astype(cd), segb) * expand(v_t.astype(cd)))
+        o_row = o_row * mmc((1.0 / denom).astype(cd), segb)
     x = x + mm(o_row.astype(cd), "w_o") + r["b_o"][0].astype(f32)
 
     # --- MLP ---------------------------------------------------------
@@ -227,26 +257,38 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     """One token through the whole layer stack as a single ``pallas_call``.
 
     pack: ``fused_decode_pack`` output; cache_k/v: row-major
-    (L, T, KVH·Dh) in the cache dtype; x: (1, D) embedded token; pos:
-    scalar int32 position of this token (its row in the cache is written by
-    the CALLER from the returned k/v — the kernel only reads strictly-older
-    rows and folds the current token in online-softmax style).
+    (L, B, T, KVH·Dh) in the cache dtype; x: (B, D) embedded tokens
+    (B <= 8 — one sublane tile; per-layer cache blocks outgrow VMEM
+    beyond that anyway); pos: scalar int32 position of this token (its
+    row in the cache is written by the CALLER from the returned k/v —
+    the kernel only reads strictly-older rows and folds the current
+    token in online-softmax style).
     ``rope_cos``/``rope_sin``: fp32 (Dh//2,) angle tables for THIS position
     (``nn.rope.rope_angles(pos, Dh)``) — when given, q and the new k are
     rotated in-kernel (split-half convention, matching ``apply_rope``).
 
-    Returns (x_out (1, D), k_new (L, 1, KVH·Dh), v_new (L, 1, KVH·Dh)).
+    Returns (x_out (B, D), k_new (L, B, KVH·Dh), v_new (L, B, KVH·Dh)).
     """
     if interpret is None:
         interpret = _interpret_default()
-    n_layers, t_cache, kn = cache_k.shape
+    n_layers, b, t_cache, kn = cache_k.shape
     nh = cfg.num_heads
     kvh = cfg.num_kv_heads or nh
     hd = kn // kvh
     d = cfg.dim
-    if x.shape != (1, d):
-        raise ValueError(f"fused decode is single-stream: x must be (1, "
-                         f"{d}), got {x.shape}")
+    if x.shape != (b, d):
+        raise ValueError(f"x must be ({b}, {d}) to match the cache's "
+                         f"batch dim, got {x.shape}")
+    if b > 8:
+        raise ValueError(
+            f"fused decode batches at most 8 streams (one sublane tile); "
+            f"got {b} — use the unfused --gen_batch path beyond that")
+    cache_mb = 2 * b * t_cache * kn * cache_k.dtype.itemsize / 2 ** 20
+    if cache_mb > 40:
+        raise ValueError(
+            f"per-layer k+v cache blocks are {cache_mb:.0f} MB (B={b}, "
+            f"T={t_cache}); double-buffered they exceed VMEM — shrink "
+            f"the batch or generation length, or use the unfused path")
 
     compute_dtype = pack["ln1_s"].dtype
     hn = nh * hd
@@ -262,9 +304,9 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         jnp.asarray(pos, jnp.int32).reshape(1), x, cache_k, cache_v,
         segm, segb], [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, d), lambda l: (0, 0)),
-        pl.BlockSpec((1, t_cache, kn), lambda l: (l, 0, 0)),
-        pl.BlockSpec((1, t_cache, kn), lambda l: (l, 0, 0)),
+        pl.BlockSpec((b, d), lambda l: (0, 0)),
+        pl.BlockSpec((1, b, t_cache, kn), lambda l: (l, 0, 0, 0)),
+        pl.BlockSpec((1, b, t_cache, kn), lambda l: (l, 0, 0, 0)),
         pl.BlockSpec((hn, nh), lambda l: (0, 0)),
         pl.BlockSpec((nh, hn), lambda l: (0, 0)),
     ]
@@ -312,7 +354,8 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     # dtype, which the int8 pack leaves unquantized.
     kernel = functools.partial(
         _decode_kernel, keys=tuple(keys), num_layers=n_layers,
-        num_heads=nh, kv_heads=kvh, head_dim=hd, mlp_act=cfg.mlp_act,
+        num_heads=nh, kv_heads=kvh, head_dim=hd, batch=b,
+        mlp_act=cfg.mlp_act,
         compute_dtype=compute_dtype, cache_dtype=cache_k.dtype,
         out_dtype=x.dtype, eps=1e-6)
 
@@ -321,16 +364,16 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         grid=(n_layers,),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, d), lambda l: (0, 0)),
-            pl.BlockSpec((1, 1, kn), lambda l: (l, 0, 0)),
-            pl.BlockSpec((1, 1, kn), lambda l: (l, 0, 0)),
+            pl.BlockSpec((b, d), lambda l: (0, 0)),
+            pl.BlockSpec((1, b, kn), lambda l: (l, 0, 0)),
+            pl.BlockSpec((1, b, kn), lambda l: (l, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, d), x.dtype),
-            jax.ShapeDtypeStruct((n_layers, 1, kn), cache_k.dtype),
-            jax.ShapeDtypeStruct((n_layers, 1, kn), cache_k.dtype),
+            jax.ShapeDtypeStruct((b, d), x.dtype),
+            jax.ShapeDtypeStruct((n_layers, b, kn), cache_k.dtype),
+            jax.ShapeDtypeStruct((n_layers, b, kn), cache_k.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
         # Double-buffered layer weights (~2x14 MB at GPT-2-small) exceed
         # the 16 MB default scoped-vmem limit; v5e has 128 MB VMEM.
         compiler_params=pltpu.CompilerParams(

@@ -70,10 +70,10 @@ def main(argv=None) -> int:
                              "serving-throughput axis: weights stream "
                              "once per step regardless of batch)")
     parser.add_argument("--decode_fused", action="store_true",
-                        help="single-stream decode through the fused "
-                             "stack kernel (ops/decode_kernel.py): ONE "
-                             "pallas_call per token instead of the "
-                             "op-per-op layer scan (requires gen_batch 1)")
+                        help="decode through the fused stack kernel "
+                             "(ops/decode_kernel.py): ONE pallas_call "
+                             "per token instead of the op-per-op layer "
+                             "scan (gen_batch <= 8)")
     parser.add_argument("--decode_int8", action="store_true",
                         help="int8-quantize the decode weights (per "
                              "output channel): half the HBM weight "
@@ -129,8 +129,8 @@ def main(argv=None) -> int:
 
         prompt = jnp.asarray(toks[:ns.gen_batch, :8])
         if ns.decode_fused and ns.beam_size > 1:
-            parser.error("--decode_fused is single-stream sampling only; "
-                         "it does not compose with --beam_size")
+            parser.error("--decode_fused is a sampling path; it does not "
+                         "compose with --beam_size")
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size,

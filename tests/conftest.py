@@ -57,6 +57,7 @@ _SLOW_TESTS = (
     "tests/test_checkpoint.py::TestTrainerResume::test_crash_resume",
     "tests/test_checkpoint.py::TestTrainerResume::test_resume_past",
     "tests/test_checkpoint.py::TestTrainerResume::test_second_fit",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_batched",
     "tests/test_decode_kernel.py::TestFusedDecode::test_gqa_swiglu",
     "tests/test_decode_kernel.py::TestFusedDecode::test_greedy_matches",
     "tests/test_decode_kernel.py::TestFusedDecode::test_rope_llama",

@@ -77,10 +77,34 @@ class TestFusedDecode:
         if hits.size:                      # everything after first EOS is EOS
             assert np.all(gen[hits[0]:] == 3)
 
-    def test_batch_gt1_rejected(self):
+    def test_batched_matches_unfused(self):
+        """B=4 streams through one kernel (leading-dim batching): every
+        stream's greedy output must match the unfused loop's."""
         m, p = mk()
-        pr = prompt_of(m, b=2)
-        with pytest.raises(ValueError, match="single-stream"):
+        pr = prompt_of(m, b=4)
+        a = m.generate(p, pr, 10, temperature=0.0)
+        b = m.generate(p, pr, 10, temperature=0.0, fused=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_batched_llama_style_int8(self):
+        """The batched kernel branch with EVERY option stacked: GQA lane
+        expansion + in-kernel RoPE + SwiGLU + int8 weights, B=4 — guards
+        batch>1 interactions the single-stream tests never reach."""
+        m, p = mk(rope=True, num_kv_heads=2, mlp_act="swiglu")
+        pr = prompt_of(m, b=4)
+        a = np.asarray(m.generate(p, pr, 10, temperature=0.0))
+        b = np.asarray(m.generate(p, pr, 10, temperature=0.0, fused=True))
+        np.testing.assert_array_equal(a, b)
+        # int8 fused runs and matches its own fp-fused prefix (cf.
+        # test_int8_fused_matches_fp for the rounding caveat)
+        c = np.asarray(m.generate(p, pr, 10, temperature=0.0, fused=True,
+                                  int8_weights=True))
+        assert np.array_equal(b[:, 8:12], c[:, 8:12])
+
+    def test_batch_gt8_rejected(self):
+        m, p = mk()
+        pr = prompt_of(m, b=9)
+        with pytest.raises(ValueError, match="at most 8"):
             m.generate(p, pr, 4, fused=True)
 
     def test_rope_llama_style_matches_unfused(self):
