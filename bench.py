@@ -11,32 +11,217 @@ of the >=90%-of-roofline north-star target achieved, i.e.
 ``roofline_fraction / 0.90`` (>=1.0 means the target is met).  On hardware
 with no known roofline (CPU), falls back to the N=1000 reference shape's
 absolute GFLOP/s with vs_baseline = 1.0.
+
+Outage handling: a dead TPU relay presents as either a raised
+``Unavailable: backend init`` error or an indefinite hang inside
+``jax.devices()`` (both observed live, round 3).  Either way this entry
+still prints exactly ONE JSON line — ``{"error": "tpu_unavailable", ...}``
+with a nonzero exit code — so ``BENCH_r*.json`` distinguishes "the relay is
+down" from "the harness is broken" without reading tracebacks.  Backend
+init runs under a watchdog (``DTF_BENCH_INIT_TIMEOUT_S``, default 600s —
+first compile on the relay can legitimately take tens of seconds).
 """
 
 import json
+import os
 import sys
+import threading
+
+_METRIC = "matmul_tflops_per_chip"
 
 
-def main() -> None:
-    from dtf_tpu.bench.matmul import sweep
+def _failure_line(error: str, stage: str, reason: str) -> dict:
+    """The one failure shape: same metric/unit keys as success, null values."""
+    return {
+        "error": error,
+        "metric": _METRIC,
+        "value": None,
+        "unit": "TFLOP/s",
+        "vs_baseline": None,
+        "detail": {"stage": stage, "reason": reason},
+    }
 
-    results = sweep(ns=(1000, 1024, 2048, 4096, 8192), dtype="bfloat16")
-    best = max(results, key=lambda r: r["tflops_per_chip"])
+
+_emit_lock = threading.Lock()
+
+
+def _emit_once(line: dict, state: dict) -> bool:
+    """Print ``line`` iff nothing has been emitted yet for this run.
+
+    The exactly-one-JSON-line contract has a genuine race: the deadline
+    Timer can start firing in the same instant the sweep finishes (Timer
+    .cancel() cannot stop a callback already running).  All emission —
+    success, classified failure, deadline abort — goes through this latch.
+    """
+    with _emit_lock:
+        if state.get("emitted"):
+            return False
+        state["emitted"] = True
+        print(json.dumps(line), flush=True)
+        return True
+
+
+# Test seam: init_backend's probe thread class (patching the stdlib
+# threading.Thread would hijack unrelated threads).
+_Thread = threading.Thread
+
+
+def init_backend(timeout_s: float):
+    """Initialise the jax backend under a watchdog.
+
+    A dead relay makes ``jax.devices()`` hang forever rather than raise, so
+    the probe runs in a daemon thread: on timeout we raise TimeoutError and
+    the main thread can still exit cleanly.  Backend init errors (e.g.
+    ``Unavailable``) propagate as-is.
+    """
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            # This image's sitecustomize imports the axon TPU plugin before
+            # user code, so the JAX_PLATFORMS env var alone can silently
+            # lose; jax.config.update after import is the reliable form.
+            if os.environ.get("JAX_PLATFORMS"):
+                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+            result["devices"] = [str(d) for d in jax.devices()]
+        except BaseException as exc:
+            # Normalised below: anything non-Exception except operator abort
+            # (e.g. a plugin calling sys.exit) must not escape main()'s
+            # Exception classifiers, or no JSON line gets printed.
+            result["exc"] = exc
+
+    t = _Thread(target=probe, daemon=True, name="bench-backend-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"jax backend init did not complete within {timeout_s:.0f}s "
+            "(dead relay hangs rather than raising)")
+    if "exc" in result:
+        exc = result["exc"]
+        if isinstance(exc, (Exception, KeyboardInterrupt)):
+            raise exc  # Exceptions are classified by main; Ctrl+C aborts
+        raise RuntimeError(f"backend init raised {type(exc).__name__}: {exc}")
+    return result["devices"]
+
+
+def main(_init=init_backend) -> int:
+    emit_state: dict = {}
+
+    def fail(error: str, stage: str, reason: str) -> int:
+        _emit_once(_failure_line(error, stage, reason), emit_state)
+        return 1
+
+    # All env knobs parse before any backend work so a typo in any of them
+    # gets its own config_error line instead of a traceback or a misleading
+    # stage.  DTF_BENCH_NS: comma-separated N override for smoke runs (the
+    # full sweep is TPU-sized; N=8192 bf16 alone is minutes/matmul on CPU).
+    # DTF_BENCH_DEADLINE_S: whole-run deadline — the relay's hang mode can
+    # strike mid-sweep too, after init succeeded.
+    try:
+        timeout_s = float(os.environ.get("DTF_BENCH_INIT_TIMEOUT_S", "600"))
+        deadline_s = float(os.environ.get("DTF_BENCH_DEADLINE_S", "1800"))
+        ns = tuple(int(n) for n in
+                   os.environ.get("DTF_BENCH_NS", "1000,1024,2048,4096,8192")
+                   .split(","))
+    except ValueError as exc:
+        return fail("config_error", "config",
+                    f"bad DTF_BENCH_* env var: {exc}")
+    # `0 < x <= TIMEOUT_MAX` also rejects NaN and inf (Thread.join/Timer
+    # raise OverflowError past TIMEOUT_MAX, which would misclassify as a
+    # tpu_unavailable or kill the deadline thread).
+    if not (0 < timeout_s <= threading.TIMEOUT_MAX
+            and 0 < deadline_s <= threading.TIMEOUT_MAX):
+        return fail("config_error", "config",
+                    "DTF_BENCH_INIT_TIMEOUT_S and DTF_BENCH_DEADLINE_S must "
+                    f"be in (0, {threading.TIMEOUT_MAX:.0f}], "
+                    f"got {timeout_s} / {deadline_s}")
+    if not ns or not all(n > 0 for n in ns):
+        return fail("config_error", "config",
+                    f"DTF_BENCH_NS values must be positive, got {ns}")
+
+    # Classify a deadline hit by where it struck: before backend init
+    # succeeded it is the relay's hang mode; after, the backend provably
+    # came up, so it is a run that died/stalled — not an outage.
+    init_ok = threading.Event()
+
+    def deadline_abort() -> None:
+        if init_ok.is_set():
+            err, where = "benchmark_error", "hang after successful backend init"
+        else:
+            err, where = "tpu_unavailable", "relay hang during backend init"
+        line = _failure_line(
+            err, "deadline",
+            f"no result within DTF_BENCH_DEADLINE_S={deadline_s:.0f}s ({where})")
+        if _emit_once(line, emit_state):  # a finished run wins the race
+            os._exit(1)
+
+    deadline = threading.Timer(deadline_s, deadline_abort)
+    deadline.daemon = True
+    deadline.start()
+    try:
+        try:
+            devices = _init(timeout_s)
+        except ImportError as exc:
+            # A venv where jax itself fails to import is a harness bug, not
+            # an outage; keep the two distinguishable as the docstring
+            # promises.
+            return fail("harness_error", "backend_init",
+                        f"{type(exc).__name__}: {exc}")
+        except Exception as exc:
+            return fail("tpu_unavailable", "backend_init",
+                        f"{type(exc).__name__}: {exc}")
+        init_ok.set()
+
+        try:
+            # ANY import-time failure (ImportError or module-level code
+            # dying) is a broken package, i.e. a harness bug; once sweep
+            # is RUNNING, any error (even a lazy ImportError inside it)
+            # means the backend came up and the run died ->
+            # benchmark_error.
+            from dtf_tpu.bench.matmul import sweep
+        except KeyboardInterrupt:
+            raise
+        except BaseException as exc:
+            return fail("harness_error", "sweep",
+                        f"{type(exc).__name__}: {exc}")
+        try:
+            results = sweep(ns=ns, dtype="bfloat16")
+            best = max(results, key=lambda r: r["tflops_per_chip"])
+        except KeyboardInterrupt:
+            raise
+        except BaseException as exc:
+            # BaseException: an observed plugin failure mode is calling
+            # sys.exit() mid-run, which must still produce the JSON line.
+            return fail("benchmark_error", "sweep",
+                        f"{type(exc).__name__}: {exc}")
+    finally:
+        # Disarm the process-killer on EVERY exit path — main() is embedded
+        # by tests; a live Timer would os._exit a pytest session 30 min in.
+        deadline.cancel()
+
     if best["roofline_fraction"] is not None:
+        detail = {
+            "best_n": best["n"],
+            "device": best["device_kind"],
+            "n_chips": best["n_chips"],
+            "roofline_fraction": round(best["roofline_fraction"], 4),
+            "sweep_tflops": {str(r["n"]): round(r["tflops_per_chip"], 2)
+                             for r in results},
+        }
+        # The reference-shape timing key is only honest when N=1000 ran
+        # (a DTF_BENCH_NS smoke run may not include it).
+        for r in results:
+            if r["n"] == 1000:
+                detail["n1000_matmul_time_us"] = round(r["matmul_time_us"], 3)
         line = {
-            "metric": "matmul_tflops_per_chip",
+            "metric": _METRIC,
             "value": round(best["tflops_per_chip"], 2),
             "unit": "TFLOP/s",
             "vs_baseline": round(best["roofline_fraction"] / 0.90, 4),
-            "detail": {
-                "best_n": best["n"],
-                "device": best["device_kind"],
-                "n_chips": best["n_chips"],
-                "roofline_fraction": round(best["roofline_fraction"], 4),
-                "n1000_matmul_time_us": round(results[0]["matmul_time_us"], 3),
-                "sweep_tflops": {str(r["n"]): round(r["tflops_per_chip"], 2)
-                                 for r in results},
-            },
+            "detail": detail,
         }
     else:
         line = {
@@ -44,9 +229,12 @@ def main() -> None:
             "value": round(best["tflops_per_chip"] * 1000, 2),
             "unit": "GFLOP/s",
             "vs_baseline": 1.0,
-            "detail": {"best_n": best["n"], "device": best["device_kind"]},
+            "detail": {"best_n": best["n"], "device": best["device_kind"],
+                       "n_devices": len(devices)},
         }
-    print(json.dumps(line))
+    # If the deadline callback won the emission race, the failure line is
+    # already out; the exit code must match it.
+    return 0 if _emit_once(line, emit_state) else 1
 
 
 if __name__ == "__main__":
