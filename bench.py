@@ -129,6 +129,7 @@ def main(_init=init_backend) -> int:
     except ValueError as exc:
         return fail("config_error", "config",
                     f"bad DTF_BENCH_* env var: {exc}")
+
     # `0 < x <= TIMEOUT_MAX` also rejects NaN and inf (Thread.join/Timer
     # raise OverflowError past TIMEOUT_MAX, which would misclassify as a
     # tpu_unavailable or kill the deadline thread).
@@ -171,6 +172,24 @@ def main(_init=init_backend) -> int:
             return fail("harness_error", "backend_init",
                         f"{type(exc).__name__}: {exc}")
         except Exception as exc:
+            msg = str(exc).lower()
+            # A JAX_PLATFORMS typo surfaces here as jax's "unknown
+            # backend/platform" error.  Platform names are an open PJRT
+            # registry (no allowlist possible), but the CORE names are
+            # fixed: if the operator asked only for core platforms and one
+            # is missing, that is a plugin/relay failure (outage), not a
+            # typo — only an unrecognized name classifies as config_error.
+            core = {"cpu", "tpu", "gpu", "cuda", "rocm"}
+            req = [p.strip().lower() for p in
+                   os.environ.get("JAX_PLATFORMS", "").split(",")
+                   if p.strip()]
+            if (req and not all(p in core for p in req)
+                    and "unknown" in msg
+                    and ("backend" in msg or "platform" in msg)):
+                return fail("config_error", "backend_init",
+                            f"bad JAX_PLATFORMS="
+                            f"{os.environ['JAX_PLATFORMS']!r}? "
+                            f"{type(exc).__name__}: {exc}")
             return fail("tpu_unavailable", "backend_init",
                         f"{type(exc).__name__}: {exc}")
         init_ok.set()

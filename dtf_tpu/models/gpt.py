@@ -770,28 +770,16 @@ class GPT(Module):
         the kernel's k/v outputs are written back with one
         ``dynamic_update_slice`` per token."""
         from dtf_tpu.nn.sampling import sample_token
-        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
-                                               fused_decode_step)
 
         cfg = self.cfg
         b, p_len = prompt.shape
-        if b > 8:
-            raise ValueError(f"fused decode batches at most 8 streams "
-                             f"(got {b}) — use the default path beyond "
-                             f"that (the op-per-op loop already "
-                             f"amortizes weight streaming at large "
-                             f"batch)")
-        if cfg.pipeline_mesh is not None:
-            raise ValueError("fused decode does not compose with pipeline "
-                             "parallelism")
+        self._check_fused_decode(b)
         total = p_len + max_new_tokens
 
         cache, logits = self._prefill_cache(params, prompt,
                                             self._cache_len(total))
-        # row-major cache: (L, B, T, KVH, Dh) -> (L, B, T, KVH·Dh)
-        n_l, _, t_c = cache["k"].shape[:3]
-        ck = cache["k"].reshape(n_l, b, t_c, -1)
-        cv = cache["v"].reshape(n_l, b, t_c, -1)
+        pack, head_q, ck, cv = self._fused_decode_setup(
+            params, cache, int8_weights)
 
         rng, sub = jax.random.split(rng)
         first = sample_token(sub, logits, temperature=temperature,
@@ -801,31 +789,11 @@ class GPT(Module):
         out = out.at[:, p_len].set(first)
         done = (first == eos_id) if eos_id is not None else None
 
-        pack = fused_decode_pack(params, cfg, int8=int8_weights)
-        head_q = (_quantize_cols(params["tok"]["table"].T)
-                  if int8_weights else None)
-
         def step(carry, pos):
             out, ck, cv, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
-            x = self._embed(params, tok, pos[None])[:, 0, :]     # (B, D)
-            rope_kw = {}
-            if cfg.rope:
-                from dtf_tpu.nn.rope import rope_angles
-                cos, sin = rope_angles(pos, cfg.dim // cfg.num_heads)
-                rope_kw = {"rope_cos": cos, "rope_sin": sin}
-            x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
-                                                **rope_kw)
-            ck = lax.dynamic_update_slice(ck, k_new[:, :, None, :],
-                                          (0, 0, pos, 0))
-            cv = lax.dynamic_update_slice(cv, v_new[:, :, None, :],
-                                          (0, 0, pos, 0))
-            h = self.ln_f.apply(params["ln_f"], x[:, None, :])
-            if head_q is not None:
-                logits = _dequant_matmul(h, head_q[0], head_q[1],
-                                         jnp.float32)[:, 0, :]
-            else:
-                logits = self.tok.attend(params["tok"], h)[:, 0, :]
+            logits, ck, cv = self._fused_token_logits(
+                params, pack, head_q, ck, cv, tok, pos)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
@@ -839,10 +807,75 @@ class GPT(Module):
                                         jnp.arange(p_len, total - 1))
         return out
 
+    def _check_fused_decode(self, n_streams: int) -> None:
+        """The fused stack kernel's preconditions, shared by generate and
+        beam (ONE place so the two paths cannot drift): at most
+        ``MAX_FUSED_STREAMS`` streams (one sublane tile — per-layer cache
+        blocks outgrow VMEM beyond that anyway), no pipeline parallelism."""
+        from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS
+
+        if n_streams > MAX_FUSED_STREAMS:
+            raise ValueError(
+                f"fused decode streams (batch, or batch x beams) are "
+                f"capped at {MAX_FUSED_STREAMS}, i.e. at most "
+                f"{MAX_FUSED_STREAMS} rows of one sublane tile; "
+                f"got {n_streams} — use the unfused path (the op-per-op "
+                f"loop already amortizes weight streaming at large batch) "
+                f"or shrink the batch/beam")
+        if self.cfg.pipeline_mesh is not None:
+            raise ValueError("fused decode does not compose with pipeline "
+                             "parallelism")
+
+    def _fused_decode_setup(self, params, cache, int8_weights: bool):
+        """Shared fused-decode prologue: kernel weight pack, optional int8
+        head quantization, and the (L, B, T, KVH, Dh) -> row-major
+        (L, B, T, KVH·Dh) cache reshape.  The stream count (B for
+        generate, B·W for beam) is the cache's own batch dim — derived,
+        not passed, so a wrong caller value cannot silently scramble the
+        reshape."""
+        from dtf_tpu.ops.decode_kernel import fused_decode_pack
+
+        pack = fused_decode_pack(params, self.cfg, int8=int8_weights)
+        head_q = (_quantize_cols(params["tok"]["table"].T)
+                  if int8_weights else None)
+        n_l, n_streams, t_c = cache["k"].shape[:3]
+        ck = cache["k"].reshape(n_l, n_streams, t_c, -1)
+        cv = cache["v"].reshape(n_l, n_streams, t_c, -1)
+        return pack, head_q, ck, cv
+
+    def _fused_token_logits(self, params, pack, head_q, ck, cv, tok, pos):
+        """One token for all streams through the fused stack kernel: embed
+        ``tok`` (B, 1), run ``fused_decode_step``, write the returned k/v
+        rows into the row-major caches at ``pos``, project to logits.
+        Shared by :meth:`_generate_fused` and the fused beam path so the
+        two decode modes cannot drift."""
+        from dtf_tpu.ops.decode_kernel import fused_decode_step
+
+        cfg = self.cfg
+        x = self._embed(params, tok, pos[None])[:, 0, :]         # (B, D)
+        rope_kw = {}
+        if cfg.rope:
+            from dtf_tpu.nn.rope import rope_angles
+            cos, sin = rope_angles(pos, cfg.dim // cfg.num_heads)
+            rope_kw = {"rope_cos": cos, "rope_sin": sin}
+        x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
+                                            **rope_kw)
+        ck = lax.dynamic_update_slice(ck, k_new[:, :, None, :],
+                                      (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(cv, v_new[:, :, None, :],
+                                      (0, 0, pos, 0))
+        h = self.ln_f.apply(params["ln_f"], x[:, None, :])
+        if head_q is not None:
+            logits = _dequant_matmul(h, head_q[0], head_q[1],
+                                     jnp.float32)[:, 0, :]
+        else:
+            logits = self.tok.attend(params["tok"], h)[:, 0, :]
+        return logits, ck, cv
+
     def beam_search(self, params, prompt, max_new_tokens: int, *,
                     beam_size: int = 4, eos_id: Optional[int] = None,
                     length_penalty: float = 0.0,
-                    int8_weights: bool = False):
+                    int8_weights: bool = False, fused: bool = False):
         """Deterministic beam decoding.  prompt (B, P) int32 ->
         (sequences (B, W, P+max_new), scores (B, W)), beams sorted best
         first.
@@ -855,6 +888,13 @@ class GPT(Module):
         ``eos_id``, so its score stops changing); ``length_penalty`` > 0
         applies the GNMT ``((5+len)/6)^alpha`` normalization to the final
         ranking.
+
+        ``fused=True`` runs each decode token through the single-
+        ``pallas_call`` stack kernel (ops/decode_kernel.py): the W beams
+        are exactly W decode streams (B·W <= 8, the kernel's stream cap),
+        the beam bookkeeping — top-W over W·V, cache-row reordering —
+        stays outside the kernel where XLA already handles it well.
+        Composes with ``int8_weights``.
         """
         cfg = self.cfg
         b, p_len = prompt.shape
@@ -863,6 +903,8 @@ class GPT(Module):
         if total > cfg.max_len:
             raise ValueError(f"prompt+new = {total} exceeds max_len "
                              f"{cfg.max_len}")
+        if fused:
+            self._check_fused_decode(b * w)
         if max_new_tokens == 0:
             return (jnp.repeat(prompt[:, None], w, axis=1),
                     jnp.zeros((b, w), jnp.float32))
@@ -891,14 +933,26 @@ class GPT(Module):
             idx = beam_idx.reshape(1, b, w, *([1] * (cv.ndim - 3)))
             return jnp.take_along_axis(cv, idx, axis=2).reshape(c.shape)
 
-        packed = self._decode_pack(params, int8=int8_weights)
+        if fused:
+            pack, head_q, ck, cv = self._fused_decode_setup(
+                params, cache, int8_weights)
+            cache = (ck, cv)
+
+            def decode_logits(cache, tok, pos):
+                logits, ck, cv = self._fused_token_logits(
+                    params, pack, head_q, cache[0], cache[1], tok, pos)
+                return logits, (ck, cv)
+        else:
+            packed = self._decode_pack(params, int8=int8_weights)
+
+            def decode_logits(cache, tok, pos):
+                return self._decode_logits(params, cache, tok, pos, packed)
 
         def step(carry, pos):
             out, cache, scores, alive = carry
             tok = lax.dynamic_slice(out, (0, 0, pos),
                                     (b, w, 1)).reshape(b * w, 1)
-            logits, cache = self._decode_logits(params, cache, tok, pos,
-                                                packed)
+            logits, cache = decode_logits(cache, tok, pos)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             logp = logp.reshape(b, w, v_size)
             if eos_id is not None:

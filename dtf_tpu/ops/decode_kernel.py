@@ -48,6 +48,11 @@ from dtf_tpu.ops.flash_attention import _interpret_default
 
 NEG_BIG = -1e30
 
+# One sublane tile of decode streams; per-layer cache blocks outgrow VMEM
+# beyond this anyway.  Shared by the kernel guard, GPT._check_fused_decode,
+# and the lm workload's CLI pre-check so the cap cannot drift.
+MAX_FUSED_STREAMS = 8
+
 
 def quantize_cols(w):
     """Symmetric per-output-channel (last dim) int8 weight quantization:
@@ -279,9 +284,10 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     if x.shape != (b, d):
         raise ValueError(f"x must be ({b}, {d}) to match the cache's "
                          f"batch dim, got {x.shape}")
-    if b > 8:
+    if b > MAX_FUSED_STREAMS:
         raise ValueError(
-            f"fused decode batches at most 8 streams (one sublane tile); "
+            f"fused decode batches at most {MAX_FUSED_STREAMS} streams "
+            f"(one sublane tile); "
             f"got {b} — use the unfused --gen_batch path beyond that")
     cache_mb = 2 * b * t_cache * kn * cache_k.dtype.itemsize / 2 ** 20
     if cache_mb > 40:

@@ -23,6 +23,7 @@ def main(argv=None) -> int:
     from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
     from dtf_tpu.data.datasets import synthetic_text
     from dtf_tpu.models.gpt import GPT, GPTConfig
+    from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS
     from dtf_tpu.train.metrics import MetricLogger
     from dtf_tpu.utils.timing import block
     from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
@@ -70,10 +71,12 @@ def main(argv=None) -> int:
                              "serving-throughput axis: weights stream "
                              "once per step regardless of batch)")
     parser.add_argument("--decode_fused", action="store_true",
-                        help="decode through the fused stack kernel "
-                             "(ops/decode_kernel.py): ONE pallas_call "
-                             "per token instead of the op-per-op layer "
-                             "scan (gen_batch <= 8)")
+                        help=f"decode through the fused stack kernel "
+                             f"(ops/decode_kernel.py): ONE pallas_call "
+                             f"per token instead of the op-per-op layer "
+                             f"scan (gen_batch <= {MAX_FUSED_STREAMS}; "
+                             f"with --beam_size, gen_batch x beam_size "
+                             f"<= {MAX_FUSED_STREAMS})")
     parser.add_argument("--decode_int8", action="store_true",
                         help="int8-quantize the decode weights (per "
                              "output channel): half the HBM weight "
@@ -90,6 +93,18 @@ def main(argv=None) -> int:
     parser.add_argument("--label_smoothing", type=float, default=0.0,
                         help="eps of uniform mass in the CE loss")
     ns = parser.parse_args(argv)
+    # Fail fast on the fused-decode preconditions (models/gpt.py
+    # _check_fused_decode) BEFORE the training run, not after it.
+    if ns.generate > 0 and ns.decode_fused:
+        streams = ns.gen_batch * max(ns.beam_size, 1)
+        if streams > MAX_FUSED_STREAMS:
+            parser.error(
+                f"--decode_fused runs gen_batch x beam_size streams "
+                f"through the stack kernel, capped at {MAX_FUSED_STREAMS}; "
+                f"got {streams}")
+        if ns.pipeline_microbatches > 0:
+            parser.error("--decode_fused does not compose with pipeline "
+                         "parallelism (--pipeline_microbatches)")
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
@@ -128,13 +143,11 @@ def main(argv=None) -> int:
         import jax
 
         prompt = jnp.asarray(toks[:ns.gen_batch, :8])
-        if ns.decode_fused and ns.beam_size > 1:
-            parser.error("--decode_fused is a sampling path; it does not "
-                         "compose with --beam_size")
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size,
-                int8_weights=ns.decode_int8)[0][:, 0])
+                int8_weights=ns.decode_int8,
+                fused=ns.decode_fused)[0][:, 0])
         else:
             gen = jax.jit(lambda p, pr, key: model.generate(
                 p, pr, ns.generate, temperature=ns.temperature,

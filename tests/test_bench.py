@@ -174,11 +174,44 @@ class TestOutageAwareEntry:
         assert line["error"] == "benchmark_error"
         assert line["detail"]["stage"] == "sweep"
 
-    def test_real_init_succeeds_on_cpu(self):
+    def test_real_init_succeeds_on_cpu(self, monkeypatch):
+        """Pin the platform: on a TPU-plugin image with a hung relay this
+        would otherwise block the fast suite for the full watchdog."""
         import bench
 
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
         devices = bench.init_backend(timeout_s=120)
         assert len(devices) >= 1
+
+    def test_bad_jax_platforms_is_config_error(self, capsys, monkeypatch):
+        """A JAX_PLATFORMS typo (jax raises 'unknown backend') classifies
+        as config_error, not a relay outage; platform names are an open
+        PJRT registry so there is no allowlist to validate against."""
+        monkeypatch.setenv("JAX_PLATFORMS", "tup")
+
+        def unknown_backend_init(timeout_s):
+            raise RuntimeError("Unknown backend: 'tup' requested, but no "
+                               "platforms are present.")
+
+        rc, line = self._run_main(capsys, _init=unknown_backend_init)
+        assert rc == 1
+        assert line["error"] == "config_error"
+        assert "JAX_PLATFORMS" in line["detail"]["reason"]
+
+    def test_valid_platform_unregistered_is_outage(self, capsys,
+                                                   monkeypatch):
+        """JAX_PLATFORMS=tpu (a core name) + 'unknown backend' means the
+        plugin failed to register — an outage, not a config typo."""
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+
+        def unregistered_init(timeout_s):
+            raise RuntimeError("Unknown backend: 'tpu' requested, but no "
+                               "platforms that are instances of tpu are "
+                               "present.")
+
+        rc, line = self._run_main(capsys, _init=unregistered_init)
+        assert rc == 1
+        assert line["error"] == "tpu_unavailable"
 
     def test_deadline_abort_fires_in_subprocess(self):
         """The whole-run deadline (the os._exit path no in-process test can
