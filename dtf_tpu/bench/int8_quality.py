@@ -19,7 +19,16 @@ Both effects ADD noise here, so a near-1.0 perplexity ratio from this
 harness implies at-least-as-good deployed quality.
 
     python -m dtf_tpu.bench.int8_quality [--preset gpt2_small]
-        [--batch 8] [--seq 512] [--gen 256]
+        [--batch 8] [--seq 512] [--gen 256] [--ckpt DIR]
+
+``--ckpt`` scores TRAINED weights (a checkpoint directory written by the
+trainer's CheckpointManager) instead of random init.  This matters
+because random-init weights have benign per-channel dynamic range;
+training grows outlier channels — the case per-channel int8 quantization
+exists for — so the random-init ratio likely overstates the deployed
+quality margin (r3 VERDICT weak #4).  ``scale_stats`` quantifies exactly
+that: the per-matrix max/median ratio of the per-output-channel scales
+(1.0 = perfectly uniform channels; large = outliers dominate).
 """
 
 from __future__ import annotations
@@ -65,8 +74,66 @@ def dequantized_params(params):
     return out
 
 
+def scale_stats(params, cfg) -> dict:
+    """Per-output-channel scale dispersion of every decode-quantized
+    matrix: ratio = max(scale)/median(scale) per matrix (per layer for
+    stacked weights).  Near 1.0 means channels are uniform (int8 is
+    easy); large ratios mean outlier channels emerged — the regime
+    per-channel quantization exists for.  The scales are read off
+    ``fused_decode_pack(int8=True)`` (plus ``_decode_pack``'s head
+    quantization), i.e. the DEPLOYED layouts, so the stat cannot drift
+    from what the kernel actually quantizes.  Returns the worst and
+    median ratio over all matrices plus a per-family breakdown."""
+    import jax
+    import numpy as np
+
+    from dtf_tpu.ops.decode_kernel import fused_decode_pack, quantize_cols
+
+    def ratios(sc):
+        s = np.asarray(sc, np.float64)
+        s = s.reshape(-1, s.shape[-1])          # (L|1, N)
+        med = np.median(s, axis=-1)
+        return (s.max(axis=-1) / np.maximum(med, 1e-30)).tolist()
+
+    # jit: at GPT-2-small scale an eager op-by-op quantization of ~124M
+    # params is seconds of host time.
+    pack = jax.jit(lambda p: fused_decode_pack(p, cfg, int8=True))(params)
+    fams = {key[2:]: ratios(pack[key + "_sc"])
+            for key in ("w_qkv", "w_o", "w_fc1", "w_fc2", "w_gate")
+            if key + "_sc" in pack}
+    head_sc = jax.jit(
+        lambda t: quantize_cols(t.T)[1])(params["tok"]["table"])  # as _decode_pack
+    fams["head"] = ratios(head_sc)
+    allr = [r for v in fams.values() for r in v]
+    return {
+        "max_scale_ratio": float(np.max(allr)),
+        "median_scale_ratio": float(np.median(allr)),
+        "per_family_max": {k: float(np.max(v)) for k, v in fams.items()},
+    }
+
+
+def load_checkpoint_params(ckpt_dir: str):
+    """Load the params subtree from a trainer CheckpointManager directory
+    (no state template needed: orbax restores with saved metadata).
+    Deliberate tradeoff: the whole TrainState (params + optimizer
+    moments, ~3x the params bytes) is materialized and the rest dropped —
+    a params-only orbax partial restore needs a state template this
+    harness by design does not have.  ~1 GB transient host memory at
+    GPT-2-small scale; acceptable for an offline quality harness."""
+    import orbax.checkpoint as ocp
+
+    import contextlib
+
+    with contextlib.closing(ocp.CheckpointManager(ckpt_dir)) as mgr:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps under {ckpt_dir}")
+        state = mgr.restore(step)
+    return state["params"], step
+
+
 def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
-        gen: int = 256, seed: int = 0) -> dict:
+        gen: int = 256, seed: int = 0, ckpt: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -79,7 +146,22 @@ def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
            "tiny": GPTConfig.tiny}[preset](dtype=jnp.bfloat16,
                                            max_len=max(seq, gen + 8))
     model = GPT(cfg)
-    params = model.init(jax.random.key(seed))
+    ckpt_step = None
+    if ckpt is not None:
+        params, ckpt_step = load_checkpoint_params(ckpt)
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if "pos" in params:
+            # Positions beyond the trained table would be a SILENT
+            # out-of-bounds gather (JAX clamps) — garbage numbers that
+            # look like a valid measurement.
+            avail = params["pos"]["table"].shape[0]
+            if cfg.max_len > avail:
+                raise ValueError(
+                    f"checkpoint position table covers {avail} positions "
+                    f"but --seq/--gen need {cfg.max_len}; rerun with "
+                    f"--seq/--gen within the trained max_len ({avail})")
+    else:
+        params = model.init(jax.random.key(seed))
     params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
                                     params)
     p8 = jax.jit(dequantized_params)(params)
@@ -96,14 +178,18 @@ def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
     b = np.asarray(g(p8, prompt))
     agree = float((a[0, 8:] == b[0, 8:]).mean())
     div = int(np.argmax(a[0, 8:] != b[0, 8:])) if agree < 1.0 else gen
-    return {
+    out = {
         "tokens_scored": batch * (seq - 1),
         "loss_fp": l_fp, "loss_int8": l_i8,
         "ppl_ratio": float(np.exp(l_i8 - l_fp)),
         "greedy_agreement": agree,
         "first_divergence": div,
         "gen_tokens": gen,
+        "weights": "random-init" if ckpt is None else f"trained ({ckpt})",
+        "ckpt_step": ckpt_step,
     }
+    out.update(scale_stats(params, cfg))
+    return out
 
 
 def main(argv=None) -> int:
@@ -114,6 +200,10 @@ def main(argv=None) -> int:
     parser.add_argument("--seq", type=int, default=512)
     parser.add_argument("--gen", type=int, default=256)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ckpt", default=None, metavar="DIR",
+                        help="score TRAINED weights from this trainer "
+                             "checkpoint directory (must match --preset); "
+                             "default: random init")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (reliable even when "
                              "a TPU plugin is registered: jax.config "
@@ -123,7 +213,9 @@ def main(argv=None) -> int:
     if ns.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    r = run(ns.preset, ns.batch, ns.seq, ns.gen, ns.seed)
+    r = run(ns.preset, ns.batch, ns.seq, ns.gen, ns.seed, ckpt=ns.ckpt)
+    print(f"weights: {r['weights']}"
+          + (f" step {r['ckpt_step']}" if r['ckpt_step'] is not None else ""))
     print(f"tokens scored: {r['tokens_scored']}")
     print(f"fp loss {r['loss_fp']:.6f}   int8 loss {r['loss_int8']:.6f}")
     print(f"perplexity ratio {r['ppl_ratio']:.6f} "
@@ -131,6 +223,11 @@ def main(argv=None) -> int:
     print(f"greedy agreement over {r['gen_tokens']}: "
           f"{r['greedy_agreement']:.4f} "
           f"(first divergence at {r['first_divergence']})")
+    print(f"per-channel scale dispersion (max/median per matrix): "
+          f"worst {r['max_scale_ratio']:.2f}, "
+          f"median {r['median_scale_ratio']:.2f}, by family "
+          + ", ".join(f"{k}={v:.2f}"
+                      for k, v in r['per_family_max'].items()))
     return 0
 
 

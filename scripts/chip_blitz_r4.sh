@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-4 chip measurement blitz (r3 VERDICT #1): the moment the TPU relay
+# is back, run these IN ORDER and append the results to BASELINE.md.
+# Measurement before new code — the relay died mid-round-3 and took every
+# unrecorded row with it.  The chip is SINGLE-TENANT: one process at a
+# time, and do not kill anything mid-compile (it can wedge the relay).
+#
+# Usage: bash scripts/chip_blitz_r4.sh [outdir]   (default /tmp/r4_blitz)
+# Each step logs to its own file; a step that fails must NOT stop the rest.
+set -u
+OUT=${1:-/tmp/r4_blitz}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) ==="
+  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+  echo "rc=$? -> $OUT/$name.log"
+  tail -5 "$OUT/$name.log"
+}
+
+# 1a. Headline matmul bench -> the BENCH_r04 shape the driver captures.
+run bench 1800 python bench.py
+
+# 1b. BERT-base global-batch-256 with the round-3 MFU fixes recorded as a
+#     ROW (not a projection).  NO grad_accum: unroll+accum compile was
+#     pathological (>20 min, r3).  mb64 fits at attn policy (r3 deep dive).
+run bert_attn_unroll 3600 python -m dtf_tpu.workloads.bert_pretrain \
+  --preset base --bf16 --remat --remat_policy attn --layer_loop unroll \
+  --per_device_batch 64 --steps 30
+
+# 1c. GPT-2-small, same flags + chunked loss.
+run gpt_attn_unroll 3600 python -m dtf_tpu.workloads.lm \
+  --preset gpt2_small --bf16 --remat --remat_policy attn \
+  --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30
+
+# 1d. Re-confirm the fused-decode single-stream ladder (r3: 3,811 tok/s,
+#     builder-measured only).  The workload prints a steady-state rate;
+#     the honest number is the time_linfit ladder in the python API —
+#     use the workload here for a quick confirm, ladder in the follow-up.
+run fused_decode_1 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
+  --bf16 --steps 2 --generate 512 --decode_fused
+
+# 3. Mosaic-validate the batched fused kernel + in-kernel RoPE (r3 landed
+#    interpret-only; the (B,T,.)->(B*T,.) major-dim reshapes are the
+#    legality risk).  LLaMA-style preset exercises RoPE+GQA+SwiGLU.
+for b in 2 4 8; do
+  run fused_batched_$b 1800 python -m dtf_tpu.workloads.lm --preset llama \
+    --bf16 --steps 2 --generate 256 --gen_batch "$b" --decode_fused
+done
+
+# 6. Fused beam search (new this round): width-4 on one stream.
+run fused_beam4 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
+  --bf16 --steps 2 --generate 256 --beam_size 4 --decode_fused
+run beam4_unfused 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
+  --bf16 --steps 2 --generate 256 --beam_size 4
+
+# 4. T5 + BERT+MoE rows (first real-chip perf rows for these families).
+run t5_base 3600 python -m dtf_tpu.workloads.t5_pretrain \
+  --preset base --bf16 --remat --per_device_batch 32 --steps 30
+run bert_moe 3600 python -m dtf_tpu.workloads.bert_pretrain \
+  --preset base --bf16 --remat --moe_experts 8 \
+  --per_device_batch 32 --steps 30
+
+# 5. int8 quality on TRAINED weights: train GPT-2-small a few thousand
+#    steps on the Markov LM task, checkpoint, score.  Longest step last.
+run train_gpt2s 14400 python -m dtf_tpu.workloads.lm --preset gpt2_small \
+  --bf16 --remat --remat_policy attn --per_device_batch 8 --steps 3000 \
+  --checkpoint_every 1000 --logdir /tmp/r4_gpt2s
+run int8_trained 3600 python -m dtf_tpu.bench.int8_quality \
+  --preset gpt2_small --ckpt /tmp/r4_gpt2s/checkpoints
+run int8_random 3600 python -m dtf_tpu.bench.int8_quality \
+  --preset gpt2_small
+
+echo "=== blitz complete; logs in $OUT ==="

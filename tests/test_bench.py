@@ -245,6 +245,53 @@ class TestOutageAwareEntry:
 
 
 class TestInt8Quality:
+    @pytest.mark.slow
+    def test_trained_checkpoint_path(self, tmp_path):
+        """--ckpt scores TRAINED weights: train tiny for a few steps via
+        the lm workload, checkpoint, and confirm the harness (a) restores
+        the trained params (loss on the training distribution beats fresh
+        init), (b) reports scale-dispersion stats."""
+        import jax
+        import jax.numpy as jnp
+
+        from dtf_tpu.bench.int8_quality import (load_checkpoint_params,
+                                                run, scale_stats)
+        from dtf_tpu.data.datasets import synthetic_text
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        from dtf_tpu.workloads import lm
+
+        rc = lm.main(["--preset", "tiny", "--steps", "8",
+                      "--checkpoint_every", "6", "--batch_size", "8",
+                      "--logdir", str(tmp_path)])
+        assert rc == 0
+        params, step = load_checkpoint_params(str(tmp_path / "checkpoints"))
+        assert step is not None and step >= 6
+        cfg = GPTConfig.tiny()
+        m = GPT(cfg)
+        toks = jnp.asarray(synthetic_text(64, cfg.max_len, cfg.vocab_size,
+                                          seed=1))
+        batch = {"tokens": toks[:16]}
+        trained = float(m.loss(
+            jax.tree_util.tree_map(jnp.asarray, params), batch)[0])
+        fresh = float(m.loss(m.init(jax.random.key(0)), batch)[0])
+        assert trained < fresh - 0.01, (trained, fresh)
+
+        r = run("tiny", batch=2, seq=32, gen=8,
+                ckpt=str(tmp_path / "checkpoints"))
+        assert r["ckpt_step"] == step
+        assert 0.9 < r["ppl_ratio"] < 1.1
+        assert r["max_scale_ratio"] >= 1.0
+        assert set(r["per_family_max"]) >= {"qkv", "o", "fc1", "fc2",
+                                            "head"}
+        s = scale_stats(m.init(jax.random.key(0)), cfg)
+        assert s["max_scale_ratio"] >= s["median_scale_ratio"] >= 1.0
+
+        # seq beyond the trained position table must REFUSE, not silently
+        # clamp the gather
+        with pytest.raises(ValueError, match="position table"):
+            run("tiny", batch=2, seq=256, gen=8,
+                ckpt=str(tmp_path / "checkpoints"))
+
     def test_tiny_ppl_ratio_near_one(self):
         """The decode quantization's perplexity damage is bounded: ratio
         within ±2% on the tiny preset (measured ~0.9998; a broken
