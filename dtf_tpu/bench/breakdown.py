@@ -77,6 +77,47 @@ class Row:
         return "  ".join(cols)
 
 
+def _attn_rows(rows, b, t, h, hd, bq, bk, causal, tag):
+    """Time flash fwd and fwd+bwd at (B, h, T, hd) with the given block
+    sizes and append two Rows.  ONE home for the non-obvious accounting —
+    the causal block-skip discount ((nb+1)/2nb of the dense FLOPs) and
+    the 3.5x fwd+bwd multiplier (bwd recomputes s/p once and computes
+    dq+dk+dv in one fused kernel) — shared by breakdown() and
+    attn_sweep() so the two cannot drift.  Block sizes are resolved via
+    _block_sizes first so tags always name what actually ran."""
+    from dtf_tpu.ops.flash_attention import flash_attention, _block_sizes
+
+    mk = lambda k, shape: jax.random.normal(jax.random.key(k), shape,
+                                            jnp.bfloat16)
+    rbq, rbk = _block_sizes(t, bq, bk)
+    q = mk(6, (b, h, t, hd))
+    flops = 4.0 * b * h * t * t * hd               # qk + pv
+    if causal:
+        # the kernel skips blocks above the diagonal: of nb^2 block pairs
+        # only nb(nb+1)/2 execute (diagonal blocks half-masked but still
+        # computed, so credit them fully).  The credit uses the REFERENCE
+        # 512 tiling's block count for every row, NOT the row's own
+        # tiling: finer tiles execute fewer wasted above-diagonal FLOPs,
+        # and crediting each tiling its own executed count would make
+        # TF/s incomparable across the sweep (a faster config could
+        # print a lower TF/s).  Fixed credit = fixed useful-work proxy;
+        # rows then rank identically by TF/s and by seconds.
+        nb = t // _block_sizes(t, 512, 512)[0]
+        flops *= (nb + 1) / (2 * nb)
+    fa = functools.partial(flash_attention, causal=causal,
+                           block_q=rbq, block_k=rbk)
+    full_tag = f"{tag} bq{rbq} bk{rbk}"
+    s = _time(lambda x: fa(x, q, q).astype(jnp.bfloat16), q)
+    rows.append(Row(f"fwd {full_tag}", s, flops=flops))
+
+    def fa_grad(x):
+        g = jax.grad(lambda y: jnp.sum(fa(y, q, q) * 1e-6))(x)
+        return g.astype(jnp.bfloat16)
+    s = _time(fa_grad, q)
+    rows.append(Row(f"fwd+bwd {full_tag}", s, flops=3.5 * flops))
+    return flops
+
+
 def breakdown(family: str = "bert", batch: Optional[int] = None,
               seq: Optional[int] = None) -> list[Row]:
     if family == "bert":
@@ -121,26 +162,10 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     s = _time(lambda x: jax.nn.gelu(x), mk(5, (b, t, f)))
     rows.append(Row("gelu (B,T,F)", s, bytes_moved=2.0 * bt * f * 2))
 
-    # --- attention ----------------------------------------------------
-    from dtf_tpu.ops.flash_attention import flash_attention, _block_sizes
+    # --- attention (shared accounting: _attn_rows) --------------------
     hd = d // h
-    q = mk(6, (b, h, t, hd))
-    attn_flops = 4.0 * b * h * t * t * hd          # qk + pv
-    if causal:
-        # the kernel skips blocks above the diagonal: of nb^2 block pairs
-        # only nb(nb+1)/2 execute (diagonal blocks half-masked but still
-        # computed, so credit them fully)
-        nb = t // _block_sizes(t, 512, 512)[0]
-        attn_flops *= (nb + 1) / (2 * nb)
-    fa = functools.partial(flash_attention, causal=causal)
-    s = _time(lambda x: fa(x, q, q).astype(jnp.bfloat16), q)
-    rows.append(Row("flash attention fwd", s, flops=attn_flops))
-
-    def fa_grad(x):
-        g = jax.grad(lambda y: jnp.sum(fa(y, q, q) * 1e-6))(x)
-        return g.astype(jnp.bfloat16)
-    s = _time(fa_grad, q)
-    rows.append(Row("flash attention fwd+bwd", s, flops=3.5 * attn_flops))
+    attn_flops = _attn_rows(rows, b, t, h, hd, 512, 512, causal,
+                            "flash attention")
 
     # --- one whole block: fwd, then fwd+bwd --------------------------
     from dtf_tpu.models.gpt import GPTBlock, GPTConfig
@@ -188,16 +213,75 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     return rows
 
 
+def attn_sweep(family: str = "bert", batch: Optional[int] = None,
+               seq: Optional[int] = None,
+               blocks=(128, 256, 512)) -> list[Row]:
+    """Attention-kernel efficiency sweep for the MFU close-or-retire
+    question (r3 VERDICT #2): is the flash kernel at its SHAPE ceiling?
+
+    Two experiments at the benchmark shapes:
+
+    * **block-size sweep**: fwd and fwd+bwd at every (block_q, block_k)
+      in ``blocks``² — if no config beats the 512/512 default, tiling is
+      not the bottleneck;
+    * **Dh ablation**: (B, 12, T, 64) vs (B, 6, T, 128) — SAME total
+      FLOPs (H·Dh = 768 fixed), so if TF/s ~doubles at Dh=128 the gap is
+      shape-imposed (Dh=64 fills half the 128-lane MXU contraction on
+      the q·kᵀ matmul) and the kernel is at its ceiling; if it does not,
+      the kernel is leaving performance on the table.
+
+    The shape ceiling to compare against is ~peak/2 at Dh=64.
+    """
+    from dtf_tpu.ops.flash_attention import _block_sizes
+
+    if family == "bert":
+        b, t, causal = batch or 64, seq or 512, False
+    else:
+        b, t, causal = batch or 32, seq or 1024, True
+    rows: list[Row] = []
+
+    seen = set()
+    for bq in blocks:
+        for bk in blocks:
+            # _block_sizes clamps to divisors of T; dedupe combos that
+            # resolve identically (at T=128 the whole grid collapses).
+            resolved = _block_sizes(t, bq, bk)
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            _attn_rows(rows, b, t, 12, 64, *resolved, causal, "H12 Dh64")
+    # Dh ablation at the default tiling: same FLOPs, double the MXU
+    # contraction depth.
+    _attn_rows(rows, b, t, 6, 128, 512, 512, causal,
+               "H6 Dh128 (same FLOPs)")
+    return rows
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--family", choices=["bert", "gpt"], default="bert")
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--seq", type=int, default=None)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (reliable even when "
+                             "a TPU plugin is registered)")
+    parser.add_argument("--attn_sweep", action="store_true",
+                        help="attention block-size sweep + Dh shape "
+                             "ablation instead of the layer breakdown "
+                             "(the r4 MFU close-or-retire evidence)")
     ns = parser.parse_args(argv)
+    if ns.cpu:
+        jax.config.update("jax_platforms", "cpu")
     peak = peak_flops_per_chip()
-    rows = breakdown(ns.family, ns.batch, ns.seq)
-    print(f"# {ns.family} layer breakdown "
-          f"(peak {peak / 1e12 if peak else float('nan'):.0f} TF/s bf16)")
+    if ns.attn_sweep:
+        rows = attn_sweep(ns.family, ns.batch, ns.seq)
+        print(f"# {ns.family} attention sweep "
+              f"(peak {peak / 1e12 if peak else float('nan'):.0f} TF/s "
+              f"bf16; Dh=64 shape ceiling ~peak/2)")
+    else:
+        rows = breakdown(ns.family, ns.batch, ns.seq)
+        print(f"# {ns.family} layer breakdown "
+              f"(peak {peak / 1e12 if peak else float('nan'):.0f} TF/s bf16)")
     for r in rows:
         print(r.line(peak))
     return 0

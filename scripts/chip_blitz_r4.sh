@@ -12,12 +12,19 @@ OUT=${1:-/tmp/r4_blitz}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+# Timeouts are sized >=3x the r3-measured compile+run time of each step
+# (worst measured compile ~20 min for unroll+accum, which this script
+# AVOIDS) — a timeout firing mid-compile is the known relay-wedging
+# action, so the margins are deliberately generous and a health probe
+# runs after every step to catch a wedged relay early.
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 to=$2; shift 2
   echo "=== $name (timeout ${to}s) ==="
   timeout "$to" "$@" >"$OUT/$name.log" 2>&1
   echo "rc=$? -> $OUT/$name.log"
   tail -5 "$OUT/$name.log"
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
+    || echo "WARNING: relay health probe FAILED after $name - STOP and check"
 }
 
 # 1a. Headline matmul bench -> the BENCH_r04 shape the driver captures.
@@ -42,6 +49,15 @@ run gpt_attn_unroll 3600 python -m dtf_tpu.workloads.lm \
 run fused_decode_1 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
   --bf16 --steps 2 --generate 512 --decode_fused
 
+# 2. MFU close-or-retire evidence: attention block-size sweep + Dh
+#    shape ablation (bench/breakdown.py --attn_sweep).  If no tiling
+#    beats 512/512 AND Dh=128 ~doubles TF/s at equal FLOPs, the kernel
+#    is at its shape ceiling and the 45%% target retires with proof.
+run attn_sweep_bert 3600 python -m dtf_tpu.bench.breakdown \
+  --attn_sweep --family bert
+run attn_sweep_gpt 3600 python -m dtf_tpu.bench.breakdown \
+  --attn_sweep --family gpt
+
 # 3. Mosaic-validate the batched fused kernel + in-kernel RoPE (r3 landed
 #    interpret-only; the (B,T,.)->(B*T,.) major-dim reshapes are the
 #    legality risk).  LLaMA-style preset exercises RoPE+GQA+SwiGLU.
@@ -50,20 +66,21 @@ for b in 2 4 8; do
     --bf16 --steps 2 --generate 256 --gen_batch "$b" --decode_fused
 done
 
-# 6. Fused beam search (new this round): width-4 on one stream.
+# 4. Fused beam search (new this round): width-4 on one stream.
 run fused_beam4 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
   --bf16 --steps 2 --generate 256 --beam_size 4 --decode_fused
 run beam4_unfused 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
   --bf16 --steps 2 --generate 256 --beam_size 4
 
-# 4. T5 + BERT+MoE rows (first real-chip perf rows for these families).
-run t5_base 3600 python -m dtf_tpu.workloads.t5_pretrain \
-  --preset base --bf16 --remat --per_device_batch 32 --steps 30
+# 5. T5 + BERT+MoE rows (first real-chip perf rows for these families).
+# seq2seq has no --remat flag; T5-small bf16 at seq 512 fits without it.
+run t5_small 3600 python -m dtf_tpu.workloads.seq2seq \
+  --preset small --bf16 --seq_len 512 --per_device_batch 16 --steps 30
 run bert_moe 3600 python -m dtf_tpu.workloads.bert_pretrain \
   --preset base --bf16 --remat --moe_experts 8 \
   --per_device_batch 32 --steps 30
 
-# 5. int8 quality on TRAINED weights: train GPT-2-small a few thousand
+# 6. int8 quality on TRAINED weights: train GPT-2-small a few thousand
 #    steps on the Markov LM task, checkpoint, score.  Longest step last.
 run train_gpt2s 14400 python -m dtf_tpu.workloads.lm --preset gpt2_small \
   --bf16 --remat --remat_policy attn --per_device_batch 8 --steps 3000 \

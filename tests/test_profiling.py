@@ -116,3 +116,25 @@ class TestSummarizeTrace:
         from dtf_tpu.utils.profiling import summarize_trace
         with _pytest.raises(FileNotFoundError, match="trace.json.gz"):
             summarize_trace(str(tmp_path))
+
+
+class TestAttnSweep:
+    @pytest.mark.slow
+    def test_sweep_rows_dedupe_and_report(self):
+        """attn_sweep (the r4 MFU close-or-retire evidence tool): at T=128
+        the whole block grid clamps to one combo, plus the Dh ablation —
+        4 rows (fwd + fwd+bwd each), every row with positive time and
+        FLOPs, and the Dh=128 row carries the SAME FLOPs as the Dh=64 row
+        (the ablation's whole point)."""
+        from dtf_tpu.bench.breakdown import attn_sweep
+
+        rows = attn_sweep("bert", batch=1, seq=128)
+        names = [r.name for r in rows]
+        assert len(names) == len(set(names))
+        assert len(rows) == 4
+        assert all(r.seconds > 0 and r.flops > 0 for r in rows)
+        by = {r.name: r for r in rows}
+        f64 = by["fwd H12 Dh64 bq128 bk128"].flops
+        # the ablation tag names the RESOLVED tiling (clamped at T=128)
+        f128 = by["fwd H6 Dh128 (same FLOPs) bq128 bk128"].flops
+        assert f64 == f128
