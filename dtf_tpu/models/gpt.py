@@ -704,8 +704,9 @@ class GPT(Module):
 
         ``fused=True`` routes each decode token through the single-
         ``pallas_call`` stack kernel (ops/decode_kernel.py) instead of the
-        op-per-op layer scan — batches up to 8 streams; composes with
-        ``int8_weights``.
+        op-per-op layer scan — up to 32 streams (in sublane tiles of 8
+        on an inner grid dim, so layer weights stream once per layer
+        regardless of stream count); composes with ``int8_weights``.
         """
         from dtf_tpu.nn.sampling import sample_token
 
@@ -766,7 +767,8 @@ class GPT(Module):
         ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
         op count drops from ~170 to ~12, attacking the measured
         op-latency floor of the unfused loop (BASELINE.md round 2).
-        Up to 8 streams; the cache runs row-major (L, B, T, KVH·Dh) and
+        Up to 32 streams (tiles of 8 beyond the first sublane tile);
+        the cache runs row-major (L, B, T, KVH·Dh) and
         the kernel's k/v outputs are written back with one
         ``dynamic_update_slice`` per token."""
         from dtf_tpu.nn.sampling import sample_token
@@ -809,19 +811,13 @@ class GPT(Module):
 
     def _check_fused_decode(self, n_streams: int) -> None:
         """The fused stack kernel's preconditions, shared by generate and
-        beam (ONE place so the two paths cannot drift): at most
-        ``MAX_FUSED_STREAMS`` streams (one sublane tile — per-layer cache
-        blocks outgrow VMEM beyond that anyway), no pipeline parallelism."""
-        from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS
+        beam (ONE place so the two paths cannot drift): the kernel's
+        stream-count rule (``validate_stream_count`` — up to
+        MAX_FUSED_STREAMS, in sublane tiles of 8 beyond the first), no
+        pipeline parallelism."""
+        from dtf_tpu.ops.decode_kernel import validate_stream_count
 
-        if n_streams > MAX_FUSED_STREAMS:
-            raise ValueError(
-                f"fused decode streams (batch, or batch x beams) are "
-                f"capped at {MAX_FUSED_STREAMS}, i.e. at most "
-                f"{MAX_FUSED_STREAMS} rows of one sublane tile; "
-                f"got {n_streams} — use the unfused path (the op-per-op "
-                f"loop already amortizes weight streaming at large batch) "
-                f"or shrink the batch/beam")
+        validate_stream_count(n_streams)
         if self.cfg.pipeline_mesh is not None:
             raise ValueError("fused decode does not compose with pipeline "
                              "parallelism")
@@ -891,7 +887,8 @@ class GPT(Module):
 
         ``fused=True`` runs each decode token through the single-
         ``pallas_call`` stack kernel (ops/decode_kernel.py): the W beams
-        are exactly W decode streams (B·W <= 8, the kernel's stream cap),
+        are exactly W decode streams (B·W within the kernel's stream
+        rule — up to 32, multiples of 8 beyond the first tile),
         the beam bookkeeping — top-W over W·V, cache-row reordering —
         stays outside the kernel where XLA already handles it well.
         Composes with ``int8_weights``.

@@ -48,10 +48,31 @@ from dtf_tpu.ops.flash_attention import _interpret_default
 
 NEG_BIG = -1e30
 
-# One sublane tile of decode streams; per-layer cache blocks outgrow VMEM
-# beyond this anyway.  Shared by the kernel guard, GPT._check_fused_decode,
-# and the lm workload's CLI pre-check so the cap cannot drift.
-MAX_FUSED_STREAMS = 8
+# Stream capacity of the fused decode kernel.  Streams run in sublane
+# tiles of 8: 1-8 streams are one tile; 9-32 must be a multiple of 8 and
+# ride a (layers, batch_tiles) grid with the batch-tile dim INNERMOST, so
+# each layer's weights stream to VMEM once and are reused by every tile —
+# the whole point of batched decode.  Above 32 the per-tile cache blocks
+# plus double-buffered weights outgrow VMEM.  Shared by the kernel guard,
+# GPT._check_fused_decode, and the lm workload's CLI pre-check so the cap
+# cannot drift.
+MAX_FUSED_STREAMS = 32
+STREAM_TILE = 8
+
+
+def validate_stream_count(n: int) -> None:
+    """The ONE definition of which stream counts the fused kernel takes."""
+    if n > MAX_FUSED_STREAMS:
+        raise ValueError(
+            f"fused decode streams (batch, or batch x beams) are capped "
+            f"at {MAX_FUSED_STREAMS}; got {n} — use the unfused path (the "
+            f"op-per-op loop already amortizes weight streaming at large "
+            f"batch) or shrink the batch/beam")
+    if n > STREAM_TILE and n % STREAM_TILE:
+        raise ValueError(
+            f"fused decode streams beyond {STREAM_TILE} must be a "
+            f"multiple of the sublane tile ({STREAM_TILE}); got {n} — "
+            f"pad the batch or use the unfused path")
 
 
 def quantize_cols(w):
@@ -145,16 +166,21 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     x_out, k_new, v_new = refs[n_in:n_in + 3]
     x_s = refs[n_in + 3]
     l = pl.program_id(0)
+    bt = pl.program_id(1)
     g = num_heads // kv_heads
     scale = head_dim ** -0.5
     pos = r["pos"][0]
     cd = compute_dtype
+    # This grid step's slice of the residual scratch: the scratch holds
+    # ALL streams (total_b, D); each (layer, batch-tile) step works on
+    # its tile's rows and carries them to the next layer's visit.
+    rows = pl.ds(bt * batch, batch)
 
     @pl.when(l == 0)
     def _init():
-        x_s[...] = r["x"][...].astype(jnp.float32)
+        x_s[rows] = r["x"][...].astype(jnp.float32)
 
-    x = x_s[...]                                       # (B, D) f32
+    x = x_s[rows]                                      # (tile_b, D) f32
     sc = lambda name: r.get(name + "_sc")
     mm = lambda h, name: _mm(h, r[name], sc(name), 0, cd)
     f32 = jnp.float32
@@ -253,7 +279,7 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     y = mm(u.astype(cd), "w_fc2") + r["b_fc2"][0].astype(f32)
     x = x + y
 
-    x_s[...] = x
+    x_s[rows] = x
     x_out[...] = x.astype(out_dtype)
 
 
@@ -263,11 +289,13 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
 
     pack: ``fused_decode_pack`` output; cache_k/v: row-major
     (L, B, T, KVH·Dh) in the cache dtype; x: (B, D) embedded tokens
-    (B <= 8 — one sublane tile; per-layer cache blocks outgrow VMEM
-    beyond that anyway); pos: scalar int32 position of this token (its
-    row in the cache is written by the CALLER from the returned k/v —
-    the kernel only reads strictly-older rows and folds the current
-    token in online-softmax style).
+    (B <= MAX_FUSED_STREAMS; beyond one sublane tile of 8 the batch
+    rides an inner grid dimension in tiles of STREAM_TILE, so layer
+    weights stream to VMEM once per layer and every tile reuses them);
+    pos: scalar int32 position of this token (its row in the cache is
+    written by the CALLER from the returned k/v — the kernel only reads
+    strictly-older rows and folds the current token in online-softmax
+    style).
     ``rope_cos``/``rope_sin``: fp32 (Dh//2,) angle tables for THIS position
     (``nn.rope.rope_angles(pos, Dh)``) — when given, q and the new k are
     rotated in-kernel (split-half convention, matching ``apply_rope``).
@@ -284,17 +312,17 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     if x.shape != (b, d):
         raise ValueError(f"x must be ({b}, {d}) to match the cache's "
                          f"batch dim, got {x.shape}")
-    if b > MAX_FUSED_STREAMS:
-        raise ValueError(
-            f"fused decode batches at most {MAX_FUSED_STREAMS} streams "
-            f"(one sublane tile); "
-            f"got {b} — use the unfused --gen_batch path beyond that")
-    cache_mb = 2 * b * t_cache * kn * cache_k.dtype.itemsize / 2 ** 20
+    validate_stream_count(b)
+    tile_b = b if b <= STREAM_TILE else STREAM_TILE
+    n_bt = b // tile_b
+    cache_mb = (2 * tile_b * t_cache * kn
+                * cache_k.dtype.itemsize / 2 ** 20)
     if cache_mb > 40:
         raise ValueError(
-            f"per-layer k+v cache blocks are {cache_mb:.0f} MB (B={b}, "
-            f"T={t_cache}); double-buffered they exceed VMEM — shrink "
-            f"the batch or generation length, or use the unfused path")
+            f"per-(layer, tile) k+v cache blocks are {cache_mb:.0f} MB "
+            f"(tile {tile_b}, T={t_cache}); double-buffered they exceed "
+            f"VMEM — shrink the generation length or use the unfused "
+            f"path")
 
     compute_dtype = pack["ln1_s"].dtype
     hn = nh * hd
@@ -306,22 +334,24 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     segm = (lane((hn, nh), 0) // hd == lane((hn, nh), 1)).astype(
         compute_dtype)
     segb = segm.T
+    # Every index_map takes (layer, batch_tile); grid-invariant inputs
+    # pin both to block 0.
     keys, args, in_specs = ["pos", "x", "kc", "vc", "segm", "segb"], [
         jnp.asarray(pos, jnp.int32).reshape(1), x, cache_k, cache_v,
         segm, segb], [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((b, d), lambda l: (0, 0)),
-        pl.BlockSpec((1, b, t_cache, kn), lambda l: (l, 0, 0, 0)),
-        pl.BlockSpec((1, b, t_cache, kn), lambda l: (l, 0, 0, 0)),
-        pl.BlockSpec((hn, nh), lambda l: (0, 0)),
-        pl.BlockSpec((nh, hn), lambda l: (0, 0)),
+        pl.BlockSpec((tile_b, d), lambda l, t: (t, 0)),
+        pl.BlockSpec((1, tile_b, t_cache, kn), lambda l, t: (l, t, 0, 0)),
+        pl.BlockSpec((1, tile_b, t_cache, kn), lambda l, t: (l, t, 0, 0)),
+        pl.BlockSpec((hn, nh), lambda l, t: (0, 0)),
+        pl.BlockSpec((nh, hn), lambda l, t: (0, 0)),
     ]
     if g > 1:
         i, j = lane((kn, hn), 0), lane((kn, hn), 1)
         expm = (i == (j // (g * hd)) * hd + j % hd).astype(compute_dtype)
         keys.append("expm")
         args.append(expm)
-        in_specs.append(pl.BlockSpec((kn, hn), lambda l: (0, 0)))
+        in_specs.append(pl.BlockSpec((kn, hn), lambda l, t: (0, 0)))
     if rope_cos is not None:
         half = hd // 2
         # per-head swap-halves with sign: out[h·Dh+i] = -x[h·Dh+i+half]
@@ -345,34 +375,37 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                      jnp.tile(sdoubled, reps)[None],
                      swap_matrix(reps * hd)]
             n_l = reps * hd
-            in_specs += [pl.BlockSpec((1, n_l), lambda l: (0, 0)),
-                         pl.BlockSpec((1, n_l), lambda l: (0, 0)),
-                         pl.BlockSpec((n_l, n_l), lambda l: (0, 0))]
+            in_specs += [pl.BlockSpec((1, n_l), lambda l, t: (0, 0)),
+                         pl.BlockSpec((1, n_l), lambda l, t: (0, 0)),
+                         pl.BlockSpec((n_l, n_l), lambda l, t: (0, 0))]
     for name, arr in pack.items():
         keys.append(name)
         args.append(arr)
         blk = (1, *arr.shape[1:])
         in_specs.append(pl.BlockSpec(
-            blk, lambda l, _n=len(arr.shape): (l,) + (0,) * (_n - 1)))
+            blk, lambda l, t, _n=len(arr.shape): (l,) + (0,) * (_n - 1)))
 
     # Compute in the packed weights' dtype (bf16 in the benchmarks, fp32
     # in CPU parity tests); int8-packed weights widen to the LN params'
     # dtype, which the int8 pack leaves unquantized.
     kernel = functools.partial(
         _decode_kernel, keys=tuple(keys), num_layers=n_layers,
-        num_heads=nh, kv_heads=kvh, head_dim=hd, batch=b,
+        num_heads=nh, kv_heads=kvh, head_dim=hd, batch=tile_b,
         mlp_act=cfg.mlp_act,
         compute_dtype=compute_dtype, cache_dtype=cache_k.dtype,
         out_dtype=x.dtype, eps=1e-6)
 
+    # Grid: batch tiles INNERMOST, so a layer's weight blocks stay
+    # resident in VMEM while every tile consumes them (one weight DMA
+    # per layer per token regardless of stream count).
     x_out, k_new, v_new = pl.pallas_call(
         kernel,
-        grid=(n_layers,),
+        grid=(n_layers, n_bt),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((b, d), lambda l: (0, 0)),
-            pl.BlockSpec((1, b, kn), lambda l: (l, 0, 0)),
-            pl.BlockSpec((1, b, kn), lambda l: (l, 0, 0)),
+            pl.BlockSpec((tile_b, d), lambda l, t: (t, 0)),
+            pl.BlockSpec((1, tile_b, kn), lambda l, t: (l, t, 0)),
+            pl.BlockSpec((1, tile_b, kn), lambda l, t: (l, t, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, d), x.dtype),

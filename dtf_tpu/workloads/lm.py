@@ -23,7 +23,8 @@ def main(argv=None) -> int:
     from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
     from dtf_tpu.data.datasets import synthetic_text
     from dtf_tpu.models.gpt import GPT, GPTConfig
-    from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS
+    from dtf_tpu.ops.decode_kernel import (MAX_FUSED_STREAMS, STREAM_TILE,
+                                           validate_stream_count)
     from dtf_tpu.train.metrics import MetricLogger
     from dtf_tpu.utils.timing import block
     from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
@@ -74,9 +75,9 @@ def main(argv=None) -> int:
                         help=f"decode through the fused stack kernel "
                              f"(ops/decode_kernel.py): ONE pallas_call "
                              f"per token instead of the op-per-op layer "
-                             f"scan (gen_batch <= {MAX_FUSED_STREAMS}; "
-                             f"with --beam_size, gen_batch x beam_size "
-                             f"<= {MAX_FUSED_STREAMS})")
+                             f"scan (gen_batch x max(beam_size, 1) <= "
+                             f"{MAX_FUSED_STREAMS}; beyond {STREAM_TILE} "
+                             f"streams, a multiple of {STREAM_TILE})")
     parser.add_argument("--decode_int8", action="store_true",
                         help="int8-quantize the decode weights (per "
                              "output channel): half the HBM weight "
@@ -96,12 +97,10 @@ def main(argv=None) -> int:
     # Fail fast on the fused-decode preconditions (models/gpt.py
     # _check_fused_decode) BEFORE the training run, not after it.
     if ns.generate > 0 and ns.decode_fused:
-        streams = ns.gen_batch * max(ns.beam_size, 1)
-        if streams > MAX_FUSED_STREAMS:
-            parser.error(
-                f"--decode_fused runs gen_batch x beam_size streams "
-                f"through the stack kernel, capped at {MAX_FUSED_STREAMS}; "
-                f"got {streams}")
+        try:
+            validate_stream_count(ns.gen_batch * max(ns.beam_size, 1))
+        except ValueError as exc:
+            parser.error(str(exc))
         if ns.pipeline_microbatches > 0:
             parser.error("--decode_fused does not compose with pipeline "
                          "parallelism (--pipeline_microbatches)")

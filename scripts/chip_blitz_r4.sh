@@ -61,10 +61,14 @@ run attn_sweep_gpt 3600 python -m dtf_tpu.bench.breakdown \
 # 3. Mosaic-validate the batched fused kernel + in-kernel RoPE (r3 landed
 #    interpret-only; the (B,T,.)->(B*T,.) major-dim reshapes are the
 #    legality risk).  LLaMA-style preset exercises RoPE+GQA+SwiGLU.
-for b in 2 4 8; do
+for b in 2 4 8 16 32; do
   run fused_batched_$b 1800 python -m dtf_tpu.workloads.lm --preset llama \
     --bf16 --steps 2 --generate 256 --gen_batch "$b" --decode_fused
 done
+# aggregate-throughput comparison point: unfused at 32 streams (r2: 3,571
+# aggregate tok/s; the tiled fused kernel should beat it substantially)
+run unfused_batched_32 1800 python -m dtf_tpu.workloads.lm --preset llama \
+  --bf16 --steps 2 --generate 256 --gen_batch 32
 
 # 4. Fused beam search (new this round): width-4 on one stream.
 run fused_beam4 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \

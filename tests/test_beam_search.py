@@ -182,8 +182,23 @@ class TestFusedBeamSearch:
 
     def test_stream_cap_enforced(self, model, params):
         prompt = jnp.zeros((2, 4), jnp.int32)
-        with pytest.raises(ValueError, match="capped at 8"):
-            model.beam_search(params, prompt, 4, beam_size=8, fused=True)
+        with pytest.raises(ValueError, match="capped at"):
+            model.beam_search(params, prompt, 4, beam_size=24, fused=True)
+        # beyond one sublane tile, B*W must be a multiple of 8
+        with pytest.raises(ValueError, match="multiple of the sublane"):
+            model.beam_search(params, prompt, 4, beam_size=6, fused=True)
+
+    def test_two_prompts_beam8_tiled_matches_unfused(self, model, params):
+        """B=2 x W=8 = 16 streams: the fused beam rides two sublane tiles
+        and must match the unfused beam exactly."""
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(0, 16, (2, 4)), jnp.int32)
+        ref, ref_s = model.beam_search(params, prompt, 5, beam_size=8)
+        got, got_s = model.beam_search(params, prompt, 5, beam_size=8,
+                                       fused=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                                   atol=1e-4)
 
     def test_under_jit(self, model, params):
         prompt = jnp.asarray([[5, 11, 2, 8]], jnp.int32)

@@ -101,11 +101,35 @@ class TestFusedDecode:
                                   int8_weights=True))
         assert np.array_equal(b[:, 8:12], c[:, 8:12])
 
-    def test_batch_gt8_rejected(self):
+    def test_stream_count_rules(self):
+        """Streams beyond one sublane tile must be a multiple of 8; the
+        hard cap is MAX_FUSED_STREAMS."""
+        from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS
+
         m, p = mk()
-        pr = prompt_of(m, b=9)
-        with pytest.raises(ValueError, match="at most 8"):
-            m.generate(p, pr, 4, fused=True)
+        with pytest.raises(ValueError, match="multiple of the sublane"):
+            m.generate(p, prompt_of(m, b=9), 4, fused=True)
+        with pytest.raises(ValueError, match="capped at"):
+            m.generate(p, prompt_of(m, b=MAX_FUSED_STREAMS + 8), 4,
+                       fused=True)
+
+    def test_batch16_tiled_matches_unfused(self):
+        """16 streams ride two sublane tiles on the inner grid dim; greedy
+        tokens must match the unfused loop stream-for-stream."""
+        m, p = mk()
+        pr = prompt_of(m, b=16)
+        a = m.generate(p, pr, 8, temperature=0.0)
+        b = m.generate(p, pr, 8, temperature=0.0, fused=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_batch32_tiled_gqa_matches_unfused(self):
+        """The full cap (32 streams, four tiles) with the LLaMA-style
+        wiring (RoPE + GQA + SwiGLU)."""
+        m, p = mk(rope=True, num_kv_heads=2, mlp_act="swiglu")
+        pr = prompt_of(m, b=32)
+        a = m.generate(p, pr, 6, temperature=0.0)
+        b = m.generate(p, pr, 6, temperature=0.0, fused=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_rope_llama_style_matches_unfused(self):
         """Full LLaMA-style wiring (RoPE in-kernel via the swap-halves
