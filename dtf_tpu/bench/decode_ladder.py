@@ -1,0 +1,145 @@
+"""Reproducible decode-throughput ladder — the honest decode number.
+
+The only trustworthy per-token time through the axon relay is the
+marginal one: the least-squares slope of ``max_new_tokens -> wall time``
+over a ladder of generation lengths (``utils.timing.time_linfit``).
+Spot timings carry ~50-250 ms of fixed relay cost per synced call, and
+the relay *memoizes* bitwise-identical executions (BASELINE.md round-3
+"timing methodology correction"), so this harness also perturbs the
+prompt between repetitions — every timed call is a genuinely new
+execution.
+
+One command per BASELINE.md decode row::
+
+    python -m dtf_tpu.bench.decode_ladder --preset gpt2_small \
+        --mode fused --streams 32            # tiled fused kernel
+    python -m dtf_tpu.bench.decode_ladder --preset llama \
+        --mode fused --streams 1 --int8      # int8 weights in-kernel
+    python -m dtf_tpu.bench.decode_ladder --preset gpt2_small \
+        --mode fused --beam 4                # beam through the kernel
+
+The reference has no decode path at all (TF1 parameter-server MNIST
+demo); these rows are framework-beyond-parity serving numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
+        int8: bool = False, beam: int = 0, ladder=(32, 64, 128),
+        reps: int = 3, prompt_len: int = 8, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    from dtf_tpu.utils.timing import time_linfit
+
+    fused = mode == "fused"
+    # Increasing, deduped ladder with >=2 points: the fit needs a real
+    # slope, and the no-signal check reads the shortest-vs-longest run.
+    ladder = tuple(sorted(set(ladder)))
+    if len(ladder) < 2:
+        raise ValueError(f"ladder needs >=2 distinct lengths, got {ladder}")
+    max_new = max(ladder)
+    cfg = GPTConfig.from_preset(
+        preset, dtype=jnp.bfloat16,
+        max_len=max(prompt_len + max_new + 1, 128))
+    model = GPT(cfg)
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), model.init(jax.random.key(seed)))
+
+    base_prompt = jax.random.randint(
+        jax.random.key(seed + 1), (streams, prompt_len), 0, cfg.vocab_size)
+
+    def gen_fn(k):
+        if beam > 0:
+            return jax.jit(lambda p, pr: model.beam_search(
+                p, pr, k, beam_size=beam, int8_weights=int8,
+                fused=fused)[0])
+        return jax.jit(lambda p, pr: model.generate(
+            p, pr, k, temperature=0.0, int8_weights=int8, fused=fused))
+
+    # Perturb the prompt each call: the relay memoizes bitwise-identical
+    # executions.  A deterministic token shift keeps runs reproducible
+    # while making every execution distinct.
+    counter = [0]
+
+    def closure_of(k):
+        g = gen_fn(k)
+
+        def call():
+            counter[0] += 1
+            pr = (base_prompt + counter[0]) % cfg.vocab_size
+            return g(params, pr)
+        return call
+
+    fit = time_linfit(closure_of, ladder, reps=reps)
+    per_token_s = fit.per_iter_s
+    out = {
+        "preset": preset, "mode": mode, "streams": streams,
+        "int8": int8, "beam": beam,
+        "ladder": [[k, round(t * 1e3, 2)] for k, t in fit.points],
+        "per_token_us": per_token_s * 1e6,
+        "fit_overhead_ms": fit.overhead_s * 1e3,
+        "device": str(jax.devices()[0]),
+    }
+    # time_linfit clamps the slope to >= 1e-12, so "no signal" must be
+    # detected directly: the longest chain must actually take longer
+    # than the shortest (ladder passed in increasing order), and the
+    # per-token time must be physically plausible (>1 ns).
+    times = [t for _, t in fit.points]
+    if times[-1] <= times[0] or per_token_s <= 1e-9:
+        out["tok_s_per_stream"] = out["tok_s_aggregate"] = None
+        out["warning"] = ("non-positive slope — ladder is "
+                          "noise-dominated; lengthen --ladder or raise "
+                          "--reps")
+    else:
+        out["tok_s_per_stream"] = 1.0 / per_token_s
+        out["tok_s_aggregate"] = streams / per_token_s
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--preset", default="gpt2_small",
+                        choices=["gpt2_small", "llama", "tiny"])
+    parser.add_argument("--mode", choices=["fused", "unfused"],
+                        default="fused")
+    parser.add_argument("--streams", type=int, default=1)
+    parser.add_argument("--int8", action="store_true")
+    parser.add_argument("--beam", type=int, default=0,
+                        help=">0: beam search of this width (tokens "
+                             "counted per batch row, beams are search "
+                             "overhead)")
+    parser.add_argument("--ladder", default="32,64,128",
+                        help="comma-separated max_new_tokens ladder")
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (reliable even when "
+                             "a TPU plugin is registered)")
+    ns = parser.parse_args(argv)
+    if ns.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ladder = tuple(int(k) for k in ns.ladder.split(","))
+    r = run(ns.preset, ns.mode, ns.streams, ns.int8, ns.beam, ladder,
+            ns.reps)
+    beam_tag = f" beam={r['beam']}" if r["beam"] else ""
+    int8_tag = " int8" if r["int8"] else ""
+    print(f"{r['preset']} {r['mode']}{int8_tag}{beam_tag} "
+          f"x{r['streams']} streams on {r['device']}")
+    print(f"ladder (max_new_tokens, best ms): {r['ladder']}")
+    if r.get("warning"):
+        print(f"NO RESULT: {r['warning']}")
+        return 1
+    print(f"per-token {r['per_token_us']:.1f} us  ->  "
+          f"{r['tok_s_per_stream']:.1f} tok/s/stream, "
+          f"{r['tok_s_aggregate']:.1f} tok/s aggregate "
+          f"(fixed overhead {r['fit_overhead_ms']:.0f} ms absorbed)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

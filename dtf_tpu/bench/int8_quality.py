@@ -141,10 +141,8 @@ def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
     from dtf_tpu.data.datasets import synthetic_text
     from dtf_tpu.models.gpt import GPT, GPTConfig
 
-    cfg = {"gpt2_small": GPTConfig.gpt2_small,
-           "llama": GPTConfig.llama_style,
-           "tiny": GPTConfig.tiny}[preset](dtype=jnp.bfloat16,
-                                           max_len=max(seq, gen + 8))
+    cfg = GPTConfig.from_preset(preset, dtype=jnp.bfloat16,
+                                max_len=max(seq, gen + 8))
     model = GPT(cfg)
     ckpt_step = None
     if ckpt is not None:

@@ -98,6 +98,18 @@ class GPTConfig:
         d.update(kw)
         return cls(**d)
 
+    # The ONE preset-name -> constructor mapping for every CLI/benchmark
+    # (lm workload, int8_quality, decode_ladder); "llama" is the CLI
+    # spelling of llama_style.
+    @classmethod
+    def from_preset(cls, name: str, **kw) -> "GPTConfig":
+        ctors = {"gpt2_small": cls.gpt2_small, "llama": cls.llama_style,
+                 "tiny": cls.tiny}
+        if name not in ctors:
+            raise ValueError(f"unknown GPT preset {name!r}; "
+                             f"choose from {sorted(ctors)}")
+        return ctors[name](**kw)
+
     def flash_enabled(self) -> bool:
         if self.use_flash is None:
             return jax.default_backend() == "tpu"

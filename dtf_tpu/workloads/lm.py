@@ -123,9 +123,7 @@ def main(argv=None) -> int:
         kw["pipeline_mesh"] = cluster.mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches
         kw["pipeline_schedule"] = ns.pipeline_schedule
-    cfg = {"gpt2_small": GPTConfig.gpt2_small,
-           "llama": GPTConfig.llama_style,
-           "tiny": GPTConfig.tiny}[ns.preset](**kw)
+    cfg = GPTConfig.from_preset(ns.preset, **kw)
     model = GPT(cfg)
 
     global_batch = global_batch_size(cluster, train_cfg)

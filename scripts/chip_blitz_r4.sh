@@ -42,12 +42,12 @@ run gpt_attn_unroll 3600 python -m dtf_tpu.workloads.lm \
   --preset gpt2_small --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30
 
-# 1d. Re-confirm the fused-decode single-stream ladder (r3: 3,811 tok/s,
-#     builder-measured only).  The workload prints a steady-state rate;
-#     the honest number is the time_linfit ladder in the python API —
-#     use the workload here for a quick confirm, ladder in the follow-up.
-run fused_decode_1 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
-  --bf16 --steps 2 --generate 512 --decode_fused
+# 1d. Re-confirm the fused-decode single-stream number (r3: 3,811 tok/s,
+#     builder-measured only) with the reproducible ladder module.
+run ladder_fused_1 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode fused --streams 1
+run ladder_unfused_1 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode unfused --streams 1
 
 # 2. MFU close-or-retire evidence: attention block-size sweep + Dh
 #    shape ablation (bench/breakdown.py --attn_sweep).  If no tiling
@@ -65,16 +65,23 @@ for b in 2 4 8 16 32; do
   run fused_batched_$b 1800 python -m dtf_tpu.workloads.lm --preset llama \
     --bf16 --steps 2 --generate 256 --gen_batch "$b" --decode_fused
 done
-# aggregate-throughput comparison point: unfused at 32 streams (r2: 3,571
-# aggregate tok/s; the tiled fused kernel should beat it substantially)
-run unfused_batched_32 1800 python -m dtf_tpu.workloads.lm --preset llama \
-  --bf16 --steps 2 --generate 256 --gen_batch 32
+# aggregate-throughput ladder rows: tiled fused vs unfused at 16/32
+# streams (r2 unfused-32: 3,571 aggregate tok/s — the tiled kernel
+# should beat it substantially), plus int8-in-kernel at 32.
+for s in 16 32; do
+  run ladder_fused_$s 2400 python -m dtf_tpu.bench.decode_ladder \
+    --preset gpt2_small --mode fused --streams "$s"
+  run ladder_unfused_$s 2400 python -m dtf_tpu.bench.decode_ladder \
+    --preset gpt2_small --mode unfused --streams "$s"
+done
+run ladder_fused_32_int8 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode fused --streams 32 --int8
 
 # 4. Fused beam search (new this round): width-4 on one stream.
-run fused_beam4 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
-  --bf16 --steps 2 --generate 256 --beam_size 4 --decode_fused
-run beam4_unfused 1800 python -m dtf_tpu.workloads.lm --preset gpt2_small \
-  --bf16 --steps 2 --generate 256 --beam_size 4
+run ladder_beam4_fused 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode fused --beam 4
+run ladder_beam4_unfused 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode unfused --beam 4
 
 # 5. T5 + BERT+MoE rows (first real-chip perf rows for these families).
 # seq2seq has no --remat flag; T5-small bf16 at seq 512 fits without it.

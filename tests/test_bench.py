@@ -302,3 +302,51 @@ class TestInt8Quality:
         assert 0.98 < r["ppl_ratio"] < 1.02
         assert r["tokens_scored"] == 4 * 63
         assert 0.0 <= r["greedy_agreement"] <= 1.0
+
+
+class TestDecodeLadder:
+    @pytest.mark.slow
+    def test_ladder_reports_rates(self):
+        """The reproducible decode ladder (bench.decode_ladder): positive
+        marginal per-token time and consistent aggregate accounting on
+        the tiny preset, fused and unfused."""
+        from dtf_tpu.bench.decode_ladder import run
+
+        r = run("tiny", mode="fused", streams=2, ladder=(4, 8, 16),
+                reps=2)
+        assert r["tok_s_per_stream"] is None or r["tok_s_per_stream"] > 0
+        if r["tok_s_per_stream"]:
+            assert r["tok_s_aggregate"] == pytest.approx(
+                2 * r["tok_s_per_stream"])
+            # a reported rate must be physically plausible, never the
+            # clamped-slope absurdity (time_linfit floors the slope at
+            # 1e-12 s)
+            assert r["tok_s_per_stream"] < 1e9
+        assert len(r["ladder"]) == 3
+
+    @pytest.mark.slow
+    def test_no_signal_ladder_flags_warning(self, monkeypatch):
+        """A noise-dominated ladder (non-increasing times / clamped
+        slope) must yield NO rate, not an absurd one."""
+        import dtf_tpu.bench.decode_ladder as dl
+        import dtf_tpu.utils.timing as timing
+
+        def flat_fit(fn_of_iters, ladder, reps=3):
+            # synthetic clamped-slope fit: no model timing needed
+            return timing.LinFit(per_iter_s=1e-12, overhead_s=0.001,
+                                 points=tuple((k, 0.001) for k in ladder))
+
+        # decode_ladder imports time_linfit inside run(); patch the source
+        monkeypatch.setattr(timing, "time_linfit", flat_fit)
+        r = dl.run("tiny", mode="unfused", streams=1, ladder=(4, 8),
+                   reps=1)
+        assert r["tok_s_per_stream"] is None
+        assert "warning" in r
+
+    @pytest.mark.slow
+    def test_beam_mode_runs(self):
+        from dtf_tpu.bench.decode_ladder import run
+
+        r = run("tiny", mode="unfused", streams=1, beam=2,
+                ladder=(4, 8), reps=2)
+        assert r["beam"] == 2 and len(r["ladder"]) == 2
