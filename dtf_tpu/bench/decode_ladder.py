@@ -29,7 +29,8 @@ import argparse
 
 def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         int8: bool = False, beam: int = 0, ladder=(32, 64, 128),
-        reps: int = 3, prompt_len: int = 8, seed: int = 0) -> dict:
+        reps: int = 3, prompt_len: int = 8, seed: int = 0,
+        kv_int8: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -57,9 +58,10 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         if beam > 0:
             return jax.jit(lambda p, pr: model.beam_search(
                 p, pr, k, beam_size=beam, int8_weights=int8,
-                fused=fused)[0])
+                fused=fused, kv_int8=kv_int8)[0])
         return jax.jit(lambda p, pr: model.generate(
-            p, pr, k, temperature=0.0, int8_weights=int8, fused=fused))
+            p, pr, k, temperature=0.0, int8_weights=int8, fused=fused,
+            kv_int8=kv_int8))
 
     # Perturb the prompt each call: the relay memoizes bitwise-identical
     # executions.  A deterministic token shift keeps runs reproducible
@@ -79,7 +81,7 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
     per_token_s = fit.per_iter_s
     out = {
         "preset": preset, "mode": mode, "streams": streams,
-        "int8": int8, "beam": beam,
+        "int8": int8, "kv_int8": kv_int8, "beam": beam,
         "ladder": [[k, round(t * 1e3, 2)] for k, t in fit.points],
         "per_token_us": per_token_s * 1e6,
         "fit_overhead_ms": fit.overhead_s * 1e3,
@@ -109,6 +111,8 @@ def main(argv=None) -> int:
                         default="fused")
     parser.add_argument("--streams", type=int, default=1)
     parser.add_argument("--int8", action="store_true")
+    parser.add_argument("--kv_int8", action="store_true",
+                        help="int8 KV-cache rows (fused only)")
     parser.add_argument("--beam", type=int, default=0,
                         help=">0: beam search of this width (tokens "
                              "counted per batch row, beams are search "
@@ -125,9 +129,10 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     ladder = tuple(int(k) for k in ns.ladder.split(","))
     r = run(ns.preset, ns.mode, ns.streams, ns.int8, ns.beam, ladder,
-            ns.reps)
+            ns.reps, kv_int8=ns.kv_int8)
     beam_tag = f" beam={r['beam']}" if r["beam"] else ""
-    int8_tag = " int8" if r["int8"] else ""
+    int8_tag = (" int8" if r["int8"] else "") + (
+        " kv-int8" if r.get("kv_int8") else "")
     print(f"{r['preset']} {r['mode']}{int8_tag}{beam_tag} "
           f"x{r['streams']} streams on {r['device']}")
     print(f"ladder (max_new_tokens, best ms): {r['ladder']}")

@@ -132,6 +132,26 @@ def load_checkpoint_params(ckpt_dir: str):
     return state["params"], step
 
 
+def _load_params_for(model_cfg, ckpt: str):
+    """Checkpoint params for a model config, with the position-table
+    bounds guard (positions beyond the trained table would be a SILENT
+    clamped gather — garbage numbers that look valid).  Shared by run()
+    and kv_run() so neither can drop the check."""
+    import jax
+    import jax.numpy as jnp
+
+    params, step = load_checkpoint_params(ckpt)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    if "pos" in params:
+        avail = params["pos"]["table"].shape[0]
+        if model_cfg.max_len > avail:
+            raise ValueError(
+                f"checkpoint position table covers {avail} positions "
+                f"but --seq/--gen need {model_cfg.max_len}; rerun with "
+                f"--seq/--gen within the trained max_len ({avail})")
+    return params, step
+
+
 def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
         gen: int = 256, seed: int = 0, ckpt: str | None = None) -> dict:
     import jax
@@ -146,18 +166,7 @@ def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
     model = GPT(cfg)
     ckpt_step = None
     if ckpt is not None:
-        params, ckpt_step = load_checkpoint_params(ckpt)
-        params = jax.tree_util.tree_map(jnp.asarray, params)
-        if "pos" in params:
-            # Positions beyond the trained table would be a SILENT
-            # out-of-bounds gather (JAX clamps) — garbage numbers that
-            # look like a valid measurement.
-            avail = params["pos"]["table"].shape[0]
-            if cfg.max_len > avail:
-                raise ValueError(
-                    f"checkpoint position table covers {avail} positions "
-                    f"but --seq/--gen need {cfg.max_len}; rerun with "
-                    f"--seq/--gen within the trained max_len ({avail})")
+        params, ckpt_step = _load_params_for(cfg, ckpt)
     else:
         params = model.init(jax.random.key(seed))
     params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
@@ -190,6 +199,88 @@ def run(preset: str = "gpt2_small", batch: int = 8, seq: int = 512,
     return out
 
 
+def kv_run(preset: str = "gpt2_small", batch: int = 4, seq: int = 256,
+           seed: int = 0, prompt_len: int = 8,
+           ckpt: str | None = None) -> dict:
+    """KV-cache int8 quality: teacher-forced perplexity through the FUSED
+    DECODE path with an fp cache vs an int8 cache (``quantize_rows``).
+
+    Weight quantization is measured by ``run`` on the parallel forward;
+    the KV cache only exists on the decode path, so its damage must be
+    measured there: feed the ground-truth token at every position and
+    score the next-token log-prob, once per cache mode.  Also returns
+    ``fp_vs_parallel_delta`` — the fp-cache decode loss minus the same
+    positions' loss from the parallel forward — as a self-check of the
+    harness (must be ~bf16 noise; a bug in the decode loop would show
+    here first).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from dtf_tpu.data.datasets import synthetic_text
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.from_preset(preset, dtype=jnp.bfloat16,
+                                max_len=max(seq, 128))
+    model = GPT(cfg)
+    if ckpt is not None:
+        params, _ = _load_params_for(cfg, ckpt)
+    else:
+        params = model.init(jax.random.key(seed))
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
+                                    params)
+    if seq - 1 <= prompt_len:
+        raise ValueError(f"seq ({seq}) must exceed prompt_len + 1 "
+                         f"({prompt_len + 1}): nothing to teacher-force")
+    toks = jnp.asarray(synthetic_text(batch, seq, cfg.vocab_size,
+                                      seed=seed + 9))
+    positions = jnp.arange(prompt_len, seq - 1)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def decode_loss(params, toks, kv_int8):
+        cache, _ = model._prefill_cache(params, toks[:, :prompt_len],
+                                        model._cache_len(seq))
+        pack, head_q, kv = model._fused_decode_setup(
+            params, cache, False, kv_int8)
+
+        def step(carry, pos):
+            kv, total = carry
+            tok = lax.dynamic_slice(toks, (0, pos), (batch, 1))
+            logits, kv = model._fused_token_logits(
+                params, pack, head_q, kv, tok, pos)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tgt = lax.dynamic_slice(toks, (0, pos + 1), (batch, 1))[:, 0]
+            total += -jnp.take_along_axis(logp, tgt[:, None], 1).sum()
+            return (kv, total), None
+
+        (_, total), _ = lax.scan(step, (kv, jnp.float32(0)), positions)
+        return total / (batch * positions.size)
+
+    l_fp = float(decode_loss(params, toks, False))
+    l_i8 = float(decode_loss(params, toks, True))
+
+    # Same positions' loss from the parallel forward (harness self-check):
+    # the decode loop scores targets prompt_len+1 .. seq-1 (predicted from
+    # rows prompt_len .. seq-2), so slice exactly those.
+    logits = model.apply(params, toks).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    pred_rows = logp[:, prompt_len:seq - 1, :]
+    tgt = toks[:, prompt_len + 1:seq]
+    par = float(-jnp.take_along_axis(
+        pred_rows, tgt[..., None], -1).mean())
+    return {
+        "tokens_scored": batch * int(positions.size),
+        "loss_fp_cache": l_fp, "loss_int8_cache": l_i8,
+        "kv_ppl_ratio": float(np.exp(l_i8 - l_fp)),
+        "fp_vs_parallel_delta": l_fp - par,
+        "weights": "random-init" if ckpt is None else f"trained ({ckpt})",
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--preset", default="gpt2_small",
@@ -198,6 +289,9 @@ def main(argv=None) -> int:
     parser.add_argument("--seq", type=int, default=512)
     parser.add_argument("--gen", type=int, default=256)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kv", action="store_true",
+                        help="ALSO measure int8 KV-cache quality via "
+                             "teacher-forced fused decode (kv_run)")
     parser.add_argument("--ckpt", default=None, metavar="DIR",
                         help="score TRAINED weights from this trainer "
                              "checkpoint directory (must match --preset); "
@@ -226,6 +320,14 @@ def main(argv=None) -> int:
           f"median {r['median_scale_ratio']:.2f}, by family "
           + ", ".join(f"{k}={v:.2f}"
                       for k, v in r['per_family_max'].items()))
+    if ns.kv:
+        kr = kv_run(ns.preset, ns.batch, ns.seq, ns.seed, ckpt=ns.ckpt)
+        print(f"KV-cache int8 (teacher-forced fused decode, "
+              f"{kr['tokens_scored']} tokens): ppl ratio "
+              f"{kr['kv_ppl_ratio']:.6f} "
+              f"({(kr['kv_ppl_ratio'] - 1) * 100:+.4f}%); harness "
+              f"self-check fp-decode vs parallel delta "
+              f"{kr['fp_vs_parallel_delta']:+.5f}")
     return 0
 
 

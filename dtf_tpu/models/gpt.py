@@ -695,7 +695,7 @@ class GPT(Module):
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  rng=None, int8_weights: bool = False,
-                 fused: bool = False):
+                 fused: bool = False, kv_int8: bool = False):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         Two phases, one compiled program:
@@ -736,7 +736,11 @@ class GPT(Module):
             return self._generate_fused(
                 params, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_id=eos_id, rng=rng,
-                int8_weights=int8_weights)
+                int8_weights=int8_weights, kv_int8=kv_int8)
+        if kv_int8:
+            raise ValueError("kv_int8 is a fused-decode feature; pass "
+                             "fused=True (the op-per-op loop keeps the "
+                             "fp cache)")
 
         # Cache bounded to the live total (lane-aligned), not max_len.
         cache, logits = self._prefill_cache(params, prompt,
@@ -774,7 +778,7 @@ class GPT(Module):
 
     def _generate_fused(self, params, prompt, max_new_tokens: int, *,
                         temperature, top_k, top_p, eos_id, rng,
-                        int8_weights):
+                        int8_weights, kv_int8=False):
         """generate()'s decode loop with the whole layer stack fused into
         ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
         op count drops from ~170 to ~12, attacking the measured
@@ -792,8 +796,8 @@ class GPT(Module):
 
         cache, logits = self._prefill_cache(params, prompt,
                                             self._cache_len(total))
-        pack, head_q, ck, cv = self._fused_decode_setup(
-            params, cache, int8_weights)
+        pack, head_q, kv = self._fused_decode_setup(
+            params, cache, int8_weights, kv_int8)
 
         rng, sub = jax.random.split(rng)
         first = sample_token(sub, logits, temperature=temperature,
@@ -804,10 +808,10 @@ class GPT(Module):
         done = (first == eos_id) if eos_id is not None else None
 
         def step(carry, pos):
-            out, ck, cv, rng, done = carry
+            out, kv, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
-            logits, ck, cv = self._fused_token_logits(
-                params, pack, head_q, ck, cv, tok, pos)
+            logits, kv = self._fused_token_logits(
+                params, pack, head_q, kv, tok, pos)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
@@ -815,10 +819,10 @@ class GPT(Module):
                 nxt = jnp.where(done, eos_id, nxt)
                 done = done | (nxt == eos_id)
             out = lax.dynamic_update_slice(out, nxt[:, None], (0, pos + 1))
-            return (out, ck, cv, rng, done), None
+            return (out, kv, rng, done), None
 
-        (out, _, _, _, _), _ = lax.scan(step, (out, ck, cv, rng, done),
-                                        jnp.arange(p_len, total - 1))
+        (out, _, _, _), _ = lax.scan(step, (out, kv, rng, done),
+                                     jnp.arange(p_len, total - 1))
         return out
 
     def _check_fused_decode(self, n_streams: int) -> None:
@@ -834,14 +838,21 @@ class GPT(Module):
             raise ValueError("fused decode does not compose with pipeline "
                              "parallelism")
 
-    def _fused_decode_setup(self, params, cache, int8_weights: bool):
+    def _fused_decode_setup(self, params, cache, int8_weights: bool,
+                            kv_int8: bool = False):
         """Shared fused-decode prologue: kernel weight pack, optional int8
         head quantization, and the (L, B, T, KVH, Dh) -> row-major
         (L, B, T, KVH·Dh) cache reshape.  The stream count (B for
         generate, B·W for beam) is the cache's own batch dim — derived,
         not passed, so a wrong caller value cannot silently scramble the
-        reshape."""
-        from dtf_tpu.ops.decode_kernel import fused_decode_pack
+        reshape.
+
+        Returns (pack, head_q, kv) where ``kv`` is the cache tuple the
+        fused token step threads through the scan: (ck, cv) in fp, or
+        (ck, cv, k_scales, v_scales) when ``kv_int8`` quantizes the
+        cache rows (halved cache DMA per token; ``quantize_rows``)."""
+        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
+                                               quantize_rows)
 
         pack = fused_decode_pack(params, self.cfg, int8=int8_weights)
         head_q = (_quantize_cols(params["tok"]["table"].T)
@@ -849,41 +860,60 @@ class GPT(Module):
         n_l, n_streams, t_c = cache["k"].shape[:3]
         ck = cache["k"].reshape(n_l, n_streams, t_c, -1)
         cv = cache["v"].reshape(n_l, n_streams, t_c, -1)
-        return pack, head_q, ck, cv
+        if not kv_int8:
+            return pack, head_q, (ck, cv)
+        ck, ksc = quantize_rows(ck)
+        cv, vsc = quantize_rows(cv)
+        return pack, head_q, (ck, cv, ksc, vsc)
 
-    def _fused_token_logits(self, params, pack, head_q, ck, cv, tok, pos):
+    def _fused_token_logits(self, params, pack, head_q, kv, tok, pos):
         """One token for all streams through the fused stack kernel: embed
         ``tok`` (B, 1), run ``fused_decode_step``, write the returned k/v
-        rows into the row-major caches at ``pos``, project to logits.
-        Shared by :meth:`_generate_fused` and the fused beam path so the
-        two decode modes cannot drift."""
-        from dtf_tpu.ops.decode_kernel import fused_decode_step
+        rows into the row-major caches at ``pos`` (quantizing them when
+        the cache tuple carries int8 scales), project to logits.  Shared
+        by :meth:`_generate_fused` and the fused beam path so the two
+        decode modes cannot drift."""
+        from dtf_tpu.ops.decode_kernel import (fused_decode_step,
+                                               quantize_rows)
 
         cfg = self.cfg
+        kv_int8 = len(kv) == 4
+        ck, cv = kv[0], kv[1]
         x = self._embed(params, tok, pos[None])[:, 0, :]         # (B, D)
         rope_kw = {}
         if cfg.rope:
             from dtf_tpu.nn.rope import rope_angles
             cos, sin = rope_angles(pos, cfg.dim // cfg.num_heads)
             rope_kw = {"rope_cos": cos, "rope_sin": sin}
+        if kv_int8:
+            rope_kw.update(cache_k_scale=kv[2], cache_v_scale=kv[3])
         x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
                                             **rope_kw)
+        if kv_int8:
+            k_new, ksc_new = quantize_rows(k_new)
+            v_new, vsc_new = quantize_rows(v_new)
+            ksc = lax.dynamic_update_slice(
+                kv[2], ksc_new[:, :, None, :], (0, 0, pos, 0))
+            vsc = lax.dynamic_update_slice(
+                kv[3], vsc_new[:, :, None, :], (0, 0, pos, 0))
         ck = lax.dynamic_update_slice(ck, k_new[:, :, None, :],
                                       (0, 0, pos, 0))
         cv = lax.dynamic_update_slice(cv, v_new[:, :, None, :],
                                       (0, 0, pos, 0))
+        kv = (ck, cv, ksc, vsc) if kv_int8 else (ck, cv)
         h = self.ln_f.apply(params["ln_f"], x[:, None, :])
         if head_q is not None:
             logits = _dequant_matmul(h, head_q[0], head_q[1],
                                      jnp.float32)[:, 0, :]
         else:
             logits = self.tok.attend(params["tok"], h)[:, 0, :]
-        return logits, ck, cv
+        return logits, kv
 
     def beam_search(self, params, prompt, max_new_tokens: int, *,
                     beam_size: int = 4, eos_id: Optional[int] = None,
                     length_penalty: float = 0.0,
-                    int8_weights: bool = False, fused: bool = False):
+                    int8_weights: bool = False, fused: bool = False,
+                    kv_int8: bool = False):
         """Deterministic beam decoding.  prompt (B, P) int32 ->
         (sequences (B, W, P+max_new), scores (B, W)), beams sorted best
         first.
@@ -914,6 +944,9 @@ class GPT(Module):
                              f"{cfg.max_len}")
         if fused:
             self._check_fused_decode(b * w)
+        elif kv_int8:
+            raise ValueError("kv_int8 is a fused-decode feature; pass "
+                             "fused=True")
         if max_new_tokens == 0:
             return (jnp.repeat(prompt[:, None], w, axis=1),
                     jnp.zeros((b, w), jnp.float32))
@@ -943,14 +976,12 @@ class GPT(Module):
             return jnp.take_along_axis(cv, idx, axis=2).reshape(c.shape)
 
         if fused:
-            pack, head_q, ck, cv = self._fused_decode_setup(
-                params, cache, int8_weights)
-            cache = (ck, cv)
+            pack, head_q, cache = self._fused_decode_setup(
+                params, cache, int8_weights, kv_int8)
 
             def decode_logits(cache, tok, pos):
-                logits, ck, cv = self._fused_token_logits(
-                    params, pack, head_q, cache[0], cache[1], tok, pos)
-                return logits, (ck, cv)
+                return self._fused_token_logits(
+                    params, pack, head_q, cache, tok, pos)
         else:
             packed = self._decode_pack(params, int8=int8_weights)
 

@@ -91,6 +91,19 @@ def quantize_cols(w):
     return q, scale
 
 
+def quantize_rows(x):
+    """Symmetric per-row (last dim) int8 quantization for KV-cache rows:
+    (..., N) -> (int8 same shape, fp32 scale (..., 8) lane-replicated).
+    The scale is stored 8-lanes-wide because a 1-lane trailing dim is not
+    a legal Mosaic block; the kernel re-broadcasts lane 0 across the row
+    with a constant matmul."""
+    m = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(m / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127,
+                 127).astype(jnp.int8)
+    return q, jnp.broadcast_to(scale, (*scale.shape[:-1], 8))
+
+
 def fused_decode_pack(params, cfg, int8: bool = False) -> dict:
     """Repack GPT params for the fused kernel (once per generate call).
 
@@ -162,7 +175,7 @@ def _mm(x_c, w_ref, sc_ref, idx, compute_dtype):
 
 
 def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
-                   batch, mlp_act, compute_dtype, cache_dtype, out_dtype,
+                   batch, mlp_act, compute_dtype, new_dtype, out_dtype,
                    eps):
     n_in = len(keys)
     r = dict(zip(keys, refs[:n_in]))
@@ -212,8 +225,8 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
         k_t = (k_t * r[f"rope_cos_{side}"][...]
                + mmc(k_t.astype(cd), r[f"rope_swap_{side}"][...])
                * r[f"rope_sin_{side}"][...])
-    k_new[0] = k_t.astype(cache_dtype)
-    v_new[0] = v_t.astype(cache_dtype)
+    k_new[0] = k_t.astype(new_dtype)
+    v_new[0] = v_t.astype(new_dtype)
 
     # Segment arithmetic via constant 0/1 matmuls (Mosaic does not lower
     # lane-splitting reshapes like (T, H·Dh)->(T, H, Dh)):
@@ -226,12 +239,25 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     q_c = q_row.astype(cd)
     s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale    # (B, H)
 
+    if "kc_sc" in r:
+        # int8 KV cache: rows widen in VMEM and re-apply their per-row
+        # scale; the (T, 8) lane-replicated scale broadcasts across the
+        # row via the constant lane-0 selector matmul (sc_brd) — the
+        # same no-lane-reshape vocabulary as the segment matrices.
+        brd = r["sc_brd"][...]                         # (8, KVH·Dh)
+        dq = lambda c, s_: (c.astype(jnp.float32)
+                            * mmc(s_, brd)).astype(cd)
+    else:
+        dq = lambda c, s_: c.astype(cd)
+
     if batch == 1:
         # Deliberate specialization for the single-stream latency headline:
         # rank-2 arrays, no (B·T) reshape round-trips.  Keep in sync with
         # the general branch below (tests cover both at every config).
-        kc = expand(r["kc"][0, 0].astype(cd))          # (T, H·Dh)
-        vc = expand(r["vc"][0, 0].astype(cd))
+        ksc = r["kc_sc"][0, 0] if "kc_sc" in r else None
+        vsc = r["vc_sc"][0, 0] if "kc_sc" in r else None
+        kc = expand(dq(r["kc"][0, 0], ksc))            # (T, H·Dh)
+        vc = expand(dq(r["vc"][0, 0], vsc))
         s = mmc(kc * q_c, segm) * scale                # (T, H) f32
         visible = (jax.lax.broadcasted_iota(jnp.int32, (t_cache, 1), 0)
                    < pos)                              # strictly-older rows
@@ -251,8 +277,13 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
         # split back for the per-row softmax reductions — major-dim
         # reshapes only, the lane dim never splits.
         b = batch
-        kc2 = expand(r["kc"][0].astype(cd).reshape(b * t_cache, kn))
-        vc2 = expand(r["vc"][0].astype(cd).reshape(b * t_cache, kn))
+        if "kc_sc" in r:
+            ksc = r["kc_sc"][0].reshape(b * t_cache, 8)
+            vsc = r["vc_sc"][0].reshape(b * t_cache, 8)
+        else:
+            ksc = vsc = None
+        kc2 = expand(dq(r["kc"][0].reshape(b * t_cache, kn), ksc))
+        vc2 = expand(dq(r["vc"][0].reshape(b * t_cache, kn), vsc))
         q_rep = jnp.broadcast_to(
             q_c[:, None, :], (b, t_cache, hn)).reshape(b * t_cache, hn)
         s = mmc(kc2 * q_rep, segm).reshape(b, t_cache, num_heads) * scale
@@ -287,6 +318,7 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
 
 
 def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
+                      cache_k_scale=None, cache_v_scale=None,
                       rope_cos=None, rope_sin=None, interpret=None):
     """One token through the whole layer stack as a single ``pallas_call``.
 
@@ -303,6 +335,11 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     (``nn.rope.rope_angles(pos, Dh)``) — when given, q and the new k are
     rotated in-kernel (split-half convention, matching ``apply_rope``).
 
+    ``cache_k_scale``/``cache_v_scale``: required iff the caches are
+    int8 — fp32 (L, B, T, 8) lane-replicated per-row scales
+    (``quantize_rows``).  The returned k/v rows are ALWAYS in x's dtype;
+    an int8-cache caller quantizes them before writing.
+
     Returns (x_out (B, D), k_new (L, B, KVH·Dh), v_new (L, B, KVH·Dh)).
     """
     if interpret is None:
@@ -316,10 +353,25 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         raise ValueError(f"x must be ({b}, {d}) to match the cache's "
                          f"batch dim, got {x.shape}")
     validate_stream_count(b)
+    kv_int8 = cache_k.dtype == jnp.int8
+    if cache_v.dtype != cache_k.dtype:
+        raise ValueError(f"cache_k/cache_v dtypes must match, got "
+                         f"{cache_k.dtype} vs {cache_v.dtype}")
+    if (kv_int8 != (cache_k_scale is not None)
+            or kv_int8 != (cache_v_scale is not None)):
+        raise ValueError("int8 caches require BOTH cache_k_scale and "
+                         "cache_v_scale; fp caches must pass neither")
     tile_b = b if b <= STREAM_TILE else STREAM_TILE
     n_bt = b // tile_b
+    # The guard budgets the kernel's WORKING footprint, which the
+    # in-kernel widened (compute-dtype) cache copies dominate — int8
+    # halves the streamed bytes but not those copies, so the guard uses
+    # the compute itemsize (>=2) either way, plus the int8 path's two
+    # fp32 (tile_b, T, 8) scale blocks.
+    scale_bytes = 2 * tile_b * t_cache * 8 * 4 if kv_int8 else 0
     cache_mb = (2 * tile_b * t_cache * kn
-                * cache_k.dtype.itemsize / 2 ** 20)
+                * max(cache_k.dtype.itemsize, 2)
+                + scale_bytes) / 2 ** 20
     if cache_mb > 40:
         raise ValueError(
             f"per-(layer, tile) k+v cache blocks are {cache_mb:.0f} MB "
@@ -349,6 +401,18 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         pl.BlockSpec((hn, nh), lambda l, t: (0, 0)),
         pl.BlockSpec((nh, hn), lambda l, t: (0, 0)),
     ]
+    if kv_int8:
+        keys += ["kc_sc", "vc_sc", "sc_brd"]
+        # lane-0 selector: (T, 8) scales @ (8, KVH·Dh) -> row-broadcast
+        sc_brd = (lane((8, kn), 0) == 0).astype(jnp.float32)
+        args += [cache_k_scale, cache_v_scale, sc_brd]
+        in_specs += [
+            pl.BlockSpec((1, tile_b, t_cache, 8),
+                         lambda l, t: (l, t, 0, 0)),
+            pl.BlockSpec((1, tile_b, t_cache, 8),
+                         lambda l, t: (l, t, 0, 0)),
+            pl.BlockSpec((8, kn), lambda l, t: (0, 0)),
+        ]
     if g > 1:
         i, j = lane((kn, hn), 0), lane((kn, hn), 1)
         expm = (i == (j // (g * hd)) * hd + j % hd).astype(compute_dtype)
@@ -395,7 +459,7 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         _decode_kernel, keys=tuple(keys), num_layers=n_layers,
         num_heads=nh, kv_heads=kvh, head_dim=hd, batch=tile_b,
         mlp_act=cfg.mlp_act,
-        compute_dtype=compute_dtype, cache_dtype=cache_k.dtype,
+        compute_dtype=compute_dtype, new_dtype=x.dtype,
         out_dtype=x.dtype, eps=1e-6)
 
     # Grid: batch tiles INNERMOST, so a layer's weight blocks stay
@@ -412,8 +476,8 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, d), x.dtype),
-            jax.ShapeDtypeStruct((n_layers, b, kn), cache_k.dtype),
-            jax.ShapeDtypeStruct((n_layers, b, kn), cache_k.dtype),
+            jax.ShapeDtypeStruct((n_layers, b, kn), x.dtype),
+            jax.ShapeDtypeStruct((n_layers, b, kn), x.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
         # Double-buffered layer weights (~2x14 MB at GPT-2-small) exceed

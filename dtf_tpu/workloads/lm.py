@@ -78,6 +78,11 @@ def main(argv=None) -> int:
                              f"scan (gen_batch x max(beam_size, 1) <= "
                              f"{MAX_FUSED_STREAMS}; beyond {STREAM_TILE} "
                              f"streams, a multiple of {STREAM_TILE})")
+    parser.add_argument("--decode_kv_int8", action="store_true",
+                        help="int8-quantize the KV cache rows (fused "
+                             "decode only): halves the per-token cache "
+                             "DMA, the dominant traffic at batched "
+                             "long-context decode")
     parser.add_argument("--decode_int8", action="store_true",
                         help="int8-quantize the decode weights (per "
                              "output channel): half the HBM weight "
@@ -104,6 +109,9 @@ def main(argv=None) -> int:
         if ns.pipeline_microbatches > 0:
             parser.error("--decode_fused does not compose with pipeline "
                          "parallelism (--pipeline_microbatches)")
+    if ns.decode_kv_int8 and not ns.decode_fused:
+        parser.error("--decode_kv_int8 requires --decode_fused (the "
+                     "op-per-op loop keeps the fp cache)")
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
@@ -143,13 +151,14 @@ def main(argv=None) -> int:
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size,
-                int8_weights=ns.decode_int8,
-                fused=ns.decode_fused)[0][:, 0])
+                int8_weights=ns.decode_int8, fused=ns.decode_fused,
+                kv_int8=ns.decode_kv_int8)[0][:, 0])
         else:
             gen = jax.jit(lambda p, pr, key: model.generate(
                 p, pr, ns.generate, temperature=ns.temperature,
                 top_k=ns.top_k, top_p=ns.top_p, rng=key,
-                int8_weights=ns.decode_int8, fused=ns.decode_fused))
+                int8_weights=ns.decode_int8, fused=ns.decode_fused,
+                kv_int8=ns.decode_kv_int8))
         t0 = time.perf_counter()
         out = gen(state["params"], prompt, jax.random.key(0))
         block(out)

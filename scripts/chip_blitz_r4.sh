@@ -76,6 +76,12 @@ for s in 16 32; do
 done
 run ladder_fused_32_int8 2400 python -m dtf_tpu.bench.decode_ladder \
   --preset gpt2_small --mode fused --streams 32 --int8
+# int8 KV cache: halves per-token cache DMA (dominant at batched
+# long-context); quality contract = bench.int8_quality --kv
+run ladder_fused_32_kvint8 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset gpt2_small --mode fused --streams 32 --kv_int8
+run int8_kv_quality 3600 python -m dtf_tpu.bench.int8_quality \
+  --preset gpt2_small --kv
 
 # 4. Fused beam search (new this round): width-4 on one stream.
 run ladder_beam4_fused 2400 python -m dtf_tpu.bench.decode_ladder \

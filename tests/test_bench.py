@@ -350,3 +350,18 @@ class TestDecodeLadder:
         r = run("tiny", mode="unfused", streams=1, beam=2,
                 ladder=(4, 8), reps=2)
         assert r["beam"] == 2 and len(r["ladder"]) == 2
+
+
+class TestKVQuality:
+    @pytest.mark.slow
+    def test_kv_run_ratio_and_selfcheck(self):
+        """KV-cache int8 quality harness: perplexity ratio within a tight
+        band on tiny, and the fp-cache decode loss agrees with the same
+        positions' parallel-forward loss (the harness's own validity
+        check)."""
+        from dtf_tpu.bench.int8_quality import kv_run
+
+        r = kv_run("tiny", batch=2, seq=48)
+        assert 0.98 < r["kv_ppl_ratio"] < 1.02
+        assert abs(r["fp_vs_parallel_delta"]) < 0.05
+        assert r["tokens_scored"] == 2 * (48 - 1 - 8)

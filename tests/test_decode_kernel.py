@@ -139,3 +139,74 @@ class TestFusedDecode:
         a = m.generate(p, pr, 10, temperature=0.0)
         b = m.generate(p, pr, 10, temperature=0.0, fused=True)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestInt8KVCache:
+    """int8 KV-cache rows through the fused kernel (quantize_rows +
+    in-kernel per-row dequant via the lane-0 selector matmul): halves the
+    per-token cache DMA, the dominant traffic at batched long-context
+    decode.  Quality contract lives in bench.int8_quality.kv_run."""
+
+    def test_quantize_rows_roundtrip(self):
+        from dtf_tpu.ops.decode_kernel import quantize_rows
+
+        x = jax.random.normal(jax.random.key(0), (4, 16, 96),
+                              jnp.float32) * 3.0
+        q, sc = quantize_rows(x)
+        assert q.dtype == jnp.int8 and sc.shape == (4, 16, 8)
+        # lane-replicated scale: all 8 lanes identical
+        np.testing.assert_array_equal(np.asarray(sc),
+                                      np.asarray(sc[..., :1]) *
+                                      np.ones((1, 1, 8), np.float32))
+        back = q.astype(jnp.float32) * sc[..., :1]
+        err = np.abs(np.asarray(back - x))
+        bound = np.asarray(jnp.max(jnp.abs(x), -1, keepdims=True)) / 127
+        assert (err <= bound + 1e-6).all()
+
+    def test_greedy_agreement_with_fp_cache(self):
+        """Random-init tiny logits are near-uniform, so token flips are
+        expected — require a long identical prefix and high agreement
+        (same contract as the int8-weights test)."""
+        m, p = mk()
+        pr = prompt_of(m)
+        a = m.generate(p, pr, 16, temperature=0.0, fused=True)
+        b = m.generate(p, pr, 16, temperature=0.0, fused=True,
+                       kv_int8=True)
+        an, bn = np.asarray(a)[0, 8:], np.asarray(b)[0, 8:]
+        agree = (an == bn).mean()
+        assert agree >= 0.5, agree
+        assert (an[:4] == bn[:4]).all()
+
+    def test_batched_tiles_and_gqa(self):
+        m, p = mk(rope=True, num_kv_heads=2, mlp_act="swiglu")
+        pr = prompt_of(m, b=16)
+        out = m.generate(p, pr, 6, temperature=0.0, fused=True,
+                         kv_int8=True)
+        assert out.shape == (16, 14)
+
+    def test_beam_composes(self):
+        m, p = mk()
+        pr = prompt_of(m)
+        beams, scores = m.beam_search(p, pr, 5, beam_size=4, fused=True,
+                                      kv_int8=True)
+        assert beams.shape == (1, 4, 13)
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_requires_fused(self):
+        m, p = mk()
+        pr = prompt_of(m)
+        with pytest.raises(ValueError, match="fused"):
+            m.generate(p, pr, 4, kv_int8=True)
+        with pytest.raises(ValueError, match="fused"):
+            m.beam_search(p, pr, 4, beam_size=2, kv_int8=True)
+
+    def test_scale_mismatch_rejected(self):
+        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
+                                               fused_decode_step)
+
+        m, p = mk()
+        pack = fused_decode_pack(p, m.cfg)
+        ck = jnp.zeros((2, 1, 16, 32), jnp.int8)
+        x = jnp.zeros((1, 32), jnp.float32)
+        with pytest.raises(ValueError, match="int8 caches require"):
+            fused_decode_step(pack, ck, ck, x, 4, m.cfg)
