@@ -30,7 +30,7 @@ import argparse
 def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         int8: bool = False, beam: int = 0, ladder=(32, 64, 128),
         reps: int = 3, prompt_len: int = 8, seed: int = 0,
-        kv_int8: bool = False) -> dict:
+        kv_int8: bool = False, cache_chunk=None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -58,10 +58,10 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         if beam > 0:
             return jax.jit(lambda p, pr: model.beam_search(
                 p, pr, k, beam_size=beam, int8_weights=int8,
-                fused=fused, kv_int8=kv_int8)[0])
+                fused=fused, kv_int8=kv_int8, cache_chunk=cache_chunk)[0])
         return jax.jit(lambda p, pr: model.generate(
             p, pr, k, temperature=0.0, int8_weights=int8, fused=fused,
-            kv_int8=kv_int8))
+            kv_int8=kv_int8, cache_chunk=cache_chunk))
 
     # Perturb the prompt each call: the relay memoizes bitwise-identical
     # executions.  A deterministic token shift keeps runs reproducible
@@ -113,6 +113,10 @@ def main(argv=None) -> int:
     parser.add_argument("--int8", action="store_true")
     parser.add_argument("--kv_int8", action="store_true",
                         help="int8 KV-cache rows (fused only)")
+    parser.add_argument("--cache_chunk", type=int, default=None,
+                        help="walk the KV cache in chunks of this many "
+                             "rows (fused long-context; default: whole "
+                             "cache when it fits the VMEM budget)")
     parser.add_argument("--beam", type=int, default=0,
                         help=">0: beam search of this width (tokens "
                              "counted per batch row, beams are search "
@@ -120,6 +124,9 @@ def main(argv=None) -> int:
     parser.add_argument("--ladder", default="32,64,128",
                         help="comma-separated max_new_tokens ladder")
     parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--prompt_len", type=int, default=8,
+                        help="prompt length (long-context rows: a long "
+                             "prompt makes the cache long from step one)")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (reliable even when "
                              "a TPU plugin is registered)")
@@ -129,7 +136,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     ladder = tuple(int(k) for k in ns.ladder.split(","))
     r = run(ns.preset, ns.mode, ns.streams, ns.int8, ns.beam, ladder,
-            ns.reps, kv_int8=ns.kv_int8)
+            ns.reps, prompt_len=ns.prompt_len, kv_int8=ns.kv_int8,
+            cache_chunk=ns.cache_chunk)
     beam_tag = f" beam={r['beam']}" if r["beam"] else ""
     int8_tag = (" int8" if r["int8"] else "") + (
         " kv-int8" if r.get("kv_int8") else "")

@@ -566,8 +566,15 @@ class GPT(Module):
         HBM traffic scales with the cache, so both decode entry points size
         it to the generation actually requested, not max_len.  128 beats
         finer alignments in measurement (64-multiples gave XLA worse
-        layouts: ~900 vs ~960 tok/s single-stream)."""
-        return min(-(-total // 128) * 128, self.cfg.max_len)
+        layouts: ~900 vs ~960 tok/s single-stream).  When max_len clamps
+        below the 128-round-up, keep at least 8-alignment if the window
+        allows — the fused path's cache chunking needs an 8-aligned
+        divisor of T (sublane tiling), and an odd T would otherwise lock
+        long-context runs out of it."""
+        t = min(-(-total // 128) * 128, self.cfg.max_len)
+        if t % 8 and -(-total // 8) * 8 <= self.cfg.max_len:
+            t = max(t - t % 8, -(-total // 8) * 8)
+        return t
 
     def init_cache(self, batch: int, length: int | None = None):
         """KV cache sized to ``length`` (default cfg.max_len).  Decode HBM
@@ -695,7 +702,8 @@ class GPT(Module):
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  rng=None, int8_weights: bool = False,
-                 fused: bool = False, kv_int8: bool = False):
+                 fused: bool = False, kv_int8: bool = False,
+                 cache_chunk: Optional[int] = None):
         """Sample continuations.  prompt (B, P) int32 -> (B, P+max_new).
 
         Two phases, one compiled program:
@@ -736,11 +744,15 @@ class GPT(Module):
             return self._generate_fused(
                 params, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_id=eos_id, rng=rng,
-                int8_weights=int8_weights, kv_int8=kv_int8)
+                int8_weights=int8_weights, kv_int8=kv_int8,
+                cache_chunk=cache_chunk)
         if kv_int8:
             raise ValueError("kv_int8 is a fused-decode feature; pass "
                              "fused=True (the op-per-op loop keeps the "
                              "fp cache)")
+        if cache_chunk is not None:
+            raise ValueError("cache_chunk is a fused-decode feature; "
+                             "pass fused=True")
 
         # Cache bounded to the live total (lane-aligned), not max_len.
         cache, logits = self._prefill_cache(params, prompt,
@@ -778,7 +790,7 @@ class GPT(Module):
 
     def _generate_fused(self, params, prompt, max_new_tokens: int, *,
                         temperature, top_k, top_p, eos_id, rng,
-                        int8_weights, kv_int8=False):
+                        int8_weights, kv_int8=False, cache_chunk=None):
         """generate()'s decode loop with the whole layer stack fused into
         ONE Pallas kernel per token (ops/decode_kernel.py) — the per-token
         op count drops from ~170 to ~12, attacking the measured
@@ -811,7 +823,8 @@ class GPT(Module):
             out, kv, rng, done = carry
             tok = lax.dynamic_slice(out, (0, pos), (b, 1))
             logits, kv = self._fused_token_logits(
-                params, pack, head_q, kv, tok, pos)
+                params, pack, head_q, kv, tok, pos,
+                cache_chunk=cache_chunk)
             rng, sub = jax.random.split(rng)
             nxt = sample_token(sub, logits, temperature=temperature,
                                top_k=top_k, top_p=top_p)
@@ -866,7 +879,8 @@ class GPT(Module):
         cv, vsc = quantize_rows(cv)
         return pack, head_q, (ck, cv, ksc, vsc)
 
-    def _fused_token_logits(self, params, pack, head_q, kv, tok, pos):
+    def _fused_token_logits(self, params, pack, head_q, kv, tok, pos,
+                            cache_chunk=None):
         """One token for all streams through the fused stack kernel: embed
         ``tok`` (B, 1), run ``fused_decode_step``, write the returned k/v
         rows into the row-major caches at ``pos`` (quantizing them when
@@ -888,6 +902,7 @@ class GPT(Module):
         if kv_int8:
             rope_kw.update(cache_k_scale=kv[2], cache_v_scale=kv[3])
         x, k_new, v_new = fused_decode_step(pack, ck, cv, x, pos, cfg,
+                                            cache_chunk=cache_chunk,
                                             **rope_kw)
         if kv_int8:
             k_new, ksc_new = quantize_rows(k_new)
@@ -913,7 +928,8 @@ class GPT(Module):
                     beam_size: int = 4, eos_id: Optional[int] = None,
                     length_penalty: float = 0.0,
                     int8_weights: bool = False, fused: bool = False,
-                    kv_int8: bool = False):
+                    kv_int8: bool = False,
+                    cache_chunk: Optional[int] = None):
         """Deterministic beam decoding.  prompt (B, P) int32 ->
         (sequences (B, W, P+max_new), scores (B, W)), beams sorted best
         first.
@@ -947,6 +963,9 @@ class GPT(Module):
         elif kv_int8:
             raise ValueError("kv_int8 is a fused-decode feature; pass "
                              "fused=True")
+        elif cache_chunk is not None:
+            raise ValueError("cache_chunk is a fused-decode feature; "
+                             "pass fused=True")
         if max_new_tokens == 0:
             return (jnp.repeat(prompt[:, None], w, axis=1),
                     jnp.zeros((b, w), jnp.float32))
@@ -981,7 +1000,8 @@ class GPT(Module):
 
             def decode_logits(cache, tok, pos):
                 return self._fused_token_logits(
-                    params, pack, head_q, cache, tok, pos)
+                    params, pack, head_q, cache, tok, pos,
+                    cache_chunk=cache_chunk)
         else:
             packed = self._decode_pack(params, int8=int8_weights)
 

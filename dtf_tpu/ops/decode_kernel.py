@@ -174,6 +174,54 @@ def _mm(x_c, w_ref, sc_ref, idx, compute_dtype):
     return y
 
 
+def _qkv_project(r, x, mm, mmc, hn, kn, eps, cd):
+    """LN1 + packed qkv projection + optional in-kernel RoPE -> (q_row,
+    k_t, v_t) in fp32.  Shared by the single-chunk and chunked kernels."""
+    f32 = jnp.float32
+    hb = _ln(x, r["ln1_s"], r["ln1_b"], eps).astype(cd)
+    qkv = mm(hb, "w_qkv") + r["b_qkv"][0].astype(f32)
+    q_row = qkv[:, :hn]
+    k_t = qkv[:, hn:hn + kn]
+    v_t = qkv[:, hn + kn:]
+    if "rope_cos_q" in r:
+        # RoPE as lane arithmetic: rope(x) = x ⊙ [cos,cos] +
+        # swap_halves(x) ⊙ [sin,sin], where swap_halves is the constant
+        # per-head [[0, I], [-I, 0]] matmul (r["rope_swap_*"]) — the same
+        # no-lane-reshape trick as the segment matrices.  Without GQA the
+        # k tables are byte-identical to the q tables, so they are only
+        # passed (and streamed) separately when KVH != H.
+        q_row = (q_row * r["rope_cos_q"][...]
+                 + mmc(q_row.astype(cd), r["rope_swap_q"][...])
+                 * r["rope_sin_q"][...])
+        side = "k" if "rope_cos_k" in r else "q"
+        k_t = (k_t * r[f"rope_cos_{side}"][...]
+               + mmc(k_t.astype(cd), r[f"rope_swap_{side}"][...])
+               * r[f"rope_sin_{side}"][...])
+    return q_row, k_t, v_t
+
+
+def _mlp_residual_tail(r, x, mm, mlp_act, eps, cd):
+    """x + MLP(LN2(x)) in fp32 — shared kernel tail."""
+    f32 = jnp.float32
+    h2 = _ln(x, r["ln2_s"], r["ln2_b"], eps).astype(cd)
+    u = mm(h2, "w_fc1") + r["b_fc1"][0].astype(f32)
+    if mlp_act == "swiglu":
+        gate = mm(h2, "w_gate") + r["b_gate"][0].astype(f32)
+        u = jax.nn.silu(gate) * u
+    else:
+        u = jax.nn.gelu(u)
+    return x + mm(u.astype(cd), "w_fc2") + r["b_fc2"][0].astype(f32)
+
+
+def _cache_dq(r, cd, mmc):
+    """Row-dequant closure for the (possibly int8) cache blocks."""
+    if "kc_sc" in r:
+        brd = r["sc_brd"][...]
+        return lambda c, s_: (c.astype(jnp.float32)
+                              * mmc(s_, brd)).astype(cd)
+    return lambda c, s_: c.astype(cd)
+
+
 def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
                    batch, mlp_act, compute_dtype, new_dtype, out_dtype,
                    eps):
@@ -205,26 +253,8 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     hn, kn = num_heads * head_dim, kv_heads * head_dim
 
     # --- attention (lane-segment arithmetic; see module docstring) ----
-    hb = _ln(x, r["ln1_s"], r["ln1_b"], eps).astype(cd)
     t_cache = r["kc"].shape[2]
-    qkv = mm(hb, "w_qkv") + r["b_qkv"][0].astype(f32)  # (B, (H+2KVH)·Dh)
-    q_row = qkv[:, :hn]
-    k_t = qkv[:, hn:hn + kn]
-    v_t = qkv[:, hn + kn:]
-    if "rope_cos_q" in r:
-        # RoPE as lane arithmetic: rope(x) = x ⊙ [cos,cos] +
-        # swap_halves(x) ⊙ [sin,sin], where swap_halves is the constant
-        # per-head [[0, I], [-I, 0]] matmul (r["rope_swap_*"]) — the same
-        # no-lane-reshape trick as the segment matrices.  Without GQA the
-        # k tables are byte-identical to the q tables, so they are only
-        # passed (and streamed) separately when KVH != H.
-        q_row = (q_row * r["rope_cos_q"][...]
-                 + mmc(q_row.astype(cd), r["rope_swap_q"][...])
-                 * r["rope_sin_q"][...])
-        side = "k" if "rope_cos_k" in r else "q"
-        k_t = (k_t * r[f"rope_cos_{side}"][...]
-               + mmc(k_t.astype(cd), r[f"rope_swap_{side}"][...])
-               * r[f"rope_sin_{side}"][...])
+    q_row, k_t, v_t = _qkv_project(r, x, mm, mmc, hn, kn, eps, cd)
     k_new[0] = k_t.astype(new_dtype)
     v_new[0] = v_t.astype(new_dtype)
 
@@ -239,16 +269,10 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
     q_c = q_row.astype(cd)
     s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale    # (B, H)
 
-    if "kc_sc" in r:
-        # int8 KV cache: rows widen in VMEM and re-apply their per-row
-        # scale; the (T, 8) lane-replicated scale broadcasts across the
-        # row via the constant lane-0 selector matmul (sc_brd) — the
-        # same no-lane-reshape vocabulary as the segment matrices.
-        brd = r["sc_brd"][...]                         # (8, KVH·Dh)
-        dq = lambda c, s_: (c.astype(jnp.float32)
-                            * mmc(s_, brd)).astype(cd)
-    else:
-        dq = lambda c, s_: c.astype(cd)
+    # int8 KV cache rows widen in VMEM with their per-row scale
+    # re-broadcast by the constant lane-0 selector matmul (sc_brd) —
+    # the same no-lane-reshape vocabulary as the segment matrices.
+    dq = _cache_dq(r, cd, mmc)
 
     if batch == 1:
         # Deliberate specialization for the single-stream latency headline:
@@ -301,25 +325,109 @@ def _decode_kernel(*refs, keys, num_layers, num_heads, kv_heads, head_dim,
                  + mmc(p_self.astype(cd), segb) * expand(v_t.astype(cd)))
         o_row = o_row * mmc((1.0 / denom).astype(cd), segb)
     x = x + mm(o_row.astype(cd), "w_o") + r["b_o"][0].astype(f32)
-
-    # --- MLP ---------------------------------------------------------
-    h2 = _ln(x, r["ln2_s"], r["ln2_b"], eps).astype(cd)
-    u = mm(h2, "w_fc1") + r["b_fc1"][0].astype(f32)
-    if mlp_act == "swiglu":
-        gate = mm(h2, "w_gate") + r["b_gate"][0].astype(f32)
-        u = jax.nn.silu(gate) * u
-    else:
-        u = jax.nn.gelu(u)
-    y = mm(u.astype(cd), "w_fc2") + r["b_fc2"][0].astype(f32)
-    x = x + y
+    x = _mlp_residual_tail(r, x, mm, mlp_act, eps, cd)
 
     x_s[rows] = x
     x_out[...] = x.astype(out_dtype)
 
 
+def _decode_kernel_chunked(*refs, keys, num_layers, num_heads, kv_heads,
+                           head_dim, batch, mlp_act, compute_dtype,
+                           new_dtype, out_dtype, eps, chunk):
+    """Long-context variant: a third (innermost) grid dim walks the KV
+    cache in chunks with an online softmax, so per-step VMEM holds one
+    (tile_b, chunk, KVH·Dh) cache block instead of the whole T.  The
+    running (max, denominator, accumulator) live in VMEM scratch per
+    stream; the current token's self-term seeds them (m=s_self, den=1,
+    acc=v_t) so chunk passes only fold strictly-older rows.  The
+    single-chunk kernel (`_decode_kernel`) is kept verbatim for caches
+    that fit — its one-shot softmax is bit-stable against round-3's
+    chip-validated behavior."""
+    n_in = len(keys)
+    r = dict(zip(keys, refs[:n_in]))
+    x_out, k_new, v_new = refs[n_in:n_in + 3]
+    x_s, q_s, m_s, den_s, acc_s = refs[n_in + 3:n_in + 8]
+    l = pl.program_id(0)
+    bt = pl.program_id(1)
+    tc = pl.program_id(2)
+    n_tc = pl.num_programs(2)
+    g = num_heads // kv_heads
+    scale = head_dim ** -0.5
+    pos = r["pos"][0]
+    cd = compute_dtype
+    rows = pl.ds(bt * batch, batch)
+
+    sc = lambda name: r.get(name + "_sc")
+    mm = lambda h, name: _mm(h, r[name], sc(name), 0, cd)
+    f32 = jnp.float32
+    mmc = lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+    hn, kn = num_heads * head_dim, kv_heads * head_dim
+    segm, segb = r["segm"][...], r["segb"][...]
+    expand = ((lambda a: a) if g == 1
+              else (lambda a: mmc(a, r["expm"][...]).astype(cd)))
+    dq = _cache_dq(r, cd, mmc)
+    b = batch
+
+    @pl.when((l == 0) & (tc == 0))
+    def _init_residual():
+        x_s[rows] = r["x"][...].astype(jnp.float32)
+
+    @pl.when(tc == 0)
+    def _project_and_seed():
+        x = x_s[rows]
+        q_row, k_t, v_t = _qkv_project(r, x, mm, mmc, hn, kn, eps, cd)
+        k_new[0] = k_t.astype(new_dtype)
+        v_new[0] = v_t.astype(new_dtype)
+        q_c = q_row.astype(cd)
+        q_s[rows] = q_row
+        s_self = mmc(expand(k_t.astype(cd)) * q_c, segm) * scale
+        m_s[rows] = s_self                      # running max
+        den_s[rows] = jnp.ones_like(s_self)     # p_self = exp(0) = 1
+        acc_s[rows] = expand(v_t.astype(cd)).astype(f32)
+
+    # ---- fold this cache chunk into the running softmax ----
+    q_c = q_s[rows].astype(cd)                  # (B, H·Dh)
+    if "kc_sc" in r:
+        ksc = r["kc_sc"][0].reshape(b * chunk, 8)
+        vsc = r["vc_sc"][0].reshape(b * chunk, 8)
+    else:
+        ksc = vsc = None
+    kc2 = expand(dq(r["kc"][0].reshape(b * chunk, kn), ksc))
+    vc2 = expand(dq(r["vc"][0].reshape(b * chunk, kn), vsc))
+    q_rep = jnp.broadcast_to(
+        q_c[:, None, :], (b, chunk, hn)).reshape(b * chunk, hn)
+    s = mmc(kc2 * q_rep, segm).reshape(b, chunk, num_heads) * scale
+    # strictly-older rows only, at this chunk's global offset
+    visible = (tc * chunk
+               + jax.lax.broadcasted_iota(jnp.int32, (1, chunk, 1), 1)
+               < pos)
+    s = jnp.where(visible, s, NEG_BIG)
+    m_old = m_s[rows]                           # (B, H)
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_old - m_new)              # (B, H)
+    p = jnp.exp(s - m_new[:, None, :])          # (B, C, H)
+    den_s[rows] = den_s[rows] * alpha + jnp.sum(p, axis=1)
+    pv = (mmc(p.reshape(b * chunk, num_heads).astype(cd), segb)
+          .astype(cd) * vc2)                    # (B·C, H·Dh)
+    acc_s[rows] = (acc_s[rows] * mmc(alpha.astype(cd), segb)
+                   + jnp.sum(pv.reshape(b, chunk, hn), axis=1, dtype=f32))
+    m_s[rows] = m_new
+
+    @pl.when(tc == n_tc - 1)
+    def _finalize():
+        x = x_s[rows]
+        o_row = acc_s[rows] * mmc((1.0 / den_s[rows]).astype(cd), segb)
+        x = x + mm(o_row.astype(cd), "w_o") + r["b_o"][0].astype(f32)
+        x = _mlp_residual_tail(r, x, mm, mlp_act, eps, cd)
+        x_s[rows] = x
+        x_out[...] = x.astype(out_dtype)
+
+
 def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                       cache_k_scale=None, cache_v_scale=None,
-                      rope_cos=None, rope_sin=None, interpret=None):
+                      rope_cos=None, rope_sin=None, cache_chunk=None,
+                      interpret=None):
     """One token through the whole layer stack as a single ``pallas_call``.
 
     pack: ``fused_decode_pack`` output; cache_k/v: row-major
@@ -363,21 +471,39 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                          "cache_v_scale; fp caches must pass neither")
     tile_b = b if b <= STREAM_TILE else STREAM_TILE
     n_bt = b // tile_b
-    # The guard budgets the kernel's WORKING footprint, which the
+
+    # The VMEM budget covers the kernel's WORKING footprint, which the
     # in-kernel widened (compute-dtype) cache copies dominate — int8
-    # halves the streamed bytes but not those copies, so the guard uses
+    # halves the streamed bytes but not those copies, so the budget uses
     # the compute itemsize (>=2) either way, plus the int8 path's two
-    # fp32 (tile_b, T, 8) scale blocks.
-    scale_bytes = 2 * tile_b * t_cache * 8 * 4 if kv_int8 else 0
-    cache_mb = (2 * tile_b * t_cache * kn
-                * max(cache_k.dtype.itemsize, 2)
-                + scale_bytes) / 2 ** 20
-    if cache_mb > 40:
-        raise ValueError(
-            f"per-(layer, tile) k+v cache blocks are {cache_mb:.0f} MB "
-            f"(tile {tile_b}, T={t_cache}); double-buffered they exceed "
-            f"VMEM — shrink the generation length or use the unfused "
-            f"path")
+    # fp32 (tile_b, chunk, 8) scale blocks.  A cache too long for one
+    # block walks in chunks on a third (innermost) grid dim with an
+    # online softmax (`_decode_kernel_chunked`).
+    def _fits(ch):
+        sb = 2 * tile_b * ch * 8 * 4 if kv_int8 else 0
+        return (2 * tile_b * ch * kn * max(cache_k.dtype.itemsize, 2)
+                + sb) / 2 ** 20 <= 40
+    if cache_chunk is not None:
+        # explicit override (tests; chip tuning) — must tile the cache
+        if (t_cache % cache_chunk or
+                (cache_chunk % 8 and cache_chunk != t_cache)):
+            raise ValueError(
+                f"cache_chunk {cache_chunk} must divide T={t_cache} and "
+                f"be 8-aligned")
+        chunk, n_tc = cache_chunk, t_cache // cache_chunk
+    elif _fits(t_cache):
+        chunk, n_tc = t_cache, 1
+    else:
+        for n in range(2, t_cache // 8 + 1):
+            cand = t_cache // n
+            if t_cache % n == 0 and cand % 8 == 0 and _fits(cand):
+                chunk, n_tc = cand, n
+                break
+        else:
+            raise ValueError(
+                f"no 8-aligned divisor of T={t_cache} gives a per-"
+                f"(layer, tile) cache chunk within the VMEM budget at "
+                f"tile {tile_b} — use the unfused path")
 
     compute_dtype = pack["ln1_s"].dtype
     hn = nh * hd
@@ -395,11 +521,11 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         jnp.asarray(pos, jnp.int32).reshape(1), x, cache_k, cache_v,
         segm, segb], [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((tile_b, d), lambda l, t: (t, 0)),
-        pl.BlockSpec((1, tile_b, t_cache, kn), lambda l, t: (l, t, 0, 0)),
-        pl.BlockSpec((1, tile_b, t_cache, kn), lambda l, t: (l, t, 0, 0)),
-        pl.BlockSpec((hn, nh), lambda l, t: (0, 0)),
-        pl.BlockSpec((nh, hn), lambda l, t: (0, 0)),
+        pl.BlockSpec((tile_b, d), lambda l, t, c: (t, 0)),
+        pl.BlockSpec((1, tile_b, chunk, kn), lambda l, t, c: (l, t, c, 0)),
+        pl.BlockSpec((1, tile_b, chunk, kn), lambda l, t, c: (l, t, c, 0)),
+        pl.BlockSpec((hn, nh), lambda l, t, c: (0, 0)),
+        pl.BlockSpec((nh, hn), lambda l, t, c: (0, 0)),
     ]
     if kv_int8:
         keys += ["kc_sc", "vc_sc", "sc_brd"]
@@ -407,18 +533,18 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         sc_brd = (lane((8, kn), 0) == 0).astype(jnp.float32)
         args += [cache_k_scale, cache_v_scale, sc_brd]
         in_specs += [
-            pl.BlockSpec((1, tile_b, t_cache, 8),
-                         lambda l, t: (l, t, 0, 0)),
-            pl.BlockSpec((1, tile_b, t_cache, 8),
-                         lambda l, t: (l, t, 0, 0)),
-            pl.BlockSpec((8, kn), lambda l, t: (0, 0)),
+            pl.BlockSpec((1, tile_b, chunk, 8),
+                         lambda l, t, c: (l, t, c, 0)),
+            pl.BlockSpec((1, tile_b, chunk, 8),
+                         lambda l, t, c: (l, t, c, 0)),
+            pl.BlockSpec((8, kn), lambda l, t, c: (0, 0)),
         ]
     if g > 1:
         i, j = lane((kn, hn), 0), lane((kn, hn), 1)
         expm = (i == (j // (g * hd)) * hd + j % hd).astype(compute_dtype)
         keys.append("expm")
         args.append(expm)
-        in_specs.append(pl.BlockSpec((kn, hn), lambda l, t: (0, 0)))
+        in_specs.append(pl.BlockSpec((kn, hn), lambda l, t, c: (0, 0)))
     if rope_cos is not None:
         half = hd // 2
         # per-head swap-halves with sign: out[h·Dh+i] = -x[h·Dh+i+half]
@@ -442,44 +568,56 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                      jnp.tile(sdoubled, reps)[None],
                      swap_matrix(reps * hd)]
             n_l = reps * hd
-            in_specs += [pl.BlockSpec((1, n_l), lambda l, t: (0, 0)),
-                         pl.BlockSpec((1, n_l), lambda l, t: (0, 0)),
-                         pl.BlockSpec((n_l, n_l), lambda l, t: (0, 0))]
+            in_specs += [pl.BlockSpec((1, n_l), lambda l, t, c: (0, 0)),
+                         pl.BlockSpec((1, n_l), lambda l, t, c: (0, 0)),
+                         pl.BlockSpec((n_l, n_l),
+                                      lambda l, t, c: (0, 0))]
     for name, arr in pack.items():
         keys.append(name)
         args.append(arr)
         blk = (1, *arr.shape[1:])
         in_specs.append(pl.BlockSpec(
-            blk, lambda l, t, _n=len(arr.shape): (l,) + (0,) * (_n - 1)))
+            blk,
+            lambda l, t, c, _n=len(arr.shape): (l,) + (0,) * (_n - 1)))
 
     # Compute in the packed weights' dtype (bf16 in the benchmarks, fp32
     # in CPU parity tests); int8-packed weights widen to the LN params'
     # dtype, which the int8 pack leaves unquantized.
-    kernel = functools.partial(
-        _decode_kernel, keys=tuple(keys), num_layers=n_layers,
-        num_heads=nh, kv_heads=kvh, head_dim=hd, batch=tile_b,
-        mlp_act=cfg.mlp_act,
-        compute_dtype=compute_dtype, new_dtype=x.dtype,
-        out_dtype=x.dtype, eps=1e-6)
+    kw = dict(keys=tuple(keys), num_layers=n_layers,
+              num_heads=nh, kv_heads=kvh, head_dim=hd, batch=tile_b,
+              mlp_act=cfg.mlp_act,
+              compute_dtype=compute_dtype, new_dtype=x.dtype,
+              out_dtype=x.dtype, eps=1e-6)
+    scratches = [pltpu.VMEM((b, d), jnp.float32)]
+    if n_tc == 1:
+        kernel = functools.partial(_decode_kernel, **kw)
+    else:
+        kernel = functools.partial(_decode_kernel_chunked, chunk=chunk,
+                                   **kw)
+        # online-softmax state: q, running max, denominator, accumulator
+        scratches += [pltpu.VMEM((b, hn), jnp.float32),
+                      pltpu.VMEM((b, nh), jnp.float32),
+                      pltpu.VMEM((b, nh), jnp.float32),
+                      pltpu.VMEM((b, hn), jnp.float32)]
 
-    # Grid: batch tiles INNERMOST, so a layer's weight blocks stay
-    # resident in VMEM while every tile consumes them (one weight DMA
-    # per layer per token regardless of stream count).
+    # Grid: batch tiles then cache chunks INNERMOST, so a layer's weight
+    # blocks stay resident in VMEM while every tile/chunk consumes them
+    # (one weight DMA per layer per token regardless of stream count).
     x_out, k_new, v_new = pl.pallas_call(
         kernel,
-        grid=(n_layers, n_bt),
+        grid=(n_layers, n_bt, n_tc),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((tile_b, d), lambda l, t: (t, 0)),
-            pl.BlockSpec((1, tile_b, kn), lambda l, t: (l, t, 0)),
-            pl.BlockSpec((1, tile_b, kn), lambda l, t: (l, t, 0)),
+            pl.BlockSpec((tile_b, d), lambda l, t, c: (t, 0)),
+            pl.BlockSpec((1, tile_b, kn), lambda l, t, c: (l, t, 0)),
+            pl.BlockSpec((1, tile_b, kn), lambda l, t, c: (l, t, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, d), x.dtype),
             jax.ShapeDtypeStruct((n_layers, b, kn), x.dtype),
             jax.ShapeDtypeStruct((n_layers, b, kn), x.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        scratch_shapes=scratches,
         # Double-buffered layer weights (~2x14 MB at GPT-2-small) exceed
         # the 16 MB default scoped-vmem limit; v5e has 128 MB VMEM.
         compiler_params=pltpu.CompilerParams(

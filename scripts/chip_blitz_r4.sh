@@ -82,6 +82,18 @@ run ladder_fused_32_kvint8 2400 python -m dtf_tpu.bench.decode_ladder \
   --preset gpt2_small --mode fused --streams 32 --kv_int8
 run int8_kv_quality 3600 python -m dtf_tpu.bench.int8_quality \
   --preset gpt2_small --kv
+# long-context fused decode with the cache walked in chunks (explicit
+# --cache_chunk: at llama dims a 3.8k cache still fits one block, so
+# force the chunked online-softmax kernel for its first real-Mosaic
+# run).  The ladder re-sizes the cache per point (T = ceil128(3584+k) =
+# 3712/3712/3840), so the chunk must divide EVERY point's T:
+# gcd(3712, 3840) = 128.
+run ladder_longctx_8 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset llama --mode fused --streams 8 --prompt_len 3584 \
+  --ladder 64,128,256 --cache_chunk 128
+run ladder_longctx_8_kvint8 2400 python -m dtf_tpu.bench.decode_ladder \
+  --preset llama --mode fused --streams 8 --prompt_len 3584 \
+  --ladder 64,128,256 --cache_chunk 128 --kv_int8
 
 # 4. Fused beam search (new this round): width-4 on one stream.
 run ladder_beam4_fused 2400 python -m dtf_tpu.bench.decode_ladder \

@@ -210,3 +210,66 @@ class TestInt8KVCache:
         x = jnp.zeros((1, 32), jnp.float32)
         with pytest.raises(ValueError, match="int8 caches require"):
             fused_decode_step(pack, ck, ck, x, 4, m.cfg)
+
+
+class TestChunkedCache:
+    """Long-context cache chunking: a third (innermost) grid dim walks
+    the KV cache with an online softmax (`_decode_kernel_chunked`), so
+    caches beyond the per-block VMEM budget stay on the fused path."""
+
+    def test_kernel_matches_single_chunk(self):
+        """The chunked online softmax equals the one-shot kernel to fp32
+        roundoff on raw caches."""
+        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
+                                               fused_decode_step)
+
+        m, p = mk()
+        pack = fused_decode_pack(p, m.cfg)
+        L, b, T, kn = 2, 2, 64, 32
+        ck = jax.random.normal(jax.random.key(1), (L, b, T, kn),
+                               jnp.float32) * 0.3
+        cv = jax.random.normal(jax.random.key(2), (L, b, T, kn),
+                               jnp.float32) * 0.3
+        x = jax.random.normal(jax.random.key(3), (b, 32), jnp.float32)
+        ref = fused_decode_step(pack, ck, cv, x, 37, m.cfg)
+        got = fused_decode_step(pack, ck, cv, x, 37, m.cfg,
+                                cache_chunk=16)
+        for r_, g_ in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(r_, np.float32),
+                                       np.asarray(g_, np.float32),
+                                       atol=1e-5)
+
+    def test_generate_matches_unfused(self):
+        m, p = mk()
+        pr = prompt_of(m, b=2)
+        ref = m.generate(p, pr, 20, temperature=0.0)
+        got = m.generate(p, pr, 20, temperature=0.0, fused=True,
+                         cache_chunk=16)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+    def test_composes_with_gqa_rope_kvint8_beam(self):
+        m, p = mk(rope=True, num_kv_heads=2, mlp_act="swiglu")
+        pr = prompt_of(m, b=2)
+        out = m.generate(p, pr, 12, temperature=0.0, fused=True,
+                         cache_chunk=8, kv_int8=True)
+        assert out.shape == (2, 20)
+        m2, p2 = mk()
+        beams, scores = m2.beam_search(p2, prompt_of(m2), 6, beam_size=4,
+                                       fused=True, cache_chunk=16)
+        ref, _ = m2.beam_search(p2, prompt_of(m2), 6, beam_size=4,
+                                fused=True)
+        np.testing.assert_array_equal(np.asarray(beams), np.asarray(ref))
+
+    def test_bad_chunk_rejected(self):
+        from dtf_tpu.ops.decode_kernel import (fused_decode_pack,
+                                               fused_decode_step)
+
+        m, p = mk()
+        pack = fused_decode_pack(p, m.cfg)
+        ck = jnp.zeros((2, 1, 64, 32), jnp.float32)
+        x = jnp.zeros((1, 32), jnp.float32)
+        for bad in (48,    # not a divisor of T=64
+                    4):    # divides 64 but is not 8-aligned
+            with pytest.raises(ValueError, match="cache_chunk"):
+                fused_decode_step(pack, ck, ck, x, 4, m.cfg,
+                                  cache_chunk=bad)
