@@ -485,11 +485,17 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                 + sb) / 2 ** 20 <= 40
     if cache_chunk is not None:
         # explicit override (tests; chip tuning) — must tile the cache
-        if (t_cache % cache_chunk or
+        # and still fit the VMEM budget
+        if (cache_chunk < 1 or t_cache % cache_chunk or
                 (cache_chunk % 8 and cache_chunk != t_cache)):
             raise ValueError(
-                f"cache_chunk {cache_chunk} must divide T={t_cache} and "
-                f"be 8-aligned")
+                f"cache_chunk {cache_chunk} must be a positive 8-aligned "
+                f"divisor of T={t_cache}")
+        if not _fits(cache_chunk):
+            raise ValueError(
+                f"cache_chunk {cache_chunk} exceeds the per-(layer, "
+                f"tile) VMEM budget at tile {tile_b} — choose a smaller "
+                f"chunk")
         chunk, n_tc = cache_chunk, t_cache // cache_chunk
     elif _fits(t_cache):
         chunk, n_tc = t_cache, 1
@@ -515,8 +521,8 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     segm = (lane((hn, nh), 0) // hd == lane((hn, nh), 1)).astype(
         compute_dtype)
     segb = segm.T
-    # Every index_map takes (layer, batch_tile); grid-invariant inputs
-    # pin both to block 0.
+    # Every index_map takes (layer, batch_tile, chunk); grid-invariant
+    # inputs pin all three to block 0.
     keys, args, in_specs = ["pos", "x", "kc", "vc", "segm", "segb"], [
         jnp.asarray(pos, jnp.int32).reshape(1), x, cache_k, cache_v,
         segm, segb], [
