@@ -134,6 +134,10 @@ class TrainConfig:
     profile_dir: Optional[str] = None
     profile_start: int = 10
     profile_steps: int = 3
+    # After the run, aggregate the captured trace's device-op time and
+    # print the top entries (utils.profiling.summarize_trace) — the
+    # one-flag MFU-eater locator.
+    profile_summary: bool = False
     determinism_every: int = 0        # 0 disables
     # Failure detection (SURVEY §5.3; the reference hung forever on a dead
     # peer): fail the process fast if the train loop makes no progress for

@@ -448,6 +448,9 @@ class Trainer:
         # step would sync the async dispatch pipeline.
         self._host_step = int(self.state["step"])
         self._profiler = None
+        if self.cfg.profile_summary and self.cfg.profile_dir is None:
+            raise ValueError("--profile_summary summarizes a captured "
+                             "trace; pass --profile_dir as well")
         if self.cfg.profile_dir is not None:
             from dtf_tpu.utils.profiling import StepWindowProfiler
             self._profiler = StepWindowProfiler(
@@ -456,6 +459,31 @@ class Trainer:
         # Armed at fit() start, disarmed in its finally (arming here would
         # let slow pre-fit host work trip a hard exit).
         self._watchdog = None
+
+    def _print_trace_summary(self, steps_traced: int) -> None:
+        from dtf_tpu.utils.profiling import summarize_trace
+
+        try:
+            rows = summarize_trace(self.cfg.profile_dir, top=10)
+        except Exception as exc:       # a summary must never fail a run
+            self.logger.print(f"[trace] summary unavailable: {exc}")
+            return
+        if not rows:
+            # CPU traces have no device "XLA Ops" lane; the summary is a
+            # TPU-run tool.
+            self.logger.print("[trace] no device-op rows in the trace "
+                              "(host-only backend?)")
+            return
+        # summarize_trace sums over every trace file in the newest run
+        # dir — on shared storage that can be several hosts' files; the
+        # denominator is this host's traced-step count.
+        self.logger.print(
+            f"[trace] device-op time per traced step ({steps_traced} "
+            f"steps; durations summed over the run dir's trace files):")
+        for name, secs in rows:
+            self.logger.print(
+                f"[trace] {secs * 1e3 / steps_traced:9.3f} ms/step  "
+                f"{name}")
 
     def _suspended_watchdog(self):
         """Disarm the hang watchdog across a legitimately-slow blocking host
@@ -613,8 +641,22 @@ class Trainer:
             # os._exit(70) the caller's cleanup.
             if self._watchdog is not None:
                 self._watchdog.close()
+            if self._profiler is not None:
+                # In the finally: a raise out of the loop must still
+                # stop_trace, or the trace file is never written.
+                self._profiler.close(self.state)
         if self._profiler is not None:
-            self._profiler.close(self.state)   # never leak an open trace
+            steps_traced = self._profiler.captured_steps
+            if self.cfg.profile_summary and self.cluster.is_coordinator:
+                if steps_traced == 0:
+                    # Never summarize a dir that may hold a PREVIOUS
+                    # run's trace as if it were this run's.
+                    self.logger.print(
+                        "[trace] no summary: the window covered no "
+                        "complete step this run (profile_start at or "
+                        "beyond the last step?)")
+                else:
+                    self._print_trace_summary(steps_traced)
         block(self.state)
         if self.ckpt is not None:
             if (not preempted and self.cfg.checkpoint_every > 0

@@ -61,6 +61,10 @@ class StepWindowProfiler:
         self.end = start + steps
         self.active = False
         self.done = False
+        # Full steps actually covered by the trace — the denominator for
+        # any per-step average (a truncated window must not be divided
+        # by the CONFIGURED step count).
+        self.captured_steps = 0
 
     def after_step(self, host_step: int, state: Any = None) -> None:
         if self.done:
@@ -68,12 +72,26 @@ class StepWindowProfiler:
         if not self.active and self.start <= host_step < self.end:
             jax.profiler.start_trace(self.logdir)
             self.active = True
-        elif self.active and host_step >= self.end:
-            self._stop(state)
+        elif self.active:
+            # every completed step while the trace is open is covered —
+            # including the one observed by the stopping call
+            self.captured_steps += 1
+            if host_step >= self.end:
+                self._stop(state)
 
     def close(self, state: Any = None) -> None:
         if self.active:
-            self._stop(state)
+            try:
+                self._stop(state)
+            except Exception:
+                # The error path must neither mask the original loop
+                # exception nor leak the open trace: retry the stop
+                # without syncing on (possibly poisoned) state.
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self.active = False
         self.done = True
 
     def _stop(self, state: Any) -> None:

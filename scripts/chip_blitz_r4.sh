@@ -41,6 +41,19 @@ run bert_attn_unroll 3600 python -m dtf_tpu.workloads.bert_pretrain \
 run gpt_attn_unroll 3600 python -m dtf_tpu.workloads.lm \
   --preset gpt2_small --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30
+# Profiled REPEATS of 1b/1c in separate legs (start/stop_trace overhead
+# and the window-end sync would perturb the headline step timings):
+# prints the top device ops per step (--profile_summary).
+run bert_attn_unroll_trace 3600 python -m dtf_tpu.workloads.bert_pretrain \
+  --preset base --bf16 --remat --remat_policy attn --layer_loop unroll \
+  --per_device_batch 64 --steps 15 \
+  --profile_dir /tmp/r4_trace_bert --profile_start 8 --profile_steps 3 \
+  --profile_summary
+run gpt_attn_unroll_trace 3600 python -m dtf_tpu.workloads.lm \
+  --preset gpt2_small --bf16 --remat --remat_policy attn \
+  --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 15 \
+  --profile_dir /tmp/r4_trace_gpt --profile_start 8 --profile_steps 3 \
+  --profile_summary
 
 # 1d. Re-confirm the fused-decode single-stream number (r3: 3,811 tok/s,
 #     builder-measured only) with the reproducible ladder module.

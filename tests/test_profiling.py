@@ -138,3 +138,33 @@ class TestAttnSweep:
         # the ablation tag names the RESOLVED tiling (clamped at T=128)
         f128 = by["fwd H6 Dh128 (same FLOPs) bq128 bk128"].flops
         assert f64 == f128
+
+
+class TestProfileSummaryFlag:
+    @pytest.mark.slow
+    def test_summary_prints_after_fit(self, tmp_path, capsys):
+        """--profile_summary: after a profiled run the trainer prints
+        [trace] lines (real per-op rows on TPU; an explicit no-device-
+        rows note on host-only backends — never silence)."""
+        from dtf_tpu.workloads import lm
+
+        rc = lm.main(["--preset", "tiny", "--steps", "6", "--batch_size",
+                      "8", "--profile_dir", str(tmp_path / "prof"),
+                      "--profile_start", "3", "--profile_steps", "2",
+                      "--profile_summary", "--logdir",
+                      str(tmp_path / "log")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        # host backend: the explicit no-device-rows note, and never the
+        # failure branch
+        assert ("no device-op rows" in out) or ("ms/step" in out)
+        assert "summary unavailable" not in out
+
+    @pytest.mark.slow
+    def test_summary_without_dir_rejected(self, tmp_path):
+        from dtf_tpu.workloads import lm
+
+        with pytest.raises(ValueError, match="profile_dir"):
+            lm.main(["--preset", "tiny", "--steps", "2", "--batch_size",
+                     "8", "--profile_summary",
+                     "--logdir", str(tmp_path / "log")])
