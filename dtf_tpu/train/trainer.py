@@ -448,9 +448,6 @@ class Trainer:
         # step would sync the async dispatch pipeline.
         self._host_step = int(self.state["step"])
         self._profiler = None
-        if self.cfg.profile_summary and self.cfg.profile_dir is None:
-            raise ValueError("--profile_summary summarizes a captured "
-                             "trace; pass --profile_dir as well")
         if self.cfg.profile_dir is not None:
             from dtf_tpu.utils.profiling import StepWindowProfiler
             self._profiler = StepWindowProfiler(
@@ -500,6 +497,8 @@ class Trainer:
 
     def fit(self, splits, epochs: Optional[int] = None,
             max_steps: Optional[int] = None) -> dict:
+        pre_traced = (self._profiler.captured_steps
+                      if self._profiler is not None else 0)
         """Epoch loop with the reference's exact console contract.
 
         Resume-correct: the per-step rng is derived by folding the global
@@ -646,9 +645,12 @@ class Trainer:
                 # stop_trace, or the trace file is never written.
                 self._profiler.close(self.state)
         if self._profiler is not None:
-            steps_traced = self._profiler.captured_steps
-            if self.cfg.profile_summary and self.cluster.is_coordinator:
-                if steps_traced == 0:
+            # Steps traced by THIS fit (a second fit on the same Trainer
+            # must not re-print the first run's summary).
+            steps_traced = self._profiler.captured_steps - pre_traced
+            if (self.cfg.profile_summary and self.cluster.is_coordinator
+                    and self._profiler.wrote_trace):
+                if steps_traced <= 0:
                     # Never summarize a dir that may hold a PREVIOUS
                     # run's trace as if it were this run's.
                     self.logger.print(

@@ -63,8 +63,11 @@ class StepWindowProfiler:
         self.done = False
         # Full steps actually covered by the trace — the denominator for
         # any per-step average (a truncated window must not be divided
-        # by the CONFIGURED step count).
+        # by the CONFIGURED step count) — and whether stop_trace actually
+        # wrote a trace (a failed stop must not let a PREVIOUS run's
+        # files be summarized as this run's).
         self.captured_steps = 0
+        self.wrote_trace = False
 
     def after_step(self, host_step: int, state: Any = None) -> None:
         if self.done:
@@ -78,11 +81,13 @@ class StepWindowProfiler:
             self.captured_steps += 1
             if host_step >= self.end:
                 self._stop(state)
+                self.wrote_trace = True
 
     def close(self, state: Any = None) -> None:
         if self.active:
             try:
                 self._stop(state)
+                self.wrote_trace = True
             except Exception:
                 # The error path must neither mask the original loop
                 # exception nor leak the open trace: retry the stop

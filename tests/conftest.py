@@ -58,6 +58,11 @@ _SLOW_TESTS = (
     "tests/test_checkpoint.py::TestTrainerResume::test_resume_past",
     "tests/test_checkpoint.py::TestTrainerResume::test_second_fit",
     "tests/test_decode_kernel.py::TestFusedDecode::test_batched",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_batch16",
+    "tests/test_decode_kernel.py::TestFusedDecode::test_batch32",
+    "tests/test_decode_kernel.py::TestChunkedCache::test_composes",
+    "tests/test_decode_kernel.py::TestChunkedCache::test_generate",
+    "tests/test_gpt.py::TestShardedDecode::test_beam_tp_mesh",
     "tests/test_decode_kernel.py::TestFusedDecode::test_gqa_swiglu",
     "tests/test_decode_kernel.py::TestFusedDecode::test_greedy_matches",
     "tests/test_decode_kernel.py::TestFusedDecode::test_rope_llama",
