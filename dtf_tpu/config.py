@@ -147,6 +147,12 @@ class TrainConfig:
     # them).
     hang_timeout_s: float = 0.0
 
+    def __post_init__(self):
+        if self.profile_summary and not self.profile_dir:
+            raise ValueError(
+                "--profile_summary aggregates a captured trace; it needs "
+                "--profile_dir to capture one")
+
 
 def _field_type(cls, f: dataclasses.Field) -> type:
     """Resolve a dataclass field's runtime type (annotations are strings under

@@ -570,7 +570,11 @@ class GPT(Module):
         below the 128-round-up, keep at least 8-alignment if the window
         allows — the fused path's cache chunking needs an 8-aligned
         divisor of T (sublane tiling), and an odd T would otherwise lock
-        long-context runs out of it."""
+        long-context runs out of it.  With a non-8-aligned max_len there
+        is no aligned choice when total lands in (floor8(max_len),
+        max_len]; fused decode then fails fast in _fused_decode_setup —
+        keep max_len 8-aligned if you want fused decode at every
+        length."""
         t = min(-(-total // 128) * 128, self.cfg.max_len)
         if t % 8 and -(-total // 8) * 8 <= self.cfg.max_len:
             t = max(t - t % 8, -(-total // 8) * 8)
@@ -803,8 +807,8 @@ class GPT(Module):
 
         cfg = self.cfg
         b, p_len = prompt.shape
-        self._check_fused_decode(b)
         total = p_len + max_new_tokens
+        self._check_fused_decode(b, total)
 
         cache, logits = self._prefill_cache(params, prompt,
                                             self._cache_len(total))
@@ -838,18 +842,31 @@ class GPT(Module):
                                      jnp.arange(p_len, total - 1))
         return out
 
-    def _check_fused_decode(self, n_streams: int) -> None:
+    def _check_fused_decode(self, n_streams: int,
+                            total: Optional[int] = None) -> None:
         """The fused stack kernel's preconditions, shared by generate and
         beam (ONE place so the two paths cannot drift): the kernel's
         stream-count rule (``validate_stream_count`` — up to
         MAX_FUSED_STREAMS, in sublane tiles of 8 beyond the first), no
-        pipeline parallelism."""
+        pipeline parallelism, and — given the prompt+new ``total`` — an
+        8-aligned cache length (checked from ints alone, BEFORE any
+        prefill compute is spent)."""
         from dtf_tpu.ops.decode_kernel import validate_stream_count
 
         validate_stream_count(n_streams)
         if self.cfg.pipeline_mesh is not None:
             raise ValueError("fused decode does not compose with pipeline "
                              "parallelism")
+        if total is not None and self._cache_len(total) % 8:
+            # _cache_len keeps T 8-aligned whenever an aligned length fits
+            # inside max_len; it cannot when total lands in
+            # (floor8(max_len), max_len] with a non-8-aligned max_len.
+            raise ValueError(
+                f"fused decode needs an 8-aligned cache length, got "
+                f"T={self._cache_len(total)}: no 8-aligned length >= "
+                f"prompt+new = {total} fits under max_len="
+                f"{self.cfg.max_len}. Use an 8-aligned max_len (or "
+                f"request fewer tokens).")
 
     def _fused_decode_setup(self, params, cache, int8_weights: bool,
                             kv_int8: bool = False):
@@ -958,17 +975,19 @@ class GPT(Module):
         if total > cfg.max_len:
             raise ValueError(f"prompt+new = {total} exceeds max_len "
                              f"{cfg.max_len}")
+        if max_new_tokens == 0:
+            # mirror generate(): the zero-token edge returns before any
+            # fused-path validation (no decode step ever runs)
+            return (jnp.repeat(prompt[:, None], w, axis=1),
+                    jnp.zeros((b, w), jnp.float32))
         if fused:
-            self._check_fused_decode(b * w)
+            self._check_fused_decode(b * w, total)
         elif kv_int8:
             raise ValueError("kv_int8 is a fused-decode feature; pass "
                              "fused=True")
         elif cache_chunk is not None:
             raise ValueError("cache_chunk is a fused-decode feature; "
                              "pass fused=True")
-        if max_new_tokens == 0:
-            return (jnp.repeat(prompt[:, None], w, axis=1),
-                    jnp.zeros((b, w), jnp.float32))
         v_size = cfg.vocab_size
 
         cache, logits = self._prefill_cache(params, prompt,

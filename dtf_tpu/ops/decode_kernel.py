@@ -461,6 +461,13 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         raise ValueError(f"x must be ({b}, {d}) to match the cache's "
                          f"batch dim, got {x.shape}")
     validate_stream_count(b)
+    if t_cache % 8:
+        # Sublane tiling: an odd-T cache block is the Mosaic-legality
+        # hazard ADVICE r4 flagged; the GPT entry points guarantee an
+        # 8-aligned T (_cache_len / _check_fused_decode) — hold direct
+        # callers to the same contract.
+        raise ValueError(f"fused decode needs an 8-aligned cache length, "
+                         f"got T={t_cache}")
     kv_int8 = cache_k.dtype == jnp.int8
     if cache_v.dtype != cache_k.dtype:
         raise ValueError(f"cache_k/cache_v dtypes must match, got "
@@ -486,8 +493,7 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
     if cache_chunk is not None:
         # explicit override (tests; chip tuning) — must tile the cache
         # and still fit the VMEM budget
-        if (cache_chunk < 1 or t_cache % cache_chunk or
-                (cache_chunk % 8 and cache_chunk != t_cache)):
+        if cache_chunk < 1 or t_cache % cache_chunk or cache_chunk % 8:
             raise ValueError(
                 f"cache_chunk {cache_chunk} must be a positive 8-aligned "
                 f"divisor of T={t_cache}")

@@ -497,8 +497,6 @@ class Trainer:
 
     def fit(self, splits, epochs: Optional[int] = None,
             max_steps: Optional[int] = None) -> dict:
-        pre_traced = (self._profiler.captured_steps
-                      if self._profiler is not None else 0)
         """Epoch loop with the reference's exact console contract.
 
         Resume-correct: the per-step rng is derived by folding the global
@@ -514,6 +512,10 @@ class Trainer:
         ``Dataset.process_shard`` + ``put_process_batch`` — same trajectory
         as the global-batch path, 1/nproc the host-side data.
         """
+        # Steps already captured before THIS fit (a second fit on the same
+        # Trainer must not re-print the first run's summary).
+        pre_traced = (self._profiler.captured_steps
+                      if self._profiler is not None else 0)
         mesh = self.cluster.mesh
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
@@ -645,8 +647,6 @@ class Trainer:
                 # stop_trace, or the trace file is never written.
                 self._profiler.close(self.state)
         if self._profiler is not None:
-            # Steps traced by THIS fit (a second fit on the same Trainer
-            # must not re-print the first run's summary).
             steps_traced = self._profiler.captured_steps - pre_traced
             if (self.cfg.profile_summary and self.cluster.is_coordinator
                     and self._profiler.wrote_trace):

@@ -14,6 +14,10 @@ from __future__ import annotations
 import sys
 import time
 
+# Held-out generation prompt width (tokens), shared by the parse-time
+# fused-decode pre-check and the actual prompt slice so they cannot drift.
+PROMPT_LEN = 8
+
 
 def main(argv=None) -> int:
     import jax.numpy as jnp
@@ -133,6 +137,23 @@ def main(argv=None) -> int:
         kw["pipeline_schedule"] = ns.pipeline_schedule
     cfg = GPTConfig.from_preset(ns.preset, **kw)
     model = GPT(cfg)
+    if ns.generate > 0:
+        # Validate the exact generation this run will attempt BEFORE the
+        # training run, not after it: window overflow for any decode
+        # mode, plus the full fused-decode precondition set (stream
+        # count, pipeline, 8-aligned cache window — models/gpt.py
+        # _check_fused_decode).
+        total = PROMPT_LEN + ns.generate
+        if total > cfg.max_len:
+            parser.error(f"--generate {ns.generate}: prompt+new = {total} "
+                         f"exceeds max_len {cfg.max_len} (raise --seq_len "
+                         f"or generate fewer tokens)")
+        if ns.decode_fused:
+            try:
+                model._check_fused_decode(
+                    ns.gen_batch * max(ns.beam_size, 1), total)
+            except ValueError as exc:
+                parser.error(str(exc))
 
     global_batch = global_batch_size(cluster, train_cfg)
     toks = synthetic_text(max(global_batch * 8, 256), cfg.max_len,
@@ -147,7 +168,7 @@ def main(argv=None) -> int:
     if ns.generate > 0:
         import jax
 
-        prompt = jnp.asarray(toks[:ns.gen_batch, :8])
+        prompt = jnp.asarray(toks[:ns.gen_batch, :PROMPT_LEN])
         if ns.beam_size > 1:
             gen = jax.jit(lambda p, pr, key: model.beam_search(
                 p, pr, ns.generate, beam_size=ns.beam_size,

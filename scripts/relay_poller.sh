@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-5 relay poller: probe the TPU relay every POLL_S seconds; the
+# moment a probe succeeds, run the chip blitz (scripts/chip_blitz_r4.sh)
+# exactly once and exit.  A dead relay HANGS rather than raising, so the
+# probe runs under timeout.  The chip is single-tenant: only this poller
+# may touch the axon platform while it runs.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+# Single-instance lock: two pollers -> two concurrent blitzes on the
+# single-tenant chip the moment the relay revives.
+exec 9>/tmp/relay_poller.lock
+flock -n 9 || { echo "another relay_poller holds the lock; exiting" >&2; exit 1; }
+OUT=${1:-/tmp/r5_blitz}
+POLL_S=${POLL_S:-240}
+PROBE_TO=${PROBE_TO:-150}
+LOG=${LOG:-/tmp/relay_poller.log}
+
+echo "$(date -u +%FT%TZ) poller start (probe timeout ${PROBE_TO}s, interval ${POLL_S}s)" >>"$LOG"
+n=0
+while true; do
+  n=$((n + 1))
+  if timeout "$PROBE_TO" python -c "import jax; d=jax.devices(); assert d and all(x.platform != 'cpu' for x in d), f'not a TPU: {d}'; print(d)" >>"$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) probe $n SUCCEEDED - relay alive, launching blitz" >>"$LOG"
+    bash scripts/chip_blitz_r4.sh "$OUT" >>"$LOG" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) blitz finished rc=0 (logs in $OUT)" >>"$LOG"
+    else
+      echo "$(date -u +%FT%TZ) blitz FAILED rc=$rc (logs in $OUT) - check per-step logs" >>"$LOG"
+    fi
+    exit "$rc"
+  fi
+  echo "$(date -u +%FT%TZ) probe $n failed" >>"$LOG"
+  sleep "$POLL_S"
+done

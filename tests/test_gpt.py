@@ -237,6 +237,23 @@ class TestGeneration:
         with pytest.raises(ValueError, match="max_len"):
             tiny.generate(tiny_params, jnp.zeros((1, 60), jnp.int32), 10)
 
+    def test_fused_unaligned_window_fails_fast(self):
+        """With a non-8-aligned max_len and a total in (floor8(max_len),
+        max_len], no 8-aligned cache length exists; fused decode must
+        raise the clear precondition error, not fail deep in the
+        kernel (ADVICE r4)."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny(max_len=59))
+        params = model.init(jax.random.key(0))
+        assert model._cache_len(58) == 59        # the unavoidable odd T
+        with pytest.raises(ValueError, match="8-aligned"):
+            model.generate(params, jnp.zeros((1, 50), jnp.int32), 8,
+                           fused=True, temperature=0.0)
+        # unfused decode still works at the same window
+        out = model.generate(params, jnp.zeros((1, 50), jnp.int32), 8,
+                             temperature=0.0)
+        assert out.shape == (1, 58)
+
 
 class TestGenerateEdges:
     def test_max_new_tokens_zero_returns_prompt(self):
