@@ -85,6 +85,12 @@ class BertConfig:
     moe_experts: int = 0
     moe_top_k: int = 1
     moe_aux_weight: float = 0.01
+    # Fused block kernels (ops/block_kernel.py): the whole attention
+    # half-block (LN/qkv/attention/out-proj/residual) and MLP half-block
+    # each run as ONE Pallas kernel, keeping the (B,T,3D) qkv and (B,T,F)
+    # hidden activations out of HBM.  Dense MHA blocks only (no MoE, no
+    # attn_impl override); backward reuses the flash dq/dk/dv kernel.
+    fused_block: bool = False
 
     @classmethod
     def tiny(cls, **kw):
@@ -106,6 +112,14 @@ class BertEncoderLayer(Module):
 
     def __init__(self, cfg: BertConfig):
         self.cfg = cfg
+        if cfg.fused_block:
+            if cfg.moe_experts > 0:
+                raise ValueError("fused_block supports dense FFN blocks "
+                                 "only (moe_experts must be 0)")
+            if cfg.attn_impl is not None:
+                raise ValueError("fused_block replaces the attention impl "
+                                 "seam; it does not compose with "
+                                 "attn_impl (ring/ulysses)")
         impl = cfg.attn_impl
         if impl is None:
             use_flash = (jax.default_backend() == "tpu"
@@ -140,6 +154,8 @@ class BertEncoderLayer(Module):
         return {name: m.init(k) for (name, m), k in zip(units, keys)}
 
     def apply(self, params, x, *, mask=None, train=False, rng=None):
+        if self.cfg.fused_block:
+            return self._apply_fused(params, x, mask)
         a = self.attn.apply(params["attn"], x, mask=mask)
         x = self.ln1.apply(params["ln1"], x + a)
         if self.moe is not None:
@@ -149,6 +165,23 @@ class BertEncoderLayer(Module):
                                jax.nn.gelu(self.fc1.apply(params["fc1"], x)))
             aux = jnp.zeros((), jnp.float32)
         return self.ln2.apply(params["ln2"], x + h), aux
+
+    def _apply_fused(self, params, x, mask):
+        """Post-LN block through the two fused megakernels
+        (ops/block_kernel.py); the padding mask rides the same (B, Tk)
+        key-padding contract as the flash kernel."""
+        from dtf_tpu.ops.block_kernel import (fused_attn_block,
+                                              fused_mlp_block)
+        kv_mask = None
+        if mask is not None:
+            from dtf_tpu.ops.flash_attention import require_kv_mask
+            kv_mask = require_kv_mask(mask, x, x, "fused_block")
+        x1 = fused_attn_block(x, params["attn"], params["ln1"],
+                              num_heads=self.cfg.num_heads,
+                              kv_mask=kv_mask)
+        y = fused_mlp_block(x1, params["fc1"], params["fc2"],
+                            params["ln2"])
+        return y, jnp.zeros((), jnp.float32)
 
     def axes(self):
         units = [("attn", self.attn), ("ln1", self.ln1),

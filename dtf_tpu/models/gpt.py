@@ -78,6 +78,11 @@ class GPTConfig:
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
     pipeline_schedule: str = "gpipe"
+    # Fused TRAIN-step block kernels (ops/block_kernel.py): pre-LN
+    # attention and MLP half-blocks each as one Pallas kernel.  Dense
+    # gelu MHA without RoPE only; decode/prefill keep their own paths
+    # (the fused decode stack kernel serves generation).
+    fused_block: bool = False
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -130,6 +135,11 @@ class GPTBlock(Module):
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
+        if cfg.fused_block:
+            from dtf_tpu.ops.block_kernel import _check_block_args
+            # fail at construction, not first apply: T checked per-call
+            _check_block_args(8, cfg.dim, cfg.num_heads, cfg.num_kv_heads,
+                              rope=cfg.rope, mlp_act=cfg.mlp_act)
         if cfg.flash_enabled():
             from dtf_tpu.ops.flash_attention import flash_attention_impl
             impl = flash_attention_impl(causal=True)
@@ -191,6 +201,15 @@ class GPTBlock(Module):
         return self._mlp_residual(params, x), k, v
 
     def apply(self, params, x, *, train=False, rng=None):
+        if self.cfg.fused_block:
+            from dtf_tpu.ops.block_kernel import (fused_attn_block,
+                                                  fused_mlp_block)
+            x = fused_attn_block(x, params["attn"], params["ln1"],
+                                 num_heads=self.cfg.num_heads,
+                                 num_kv_heads=self.cfg.num_kv_heads,
+                                 causal=True, prenorm=True)
+            return fused_mlp_block(x, params["fc1"], params["fc2"],
+                                   params["ln2"], prenorm=True)
         y, _, _ = self.prefill(params, x)
         return y
 
@@ -572,7 +591,7 @@ class GPT(Module):
         divisor of T (sublane tiling), and an odd T would otherwise lock
         long-context runs out of it.  With a non-8-aligned max_len there
         is no aligned choice when total lands in (floor8(max_len),
-        max_len]; fused decode then fails fast in _fused_decode_setup —
+        max_len]; fused decode then fails fast in _check_fused_decode —
         keep max_len 8-aligned if you want fused decode at every
         length."""
         t = min(-(-total // 128) * 128, self.cfg.max_len)

@@ -55,6 +55,11 @@ def main(argv=None) -> int:
                         default="auto",
                         help="inner attention: pallas flash kernel (mask-"
                              "capable) vs XLA softmax (auto = flash on TPU)")
+    parser.add_argument("--fused_block", action="store_true",
+                        help="run each encoder block as two fused Pallas "
+                             "megakernels (attention + MLP halves; "
+                             "ops/block_kernel.py) — qkv and the MLP "
+                             "hidden never touch HBM")
     parser.add_argument("--ring_attention", action="store_true",
                         help="sequence-parallel ring attention over 'seq'")
     parser.add_argument("--ulysses", action="store_true",
@@ -114,6 +119,8 @@ def main(argv=None) -> int:
         kw["layer_loop"] = ns.layer_loop
     if ns.moe_experts > 0:
         kw["moe_experts"] = ns.moe_experts
+    if ns.fused_block:
+        kw["fused_block"] = True
     if ns.mlm_predictions is not None:
         kw["mlm_predictions"] = ns.mlm_predictions
     elif ns.preset == "base":

@@ -27,8 +27,7 @@ def main(argv=None) -> int:
     from dtf_tpu.config import ClusterConfig, TrainConfig, build_parser, _from_namespace
     from dtf_tpu.data.datasets import synthetic_text
     from dtf_tpu.models.gpt import GPT, GPTConfig
-    from dtf_tpu.ops.decode_kernel import (MAX_FUSED_STREAMS, STREAM_TILE,
-                                           validate_stream_count)
+    from dtf_tpu.ops.decode_kernel import MAX_FUSED_STREAMS, STREAM_TILE
     from dtf_tpu.train.metrics import MetricLogger
     from dtf_tpu.utils.timing import block
     from dtf_tpu.workloads._driver import global_batch_size, pretrain_benchmark
@@ -68,6 +67,11 @@ def main(argv=None) -> int:
                         default="auto",
                         help="inner attention: pallas flash kernel vs XLA "
                              "softmax attention (auto = flash on TPU)")
+    parser.add_argument("--fused_block", action="store_true",
+                        help="run each decoder block as two fused Pallas "
+                             "megakernels (attention + MLP halves; "
+                             "ops/block_kernel.py) for the TRAIN step — "
+                             "generation keeps its own decode paths")
     parser.add_argument("--generate", type=int, default=0, metavar="N",
                         help="after training, generate N tokens from a "
                              "held-out prompt (KV-cache decode)")
@@ -103,16 +107,8 @@ def main(argv=None) -> int:
     parser.add_argument("--label_smoothing", type=float, default=0.0,
                         help="eps of uniform mass in the CE loss")
     ns = parser.parse_args(argv)
-    # Fail fast on the fused-decode preconditions (models/gpt.py
-    # _check_fused_decode) BEFORE the training run, not after it.
-    if ns.generate > 0 and ns.decode_fused:
-        try:
-            validate_stream_count(ns.gen_batch * max(ns.beam_size, 1))
-        except ValueError as exc:
-            parser.error(str(exc))
-        if ns.pipeline_microbatches > 0:
-            parser.error("--decode_fused does not compose with pipeline "
-                         "parallelism (--pipeline_microbatches)")
+    # Decode-mode flag validation; the full fused-decode precondition set
+    # runs once, post-model-construction, via _check_fused_decode below.
     if ns.decode_kv_int8 and not ns.decode_fused:
         parser.error("--decode_kv_int8 requires --decode_fused (the "
                      "op-per-op loop keeps the fp cache)")
@@ -124,7 +120,7 @@ def main(argv=None) -> int:
 
     kw = {"dtype": jnp.bfloat16 if ns.bf16 else jnp.float32,
           "remat": ns.remat, "remat_policy": ns.remat_policy,
-          "layer_loop": ns.layer_loop,
+          "layer_loop": ns.layer_loop, "fused_block": ns.fused_block,
           "label_smoothing": ns.label_smoothing,
           "loss_chunk": ns.loss_chunk}
     if ns.attn != "auto":

@@ -17,11 +17,14 @@ cd "$(dirname "$0")/.."
 # AVOIDS) — a timeout firing mid-compile is the known relay-wedging
 # action, so the margins are deliberately generous and a health probe
 # runs after every step to catch a wedged relay early.
+FAILS=0
 run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 to=$2; shift 2
+  local name=$1 to=$2 rc; shift 2
   echo "=== $name (timeout ${to}s) ==="
   timeout "$to" "$@" >"$OUT/$name.log" 2>&1
-  echo "rc=$? -> $OUT/$name.log"
+  rc=$?
+  echo "rc=$rc -> $OUT/$name.log"
+  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
   tail -5 "$OUT/$name.log"
   timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
     || echo "WARNING: relay health probe FAILED after $name - STOP and check"
@@ -132,4 +135,5 @@ run int8_trained 3600 python -m dtf_tpu.bench.int8_quality \
 run int8_random 3600 python -m dtf_tpu.bench.int8_quality \
   --preset gpt2_small
 
-echo "=== blitz complete; logs in $OUT ==="
+echo "=== blitz complete; logs in $OUT; failed steps: $FAILS ==="
+[ "$FAILS" -eq 0 ]

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5 chip blitz: the full round-4 queue (unchanged, highest priority
+# after two dark rounds — see scripts/chip_blitz_r4.sh) followed by the
+# round-5 additions: Mosaic validation + MFU rows for the fused
+# transformer-block kernels (ops/block_kernel.py).
+# Usage: bash scripts/chip_blitz_r5.sh [outdir]   (default /tmp/r5_blitz)
+set -u
+OUT=${1:-/tmp/r5_blitz}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.." || exit 1
+
+bash scripts/chip_blitz_r4.sh "$OUT"
+R4_RC=$?
+
+FAILS=0
+run() {  # run <name> <timeout_s> <cmd...>  (same contract as r4)
+  local name=$1 to=$2 rc; shift 2
+  echo "=== $name (timeout ${to}s) ==="
+  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+  rc=$?
+  echo "rc=$rc -> $OUT/$name.log"
+  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
+  tail -5 "$OUT/$name.log"
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
+    || echo "WARNING: relay health probe FAILED after $name - STOP and check"
+}
+
+# 7. Fused-block kernels: cheap 2-step compile probes FIRST (a Mosaic
+#    rejection must cost minutes, not a 3600s window), then the MFU rows
+#    with the same flags as the r4 headline rows so the comparison is
+#    one-variable.
+run fused_block_bert_probe 1800 python -m dtf_tpu.workloads.bert_pretrain \
+  --preset base --bf16 --per_device_batch 8 --steps 2 --fused_block
+run fused_block_gpt_probe 1800 python -m dtf_tpu.workloads.lm \
+  --preset gpt2_small --bf16 --per_device_batch 2 --steps 2 --fused_block
+run bert_fused_block 3600 python -m dtf_tpu.workloads.bert_pretrain \
+  --preset base --bf16 --remat --remat_policy attn --layer_loop unroll \
+  --per_device_batch 64 --steps 30 --fused_block
+run gpt_fused_block 3600 python -m dtf_tpu.workloads.lm \
+  --preset gpt2_small --bf16 --remat --remat_policy attn \
+  --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \
+  --fused_block
+
+echo "=== r5 blitz complete; logs in $OUT; r4 rc=$R4_RC, r5 failed steps: $FAILS ==="
+[ "$R4_RC" -eq 0 ] && [ "$FAILS" -eq 0 ]

@@ -21,7 +21,7 @@ while true; do
   n=$((n + 1))
   if timeout "$PROBE_TO" python -c "import jax; d=jax.devices(); assert d and all(x.platform != 'cpu' for x in d), f'not a TPU: {d}'; print(d)" >>"$LOG" 2>&1; then
     echo "$(date -u +%FT%TZ) probe $n SUCCEEDED - relay alive, launching blitz" >>"$LOG"
-    bash scripts/chip_blitz_r4.sh "$OUT" >>"$LOG" 2>&1
+    bash scripts/chip_blitz_r5.sh "$OUT" >>"$LOG" 2>&1
     rc=$?
     if [ "$rc" -eq 0 ]; then
       echo "$(date -u +%FT%TZ) blitz finished rc=0 (logs in $OUT)" >>"$LOG"
