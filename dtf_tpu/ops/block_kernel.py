@@ -67,6 +67,15 @@ def _ln(x32, scale_row, bias_row, eps):
     return (x32 - mean) * jax.lax.rsqrt(var + eps) * scale_row + bias_row
 
 
+def _q_block(t):
+    """Largest q-block that divides t, is a multiple of 8, <= 256."""
+    for b in range(min(256, t), 7, -1):
+        if t % b == 0 and b % 8 == 0:
+            return b
+    raise AssertionError(       # _check_block_args enforces t % 8 == 0
+        f"unreachable: T={t} was validated as a multiple of 8")
+
+
 def _check_block_args(t, d, num_heads, num_kv_heads, rope=False,
                       mlp_act="gelu"):
     if num_kv_heads not in (None, num_heads):
@@ -129,26 +138,40 @@ def _attn_block_kernel(*refs, num_heads, causal, prenorm, eps, has_mask,
         preferred_element_type=jnp.float32) + bqkv_ref[:1, :].astype(
             jnp.float32)
 
+    # Causal q-block loop (static python unroll): each q block only
+    # multiplies against keys [0, q_end) — at T=1024/bq=256 that skips
+    # ~44% of the attention matmul FLOPs the full (T, T) strip would
+    # burn above the diagonal (the flash kernel's block-skipping,
+    # without its online softmax: the visible key strip is whole).
+    # Non-causal attention has nothing to skip, so it stays one strip
+    # (blocking it would only multiply unrolled kernel code).
+    bq = _q_block(t) if causal else t
     for hi in range(num_heads):
-        q = qkv_scr[:, hi * hd:(hi + 1) * hd].astype(cdt)      # (T, hd)
-        k = qkv_scr[:, d + hi * hd:d + (hi + 1) * hd].astype(cdt)
-        v = qkv_scr[:, 2 * d + hi * hd:2 * d + (hi + 1) * hd].astype(cdt)
-        s = jax.lax.dot_general(                               # (T, T)
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, MASK_VALUE)
-        if bias_ref is not None:
-            s = s + bias_ref[0][:1, :]                         # (1, T)
-        m = jnp.max(s, axis=-1, keepdims=True)                 # (T, 1)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:, hi * hd:(hi + 1) * hd] = jax.lax.dot(
-            p.astype(cdt), v, preferred_element_type=jnp.float32) / l
-        if lse_ref is not None:
-            lse_ref[0, hi] = jnp.broadcast_to(m + jnp.log(l), (t, 8))
+        k_full = qkv_scr[:, d + hi * hd:d + (hi + 1) * hd].astype(cdt)
+        v_full = qkv_scr[:, 2 * d + hi * hd:2 * d + (hi + 1) * hd].astype(
+            cdt)
+        for qb in range(t // bq):
+            q0 = qb * bq
+            k_end = q0 + bq if causal else t
+            q = qkv_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd].astype(cdt)
+            s = jax.lax.dot_general(                       # (bq, k_end)
+                q, k_full[:k_end], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(row >= col, s, MASK_VALUE)
+            if bias_ref is not None:
+                s = s + bias_ref[0][:1, :k_end]            # (1, k_end)
+            m = jnp.max(s, axis=-1, keepdims=True)         # (bq, 1)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd] = jax.lax.dot(
+                p.astype(cdt), v_full[:k_end],
+                preferred_element_type=jnp.float32) / l
+            if lse_ref is not None:
+                lse_ref[0, hi, q0:q0 + bq] = jnp.broadcast_to(
+                    m + jnp.log(l), (bq, 8))
 
     if raw_ref is not None:
         raw_ref[0] = acc_scr[:].astype(raw_ref.dtype)
