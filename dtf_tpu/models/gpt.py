@@ -79,8 +79,9 @@ class GPTConfig:
     pipeline_microbatches: int = 2
     pipeline_schedule: str = "gpipe"
     # Fused TRAIN-step block kernels (ops/block_kernel.py): pre-LN
-    # attention and MLP half-blocks each as one Pallas kernel.  Dense
-    # gelu MHA without RoPE only; decode/prefill keep their own paths
+    # attention and MLP half-blocks each as one Pallas kernel; covers
+    # the LLaMA options too (RoPE in-kernel, GQA packed k/v, SwiGLU via
+    # a packed up|gate matmul).  Decode/prefill keep their own paths
     # (the fused decode stack kernel serves generation).
     fused_block: bool = False
 
@@ -207,9 +208,12 @@ class GPTBlock(Module):
             x = fused_attn_block(x, params["attn"], params["ln1"],
                                  num_heads=self.cfg.num_heads,
                                  num_kv_heads=self.cfg.num_kv_heads,
-                                 causal=True, prenorm=True)
+                                 causal=True, prenorm=True,
+                                 rope=self.cfg.rope)
             return fused_mlp_block(x, params["fc1"], params["fc2"],
-                                   params["ln2"], prenorm=True)
+                                   params["ln2"],
+                                   fc_gate_params=params.get("fc_gate"),
+                                   prenorm=True)
         y, _, _ = self.prefill(params, x)
         return y
 

@@ -33,10 +33,13 @@ Two kernels per block (attention megakernel + MLP megakernel), each a
   shapes XLA already runs near roofline).
 
 Both variants cover post-LN (BERT: ``LN(x + f(x))``) and pre-LN (GPT:
-``x + f(LN(x))``) blocks.  Scope guards (clear errors, not silent
-fallbacks): MHA only (no GQA), no RoPE, gelu MLP (no SwiGLU), T % 8 == 0,
-T <= MAX_FUSED_T.  On CPU the kernels run in interpreter mode
-automatically (tests, the 8-device simulated mesh).
+``x + f(LN(x))``) blocks, and the LLaMA family options: RoPE rotated
+in-kernel from fp32 angle tables, GQA via a packed (D, D+2·KVH·hd) qkv
+matmul with k/v strips shared per head group, SwiGLU via a packed
+(D, 2F) up|gate matmul split in-kernel.  Scope guards (clear errors, not
+silent fallbacks): T % 8 == 0, T <= MAX_FUSED_T, KVH | H, even head dim
+under RoPE.  On CPU the kernels run in interpreter mode automatically
+(tests, the 8-device simulated mesh).
 """
 
 from __future__ import annotations
@@ -78,16 +81,15 @@ def _q_block(t):
 
 def _check_block_args(t, d, num_heads, num_kv_heads, rope=False,
                       mlp_act="gelu"):
-    if num_kv_heads not in (None, num_heads):
-        raise ValueError(
-            f"fused block kernels support MHA only (num_kv_heads="
-            f"{num_kv_heads} != num_heads={num_heads}); use the unfused "
-            f"block for GQA")
-    if rope:
-        raise ValueError("fused block kernels do not support RoPE yet; "
-                         "use the unfused block")
-    if mlp_act != "gelu":
-        raise ValueError(f"fused block kernels support gelu MLPs only, "
+    kvh = num_kv_heads or num_heads
+    if num_heads % kvh:
+        raise ValueError(f"num_kv_heads {kvh} must divide num_heads "
+                         f"{num_heads}")
+    if rope and (d // num_heads) % 2:
+        raise ValueError(f"RoPE needs an even head dim, got "
+                         f"{d // num_heads}")
+    if mlp_act not in ("gelu", "swiglu"):
+        raise ValueError(f"fused block kernels support gelu/swiglu MLPs, "
                          f"got {mlp_act!r}")
     if t % 8 or t > MAX_FUSED_T:
         raise ValueError(
@@ -102,21 +104,35 @@ def _check_block_args(t, d, num_heads, num_kv_heads, rope=False,
 # attention megakernel
 # --------------------------------------------------------------------------
 
-def _attn_block_kernel(*refs, num_heads, causal, prenorm, eps, has_mask,
-                       emit_aux):
+def _rope_rotate(x32, cos, sin):
+    """Split-half rotation on fp32 (rows, hd) with (rows, hd/2) tables —
+    the same expression as nn.rope.apply_rope."""
+    hh = x32.shape[-1] // 2
+    x1, x2 = x32[:, :hh], x32[:, hh:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=1)
+
+
+def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
+                       eps, has_mask, has_rope, emit_aux):
     """One batch row: LN/qkv/attention/out-proj/residual(/LN) in VMEM.
 
-    refs (has_mask adds bias_ref before the outputs; without ``emit_aux``
-    — the inference/eval primal — the raw/lse outputs are absent, so a
-    no-grad forward never writes them to HBM):
-      x_ref (1,T,D), wqkv_ref (D,3D), bqkv_ref (8,3D), wo_ref (D,D),
-      bo_ref (8,D), lns_ref (8,D), lnb_ref (8,D) [, bias_ref (1,8,T)],
+    refs (has_rope adds cos/sin tables, has_mask adds bias_ref, both
+    before the outputs; without ``emit_aux`` — the inference/eval primal
+    — the raw/lse outputs are absent, so a no-grad forward never writes
+    them to HBM).  W = D + 2·KVH·hd (GQA packs KVH k/v heads):
+      x_ref (1,T,D), wqkv_ref (D,W), bqkv_ref (8,W), wo_ref (D,D),
+      bo_ref (8,D), lns_ref (8,D), lnb_ref (8,D) [, cos_ref (T,hd/2),
+      sin_ref (T,hd/2)] [, bias_ref (1,8,T)],
       y_ref (1,T,D) [, raw_ref (1,T,D), lse_ref (1,H,T,8)],
-      qkv_scr (T,3D) f32, acc_scr (T,D) f32
+      qkv_scr (T,W) f32, acc_scr (T,D) f32
     """
     (x_ref, wqkv_ref, bqkv_ref, wo_ref, bo_ref, lns_ref, lnb_ref,
      *rest) = refs
     rest = list(rest)
+    cos_ref = sin_ref = None
+    if has_rope:
+        cos_ref, sin_ref = rest.pop(0), rest.pop(0)
     bias_ref = rest.pop(0) if has_mask else None
     if emit_aux:
         y_ref, raw_ref, lse_ref, qkv_scr, acc_scr = rest
@@ -126,6 +142,9 @@ def _attn_block_kernel(*refs, num_heads, causal, prenorm, eps, has_mask,
 
     t, d = x_ref.shape[1], x_ref.shape[2]
     hd = d // num_heads
+    kvh = num_kv_heads or num_heads
+    group = num_heads // kvh
+    kvw = kvh * hd
     scale = hd ** -0.5
     cdt = x_ref.dtype                       # matmul input dtype (MXU rate)
 
@@ -144,34 +163,45 @@ def _attn_block_kernel(*refs, num_heads, causal, prenorm, eps, has_mask,
     # burn above the diagonal (the flash kernel's block-skipping,
     # without its online softmax: the visible key strip is whole).
     # Non-causal attention has nothing to skip, so it stays one strip
-    # (blocking it would only multiply unrolled kernel code).
+    # (blocking it would only multiply unrolled kernel code).  GQA: the
+    # outer loop walks KV heads so each shared k/v strip (and its RoPE
+    # rotation) is built once per group, not once per q head.
     bq = _q_block(t) if causal else t
-    for hi in range(num_heads):
-        k_full = qkv_scr[:, d + hi * hd:d + (hi + 1) * hd].astype(cdt)
-        v_full = qkv_scr[:, 2 * d + hi * hd:2 * d + (hi + 1) * hd].astype(
-            cdt)
-        for qb in range(t // bq):
-            q0 = qb * bq
-            k_end = q0 + bq if causal else t
-            q = qkv_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd].astype(cdt)
-            s = jax.lax.dot_general(                       # (bq, k_end)
-                q, k_full[:k_end], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if causal:
-                row = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                s = jnp.where(row >= col, s, MASK_VALUE)
-            if bias_ref is not None:
-                s = s + bias_ref[0][:1, :k_end]            # (1, k_end)
-            m = jnp.max(s, axis=-1, keepdims=True)         # (bq, 1)
-            p = jnp.exp(s - m)
-            l = jnp.sum(p, axis=-1, keepdims=True)
-            acc_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd] = jax.lax.dot(
-                p.astype(cdt), v_full[:k_end],
-                preferred_element_type=jnp.float32) / l
-            if lse_ref is not None:
-                lse_ref[0, hi, q0:q0 + bq] = jnp.broadcast_to(
-                    m + jnp.log(l), (bq, 8))
+    for g in range(kvh):
+        k32 = qkv_scr[:, d + g * hd:d + (g + 1) * hd]
+        if has_rope:
+            k32 = _rope_rotate(k32, cos_ref[:], sin_ref[:])
+        k_full = k32.astype(cdt)
+        v_full = qkv_scr[:, d + kvw + g * hd:d + kvw + (g + 1) * hd
+                         ].astype(cdt)
+        for hi in range(g * group, (g + 1) * group):
+            for qb in range(t // bq):
+                q0 = qb * bq
+                k_end = q0 + bq if causal else t
+                q32 = qkv_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd]
+                if has_rope:
+                    q32 = _rope_rotate(q32, cos_ref[q0:q0 + bq],
+                                       sin_ref[q0:q0 + bq])
+                s = jax.lax.dot_general(                   # (bq, k_end)
+                    q32.astype(cdt), k_full[:k_end],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if causal:
+                    row = q0 + jax.lax.broadcasted_iota(
+                        jnp.int32, s.shape, 0)
+                    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                    s = jnp.where(row >= col, s, MASK_VALUE)
+                if bias_ref is not None:
+                    s = s + bias_ref[0][:1, :k_end]        # (1, k_end)
+                m = jnp.max(s, axis=-1, keepdims=True)     # (bq, 1)
+                p = jnp.exp(s - m)
+                l = jnp.sum(p, axis=-1, keepdims=True)
+                acc_scr[q0:q0 + bq, hi * hd:(hi + 1) * hd] = jax.lax.dot(
+                    p.astype(cdt), v_full[:k_end],
+                    preferred_element_type=jnp.float32) / l
+                if lse_ref is not None:
+                    lse_ref[0, hi, q0:q0 + bq] = jnp.broadcast_to(
+                        m + jnp.log(l), (bq, 8))
 
     if raw_ref is not None:
         raw_ref[0] = acc_scr[:].astype(raw_ref.dtype)
@@ -185,20 +215,28 @@ def _attn_block_kernel(*refs, num_heads, causal, prenorm, eps, has_mask,
     y_ref[0] = y.astype(y_ref.dtype)
 
 
-def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias, num_heads,
-              causal, prenorm, eps, interpret, emit_aux=True):
+def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
+              num_heads, num_kv_heads, causal, prenorm, eps, interpret,
+              emit_aux=True):
     b, t, d = x.shape
+    w = wqkv.shape[1]                 # D + 2·KVH·hd
+    hh = d // num_heads // 2
     has_mask = bias is not None
+    has_rope = cos is not None
     in_specs = [
         pl.BlockSpec((1, t, d), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((d, 3 * d), lambda bi: (0, 0)),
-        pl.BlockSpec((8, 3 * d), lambda bi: (0, 0)),
+        pl.BlockSpec((d, w), lambda bi: (0, 0)),
+        pl.BlockSpec((8, w), lambda bi: (0, 0)),
         pl.BlockSpec((d, d), lambda bi: (0, 0)),
         pl.BlockSpec((8, d), lambda bi: (0, 0)),
         pl.BlockSpec((8, d), lambda bi: (0, 0)),
         pl.BlockSpec((8, d), lambda bi: (0, 0)),
     ]
     args = [x, wqkv, bqkv8, wo, bo8, lns8, lnb8]
+    if has_rope:
+        in_specs += [pl.BlockSpec((t, hh), lambda bi: (0, 0)),
+                     pl.BlockSpec((t, hh), lambda bi: (0, 0))]
+        args += [cos, sin]
     if has_mask:
         in_specs.append(pl.BlockSpec((1, 8, t), lambda bi: (bi, 0, 0)))
         args.append(bias)
@@ -215,14 +253,15 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias, num_heads,
         ]
     outs = pl.pallas_call(
         functools.partial(_attn_block_kernel, num_heads=num_heads,
-                          causal=causal, prenorm=prenorm, eps=eps,
-                          has_mask=has_mask, emit_aux=emit_aux),
+                          num_kv_heads=num_kv_heads, causal=causal,
+                          prenorm=prenorm, eps=eps, has_mask=has_mask,
+                          has_rope=has_rope, emit_aux=emit_aux),
         grid=(b,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((t, 3 * d), jnp.float32),   # qkv
+            pltpu.VMEM((t, w), jnp.float32),       # packed qkv
             pltpu.VMEM((t, d), jnp.float32),       # per-head out concat
         ],
         compiler_params=pltpu.CompilerParams(
@@ -239,42 +278,72 @@ def _split_heads(packed, num_heads):
     return packed.reshape(b, t, num_heads, hd).transpose(0, 2, 1, 3)
 
 
-def _merge_heads(per_head):
-    """(B, H, T, hd) -> (B, T, H·hd)."""
-    b, h, t, hd = per_head.shape
-    return per_head.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+def _prepare_qkv(h32, wqkv, bqkv_row, cos, sin, num_heads, num_kv_heads,
+                 cdt):
+    """The projection/rotation/expansion prologue as one differentiable
+    jnp function: (B,T,D) fp32 -> q, k, v (B,H,T,hd) in ``cdt``, RoPE
+    applied, GQA heads repeated up to H.  The backward takes jax.vjp of
+    THIS, so dq/dk/dv from the flash kernel flow back through rotation
+    and head expansion (grouped-head grads summed) by plain AD — no
+    hand-maintained transpose math."""
+    b, t, d = h32.shape
+    kvh = num_kv_heads or num_heads
+    hd = d // num_heads
+    kvw = kvh * hd
+    qkv = jax.lax.dot(h32.astype(cdt).reshape(b * t, d), wqkv,
+                      preferred_element_type=jnp.float32)
+    qkv = (qkv + bqkv_row.astype(jnp.float32)).reshape(b, t, d + 2 * kvw)
+    q = qkv[..., :d].reshape(b, t, num_heads, hd)
+    k = qkv[..., d:d + kvw].reshape(b, t, kvh, hd)
+    v = qkv[..., d + kvw:].reshape(b, t, kvh, hd)
+    if cos is not None:
+        from dtf_tpu.nn.rope import apply_rope
+        pos = jnp.arange(t)
+        q = apply_rope(q, pos)
+        k = apply_rope(k, pos)
+    reps = num_heads // kvh
+    if reps > 1:
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    to_ph = lambda a: a.astype(cdt).transpose(0, 2, 1, 3)
+    return to_ph(q), to_ph(k), to_ph(v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
-def _fused_attn(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias, num_heads,
-                causal, prenorm, eps, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14,
+                                                    15))
+def _fused_attn(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
+                num_heads, num_kv_heads, causal, prenorm, eps, interpret):
     # No-grad forward (eval/inference): the y-only kernel variant — the
     # raw/lse residuals are never written to HBM.
-    y, _, _ = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias,
-                        num_heads, causal, prenorm, eps, interpret,
-                        emit_aux=False)
+    y, _, _ = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
+                        bias, num_heads, num_kv_heads, causal, prenorm,
+                        eps, interpret, emit_aux=False)
     return y
 
 
-def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias,
-                         num_heads, causal, prenorm, eps, interpret):
-    y, raw, lse = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias,
-                            num_heads, causal, prenorm, eps, interpret)
+def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
+                         bias, num_heads, num_kv_heads, causal, prenorm,
+                         eps, interpret):
+    y, raw, lse = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos,
+                            sin, bias, num_heads, num_kv_heads, causal,
+                            prenorm, eps, interpret)
     from jax.ad_checkpoint import checkpoint_name
     # Same names as ops.flash_attention: the "attn" remat policy saves
     # exactly these, so the backward never re-runs the forward kernel.
     raw = checkpoint_name(raw, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return y, (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias, raw, lse)
+    return y, (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias, raw,
+               lse)
 
 
-def _fused_attn_bwd_rule(num_heads, causal, prenorm, eps, interpret, res,
-                         dy):
-    """XLA recompute (qkv projection, LN statistics) + the fused flash
-    dq/dk/dv kernel.  Matmul grads are plain XLA dots — the r3 breakdown
-    measured those at ~84% of roofline, so only attention's O(T^2) work
-    runs in Pallas here."""
-    x, wqkv, bqkv8, wo, bo8, lns8, lnb8, bias, raw, lse = res
+def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
+                         interpret, res, dy):
+    """XLA recompute (qkv projection, RoPE, LN statistics) + the fused
+    flash dq/dk/dv kernel.  Matmul grads are plain XLA dots — the r3
+    breakdown measured those at ~84% of roofline, so only attention's
+    O(T^2) work runs in Pallas here."""
+    (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias, raw,
+     lse) = res
     b, t, d = x.shape
     hd = d // num_heads
     scale = hd ** -0.5
@@ -293,12 +362,10 @@ def _fused_attn_bwd_rule(num_heads, causal, prenorm, eps, interpret, res,
         h, ln1_vjp = x32, None
 
     # --- recompute q/k/v exactly as the kernel produced them ---
-    qkv = jax.lax.dot(h.astype(cdt).reshape(b * t, d), wqkv,
-                      preferred_element_type=f32).reshape(b, t, 3 * d)
-    qkv = qkv + bqkv8[:1, :].astype(f32)
-    q = _split_heads(qkv[..., :d].astype(cdt), num_heads)
-    k = _split_heads(qkv[..., d:2 * d].astype(cdt), num_heads)
-    v = _split_heads(qkv[..., 2 * d:].astype(cdt), num_heads)
+    (q, k, v), prep_vjp = jax.vjp(
+        lambda h_, w_, b_: _prepare_qkv(h_, w_, b_, cos, sin, num_heads,
+                                        num_kv_heads, cdt),
+        h, wqkv, bqkv8[:1, :])
 
     # --- residual/LN tail ---
     raw32 = raw.astype(f32)
@@ -335,19 +402,11 @@ def _fused_attn_bwd_rule(num_heads, causal, prenorm, eps, interpret, res,
     do_ph = _split_heads(d_raw.astype(cdt), num_heads)
     dq, dk, dv = _flash_bwd_call(q, k, v, o_ph, lse, bias, do_ph, causal,
                                  scale, 512, 512, interpret)
-    d_qkv = jnp.concatenate(
-        [_merge_heads(dq.astype(f32)), _merge_heads(dk.astype(f32)),
-         _merge_heads(dv.astype(f32))], axis=-1)               # (B,T,3D)
 
-    # --- projection grads + input cotangent ---
-    d_wqkv = jax.lax.dot_general(
-        h.astype(f32).reshape(b * t, d), d_qkv.reshape(b * t, 3 * d),
-        (((0,), (0,)), ((), ())), preferred_element_type=f32)
-    d_bqkv = jnp.sum(d_qkv, axis=(0, 1))
-    dh = jax.lax.dot_general(
-        d_qkv.reshape(b * t, 3 * d), wqkv.astype(f32),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=f32).reshape(b, t, d)
+    # --- projection/rotation/expansion grads + input cotangent (AD of
+    # the prepare prologue: grouped-head dk/dv sum, RoPE transpose) ---
+    dh, d_wqkv, d_bqkv_row = prep_vjp((dq, dk, dv))
+    d_bqkv = d_bqkv_row[0]
 
     if prenorm:
         (dx_ln,) = ln1_vjp(dh)
@@ -367,10 +426,13 @@ def _fused_attn_bwd_rule(num_heads, causal, prenorm, eps, interpret, res,
         out = jnp.zeros(like.shape, f32).at[0].set(g_row)
         return out.astype(like.dtype)
 
-    d_bias = None if bias is None else jnp.zeros_like(bias)
+    # cos/sin are position tables and bias a 0/-1e30 mask — not
+    # learnable inputs: zero cotangents (None where the primal was None).
+    zlike = lambda a: None if a is None else jnp.zeros_like(a)
     return (dx.astype(x.dtype), d_wqkv.astype(wqkv.dtype),
             rep8(d_bqkv, bqkv8), d_wo.astype(wo.dtype), rep8(d_bo, bo8),
-            rep8(d_lns, lns8), rep8(d_lnb, lnb8), d_bias)
+            rep8(d_lns, lns8), rep8(d_lnb, lnb8), zlike(cos), zlike(sin),
+            zlike(bias))
 
 
 _fused_attn.defvjp(_fused_attn_fwd_rule, _fused_attn_bwd_rule)
@@ -378,46 +440,63 @@ _fused_attn.defvjp(_fused_attn_fwd_rule, _fused_attn_bwd_rule)
 
 def fused_attn_block(x, attn_params, ln_params, *, num_heads,
                      num_kv_heads=None, causal=False, prenorm=False,
-                     kv_mask=None, eps=1e-6, interpret=None):
+                     rope=False, kv_mask=None, eps=1e-6, interpret=None):
     """Fused attention half-block.
 
     post-LN (BERT, ``prenorm=False``): ``LN(x + Attn(x))``
     pre-LN (GPT, ``prenorm=True``):    ``x + Attn(LN(x))``
 
     ``attn_params`` is the MultiHeadAttention param tree (q/k/v/o with
-    (D, H, hd) weights); ``ln_params`` the LayerNorm tree.  ``kv_mask``
-    (B, T) bool marks visible keys (BERT padding); composable with
-    ``causal``.  Packing to the kernel layout (one (D, 3D) qkv matmul,
-    sublane-replicated vectors) happens here in plain jnp, so parameter
-    gradients flow through the packing automatically.
+    (D, H|KVH, hd) weights — GQA packs the smaller k/v projections);
+    ``ln_params`` the LayerNorm tree.  ``rope`` rotates q/k in-kernel
+    with train-step positions arange(T) (split-half convention,
+    nn.rope).  ``kv_mask`` (B, T) bool marks visible keys (BERT
+    padding); composable with ``causal``.  Packing to the kernel layout
+    (one (D, D+2·KVH·hd) qkv matmul, sublane-replicated vectors) happens
+    here in plain jnp, so parameter gradients flow through the packing
+    automatically.
     """
     b, t, d = x.shape
-    _check_block_args(t, d, num_heads, num_kv_heads)
+    _check_block_args(t, d, num_heads, num_kv_heads, rope=rope)
     if interpret is None:
         interpret = _interpret_default()
 
     wqkv = jnp.concatenate(
-        [attn_params[n]["w"].reshape(d, d) for n in ("q", "k", "v")],
+        [attn_params[n]["w"].reshape(d, -1) for n in ("q", "k", "v")],
         axis=1)
     bqkv = jnp.concatenate(
-        [attn_params[n]["b"].reshape(d) for n in ("q", "k", "v")])
+        [attn_params[n]["b"].reshape(-1) for n in ("q", "k", "v")])
     wo = attn_params["o"]["w"].reshape(d, d)
     rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))
     bias = None if kv_mask is None else _mask_bias(kv_mask, t)
+    cos = sin = None
+    if rope:
+        from dtf_tpu.nn.rope import rope_angles
+        cos, sin = rope_angles(jnp.arange(t), d // num_heads)  # (T, hd/2)
     return _fused_attn(x, wqkv, rep8(bqkv), wo,
                        rep8(attn_params["o"]["b"]),
                        rep8(ln_params["scale"]), rep8(ln_params["bias"]),
-                       bias, num_heads, causal, prenorm, eps, interpret)
+                       cos, sin, bias, num_heads, num_kv_heads, causal,
+                       prenorm, eps, interpret)
 
 
 # --------------------------------------------------------------------------
 # MLP megakernel
 # --------------------------------------------------------------------------
 
+def _mlp_act(h1, act):
+    """gelu on the (rows, F) hidden, or SwiGLU on a (rows, 2F) packed
+    [up | gate] hidden (one matmul produced both halves)."""
+    if act == "gelu":
+        return jax.nn.gelu(h1)
+    f = h1.shape[-1] // 2
+    return jax.nn.silu(h1[:, f:]) * h1[:, :f]
+
+
 def _mlp_block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref,
-                      lnb_ref, y_ref, *, prenorm, eps):
-    """One (rows, D) block: LN/fc1/gelu/fc2/residual(/LN); the (rows, F)
-    hidden exists only in VMEM."""
+                      lnb_ref, y_ref, *, act, prenorm, eps):
+    """One (rows, D) block: LN/fc1/act/fc2/residual(/LN); the (rows, F)
+    (or (rows, 2F) SwiGLU [up|gate]) hidden exists only in VMEM."""
     cdt = x_ref.dtype
     x32 = x_ref[:].astype(jnp.float32)
     lns = lns_ref[:1, :].astype(jnp.float32)
@@ -426,7 +505,7 @@ def _mlp_block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref,
     h1 = jax.lax.dot(h.astype(cdt), w1_ref[:],
                      preferred_element_type=jnp.float32) + b1_ref[
                          :1, :].astype(jnp.float32)
-    g = jax.nn.gelu(h1)
+    g = _mlp_act(h1, act)
     h2 = jax.lax.dot(g.astype(cdt), w2_ref[:],
                      preferred_element_type=jnp.float32) + b2_ref[
                          :1, :].astype(jnp.float32)
@@ -442,18 +521,21 @@ def _mlp_rows(n):
     raise ValueError(f"B*T = {n} has no 8-aligned row block; pad the batch")
 
 
-def _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps, interpret):
+def _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+             interpret):
     n, d = x2.shape
-    f = w1.shape[1]
+    f = w1.shape[1]                   # F, or 2F for the SwiGLU pack
+    f2 = w2.shape[0]                  # always F
     bn = _mlp_rows(n)
     return pl.pallas_call(
-        functools.partial(_mlp_block_kernel, prenorm=prenorm, eps=eps),
+        functools.partial(_mlp_block_kernel, act=act, prenorm=prenorm,
+                          eps=eps),
         grid=(n // bn,),
         in_specs=[
             pl.BlockSpec((bn, d), lambda i: (i, 0)),
             pl.BlockSpec((d, f), lambda i: (0, 0)),
             pl.BlockSpec((8, f), lambda i: (0, 0)),
-            pl.BlockSpec((f, d), lambda i: (0, 0)),
+            pl.BlockSpec((f2, d), lambda i: (0, 0)),
             pl.BlockSpec((8, d), lambda i: (0, 0)),
             pl.BlockSpec((8, d), lambda i: (0, 0)),
             pl.BlockSpec((8, d), lambda i: (0, 0)),
@@ -466,7 +548,7 @@ def _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps, interpret):
     )(x2, w1, b18, w2, b28, lns8, lnb8)
 
 
-def _mlp_ref(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps):
+def _mlp_ref(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps):
     """XLA reference with the kernel's exact dtype discipline — the
     backward differentiates THIS, so grads match the fused forward."""
     cdt = x2.dtype
@@ -476,29 +558,31 @@ def _mlp_ref(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps):
     h = _ln(x32, lns, lnb, eps) if prenorm else x32
     h1 = jax.lax.dot(h.astype(cdt), w1,
                      preferred_element_type=f32) + b18[:1, :].astype(f32)
-    h2 = jax.lax.dot(jax.nn.gelu(h1).astype(cdt), w2,
+    h2 = jax.lax.dot(_mlp_act(h1, act).astype(cdt), w2,
                      preferred_element_type=f32) + b28[:1, :].astype(f32)
     u = x32 + h2
     return (u if prenorm else _ln(u, lns, lnb, eps)).astype(x2.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
-def _fused_mlp(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps, interpret):
-    return _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _fused_mlp(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+               interpret):
+    return _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
                     interpret)
 
 
-def _fused_mlp_fwd_rule(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps,
-                        interpret):
-    y = _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, prenorm, eps, interpret)
+def _fused_mlp_fwd_rule(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm,
+                        eps, interpret):
+    y = _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+                 interpret)
     return y, (x2, w1, b18, w2, b28, lns8, lnb8)
 
 
-def _fused_mlp_bwd_rule(prenorm, eps, interpret, res, dy):
+def _fused_mlp_bwd_rule(act, prenorm, eps, interpret, res, dy):
     # Rebuilding the (rows, F) hidden costs two matmuls XLA runs near
     # roofline — cheaper than saving ~190 MB/layer of it to HBM.
     _, vjp = jax.vjp(
-        lambda *a: _mlp_ref(*a, prenorm=prenorm, eps=eps), *res)
+        lambda *a: _mlp_ref(*a, act=act, prenorm=prenorm, eps=eps), *res)
     return vjp(dy)
 
 
@@ -506,19 +590,28 @@ _fused_mlp.defvjp(_fused_mlp_fwd_rule, _fused_mlp_bwd_rule)
 
 
 def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
-                    prenorm=False, eps=1e-6, interpret=None):
+                    fc_gate_params=None, prenorm=False, eps=1e-6,
+                    interpret=None):
     """Fused MLP half-block.
 
-    post-LN (BERT): ``LN(x + fc2(gelu(fc1(x))))``
-    pre-LN (GPT):   ``x + fc2(gelu(fc1(LN(x))))``
+    post-LN (BERT): ``LN(x + fc2(act(fc1(x))))``
+    pre-LN (GPT):   ``x + fc2(act(fc1(LN(x))))``
 
+    ``fc_gate_params`` switches the activation to SwiGLU
+    (``silu(gate(h)) * fc1(h)``, models/gpt.py GPTBlock) — the gate and
+    up projections pack into ONE (D, 2F) matmul, split in-kernel.
     Operates on flattened (B·T, D) rows — no cross-row coupling."""
     b, t, d = x.shape
     if interpret is None:
         interpret = _interpret_default()
     rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))
-    y = _fused_mlp(x.reshape(b * t, d), fc1_params["w"],
-                   rep8(fc1_params["b"]), fc2_params["w"],
+    w1, b1 = fc1_params["w"], fc1_params["b"]
+    act = "gelu"
+    if fc_gate_params is not None:
+        act = "swiglu"
+        w1 = jnp.concatenate([w1, fc_gate_params["w"]], axis=1)
+        b1 = jnp.concatenate([b1, fc_gate_params["b"]])
+    y = _fused_mlp(x.reshape(b * t, d), w1, rep8(b1), fc2_params["w"],
                    rep8(fc2_params["b"]), rep8(ln_params["scale"]),
-                   rep8(ln_params["bias"]), prenorm, eps, interpret)
+                   rep8(ln_params["bias"]), act, prenorm, eps, interpret)
     return y.reshape(b, t, d)

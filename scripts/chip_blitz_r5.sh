@@ -40,6 +40,11 @@ run gpt_fused_block 3600 python -m dtf_tpu.workloads.lm \
   --preset gpt2_small --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \
   --fused_block
+# llama wiring (RoPE in-kernel + GQA packed k/v + SwiGLU up|gate pack)
+run llama_fused_block 3600 python -m dtf_tpu.workloads.lm \
+  --preset llama --bf16 --remat --remat_policy attn \
+  --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \
+  --fused_block
 
 echo "=== r5 blitz complete; logs in $OUT; r4 rc=$R4_RC, r5 failed steps: $FAILS ==="
 [ "$R4_RC" -eq 0 ] && [ "$FAILS" -eq 0 ]
