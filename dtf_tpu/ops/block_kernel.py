@@ -297,10 +297,19 @@ def _prepare_qkv(h32, wqkv, bqkv_row, cos, sin, num_heads, num_kv_heads,
     k = qkv[..., d:d + kvw].reshape(b, t, kvh, hd)
     v = qkv[..., d + kvw:].reshape(b, t, kvh, hd)
     if cos is not None:
-        from dtf_tpu.nn.rope import apply_rope
-        pos = jnp.arange(t)
-        q = apply_rope(q, pos)
-        k = apply_rope(k, pos)
+        # Rotate with the SAME tables the forward kernel consumed (one
+        # source of truth — a caller-supplied theta cannot diverge
+        # between forward and backward).
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+        hh = hd // 2
+
+        def rot(a):
+            a1, a2 = a[..., :hh], a[..., hh:]
+            return jnp.concatenate([a1 * c - a2 * s, a1 * s + a2 * c],
+                                   axis=-1)
+
+        q, k = rot(q), rot(k)
     reps = num_heads // kvh
     if reps > 1:
         k = jnp.repeat(k, reps, axis=2)
@@ -484,19 +493,24 @@ def fused_attn_block(x, attn_params, ln_params, *, num_heads,
 # MLP megakernel
 # --------------------------------------------------------------------------
 
-def _mlp_act(h1, act):
-    """gelu on the (rows, F) hidden, or SwiGLU on a (rows, 2F) packed
-    [up | gate] hidden (one matmul produced both halves)."""
-    if act == "gelu":
-        return jax.nn.gelu(h1)
-    f = h1.shape[-1] // 2
-    return jax.nn.silu(h1[:, f:]) * h1[:, :f]
-
-
-def _mlp_block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref,
-                      lnb_ref, y_ref, *, act, prenorm, eps):
+def _mlp_block_kernel(*refs, has_gate, prenorm, eps):
     """One (rows, D) block: LN/fc1/act/fc2/residual(/LN); the (rows, F)
-    (or (rows, 2F) SwiGLU [up|gate]) hidden exists only in VMEM."""
+    hidden exists only in VMEM.  With ``has_gate`` (SwiGLU) the gate is
+    a SEPARATE matmul operand — NOT packed into fc1 — mirroring the
+    model's split-projection design so tensor-parallel sharding of the
+    'mlp' axis keeps silu(gate)*up local per shard (models/gpt.py
+    GPTBlock comment).
+
+    refs: x (bn,D), w1 (D,F), b1 (8,F) [, wg (D,F), bg (8,F)],
+    w2 (F,D), b2 (8,D), lns (8,D), lnb (8,D), y (bn,D)
+    """
+    if has_gate:
+        (x_ref, w1_ref, b1_ref, wg_ref, bg_ref, w2_ref, b2_ref, lns_ref,
+         lnb_ref, y_ref) = refs
+    else:
+        (x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref, lnb_ref,
+         y_ref) = refs
+        wg_ref = bg_ref = None
     cdt = x_ref.dtype
     x32 = x_ref[:].astype(jnp.float32)
     lns = lns_ref[:1, :].astype(jnp.float32)
@@ -505,7 +519,13 @@ def _mlp_block_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref,
     h1 = jax.lax.dot(h.astype(cdt), w1_ref[:],
                      preferred_element_type=jnp.float32) + b1_ref[
                          :1, :].astype(jnp.float32)
-    g = _mlp_act(h1, act)
+    if has_gate:
+        hg = jax.lax.dot(h.astype(cdt), wg_ref[:],
+                         preferred_element_type=jnp.float32) + bg_ref[
+                             :1, :].astype(jnp.float32)
+        g = jax.nn.silu(hg) * h1
+    else:
+        g = jax.nn.gelu(h1)
     h2 = jax.lax.dot(g.astype(cdt), w2_ref[:],
                      preferred_element_type=jnp.float32) + b2_ref[
                          :1, :].astype(jnp.float32)
@@ -521,34 +541,43 @@ def _mlp_rows(n):
     raise ValueError(f"B*T = {n} has no 8-aligned row block; pad the batch")
 
 
-def _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
              interpret):
     n, d = x2.shape
-    f = w1.shape[1]                   # F, or 2F for the SwiGLU pack
-    f2 = w2.shape[0]                  # always F
+    f = w1.shape[1]
+    has_gate = wg is not None
     bn = _mlp_rows(n)
+    in_specs = [
+        pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        pl.BlockSpec((d, f), lambda i: (0, 0)),
+        pl.BlockSpec((8, f), lambda i: (0, 0)),
+    ]
+    args = [x2, w1, b18]
+    if has_gate:
+        in_specs += [pl.BlockSpec((d, f), lambda i: (0, 0)),
+                     pl.BlockSpec((8, f), lambda i: (0, 0))]
+        args += [wg, bg8]
+    in_specs += [
+        pl.BlockSpec((f, d), lambda i: (0, 0)),
+        pl.BlockSpec((8, d), lambda i: (0, 0)),
+        pl.BlockSpec((8, d), lambda i: (0, 0)),
+        pl.BlockSpec((8, d), lambda i: (0, 0)),
+    ]
+    args += [w2, b28, lns8, lnb8]
     return pl.pallas_call(
-        functools.partial(_mlp_block_kernel, act=act, prenorm=prenorm,
-                          eps=eps),
+        functools.partial(_mlp_block_kernel, has_gate=has_gate,
+                          prenorm=prenorm, eps=eps),
         grid=(n // bn,),
-        in_specs=[
-            pl.BlockSpec((bn, d), lambda i: (i, 0)),
-            pl.BlockSpec((d, f), lambda i: (0, 0)),
-            pl.BlockSpec((8, f), lambda i: (0, 0)),
-            pl.BlockSpec((f2, d), lambda i: (0, 0)),
-            pl.BlockSpec((8, d), lambda i: (0, 0)),
-            pl.BlockSpec((8, d), lambda i: (0, 0)),
-            pl.BlockSpec((8, d), lambda i: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(x2, w1, b18, w2, b28, lns8, lnb8)
+    )(*args)
 
 
-def _mlp_ref(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps):
+def _mlp_ref(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps):
     """XLA reference with the kernel's exact dtype discipline — the
     backward differentiates THIS, so grads match the fused forward."""
     cdt = x2.dtype
@@ -558,31 +587,38 @@ def _mlp_ref(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps):
     h = _ln(x32, lns, lnb, eps) if prenorm else x32
     h1 = jax.lax.dot(h.astype(cdt), w1,
                      preferred_element_type=f32) + b18[:1, :].astype(f32)
-    h2 = jax.lax.dot(_mlp_act(h1, act).astype(cdt), w2,
+    if wg is not None:
+        hg = jax.lax.dot(h.astype(cdt), wg,
+                         preferred_element_type=f32) + bg8[:1, :].astype(
+                             f32)
+        g = jax.nn.silu(hg) * h1
+    else:
+        g = jax.nn.gelu(h1)
+    h2 = jax.lax.dot(g.astype(cdt), w2,
                      preferred_element_type=f32) + b28[:1, :].astype(f32)
     u = x32 + h2
     return (u if prenorm else _ln(u, lns, lnb, eps)).astype(x2.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
-def _fused_mlp(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _fused_mlp(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
                interpret):
-    return _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
-                    interpret)
+    return _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm,
+                    eps, interpret)
 
 
-def _fused_mlp_fwd_rule(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm,
-                        eps, interpret):
-    y = _mlp_fwd(x2, w1, b18, w2, b28, lns8, lnb8, act, prenorm, eps,
+def _fused_mlp_fwd_rule(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8,
+                        prenorm, eps, interpret):
+    y = _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
                  interpret)
-    return y, (x2, w1, b18, w2, b28, lns8, lnb8)
+    return y, (x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8)
 
 
-def _fused_mlp_bwd_rule(act, prenorm, eps, interpret, res, dy):
+def _fused_mlp_bwd_rule(prenorm, eps, interpret, res, dy):
     # Rebuilding the (rows, F) hidden costs two matmuls XLA runs near
     # roofline — cheaper than saving ~190 MB/layer of it to HBM.
     _, vjp = jax.vjp(
-        lambda *a: _mlp_ref(*a, act=act, prenorm=prenorm, eps=eps), *res)
+        lambda *a: _mlp_ref(*a, prenorm=prenorm, eps=eps), *res)
     return vjp(dy)
 
 
@@ -598,20 +634,20 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
     pre-LN (GPT):   ``x + fc2(act(fc1(LN(x))))``
 
     ``fc_gate_params`` switches the activation to SwiGLU
-    (``silu(gate(h)) * fc1(h)``, models/gpt.py GPTBlock) — the gate and
-    up projections pack into ONE (D, 2F) matmul, split in-kernel.
-    Operates on flattened (B·T, D) rows — no cross-row coupling."""
+    (``silu(gate(h)) * fc1(h)``, models/gpt.py GPTBlock); the gate stays
+    a SEPARATE matmul operand so tensor-parallel sharding of the 'mlp'
+    axis keeps the elementwise product local per shard (the model's
+    split-projection rationale).  Operates on flattened (B·T, D) rows —
+    no cross-row coupling."""
     b, t, d = x.shape
     if interpret is None:
         interpret = _interpret_default()
     rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))
-    w1, b1 = fc1_params["w"], fc1_params["b"]
-    act = "gelu"
+    wg = bg8 = None
     if fc_gate_params is not None:
-        act = "swiglu"
-        w1 = jnp.concatenate([w1, fc_gate_params["w"]], axis=1)
-        b1 = jnp.concatenate([b1, fc_gate_params["b"]])
-    y = _fused_mlp(x.reshape(b * t, d), w1, rep8(b1), fc2_params["w"],
+        wg, bg8 = fc_gate_params["w"], rep8(fc_gate_params["b"])
+    y = _fused_mlp(x.reshape(b * t, d), fc1_params["w"],
+                   rep8(fc1_params["b"]), wg, bg8, fc2_params["w"],
                    rep8(fc2_params["b"]), rep8(ln_params["scale"]),
-                   rep8(ln_params["bias"]), act, prenorm, eps, interpret)
+                   rep8(ln_params["bias"]), prenorm, eps, interpret)
     return y.reshape(b, t, d)
