@@ -190,12 +190,20 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     rows.append(Row("block fwd+bwd (x-grad only)", s,
                     flops=2.0 * blk_fwd_flops))
 
+    def _fold_w_grads(gp, gx):
+        """Mix every weight-grad leaf into the timed output: a discarded
+        gp is dead code and XLA deletes the dW matmuls the row exists to
+        measure (verified in HLO: 3 dots -> 2 when gp is dropped)."""
+        acc = sum(jnp.sum(l.astype(jnp.float32))
+                  for l in jax.tree_util.tree_leaves(gp))
+        return (gx + acc * 1e-20).astype(jnp.bfloat16)
+
     def blk_grad_w(x):
         gp, gx = jax.grad(
             lambda pp, y: jnp.sum(block.apply(pp, y)
                                   .astype(jnp.float32)) * 1e-6,
             argnums=(0, 1))(bp, x)
-        return gx.astype(jnp.bfloat16)
+        return _fold_w_grads(gp, gx)
     s = _time(blk_grad_w, mk(10, (b, t, d)))
     rows.append(Row("block fwd+bwd (x+w grads)", s,
                     flops=3.0 * blk_fwd_flops))
@@ -208,6 +216,27 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     s = _time(blk_grad_remat, mk(11, (b, t, d)))
     # x-grad only (see above) + one full recompute: ~3x fwd executed.
     rows.append(Row("block fwd+bwd x-grad, full remat", s,
+                    flops=3.0 * blk_fwd_flops))
+
+    # --- the same block through the fused megakernels ----------------
+    # (ops/block_kernel.py; same params tree, apply() routes to the
+    # kernels) — the isolated fused-vs-unfused comparison the round-5
+    # MFU push rests on, free of workload noise.
+    cfg_f = GPTConfig(dim=d, num_heads=h, mlp_dim=f, max_len=t,
+                      dtype=jnp.bfloat16, vocab_size=1024,
+                      fused_block=True)
+    block_f = GPTBlock(cfg_f)
+    s = _time(lambda x: block_f.apply(bp, x), mk(8, (b, t, d)))
+    rows.append(Row("block fwd (fused kernels)", s, flops=blk_fwd_flops))
+
+    def blk_f_grad_w(x):
+        gp, gx = jax.grad(
+            lambda pp, y: jnp.sum(block_f.apply(pp, y)
+                                  .astype(jnp.float32)) * 1e-6,
+            argnums=(0, 1))(bp, x)
+        return _fold_w_grads(gp, gx)
+    s = _time(blk_f_grad_w, mk(10, (b, t, d)))
+    rows.append(Row("block fwd+bwd x+w grads (fused kernels)", s,
                     flops=3.0 * blk_fwd_flops))
 
     return rows

@@ -35,8 +35,9 @@ Two kernels per block (attention megakernel + MLP megakernel), each a
 Both variants cover post-LN (BERT: ``LN(x + f(x))``) and pre-LN (GPT:
 ``x + f(LN(x))``) blocks, and the LLaMA family options: RoPE rotated
 in-kernel from fp32 angle tables, GQA via a packed (D, D+2·KVH·hd) qkv
-matmul with k/v strips shared per head group, SwiGLU via a packed
-(D, 2F) up|gate matmul split in-kernel.  Scope guards (clear errors, not
+matmul with k/v strips shared per head group, SwiGLU with the gate as a
+SEPARATE matmul operand (a (D, 2F) pack would break tensor-parallel
+'mlp'-axis sharding — models/gpt.py GPTBlock).  Scope guards (clear errors, not
 silent fallbacks): T % 8 == 0, T <= MAX_FUSED_T, KVH | H, even head dim
 under RoPE.  On CPU the kernels run in interpreter mode automatically
 (tests, the 8-device simulated mesh).

@@ -40,7 +40,12 @@ run gpt_fused_block 3600 python -m dtf_tpu.workloads.lm \
   --preset gpt2_small --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \
   --fused_block
-# llama wiring (RoPE in-kernel + GQA packed k/v + SwiGLU up|gate pack)
+# component-level isolation: the layer breakdown now ends with fused-
+# vs-unfused block rows (bench/breakdown.py) — the kernel win free of
+# workload noise.
+run breakdown_fused_bert 3600 python -m dtf_tpu.bench.breakdown --family bert
+run breakdown_fused_gpt 3600 python -m dtf_tpu.bench.breakdown --family gpt
+# llama wiring (RoPE in-kernel + GQA separate-gate SwiGLU)
 run llama_fused_block 3600 python -m dtf_tpu.workloads.lm \
   --preset llama --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \

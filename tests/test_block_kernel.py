@@ -203,6 +203,38 @@ class TestAttnBlockParity:
                                    np.asarray(ref, np.float32),
                                    atol=5e-2, rtol=5e-2)
 
+    @pytest.mark.slow
+    def test_bf16_grads_track_xla(self):
+        """bf16 grads: fused vs XLA block, relative L2 per leaf < 5%
+        (bf16 rounding differs op-by-op; directional agreement is the
+        contract)."""
+        layer, params = self._bert_layer(dtype=jnp.bfloat16)
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, params)
+        x = jax.random.normal(jax.random.key(5), (2, 16, 32), jnp.bfloat16)
+
+        def fused(p):
+            x1 = fused_attn_block(x, p["attn"], p["ln1"], num_heads=4)
+            return jnp.sum(jnp.sin(fused_mlp_block(
+                x1, p["fc1"], p["fc2"], p["ln2"]).astype(jnp.float32)))
+
+        g_ref = jax.grad(lambda p: jnp.sum(jnp.sin(
+            layer.apply(p, x)[0].astype(jnp.float32))))(params)
+        g_fused = jax.grad(fused)(params)
+        ref_leaves = [np.asarray(a, np.float32).ravel()
+                      for a in jax.tree.leaves(g_ref)]
+        gmax = max(np.linalg.norm(a) for a in ref_leaves)
+        for a, b in zip(ref_leaves, jax.tree.leaves(g_fused),
+                        strict=True):
+            b = np.asarray(b, np.float32).ravel()
+            # scale-aware: leaves whose gradient is tiny relative to the
+            # block's largest leaf are bf16-noise-dominated by both
+            # paths; hold them to the global scale instead.
+            denom = max(np.linalg.norm(a), 0.05 * gmax)
+            assert np.linalg.norm(a - b) / denom < 0.05, (
+                np.linalg.norm(a - b), denom, gmax)
+
 
 class TestGuards:
     def test_bad_kv_heads_rejected(self):
