@@ -72,6 +72,12 @@ class T5Config:
     # differentiable ctx) while the encoder keeps GPipe-by-AD — see
     # T5.pipeline_loss_and_grads.
     pipeline_schedule: str = "gpipe"
+    # Fused TRAIN-step block kernels (ops/block_kernel.py): encoder
+    # self-attn + FFN and decoder self-attn + FFN half-blocks each run
+    # as one Pallas kernel (RMSNorm and the learned relpos bias
+    # in-kernel; the bias switches the backward to the XLA-reference
+    # vjp).  Cross-attention keeps the XLA path (distinct K/V source).
+    fused_block: bool = False
 
     @classmethod
     def small(cls, **kw):
@@ -95,6 +101,7 @@ class T5Config:
 
 class _FFN(Module):
     def __init__(self, cfg: T5Config):
+        self.cfg = cfg
         self.ln = cfg.make_norm()
         self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
                          axes_in="embed", axes_out="mlp")
@@ -107,6 +114,11 @@ class _FFN(Module):
                 "fc2": self.fc2.init(k3)}
 
     def apply(self, params, x, *, train=False, rng=None):
+        if self.cfg.fused_block:
+            from dtf_tpu.ops.block_kernel import fused_mlp_block
+            return fused_mlp_block(x, params["fc1"], params["fc2"],
+                                   params["ln"], prenorm=True,
+                                   norm=self.cfg.norm)
         h = self.ln.apply(params["ln"], x)
         return x + self.fc2.apply(params["fc2"],
                                   jax.nn.gelu(self.fc1.apply(params["fc1"],
@@ -136,6 +148,16 @@ class T5EncoderLayer(Module):
 
     def apply(self, params, x, *, pad_mask=None, bias=None, train=False,
               rng=None):
+        if self.cfg.fused_block:
+            from dtf_tpu.ops.block_kernel import fused_attn_block
+            from dtf_tpu.ops.flash_attention import require_kv_mask
+            kv_mask = (None if pad_mask is None else
+                       require_kv_mask(pad_mask, x, x, "fused_block"))
+            x = fused_attn_block(x, params["attn"], params["ln"],
+                                 num_heads=self.cfg.num_heads,
+                                 prenorm=True, norm=self.cfg.norm,
+                                 kv_mask=kv_mask, rel_bias=bias)
+            return self.ffn.apply(params["ffn"], x)
         h = self.ln.apply(params["ln"], x)
         p = params["attn"]
         q, k, v = self.attn.qkv(p, h)
@@ -174,12 +196,20 @@ class T5DecoderLayer(Module):
     def apply(self, params, x, ctx, *, ctx_mask=None, self_bias=None,
               train=False, rng=None):
         t = x.shape[1]
-        h = self.ln_self.apply(params["ln_self"], x)
-        p = params["self_attn"]
-        q, k, v = self.self_attn.qkv(p, h)
-        o = dot_product_attention(q, k, v, mask=causal_mask(t),
-                                  bias=self_bias)
-        x = x + self.self_attn.out_proj(p, o)
+        if self.cfg.fused_block:
+            from dtf_tpu.ops.block_kernel import fused_attn_block
+            x = fused_attn_block(x, params["self_attn"],
+                                 params["ln_self"],
+                                 num_heads=self.cfg.num_heads,
+                                 causal=True, prenorm=True,
+                                 norm=self.cfg.norm, rel_bias=self_bias)
+        else:
+            h = self.ln_self.apply(params["ln_self"], x)
+            p = params["self_attn"]
+            q, k, v = self.self_attn.qkv(p, h)
+            o = dot_product_attention(q, k, v, mask=causal_mask(t),
+                                      bias=self_bias)
+            x = x + self.self_attn.out_proj(p, o)
         h = self.ln_cross.apply(params["ln_cross"], x)
         x = x + self.cross_attn.apply(params["cross_attn"], h, kv_input=ctx,
                                       mask=ctx_mask)

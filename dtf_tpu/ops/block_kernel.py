@@ -62,13 +62,24 @@ from dtf_tpu.ops.flash_attention import (MASK_VALUE, _bwd as _flash_bwd_call,
 MAX_FUSED_T = 1024
 
 
-def _ln(x32, scale_row, bias_row, eps):
-    """LayerNorm on fp32 (rows, D) with (1, D) scale/bias — the SAME
-    expression the backward's XLA recompute differentiates, and the same
-    fp32-statistics semantics as nn.layers.LayerNorm."""
+def _ln(x32, scale_row, bias_row, eps, kind="layernorm"):
+    """LayerNorm or RMSNorm on fp32 (rows, D) with (1, D) scale/bias —
+    the SAME expression the backward's XLA recompute differentiates, and
+    the same fp32-statistics semantics as nn.layers.LayerNorm/RMSNorm
+    (``bias_row`` is ignored under rmsnorm, which has no bias)."""
+    if kind == "rmsnorm":
+        return x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps) * scale_row
     mean = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.var(x32, axis=-1, keepdims=True)
     return (x32 - mean) * jax.lax.rsqrt(var + eps) * scale_row + bias_row
+
+
+def _ln_bias(ln_params):
+    """The norm tree's bias, or a zeros placeholder when the norm has
+    none (rmsnorm) — ONE definition for both public entry points."""
+    lnb = ln_params.get("bias")
+    return jnp.zeros_like(ln_params["scale"]) if lnb is None else lnb
 
 
 def _q_block(t):
@@ -115,16 +126,17 @@ def _rope_rotate(x32, cos, sin):
 
 
 def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
-                       eps, has_mask, has_rope, emit_aux):
+                       norm, eps, has_mask, has_rope, has_rel, emit_aux):
     """One batch row: LN/qkv/attention/out-proj/residual(/LN) in VMEM.
 
-    refs (has_rope adds cos/sin tables, has_mask adds bias_ref, both
-    before the outputs; without ``emit_aux`` — the inference/eval primal
-    — the raw/lse outputs are absent, so a no-grad forward never writes
-    them to HBM).  W = D + 2·KVH·hd (GQA packs KVH k/v heads):
+    refs (has_rope adds cos/sin tables, has_rel the T5-style (H,T,T)
+    logit bias, has_mask adds bias_ref, all before the outputs; without
+    ``emit_aux`` — the inference/eval primal — the raw/lse outputs are
+    absent, so a no-grad forward never writes them to HBM).
+    W = D + 2·KVH·hd (GQA packs KVH k/v heads):
       x_ref (1,T,D), wqkv_ref (D,W), bqkv_ref (8,W), wo_ref (D,D),
       bo_ref (8,D), lns_ref (8,D), lnb_ref (8,D) [, cos_ref (T,hd/2),
-      sin_ref (T,hd/2)] [, bias_ref (1,8,T)],
+      sin_ref (T,hd/2)] [, rel_ref (H,T,T)] [, bias_ref (1,8,T)],
       y_ref (1,T,D) [, raw_ref (1,T,D), lse_ref (1,H,T,8)],
       qkv_scr (T,W) f32, acc_scr (T,D) f32
     """
@@ -134,6 +146,7 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
     cos_ref = sin_ref = None
     if has_rope:
         cos_ref, sin_ref = rest.pop(0), rest.pop(0)
+    rel_ref = rest.pop(0) if has_rel else None
     bias_ref = rest.pop(0) if has_mask else None
     if emit_aux:
         y_ref, raw_ref, lse_ref, qkv_scr, acc_scr = rest
@@ -151,7 +164,7 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
 
     x32 = x_ref[0].astype(jnp.float32)                        # (T, D)
     h = (_ln(x32, lns_ref[:1, :].astype(jnp.float32),
-             lnb_ref[:1, :].astype(jnp.float32), eps)
+             lnb_ref[:1, :].astype(jnp.float32), eps, norm)
          if prenorm else x32)
     qkv_scr[:] = jax.lax.dot(
         h.astype(cdt), wqkv_ref[:],
@@ -192,6 +205,8 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
                         jnp.int32, s.shape, 0)
                     col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
                     s = jnp.where(row >= col, s, MASK_VALUE)
+                if rel_ref is not None:                    # (bq, k_end)
+                    s = s + rel_ref[hi, q0:q0 + bq, :k_end]
                 if bias_ref is not None:
                     s = s + bias_ref[0][:1, :k_end]        # (1, k_end)
                 m = jnp.max(s, axis=-1, keepdims=True)     # (bq, 1)
@@ -212,18 +227,20 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
             jnp.float32)
     u = x32 + a
     y = u if prenorm else _ln(u, lns_ref[:1, :].astype(jnp.float32),
-                              lnb_ref[:1, :].astype(jnp.float32), eps)
+                              lnb_ref[:1, :].astype(jnp.float32), eps,
+                              norm)
     y_ref[0] = y.astype(y_ref.dtype)
 
 
-def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
-              num_heads, num_kv_heads, causal, prenorm, eps, interpret,
-              emit_aux=True):
+def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
+              num_heads, num_kv_heads, causal, prenorm, norm, eps,
+              interpret, emit_aux=True):
     b, t, d = x.shape
     w = wqkv.shape[1]                 # D + 2·KVH·hd
     hh = d // num_heads // 2
     has_mask = bias is not None
     has_rope = cos is not None
+    has_rel = rel is not None
     in_specs = [
         pl.BlockSpec((1, t, d), lambda bi: (bi, 0, 0)),
         pl.BlockSpec((d, w), lambda bi: (0, 0)),
@@ -238,6 +255,10 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
         in_specs += [pl.BlockSpec((t, hh), lambda bi: (0, 0)),
                      pl.BlockSpec((t, hh), lambda bi: (0, 0))]
         args += [cos, sin]
+    if has_rel:
+        in_specs.append(
+            pl.BlockSpec((num_heads, t, t), lambda bi: (0, 0, 0)))
+        args.append(rel)
     if has_mask:
         in_specs.append(pl.BlockSpec((1, 8, t), lambda bi: (bi, 0, 0)))
         args.append(bias)
@@ -255,8 +276,9 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
     outs = pl.pallas_call(
         functools.partial(_attn_block_kernel, num_heads=num_heads,
                           num_kv_heads=num_kv_heads, causal=causal,
-                          prenorm=prenorm, eps=eps, has_mask=has_mask,
-                          has_rope=has_rope, emit_aux=emit_aux),
+                          prenorm=prenorm, norm=norm, eps=eps,
+                          has_mask=has_mask, has_rope=has_rope,
+                          has_rel=has_rel, emit_aux=emit_aux),
         grid=(b,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -319,41 +341,98 @@ def _prepare_qkv(h32, wqkv, bqkv_row, cos, sin, num_heads, num_kv_heads,
     return to_ph(q), to_ph(k), to_ph(v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14,
-                                                    15))
-def _fused_attn(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias,
-                num_heads, num_kv_heads, causal, prenorm, eps, interpret):
+def _attn_ref(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, rel, cos, sin, bias,
+              num_heads, num_kv_heads, causal, prenorm, norm, eps):
+    """XLA reference of the whole attention half-block with the kernel's
+    dtype discipline — the rel-bias backward differentiates THIS (the
+    flash dq/dk/dv kernel has no per-head/per-query bias input, and the
+    learned relpos table needs a real cotangent)."""
+    b, t, d = x.shape
+    cdt = x.dtype
+    f32 = jnp.float32
+    hd = d // num_heads
+    x32 = x.astype(f32)
+    lns, lnb = lns8[:1, :].astype(f32), lnb8[:1, :].astype(f32)
+    h = _ln(x32, lns, lnb, eps, norm) if prenorm else x32
+    q, k, v = _prepare_qkv(h, wqkv, bqkv8[:1, :], cos, sin, num_heads,
+                           num_kv_heads, cdt)           # (B,H,T,hd) cdt
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=f32) * (hd ** -0.5)
+    if causal:
+        tri = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(tri[None, None], s, MASK_VALUE)
+    if rel is not None:
+        s = s + rel.astype(f32)[None]                   # (1,H,T,T)
+    if bias is not None:
+        s = s + bias[:, :1, :][:, None, :, :]           # (B,1,1,T)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(cdt), v,
+                     preferred_element_type=f32)
+    raw = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    a = jax.lax.dot(raw.astype(cdt).reshape(b * t, d), wo,
+                    preferred_element_type=f32).reshape(b, t, d)
+    u = x32 + a + bo8[:1, :].astype(f32)
+    y = u if prenorm else _ln(u, lns, lnb, eps, norm)
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13, 14, 15,
+                                                    16, 17))
+def _fused_attn(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
+                num_heads, num_kv_heads, causal, prenorm, norm, eps,
+                interpret):
     # No-grad forward (eval/inference): the y-only kernel variant — the
     # raw/lse residuals are never written to HBM.
     y, _, _ = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
-                        bias, num_heads, num_kv_heads, causal, prenorm,
-                        eps, interpret, emit_aux=False)
+                        rel, bias, num_heads, num_kv_heads, causal,
+                        prenorm, norm, eps, interpret, emit_aux=False)
     return y
 
 
 def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
-                         bias, num_heads, num_kv_heads, causal, prenorm,
-                         eps, interpret):
+                         rel, bias, num_heads, num_kv_heads, causal,
+                         prenorm, norm, eps, interpret):
+    # With a rel bias the backward is the XLA-reference vjp (see
+    # _fused_attn_bwd_rule), which rebuilds everything from the inputs —
+    # skip emitting (and saving) raw/lse entirely.
+    emit_aux = rel is None
     y, raw, lse = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos,
-                            sin, bias, num_heads, num_kv_heads, causal,
-                            prenorm, eps, interpret)
-    from jax.ad_checkpoint import checkpoint_name
-    # Same names as ops.flash_attention: the "attn" remat policy saves
-    # exactly these, so the backward never re-runs the forward kernel.
-    raw = checkpoint_name(raw, "flash_out")
-    lse = checkpoint_name(lse, "flash_lse")
-    return y, (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias, raw,
-               lse)
+                            sin, rel, bias, num_heads, num_kv_heads,
+                            causal, prenorm, norm, eps, interpret,
+                            emit_aux=emit_aux)
+    if emit_aux:
+        from jax.ad_checkpoint import checkpoint_name
+        # Same names as ops.flash_attention: the "attn" remat policy
+        # saves exactly these, so the backward never re-runs the
+        # forward kernel.
+        raw = checkpoint_name(raw, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
+    return y, (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
+               raw, lse)
 
 
-def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
-                         interpret, res, dy):
+def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, norm,
+                         eps, interpret, res, dy):
     """XLA recompute (qkv projection, RoPE, LN statistics) + the fused
     flash dq/dk/dv kernel.  Matmul grads are plain XLA dots — the r3
     breakdown measured those at ~84% of roofline, so only attention's
-    O(T^2) work runs in Pallas here."""
-    (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, bias, raw,
+    O(T^2) work runs in Pallas here.  With a T5-style rel bias the whole
+    backward is instead the vjp of the XLA reference (the flash backward
+    has no per-head bias input, and the learned relpos table needs its
+    cotangent)."""
+    (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias, raw,
      lse) = res
+    if rel is not None:
+        diff = (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, rel)
+        _, vjp = jax.vjp(
+            lambda x_, wq_, bq_, wo_, bo_, ls_, lb_, rel_: _attn_ref(
+                x_, wq_, bq_, wo_, bo_, ls_, lb_, rel_, cos, sin, bias,
+                num_heads, num_kv_heads, causal, prenorm, norm, eps),
+            *diff)
+        dx, d_wqkv, d_bqkv8, d_wo, d_bo8, d_lns8, d_lnb8, d_rel = vjp(dy)
+        zlike = lambda a: None if a is None else jnp.zeros_like(a)
+        return (dx, d_wqkv, d_bqkv8, d_wo, d_bo8, d_lns8, d_lnb8,
+                zlike(cos), zlike(sin), d_rel, zlike(bias))
     b, t, d = x.shape
     hd = d // num_heads
     scale = hd ** -0.5
@@ -367,7 +446,8 @@ def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
 
     # --- recompute the projection input h (and its LN vjp for pre-LN) ---
     if prenorm:
-        h, ln1_vjp = jax.vjp(lambda v_: _ln(v_, lns, lnb, eps), x32)
+        h, ln1_vjp = jax.vjp(
+            lambda v_, s_, b_: _ln(v_, s_, b_, eps, norm), x32, lns, lnb)
     else:
         h, ln1_vjp = x32, None
 
@@ -382,21 +462,18 @@ def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
     if prenorm:
         # y = x + raw @ wo + bo
         du = dy32
-        d_lns_tail = jnp.zeros((), f32)     # pre-LN: ln grads come from ln1
+        d_lns_tail = d_lnb_tail = None  # pre-LN: ln grads come from ln1
     else:
         # y = LN(u), u = x + raw @ wo + bo: redo the (cheap) out
-        # projection to rebuild u for the LN statistics.
+        # projection to rebuild u for the LN statistics; all LN grads
+        # via vjp of _ln (covers both norm kinds).
         a = jax.lax.dot(raw.astype(cdt).reshape(b * t, d), wo,
                         preferred_element_type=f32).reshape(b, t, d)
         u = x32 + a + bo8[:1, :].astype(f32)
-        _, ln2_vjp = jax.vjp(lambda v_: _ln(v_, lns, lnb, eps), u)
-        (du,) = ln2_vjp(dy32)
-        # scale/bias grads of the tail LN
-        mean = jnp.mean(u, axis=-1, keepdims=True)
-        var = jnp.var(u, axis=-1, keepdims=True)
-        xhat = (u - mean) * jax.lax.rsqrt(var + eps)
-        d_lns_tail = jnp.sum(xhat * dy32, axis=(0, 1))
-        d_lnb_tail = jnp.sum(dy32, axis=(0, 1))
+        _, ln2_vjp = jax.vjp(
+            lambda u_, s_, b_: _ln(u_, s_, b_, eps, norm), u, lns, lnb)
+        du, d_lns_row, d_lnb_row = ln2_vjp(dy32)
+        d_lns_tail, d_lnb_tail = d_lns_row[0], d_lnb_row[0]
 
     # --- output projection grads ---
     d_wo = jax.lax.dot_general(
@@ -419,13 +496,9 @@ def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
     d_bqkv = d_bqkv_row[0]
 
     if prenorm:
-        (dx_ln,) = ln1_vjp(dh)
+        dx_ln, d_lns_row, d_lnb_row = ln1_vjp(dh)
         dx = dy32 + dx_ln
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.var(x32, axis=-1, keepdims=True)
-        xhat = (x32 - mean) * jax.lax.rsqrt(var + eps)
-        d_lns = jnp.sum(xhat * dh, axis=(0, 1))
-        d_lnb = jnp.sum(dh, axis=(0, 1))
+        d_lns, d_lnb = d_lns_row[0], d_lnb_row[0]
     else:
         dx = du + dh
         d_lns, d_lnb = d_lns_tail, d_lnb_tail
@@ -442,7 +515,7 @@ def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, eps,
     return (dx.astype(x.dtype), d_wqkv.astype(wqkv.dtype),
             rep8(d_bqkv, bqkv8), d_wo.astype(wo.dtype), rep8(d_bo, bo8),
             rep8(d_lns, lns8), rep8(d_lnb, lnb8), zlike(cos), zlike(sin),
-            zlike(bias))
+            None, zlike(bias))
 
 
 _fused_attn.defvjp(_fused_attn_fwd_rule, _fused_attn_bwd_rule)
@@ -450,21 +523,26 @@ _fused_attn.defvjp(_fused_attn_fwd_rule, _fused_attn_bwd_rule)
 
 def fused_attn_block(x, attn_params, ln_params, *, num_heads,
                      num_kv_heads=None, causal=False, prenorm=False,
-                     rope=False, kv_mask=None, eps=1e-6, interpret=None):
+                     rope=False, kv_mask=None, rel_bias=None,
+                     norm="layernorm", eps=1e-6, interpret=None):
     """Fused attention half-block.
 
     post-LN (BERT, ``prenorm=False``): ``LN(x + Attn(x))``
-    pre-LN (GPT, ``prenorm=True``):    ``x + Attn(LN(x))``
+    pre-LN (GPT/T5, ``prenorm=True``): ``x + Attn(LN(x))``
 
     ``attn_params`` is the MultiHeadAttention param tree (q/k/v/o with
     (D, H|KVH, hd) weights — GQA packs the smaller k/v projections);
-    ``ln_params`` the LayerNorm tree.  ``rope`` rotates q/k in-kernel
-    with train-step positions arange(T) (split-half convention,
-    nn.rope).  ``kv_mask`` (B, T) bool marks visible keys (BERT
-    padding); composable with ``causal``.  Packing to the kernel layout
-    (one (D, D+2·KVH·hd) qkv matmul, sublane-replicated vectors) happens
-    here in plain jnp, so parameter gradients flow through the packing
-    automatically.
+    ``ln_params`` the LayerNorm/RMSNorm tree (``norm`` selects; rmsnorm
+    has no bias).  ``rope`` rotates q/k in-kernel with train-step
+    positions arange(T) (split-half convention, nn.rope).  ``kv_mask``
+    (B, T) bool marks visible keys (BERT padding); composable with
+    ``causal``.  ``rel_bias`` is a T5-style (1|·, H, T, T) additive
+    logit bias (LEARNED — its cotangent flows back to the relpos
+    table); it switches the backward to the XLA-reference vjp since the
+    flash dq/dk/dv kernel has no per-head bias input.  Packing to the
+    kernel layout (one (D, D+2·KVH·hd) qkv matmul, sublane-replicated
+    vectors) happens here in plain jnp, so parameter gradients flow
+    through the packing automatically.
     """
     b, t, d = x.shape
     _check_block_args(t, d, num_heads, num_kv_heads, rope=rope)
@@ -483,18 +561,22 @@ def fused_attn_block(x, attn_params, ln_params, *, num_heads,
     if rope:
         from dtf_tpu.nn.rope import rope_angles
         cos, sin = rope_angles(jnp.arange(t), d // num_heads)  # (T, hd/2)
+    rel = None
+    if rel_bias is not None:
+        rel = rel_bias.reshape(num_heads, t, t).astype(jnp.float32)
+    lnb = _ln_bias(ln_params)
     return _fused_attn(x, wqkv, rep8(bqkv), wo,
                        rep8(attn_params["o"]["b"]),
-                       rep8(ln_params["scale"]), rep8(ln_params["bias"]),
-                       cos, sin, bias, num_heads, num_kv_heads, causal,
-                       prenorm, eps, interpret)
+                       rep8(ln_params["scale"]), rep8(lnb),
+                       cos, sin, rel, bias, num_heads, num_kv_heads,
+                       causal, prenorm, norm, eps, interpret)
 
 
 # --------------------------------------------------------------------------
 # MLP megakernel
 # --------------------------------------------------------------------------
 
-def _mlp_block_kernel(*refs, has_gate, prenorm, eps):
+def _mlp_block_kernel(*refs, has_gate, prenorm, norm, eps):
     """One (rows, D) block: LN/fc1/act/fc2/residual(/LN); the (rows, F)
     hidden exists only in VMEM.  With ``has_gate`` (SwiGLU) the gate is
     a SEPARATE matmul operand — NOT packed into fc1 — mirroring the
@@ -516,7 +598,7 @@ def _mlp_block_kernel(*refs, has_gate, prenorm, eps):
     x32 = x_ref[:].astype(jnp.float32)
     lns = lns_ref[:1, :].astype(jnp.float32)
     lnb = lnb_ref[:1, :].astype(jnp.float32)
-    h = _ln(x32, lns, lnb, eps) if prenorm else x32
+    h = _ln(x32, lns, lnb, eps, norm) if prenorm else x32
     h1 = jax.lax.dot(h.astype(cdt), w1_ref[:],
                      preferred_element_type=jnp.float32) + b1_ref[
                          :1, :].astype(jnp.float32)
@@ -531,7 +613,8 @@ def _mlp_block_kernel(*refs, has_gate, prenorm, eps):
                      preferred_element_type=jnp.float32) + b2_ref[
                          :1, :].astype(jnp.float32)
     u = x32 + h2
-    y_ref[:] = (u if prenorm else _ln(u, lns, lnb, eps)).astype(y_ref.dtype)
+    y_ref[:] = (u if prenorm else _ln(u, lns, lnb, eps,
+                                     norm)).astype(y_ref.dtype)
 
 
 def _mlp_rows(n):
@@ -542,8 +625,8 @@ def _mlp_rows(n):
     raise ValueError(f"B*T = {n} has no 8-aligned row block; pad the batch")
 
 
-def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
-             interpret):
+def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
+             eps, interpret):
     n, d = x2.shape
     f = w1.shape[1]
     has_gate = wg is not None
@@ -567,7 +650,7 @@ def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
     args += [w2, b28, lns8, lnb8]
     return pl.pallas_call(
         functools.partial(_mlp_block_kernel, has_gate=has_gate,
-                          prenorm=prenorm, eps=eps),
+                          prenorm=prenorm, norm=norm, eps=eps),
         grid=(n // bn,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
@@ -578,14 +661,15 @@ def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
     )(*args)
 
 
-def _mlp_ref(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps):
+def _mlp_ref(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
+             eps):
     """XLA reference with the kernel's exact dtype discipline — the
     backward differentiates THIS, so grads match the fused forward."""
     cdt = x2.dtype
     f32 = jnp.float32
     x32 = x2.astype(f32)
     lns, lnb = lns8[:1, :].astype(f32), lnb8[:1, :].astype(f32)
-    h = _ln(x32, lns, lnb, eps) if prenorm else x32
+    h = _ln(x32, lns, lnb, eps, norm) if prenorm else x32
     h1 = jax.lax.dot(h.astype(cdt), w1,
                      preferred_element_type=f32) + b18[:1, :].astype(f32)
     if wg is not None:
@@ -598,28 +682,30 @@ def _mlp_ref(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps):
     h2 = jax.lax.dot(g.astype(cdt), w2,
                      preferred_element_type=f32) + b28[:1, :].astype(f32)
     u = x32 + h2
-    return (u if prenorm else _ln(u, lns, lnb, eps)).astype(x2.dtype)
+    return (u if prenorm else _ln(u, lns, lnb, eps,
+                                  norm)).astype(x2.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
-def _fused_mlp(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
-               interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+def _fused_mlp(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
+               eps, interpret):
     return _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm,
-                    eps, interpret)
+                    norm, eps, interpret)
 
 
 def _fused_mlp_fwd_rule(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8,
-                        prenorm, eps, interpret):
-    y = _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, eps,
-                 interpret)
+                        prenorm, norm, eps, interpret):
+    y = _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm,
+                 norm, eps, interpret)
     return y, (x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8)
 
 
-def _fused_mlp_bwd_rule(prenorm, eps, interpret, res, dy):
+def _fused_mlp_bwd_rule(prenorm, norm, eps, interpret, res, dy):
     # Rebuilding the (rows, F) hidden costs two matmuls XLA runs near
     # roofline — cheaper than saving ~190 MB/layer of it to HBM.
     _, vjp = jax.vjp(
-        lambda *a: _mlp_ref(*a, prenorm=prenorm, eps=eps), *res)
+        lambda *a: _mlp_ref(*a, prenorm=prenorm, norm=norm, eps=eps),
+        *res)
     return vjp(dy)
 
 
@@ -627,19 +713,20 @@ _fused_mlp.defvjp(_fused_mlp_fwd_rule, _fused_mlp_bwd_rule)
 
 
 def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
-                    fc_gate_params=None, prenorm=False, eps=1e-6,
-                    interpret=None):
+                    fc_gate_params=None, prenorm=False, norm="layernorm",
+                    eps=1e-6, interpret=None):
     """Fused MLP half-block.
 
-    post-LN (BERT): ``LN(x + fc2(act(fc1(x))))``
-    pre-LN (GPT):   ``x + fc2(act(fc1(LN(x))))``
+    post-LN (BERT):    ``LN(x + fc2(act(fc1(x))))``
+    pre-LN (GPT/T5):   ``x + fc2(act(fc1(LN(x))))``
 
     ``fc_gate_params`` switches the activation to SwiGLU
     (``silu(gate(h)) * fc1(h)``, models/gpt.py GPTBlock); the gate stays
     a SEPARATE matmul operand so tensor-parallel sharding of the 'mlp'
     axis keeps the elementwise product local per shard (the model's
-    split-projection rationale).  Operates on flattened (B·T, D) rows —
-    no cross-row coupling."""
+    split-projection rationale).  ``norm`` selects LayerNorm or RMSNorm
+    (T5; no bias).  Operates on flattened (B·T, D) rows — no cross-row
+    coupling."""
     b, t, d = x.shape
     if interpret is None:
         interpret = _interpret_default()
@@ -647,8 +734,9 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
     wg = bg8 = None
     if fc_gate_params is not None:
         wg, bg8 = fc_gate_params["w"], rep8(fc_gate_params["b"])
+    lnb = _ln_bias(ln_params)
     y = _fused_mlp(x.reshape(b * t, d), fc1_params["w"],
                    rep8(fc1_params["b"]), wg, bg8, fc2_params["w"],
                    rep8(fc2_params["b"]), rep8(ln_params["scale"]),
-                   rep8(ln_params["bias"]), prenorm, eps, interpret)
+                   rep8(lnb), prenorm, norm, eps, interpret)
     return y.reshape(b, t, d)

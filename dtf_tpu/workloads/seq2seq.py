@@ -44,6 +44,11 @@ def main(argv=None) -> int:
                         help="1f1b: decoder stack runs the interleaved "
                              "schedule (O(stages) activations), encoder "
                              "keeps GPipe-by-AD")
+    parser.add_argument("--fused_block", action="store_true",
+                        help="encoder/decoder self-attn + FFN half-"
+                             "blocks as fused Pallas megakernels "
+                             "(ops/block_kernel.py; RMSNorm + relpos "
+                             "bias in-kernel, cross-attention unfused)")
     parser.set_defaults(learning_rate=3e-3)   # task-suited default
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)
@@ -57,7 +62,8 @@ def main(argv=None) -> int:
     dtype = jnp.bfloat16 if ns.bf16 else jnp.float32
     kw = dict(dtype=dtype, max_src_len=max(ns.seq_len, 16),
               max_tgt_len=max(ns.seq_len, 16),
-              label_smoothing=ns.label_smoothing)
+              label_smoothing=ns.label_smoothing,
+              fused_block=ns.fused_block)
     if ns.pipeline_microbatches > 0:
         kw["pipeline_mesh"] = mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches

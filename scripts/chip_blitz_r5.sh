@@ -50,6 +50,10 @@ run llama_fused_block 3600 python -m dtf_tpu.workloads.lm \
   --preset llama --bf16 --remat --remat_policy attn \
   --layer_loop unroll --loss_chunk 128 --per_device_batch 8 --steps 30 \
   --fused_block
+# T5 wiring (RMSNorm + learned relpos bias in-kernel; XLA-vjp backward)
+run t5_fused_block 3600 python -m dtf_tpu.workloads.seq2seq \
+  --preset small --bf16 --seq_len 512 --per_device_batch 16 --steps 30 \
+  --fused_block
 
 echo "=== r5 blitz complete; logs in $OUT; r4 rc=$R4_RC, r5 failed steps: $FAILS ==="
 [ "$R4_RC" -eq 0 ] && [ "$FAILS" -eq 0 ]

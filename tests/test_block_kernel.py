@@ -306,6 +306,31 @@ class TestModelIntegration:
         assert abs(float(l0) - float(l1)) < 3e-5
         _tree_close(g0, g1, 1e-3, 1e-3)
 
+    @pytest.mark.parametrize("extra", [
+        {},                             # rmsnorm + relative positions
+        {"norm": "layernorm"},
+        {"positions": "absolute"},      # no relpos bias -> flash bwd path
+    ])
+    def test_t5_loss_and_grads(self, extra):
+        """T5 fused blocks (encoder self-attn+FFN, decoder self-attn+FFN;
+        cross-attention unfused): loss+grads match, INCLUDING the learned
+        relpos table's cotangent through the in-kernel bias."""
+        from dtf_tpu.models.t5 import T5, T5Config
+        m0 = T5(T5Config.tiny(**extra))
+        m1 = T5(T5Config.tiny(fused_block=True, **extra))
+        p = m0.init(jax.random.key(0))
+        r = np.random.default_rng(0)
+        src = np.asarray(r.integers(2, 64, (4, 16)), np.int32)
+        src[:, 12:] = 0                  # real padding -> pad_mask path
+        batch = {"src": jnp.asarray(src),
+                 "tgt": jnp.asarray(src[:, ::-1].copy())}
+        l0, g0 = jax.value_and_grad(lambda p: m0.loss(p, batch)[0])(p)
+        l1, g1 = jax.value_and_grad(lambda p: m1.loss(p, batch)[0])(p)
+        assert abs(float(l0) - float(l1)) < 3e-5
+        _tree_close(g0, g1, 1e-3, 1e-3)
+        if "relpos_enc" in g1:
+            assert float(jnp.abs(g1["relpos_enc"]["table"]).sum()) > 0
+
     def test_train_step_under_mesh(self, mesh_2d):
         """One full DP/TP-sharded train step with fused blocks: finite
         loss, same value as the unfused step (GSPMD handles layout)."""
