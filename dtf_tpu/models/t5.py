@@ -73,10 +73,10 @@ class T5Config:
     # T5.pipeline_loss_and_grads.
     pipeline_schedule: str = "gpipe"
     # Fused TRAIN-step block kernels (ops/block_kernel.py): encoder
-    # self-attn + FFN and decoder self-attn + FFN half-blocks each run
-    # as one Pallas kernel (RMSNorm and the learned relpos bias
-    # in-kernel; the bias switches the backward to the XLA-reference
-    # vjp).  Cross-attention keeps the XLA path (distinct K/V source).
+    # self-attn + FFN and decoder self-attn + cross-attn + FFN
+    # half-blocks each run as one Pallas kernel (RMSNorm and the learned
+    # relpos bias in-kernel; the rel bias and cross-attention use the
+    # XLA-reference-vjp backward).
     fused_block: bool = False
 
     @classmethod
@@ -197,12 +197,21 @@ class T5DecoderLayer(Module):
               train=False, rng=None):
         t = x.shape[1]
         if self.cfg.fused_block:
-            from dtf_tpu.ops.block_kernel import fused_attn_block
+            from dtf_tpu.ops.block_kernel import (fused_attn_block,
+                                                  fused_cross_attn_block)
+            from dtf_tpu.ops.flash_attention import require_kv_mask
             x = fused_attn_block(x, params["self_attn"],
                                  params["ln_self"],
                                  num_heads=self.cfg.num_heads,
                                  causal=True, prenorm=True,
                                  norm=self.cfg.norm, rel_bias=self_bias)
+            ctx_kv = (None if ctx_mask is None else
+                      require_kv_mask(ctx_mask, x, ctx, "fused_block"))
+            x = fused_cross_attn_block(x, ctx, params["cross_attn"],
+                                       params["ln_cross"],
+                                       num_heads=self.cfg.num_heads,
+                                       ctx_kv_mask=ctx_kv,
+                                       norm=self.cfg.norm)
         else:
             h = self.ln_self.apply(params["ln_self"], x)
             p = params["self_attn"]
@@ -210,9 +219,9 @@ class T5DecoderLayer(Module):
             o = dot_product_attention(q, k, v, mask=causal_mask(t),
                                       bias=self_bias)
             x = x + self.self_attn.out_proj(p, o)
-        h = self.ln_cross.apply(params["ln_cross"], x)
-        x = x + self.cross_attn.apply(params["cross_attn"], h, kv_input=ctx,
-                                      mask=ctx_mask)
+            h = self.ln_cross.apply(params["ln_cross"], x)
+            x = x + self.cross_attn.apply(params["cross_attn"], h,
+                                          kv_input=ctx, mask=ctx_mask)
         return self.ffn.apply(params["ffn"], x)
 
     def decode_step(self, params, x_t, cache, cross_k, cross_v, pos,

@@ -740,3 +740,195 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
                    rep8(fc2_params["b"]), rep8(ln_params["scale"]),
                    rep8(lnb), prenorm, norm, eps, interpret)
     return y.reshape(b, t, d)
+
+
+# --------------------------------------------------------------------------
+# cross-attention megakernel (T5 decoder)
+# --------------------------------------------------------------------------
+
+def _cross_block_kernel(x_ref, ctx_ref, wq_ref, bq_ref, wkv_ref, bkv_ref,
+                        wo_ref, bo_ref, lns_ref, lnb_ref, *rest,
+                        num_heads, norm, eps, has_mask):
+    """One batch row of ``x + O(attn(Q(norm(x)), K(ctx), V(ctx)))`` —
+    the T5 decoder's pre-LN cross-attention half-block.  q comes from
+    the normalized decoder states, k/v from the RAW encoder output
+    (T5DecoderLayer contract).  refs:
+      x (1,T,D), ctx (1,S,D), wq (D,D), bq (8,D), wkv (D,2D),
+      bkv (8,2D) [, bias (1,8,S)], y (1,T,D),
+      q_scr (T,D) f32, kv_scr (S,2D) f32, acc_scr (T,D) f32
+    """
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_mask else None
+    y_ref, q_scr, kv_scr, acc_scr = rest
+
+    t, d = x_ref.shape[1], x_ref.shape[2]
+    hd = d // num_heads
+    scale = hd ** -0.5
+    cdt = x_ref.dtype
+
+    x32 = x_ref[0].astype(jnp.float32)                        # (T, D)
+    h = _ln(x32, lns_ref[:1, :].astype(jnp.float32),
+            lnb_ref[:1, :].astype(jnp.float32), eps, norm)
+    q_scr[:] = jax.lax.dot(
+        h.astype(cdt), wq_ref[:],
+        preferred_element_type=jnp.float32) + bq_ref[:1, :].astype(
+            jnp.float32)
+    kv_scr[:] = jax.lax.dot(
+        ctx_ref[0], wkv_ref[:],
+        preferred_element_type=jnp.float32) + bkv_ref[:1, :].astype(
+            jnp.float32)
+
+    for hi in range(num_heads):
+        q = q_scr[:, hi * hd:(hi + 1) * hd].astype(cdt)       # (T, hd)
+        k = kv_scr[:, hi * hd:(hi + 1) * hd].astype(cdt)      # (S, hd)
+        v = kv_scr[:, d + hi * hd:d + (hi + 1) * hd].astype(cdt)
+        s = jax.lax.dot_general(                              # (T, S)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0][:1, :]                        # (1, S)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:, hi * hd:(hi + 1) * hd] = jax.lax.dot(
+            p.astype(cdt), v, preferred_element_type=jnp.float32) / l
+
+    a = jax.lax.dot(
+        acc_scr[:].astype(cdt), wo_ref[:],
+        preferred_element_type=jnp.float32) + bo_ref[:1, :].astype(
+            jnp.float32)
+    y_ref[0] = (x32 + a).astype(y_ref.dtype)
+
+
+def _cross_fwd(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
+               num_heads, norm, eps, interpret):
+    b, t, d = x.shape
+    s_len = ctx.shape[1]
+    has_mask = bias is not None
+    in_specs = [
+        pl.BlockSpec((1, t, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, s_len, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((d, d), lambda bi: (0, 0)),
+        pl.BlockSpec((8, d), lambda bi: (0, 0)),
+        pl.BlockSpec((d, 2 * d), lambda bi: (0, 0)),
+        pl.BlockSpec((8, 2 * d), lambda bi: (0, 0)),
+        pl.BlockSpec((d, d), lambda bi: (0, 0)),
+        pl.BlockSpec((8, d), lambda bi: (0, 0)),
+        pl.BlockSpec((8, d), lambda bi: (0, 0)),
+        pl.BlockSpec((8, d), lambda bi: (0, 0)),
+    ]
+    args = [x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, 8, s_len), lambda bi: (bi, 0, 0)))
+        args.append(bias)
+    return pl.pallas_call(
+        functools.partial(_cross_block_kernel, num_heads=num_heads,
+                          norm=norm, eps=eps, has_mask=has_mask),
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, t, d), lambda bi: (bi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, t, d), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((t, d), jnp.float32),         # q
+            pltpu.VMEM((s_len, 2 * d), jnp.float32), # packed k|v
+            pltpu.VMEM((t, d), jnp.float32),         # per-head out concat
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)
+
+
+def _cross_ref(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
+               num_heads, norm, eps):
+    """XLA reference with the kernel's dtype discipline — the backward
+    differentiates THIS (flash bwd is self-attention-only: Tq != Tk)."""
+    b, t, d = x.shape
+    s_len = ctx.shape[1]
+    cdt = x.dtype
+    f32 = jnp.float32
+    hd = d // num_heads
+    x32 = x.astype(f32)
+    h = _ln(x32, lns8[:1, :].astype(f32), lnb8[:1, :].astype(f32), eps,
+            norm)
+    q = (jax.lax.dot(h.astype(cdt).reshape(b * t, d), wq,
+                     preferred_element_type=f32)
+         + bq8[:1, :].astype(f32)).reshape(b, t, num_heads, hd)
+    kv = (jax.lax.dot(ctx.reshape(b * s_len, d), wkv,
+                      preferred_element_type=f32)
+          + bkv8[:1, :].astype(f32)).reshape(b, s_len, 2 * d)
+    k = kv[..., :d].reshape(b, s_len, num_heads, hd)
+    v = kv[..., d:].reshape(b, s_len, num_heads, hd)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(cdt), k.astype(cdt),
+                    preferred_element_type=f32) * (hd ** -0.5)
+    if bias is not None:
+        sc = sc + bias[:, :1, :][:, None, :, :]               # (B,1,1,S)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cdt), v.astype(cdt),
+                     preferred_element_type=f32)
+    raw = out.reshape(b, t, d)
+    a = jax.lax.dot(raw.astype(cdt).reshape(b * t, d), wo,
+                    preferred_element_type=f32).reshape(b, t, d)
+    return (x32 + a + bo8[:1, :].astype(f32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13, 14))
+def _fused_cross(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
+                 num_heads, norm, eps, interpret):
+    return _cross_fwd(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8,
+                      bias, num_heads, norm, eps, interpret)
+
+
+def _fused_cross_fwd_rule(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8,
+                          lnb8, bias, num_heads, norm, eps, interpret):
+    y = _cross_fwd(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
+                   num_heads, norm, eps, interpret)
+    return y, (x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias)
+
+
+def _fused_cross_bwd_rule(num_heads, norm, eps, interpret, res, dy):
+    x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias = res
+    diff = (x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8)
+    _, vjp = jax.vjp(
+        lambda *a: _cross_ref(*a, bias, num_heads, norm, eps), *diff)
+    grads = vjp(dy)
+    return (*grads, None if bias is None else jnp.zeros_like(bias))
+
+
+_fused_cross.defvjp(_fused_cross_fwd_rule, _fused_cross_bwd_rule)
+
+
+def fused_cross_attn_block(x, ctx, attn_params, ln_params, *, num_heads,
+                           ctx_kv_mask=None, norm="layernorm", eps=1e-6,
+                           interpret=None):
+    """Fused pre-LN cross-attention half-block (T5 decoder):
+    ``x + O(attn(Q(norm(x)), K(ctx), V(ctx)))`` with q from the
+    normalized decoder states and k/v from the RAW encoder output.
+    ``ctx_kv_mask`` (B, S) bool masks padded encoder positions.  The
+    backward is the vjp of an XLA reference — the flash dq/dk/dv kernel
+    is self-attention-only (Tq must equal Tk)."""
+    b, t, d = x.shape
+    s_len = ctx.shape[1]
+    _check_block_args(t, d, num_heads, None)
+    if s_len % 8 or s_len > MAX_FUSED_T:
+        raise ValueError(
+            f"fused cross-attention needs S % 8 == 0 and S <= "
+            f"{MAX_FUSED_T} (got S={s_len})")
+    if interpret is None:
+        interpret = _interpret_default()
+    rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))
+    wq = attn_params["q"]["w"].reshape(d, d)
+    bq = attn_params["q"]["b"].reshape(d)
+    wkv = jnp.concatenate([attn_params[n]["w"].reshape(d, d)
+                           for n in ("k", "v")], axis=1)
+    bkv = jnp.concatenate([attn_params[n]["b"].reshape(d)
+                           for n in ("k", "v")])
+    wo = attn_params["o"]["w"].reshape(d, d)
+    bias = (None if ctx_kv_mask is None
+            else _mask_bias(ctx_kv_mask, s_len))
+    return _fused_cross(x, ctx, wq, rep8(bq), wkv, rep8(bkv), wo,
+                        rep8(attn_params["o"]["b"]),
+                        rep8(ln_params["scale"]),
+                        rep8(_ln_bias(ln_params)), bias, num_heads, norm,
+                        eps, interpret)

@@ -45,10 +45,10 @@ def main(argv=None) -> int:
                              "schedule (O(stages) activations), encoder "
                              "keeps GPipe-by-AD")
     parser.add_argument("--fused_block", action="store_true",
-                        help="encoder/decoder self-attn + FFN half-"
-                             "blocks as fused Pallas megakernels "
-                             "(ops/block_kernel.py; RMSNorm + relpos "
-                             "bias in-kernel, cross-attention unfused)")
+                        help="every encoder/decoder half-block "
+                             "(self-attn, cross-attn, FFN) as a fused "
+                             "Pallas megakernel (ops/block_kernel.py; "
+                             "RMSNorm + relpos bias in-kernel)")
     parser.set_defaults(learning_rate=3e-3)   # task-suited default
     ns = parser.parse_args(argv)
     cluster_cfg = _from_namespace(ClusterConfig, ns)

@@ -33,6 +33,13 @@ run fused_block_bert_probe 1800 python -m dtf_tpu.workloads.bert_pretrain \
   --preset base --bf16 --per_device_batch 8 --steps 2 --fused_block
 run fused_block_gpt_probe 1800 python -m dtf_tpu.workloads.lm \
   --preset gpt2_small --bf16 --per_device_batch 2 --steps 2 --fused_block
+# llama probe exercises RoPE/GQA/SwiGLU lowering; t5 probe exercises
+# rmsnorm + the (H,T,T) rel-bias input
+run fused_block_llama_probe 1800 python -m dtf_tpu.workloads.lm \
+  --preset llama --bf16 --per_device_batch 2 --steps 2 --fused_block
+run fused_block_t5_probe 1800 python -m dtf_tpu.workloads.seq2seq \
+  --preset small --bf16 --seq_len 512 --per_device_batch 2 --steps 2 \
+  --fused_block
 run bert_fused_block 3600 python -m dtf_tpu.workloads.bert_pretrain \
   --preset base --bf16 --remat --remat_policy attn --layer_loop unroll \
   --per_device_batch 64 --steps 30 --fused_block

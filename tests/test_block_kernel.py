@@ -312,9 +312,11 @@ class TestModelIntegration:
         {"positions": "absolute"},      # no relpos bias -> flash bwd path
     ])
     def test_t5_loss_and_grads(self, extra):
-        """T5 fused blocks (encoder self-attn+FFN, decoder self-attn+FFN;
-        cross-attention unfused): loss+grads match, INCLUDING the learned
-        relpos table's cotangent through the in-kernel bias."""
+        """T5 fused blocks (encoder self-attn+FFN, decoder self-attn+
+        cross-attn+FFN — the ONLY CPU parity coverage for the cross
+        kernel incl. its ctx_mask padding path): loss+grads match,
+        INCLUDING the learned relpos table's cotangent through the
+        in-kernel bias."""
         from dtf_tpu.models.t5 import T5, T5Config
         m0 = T5(T5Config.tiny(**extra))
         m1 = T5(T5Config.tiny(fused_block=True, **extra))
@@ -330,6 +332,34 @@ class TestModelIntegration:
         _tree_close(g0, g1, 1e-3, 1e-3)
         if "relpos_enc" in g1:
             assert float(jnp.abs(g1["relpos_enc"]["table"]).sum()) > 0
+
+    def test_pipeline_parallel_composes(self):
+        """fused_block inside GPipe pipeline stages (shard_map) must
+        reproduce the unfused pipelined loss exactly."""
+        from dtf_tpu import optim
+        from dtf_tpu.models.bert import BertConfig, BertMLM
+        from dtf_tpu.parallel import sharding as sh
+        from dtf_tpu.parallel.mesh import make_mesh
+        from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                           put_global_batch)
+        mesh = make_mesh("data=4,pipe=2", devices=jax.devices()[:8])
+        losses = {}
+        for fused in (False, True):
+            cfg = BertConfig.tiny(num_layers=2, pipeline_mesh=mesh,
+                                  pipeline_microbatches=2,
+                                  use_flash=False, fused_block=fused)
+            model = BertMLM(cfg)
+            opt = optim.adam(1e-3)
+            state = init_state(model, opt, seed=0, mesh=mesh,
+                               param_shardings=sh.apply_rules(
+                                   model.axes(), mesh))
+            step = make_train_step(model.loss, opt, mesh)
+            toks = np.asarray(np.random.default_rng(1).integers(
+                4, 128, (16, 32)), dtype=np.int32)
+            _, metrics = step(state, put_global_batch(mesh, toks),
+                              jax.random.key(1))
+            losses[fused] = float(metrics["loss"])
+        assert abs(losses[True] - losses[False]) < 1e-4, losses
 
     def test_train_step_under_mesh(self, mesh_2d):
         """One full DP/TP-sharded train step with fused blocks: finite
