@@ -91,6 +91,20 @@ def _q_block(t):
         f"unreachable: T={t} was validated as a multiple of 8")
 
 
+# Scoped-VMEM ceiling the kernels request (pltpu.CompilerParams); the
+# estimate guards below keep requested working sets under it with an
+# actionable error instead of an opaque Mosaic allocation failure.
+VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def _check_vmem(estimate_bytes, what):
+    if estimate_bytes > VMEM_BUDGET:
+        raise ValueError(
+            f"{what} needs ~{estimate_bytes / 2**20:.0f} MB of VMEM "
+            f"(> {VMEM_BUDGET / 2**20:.0f} MB budget); use the unfused "
+            f"block (or sequence parallelism) at these dimensions")
+
+
 def _check_block_args(t, d, num_heads, num_kv_heads, rope=False,
                       mlp_act="gelu"):
     kvh = num_kv_heads or num_heads
@@ -288,7 +302,7 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
             pltpu.VMEM((t, d), jnp.float32),       # per-head out concat
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+            vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)
     return outs if emit_aux else (outs[0], None, None)
@@ -546,6 +560,15 @@ def fused_attn_block(x, attn_params, ln_params, *, num_heads,
     """
     b, t, d = x.shape
     _check_block_args(t, d, num_heads, num_kv_heads, rope=rope)
+    kvh = num_kv_heads or num_heads
+    w_pack = d + 2 * kvh * (d // num_heads)
+    isz = x.dtype.itemsize
+    _check_vmem(
+        4 * t * (w_pack + d)                       # qkv + acc scratch f32
+        + isz * (d * w_pack + d * d)               # packed weights
+        + isz * 3 * t * d                          # x/y/raw blocks
+        + (4 * num_heads * t * t if rel_bias is not None else 0),
+        "fused_attn_block")
     if interpret is None:
         interpret = _interpret_default()
 
@@ -656,7 +679,7 @@ def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+            vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)
 
@@ -728,6 +751,14 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
     (T5; no bias).  Operates on flattened (B·T, D) rows — no cross-row
     coupling."""
     b, t, d = x.shape
+    f = fc1_params["w"].shape[1]
+    isz = x.dtype.itemsize
+    n_mats = 3 if fc_gate_params is not None else 2
+    bn = _mlp_rows(b * t)
+    _check_vmem(isz * n_mats * d * f               # fc1 [+gate] + fc2
+                + 4 * bn * (n_mats - 1) * f        # f32 hidden(s)
+                + isz * 2 * bn * d,                # x/y blocks
+                "fused_mlp_block")
     if interpret is None:
         interpret = _interpret_default()
     rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))
@@ -835,7 +866,7 @@ def _cross_fwd(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
             pltpu.VMEM((t, d), jnp.float32),         # per-head out concat
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+            vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)
 
@@ -915,6 +946,11 @@ def fused_cross_attn_block(x, ctx, attn_params, ln_params, *, num_heads,
         raise ValueError(
             f"fused cross-attention needs S % 8 == 0 and S <= "
             f"{MAX_FUSED_T} (got S={s_len})")
+    isz = x.dtype.itemsize
+    _check_vmem(4 * (t * 2 * d + s_len * 2 * d)    # q/acc + kv scratch f32
+                + isz * 4 * d * d                  # wq/wkv/wo
+                + isz * (2 * t * d + s_len * d),   # x/y/ctx blocks
+                "fused_cross_attn_block")
     if interpret is None:
         interpret = _interpret_default()
     rep8 = lambda v_: jnp.broadcast_to(v_[None, :], (8, v_.shape[0]))

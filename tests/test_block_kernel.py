@@ -249,6 +249,18 @@ class TestGuards:
             fused_attn_block(jnp.zeros((1, MAX_FUSED_T + 8, 32)), {}, {},
                              num_heads=4)
 
+    def test_vmem_estimate_guard(self):
+        """Dimensions whose working set exceeds the scoped-VMEM budget
+        fail fast with an actionable error, not an opaque Mosaic
+        allocation failure.  The guard reads only shapes/dtypes, so
+        ShapeDtypeStructs suffice — no gigabyte zeros on the test rig."""
+        x = jax.ShapeDtypeStruct((1, 1024, 8192), jnp.float32)
+        with pytest.raises(ValueError, match="VMEM"):
+            fused_attn_block(x, {}, {}, num_heads=64)
+        w1 = jax.ShapeDtypeStruct((8192, 32768), jnp.float32)
+        with pytest.raises(ValueError, match="VMEM"):
+            fused_mlp_block(x, {"w": w1, "b": None}, {}, {})
+
     def test_odd_head_dim_rope_rejected(self):
         with pytest.raises(ValueError, match="even head dim"):
             fused_attn_block(jnp.zeros((1, 16, 36)), {}, {}, num_heads=4,
