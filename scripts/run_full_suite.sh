@@ -1,0 +1,19 @@
+#!/bin/bash
+# Full test suite (fast + slow), one pytest PROCESS PER FILE.
+# A single-process run of all ~420 tests accumulates enough XLA-CPU
+# client state on this 1-core rig to segfault partway through
+# (reproduced twice at different tests; every file passes in
+# isolation) — per-file processes bound the accumulation and give the
+# same coverage.  Multi-process tests manage their own subprocesses.
+# Usage: bash scripts/run_full_suite.sh [extra pytest args...]
+set -u
+cd "$(dirname "$0")/.." || exit 1
+FAILS=0
+for f in tests/test_*.py; do
+  echo "=== $f ==="
+  python -m pytest "$f" -q -m "slow or not slow" -p no:cacheprovider "$@"
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: $f (rc=$rc)"; }
+done
+echo "=== full suite done; failed files: $FAILS ==="
+exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)
