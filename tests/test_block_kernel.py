@@ -82,6 +82,24 @@ class TestAttnBlockParity:
         g_fused = jax.grad(lambda p: jnp.sum(jnp.sin(fused(p, x))))(params)
         _tree_close(g_ref, g_fused, 5e-4, 5e-4)
 
+    def test_prenorm_causal_fwd_fast(self):
+        """Fast-tier pre-LN/causal coverage: forward parity only (the
+        fwd+grad version is slow-tier)."""
+        from dtf_tpu.models.gpt import GPTBlock, GPTConfig
+        cfg = GPTConfig.tiny(use_flash=False)
+        blk = GPTBlock(cfg)
+        params = blk.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(3), (2, 16, 32), jnp.float32)
+        x1 = fused_attn_block(x, params["attn"], params["ln1"],
+                              num_heads=cfg.num_heads, causal=True,
+                              prenorm=True)
+        y = fused_mlp_block(x1, params["fc1"], params["fc2"],
+                            params["ln2"], prenorm=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(blk.apply(params, x)),
+                                   atol=2e-5, rtol=1e-5)
+
+    @pytest.mark.slow
     def test_prenorm_causal_matches_gpt_block(self):
         from dtf_tpu.models.gpt import GPTBlock, GPTConfig
         cfg = GPTConfig.tiny(use_flash=False)
