@@ -363,6 +363,37 @@ class TestModelIntegration:
         if "relpos_enc" in g1:
             assert float(jnp.abs(g1["relpos_enc"]["table"]).sum()) > 0
 
+    @pytest.mark.parametrize("family", ["llama", "t5"])
+    def test_bf16_families_track_unfused(self, family):
+        """bf16 llama/T5 fused paths (the dtypes the blitz rows run):
+        loss finite and within bf16 noise of the unfused model."""
+        if family == "llama":
+            from dtf_tpu.models.gpt import GPT, GPTConfig
+            kw = dict(rope=True, num_kv_heads=2, mlp_act="swiglu",
+                      dtype=jnp.bfloat16, use_flash=False)
+            m0, m1 = GPT(GPTConfig.tiny(**kw)), GPT(
+                GPTConfig.tiny(fused_block=True, **kw))
+            p = m0.init(jax.random.key(0))
+            batch = jnp.asarray(np.random.default_rng(0).integers(
+                0, 128, (2, 32)), jnp.int32)
+        else:
+            from dtf_tpu.models.t5 import T5, T5Config
+            kw = dict(dtype=jnp.bfloat16)
+            m0, m1 = T5(T5Config.tiny(**kw)), T5(
+                T5Config.tiny(fused_block=True, **kw))
+            p = m0.init(jax.random.key(0))
+            toks = jnp.asarray(np.random.default_rng(0).integers(
+                2, 64, (2, 16)), jnp.int32)
+            batch = {"src": toks, "tgt": toks[:, ::-1].copy()}
+        l0, g0 = jax.value_and_grad(lambda p: m0.loss(p, batch)[0])(p)
+        l1, g1 = jax.value_and_grad(lambda p: m1.loss(p, batch)[0])(p)
+        assert np.isfinite(float(l1))
+        assert abs(float(l0) - float(l1)) < 0.05, (float(l0), float(l1))
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1),
+                        strict=True):
+            assert np.isfinite(np.asarray(a, np.float32)).all()
+            assert np.isfinite(np.asarray(b, np.float32)).all()
+
     def test_pipeline_parallel_composes(self):
         """fused_block inside GPipe pipeline stages (shard_map) must
         reproduce the unfused pipelined loss exactly."""
