@@ -221,7 +221,16 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     # --- the same block through the fused megakernels ----------------
     # (ops/block_kernel.py; same params tree, apply() routes to the
     # kernels) — the isolated fused-vs-unfused comparison the round-5
-    # MFU push rests on, free of workload noise.
+    # MFU push rests on, free of workload noise.  SKIP (never crash: on
+    # chip the rows above are already-spent minutes) when T is outside
+    # the fused kernels' scope.
+    try:
+        from dtf_tpu.ops.block_kernel import _check_block_args, _q_block
+        _check_block_args(t, d, h, None)
+        _q_block(t)
+    except ValueError as exc:
+        print(f"# fused-block rows skipped: {exc}")
+        return rows
     cfg_f = GPTConfig(dim=d, num_heads=h, mlp_dim=f, max_len=t,
                       dtype=jnp.bfloat16, vocab_size=1024,
                       fused_block=True)

@@ -83,12 +83,20 @@ def _ln_bias(ln_params):
 
 
 def _q_block(t):
-    """Largest q-block that divides t, is a multiple of 8, <= 256."""
+    """Largest q-block that divides t, is a multiple of 8, <= 256.
+
+    A degenerate divisor (e.g. T=1016 = 8·127 -> bq=8) would python-
+    unroll the causal loop into T/8 x H inlined bodies — a Mosaic
+    code-size blowup — so awkward lengths raise instead."""
     for b in range(min(256, t), 7, -1):
         if t % b == 0 and b % 8 == 0:
+            if t > 256 and b < 64:
+                break
             return b
-    raise AssertionError(       # _check_block_args enforces t % 8 == 0
-        f"unreachable: T={t} was validated as a multiple of 8")
+    raise ValueError(
+        f"T={t} has no 8-aligned q-block divisor >= 64 for the causal "
+        f"fused kernel; pad the sequence (e.g. to a multiple of 128) or "
+        f"use the unfused block")
 
 
 # Scoped-VMEM ceiling the kernels request (pltpu.CompilerParams); the

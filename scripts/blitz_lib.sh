@@ -1,0 +1,17 @@
+# Shared chip-blitz step runner, sourced by scripts/chip_blitz_r*.sh.
+# Requires $OUT to be set.  Counts failures in $FAILS; a step that fails
+# must NOT stop the rest, and a post-step health probe catches a wedged
+# relay early (a timeout firing mid-compile is the known wedging action,
+# so step timeouts are sized generously by the callers).
+FAILS=0
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2 rc; shift 2
+  echo "=== $name (timeout ${to}s) ==="
+  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+  rc=$?
+  echo "rc=$rc -> $OUT/$name.log"
+  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
+  tail -5 "$OUT/$name.log"
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
+    || echo "WARNING: relay health probe FAILED after $name - STOP and check"
+}

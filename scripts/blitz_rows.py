@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Summarize a chip-blitz output directory into BASELINE.md-ready rows.
+
+The blitz (scripts/chip_blitz_r5.sh) writes one log per step; after two
+dark rounds, minutes on a live chip are the scarcest resource — this
+turns a finished (or partial) blitz into a compact table immediately
+instead of hand-scraping twenty logs.
+
+    python scripts/blitz_rows.py [/tmp/r5_blitz]
+
+Pure text processing (no jax import): safe to run anywhere, any time,
+including against partial results while the blitz is still running.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+# Last-matching-line patterns per interesting fact.
+PATTERNS = [
+    ("step", re.compile(r"^Step-Time: .*")),
+    ("mfu", re.compile(r"^Model-Compute: .*")),
+    ("bench", re.compile(r'^\{"(?:metric|error)".*')),
+    ("ladder", re.compile(r"^per-token .*aggregate.*")),
+    ("no_result", re.compile(r"^NO RESULT: .*")),
+    ("ppl", re.compile(r"^perplexity ratio .*")),
+    ("kv_ppl", re.compile(r"^KV-cache int8 .*")),
+    ("trace", re.compile(r"^\[trace\] .*")),
+    ("error", re.compile(r"^\w*Error: .*|^ValueError: .*")),
+]
+
+
+def summarize(log: pathlib.Path) -> list[str]:
+    found: dict[str, str] = {}
+    trace_rows: list[str] = []
+    for line in log.read_text(errors="replace").splitlines():
+        line = line.strip()
+        for key, pat in PATTERNS:
+            if pat.match(line):
+                if key == "trace":
+                    trace_rows.append(line)
+                else:
+                    found[key] = line
+    out = [found[k] for k, _ in PATTERNS if k in found and k != "trace"]
+    out += trace_rows[:5]                  # top device ops only
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    outdir = pathlib.Path(argv[0] if argv else "/tmp/r5_blitz")
+    logs = sorted(outdir.glob("*.log"))
+    if not logs:
+        print(f"no logs in {outdir}")
+        return 1
+    for log in logs:
+        rows = summarize(log)
+        print(f"### {log.stem}")
+        if rows:
+            for r in rows:
+                print(f"    {r}")
+        else:
+            tail = log.read_text(errors="replace").splitlines()[-3:]
+            print("    (no recognized result lines; tail:)")
+            for r in tail:
+                print(f"    | {r.strip()}")
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,20 +15,8 @@ cd "$(dirname "$0")/.."
 # Timeouts are sized >=3x the r3-measured compile+run time of each step
 # (worst measured compile ~20 min for unroll+accum, which this script
 # AVOIDS) — a timeout firing mid-compile is the known relay-wedging
-# action, so the margins are deliberately generous and a health probe
-# runs after every step to catch a wedged relay early.
-FAILS=0
-run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 to=$2 rc; shift 2
-  echo "=== $name (timeout ${to}s) ==="
-  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
-  rc=$?
-  echo "rc=$rc -> $OUT/$name.log"
-  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
-  tail -5 "$OUT/$name.log"
-  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
-    || echo "WARNING: relay health probe FAILED after $name - STOP and check"
-}
+# action, so the margins are deliberately generous.
+. "$(dirname "$0")/blitz_lib.sh"
 
 # 1a. Headline matmul bench -> the BENCH_r04 shape the driver captures.
 run bench 1800 python bench.py

@@ -12,18 +12,7 @@ cd "$(dirname "$0")/.." || exit 1
 bash scripts/chip_blitz_r4.sh "$OUT"
 R4_RC=$?
 
-FAILS=0
-run() {  # run <name> <timeout_s> <cmd...>  (same contract as r4)
-  local name=$1 to=$2 rc; shift 2
-  echo "=== $name (timeout ${to}s) ==="
-  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
-  rc=$?
-  echo "rc=$rc -> $OUT/$name.log"
-  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
-  tail -5 "$OUT/$name.log"
-  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1 \
-    || echo "WARNING: relay health probe FAILED after $name - STOP and check"
-}
+. "$(dirname "$0")/blitz_lib.sh"
 
 # 7. Fused-block kernels: cheap 2-step compile probes FIRST (a Mosaic
 #    rejection must cost minutes, not a 3600s window), then the MFU rows

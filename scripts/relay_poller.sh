@@ -1,7 +1,8 @@
 #!/bin/bash
 # Round-5 relay poller: probe the TPU relay every POLL_S seconds; the
-# moment a probe succeeds, run the chip blitz (scripts/chip_blitz_r4.sh)
-# exactly once and exit.  A dead relay HANGS rather than raising, so the
+# moment a probe succeeds, run the chip blitz (scripts/chip_blitz_r5.sh
+# — the full r4 queue plus the round-5 fused-block steps) exactly once
+# and exit.  A dead relay HANGS rather than raising, so the
 # probe runs under timeout.  The chip is single-tenant: only this poller
 # may touch the axon platform while it runs.
 set -u
