@@ -22,8 +22,24 @@ while true; do
   n=$((n + 1))
   if timeout "$PROBE_TO" python -c "import jax; d=jax.devices(); assert d and all(x.platform != 'cpu' for x in d), f'not a TPU: {d}'; print(d)" >>"$LOG" 2>&1; then
     echo "$(date -u +%FT%TZ) probe $n SUCCEEDED - relay alive, launching blitz" >>"$LOG"
-    bash scripts/chip_blitz_r5.sh "$OUT" >>"$LOG" 2>&1
+    bash scripts/chip_blitz_r5.sh "$OUT" >>"$LOG" 2>&1 &
+    blitz_pid=$!
+    summarize() {   # partial results land IN THE REPO so the driver's
+      {             # end-of-round commit captures them even mid-blitz
+        echo "# Round-5 chip blitz results ($(date -u +%FT%TZ))"
+        echo "# (auto-written by scripts/relay_poller.sh via"
+        echo "#  scripts/blitz_rows.py; partial until the blitz ends)"
+        echo
+        python scripts/blitz_rows.py "$OUT"
+      } > BLITZ_R5_RESULTS.md 2>&1
+    }
+    while kill -0 "$blitz_pid" 2>/dev/null; do
+      sleep 600
+      ls "$OUT"/*.log >/dev/null 2>&1 && summarize
+    done
+    wait "$blitz_pid"
     rc=$?
+    summarize
     if [ "$rc" -eq 0 ]; then
       echo "$(date -u +%FT%TZ) blitz finished rc=0 (logs in $OUT)" >>"$LOG"
     else
