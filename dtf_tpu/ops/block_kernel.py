@@ -41,6 +41,15 @@ SEPARATE matmul operand (a (D, 2F) pack would break tensor-parallel
 silent fallbacks): T % 8 == 0, T <= MAX_FUSED_T, KVH | H, even head dim
 under RoPE.  On CPU the kernels run in interpreter mode automatically
 (tests, the 8-device simulated mesh).
+
+Sharding status (honest): correctness under GSPMD meshes is tested —
+DP/FSDP/TP train steps and GPipe pipeline stages reproduce the unfused
+losses exactly (tests + the driver dryrun's two-step fused leg).  TP
+*efficiency* is not: GSPMD resolves the pallas_call by gathering the
+sharded weight operands, so a tensor-sharded fused block pays an
+all-gather the unfused megatron path avoids.  The benchmarked fused
+configs are single-chip/DP; a shard-local fused block (shard_map with
+per-shard head groups) is future work gated on multi-chip hardware.
 """
 
 from __future__ import annotations
