@@ -508,47 +508,23 @@ class GPT(Module):
     # --- training objective -------------------------------------------
 
     def _loss_chunked(self, params, tokens, train):
-        """CE loss scanned over T-chunks (cfg.loss_chunk) of the hidden
-        states: per chunk, logits -> log-softmax -> gather, all under
-        jax.checkpoint so backward recomputes them from the (B, C, D)
-        hidden slice instead of saving (B, T, V) fp32 logits."""
-        from dtf_tpu.nn.losses import smooth_token_logp
+        """CE over T-chunks via nn.losses.chunked_token_ce (the shared
+        GPT/T5 memory lever, cfg.loss_chunk): backward recomputes each
+        chunk's logits from its (B, C, D) hidden slice instead of saving
+        the (B, T, V) fp32 logits."""
+        from dtf_tpu.nn.losses import chunked_token_ce
 
         cfg = self.cfg
         h = self._hidden(params, tokens, train=train)[:, :-1]
         targets = tokens[:, 1:]
-        b, t1, d = h.shape
-        c = min(cfg.loss_chunk, t1)
-        pad = (-t1) % c
-        if pad:
-            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-            targets = jnp.pad(targets, ((0, 0), (0, pad)))
-        w = (jnp.arange(t1 + pad) < t1).astype(jnp.float32)
-        n = (t1 + pad) // c
-        hs = h.reshape(b, n, c, d).swapaxes(0, 1)          # (n, B, C, D)
-        ts = targets.reshape(b, n, c).swapaxes(0, 1)       # (n, B, C)
-        ws = w.reshape(n, c)
-
-        def chunk(carry, inp):
-            hc, tc, wc = inp
-            nll, sm, acc = carry
-            logits = self.tok.attend(params["tok"], hc).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            tl = jnp.take_along_axis(logp, tc[..., None], -1)[..., 0]
-            sl = smooth_token_logp(logp, tl, cfg.label_smoothing)
-            wcb = wc[None, :]
-            nll = nll - jnp.sum(tl * wcb)
-            sm = sm - jnp.sum(sl * wcb)
-            acc = acc + jnp.sum((jnp.argmax(logits, -1) == tc) * wcb)
-            return (nll, sm, acc), None
-
-        zero = jnp.zeros((), jnp.float32)
-        (nll, sm, acc), _ = lax.scan(jax.checkpoint(chunk),
-                                     (zero, zero, zero), (hs, ts, ws))
-        denom = b * t1
-        nll = nll / denom
-        return sm / denom, {"accuracy": acc / denom,
-                            "perplexity": jnp.exp(jnp.minimum(nll, 20.0))}
+        b, t1, _ = h.shape
+        weights = jnp.ones((b, t1), jnp.float32)
+        nll, sm, acc, wsum = chunked_token_ce(
+            lambda hc: self.tok.attend(params["tok"], hc), h, targets,
+            weights, cfg.label_smoothing, cfg.loss_chunk)
+        nll = nll / wsum             # wsum == b * t1 (every position real)
+        return sm / wsum, {"accuracy": acc / wsum,
+                           "perplexity": jnp.exp(jnp.minimum(nll, 20.0))}
 
     def loss(self, params, batch, rng=None, train=True):
         """Next-token cross-entropy (optionally label-smoothed, see

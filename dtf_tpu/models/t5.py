@@ -72,6 +72,11 @@ class T5Config:
     # differentiable ctx) while the encoder keeps GPipe-by-AD — see
     # T5.pipeline_loss_and_grads.
     pipeline_schedule: str = "gpipe"
+    # >0: compute the CE loss in decoder-T chunks of this size under
+    # jax.checkpoint, so the (B, T, V) fp32 logits — at T5-small scale
+    # B=16, T=512, V=32k ≈ 1 GB, the single largest activation — are
+    # never materialized (same lever as GPTConfig.loss_chunk).
+    loss_chunk: int = 0
     # Fused TRAIN-step block kernels (ops/block_kernel.py): encoder
     # self-attn + FFN and decoder self-attn + cross-attn + FFN
     # half-blocks each run as one Pallas kernel (RMSNorm and the learned
@@ -423,6 +428,15 @@ class T5(Module):
 
     def decode(self, params, tgt_in, ctx, ctx_mask):
         """Teacher-forced decoder pass: tgt_in (B, T) -> logits (B, T, V)."""
+        h = self.decode_hidden(params, tgt_in, ctx, ctx_mask)
+        return self.tok.attend(params["tok"], h).astype(jnp.float32)
+
+    def decode_hidden(self, params, tgt_in, ctx, ctx_mask):
+        """The decoder stack WITHOUT the vocab head: tgt_in (B, T) ->
+        post-final-norm hidden states (B, T, D).  Split out so the
+        chunked CE loss can run the head per chunk (the (B, T, V) fp32
+        logits are the largest activation at T5-small scale: ~1 GB at
+        B=16, T=512, V=32k)."""
         t = tgt_in.shape[1]
         x = self.tok.apply(params["tok"], tgt_in)
         bias = None
@@ -458,15 +472,13 @@ class T5(Module):
                 stage, grouped, x, self.cfg.pipeline_mesh,
                 num_microbatches=self.cfg.pipeline_microbatches,
                 ctx={"ctx": ctx, "ctx_valid": ctx_mask[:, 0, 0, :]})
-            x = self.ln_dec.apply(params["ln_dec"], x)
-            return self.tok.attend(params["tok"], x).astype(jnp.float32)
+            return self.ln_dec.apply(params["ln_dec"], x)
 
         def body(carry, lp):
             return fn(lp, carry, ctx, ctx_mask=ctx_mask, self_bias=bias), None
 
         x, _ = lax.scan(body, x, params["dec_layers"])
-        x = self.ln_dec.apply(params["ln_dec"], x)
-        return self.tok.attend(params["tok"], x).astype(jnp.float32)
+        return self.ln_dec.apply(params["ln_dec"], x)
 
     def apply(self, params, batch, *, train=False, rng=None):
         src, tgt_in = batch
@@ -478,10 +490,31 @@ class T5(Module):
             [jnp.full((tgt.shape[0], 1), self.cfg.bos_id, tgt.dtype),
              tgt[:, :-1]], axis=1)
 
+    def _loss_chunked(self, params, src, tgt, train):
+        """CE over decoder-T chunks via nn.losses.chunked_token_ce (the
+        shared GPT/T5 memory lever): the (B, T, V) fp32 logits never
+        materialize; pad-position weights are 0, so the injected chunk
+        pad rows drop out."""
+        from dtf_tpu.nn.losses import chunked_token_ce
+
+        cfg = self.cfg
+        ctx, mask = self.encode(params, src)
+        h = self.decode_hidden(params, self._shift_right(tgt), ctx, mask)
+        weights = (tgt != cfg.pad_id).astype(jnp.float32)
+        _, sm, acc, wsum = chunked_token_ce(
+            lambda hc: self.tok.attend(params["tok"], hc), h, tgt,
+            weights, cfg.label_smoothing, cfg.loss_chunk)
+        denom = jnp.maximum(wsum, 1.0)
+        return sm / denom, {"accuracy": acc / denom}
+
     def loss(self, params, batch, rng=None, train=True):
         """batch: {"src": (B, S), "tgt": (B, T)} int32.  Cross-entropy on
-        the decoder's next-token predictions, pad positions masked out."""
+        the decoder's next-token predictions, pad positions masked out.
+        With cfg.loss_chunk > 0 the head runs per T-chunk under
+        jax.checkpoint (see _loss_chunked)."""
         src, tgt = batch["src"], batch["tgt"]
+        if self.cfg.loss_chunk > 0:
+            return self._loss_chunked(params, src, tgt, train)
         logits = self.apply(params, (src, self._shift_right(tgt)),
                             train=train, rng=rng)
         from dtf_tpu.nn.losses import smooth_token_logp

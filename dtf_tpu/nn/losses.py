@@ -56,3 +56,51 @@ def smooth_token_logp(logp: jax.Array, tok_logp: jax.Array,
     if eps == 0.0:
         return tok_logp
     return (1.0 - eps) * tok_logp + eps * jnp.mean(logp, axis=-1)
+
+
+def chunked_token_ce(attend_fn, h, targets, weights, label_smoothing: float,
+                     chunk: int):
+    """Token cross-entropy scanned over T-chunks of the hidden states —
+    the ONE chunked-CE definition used by GPT and T5 (``cfg.loss_chunk``).
+
+    Per chunk, ``attend_fn(hc) -> (B, C, V)`` logits, log-softmax, target
+    gather and label smoothing run under ``jax.checkpoint``, so the full
+    (B, T, V) fp32 logits are never materialized and the backward
+    recomputes each chunk's logits from its (B, C, D) hidden slice.
+
+    h (B, T, D); targets (B, T) int32; weights (B, T) fp32 (a position
+    whose weight is 0 contributes nothing).  T is padded to a multiple of
+    ``chunk`` with zero-weight rows.  Returns fp32 scalar sums
+    ``(nll, smooth_nll, correct, weight)`` — callers normalize.
+    """
+    from jax import lax
+
+    b, t, d = h.shape
+    c = min(chunk, t)
+    pad = (-t) % c
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))
+    n = (t + pad) // c
+    hs = h.reshape(b, n, c, d).swapaxes(0, 1)              # (n, B, C, D)
+    ts = targets.reshape(b, n, c).swapaxes(0, 1)           # (n, B, C)
+    ws = weights.reshape(b, n, c).swapaxes(0, 1)
+
+    def step(carry, inp):
+        hc, tc, wc = inp
+        nll_s, sm_s, acc_s, w_s = carry
+        logits = attend_fn(hc).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tl = jnp.take_along_axis(logp, tc[..., None], -1)[..., 0]
+        sl = smooth_token_logp(logp, tl, label_smoothing)
+        nll_s = nll_s - jnp.sum(tl * wc)
+        sm_s = sm_s - jnp.sum(sl * wc)
+        acc_s = acc_s + jnp.sum((jnp.argmax(logits, -1) == tc) * wc)
+        return (nll_s, sm_s, acc_s, w_s + jnp.sum(wc)), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (nll, sm, acc, wsum), _ = lax.scan(jax.checkpoint(step),
+                                       (zero, zero, zero, zero),
+                                       (hs, ts, ws))
+    return nll, sm, acc, wsum

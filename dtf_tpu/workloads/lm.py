@@ -107,6 +107,12 @@ def main(argv=None) -> int:
     parser.add_argument("--label_smoothing", type=float, default=0.0,
                         help="eps of uniform mass in the CE loss")
     ns = parser.parse_args(argv)
+    if (ns.loss_chunk > 0 and ns.pipeline_microbatches > 0
+            and ns.pipeline_schedule == "1f1b"):
+        parser.error("--loss_chunk has no effect under "
+                     "--pipeline_schedule 1f1b (the interleaved schedule "
+                     "computes its per-microbatch head loss densely); "
+                     "drop one of the two flags")
     # Decode-mode flag validation; the full fused-decode precondition set
     # runs once, post-model-construction, via _check_fused_decode below.
     if ns.decode_kv_int8 and not ns.decode_fused:

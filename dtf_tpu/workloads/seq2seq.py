@@ -44,6 +44,11 @@ def main(argv=None) -> int:
                         help="1f1b: decoder stack runs the interleaved "
                              "schedule (O(stages) activations), encoder "
                              "keeps GPipe-by-AD")
+    parser.add_argument("--loss_chunk", type=int, default=0,
+                        help=">0: compute the CE loss in decoder-T "
+                             "chunks of this size (never materializes "
+                             "the (B,T,V) fp32 logits; backward "
+                             "recomputes per chunk)")
     parser.add_argument("--fused_block", action="store_true",
                         help="every encoder/decoder half-block "
                              "(self-attn, cross-attn, FFN) as a fused "
@@ -51,6 +56,12 @@ def main(argv=None) -> int:
                              "RMSNorm + relpos bias in-kernel)")
     parser.set_defaults(learning_rate=3e-3)   # task-suited default
     ns = parser.parse_args(argv)
+    if (ns.loss_chunk > 0 and ns.pipeline_microbatches > 0
+            and ns.pipeline_schedule == "1f1b"):
+        parser.error("--loss_chunk has no effect under "
+                     "--pipeline_schedule 1f1b (the interleaved schedule "
+                     "computes its per-microbatch head loss densely); "
+                     "drop one of the two flags")
     cluster_cfg = _from_namespace(ClusterConfig, ns)
     train_cfg = _from_namespace(TrainConfig, ns)
 
@@ -63,7 +74,7 @@ def main(argv=None) -> int:
     kw = dict(dtype=dtype, max_src_len=max(ns.seq_len, 16),
               max_tgt_len=max(ns.seq_len, 16),
               label_smoothing=ns.label_smoothing,
-              fused_block=ns.fused_block)
+              fused_block=ns.fused_block, loss_chunk=ns.loss_chunk)
     if ns.pipeline_microbatches > 0:
         kw["pipeline_mesh"] = mesh
         kw["pipeline_microbatches"] = ns.pipeline_microbatches

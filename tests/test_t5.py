@@ -83,6 +83,29 @@ class TestForward:
         assert float(l_full) == pytest.approx(float(manual), rel=1e-5)
 
 
+class TestChunkedLoss:
+    @pytest.mark.parametrize("chunk", [8, 6])   # even split / pad path
+    def test_chunked_matches_dense(self, model, params, chunk):
+        """cfg.loss_chunk: loss, grads and accuracy must match the dense
+        head exactly (chunk 6 exercises the pad-to-multiple path; pad
+        rows carry pad_id targets, so the mask drops them)."""
+        src = np.array(rand_tokens(11, (4, 16)))
+        src[:, 12:] = 0                          # real padding
+        batch = {"src": jnp.asarray(src),
+                 "tgt": jnp.asarray(src[:, ::-1].copy())}
+        mc = T5(T5Config.tiny(loss_chunk=chunk, label_smoothing=0.1))
+        md = T5(T5Config.tiny(label_smoothing=0.1))
+        ld, gd = jax.value_and_grad(lambda p: md.loss(p, batch)[0])(params)
+        lc, gc = jax.value_and_grad(lambda p: mc.loss(p, batch)[0])(params)
+        assert abs(float(ld) - float(lc)) < 1e-6
+        for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gc),
+                        strict=True):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+        assert abs(float(md.loss(params, batch)[1]["accuracy"])
+                   - float(mc.loss(params, batch)[1]["accuracy"])) < 1e-6
+
+
 class TestGeneration:
     def test_greedy_matches_teacher_forced(self, model, params):
         """KV-cache decode (+ pre-projected cross K/V) must reproduce the
