@@ -50,6 +50,13 @@ run llama_fused_block 3600 python -m dtf_tpu.workloads.lm \
 run t5_fused_block 3600 python -m dtf_tpu.workloads.seq2seq \
   --preset small --bf16 --seq_len 512 --per_device_batch 16 --steps 30 \
   --fused_block
+# chunked-CE fallback/ablation: the r4 t5_small row runs the dense
+# (B,T,V) head with no remat (never chip-run — sized on paper); this
+# row both measures loss_chunk's cost and rescues the family's first
+# perf row if the dense head OOMs.
+run t5_small_chunked 3600 python -m dtf_tpu.workloads.seq2seq \
+  --preset small --bf16 --seq_len 512 --per_device_batch 16 --steps 30 \
+  --loss_chunk 128
 
 echo "=== r5 blitz complete; logs in $OUT; r4 rc=$R4_RC, r5 failed steps: $FAILS ==="
 [ "$R4_RC" -eq 0 ] && [ "$FAILS" -eq 0 ]
