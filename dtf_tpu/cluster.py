@@ -93,17 +93,53 @@ def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
                 f"--platform={config.platform}")
         # CLI version of the tests' simulated mesh (SURVEY.md §4): N CPU
         # devices on one host.  config.update works post-import as long as
-        # no backend has been initialized yet.
+        # no backend has been initialized yet.  Older jax (< 0.5) has no
+        # jax_num_cpu_devices option; there the XLA_FLAGS route works for
+        # the same reason (read at backend init, which hasn't happened).
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", config.simulated_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices",
+                              config.simulated_devices)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{config.simulated_devices}").strip()
 
     if config.num_processes > 1 and not _INITIALIZED:
         if not config.coordinator_address:
             raise ValueError("--coordinator_address required when num_processes > 1")
-        jax.distributed.initialize(
-            coordinator_address=config.coordinator_address,
-            num_processes=config.num_processes,
-            process_id=config.process_id,
+        # Bounded retry-with-backoff: at pod scale, workers routinely race
+        # a coordinator that is still scheduling/binding its port, and the
+        # first connect attempt failing is NOT a config error.  Jitter is
+        # seeded by the process index so a fleet of retriers decorrelates.
+        # ValueError (bad topology/config) stays terminal; exhaustion
+        # raises RetryExhausted chained to the last connect error.
+        from dtf_tpu.utils.retry import Backoff, retry_call
+
+        def reset_distributed(_attempt, _exc):
+            # A failed connect can leave jax's global distributed state
+            # assigned; without this, every later attempt would die on
+            # "initialize should only be called once" instead of actually
+            # re-dialing the coordinator.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+
+        retry_call(
+            lambda: jax.distributed.initialize(
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+            ),
+            attempts=5,
+            backoff=Backoff(base_s=1.0, max_s=15.0,
+                            seed=config.process_id),
+            retry_on=(RuntimeError, OSError, ConnectionError),
+            on_retry=reset_distributed,
+            what=f"jax.distributed.initialize "
+                 f"({config.coordinator_address})",
         )
         _INITIALIZED = True
         log.info("jax.distributed initialized: process %d/%d, coordinator %s",

@@ -146,6 +146,29 @@ class TrainConfig:
     # eval and checkpoint saves are excluded (the watchdog suspends around
     # them).
     hang_timeout_s: float = 0.0
+    # Self-healing (DESIGN.md §5): guard every update against non-finite
+    # loss/gradients inside the compiled step — a bad step is SKIPPED
+    # (params/opt state unchanged, a replicated `skipped` counter bumps)
+    # instead of poisoning the parameters.  Replica-uniform by construction,
+    # one isfinite scan per step of overhead.
+    nonfinite_guard: bool = True
+    # After this many CONSECUTIVE guarded-bad steps (a device-side streak
+    # counter, checked at logging sync points so the hot loop stays free of
+    # per-step host syncs), roll the params/opt state back to the last good
+    # checkpoint — or raise TrainingDiverged when there is none / the
+    # rollback budget (max_rollbacks) is spent.  0 disables the policy
+    # (bad steps are still skipped and counted).
+    bad_step_limit: int = 5
+    max_rollbacks: int = 2
+    # Fault-injection spec for the chaos harness (resilience/chaos.py), e.g.
+    # "nan_grad@17,corrupt_ckpt@latest,sigterm@40,stall@25:3s,
+    # loader_error@9,seed=7".  None disables.
+    chaos: Optional[str] = None
+    # Workload CLIs with supervision support (workloads/mnist.py) wrap the
+    # fit in resilience.supervisor.run_supervised with this restart budget:
+    # crash or preemption -> restore the last checkpoint and go again.
+    # 0 disables (single attempt).
+    max_restarts: int = 0
 
     def __post_init__(self):
         if self.profile_summary and not self.profile_dir:

@@ -20,6 +20,7 @@ from typing import Optional
 import numpy as np
 
 from dtf_tpu.native import load_library
+from dtf_tpu.utils.retry import Backoff, retry_call
 
 
 class NativeDataset:
@@ -33,6 +34,9 @@ class NativeDataset:
         self._n = lib.dtf_loader_num_examples(handle)
         self._feat = lib.dtf_loader_feat(handle)
         self.batches_consumed = 0
+        # One schedule for the loader's lifetime — next_batch is the hot
+        # data path and must not re-seed an rng per fetch.
+        self._retry_backoff = Backoff(base_s=0.05, max_s=0.5)
 
     @classmethod
     def from_idx(cls, images_path: str, labels_path: str, *,
@@ -64,12 +68,24 @@ class NativeDataset:
                 f"{self.batch_size}, got request for {batch_size}")
         imgs = np.empty((self.batch_size, self._feat), np.float32)
         labs = np.empty((self.batch_size, self.num_classes), np.float32)
-        rc = self._lib.dtf_loader_next(
-            self._handle,
-            imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            labs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        if rc != 0:
-            raise RuntimeError("native loader failed")
+
+        def pull():
+            rc = self._lib.dtf_loader_next(
+                self._handle,
+                imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise OSError(f"native loader dtf_loader_next rc={rc}")
+
+        # A nonzero rc today means a closed/invalid handle (deterministic),
+        # so the bounded retry exists for the error CONTRACT — any future
+        # transient rc codes get a brief retry, and every failure ends in
+        # a loud terminal RetryExhausted, never an unbounded loop.  A dead
+        # producer thread is a different failure class: it blocks inside
+        # the C++ wait, which the trainer's hang watchdog (not this retry)
+        # converts into a fail-fast exit.
+        retry_call(pull, attempts=3, backoff=self._retry_backoff,
+                   retry_on=(OSError,), what="native loader next_batch")
         self.batches_consumed += 1
         return imgs, labs
 

@@ -61,7 +61,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dtf_tpu.ops.flash_attention import (MASK_VALUE, _bwd as _flash_bwd_call,
+from dtf_tpu.ops.flash_attention import (MASK_VALUE, _CompilerParams,
+                                         _bwd as _flash_bwd_call,
                                          _interpret_default, _mask_bias)
 
 # One batch row's full-T activations must fit VMEM next to the packed
@@ -318,7 +319,7 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
             pltpu.VMEM((t, w), jnp.float32),       # packed qkv
             pltpu.VMEM((t, d), jnp.float32),       # per-head out concat
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)
@@ -695,7 +696,7 @@ def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)
@@ -882,7 +883,7 @@ def _cross_fwd(x, ctx, wq, bq8, wkv, bkv8, wo, bo8, lns8, lnb8, bias,
             pltpu.VMEM((s_len, 2 * d), jnp.float32), # packed k|v
             pltpu.VMEM((t, d), jnp.float32),         # per-head out concat
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=VMEM_BUDGET),
         interpret=interpret,
     )(*args)

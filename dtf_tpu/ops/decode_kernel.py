@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dtf_tpu.ops.flash_attention import _interpret_default
+from dtf_tpu.ops.flash_attention import (_CompilerParams,
+                                          _interpret_default)
 
 NEG_BIG = -1e30
 
@@ -632,7 +633,7 @@ def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
         scratch_shapes=scratches,
         # Double-buffered layer weights (~2x14 MB at GPT-2-small) exceed
         # the 16 MB default scoped-vmem limit; v5e has 128 MB VMEM.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*args)

@@ -42,6 +42,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+# jax < 0.5 spells pltpu.CompilerParams 'TPUCompilerParams' (same fields).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
 
 NEG_INF = float("-inf")
 # Additive value for padding masks.  Finite on purpose: a k block that is
@@ -319,7 +322,7 @@ def _bwd(q, k, v, o, lse, bias, do, causal, scale, block_q, block_k,
                         pltpu.VMEM((bk, d), jnp.float32)],
         # The (T, D) dq accumulator exceeds the 16 MB default scoped-vmem
         # limit for very long sequences (T=64k, D=64 -> 16 MB + blocks).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*args)

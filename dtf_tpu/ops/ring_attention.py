@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.parallel.collectives import shard_map_fn
+
 NEG_BIG = -1e30   # finite "-inf": keeps exp() NaN-free for all-masked rows
 
 
@@ -111,8 +113,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
     if has_mask:
         in_specs.append(P(batch_axes or None, axis))
         args.append(kv_mask)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=spec, check_vma=False)
+    mapped = shard_map_fn(body, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=spec)
     return mapped(*args)
 
 

@@ -36,6 +36,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.parallel.collectives import shard_map_fn
+
 from dtf_tpu.nn.attention import causal_mask, dot_product_attention
 
 
@@ -113,8 +115,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = "seq",
     if has_mask:
         in_specs.append(P(batch_axes or None, axis))
         args.append(kv_mask)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=spec, check_vma=False)
+    mapped = shard_map_fn(body, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=spec)
     return mapped(*args)
 
 

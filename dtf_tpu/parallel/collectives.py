@@ -52,7 +52,7 @@ def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
 
     Building block for ring attention / pipeline schedules.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -104,7 +104,7 @@ def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
     convergence A/B); use exact ``pmean`` when that matters more than
     bandwidth.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     if n == 1:
         return x
     me = lax.axis_index(axis)
@@ -149,7 +149,26 @@ def axis_index(axis: str) -> jax.Array:
     return lax.axis_index(axis)
 
 
+def axis_size(axis: str) -> int:
+    """Size of a mapped mesh axis from inside shard_map'd code.  Newer jax
+    spells this ``lax.axis_size``; older releases constant-fold the classic
+    ``psum(1, axis)`` idiom to the same static int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
 def shard_map_fn(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
-    """Wrap ``jax.shard_map`` with the framework's mesh conventions."""
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=check_vma)
+    """Wrap ``shard_map`` with the framework's mesh conventions.
+
+    THE shard_map entry point for the whole framework (trainer, pipeline
+    schedules, ring/ulysses attention route through here): newer jax exposes
+    ``jax.shard_map(..., check_vma=)``, older releases only
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` — same
+    semantics, renamed flag."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)

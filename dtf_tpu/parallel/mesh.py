@@ -99,14 +99,24 @@ def make_mesh(spec: "MeshSpec | str",
         spec = MeshSpec.parse(spec)
     devices = list(devices) if devices is not None else jax.devices()
     spec = spec.resolve(len(devices))
-    axis_type = (jax.sharding.AxisType.Explicit if explicit
-                 else jax.sharding.AxisType.Auto)
-    axis_types = (axis_type,) * len(spec.names)
+    # Older jax (< 0.5) has no AxisType: every axis is implicitly Auto
+    # (GSPMD mode — the framework default), so the annotation is simply
+    # omitted there; only an Explicit request has no equivalent.
+    axis_type_cls = getattr(jax.sharding, "AxisType", None)
+    if axis_type_cls is None:
+        if explicit:
+            raise NotImplementedError(
+                f"explicit axis types need jax.sharding.AxisType "
+                f"(jax >= 0.5); this is jax {jax.__version__}")
+        kwargs = {}
+    else:
+        axis_type = axis_type_cls.Explicit if explicit else axis_type_cls.Auto
+        kwargs = {"axis_types": (axis_type,) * len(spec.names)}
     if devices == list(jax.devices()):
-        return jax.make_mesh(spec.sizes, spec.names, axis_types=axis_types)
+        return jax.make_mesh(spec.sizes, spec.names, **kwargs)
     import numpy as np
     dev_grid = np.asarray(devices).reshape(spec.sizes)
-    return Mesh(dev_grid, spec.names, axis_types=axis_types)
+    return Mesh(dev_grid, spec.names, **kwargs)
 
 
 def local_mesh(spec: "MeshSpec | str" = "data=-1") -> Mesh:

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.parallel.collectives import axis_size, shard_map_fn
+
 
 def _validate(mesh, axis, stage_params, x, m, batch_axes):
     if axis not in mesh.axis_names:
@@ -107,9 +109,9 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
 
     body = functools.partial(_per_device_pipeline, stage_fn, s=s, m=m,
                              axis=axis, data_axes=batch_axes)
-    mapped = jax.shard_map(
+    mapped = shard_map_fn(
         body, mesh=mesh, in_specs=(param_spec, x_spec, ctx_spec),
-        out_specs=(x_spec, P()), check_vma=False)
+        out_specs=(x_spec, P()))
     ys, aux = mapped(stage_params, xs, ctx)
     return ys.reshape(x.shape[0], *x.shape[1:]), aux
 
@@ -230,11 +232,10 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
                              axis=axis, aux_weight=aux_weight,
                              data_axes=batch_axes,
                              has_dctx=dctx_in is not None)
-    mapped = jax.shard_map(
+    mapped = shard_map_fn(
         body, mesh=mesh,
         in_specs=(param_spec, head_spec, x_spec, ctx_spec, dctx_spec),
-        out_specs=(P(), param_spec, head_spec, x_spec, dctx_spec),
-        check_vma=False)
+        out_specs=(P(), param_spec, head_spec, x_spec, dctx_spec))
     loss, sgrads, hgrads, dxs, ddctx = mapped(stage_params, head_params,
                                               xs, ctx, dctx_in)
     if dctx_in is None:
@@ -389,7 +390,7 @@ def _per_device_1f1b(stage_fn, loss_fn, stage_params, head_params, xs, ctx,
         # handle this via the pmean above — dxs rows are per-shard)
         dsize = 1
         for a in data_axes:
-            dsize *= lax.axis_size(a)
+            dsize *= axis_size(a)
         dxs = dxs / dsize
         dcs = jax.tree_util.tree_map(lambda g: g / dsize, dcs)
     # re-add the stacked stage dim so out_specs P(axis) reassembles (S, ...)

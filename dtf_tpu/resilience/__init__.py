@@ -1,0 +1,25 @@
+"""Self-healing subsystem: deterministic fault injection + restart supervision.
+
+The reference had *no* failure handling — a dead PS hung every worker
+forever and any crash lost all state (SURVEY.md §5.3-§5.4).  The seed
+framework answered with fail-fast primitives (hang watchdog, SIGTERM →
+checkpoint, orbax resume); this package closes the loop with the failures
+that *don't* kill the process and the machinery that proves recovery
+end-to-end:
+
+* :mod:`dtf_tpu.resilience.chaos` — a seeded, spec-driven fault plan
+  (non-finite gradients, loader errors, stalls, checkpoint corruption,
+  simulated preemption) injected at exact steps, from tests or the CLI;
+* :mod:`dtf_tpu.resilience.supervisor` — bounded-restart supervision of a
+  whole fit, resuming from the last good checkpoint between attempts.
+
+The in-step non-finite guard and rollback policy live in the trainer
+(``train/trainer.py``); checkpoint checksums and the corruption-tolerant
+restore live in ``train/checkpoint.py``.  DESIGN.md §5 has the full
+failure-model walkthrough.
+"""
+
+from dtf_tpu.resilience.chaos import ChaosLoaderError, FaultPlan  # noqa: F401
+from dtf_tpu.resilience.supervisor import (  # noqa: F401
+    SupervisorGaveUp, run_supervised, run_supervised_fit,
+)

@@ -1,0 +1,214 @@
+"""Deterministic fault injection: a seeded :class:`FaultPlan` parsed from a
+compact spec string.
+
+Chaos testing only earns its keep if a failing run is *replayable*: every
+fault fires at an exact global step, exactly once, and byte-level corruption
+draws from a seeded rng — so ``--chaos "nan_grad@17,sigterm@40"`` produces
+the same failure sequence on every run.  Spec grammar (comma-separated)::
+
+    nan_grad@S           poison the step-S batch's float leaves with NaN
+                         (drives the train step's non-finite guard)
+    loader_error@S       raise a transient ChaosLoaderError from the step-S
+                         batch fetch (drives the data-path retry)
+    stall@S:DURs         sleep DUR seconds before step S (drives the hang
+                         watchdog; '3s' or bare '3' both parse)
+    sigterm@S            deliver SIGTERM to this process before step S
+                         (drives the preemption save/exit path)
+    corrupt_ckpt@S       after the step-S checkpoint save lands, scribble
+                         over its files (drives restore_robust fallback)
+    corrupt_ckpt@latest  corrupt the newest checkpoint right before the
+                         next restore (the restart-after-crash window)
+    seed=N               seed for corruption bytes (default 0)
+
+Every fault fires once.  A plan is shared state: an in-process supervisor
+must pass ONE plan through all restart attempts (``Trainer(...,
+chaos=plan)``), otherwise step-keyed faults re-fire when the resumed run
+replays their step.  The trainer owns the injection points; this module
+only decides *when* and performs the host-side side effects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import signal
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+_KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "corrupt_ckpt")
+
+
+class ChaosLoaderError(OSError):
+    """Injected transient data-loader failure (an OSError so the data
+    path's normal ``retry_on=(OSError,)`` policy handles it — the test
+    exercises the real retry code, not a chaos-only branch)."""
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    step: Optional[int]          # None for corrupt_ckpt@latest
+    duration_s: float = 0.0      # stall only
+    fired: bool = False
+
+    def __str__(self) -> str:
+        at = "latest" if self.step is None else str(self.step)
+        extra = f":{self.duration_s:g}s" if self.kind == "stall" else ""
+        return f"{self.kind}@{at}{extra}"
+
+
+class FaultPlan:
+    """The parsed spec; trainers call the ``maybe_*`` hooks at their
+    injection points and each matching fault fires exactly once."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0,
+                 sleep=time.sleep, kill=os.kill):
+        self.faults = faults
+        self.seed = seed
+        self._sleep = sleep
+        self._kill = kill
+
+    @classmethod
+    def parse(cls, spec: str, **kwargs) -> "FaultPlan":
+        faults, seed = [], 0
+        for raw in spec.split(","):
+            entry = raw.strip()
+            if not entry:
+                continue
+            if entry.startswith("seed="):
+                seed = int(entry[len("seed="):])
+                continue
+            m = re.fullmatch(r"([a-z_]+)@([a-z0-9]+)(?::([0-9.]+)s?)?", entry)
+            if not m or m.group(1) not in _KINDS:
+                raise ValueError(
+                    f"bad chaos entry {entry!r}; expected kind@step with "
+                    f"kind in {_KINDS} (e.g. 'nan_grad@17,sigterm@40,"
+                    f"stall@25:3s,corrupt_ckpt@latest,seed=7')")
+            kind, at, dur = m.group(1), m.group(2), m.group(3)
+            if at == "latest":
+                if kind != "corrupt_ckpt":
+                    raise ValueError(f"@latest is only valid for "
+                                     f"corrupt_ckpt, got {entry!r}")
+                step = None
+            else:
+                step = int(at)
+            if kind == "stall" and not dur:
+                raise ValueError(f"stall needs a duration, e.g. "
+                                 f"'stall@{at}:3s'; got {entry!r}")
+            faults.append(Fault(kind, step,
+                                duration_s=float(dur) if dur else 0.0))
+        return cls(faults, seed=seed, **kwargs)
+
+    def __str__(self) -> str:
+        return ",".join(str(f) for f in self.faults)
+
+    def pending(self) -> List[Fault]:
+        return [f for f in self.faults if not f.fired]
+
+    def _take(self, kind: str, step: Optional[int]) -> Optional[Fault]:
+        for f in self.faults:
+            if not f.fired and f.kind == kind and f.step == step:
+                f.fired = True
+                log.warning("[chaos] firing %s", f)
+                return f
+        return None
+
+    # -- injection hooks (trainer calls these) ------------------------------
+
+    def maybe_step_faults(self, step: int) -> None:
+        """Stall and SIGTERM, fired at the top of the step loop."""
+        f = self._take("stall", step)
+        if f is not None:
+            self._sleep(f.duration_s)
+        if self._take("sigterm", step) is not None:
+            self._kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_loader_error(self, step: int) -> None:
+        """Raises inside the batch fetch so the REAL retry path recovers."""
+        if self._take("loader_error", step) is not None:
+            raise ChaosLoaderError(
+                f"injected loader failure at step {step} (chaos)")
+
+    def maybe_poison_batch(self, step: int, batch: Any) -> Any:
+        """NaN-fill the float leaves of the host batch — the loss and every
+        gradient go non-finite, driving the guard end-to-end through the
+        real compiled step.  (Integer-only batches, e.g. pure token LM
+        data, have no float leaf to poison — fail loudly rather than
+        silently not injecting.)"""
+        if self._take("nan_grad", step) is None:
+            return batch
+        import jax
+
+        poisoned = [False]
+
+        def nanify(x):
+            x = np.asarray(x)
+            if np.issubdtype(x.dtype, np.floating):
+                poisoned[0] = True
+                return np.full_like(x, np.nan)
+            return x
+
+        batch = jax.tree_util.tree_map(nanify, batch)
+        if not poisoned[0]:
+            raise ValueError(
+                "chaos nan_grad: batch has no float leaf to poison (token-"
+                "only data); inject at a float-input workload instead")
+        return batch
+
+    def maybe_corrupt_after_save(self, step: int, ckpt) -> None:
+        """corrupt_ckpt@S: wait for the step-S save to land, then scribble
+        on it (the manifest was computed from the clean bytes, so the
+        corruption is detectable)."""
+        if self._take("corrupt_ckpt", step) is None:
+            return
+        ckpt.wait()              # async save must land before we can maul it
+        self._corrupt(ckpt, step)
+
+    def maybe_corrupt_latest(self, ckpt) -> None:
+        """corrupt_ckpt@latest: corrupt the newest step right before a
+        restore — the crash-mid-save / bit-rot-at-rest window a restart
+        walks into."""
+        if self._take("corrupt_ckpt", None) is None:
+            return
+        ckpt.wait()
+        step = ckpt.latest_step()
+        if step is None:
+            log.warning("[chaos] corrupt_ckpt@latest: no checkpoint exists")
+            return
+        self._corrupt(ckpt, step)
+
+    def _corrupt(self, ckpt, step: int) -> None:
+        step_dir = ckpt.step_dir(step)
+        if step_dir is None:
+            log.warning("[chaos] corrupt_ckpt: no directory for step %d",
+                        step)
+            return
+        corrupt_tree(step_dir, seed=self.seed)
+        log.warning("[chaos] corrupted checkpoint step %d (%s)", step,
+                    step_dir)
+
+
+def corrupt_tree(root: str, seed: int = 0, max_bytes: int = 1024) -> int:
+    """Overwrite the head of every regular file under ``root`` with seeded
+    random bytes (and truncate one file to simulate a partial write).
+    Returns the number of files corrupted."""
+    rng = np.random.default_rng(seed)
+    count = 0
+    for dirpath, _, files in sorted(os.walk(root)):
+        for name in sorted(files):
+            path = os.path.join(dirpath, name)
+            size = os.path.getsize(path)
+            if size == 0:
+                continue
+            with open(path, "r+b") as f:
+                f.write(rng.bytes(min(size, max_bytes)))
+                if count == 0:       # one partial-write casualty
+                    f.truncate(max(size // 2, 1))
+            count += 1
+    return count

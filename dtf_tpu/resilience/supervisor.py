@@ -1,0 +1,148 @@
+"""Restart supervisor: run a fit under bounded retries, resuming from the
+last checkpoint between attempts.
+
+This is the outermost layer of the failure model (DESIGN.md §5) and the
+piece that proves the others compose: the watchdog and the coordination
+service turn hangs/dead peers into process exits, the preemption handler
+turns SIGTERM into a clean checkpoint, the non-finite guard turns bad math
+into skipped steps (or a :class:`~dtf_tpu.train.trainer.TrainingDiverged`
+raise when it persists) — and the supervisor turns ALL of those into
+"restore the last good checkpoint and go again", with
+:class:`~dtf_tpu.utils.retry.Backoff` between attempts and a bounded
+restart budget so a permanently-broken job still terminates loudly.
+
+In production the supervisor is the job scheduler (k8s restartPolicy, GKE
+node auto-repair re-admitting the pod): each attempt is a fresh process
+whose ``--resume`` picks up the trajectory.  ``run_supervised`` is the
+in-process equivalent for single-host jobs, integration tests, and the
+chaos suite; ``fit_once`` must build a FRESH trainer + data stream per
+attempt (resume fast-forwards the cursor from the restored step — a reused
+mid-stream dataset cannot rewind).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from dtf_tpu.utils.retry import Backoff
+
+log = logging.getLogger("dtf_tpu")
+
+
+class SupervisorGaveUp(RuntimeError):
+    """Restart budget exhausted.  ``history`` holds (attempt, outcome)
+    strings; ``__cause__`` chains the last crash (None if the budget went
+    to preemptions)."""
+
+    def __init__(self, restarts: int, history: List[Tuple[int, str]]):
+        hist = "; ".join(f"#{a}: {o}" for a, o in history)
+        super().__init__(
+            f"supervisor gave up after {restarts} restart(s): {hist}")
+        self.history = history
+
+
+def _default_needs_restart(result: Any) -> bool:
+    """Trainer.fit reports SIGTERM preemption as a clean result with
+    ``preempted=True`` — finished-by-interruption, so restart."""
+    return isinstance(result, dict) and bool(result.get("preempted"))
+
+
+def run_supervised(fit_once: Callable[[int], Any], *,
+                   max_restarts: int = 3,
+                   backoff: Optional[Backoff] = None,
+                   retry_on: Sequence[type] = (Exception,),
+                   needs_restart: Callable[[Any], bool] = _default_needs_restart,
+                   on_restart: Optional[Callable[[int, str], None]] = None,
+                   sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Run ``fit_once(attempt)`` until it completes, restarting on crash or
+    preemption up to ``max_restarts`` times; returns the completed result.
+
+    A restart is consumed when ``fit_once`` raises an exception matching
+    ``retry_on`` or returns a result for which ``needs_restart`` is true
+    (default: a preempted fit).  ``KeyboardInterrupt``/``SystemExit`` are
+    never swallowed.  ``on_restart(attempt, why)`` observes each restart
+    before the backoff sleep.  Exhaustion raises :class:`SupervisorGaveUp`
+    chained to the last crash.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    backoff = backoff or Backoff(base_s=1.0, max_s=60.0)
+    retry_on = tuple(retry_on)
+    history: List[Tuple[int, str]] = []
+    last_exc: Optional[BaseException] = None
+    for attempt in range(max_restarts + 1):
+        try:
+            result = fit_once(attempt)
+        except retry_on as exc:
+            if getattr(exc, "no_restart", False):
+                # Deterministic failures (e.g. checkpoint template/schema
+                # mismatch, CheckpointMismatchError) replay identically on
+                # every attempt — restarting only delays and buries the
+                # loud signal.
+                raise
+            last_exc = exc
+            why = f"crashed ({type(exc).__name__}: {exc})"
+        else:
+            if not needs_restart(result):
+                if attempt:
+                    log.info("supervisor: completed on attempt %d after "
+                             "%d restart(s)", attempt + 1, attempt)
+                return result
+            why = "preempted"
+        history.append((attempt, why))
+        if attempt < max_restarts:
+            d = backoff.delay_s(attempt)
+            log.warning("supervisor: attempt %d %s; restarting from last "
+                        "checkpoint in %.2fs (%d/%d restarts used)",
+                        attempt + 1, why, d, attempt + 1, max_restarts)
+            if on_restart is not None:
+                on_restart(attempt, why)
+            sleep(d)
+    raise SupervisorGaveUp(max_restarts, history) from last_exc
+
+
+def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
+                       base_cfg, *, max_restarts: int,
+                       chaos: Any = None,
+                       initial_splits: Any = None,
+                       backoff: Optional[Backoff] = None,
+                       sleep: Callable[[float], None] = time.sleep) -> Any:
+    """The supervised-workload pattern, shared by the Trainer-style CLIs
+    (mnist, cifar) and tests:
+
+    * ONE chaos plan across all attempts (step-keyed faults fire exactly
+      once per supervised run, not once per restart);
+    * a FRESH trainer + data stream per attempt, with ``resume=True`` from
+      the second attempt on (resume fast-forwards the cursor from the
+      restored step — a reused mid-stream dataset cannot rewind);
+    * the attempt's checkpoint manager closed win or lose.
+
+    ``trainer_factory(cfg, plan) -> Trainer``; ``splits_factory() ->
+    DataSplits`` (or anything ``Trainer.fit`` accepts).  A caller that
+    already loaded the data (e.g. to size its lr schedule) passes it as
+    ``initial_splits`` — attempt 0 trains on it instead of loading twice;
+    only restarts need a fresh, rewound stream.  Returns the completed
+    fit result."""
+    import dataclasses
+
+    plan = chaos
+    if isinstance(plan, str):
+        from dtf_tpu.resilience.chaos import FaultPlan
+        plan = FaultPlan.parse(plan)
+
+    def fit_once(attempt: int):
+        cfg = dataclasses.replace(base_cfg,
+                                  resume=base_cfg.resume or attempt > 0)
+        trainer = trainer_factory(cfg, plan)
+        splits = (initial_splits if attempt == 0
+                  and initial_splits is not None else splits_factory())
+        try:
+            return trainer.fit(splits)
+        finally:
+            if trainer.ckpt is not None:
+                trainer.ckpt.close()
+
+    return run_supervised(fit_once, max_restarts=max_restarts,
+                          backoff=backoff, sleep=sleep)

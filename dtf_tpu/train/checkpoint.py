@@ -8,17 +8,63 @@ checkpoint+restart is this framework's recovery story.
 
 Orbax-backed: async-capable, multi-host aware (each process writes its own
 shards), preserves shardings on restore via the state template.
+
+Hardening (DESIGN.md §5): a restart must never be wedged by the very crash
+it is recovering from.  Each landed save gets a sidecar **manifest** —
+per-file sizes + CRC32 under ``<dir>/manifests/<step>.json``, written by
+the coordinator once the async save commits — and :meth:`restore_robust`
+walks steps newest→oldest, skipping any step whose manifest doesn't verify
+or whose orbax restore raises (partial write, bit rot, chaos-injected
+corruption), so the newest *intact* checkpoint wins.  A corrupt latest
+checkpoint costs ``checkpoint_every`` steps of progress, not the job.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import threading
+import zlib
+from typing import Any, List, Optional
 
 import jax
 
 log = logging.getLogger("dtf_tpu")
+
+_MANIFEST_DIR = "manifests"
+
+
+class CheckpointMismatchError(RuntimeError):
+    """A checkpoint verified INTACT failed to restore: the caller's state
+    template doesn't match what was saved (different model, optimizer, or
+    ``nonfinite_guard`` setting).  Deterministic — a restart replays it
+    identically — so the supervisor must not burn its budget retrying
+    (``no_restart``)."""
+
+    no_restart = True
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def _tree_manifest(root: str) -> dict:
+    """{relpath: {size, crc32}} over every regular file under root."""
+    files = {}
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            files[rel] = {"size": os.path.getsize(path),
+                          "crc32": _file_crc32(path)}
+    return files
 
 
 class CheckpointManager:
@@ -38,22 +84,130 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # Steps saved but not yet manifested (async saves can't be
+        # checksummed until they commit).  Committed steps are manifested
+        # by a background thread at the NEXT save boundary — a hard kill
+        # between saves must not leave the run's checkpoints unverifiable
+        # — and synchronously on the wait()/restore paths.
+        self._unmanifested: List[int] = []
+        self._manifest_threads: List[threading.Thread] = []
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Async save; returns True if a save was queued/performed."""
         saved = self._mgr.save(
             step, args=self._ocp.args.StandardSave(state), force=force)
         if saved:
+            # orbax's save just waited for the previous save internally,
+            # so every EARLIER pending step is committed on disk; checksum
+            # those on a background thread (pure file I/O — the hot loop
+            # must not block on a full checkpoint read-back).
+            committed, self._unmanifested = self._unmanifested, [step]
+            if committed and jax.process_index() == 0:
+                t = threading.Thread(target=self._write_manifests,
+                                     args=(committed,), daemon=True,
+                                     name="dtf_tpu-manifest")
+                t.start()
+                self._manifest_threads.append(t)
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
         return saved
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def step_dir(self, step: int) -> Optional[str]:
+        """The on-disk directory of a landed step, or None."""
+        path = os.path.join(self.directory, str(step))
+        return path if os.path.isdir(path) else None
+
+    # -- integrity sidecar --------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, _MANIFEST_DIR, f"{step}.json")
+
+    def _write_manifests(self, steps: List[int]) -> None:
+        """Checksum COMMITTED steps to manifest sidecars (file I/O only —
+        safe off-thread).  Must never raise: it also runs on the save hot
+        path's background thread."""
+        try:
+            mdir = os.path.join(self.directory, _MANIFEST_DIR)
+            os.makedirs(mdir, exist_ok=True)
+            for step in steps:
+                step_dir = self.step_dir(step)
+                if step_dir is None:  # pruned by max_to_keep or failed
+                    continue
+                manifest = {"step": step, "files": _tree_manifest(step_dir)}
+                tmp = self._manifest_path(step) + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, self._manifest_path(step))
+        except Exception as exc:      # missing manifest degrades, not fails
+            log.warning("manifest write failed for steps %s: %s", steps, exc)
+
+    def flush_manifests(self) -> None:
+        """Synchronous settle point (wait()/restore paths): wait for
+        pending async saves, join in-flight background manifest writers,
+        manifest the remainder, prune stale sidecars.  Coordinator-only
+        writes; every process waits so the barrier stays symmetric."""
+        for t in self._manifest_threads:
+            t.join()
+        self._manifest_threads = []
+        if self._unmanifested:
+            self._mgr.wait_until_finished()
+            pending, self._unmanifested = self._unmanifested, []
+            if jax.process_index() == 0:
+                self._write_manifests(pending)
+        if jax.process_index() != 0:
+            return
+        # Prune sidecars whose checkpoint max_to_keep already deleted, so
+        # manifests/ tracks the live steps instead of growing unbounded.
+        mdir = os.path.join(self.directory, _MANIFEST_DIR)
+        if not os.path.isdir(mdir):
+            return
+        live = {str(s) for s in self._mgr.all_steps()}
+        for name in os.listdir(mdir):
+            stem = name[:-len(".json")] if name.endswith(".json") else None
+            if stem is not None and stem.isdigit() and stem not in live:
+                try:
+                    os.remove(os.path.join(mdir, name))
+                except OSError:
+                    pass
+
+    def verify(self, step: int) -> tuple[bool, str]:
+        """Check a landed step against its manifest.  (True, reason) means
+        "no evidence of corruption" — a missing manifest (legacy layout or
+        a crash before flush) passes here and relies on the restore
+        try/except for protection."""
+        step_dir = self.step_dir(step)
+        if step_dir is None:
+            return False, "step directory missing"
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            return True, "no manifest (unverified)"
+        try:
+            with open(mpath) as f:
+                recorded = json.load(f)["files"]
+        except (OSError, ValueError, KeyError) as exc:
+            return True, f"unreadable manifest ({exc}); unverified"
+        for rel, meta in recorded.items():
+            path = os.path.join(step_dir, rel)
+            if not os.path.exists(path):
+                return False, f"missing file {rel}"
+            if os.path.getsize(path) != meta["size"]:
+                return False, f"size mismatch on {rel}"
+            if _file_crc32(path) != meta["crc32"]:
+                return False, f"crc mismatch on {rel}"
+        return True, "manifest ok"
+
+    # -- restore ------------------------------------------------------------
+
     def restore(self, state_template: Any,
                 step: Optional[int] = None) -> tuple[Any, Optional[int]]:
         """Restore into the template's shapes/dtypes/shardings.  Returns
         (state, step) — (template, None) when nothing to restore."""
+        self.flush_manifests()
         step = step if step is not None else self.latest_step()
         if step is None:
             return state_template, None
@@ -62,9 +216,129 @@ class CheckpointManager:
         log.info("checkpoint restored from step %d", step)
         return restored, step
 
+    def _first_verified(self, candidates: List[int]
+                        ) -> tuple[Optional[int], Optional[str]]:
+        """Newest candidate passing verification, with its verdict string;
+        logs each rejected step."""
+        for step in candidates:
+            ok, why = self.verify(step)
+            if ok:
+                return step, why
+            log.warning("checkpoint step %d failed verification (%s); "
+                        "falling back to an older step", step, why)
+        return None, None
+
+    def restore_robust(self, state_template: Any,
+                       max_step: Optional[int] = None
+                       ) -> tuple[Any, Optional[int]]:
+        """Restore the newest step that verifies AND restores cleanly,
+        falling back past corrupt/partial steps (with a loud warning each
+        time).  Returns (template, None) when no step survives — the
+        caller decides whether a cold start is acceptable.
+
+        A step whose manifest verifies INTACT but whose restore still
+        raises is NOT corruption — it is a template mismatch (different
+        model/optimizer, or a guard-counter schema change from toggling
+        ``nonfinite_guard``): that error re-raises instead of silently
+        cold-starting past a perfectly good trajectory.
+
+        Multi-host: the orbax restore is a collective, so the step choice
+        must be identical on every process — the coordinator verifies and
+        broadcasts its pick (same rule as the preemption save's allgather;
+        a process-local decision could deadlock hosts in different
+        restores).  Manifests are written by the coordinator against the
+        shared filesystem, so its verdict is the authoritative one."""
+        self.flush_manifests()
+        candidates = [s for s in reversed(self.all_steps())
+                      if max_step is None or s <= max_step]
+        had_any = bool(candidates)
+        multi = jax.process_count() > 1
+        if multi:
+            import numpy as np
+            from jax.experimental import multihost_utils
+        while candidates:
+            if multi:
+                pick = np.asarray([-1, 0], np.int32)
+                if jax.process_index() == 0:
+                    s, why = self._first_verified(candidates)
+                    if s is not None:
+                        pick = np.asarray(
+                            [s, 1 if why == "manifest ok" else 0], np.int32)
+                pick = np.asarray(multihost_utils.broadcast_one_to_all(pick))
+                step, verified = int(pick[0]), bool(pick[1])
+                if step < 0:
+                    break
+            else:
+                step, why = self._first_verified(candidates)
+                if step is None:
+                    break
+                verified = (why == "manifest ok")
+            def attempt_restore():
+                restored, exc = None, None
+                try:
+                    restored = self._mgr.restore(
+                        step,
+                        args=self._ocp.args.StandardRestore(state_template))
+                except Exception as e:  # orbax raises many concrete types
+                    exc = e
+                deterministic = exc is not None
+                if multi:
+                    # The fallback decision must ALSO be symmetric: one
+                    # host's per-shard read error while the others
+                    # succeeded would desynchronize the loop into
+                    # mismatched collectives.  Everyone agrees on this
+                    # attempt's outcome; if any host failed, all discard
+                    # together.  A template mismatch fails IDENTICALLY on
+                    # every host, so a partial failure is by definition
+                    # transient I/O, never schema.
+                    oks = np.asarray(multihost_utils.process_allgather(
+                        np.asarray([0 if exc is not None else 1],
+                                   np.int32)))
+                    deterministic = not oks.any()
+                    if not oks.all() and exc is None:
+                        exc = RuntimeError(
+                            "restore failed on another process")
+                        restored = None
+                return restored, exc, deterministic
+
+            restored, exc, deterministic = attempt_restore()
+            if exc is not None and verified and deterministic:
+                # An intact step that won't restore is ALMOST CERTAINLY a
+                # template mismatch — but a transient I/O blip fails once
+                # while a schema mismatch fails every time, so spend one
+                # retry telling them apart before the no-restart raise.
+                # (verified and deterministic agree on every host, so the
+                # retry stays a symmetric collective.)
+                log.warning("checkpoint step %d verified intact but failed "
+                            "to restore (%s: %s); retrying once to rule "
+                            "out a transient I/O error", step,
+                            type(exc).__name__, exc)
+                restored, exc, deterministic = attempt_restore()
+            if exc is not None:
+                if verified and deterministic:
+                    raise CheckpointMismatchError(
+                        f"checkpoint step {step} is verified intact "
+                        f"(manifest checksums match) but failed to restore "
+                        f"into the given state template — this is a "
+                        f"template/schema mismatch (different model, "
+                        f"optimizer, or nonfinite_guard setting than the "
+                        f"run that saved it), not corruption; refusing to "
+                        f"silently discard the trajectory") from exc
+                log.warning("checkpoint step %d failed to restore (%s: %s); "
+                            "falling back to an older step", step,
+                            type(exc).__name__, exc)
+                candidates = [s for s in candidates if s < step]
+                continue
+            log.info("checkpoint restored from step %d", step)
+            return restored, step
+        if had_any:
+            log.error("no restorable checkpoint under %s", self.directory)
+        return state_template, None
+
     def wait(self) -> None:
         """Block until pending async saves land (call before exit)."""
         self._mgr.wait_until_finished()
+        self.flush_manifests()
 
     def close(self) -> None:
         self.wait()

@@ -81,6 +81,14 @@ class MetricLogger:
             # the post-mortem metrics must already be on disk.
             self._tb.flush()
 
+    def event(self, step: int, name: str, detail: str = "") -> None:
+        """Resilience/lifecycle event: one console line + a unit-valued
+        ``event/<name>`` scalar so rollbacks, retries and restarts are
+        visible on the same TensorBoard time axis as the loss they
+        disturbed (and countable from the CSV post-mortem)."""
+        self.print(f"[dtf_tpu] {name}" + (f": {detail}" if detail else ""))
+        self.scalar(step, f"event/{name}", 1.0)
+
     def epoch_summary(self, test_accuracy: float, total_s: float,
                       final_cost: float) -> None:
         """The reference's per-epoch block (tf_distributed.py:126-128)."""

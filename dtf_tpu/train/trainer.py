@@ -39,8 +39,18 @@ from dtf_tpu.utils.timing import StepTimer, block
 TrainState = dict  # {"params": pytree, "opt_state": pytree, "step": i32}
 
 
+class TrainingDiverged(RuntimeError):
+    """Persistent non-finite loss/gradients the in-step guard could not
+    heal: ``bad_step_limit`` consecutive skipped steps with no checkpoint
+    to roll back to, or the rollback budget spent.  The restart supervisor
+    (resilience/supervisor.py) treats this like any other crash — restore
+    and retry — while a bare fit fails fast instead of burning the budget
+    skipping every step."""
+
+
 def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
-               mesh: Mesh, param_shardings: Optional[Any] = None) -> TrainState:
+               mesh: Mesh, param_shardings: Optional[Any] = None,
+               guard: bool = False) -> TrainState:
     """Deterministic same-seed init on all processes — the SPMD replacement
     for the reference's chief-runs-init_op + non-chief-polls protocol
     (tf_distributed.py:92-96; SURVEY.md §2.13 'coordinated init').
@@ -65,6 +75,13 @@ def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
         else jax.device_put(x, rep), opt_state)
     state = {"params": params, "opt_state": opt_state,
              "step": sh.replicate(mesh, jnp.zeros((), jnp.int32))}
+    if guard:
+        # Non-finite-guard counters (replicated i32 scalars): total updates
+        # skipped, and the current consecutive-bad streak the rollback
+        # policy watches.  Present iff the step was built with guard=True
+        # so unguarded states keep their seed pytree structure.
+        state["skipped"] = sh.replicate(mesh, jnp.zeros((), jnp.int32))
+        state["bad_streak"] = sh.replicate(mesh, jnp.zeros((), jnp.int32))
     if hasattr(model, "init_model_state"):
         state["model_state"] = sh.replicate(mesh, model.init_model_state())
     return state
@@ -146,8 +163,21 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     donate: bool = True, stateful: bool = False,
                     grad_accum: int = 1,
                     grad_compression: Optional[str] = None,
-                    grads_fn: Optional[Callable] = None) -> Callable:
+                    grads_fn: Optional[Callable] = None,
+                    guard: bool = False) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
+
+    ``guard=True`` adds the in-step non-finite guard (DESIGN.md §5): an
+    isfinite scan over the loss and every gradient leaf, all-reduced across
+    the data axes (computed BEFORE gradient sync so int8-compressed rings
+    can't launder a NaN into finite garbage, then pmean'd in explicit mode
+    so every device takes the same branch).  A bad step runs the update
+    under ``lax.cond``'s skip branch — params, optimizer state and model
+    state pass through untouched — and bumps the replicated ``skipped`` /
+    ``bad_streak`` counters in the state (``init_state(guard=True)``).
+    Metrics gain ``nonfinite`` (this step's flag), ``skipped_total`` and
+    ``bad_streak``; the trainer's rollback policy reads them at its
+    logging sync points, never per step.
 
     ``loss_fn(params, batch, rng) -> (loss, aux_dict)`` must reduce with
     *means* over the batch dim so both modes agree.  With ``stateful=True``
@@ -266,7 +296,41 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         else:
             loss, aux, new_ms, grads = value_and_grads(
                 params, model_state, batch, rng)
-        grads, loss, aux, new_ms = sync(grads, loss, aux, new_ms)
+        ok = None
+        if guard:
+            # Pre-sync isfinite: a NaN here is still a NaN (an int8-
+            # quantized ring could turn it into finite garbage on the
+            # wire); sync() all-reduces the verdict in explicit mode.
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+        grads, loss, aux, new_ms, ok = sync(grads, loss, aux, new_ms, ok)
+        if guard:
+            def apply_update(_):
+                updates, new_opt = optimizer.update(grads, opt_state, params)
+                return (optim_lib.apply_updates(params, updates), new_opt,
+                        new_ms if stateful else ())
+
+            def skip_update(_):
+                # Skip semantics: values pass through untouched — including
+                # model_state, whose "new" batch statistics came from the
+                # same poisoned batch as the gradients.
+                return (params, opt_state,
+                        model_state if stateful else ())
+
+            new_params, new_opt, kept_ms = lax.cond(
+                ok, apply_update, skip_update, None)
+            bad = 1 - ok.astype(jnp.int32)
+            skipped = state["skipped"] + bad
+            streak = (state["bad_streak"] + 1) * bad  # +1 if bad else reset
+            new_state = {"params": new_params, "opt_state": new_opt,
+                         "step": step + 1, "skipped": skipped,
+                         "bad_streak": streak}
+            if stateful:
+                new_state["model_state"] = kept_ms
+            metrics = {"loss": loss, "nonfinite": bad,
+                       "skipped_total": skipped, "bad_streak": streak, **aux}
+            return new_state, metrics
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optim_lib.apply_updates(params, updates)
         new_state = {"params": params, "opt_state": opt_state, "step": step + 1}
@@ -280,8 +344,11 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         # GSPMD emit the gradient all-reduce.
         @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
         def step_fn(state, batch, rng):
-            return grads_and_update(state, batch, rng,
-                                    sync=lambda g, l, a, ms: (g, l, a, ms))
+            # Global-batch program: loss/grads (and the guard verdict) are
+            # already global values; sync is the identity.
+            return grads_and_update(
+                state, batch, rng,
+                sync=lambda g, l, a, ms, ok: (g, l, a, ms, ok))
 
         return step_fn
 
@@ -303,9 +370,14 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         def per_device(state, batch, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(data_axes[0]))
 
-            def sync(grads, loss, aux, new_ms):
+            def sync(grads, loss, aux, new_ms, ok):
                 pmean = lambda t: jax.tree_util.tree_map(
                     lambda v: lax.pmean(v, data_axes), t)
+                if ok is not None:
+                    # All devices must take the SAME cond branch or params
+                    # diverge across replicas: all-reduce the local verdict
+                    # (mean of {0,1} flags == 1.0 iff every shard is clean).
+                    ok = lax.pmean(ok.astype(jnp.float32), data_axes) == 1.0
                 if grad_compression == "int8":
                     # int8-wire ring all-reduce for the bandwidth-heavy
                     # gradients; scalars stay exact.  (Single data axis
@@ -318,15 +390,15 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                 else:
                     g = pmean(grads)
                 return (g, pmean(loss), pmean(aux),
-                        pmean(new_ms) if new_ms is not None else None)
+                        pmean(new_ms) if new_ms is not None else None, ok)
 
             return grads_and_update(state, batch, rng, sync)
 
         batch_p = P(data_axes)
-        mapped = jax.shard_map(
+        from dtf_tpu.parallel.collectives import shard_map_fn
+        mapped = shard_map_fn(
             per_device, mesh=mesh,
-            in_specs=(P(), batch_p, P()), out_specs=(P(), P()),
-            check_vma=False)
+            in_specs=(P(), batch_p, P()), out_specs=(P(), P()))
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
     raise ValueError(f"mode must be 'implicit' or 'explicit', got {mode!r}")
@@ -397,11 +469,22 @@ class Trainer:
     mode: str = "implicit"
     grad_compression: Optional[str] = None   # "int8" (explicit mode only)
     logger: Optional[MetricLogger] = None
+    # Fault injection: a resilience.chaos.FaultPlan (or a spec string;
+    # cfg.chaos is the CLI path).  Pass ONE shared plan object through a
+    # supervisor's restart attempts so each fault still fires exactly once
+    # across the whole supervised run.
+    chaos: Optional[Any] = None
 
     def __post_init__(self):
         mesh = self.cluster.mesh
         self.logger = self.logger or MetricLogger(
             self.cfg.logdir, self.cluster.is_coordinator)
+        self._chaos = self.chaos if self.chaos is not None else self.cfg.chaos
+        if isinstance(self._chaos, str):
+            from dtf_tpu.resilience.chaos import FaultPlan
+            self._chaos = FaultPlan.parse(self._chaos)
+        self._guarded = self.cfg.nonfinite_guard
+        self._rollbacks = 0
         stateful = hasattr(self.model, "init_model_state")
         # Models that must produce their own gradients (1F1B pipeline
         # schedules interleave fwd/bwd and cannot be expressed as jax.grad
@@ -411,7 +494,8 @@ class Trainer:
                                        mode=self.mode, stateful=stateful,
                                        grad_accum=self.cfg.grad_accum,
                                        grad_compression=self.grad_compression,
-                                       grads_fn=grads_fn)
+                                       grads_fn=grads_fn,
+                                       guard=self._guarded)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         # Parameter placement from the model's logical axes: FSDP when the
         # mesh has an 'fsdp' axis, tensor/expert/... sharding per the rule
@@ -427,7 +511,8 @@ class Trainer:
             except NotImplementedError:   # model without logical axes
                 pass
         self.state = init_state(self.model, self.optimizer, self.cfg.seed,
-                                mesh, param_shardings=shardings)
+                                mesh, param_shardings=shardings,
+                                guard=self._guarded)
         # Model-structure graph to TensorBoard, once at startup — the
         # reference's writer.add_graph (tf_distributed.py:97).
         self.logger.graph(self.state["params"],
@@ -441,9 +526,54 @@ class Trainer:
             self.ckpt = CheckpointManager(
                 f"{self.cfg.logdir}/checkpoints")
             if self.cfg.resume:
-                self.state, step = self.ckpt.restore(self.state)
+                if self._chaos is not None:
+                    # corrupt_ckpt@latest models bit rot / a crash mid-save
+                    # discovered only when the restart tries to restore.
+                    self._chaos.maybe_corrupt_latest(self.ckpt)
+                had_steps = self.ckpt.all_steps()
+                try:
+                    self.state, step = self.ckpt.restore_robust(self.state)
+                except Exception as exc:
+                    from dtf_tpu.train.checkpoint import (
+                        CheckpointMismatchError)
+                    if (not isinstance(exc, CheckpointMismatchError)
+                            or not self._guarded):
+                        raise
+                    # Legacy checkpoints (saved before the guard existed /
+                    # with --no-nonfinite_guard) lack the counter leaves.
+                    # Backfill: restore without them, re-attach the fresh
+                    # zeros from init — the trajectory is too valuable to
+                    # discard over two scalar counters.
+                    legacy = {k: v for k, v in self.state.items()
+                              if k not in ("skipped", "bad_streak")}
+                    restored, step = self.ckpt.restore_robust(legacy)
+                    if step is None:
+                        raise
+                    restored["skipped"] = self.state["skipped"]
+                    restored["bad_streak"] = self.state["bad_streak"]
+                    self.state = restored
+                    self.logger.print(
+                        f"[dtf_tpu] resumed a pre-guard checkpoint "
+                        f"(step {step}); guard counters start at zero")
                 if step is not None:
                     self.logger.print(f"[dtf_tpu] resumed from step {step}")
+                elif had_steps:
+                    # A silent cold start would discard the trajectory the
+                    # user explicitly asked to resume (e.g. legacy
+                    # checkpoints without manifests that mismatch the
+                    # current guard schema).  Deleting the directory is the
+                    # intentional way to start over.
+                    err = RuntimeError(
+                        f"--resume requested but none of checkpoint steps "
+                        f"{had_steps} under {self.ckpt.directory} could be "
+                        f"restored (corrupt, partial, or saved with a "
+                        f"different model/optimizer/nonfinite_guard "
+                        f"schema); refusing to silently start fresh — "
+                        f"delete the checkpoint directory to start over")
+                    # Deterministic: a supervisor restart replays this
+                    # identically, so it must not burn the restart budget.
+                    err.no_restart = True
+                    raise err
         # Host-side mirror of state["step"]: reading the device scalar every
         # step would sync the async dispatch pipeline.
         self._host_step = int(self.state["step"])
@@ -488,6 +618,43 @@ class Trainer:
         import contextlib
         return (self._watchdog.suspend() if self._watchdog is not None
                 else contextlib.nullcontext())
+
+    def _rollback_or_fail(self, streak: int) -> None:
+        """bad_step_limit consecutive non-finite steps: restore params and
+        optimizer state from the last good checkpoint, or raise
+        TrainingDiverged when there is nothing to restore / the rollback
+        budget is spent.  The step counter and data cursor keep moving
+        FORWARD — the bad window's updates were skipped (params untouched),
+        so rolling back values while advancing past its batches is the
+        standard spike-recovery move and keeps resume bookkeeping exact."""
+        why = f"{streak} consecutive non-finite steps"
+        if self.ckpt is None:
+            raise TrainingDiverged(
+                f"{why} and checkpointing is disabled — nothing to roll "
+                f"back to (enable --checkpoint_every, or fix the "
+                f"instability: lr/clipping/data)")
+        if self._rollbacks >= self.cfg.max_rollbacks:
+            raise TrainingDiverged(
+                f"{why} after {self._rollbacks} rollback(s) — the "
+                f"instability persists across restores; failing fast")
+        cur_step = self.state["step"]
+        cur_skipped = self.state["skipped"]
+        with self._suspended_watchdog():
+            restored, good_step = self.ckpt.restore_robust(self.state)
+        if good_step is None:
+            raise TrainingDiverged(f"{why} and no restorable checkpoint")
+        # Values roll back; counters carry forward (eager elementwise ops
+        # preserve the replicated sharding of their inputs).
+        restored["step"] = cur_step
+        restored["skipped"] = cur_skipped
+        restored["bad_streak"] = restored["bad_streak"] * 0
+        self.state = restored
+        self._rollbacks += 1
+        self.logger.event(
+            int(cur_step), "rollback",
+            f"{why}; restored params/opt state from checkpoint step "
+            f"{good_step} ({self._rollbacks}/{self.cfg.max_rollbacks} "
+            f"rollbacks used)")
 
     @property
     def global_batch_size(self) -> int:
@@ -557,6 +724,22 @@ class Trainer:
             from dtf_tpu.utils.preemption import PreemptionHandler
             preempt = PreemptionHandler()
         preempted = False
+        # Data-path robustness: transient I/O errors (flaky filesystem,
+        # chaos loader_error) get a bounded retry; ValueError and the
+        # native loader's RetryExhausted stay terminal.  Chaos nan_grad
+        # poisons the host batch AFTER the fetch so the injected NaNs
+        # drive the compiled guard through the real path.
+        from dtf_tpu.utils.retry import Backoff, retry_call
+        # Jitter decorrelated by process index: hosts retrying a flaky
+        # shared filesystem must not re-hit it in lockstep.
+        fetch_backoff = Backoff(base_s=0.1, max_s=2.0,
+                                seed=cfg.seed + jax.process_index())
+
+        def fetch_batch():
+            if self._chaos is not None:
+                self._chaos.maybe_loader_error(self._host_step)
+            return train.next_batch(feed_bs)
+
         try:
             hit_cap = False
             for epoch in range(start_epoch, epochs):
@@ -566,7 +749,15 @@ class Trainer:
                     if max_steps is not None and self._host_step >= max_steps:
                         hit_cap = True
                         break
-                    batch = put(mesh, train.next_batch(feed_bs))
+                    if self._chaos is not None:
+                        self._chaos.maybe_step_faults(self._host_step)
+                    host_batch = retry_call(
+                        fetch_batch, attempts=3, backoff=fetch_backoff,
+                        retry_on=(OSError,), what="train batch fetch")
+                    if self._chaos is not None:
+                        host_batch = self._chaos.maybe_poison_batch(
+                            self._host_step, host_batch)
+                    batch = put(mesh, host_batch)
                     step_rng = jax.random.fold_in(rng_base, self._host_step)
                     self.state, metrics = self.step_fn(self.state, batch,
                                                        step_rng)
@@ -588,6 +779,12 @@ class Trainer:
                             and self._host_step % self.cfg.checkpoint_every == 0):
                         with self._suspended_watchdog():
                             self.ckpt.save(self._host_step, self.state)
+                            if self._chaos is not None:
+                                # Inside the suspended window: the hook
+                                # drains the async save + checksums files,
+                                # which must not read as a training hang.
+                                self._chaos.maybe_corrupt_after_save(
+                                    self._host_step, self.ckpt)
                     # Preemption decision: single-process polls the local
                     # flag every step; multi-process agrees via allgather
                     # only at the logging sync boundaries (deterministic,
@@ -621,6 +818,22 @@ class Trainer:
                         self.logger.scalar(step, "avg_ms", avg_ms)
                         count = 0
                         last_cost = cost
+                        # Guard policy (DESIGN.md §5): the device-side
+                        # streak counter means the hot loop never syncs
+                        # per step; the sync boundary is where the host
+                        # reads the verdict and decides.  A bad step is
+                        # already a no-op to params, so acting a few
+                        # steps late is harmless.
+                        if self._guarded:
+                            skipped_total = int(metrics["skipped_total"])
+                            if skipped_total:
+                                self.logger.scalar(step, "bad_steps_total",
+                                                   skipped_total)
+                            if (cfg.bad_step_limit > 0
+                                    and int(metrics["bad_streak"])
+                                    >= cfg.bad_step_limit):
+                                self._rollback_or_fail(
+                                    int(metrics["bad_streak"]))
                 if preempted or hit_cap:
                     break
                 if splits.test is not None:
@@ -660,6 +873,17 @@ class Trainer:
                 else:
                     self._print_trace_summary(steps_traced)
         block(self.state)
+        if self._chaos is not None and not preempted:
+            pend = self._chaos.pending()
+            if pend:
+                # An injected-but-never-fired fault proves nothing — the
+                # same accepted-but-ignored trap the benchmark driver warns
+                # about for --max_restarts.
+                self.logger.print(
+                    f"[dtf_tpu] WARNING: chaos faults never fired: "
+                    f"{','.join(str(f) for f in pend)} (step never "
+                    f"reached, or corrupt_ckpt step not a checkpoint "
+                    f"boundary) — this run did NOT exercise them")
         if self.ckpt is not None:
             if (not preempted and self.cfg.checkpoint_every > 0
                     and self.ckpt.latest_step() != self._host_step):
@@ -667,4 +891,7 @@ class Trainer:
             self.ckpt.wait()
         return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
                 "steps": int(self.state["step"]), "total_s": timer.total_s(),
-                "preempted": preempted}
+                "preempted": preempted,
+                "skipped_steps": (int(self.state["skipped"])
+                                  if "skipped" in self.state else 0),
+                "rollbacks": self._rollbacks}

@@ -1,1 +1,1 @@
-from dtf_tpu.utils import timing  # noqa: F401
+from dtf_tpu.utils import retry, timing  # noqa: F401

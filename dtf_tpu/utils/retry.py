@@ -1,0 +1,117 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The reference had exactly one answer to any transient failure: hang or die
+(SURVEY.md §5.3).  This module is the shared retry policy for the places a
+*transient* error is routine and a bounded number of re-attempts is the
+right response:
+
+* ``cluster.bootstrap`` — workers racing a slow coordinator retry
+  ``jax.distributed.initialize`` instead of dying on first connect;
+* the data path — flaky dataset/loader I/O (``trainer`` batch fetch,
+  ``native_loader``) retries and then fails with a CLEAR terminal error
+  (never a silent infinite loop);
+* ``resilience/supervisor.py`` — whole-fit restarts reuse the same
+  :class:`Backoff` schedule between attempts.
+
+Design rules: retries are *bounded* (``attempts``), the exception filter is
+*explicit* (``retry_on`` — config errors like ``ValueError`` must stay
+terminal), jitter is *seeded* (deterministic under test; decorrelated across
+processes by seeding with the process index), and the clock is injectable
+(tests pass a fake ``sleep`` and assert the exact delay sequence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+
+class RetryExhausted(RuntimeError):
+    """Terminal failure after the full retry budget.
+
+    Carries the attempt count and chains the last underlying error
+    (``__cause__``) so post-mortems see both the policy and the root cause.
+    """
+
+    def __init__(self, what: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{what}: failed after {attempts} attempt(s); last error: "
+            f"{type(last).__name__}: {last}")
+        self.what = what
+        self.attempts = attempts
+        self.last = last
+
+
+@dataclasses.dataclass
+class Backoff:
+    """Exponential backoff schedule with multiplicative jitter.
+
+    Attempt k (0-based) sleeps ``min(base_s * factor**k, max_s)`` scaled by
+    a uniform jitter in ``[1 - jitter, 1 + jitter]``.  ``seed`` makes the
+    jitter stream deterministic (seed with the process index so a fleet of
+    restarting workers decorrelates instead of thundering back in lockstep).
+    """
+
+    base_s: float = 0.5
+    max_s: float = 30.0
+    factor: float = 2.0
+    jitter: float = 0.25
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.base_s < 0 or self.max_s < 0:
+            raise ValueError(f"backoff delays must be >= 0, got "
+                             f"base_s={self.base_s}, max_s={self.max_s}")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        self._rng = np.random.default_rng(self.seed)
+
+    def delay_s(self, attempt: int) -> float:
+        """Sleep duration after failed attempt ``attempt`` (0-based)."""
+        d = min(self.base_s * self.factor ** attempt, self.max_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * float(self._rng.uniform(-1.0, 1.0))
+        return d
+
+
+def retry_call(fn: Callable, *, attempts: int = 5,
+               backoff: Optional[Backoff] = None,
+               retry_on: Sequence[type] = (OSError,),
+               what: str = "call",
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Optional[Callable[[float], None]] = None):
+    """Call ``fn()`` under a bounded retry budget; return its result.
+
+    Exceptions matching ``retry_on`` consume an attempt and back off;
+    anything else propagates immediately (a config error is not transient).
+    After ``attempts`` failures raises :class:`RetryExhausted` chained to
+    the last error — the guaranteed-terminal, guaranteed-loud exit.
+    ``on_retry(attempt, exc)`` observes each failure before the sleep.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if sleep is None:      # bound late so tests can monkeypatch time.sleep
+        sleep = time.sleep
+    backoff = backoff or Backoff()
+    retry_on = tuple(retry_on)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as exc:        # noqa: PERF203 (the loop IS the policy)
+            last = exc
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            if attempt + 1 < attempts:
+                d = backoff.delay_s(attempt)
+                log.warning("%s: attempt %d/%d failed (%s: %s); retrying "
+                            "in %.2fs", what, attempt + 1, attempts,
+                            type(exc).__name__, exc, d)
+                sleep(d)
+    raise RetryExhausted(what, attempts, last) from last

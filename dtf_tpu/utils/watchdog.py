@@ -32,11 +32,31 @@ import time
 from typing import Callable, Optional
 
 
+def dump_all_stacks(file=None) -> None:
+    """Write every thread's Python stack to ``file`` (default stderr) —
+    the post-mortem that makes a tripped watchdog diagnosable (WHERE was
+    the main thread wedged: a collective, the data loader, a lock?)
+    instead of just fatal.  Must never raise: it runs on the kill path."""
+    import faulthandler
+    try:
+        faulthandler.dump_traceback(all_threads=True,
+                                    file=file if file is not None
+                                    else sys.stderr)
+    except Exception as exc:        # no diagnosis is still better than
+        try:                        # dying without the loud exit below
+            print(f"[dtf_tpu] WATCHDOG: stack dump failed: {exc}",
+                  file=sys.stderr, flush=True)
+        except Exception:
+            pass
+
+
 def _default_on_hang(what: str, timeout_s: float) -> None:
     print(f"[dtf_tpu] WATCHDOG: no {what} progress in {timeout_s:g}s — "
           f"failing fast (the reference would hang forever here, "
           f"tf_distributed.py:96). Restart resumes from the last "
-          f"checkpoint.", file=sys.stderr, flush=True)
+          f"checkpoint. All-thread stacks follow:", file=sys.stderr,
+          flush=True)
+    dump_all_stacks()
     # os._exit, not sys.exit: the main thread is wedged (that's the point);
     # only a hard exit gets the process out of a stuck collective.
     os._exit(70)   # EX_SOFTWARE

@@ -73,6 +73,23 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
     batch_count = max(train.num_examples // global_batch, 1)
     epochs = -(-budget // batch_count)          # ceil: enough epochs for all
 
+    if train_cfg.chaos:
+        # Benchmarks accept --chaos too (the Trainer injects the plan);
+        # flag it loudly so a chaos run's numbers are never mistaken for a
+        # clean measurement.
+        logger.print(f"[dtf_tpu] CHAOS plan active ({train_cfg.chaos}): "
+                     f"timings/MFU below include injected faults")
+    if train_cfg.max_restarts > 0:
+        # An accepted-but-ignored flag would let the user believe the job
+        # is supervised when it is not.  Benchmark runs are single-attempt
+        # by design (restart-resume would corrupt the timing): the outer
+        # scheduler owns restarts here (run with --resume).
+        logger.print(
+            "[dtf_tpu] WARNING: --max_restarts is not supervised in "
+            "benchmark workloads (single attempt; timings would span "
+            "restarts) — use the mnist workload or "
+            "resilience.run_supervised, or rely on the job scheduler + "
+            "--resume")
     trainer = Trainer(cluster, model, opt, train_cfg, logger=logger)
 
     # Warmup (fresh runs only — a --resume continuation is already

@@ -53,8 +53,18 @@ def main(argv=None) -> int:
         opt = optim.get(train_cfg.optimizer)(lr)
     else:
         opt = optim.momentum(lr, beta=ns.momentum)
-    trainer = Trainer(cluster, model, opt, train_cfg)
-    trainer.fit(splits)
+    if train_cfg.max_restarts > 0:
+        # Self-healing mode: resilience.run_supervised_fit owns the
+        # shared-plan / fresh-trainer-per-attempt / resume mechanics.
+        from dtf_tpu.resilience import run_supervised_fit
+        run_supervised_fit(
+            lambda cfg, plan: Trainer(cluster, model, opt, cfg, chaos=plan),
+            lambda: load_cifar10(ns.data_dir, seed=train_cfg.seed),
+            train_cfg, max_restarts=train_cfg.max_restarts,
+            chaos=train_cfg.chaos, initial_splits=splits)
+    else:
+        trainer = Trainer(cluster, model, opt, train_cfg)
+        trainer.fit(splits)
     if cluster.is_coordinator:
         print("done")
     return 0

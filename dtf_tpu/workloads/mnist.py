@@ -58,9 +58,14 @@ def main(argv=None) -> int:
     # The native prefetcher needs the trainer's GLOBAL batch size (fixed
     # shapes): per_device_batch scales by the device count.
     global_batch = global_batch_size(cluster, train_cfg)
+    # Supervised mode loads a FRESH dataset per attempt inside fit_once;
+    # this load then only sizes total_steps, so don't spin up a C++
+    # prefetcher that would never be consumed.
+    supervised = train_cfg.max_restarts > 0
     splits = load_mnist(
         ns.data_dir, seed=train_cfg.seed,
-        native_train_batch=global_batch if ns.native_loader else None)
+        native_train_batch=(global_batch if ns.native_loader
+                            and not supervised else None))
     if splits.synthetic and cluster.is_coordinator:
         print("[dtf_tpu] MNIST_data/ not found; using deterministic "
               "synthetic data (zero-egress environment)")
@@ -71,9 +76,30 @@ def main(argv=None) -> int:
     # --optimizer overrides the reference's SGD (tf_distributed.py:73).
     opt = (optim.get(train_cfg.optimizer)(lr) if ns.optimizer
            else optim.sgd(lr))
-    trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode,
-                      grad_compression=ns.grad_compression)
-    result = trainer.fit(splits)
+
+    if supervised:
+        # Self-healing mode: crashes (incl. TrainingDiverged) and SIGTERM
+        # preemptions restore the last checkpoint and go again, under a
+        # bounded restart budget (resilience.run_supervised_fit owns the
+        # shared-plan / fresh-trainer-per-attempt mechanics).
+        from dtf_tpu.resilience import run_supervised_fit
+        result = run_supervised_fit(
+            lambda cfg, plan: Trainer(
+                cluster, model, opt, cfg, mode=ns.mode,
+                grad_compression=ns.grad_compression, chaos=plan),
+            lambda: load_mnist(
+                ns.data_dir, seed=train_cfg.seed,
+                native_train_batch=(global_batch if ns.native_loader
+                                    else None)),
+            train_cfg, max_restarts=train_cfg.max_restarts,
+            chaos=train_cfg.chaos,
+            # The sizing load above skipped the native prefetcher, so it
+            # can seed attempt 0 only on the pure-Python path.
+            initial_splits=None if ns.native_loader else splits)
+    else:
+        trainer = Trainer(cluster, model, opt, train_cfg, mode=ns.mode,
+                          grad_compression=ns.grad_compression)
+        result = trainer.fit(splits)
     if cluster.is_coordinator:
         print("done")   # tf_distributed.py:131
     return 0

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Full chaos / self-healing matrix (DESIGN.md §5, "Failure model & recovery").
+#
+# Tier-1 already runs the fast chaos unit+integration tests (marker `chaos`,
+# none marked `slow`); this script is the exhaustive pass: every chaos-marked
+# test INCLUDING slow ones, plus CLI-level injection runs of the mnist
+# workload that exercise the spec parser, the supervisor and the watchdog
+# through the real entry point.
+#
+# Usage: scripts/run_chaos_suite.sh [extra pytest args]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+fail=0
+
+echo "== chaos-marked tests (including slow) =="
+# A trailing -m overrides pytest.ini's default '-m "not slow"'.
+python -m pytest tests/ -q -p no:cacheprovider -m chaos "$@" || fail=1
+
+logdir=$(mktemp -d)
+echo "== CLI: supervised self-healing run (nan_grad + sigterm + corrupt) =="
+# 12800 synthetic examples / batch 512 = 25 steps/epoch; SIGTERM at step 12
+# preempts attempt 1, the corrupted latest checkpoint forces the restore to
+# fall back, attempt 2 completes -> exit 0 and the reference's final "done".
+python -m dtf_tpu.workloads.mnist \
+    --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+    --logdir "$logdir/heal" --checkpoint_every 5 --max_restarts 2 \
+    --chaos "nan_grad@4,sigterm@12,corrupt_ckpt@latest,loader_error@2" \
+    | tee "$logdir/heal.log"
+grep -q "^done$" "$logdir/heal.log" || { echo "FAIL: supervised run did not complete"; fail=1; }
+
+echo "== CLI: stall trips the watchdog (exit 70 + all-thread stacks) =="
+python -m dtf_tpu.workloads.mnist \
+    --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+    --logdir "$logdir/hang" --hang_timeout_s 2 \
+    --chaos "stall@6:30s" 2> "$logdir/hang.err"
+rc=$?
+if [ "$rc" -ne 70 ]; then
+    echo "FAIL: expected watchdog exit 70, got rc=$rc"; fail=1
+fi
+grep -q "WATCHDOG" "$logdir/hang.err" || { echo "FAIL: no watchdog message"; fail=1; }
+grep -Eq "Thread 0x|Current thread" "$logdir/hang.err" \
+    || { echo "FAIL: no thread stacks in watchdog dump"; fail=1; }
+
+echo "== CLI: diverged-without-checkpoint fails fast (nonzero exit) =="
+if python -m dtf_tpu.workloads.mnist \
+    --epochs 1 --batch_size 512 --init fan_in --log_frequency 1 \
+    --logdir "$logdir/div" --bad_step_limit 2 \
+    --chaos "nan_grad@3,nan_grad@4" 2> "$logdir/div.err"; then
+    echo "FAIL: persistent NaNs should not exit 0"; fail=1
+fi
+grep -q "TrainingDiverged" "$logdir/div.err" || { echo "FAIL: no TrainingDiverged"; fail=1; }
+
+rm -rf "$logdir"
+if [ "$fail" -ne 0 ]; then
+    echo "CHAOS SUITE: FAIL"
+    exit 1
+fi
+echo "CHAOS SUITE: PASS"

@@ -1,0 +1,59 @@
+"""2-process restore_robust driver (spawned by tests/test_multiprocess.py).
+
+Exercises the multi-host branch of CheckpointManager.restore_robust — the
+coordinator-broadcast step pick and the symmetric per-attempt agreement —
+against a corrupted latest checkpoint on a shared directory: both processes
+must fall back to the SAME older step (a divergent choice would deadlock
+the collective restore; this driver would then time out in the rig).
+
+Usage: _mp_restore_robust.py <task_index> <port> <ckpt_dir>
+"""
+
+import sys
+
+
+def main(task: int, port: int, ckpt_dir: str) -> None:
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig
+
+    cluster = bootstrap(ClusterConfig(
+        task_index=task, coordinator_address=f"localhost:{port}",
+        num_processes=2, mesh="data=-1"))
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from dtf_tpu import optim
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.train.checkpoint import CheckpointManager
+    from dtf_tpu.train.trainer import init_state
+
+    mesh = cluster.mesh
+    model = MnistMLP(init_scale="fan_in")
+    opt = optim.sgd(0.1)
+    s10 = init_state(model, opt, seed=1, mesh=mesh, guard=True)
+    s20 = init_state(model, opt, seed=2, mesh=mesh, guard=True)
+
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    mgr.save(10, s10, force=True)
+    mgr.save(20, s20, force=True)
+    mgr.wait()
+
+    if jax.process_index() == 0:
+        from dtf_tpu.resilience.chaos import corrupt_tree
+        corrupt_tree(mgr.step_dir(20), seed=3)
+    multihost_utils.sync_global_devices("corrupted-latest")
+
+    template = init_state(model, opt, seed=3, mesh=mesh, guard=True)
+    restored, step = mgr.restore_robust(template)
+    assert step == 10, f"expected fallback to step 10, got {step}"
+    got = np.asarray(restored["params"]["l1"]["w"].addressable_data(0))
+    want = np.asarray(s10["params"]["l1"]["w"].addressable_data(0))
+    assert np.array_equal(got, want), "restored values != step-10 values"
+    mgr.close()
+    print(f"RESTORE_ROBUST_MP_OK step {step}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])

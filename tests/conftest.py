@@ -25,6 +25,8 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running integration tests")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / self-healing resilience tests")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,428 @@
+"""Chaos harness + self-healing training (resilience/, DESIGN.md §5).
+
+Every fault class the plan can inject is exercised against the REAL code
+path it targets: NaN gradients against the compiled non-finite guard,
+loader errors against the data-path retry, corruption against the manifest
+checksums + restore_robust fallback, SIGTERM against the preemption save,
+and whole-fit crashes against the restart supervisor — culminating in the
+integration test: a faulted supervised run must converge to the fault-free
+run's final loss."""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from dtf_tpu import optim
+from dtf_tpu.cluster import Cluster
+from dtf_tpu.config import ClusterConfig, TrainConfig
+from dtf_tpu.data.datasets import Dataset, DataSplits
+from dtf_tpu.models.mlp import MnistMLP
+from dtf_tpu.resilience.chaos import (
+    ChaosLoaderError, FaultPlan, corrupt_tree,
+)
+from dtf_tpu.resilience.supervisor import SupervisorGaveUp, run_supervised
+from dtf_tpu.train.checkpoint import CheckpointManager
+from dtf_tpu.train.trainer import (
+    Trainer, TrainingDiverged, init_state, make_train_step, put_global_batch,
+)
+from dtf_tpu.utils.retry import Backoff
+
+pytestmark = pytest.mark.chaos
+
+
+def make_cluster(mesh):
+    return Cluster(config=ClusterConfig(), mesh=mesh)
+
+
+def tiny_splits(n=512, seed=0):
+    """Small, learnable classification data (the full synthetic MNIST is
+    needlessly big for fault-path tests)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    protos = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    x = (protos[y] + rng.normal(0, 2.0, (n, 784))).astype(np.float32)
+    return DataSplits(train=Dataset(x, np.eye(10, dtype=np.float32)[y],
+                                    seed=1), test=None)
+
+
+class TestFaultPlanParse:
+    def test_full_grammar(self):
+        plan = FaultPlan.parse("nan_grad@17, corrupt_ckpt@latest,"
+                               "sigterm@40,stall@25:3s,loader_error@9,"
+                               "corrupt_ckpt@30,seed=7")
+        kinds = [(f.kind, f.step) for f in plan.faults]
+        assert kinds == [("nan_grad", 17), ("corrupt_ckpt", None),
+                         ("sigterm", 40), ("stall", 25),
+                         ("loader_error", 9), ("corrupt_ckpt", 30)]
+        assert plan.seed == 7
+        assert [f for f in plan.faults if f.kind == "stall"][0].duration_s == 3.0
+
+    def test_bad_specs_fail_loudly(self):
+        for bad in ("frobnicate@3", "nan_grad@latest", "stall@5",
+                    "nan_grad", "nan_grad@@3"):
+            with pytest.raises(ValueError):
+                FaultPlan.parse(bad)
+
+    def test_each_fault_fires_once(self):
+        sleeps, kills = [], []
+        plan = FaultPlan.parse("stall@3:0.5s,sigterm@3",
+                               sleep=sleeps.append,
+                               kill=lambda pid, sig: kills.append(sig))
+        for _ in range(3):
+            plan.maybe_step_faults(3)
+        assert sleeps == [0.5] and kills == [signal.SIGTERM]
+        assert plan.pending() == []
+
+    def test_loader_error_is_oserror(self):
+        plan = FaultPlan.parse("loader_error@2")
+        plan.maybe_loader_error(1)                    # wrong step: no-op
+        with pytest.raises(ChaosLoaderError):
+            plan.maybe_loader_error(2)
+        plan.maybe_loader_error(2)                    # fired once
+
+    def test_poison_batch(self):
+        plan = FaultPlan.parse("nan_grad@5")
+        x = np.ones((4, 8), np.float32)
+        y = np.ones((4, 10), np.int32)
+        out = plan.maybe_poison_batch(4, (x, y))      # wrong step: untouched
+        assert np.isfinite(out[0]).all()
+        plan2 = FaultPlan.parse("nan_grad@5")
+        px, py = plan2.maybe_poison_batch(5, (x, y))
+        assert np.isnan(px).all()
+        assert np.array_equal(py, y)                  # int leaves untouched
+
+    def test_poison_int_only_batch_fails_loudly(self):
+        plan = FaultPlan.parse("nan_grad@0")
+        with pytest.raises(ValueError, match="no float leaf"):
+            plan.maybe_poison_batch(0, {"tokens": np.ones((2, 4), np.int32)})
+
+
+class TestNonFiniteGuard:
+    @pytest.mark.parametrize("mode", ["implicit", "explicit"])
+    def test_skip_semantics(self, mesh8, mode):
+        """A non-finite step must leave params/opt state bitwise untouched,
+        bump the counters, and keep the step counter advancing; the next
+        clean step trains normally and resets the streak."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.momentum(0.1)
+        state = init_state(model, opt, seed=1, mesh=mesh8, guard=True)
+        step = make_train_step(model.loss, opt, mesh8, mode=mode,
+                               donate=False, guard=True)
+        rng = np.random.default_rng(0)
+        x = rng.random((16, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[np.arange(16) % 10]
+        good = put_global_batch(mesh8, (x, y))
+        bad = put_global_batch(mesh8, (np.full_like(x, np.nan), y))
+
+        s1, m1 = step(state, good, jax.random.key(0))
+        assert (int(m1["nonfinite"]), int(m1["bad_streak"])) == (0, 0)
+        s2, m2 = step(s1, bad, jax.random.key(1))
+        assert (int(m2["nonfinite"]), int(m2["skipped_total"]),
+                int(m2["bad_streak"])) == (1, 1, 1)
+        assert int(s2["step"]) == 2                   # step still counts
+        for a, b in zip(jax.tree_util.tree_leaves(s1["params"]),
+                        jax.tree_util.tree_leaves(s2["params"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(s1["opt_state"]),
+                        jax.tree_util.tree_leaves(s2["opt_state"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        s3, m3 = step(s2, bad, jax.random.key(2))
+        assert int(m3["bad_streak"]) == 2             # consecutive grows
+        s4, m4 = step(s3, good, jax.random.key(3))
+        assert (int(m4["nonfinite"]), int(m4["bad_streak"]),
+                int(m4["skipped_total"])) == (0, 0, 2)
+        assert np.isfinite(float(m4["loss"]))
+        # the clean step actually updated
+        assert not np.array_equal(
+            np.asarray(s4["params"]["l1"]["w"]),
+            np.asarray(s3["params"]["l1"]["w"]))
+
+    def test_guarded_matches_unguarded_on_clean_data(self, mesh8):
+        """The guard must be a no-op on finite steps: same params."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        rng = np.random.default_rng(0)
+        x = rng.random((16, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[np.arange(16) % 10]
+        batch = put_global_batch(mesh8, (x, y))
+        out = {}
+        for guard in (False, True):
+            state = init_state(model, opt, seed=1, mesh=mesh8, guard=guard)
+            step = make_train_step(model.loss, opt, mesh8, donate=False,
+                                   guard=guard)
+            state, _ = step(state, batch, jax.random.key(0))
+            out[guard] = state["params"]
+        for a, b in zip(jax.tree_util.tree_leaves(out[False]),
+                        jax.tree_util.tree_leaves(out[True])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRestoreRobust:
+    def _states(self, mesh8):
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        return (init_state(model, opt, seed=1, mesh=mesh8, guard=True),
+                init_state(model, opt, seed=2, mesh=mesh8, guard=True),
+                init_state(model, opt, seed=3, mesh=mesh8, guard=True))
+
+    def test_falls_back_past_corrupt_latest(self, mesh8, tmp_path):
+        s10, s20, tmpl = self._states(mesh8)
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(10, s10, force=True)
+        mgr.save(20, s20, force=True)
+        mgr.wait()
+        ok, why = mgr.verify(20)
+        assert ok and why == "manifest ok"
+        corrupt_tree(mgr.step_dir(20), seed=3)
+        ok, why = mgr.verify(20)
+        assert not ok and "mismatch" in why
+        restored, step = mgr.restore_robust(tmpl)
+        assert step == 10
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["l1"]["w"]),
+            np.asarray(s10["params"]["l1"]["w"]))
+        mgr.close()
+
+    def test_fallback_without_manifest_via_restore_failure(self, mesh8,
+                                                           tmp_path):
+        """No manifest (crash before flush): the orbax-restore try/except
+        is the second line of defense."""
+        s10, s20, tmpl = self._states(mesh8)
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(10, s10, force=True)
+        mgr.save(20, s20, force=True)
+        mgr.wait()
+        os.remove(os.path.join(str(tmp_path), "manifests", "20.json"))
+        corrupt_tree(mgr.step_dir(20), seed=3)
+        ok, why = mgr.verify(20)
+        assert ok and "unverified" in why             # can't prove corruption
+        restored, step = mgr.restore_robust(tmpl)
+        assert step == 10                             # ...but restore catches it
+        mgr.close()
+
+    def test_all_corrupt_returns_template(self, mesh8, tmp_path):
+        s10, s20, tmpl = self._states(mesh8)
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(10, s10, force=True)
+        mgr.wait()
+        corrupt_tree(mgr.step_dir(10), seed=0)
+        restored, step = mgr.restore_robust(tmpl)
+        assert step is None and restored is tmpl
+        mgr.close()
+
+    def test_intact_but_mismatched_template_raises(self, mesh8, tmp_path):
+        """A checkpoint whose checksums verify is NOT corrupt: failing to
+        restore it means the caller's state template changed (model /
+        optimizer / guard schema) — that must raise, never silently
+        cold-start past a good trajectory."""
+        model = MnistMLP(init_scale="fan_in")
+        opt = optim.sgd(0.1)
+        saved = init_state(model, opt, seed=1, mesh=mesh8, guard=False)
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(10, saved, force=True)
+        mgr.wait()
+        # template with guard counters the save doesn't have
+        tmpl = init_state(model, opt, seed=2, mesh=mesh8, guard=True)
+        with pytest.raises(RuntimeError, match="template/schema mismatch"):
+            mgr.restore_robust(tmpl)
+        mgr.close()
+
+
+class TestTrainerSelfHealing:
+    def _cfg(self, tmp_path, **kw):
+        base = dict(batch_size=64, learning_rate=0.05, epochs=2,
+                    log_frequency=1, seed=1, logdir=str(tmp_path))
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_nan_step_skipped_and_counted(self, mesh8, tmp_path):
+        cfg = self._cfg(tmp_path, chaos="nan_grad@3")
+        t = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                    optim.sgd(0.05), cfg)
+        r = t.fit(tiny_splits(), epochs=2)            # 16 steps
+        assert r["skipped_steps"] == 1 and r["rollbacks"] == 0
+        assert np.isfinite(r["final_cost"])
+
+    def test_loader_error_retried_transparently(self, mesh8, tmp_path):
+        cfg = self._cfg(tmp_path, chaos="loader_error@2")
+        t = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                    optim.sgd(0.05), cfg)
+        r = t.fit(tiny_splits(), epochs=1)
+        assert r["steps"] == 8 and np.isfinite(r["final_cost"])
+        assert t._chaos.pending() == []               # it really fired
+
+    def test_consecutive_bad_steps_roll_back(self, mesh8, tmp_path):
+        cfg = self._cfg(tmp_path, chaos="nan_grad@4,nan_grad@5",
+                        bad_step_limit=2, max_rollbacks=1,
+                        checkpoint_every=2)
+        t = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                    optim.sgd(0.05), cfg)
+        r = t.fit(tiny_splits(n=256), epochs=3)       # 12 steps
+        t.ckpt.close()
+        assert r["skipped_steps"] == 2
+        assert r["rollbacks"] == 1
+        assert np.isfinite(r["final_cost"])
+
+    def test_resume_backfills_pre_guard_checkpoint(self, mesh8, tmp_path):
+        """A checkpoint saved with --no-nonfinite_guard (or before the
+        guard existed) lacks the counter leaves; resuming with the guard
+        on must backfill fresh zeros, not discard the trajectory."""
+        cfg0 = self._cfg(tmp_path, nonfinite_guard=False,
+                         checkpoint_every=4)
+        t0 = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                     optim.sgd(0.05), cfg0)
+        r0 = t0.fit(tiny_splits(n=256), epochs=2)     # 8 steps
+        t0.ckpt.close()
+        assert "skipped" not in t0.state
+
+        cfg1 = self._cfg(tmp_path, checkpoint_every=4, resume=True)
+        t1 = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                     optim.sgd(0.05), cfg1)
+        assert int(t1.state["step"]) == r0["steps"]   # resumed
+        assert int(t1.state["skipped"]) == 0          # backfilled zeros
+        r1 = t1.fit(tiny_splits(n=256), epochs=3)     # one more epoch
+        t1.ckpt.close()
+        assert r1["steps"] == 12 and np.isfinite(r1["final_cost"])
+
+    def test_persistent_nans_fail_fast_without_checkpoint(self, mesh8,
+                                                          tmp_path):
+        cfg = self._cfg(tmp_path, chaos="nan_grad@2,nan_grad@3",
+                        bad_step_limit=2)             # no checkpointing
+        t = Trainer(make_cluster(mesh8), MnistMLP(init_scale="fan_in"),
+                    optim.sgd(0.05), cfg)
+        with pytest.raises(TrainingDiverged, match="consecutive non-finite"):
+            t.fit(tiny_splits(n=256), epochs=2)
+
+
+class TestSupervisor:
+    def test_restarts_after_crashes_then_completes(self):
+        sleeps, calls = [], []
+
+        def fit_once(attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                raise RuntimeError(f"boom {attempt}")
+            return {"preempted": False, "steps": 7}
+
+        out = run_supervised(fit_once, max_restarts=3,
+                             backoff=Backoff(base_s=0.1, max_s=1.0,
+                                             jitter=0.0),
+                             sleep=sleeps.append)
+        assert out["steps"] == 7 and calls == [0, 1, 2]
+        assert sleeps == [0.1, 0.2]
+
+    def test_preemption_consumes_a_restart(self):
+        results = [{"preempted": True}, {"preempted": False, "steps": 3}]
+        out = run_supervised(lambda a: results[a], max_restarts=1,
+                             sleep=lambda s: None)
+        assert out["steps"] == 3
+
+    def test_gives_up_loudly(self):
+        def fit_once(attempt):
+            raise RuntimeError("persistent")
+
+        with pytest.raises(SupervisorGaveUp, match="2 restart") as ei:
+            run_supervised(fit_once, max_restarts=2, sleep=lambda s: None)
+        assert isinstance(ei.value.__cause__, RuntimeError)
+        assert len(ei.value.history) == 3              # initial + 2 restarts
+
+    def test_keyboard_interrupt_is_never_swallowed(self):
+        def fit_once(attempt):
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            run_supervised(fit_once, max_restarts=5, sleep=lambda s: None)
+
+    def test_no_restart_errors_are_terminal(self):
+        """Deterministic failures (checkpoint schema mismatch) replay
+        identically — the supervisor must not burn restarts on them."""
+        from dtf_tpu.train.checkpoint import CheckpointMismatchError
+        calls = []
+
+        def fit_once(attempt):
+            calls.append(attempt)
+            raise CheckpointMismatchError("template mismatch")
+
+        with pytest.raises(CheckpointMismatchError):
+            run_supervised(fit_once, max_restarts=5, sleep=lambda s: None)
+        assert calls == [0]                            # no retries
+
+
+class TestClusterInitRetry:
+    def test_retries_slow_coordinator(self, monkeypatch):
+        import dtf_tpu.cluster as cluster_mod
+        calls = {"n": 0}
+
+        def fake_init(**kw):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("coordination service not ready")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(cluster_mod, "_INITIALIZED", False)
+        monkeypatch.setattr("dtf_tpu.utils.retry.time.sleep", lambda s: None)
+        cluster = cluster_mod.bootstrap(ClusterConfig(
+            num_processes=2, coordinator_address="127.0.0.1:9"))
+        assert calls["n"] == 3 and cluster.mesh.size == 8
+
+    def test_config_error_stays_terminal(self, monkeypatch):
+        import dtf_tpu.cluster as cluster_mod
+
+        def fake_init(**kw):
+            raise ValueError("num_processes mismatch")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(cluster_mod, "_INITIALIZED", False)
+        with pytest.raises(ValueError, match="mismatch"):
+            cluster_mod.bootstrap(ClusterConfig(
+                num_processes=2, coordinator_address="127.0.0.1:9"))
+
+
+class TestChaosIntegration:
+    def test_self_healing_run_matches_fault_free(self, mesh8, tmp_path):
+        """THE acceptance scenario: nan_grad + sigterm + corrupt-latest-
+        checkpoint, driven by the supervisor.  The run must self-heal —
+        skipped step counted, restore falls back past the corrupt step,
+        supervisor resumes after the kill — and land at the fault-free
+        run's final loss within tolerance (trajectories differ only by
+        the one skipped update)."""
+        cluster = make_cluster(mesh8)
+
+        def run(logdir, plan):
+            cfg0 = TrainConfig(batch_size=64, learning_rate=0.05, epochs=2,
+                               log_frequency=4, seed=1, logdir=logdir,
+                               checkpoint_every=6)
+
+            def fit_once(attempt):
+                import dataclasses
+                cfg = dataclasses.replace(cfg0, resume=attempt > 0)
+                t = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                            optim.sgd(0.05), cfg, chaos=plan)
+                try:
+                    return t.fit(tiny_splits(n=1024), epochs=2)  # 32 steps
+                finally:
+                    if t.ckpt is not None:
+                        t.ckpt.close()
+
+            return run_supervised(fit_once, max_restarts=2,
+                                  backoff=Backoff(base_s=0.0, jitter=0.0),
+                                  sleep=lambda s: None)
+
+        plan = FaultPlan.parse("nan_grad@9,sigterm@20,corrupt_ckpt@latest")
+        faulted = run(str(tmp_path / "faulted"), plan)
+        baseline = run(str(tmp_path / "baseline"), None)
+
+        assert plan.pending() == []                   # every fault fired
+        assert baseline["preempted"] is False
+        assert faulted["preempted"] is False          # healed, not killed
+        assert faulted["steps"] == baseline["steps"] == 32
+        assert faulted["skipped_steps"] == 1          # the nan_grad step
+        assert baseline["skipped_steps"] == 0
+        assert np.isfinite(faulted["final_cost"])
+        # Same data/rng stream, one update skipped: final loss must agree
+        # to a loose tolerance.
+        assert faulted["final_cost"] == pytest.approx(
+            baseline["final_cost"], rel=0.25, abs=0.15)

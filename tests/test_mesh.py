@@ -106,7 +106,8 @@ class TestCollectives:
         def f(x):
             return col.all_reduce_mean(x, "data")
 
-        g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P())
+        g = col.shard_map_fn(f, mesh=mesh8, in_specs=P("data"),
+                             out_specs=P())
         x = jnp.arange(8.0)
         np.testing.assert_allclose(g(x), 3.5)
 
@@ -116,7 +117,8 @@ class TestCollectives:
         def f(x):
             return col.ring_permute(x, "data")
 
-        g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+        g = col.shard_map_fn(f, mesh=mesh8, in_specs=P("data"),
+                             out_specs=P("data"))
         out = g(jnp.arange(8.0))
         np.testing.assert_allclose(out, jnp.roll(jnp.arange(8.0), 1))
 
@@ -126,7 +128,8 @@ class TestCollectives:
         def f(x):
             return col.reduce_scatter(x, "data", scatter_axis=0)
 
-        g = jax.shard_map(f, mesh=mesh8, in_specs=P(None), out_specs=P("data"))
+        g = col.shard_map_fn(f, mesh=mesh8, in_specs=P(None),
+                             out_specs=P("data"))
         x = jnp.ones((8,))
         np.testing.assert_allclose(g(x), 8.0 * jnp.ones((8,)))
 

@@ -11,16 +11,18 @@ import pytest
 
 from jax.sharding import PartitionSpec as P
 
-from dtf_tpu.parallel.collectives import quantized_ring_all_reduce_mean
+from dtf_tpu.parallel.collectives import (
+    quantized_ring_all_reduce_mean, shard_map_fn,
+)
 from dtf_tpu.parallel.mesh import make_mesh
 
 
 def run_ring(mesh, x_global, axis="data"):
     """x_global: (n_dev, ...) — row d is device d's local value.  Returns
     the per-device all-reduce results stacked the same way."""
-    fn = jax.shard_map(
+    fn = shard_map_fn(
         functools.partial(quantized_ring_all_reduce_mean, axis=axis),
-        mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False)
+        mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     return np.asarray(fn(x_global))
 
 

@@ -1,0 +1,92 @@
+"""utils/retry.py: bounded retry + exponential backoff with seeded jitter
+(the shared policy behind cluster bootstrap, the data path and the restart
+supervisor)."""
+
+import pytest
+
+from dtf_tpu.utils.retry import Backoff, RetryExhausted, retry_call
+
+pytestmark = pytest.mark.chaos
+
+
+class FlakyThenOk:
+    """Raises ``exc`` for the first ``failures`` calls, then returns 42."""
+
+    def __init__(self, failures, exc=OSError("transient")):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc
+        return 42
+
+
+class TestBackoff:
+    def test_exponential_capped(self):
+        b = Backoff(base_s=1.0, max_s=4.0, factor=2.0, jitter=0.0)
+        assert [b.delay_s(k) for k in range(4)] == [1.0, 2.0, 4.0, 4.0]
+
+    def test_jitter_bounded_and_seeded(self):
+        a = Backoff(base_s=1.0, max_s=64.0, jitter=0.25, seed=7)
+        b = Backoff(base_s=1.0, max_s=64.0, jitter=0.25, seed=7)
+        da = [a.delay_s(k) for k in range(6)]
+        db = [b.delay_s(k) for k in range(6)]
+        assert da == db                     # same seed -> same delays
+        for k, d in enumerate(da):
+            nominal = min(2.0 ** k, 64.0)
+            assert 0.75 * nominal <= d <= 1.25 * nominal
+
+    def test_rejects_bad_params(self):
+        with pytest.raises(ValueError, match="jitter"):
+            Backoff(jitter=1.5)
+        with pytest.raises(ValueError, match=">= 0"):
+            Backoff(base_s=-1.0)
+
+
+class TestRetryCall:
+    def test_succeeds_after_transients(self):
+        sleeps = []
+        fn = FlakyThenOk(2)
+        out = retry_call(fn, attempts=5,
+                         backoff=Backoff(base_s=0.1, max_s=1.0, jitter=0.0),
+                         sleep=sleeps.append)
+        assert out == 42 and fn.calls == 3
+        assert sleeps == [0.1, 0.2]        # exact schedule, no real sleeping
+
+    def test_exhaustion_is_loud_and_terminal(self):
+        fn = FlakyThenOk(99)
+        with pytest.raises(RetryExhausted, match="data fetch.*3 attempt"):
+            retry_call(fn, attempts=3, what="data fetch",
+                       backoff=Backoff(base_s=0, jitter=0.0),
+                       sleep=lambda s: None)
+        assert fn.calls == 3               # bounded: no silent infinite loop
+        try:
+            retry_call(FlakyThenOk(99), attempts=2,
+                       backoff=Backoff(base_s=0, jitter=0.0),
+                       sleep=lambda s: None)
+        except RetryExhausted as e:
+            assert isinstance(e.__cause__, OSError)   # root cause chained
+            assert e.attempts == 2
+
+    def test_non_matching_exception_is_terminal(self):
+        """Config errors must not burn the retry budget."""
+        fn = FlakyThenOk(1, exc=ValueError("bad config"))
+        with pytest.raises(ValueError, match="bad config"):
+            retry_call(fn, attempts=5, retry_on=(OSError,),
+                       sleep=lambda s: None)
+        assert fn.calls == 1
+
+    def test_on_retry_observes_each_failure(self):
+        seen = []
+        retry_call(FlakyThenOk(2), attempts=3,
+                   backoff=Backoff(base_s=0, jitter=0.0),
+                   on_retry=lambda k, e: seen.append((k, type(e).__name__)),
+                   sleep=lambda s: None)
+        assert seen == [(0, "OSError"), (1, "OSError")]
+
+    def test_rejects_zero_attempts(self):
+        with pytest.raises(ValueError, match="attempts"):
+            retry_call(lambda: 1, attempts=0)
