@@ -65,6 +65,37 @@ class Cluster:
     def num_devices(self) -> int:
         return self.mesh.size
 
+    def start_health(self, print_fn=None):
+        """Arm the multi-host failure domain (resilience/health.py): a
+        heartbeat + liveness-monitor daemon thread, per the cluster
+        config's ``hb_*`` knobs.  Returns the started
+        :class:`~dtf_tpu.resilience.health.HealthMonitor`, or None when
+        disabled (``hb_interval_s <= 0``) or single-process (there are no
+        peers whose death could wedge a collective).  The caller owns
+        ``close()`` — the trainer arms it for the duration of ``fit``."""
+        cfg = self.config
+        if cfg.hb_interval_s <= 0 or jax.process_count() <= 1:
+            return None
+        if not cfg.health_dir:
+            # ClusterConfig.__post_init__ already rejects this pairing;
+            # this guards Cluster objects built with a mutated config.
+            raise ValueError(
+                "--hb_interval_s > 0 needs --health_dir (shared path or "
+                "tcp://host:port)")
+        from dtf_tpu.resilience.health import HealthMonitor, make_transport
+        transport = make_transport(cfg.health_dir, jax.process_index(),
+                                   self.is_coordinator)
+        monitor = HealthMonitor(
+            transport, jax.process_index(), jax.process_count(),
+            interval_s=cfg.hb_interval_s, miss_budget=cfg.hb_miss_budget,
+            boot_grace_s=cfg.hb_boot_grace_s,
+            is_coordinator=self.is_coordinator, print_fn=print_fn)
+        monitor.start()
+        log.info("health monitor armed: interval %gs, miss budget %d, "
+                 "rendezvous %s", cfg.hb_interval_s, cfg.hb_miss_budget,
+                 cfg.health_dir)
+        return monitor
+
 
 def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
     """Initialize the process and build the global mesh.
@@ -146,7 +177,20 @@ def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
                  jax.process_index(), jax.process_count(),
                  config.coordinator_address)
 
-    mesh = make_mesh(MeshSpec.parse(config.mesh))
+    spec = MeshSpec.parse(config.mesh)
+    if config.elastic:
+        # Elastic relaunch on a shrunken host set: a fixed mesh spec sized
+        # for the ORIGINAL cluster no longer matches the surviving device
+        # count — resize the data axis to fit (model axes stay fixed).
+        from dtf_tpu.parallel.mesh import shrink_to_devices
+        shrunk = shrink_to_devices(spec, len(jax.devices()))
+        if shrunk.sizes != spec.sizes:
+            log.warning("elastic: mesh %s re-fit to %d device(s) as %s",
+                        config.mesh, len(jax.devices()),
+                        ",".join(f"{n}={s}" for n, s in
+                                 zip(shrunk.names, shrunk.sizes)))
+        spec = shrunk
+    mesh = make_mesh(spec)
     if jax.process_index() == 0:
         log.info("mesh: axes=%s shape=%s over %d %s device(s)",
                  mesh.axis_names, dict(mesh.shape), mesh.size,

@@ -54,12 +54,36 @@ class ClusterConfig:
     # usable from the CLI: --simulated_devices 8 --mesh data=2,seq=4).
     # Implies platform=cpu.  Must be set before the first device query.
     simulated_devices: int = 0
+    # Multi-host failure domain (resilience/health.py).  hb_interval_s > 0
+    # arms per-process heartbeats + the poison-pill coordinated abort: a
+    # peer whose beats stop for hb_miss_budget intervals gets the healthy
+    # hosts OUT of the wedged collective (exit 71) instead of hanging
+    # forever.  health_dir is the rendezvous: a SHARED directory
+    # (GCS/NFS), or "tcp://host:port" to run the coordinator-hosted beat
+    # service when there is no shared filesystem.  hb_boot_grace_s covers
+    # startup skew (a peer that has never beaten is only aged after it).
+    health_dir: Optional[str] = None
+    hb_interval_s: float = 0.0        # 0 disables the health subsystem
+    hb_miss_budget: int = 3
+    hb_boot_grace_s: float = 30.0
+    # Elastic restart: when the fixed --mesh no longer matches the device
+    # count (a relaunch on fewer surviving hosts), shrink the data axis to
+    # fit instead of failing (parallel/mesh.shrink_to_devices).
+    elastic: bool = False
 
     def __post_init__(self):
         if self.job_name not in ("ps", "worker"):
             raise ValueError(
                 f"job_name must be 'ps' or 'worker' (reference CLI contract, "
                 f"tf_distributed.py:14), got {self.job_name!r}")
+        if self.hb_interval_s > 0 and not self.health_dir:
+            # Validate here, not first at fit time: a multi-host job must
+            # not burn bootstrap + compile on every host before learning
+            # its heartbeat config is incomplete.
+            raise ValueError(
+                "--hb_interval_s > 0 needs --health_dir: a SHARED "
+                "directory every host can reach, or tcp://host:port for "
+                "the coordinator-hosted beat service")
         if self.job_name == "ps":
             log.warning(
                 "--job_name=ps: the parameter-server role does not exist in "
@@ -125,6 +149,16 @@ class TrainConfig:
     # step boundary and exit cleanly.  Active whenever checkpointing is
     # configured (checkpoint_every > 0 or resume).
     preemption_save: bool = True
+    # Also treat SIGINT (ctrl-C, some schedulers' first nudge) as a
+    # preemption: checkpoint at the next boundary and exit 0 instead of
+    # dying with KeyboardInterrupt mid-step.
+    preempt_sigint: bool = False
+    # Straggler detection (resilience/health.flag_stragglers): at every
+    # logging sync point, allgather each host's avg step time and flag
+    # hosts slower than median * straggler_factor (metrics
+    # health/step_ms_p<k> and health/stragglers).  <= 1 disables; 1.5-2.0
+    # is a sane production range.  Multi-process only.
+    straggler_factor: float = 0.0
     dtype: str = "float32"
     # Observability (SURVEY §5.1/§5.2; the reference had wall-clock prints
     # only).  profile_dir: capture an XLA trace of steps

@@ -80,6 +80,39 @@ class MeshSpec:
         return MeshSpec(self.names, tuple(sizes))
 
 
+def shrink_to_devices(spec: "MeshSpec | str", n_devices: int) -> MeshSpec:
+    """Elastic restart: re-fit a mesh request onto a changed device count
+    by re-sizing the ``data`` axis, keeping every model axis (fsdp/tensor/
+    seq/expert/pipe) fixed.
+
+    Data parallelism is the one axis whose size is a pure throughput
+    knob — model math is invariant to it — so it absorbs lost (or
+    regained) hardware: a relaunch on N-1 hosts shrinks ``data`` and the
+    checkpoint reshards onto the smaller mesh through the restore
+    template.  A spec with a ``-1`` axis is already elastic and returns
+    unchanged (``resolve`` re-infers it).  Model axes that no longer
+    divide the device count are a real topology loss (e.g. a pipeline
+    stage's hosts died) — that raises; no silent degradation of the
+    parallelism strategy."""
+    if isinstance(spec, str):
+        spec = MeshSpec.parse(spec)
+    if -1 in spec.sizes:
+        return spec
+    if DATA not in spec.names:
+        raise ValueError(
+            f"cannot shrink {spec} onto {n_devices} device(s): no data "
+            f"axis to resize (model axes are fixed topology)")
+    other = math.prod(s for n, s in zip(spec.names, spec.sizes)
+                      if n != DATA)
+    if n_devices % other or n_devices < other:
+        raise ValueError(
+            f"cannot shrink {spec} onto {n_devices} device(s): model axes "
+            f"need a multiple of {other}")
+    sizes = tuple(n_devices // other if n == DATA else s
+                  for n, s in zip(spec.names, spec.sizes))
+    return MeshSpec(spec.names, sizes)
+
+
 def make_mesh(spec: "MeshSpec | str",
               devices: Optional[Sequence[jax.Device]] = None,
               explicit: bool = False) -> Mesh:

@@ -9,9 +9,18 @@ end-to-end:
 
 * :mod:`dtf_tpu.resilience.chaos` — a seeded, spec-driven fault plan
   (non-finite gradients, loader errors, stalls, checkpoint corruption,
-  simulated preemption) injected at exact steps, from tests or the CLI;
+  simulated preemption, plus host-level faults: abrupt host death,
+  persistent stragglers, network partitions, repeating ``@every`` faults)
+  injected at exact steps, from tests or the CLI;
+* :mod:`dtf_tpu.resilience.health` — the multi-host failure domain:
+  per-process heartbeats (shared-dir or coordinator-TCP transport),
+  coordinator-published cluster-health snapshots, straggler flagging, and
+  the poison-pill coordinated abort (exit 71/72) that frees healthy hosts
+  from a dead peer's collective instead of hanging in it;
 * :mod:`dtf_tpu.resilience.supervisor` — bounded-restart supervision of a
-  whole fit, resuming from the last good checkpoint between attempts.
+  whole fit with exit-cause classification (deterministic failures fail
+  fast instead of burning restarts), plus ``run_elastic_hosts``: relaunch
+  a multi-host job on the surviving host set with a shrunken mesh.
 
 The in-step non-finite guard and rollback policy live in the trainer
 (``train/trainer.py``); checkpoint checksums and the corruption-tolerant
@@ -20,6 +29,11 @@ failure-model walkthrough.
 """
 
 from dtf_tpu.resilience.chaos import ChaosLoaderError, FaultPlan  # noqa: F401
+from dtf_tpu.resilience.health import (  # noqa: F401
+    EXIT_PEER_LOST, EXIT_SELF_ISOLATED, HealthMonitor, flag_stragglers,
+    make_transport,
+)
 from dtf_tpu.resilience.supervisor import (  # noqa: F401
-    SupervisorGaveUp, run_supervised, run_supervised_fit,
+    SupervisorGaveUp, classify_exit, run_elastic_hosts, run_supervised,
+    run_supervised_fit,
 )

@@ -2,9 +2,10 @@
 compact spec string.
 
 Chaos testing only earns its keep if a failing run is *replayable*: every
-fault fires at an exact global step, exactly once, and byte-level corruption
-draws from a seeded rng — so ``--chaos "nan_grad@17,sigterm@40"`` produces
-the same failure sequence on every run.  Spec grammar (comma-separated)::
+fault fires at an exact global step, exactly once (or on an exact period),
+and byte-level corruption draws from a seeded rng — so ``--chaos
+"nan_grad@17,sigterm@40"`` produces the same failure sequence on every
+run.  Spec grammar (comma-separated)::
 
     nan_grad@S           poison the step-S batch's float leaves with NaN
                          (drives the train step's non-finite guard)
@@ -18,13 +19,31 @@ the same failure sequence on every run.  Spec grammar (comma-separated)::
                          over its files (drives restore_robust fallback)
     corrupt_ckpt@latest  corrupt the newest checkpoint right before the
                          next restore (the restart-after-crash window)
+    host_down@S:P        process P dies ABRUPTLY (SIGKILL) before step S —
+                         the lost-host case (drives heartbeat detection +
+                         coordinated abort, resilience/health.py)
+    slow_host@S:P:DURms  from step S on, process P sleeps DUR per step —
+                         a persistent straggler (drives slower-than-
+                         median*factor flagging at logging sync points)
+    partition@S[:P]      before step S, process P (default: every process)
+                         enters a simulated network partition: beats stop,
+                         observations stop; the minority side self-
+                         isolates (exit 72), the majority plants the pill
+                         (exit 71)
+    KIND@every:N[...]    repeating variant: fire at steps N, 2N, 3N, ...
+                         instead of once (nan_grad/loader_error/stall
+                         only), e.g. 'stall@every:50:1s'
     seed=N               seed for corruption bytes (default 0)
 
-Every fault fires once.  A plan is shared state: an in-process supervisor
-must pass ONE plan through all restart attempts (``Trainer(...,
-chaos=plan)``), otherwise step-keyed faults re-fire when the resumed run
-replays their step.  The trainer owns the injection points; this module
-only decides *when* and performs the host-side side effects.
+One-shot faults fire once; ``@every`` faults fire on every multiple of
+their period.  A plan is shared state: an in-process supervisor must pass
+ONE plan through all restart attempts (``Trainer(..., chaos=plan)``),
+otherwise step-keyed faults re-fire when the resumed run replays their
+step.  Host-targeted faults (``host_down``/``slow_host``/``partition``
+with P) parse identically on every process and fire only where
+``process_index`` matches — ONE spec string describes the whole cluster's
+failure schedule.  The trainer owns the injection points; this module only
+decides *when* and performs the host-side side effects.
 """
 
 from __future__ import annotations
@@ -35,13 +54,29 @@ import os
 import re
 import signal
 import time
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 log = logging.getLogger("dtf_tpu")
 
-_KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "corrupt_ckpt")
+_KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "corrupt_ckpt",
+          "host_down", "slow_host", "partition")
+# Kinds whose semantics survive refiring (a sigterm/host_down process is
+# gone; corruption of the same step proves nothing twice).
+_PERIODIC_OK = ("nan_grad", "loader_error", "stall")
+
+_DUR_RE = re.compile(r"^([0-9]+(?:\.[0-9]+)?)(ms|s)?$")
+
+
+def _parse_duration(text: str, default_unit: str, what: str) -> float:
+    """'3s' / '250ms' / bare number (default_unit) -> seconds."""
+    m = _DUR_RE.match(text)
+    if not m:
+        raise ValueError(f"bad duration {text!r} in {what!r} "
+                         f"(expected e.g. '3s' or '250ms')")
+    scale = {"s": 1.0, "ms": 1e-3}[m.group(2) or default_unit]
+    return float(m.group(1)) * scale
 
 
 class ChaosLoaderError(OSError):
@@ -53,26 +88,49 @@ class ChaosLoaderError(OSError):
 @dataclasses.dataclass
 class Fault:
     kind: str
-    step: Optional[int]          # None for corrupt_ckpt@latest
-    duration_s: float = 0.0      # stall only
+    step: Optional[int]          # None for corrupt_ckpt@latest / periodic
+    duration_s: float = 0.0      # stall / slow_host
+    process: Optional[int] = None  # host-targeted kinds; None = every host
+    period: Optional[int] = None   # @every:N repeating faults
     fired: bool = False
+    # Periodic latch: a repeating fault fires ONCE per matching step —
+    # without it, loader_error@every:N would re-raise on every attempt of
+    # the data path's retry loop at step N and turn a transient-error
+    # simulation into a guaranteed crash.
+    last_fired_step: Optional[int] = None
 
     def __str__(self) -> str:
-        at = "latest" if self.step is None else str(self.step)
-        extra = f":{self.duration_s:g}s" if self.kind == "stall" else ""
+        if self.period is not None:
+            at = f"every:{self.period}"
+        else:
+            at = "latest" if self.step is None else str(self.step)
+        extra = ""
+        if self.kind == "stall":
+            extra = f":{self.duration_s:g}s"
+        elif self.kind == "host_down":
+            extra = f":{self.process}"
+        elif self.kind == "slow_host":
+            extra = f":{self.process}:{self.duration_s * 1e3:g}ms"
+        elif self.kind == "partition" and self.process is not None:
+            extra = f":{self.process}"
         return f"{self.kind}@{at}{extra}"
 
 
 class FaultPlan:
     """The parsed spec; trainers call the ``maybe_*`` hooks at their
-    injection points and each matching fault fires exactly once."""
+    injection points.  One-shot faults fire exactly once; periodic faults
+    fire at every multiple of their period."""
 
     def __init__(self, faults: List[Fault], seed: int = 0,
-                 sleep=time.sleep, kill=os.kill):
+                 sleep=time.sleep, kill=os.kill,
+                 process_index: Optional[int] = None):
         self.faults = faults
         self.seed = seed
         self._sleep = sleep
         self._kill = kill
+        self._process_index = process_index
+        self._slow_delay_s = 0.0
+        self._on_partition: Optional[Callable[[], None]] = None
 
     @classmethod
     def parse(cls, spec: str, **kwargs) -> "FaultPlan":
@@ -84,36 +142,102 @@ class FaultPlan:
             if entry.startswith("seed="):
                 seed = int(entry[len("seed="):])
                 continue
-            m = re.fullmatch(r"([a-z_]+)@([a-z0-9]+)(?::([0-9.]+)s?)?", entry)
-            if not m or m.group(1) not in _KINDS:
+            kind, at_sep, rest = entry.partition("@")
+            if not at_sep or kind not in _KINDS:
                 raise ValueError(
                     f"bad chaos entry {entry!r}; expected kind@step with "
                     f"kind in {_KINDS} (e.g. 'nan_grad@17,sigterm@40,"
-                    f"stall@25:3s,corrupt_ckpt@latest,seed=7')")
-            kind, at, dur = m.group(1), m.group(2), m.group(3)
-            if at == "latest":
+                    f"stall@25:3s,host_down@30:1,slow_host@10:1:250ms,"
+                    f"stall@every:50:1s,corrupt_ckpt@latest,seed=7')")
+            args = rest.split(":") if rest else [""]
+            step: Optional[int] = None
+            period: Optional[int] = None
+            if args[0] == "every":
+                if kind not in _PERIODIC_OK:
+                    raise ValueError(
+                        f"@every is only valid for {_PERIODIC_OK}, got "
+                        f"{entry!r}")
+                if len(args) < 2 or not args[1].isdigit() or int(args[1]) < 1:
+                    raise ValueError(f"@every needs a positive period, "
+                                     f"e.g. '{kind}@every:50'; got {entry!r}")
+                period = int(args[1])
+                args = args[2:]
+            elif args[0] == "latest":
                 if kind != "corrupt_ckpt":
                     raise ValueError(f"@latest is only valid for "
                                      f"corrupt_ckpt, got {entry!r}")
-                step = None
+                args = args[1:]
             else:
-                step = int(at)
-            if kind == "stall" and not dur:
-                raise ValueError(f"stall needs a duration, e.g. "
-                                 f"'stall@{at}:3s'; got {entry!r}")
-            faults.append(Fault(kind, step,
-                                duration_s=float(dur) if dur else 0.0))
+                if not re.fullmatch(r"[0-9]+", args[0] or ""):
+                    raise ValueError(f"bad step in chaos entry {entry!r}")
+                step = int(args[0])
+                args = args[1:]
+            duration_s, process = 0.0, None
+            if kind == "stall":
+                if len(args) != 1 or not args[0]:
+                    raise ValueError(f"stall needs a duration, e.g. "
+                                     f"'stall@{rest.split(':')[0]}:3s'; "
+                                     f"got {entry!r}")
+                duration_s = _parse_duration(args[0], "s", entry)
+            elif kind == "host_down":
+                if len(args) != 1 or not args[0].isdigit():
+                    raise ValueError(f"host_down needs a process, e.g. "
+                                     f"'host_down@30:1'; got {entry!r}")
+                process = int(args[0])
+            elif kind == "slow_host":
+                if len(args) != 2 or not args[0].isdigit():
+                    raise ValueError(
+                        f"slow_host needs process and per-step delay, e.g. "
+                        f"'slow_host@10:1:250ms'; got {entry!r}")
+                process = int(args[0])
+                duration_s = _parse_duration(args[1], "ms", entry)
+            elif kind == "partition":
+                if len(args) > 1 or (args and args[0]
+                                     and not args[0].isdigit()):
+                    raise ValueError(f"partition takes an optional process, "
+                                     f"e.g. 'partition@30:1'; got {entry!r}")
+                process = int(args[0]) if args and args[0] else None
+            elif args and args[0]:
+                raise ValueError(f"{kind} takes no extra arguments; "
+                                 f"got {entry!r}")
+            faults.append(Fault(kind, step, duration_s=duration_s,
+                                process=process, period=period))
         return cls(faults, seed=seed, **kwargs)
 
     def __str__(self) -> str:
         return ",".join(str(f) for f in self.faults)
 
     def pending(self) -> List[Fault]:
-        return [f for f in self.faults if not f.fired]
+        """One-shot faults that never fired (periodic faults are excluded:
+        they are standing schedules, not obligations)."""
+        return [f for f in self.faults
+                if not f.fired and f.period is None]
+
+    def bind_partition(self, callback: Callable[[], None]) -> None:
+        """Wire ``partition@S`` to the health monitor's simulated-partition
+        entry point (resilience/health.py)."""
+        self._on_partition = callback
+
+    def _pid(self) -> int:
+        if self._process_index is not None:
+            return self._process_index
+        import jax
+        return jax.process_index()
 
     def _take(self, kind: str, step: Optional[int]) -> Optional[Fault]:
         for f in self.faults:
-            if not f.fired and f.kind == kind and f.step == step:
+            if f.kind != kind:
+                continue
+            if f.process is not None and self._pid() != f.process:
+                continue
+            if f.period is not None:
+                if (step is not None and step > 0 and step % f.period == 0
+                        and step != f.last_fired_step):
+                    f.last_fired_step = step
+                    log.warning("[chaos] firing %s (step %d)", f, step)
+                    return f
+                continue
+            if not f.fired and f.step == step:
                 f.fired = True
                 log.warning("[chaos] firing %s", f)
                 return f
@@ -122,12 +246,34 @@ class FaultPlan:
     # -- injection hooks (trainer calls these) ------------------------------
 
     def maybe_step_faults(self, step: int) -> None:
-        """Stall and SIGTERM, fired at the top of the step loop."""
+        """Stall, slow-host delay, partition, SIGTERM and host-down, fired
+        at the top of the step loop."""
         f = self._take("stall", step)
         if f is not None:
             self._sleep(f.duration_s)
+        f = self._take("slow_host", step)
+        if f is not None:
+            # Persistent straggler: every step from here on pays the delay
+            # (the fault "fires" once; the slowness stays).
+            self._slow_delay_s = f.duration_s
+        if self._slow_delay_s > 0:
+            self._sleep(self._slow_delay_s)
+        if self._take("partition", step) is not None:
+            if self._on_partition is not None:
+                self._on_partition()
+            else:
+                log.warning("[chaos] partition@%d fired but no health "
+                            "monitor is bound (enable --hb_interval_s); "
+                            "no-op", step)
         if self._take("sigterm", step) is not None:
             self._kill(os.getpid(), signal.SIGTERM)
+        if self._take("host_down", step) is not None:
+            # SIGKILL, not SIGTERM or sys.exit: a lost host gets no
+            # goodbye — no preemption save, no clean shutdown, no flushed
+            # buffers.  Peers must notice via missed heartbeats alone.
+            log.warning("[chaos] host_down: killing process %d (SIGKILL)",
+                        self._pid())
+            self._kill(os.getpid(), signal.SIGKILL)
 
     def maybe_loader_error(self, step: int) -> None:
         """Raises inside the batch fetch so the REAL retry path recovers."""

@@ -1,0 +1,635 @@
+"""Multi-host failure domain: heartbeats, liveness aggregation, straggler
+flagging, and coordinated abort.
+
+The watchdog (utils/watchdog.py) catches a hang in THIS process; the
+coordination service eventually propagates a dead peer — but "eventually"
+is minutes of every healthy host wedged inside a collective.  This module
+closes that gap the way the TPU-pod training systems do (MLPerf-0.6 pods,
+pjit-scaling): every process heartbeats out-of-band, liveness is
+aggregated, and when a peer goes quiet past its budget the healthy hosts
+ABORT COHERENTLY — distinct exit code, stack dump, poison-pill handshake —
+instead of blocking forever in the next psum.  An external supervisor
+(resilience.supervisor.run_elastic_hosts, or the job scheduler) then
+relaunches on the hardware that remains; checkpoint restore reshards onto
+the shrunken mesh (parallel/mesh.shrink_to_devices + the state template).
+
+Pieces, all transport-agnostic and jax-free so they unit-test in-process:
+
+* :class:`FileHeartbeatTransport` — beats as atomically-replaced files in a
+  shared rendezvous dir (GCS/NFS in production, tmpfs in tests);
+* :class:`TcpHeartbeatTransport` — no shared FS: non-coordinators push
+  beats to a tiny coordinator-hosted TCP service and learn of poison from
+  the beat response (``health_dir="tcp://host:port"`` selects it);
+* :class:`HealthMonitor` — the per-process daemon thread: beats every
+  ``interval_s``, observes peers (every process in file mode, coordinator
+  in TCP mode), publishes the cluster-health snapshot (coordinator), and
+  runs the abort protocol;
+* :func:`flag_stragglers` — the pure slower-than-``median * factor``
+  policy the trainer applies to allgathered per-host step times at its
+  logging sync points.
+
+Liveness is judged by OBSERVED CHANGE, not by timestamps in the beat
+payload: the observer records (its own monotonic clock) when each peer's
+beat counter last advanced, so cross-host clock skew cannot fake a death
+or hide one.
+
+Abort protocol (exit codes are the supervisor's survivor signal):
+
+* a peer (not all) went quiet => plant the poison pill, dump all-thread
+  stacks, ``os._exit(EXIT_PEER_LOST)`` — "I am healthy; the job is not";
+* ALL peers went quiet => ``os._exit(EXIT_SELF_ISOLATED)`` — "I am the
+  one partitioned/abandoned" (a network partition's minority side exits
+  with this, so the supervisor never mistakes it for a survivor);
+* the poison pill is observed => same EXIT_PEER_LOST path (someone else
+  made the call; exit before the next collective wedges us).
+
+A clean shutdown writes a DEPARTED beat so hosts finishing at slightly
+different times never read each other's completion as death.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+# Exit codes (watchdog owns 70 = wedged-in-place hang):
+EXIT_PEER_LOST = 71      # healthy host: a peer missed its heartbeat budget
+EXIT_SELF_ISOLATED = 72  # this host lost contact with EVERY peer
+
+DEPARTED = -1            # beat value meaning "exited cleanly, not dead"
+
+_POISON_FILE = "poison.json"
+_SNAPSHOT_FILE = "health.json"
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+
+
+class FileHeartbeatTransport:
+    """Beats in a shared rendezvous directory: ``hb_<process>`` holds the
+    beat counter (atomically replaced), ``poison.json`` is the pill,
+    ``health.json`` the coordinator's published snapshot.  Every process
+    can observe every other — symmetric detection."""
+
+    observes_peers = True
+
+    def __init__(self, directory: str, process_index: int):
+        self.directory = directory
+        self.process_index = process_index
+        os.makedirs(directory, exist_ok=True)
+
+    def _beat_path(self, process: int) -> str:
+        return os.path.join(self.directory, f"hb_{process}")
+
+    def beat(self, count: int) -> Optional[dict]:
+        """Record this process's beat; returns the poison (if planted) so
+        the send path doubles as the fastest poison check."""
+        _atomic_write(self._beat_path(self.process_index), str(count))
+        return self.read_poison()
+
+    def read_beats(self) -> Dict[int, int]:
+        beats: Dict[int, int] = {}
+        for name in os.listdir(self.directory):
+            if not name.startswith("hb_"):
+                continue
+            try:
+                beats[int(name[3:])] = int(
+                    open(os.path.join(self.directory, name)).read())
+            except (OSError, ValueError):
+                continue          # mid-replace or foreign file: skip
+        return beats
+
+    def plant_poison(self, reason: str, source: int) -> None:
+        """Atomic replace — overwriting matters: a pill left by a PREVIOUS
+        elastic round (which relaunched monitors deliberately ignore) must
+        not block this round's verdict.  Concurrent planters racing is
+        harmless: every current-round pill names a real failure."""
+        _atomic_write(os.path.join(self.directory, _POISON_FILE),
+                      json.dumps({"reason": reason, "source": source,
+                                  "time": time.time()}))
+
+    def read_poison(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.directory, _POISON_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def publish_snapshot(self, snapshot: dict) -> None:
+        try:
+            _atomic_write(os.path.join(self.directory, _SNAPSHOT_FILE),
+                          json.dumps(snapshot))
+        except OSError as exc:      # observability must never kill the job
+            log.warning("health snapshot write failed: %s", exc)
+
+    def close(self) -> None:
+        pass
+
+
+class TcpHeartbeatServer:
+    """Coordinator-side beat sink for meshes with no shared filesystem:
+    line protocol, one request per connection.
+
+        beat <process> <count>   ->  "ok" | "poison <json>"
+        poison <json>            ->  "ok"       (a client made the call)
+        snapshot                 ->  one JSON line (ops/debug endpoint)
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)
+        self.address = self._sock.getsockname()
+        self._lock = threading.Lock()
+        self._beats: Dict[int, int] = {}
+        self._poison: Optional[dict] = None
+        self._snapshot: dict = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dtf_tpu-hb-server")
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with conn:
+                    conn.settimeout(2.0)
+                    line = conn.makefile("r").readline().strip()
+                    try:
+                        reply = self._handle(line)
+                    except Exception as exc:
+                        # A malformed request (port scanner, HTTP probe,
+                        # buggy client) must never kill the serve thread —
+                        # a dead beat sink reads as a dead COORDINATOR and
+                        # would self-isolate every healthy client.
+                        reply = f"err {type(exc).__name__}"
+                    conn.sendall((reply + "\n").encode())
+            except OSError:
+                continue
+
+    def _handle(self, line: str) -> str:
+        parts = line.split(" ", 2)
+        with self._lock:
+            if parts[0] == "beat" and len(parts) == 3:
+                self._beats[int(parts[1])] = int(parts[2])
+                return ("poison " + json.dumps(self._poison)
+                        if self._poison else "ok")
+            if parts[0] == "poison" and len(parts) >= 2:
+                if self._poison is None:
+                    self._poison = json.loads(line.split(" ", 1)[1])
+                return "ok"
+            if parts[0] == "snapshot":
+                return json.dumps(self._snapshot)
+            return "err unknown command"
+
+    # -- coordinator-local accessors (no socket round trip) -----------------
+
+    def read_beats(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._beats)
+
+    def plant_poison(self, reason: str, source: int) -> None:
+        with self._lock:
+            if self._poison is None:
+                self._poison = {"reason": reason, "source": source,
+                                "time": time.time()}
+
+    def read_poison(self) -> Optional[dict]:
+        with self._lock:
+            return self._poison
+
+    def publish_snapshot(self, snapshot: dict) -> None:
+        with self._lock:
+            self._snapshot = snapshot
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+class TcpHeartbeatTransport:
+    """Client/coordinator facade over :class:`TcpHeartbeatServer`.
+
+    The coordinator hosts the server in-process (full observer); other
+    processes push beats over TCP and learn of poison from the response.
+    ``consecutive_failures`` counts unreachable-coordinator sends — the
+    monitor treats budget-many of those as losing every peer."""
+
+    def __init__(self, address: str, process_index: int,
+                 is_coordinator: bool):
+        host, _, port = address.partition(":")
+        self.process_index = process_index
+        self.consecutive_failures = 0
+        self._server: Optional[TcpHeartbeatServer] = None
+        self._poison: Optional[dict] = None
+        if is_coordinator:
+            self._server = TcpHeartbeatServer(host or "127.0.0.1", int(port))
+        self._addr = (host or "127.0.0.1", int(port))
+        self.observes_peers = is_coordinator
+
+    def _request(self, line: str) -> Optional[str]:
+        try:
+            with socket.create_connection(self._addr, timeout=2.0) as conn:
+                conn.sendall((line + "\n").encode())
+                reply = conn.makefile("r").readline().strip()
+            self.consecutive_failures = 0
+            return reply
+        except OSError:
+            self.consecutive_failures += 1
+            return None
+
+    def beat(self, count: int) -> Optional[dict]:
+        if self._server is not None:
+            self._server._beats[self.process_index] = count
+            return self._server.read_poison()
+        reply = self._request(f"beat {self.process_index} {count}")
+        if reply and reply.startswith("poison "):
+            self._poison = json.loads(reply[len("poison "):])
+        return self._poison
+
+    def read_beats(self) -> Dict[int, int]:
+        return self._server.read_beats() if self._server else {}
+
+    def plant_poison(self, reason: str, source: int) -> None:
+        if self._server is not None:
+            self._server.plant_poison(reason, source)
+        else:
+            self._request("poison " + json.dumps(
+                {"reason": reason, "source": source, "time": time.time()}))
+
+    def read_poison(self) -> Optional[dict]:
+        if self._server is not None:
+            return self._server.read_poison()
+        return self._poison
+
+    def publish_snapshot(self, snapshot: dict) -> None:
+        if self._server is not None:
+            self._server.publish_snapshot(snapshot)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+
+def make_transport(health_dir: str, process_index: int,
+                   is_coordinator: bool):
+    """``tcp://host:port`` selects the socket transport (no shared FS);
+    anything else is a shared rendezvous directory."""
+    if health_dir.startswith("tcp://"):
+        return TcpHeartbeatTransport(health_dir[len("tcp://"):],
+                                     process_index, is_coordinator)
+    return FileHeartbeatTransport(health_dir, process_index)
+
+
+# ---------------------------------------------------------------------------
+# Straggler policy
+# ---------------------------------------------------------------------------
+
+
+def finite_median(values: Sequence[float]) -> float:
+    """THE straggler baseline: median over the finite entries (NaN from a
+    broken host is flagged, never averaged in).  Shared by the flagging
+    decision and every display of it, so the printed 'cluster median'
+    can't drift from the threshold that produced the flags."""
+    arr = np.asarray(values, np.float64)
+    finite = arr[np.isfinite(arr)]
+    return float(np.median(finite)) if finite.size else float("nan")
+
+
+def flag_stragglers(step_ms: Sequence[float], factor: float) -> List[int]:
+    """Process indices slower than ``finite_median * factor``.
+
+    Median, not mean: one dying host must not drag the baseline up and
+    mask itself.  ``factor <= 1`` disables (everything exceeds nothing);
+    non-finite entries are flagged unconditionally (a host reporting NaN
+    timing is broken by definition) and excluded from the median."""
+    if factor <= 1.0 or len(step_ms) < 2:
+        return []
+    arr = np.asarray(step_ms, np.float64)
+    med = finite_median(arr)
+    return [i for i, t in enumerate(arr)
+            if not np.isfinite(t) or (med > 0 and t > med * factor)]
+
+
+# ---------------------------------------------------------------------------
+# Monitor
+# ---------------------------------------------------------------------------
+
+
+def _default_abort(code: int, reason: str) -> None:
+    print(f"[dtf_tpu] HEALTH: {reason} — coordinated abort (exit {code}). "
+          f"All-thread stacks follow:", flush=True)
+    from dtf_tpu.utils.watchdog import dump_all_stacks
+    dump_all_stacks()
+    # os._exit from the monitor thread: the main thread is (or is about to
+    # be) wedged inside a collective whose peer is gone — only a hard exit
+    # gets the process out (same rationale as the hang watchdog).
+    os._exit(code)
+
+
+@dataclasses.dataclass
+class PeerState:
+    last_count: int = 0
+    last_change: Optional[float] = None   # observer monotonic clock
+    departed: bool = False
+
+
+class HealthMonitor:
+    """Per-process heartbeat + liveness daemon (see module docstring).
+
+    ``interval_s`` is the beat period; a peer whose counter hasn't
+    advanced in ``miss_budget * interval_s`` (after ``boot_grace_s`` for a
+    peer never seen at all) is declared lost.  The thread is independent
+    of training progress by design: beats keep flowing through compiles
+    and long collectives, so a quiet peer means death/partition, never
+    mere slowness.
+    """
+
+    def __init__(self, transport, process_index: int, num_processes: int, *,
+                 interval_s: float, miss_budget: int = 3,
+                 boot_grace_s: float = 30.0,
+                 is_coordinator: Optional[bool] = None,
+                 on_abort: Callable[[int, str], None] = _default_abort,
+                 print_fn: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if miss_budget < 1:
+            raise ValueError(f"miss_budget must be >= 1, got {miss_budget}")
+        self.transport = transport
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.interval_s = interval_s
+        self.miss_budget = miss_budget
+        self.boot_grace_s = boot_grace_s
+        self.is_coordinator = (process_index == 0 if is_coordinator is None
+                               else is_coordinator)
+        self._on_abort = on_abort
+        self._print = print_fn or (lambda msg: print(msg, flush=True))
+        self._clock = clock
+        self._peers: Dict[int, PeerState] = {
+            p: PeerState() for p in range(num_processes)
+            if p != process_index}
+        self._count = 0
+        self._start: Optional[float] = None
+        self._stale_poison: Optional[dict] = None
+        self._partitioned = False
+        self._partition_at: Optional[float] = None
+        self._last_stragglers: List[int] = []
+        self._stop = threading.Event()
+        self._aborted: Optional[str] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtf_tpu-health")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "HealthMonitor":
+        self._start = self._clock()
+        # An elastic relaunch reuses the rendezvous (same --health_dir):
+        # a pill already present at start is the PREVIOUS round's verdict,
+        # not ours — remember its identity and ignore it, else every
+        # multi-host relaunch would abort on arrival.  (Stale hb_* files
+        # are harmless: counters are judged by observed change, and a
+        # reused slot's fresh beats un-latch DEPARTED below.)
+        try:
+            self._stale_poison = self.transport.read_poison()
+        except Exception:
+            self._stale_poison = None
+        self._thread.start()
+        return self
+
+    def wait_for_peers(self, timeout_s: float = 120.0) -> bool:
+        """Startup rendezvous over the beat channel: block until every
+        peer has beaten at least once (True) or ``timeout_s`` elapses
+        (False — the caller decides whether to proceed degraded).  Puts
+        hosts into the step loop in lockstep without a collective — the
+        same reason the abort path avoids collectives: at the edges of a
+        job's life you cannot rely on them.  A TCP *client* cannot
+        observe peers; it waits one miss budget instead (the coordinator
+        holds the real barrier)."""
+        deadline = time.monotonic() + timeout_s
+        if not self.transport.observes_peers:
+            time.sleep(min(self.miss_budget * self.interval_s,
+                           max(deadline - time.monotonic(), 0)))
+            return True
+        while time.monotonic() < deadline:
+            if self._aborted is not None:
+                return False
+            try:
+                beats = self.transport.read_beats()
+            except Exception:
+                beats = {}
+            if all(p in beats for p in self._peers):
+                return True
+            time.sleep(self.interval_s / 2)
+        return False
+
+    def close(self, mark_departed: bool = True) -> None:
+        """Stop the monitor.  ``mark_departed=True`` (a COMPLETED fit)
+        writes the DEPARTED beat so peers finishing later don't read our
+        exit as a death; a crash path must pass False — its beats simply
+        stop, and the peers' abort protocol (correctly) fires, because a
+        host going down mid-job is a job failure however Python-level the
+        exit was."""
+        self._stop.set()
+        self._thread.join(timeout=max(2.0, 4 * self.interval_s))
+        if mark_departed and not self._partitioned:
+            try:
+                self.transport.beat(DEPARTED)
+            except Exception:
+                pass
+        self.transport.close()
+
+    # -- chaos hook ---------------------------------------------------------
+
+    def partition(self) -> None:
+        """Simulate a network partition of THIS host: stop sending beats
+        and stop believing anything we read (we can't see the far side).
+        Our own all-peers-stale rule then self-isolates us with
+        EXIT_SELF_ISOLATED, while the majority side plants the pill and
+        exits EXIT_PEER_LOST."""
+        self._print(f"[dtf_tpu] HEALTH: process {self.process_index} "
+                    f"entering simulated network partition")
+        # From the partition instant NO information flows either way —
+        # the monitor stops beating AND stops believing the transport
+        # (whose reads would otherwise still work in this simulation,
+        # including the TCP coordinator's embedded beat sink).  Staleness
+        # is measured from now, unconditionally.
+        self._partition_at = self._clock()
+        self._partitioned = True
+
+    # -- trainer feed -------------------------------------------------------
+
+    def note_stragglers(self, step: int, per_host_ms: Sequence[float],
+                        flagged: Sequence[int]) -> None:
+        """Latest straggler verdict (trainer sync points) for the
+        published snapshot."""
+        self._last_stragglers = [int(i) for i in flagged]
+
+    # -- internals ----------------------------------------------------------
+
+    @property
+    def aborted(self) -> Optional[str]:
+        """The abort reason when a non-exiting ``on_abort`` was injected
+        (tests); None while healthy."""
+        return self._aborted
+
+    def _abort(self, code: int, reason: str) -> None:
+        self._aborted = reason
+        self._stop.set()
+        self._on_abort(code, reason)
+
+    def _observe(self, now: float) -> List[int]:
+        """Update per-peer freshness from the transport; return the list
+        of peers past their budget."""
+        beats = self.transport.read_beats()
+        stale: List[int] = []
+        budget = self.miss_budget * self.interval_s
+        for p, st in self._peers.items():
+            count = beats.get(p)
+            if count == DEPARTED:
+                st.departed = True
+                continue
+            if count is not None and (st.last_change is None
+                                      or count != st.last_count):
+                # A fresh counter un-latches DEPARTED too: after an
+                # elastic relaunch this slot may be a NEW host reusing a
+                # beat file whose previous owner departed.
+                st.departed = False
+                st.last_count, st.last_change = count, now
+                continue
+            if st.departed:
+                continue
+            if st.last_change is None:      # never seen: boot grace applies
+                if now - self._start > max(self.boot_grace_s, budget):
+                    stale.append(p)
+            elif now - st.last_change > budget:
+                stale.append(p)
+        return stale
+
+    def _snapshot(self, now: float, stale: List[int]) -> dict:
+        procs = {}
+        for p, st in sorted(self._peers.items()):
+            procs[p] = {
+                "beats": st.last_count,
+                "age_s": (round(now - st.last_change, 3)
+                          if st.last_change is not None else None),
+                "departed": st.departed,
+                "alive": st.departed or p not in stale,
+            }
+        procs[self.process_index] = {"beats": self._count, "age_s": 0.0,
+                                     "departed": False, "alive": True}
+        return {"coordinator": self.process_index,
+                "interval_s": self.interval_s,
+                "miss_budget": self.miss_budget,
+                "stragglers": self._last_stragglers,
+                "processes": procs}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            now = self._clock()
+            poison = None
+            if not self._partitioned:
+                self._count += 1
+                try:
+                    poison = self.transport.beat(self._count)
+                except Exception as exc:
+                    log.warning("heartbeat send failed: %s", exc)
+                if poison is None:
+                    try:
+                        poison = self.transport.read_poison()
+                    except Exception:
+                        poison = None
+            if (poison is not None and poison != self._stale_poison
+                    and poison.get("source") != self.process_index):
+                self._abort(
+                    EXIT_PEER_LOST,
+                    f"poison pill from process {poison.get('source')}: "
+                    f"{poison.get('reason')}")
+                return
+            live_peers = [p for p, st in self._peers.items()
+                          if not st.departed]
+            if self.transport.observes_peers and not self._partitioned:
+                stale = self._observe(now)
+                if (len(live_peers) >= 2
+                        and set(stale) >= set(live_peers)):
+                    # EVERYONE going quiet at once means *we* are the cut-
+                    # off side of a partition — with >= 2 independent
+                    # peers, simultaneous death of all of them is the far
+                    # less likely read.  (With a single peer the evidence
+                    # is symmetric, so the peer-lost branch below wins and
+                    # the supervisor counts us a survivor.)
+                    self._abort(
+                        EXIT_SELF_ISOLATED,
+                        f"lost contact with ALL peers {sorted(stale)} "
+                        f"(am I partitioned?)")
+                    return
+                if stale:
+                    reason = (f"process(es) {sorted(stale)} missed "
+                              f"{self.miss_budget} heartbeats "
+                              f"({self.miss_budget * self.interval_s:g}s)")
+                    try:
+                        self.transport.plant_poison(reason,
+                                                    self.process_index)
+                    except Exception as exc:
+                        log.warning("poison plant failed: %s", exc)
+                    if self.is_coordinator:
+                        self.transport.publish_snapshot(
+                            self._snapshot(now, stale))
+                    self._abort(EXIT_PEER_LOST, reason)
+                    return
+                if self.is_coordinator:
+                    self.transport.publish_snapshot(self._snapshot(now, []))
+            elif self._partitioned:
+                # Simulated partition: nothing flows either way, so once
+                # a miss budget elapses with (by definition) no peer
+                # heard, this side has lost everyone — self-isolate.
+                if live_peers and (now - self._partition_at
+                                   > self.miss_budget * self.interval_s):
+                    self._abort(
+                        EXIT_SELF_ISOLATED,
+                        "lost contact with ALL peers (partitioned side "
+                        "self-isolating)")
+                    return
+            elif (not self.transport.observes_peers
+                  and getattr(self.transport, "consecutive_failures", 0)
+                  >= self.miss_budget):
+                # TCP client that cannot reach the coordinator for a full
+                # budget: the far side is unreachable, we are the isolated
+                # one.
+                self._abort(
+                    EXIT_SELF_ISOLATED,
+                    "lost contact with the coordinator (self-isolating)")
+                return
+            self._stop.wait(self.interval_s)

@@ -2,14 +2,18 @@
 last checkpoint between attempts.
 
 This is the outermost layer of the failure model (DESIGN.md §5) and the
-piece that proves the others compose: the watchdog and the coordination
-service turn hangs/dead peers into process exits, the preemption handler
-turns SIGTERM into a clean checkpoint, the non-finite guard turns bad math
-into skipped steps (or a :class:`~dtf_tpu.train.trainer.TrainingDiverged`
-raise when it persists) — and the supervisor turns ALL of those into
-"restore the last good checkpoint and go again", with
-:class:`~dtf_tpu.utils.retry.Backoff` between attempts and a bounded
-restart budget so a permanently-broken job still terminates loudly.
+piece that proves the others compose: the watchdog, the health monitor
+and the coordination service turn hangs/dead peers into process exits,
+the preemption handler turns SIGTERM into a clean checkpoint, the
+non-finite guard turns bad math into skipped steps — and the supervisor
+turns the RETRYABLE ones into "restore the last good checkpoint and go
+again", with :class:`~dtf_tpu.utils.retry.Backoff` between attempts and a
+bounded restart budget so a permanently-broken job still terminates
+loudly.  Exit causes are CLASSIFIED first (:func:`classify_exit`):
+deterministic failures — :class:`~dtf_tpu.train.trainer.TrainingDiverged`
+after the in-fit rollback budget, checkpoint template mismatches, a
+refused resume — replay identically on every attempt, so they re-raise
+immediately instead of consuming restarts in an unwinnable loop.
 
 In production the supervisor is the job scheduler (k8s restartPolicy, GKE
 node auto-repair re-admitting the pod): each attempt is a fresh process
@@ -23,12 +27,29 @@ mid-stream dataset cannot rewind).
 from __future__ import annotations
 
 import logging
+import subprocess
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from dtf_tpu.utils.retry import Backoff
 
 log = logging.getLogger("dtf_tpu")
+
+
+def classify_exit(exc: BaseException) -> str:
+    """``'terminal'`` or ``'retryable'`` — THE restart-budget gate.
+
+    Terminal failures replay identically on every attempt: a checkpoint
+    template/schema mismatch (:class:`CheckpointMismatchError`), a
+    refused-resume, or :class:`~dtf_tpu.train.trainer.TrainingDiverged`
+    (the rollback budget already restored the last good checkpoint and
+    the instability returned — the trajectory is deterministic, so an
+    outer restart re-runs the exact same divergence).  Burning the
+    restart budget on those buries the loud signal under an unwinnable
+    retry loop; the supervisor re-raises them immediately instead.
+    Classification is by the ``no_restart`` attribute the deterministic
+    error types carry."""
+    return "terminal" if getattr(exc, "no_restart", False) else "retryable"
 
 
 class SupervisorGaveUp(RuntimeError):
@@ -76,11 +97,11 @@ def run_supervised(fit_once: Callable[[int], Any], *,
         try:
             result = fit_once(attempt)
         except retry_on as exc:
-            if getattr(exc, "no_restart", False):
-                # Deterministic failures (e.g. checkpoint template/schema
-                # mismatch, CheckpointMismatchError) replay identically on
-                # every attempt — restarting only delays and buries the
-                # loud signal.
+            if classify_exit(exc) == "terminal":
+                # Deterministic failures (TrainingDiverged, checkpoint
+                # template/schema mismatch) replay identically on every
+                # attempt — restarting only delays and buries the loud
+                # signal (see classify_exit).
                 raise
             last_exc = exc
             why = f"crashed ({type(exc).__name__}: {exc})"
@@ -146,3 +167,113 @@ def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
 
     return run_supervised(fit_once, max_restarts=max_restarts,
                           backoff=backoff, sleep=sleep)
+
+
+# ---------------------------------------------------------------------------
+# Elastic host-level supervision
+# ---------------------------------------------------------------------------
+
+
+def run_elastic_hosts(build_cmd: Callable[[int, int, int], List[str]],
+                      num_hosts: int, *,
+                      max_rounds: int = 2,
+                      min_hosts: int = 1,
+                      env: Optional[dict] = None,
+                      cwd: Optional[str] = None,
+                      timeout_s: float = 600.0,
+                      on_round: Optional[Callable[[int, int], None]] = None,
+                      popen=subprocess.Popen) -> Tuple[List[str], int, int]:
+    """Run a multi-host job elastically: when a host dies, relaunch on the
+    SURVIVING host set (shrunken mesh) instead of giving up.
+
+    The health subsystem (resilience/health.py) makes the survivor set
+    legible from exit codes alone: a host that loses a peer exits
+    ``EXIT_PEER_LOST`` (71) after the coordinated abort, the dead/
+    partitioned host exits some other way (SIGKILL, ``EXIT_SELF_ISOLATED``,
+    a crash).  Each round spawns ``build_cmd(slot, n_hosts, round) ->
+    argv`` for every surviving slot with CONTIGUOUS re-assigned indices —
+    slot k of round r+1 is the k-th survivor of round r — so the relaunch
+    is a normal smaller job: ``--num_processes`` drops, ``data=-1`` (or an
+    ``--elastic`` fixed mesh via :func:`~dtf_tpu.parallel.mesh.
+    shrink_to_devices`) re-resolves over the remaining devices, and
+    ``--resume`` reshards the last intact checkpoint onto the shrunken
+    mesh through the restore template.
+
+    In production this loop IS the job scheduler (GKE/k8s recreating the
+    job with the live node set); this function is the same decision
+    procedure in-process for single-machine rigs, integration tests, and
+    the chaos suite.
+
+    Returns ``(outputs, final_num_hosts, rounds_used)`` of the completing
+    round.  Raises :class:`SupervisorGaveUp` when the round budget is
+    spent or fewer than ``min_hosts`` survivors remain.  A host that
+    neither completes nor aborts within ``timeout_s`` is killed and
+    counted dead (its coordinated abort failed — don't trust it)."""
+    from dtf_tpu.resilience.health import EXIT_PEER_LOST
+
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    history: List[Tuple[int, str]] = []
+    n = num_hosts
+    for round_idx in range(max_rounds + 1):
+        if on_round is not None:
+            on_round(round_idx, n)
+        # Outputs go to spooled temp files, not PIPEs: the hosts of one
+        # round are interdependent (collectives), so blocking on host k's
+        # pipe while host k+1 fills its 64KB buffer could wedge a healthy
+        # round into the timeout.  Launching inside the try keeps a
+        # mid-fan-out popen failure from leaking the already-started
+        # workers.
+        import tempfile
+
+        procs, files, outs, codes = [], [], [], []
+        deadline = time.monotonic() + timeout_s
+        try:
+            for slot in range(n):
+                f = tempfile.TemporaryFile(mode="w+")
+                files.append(f)
+                procs.append(popen(build_cmd(slot, n, round_idx), env=env,
+                                   cwd=cwd, stdout=f,
+                                   stderr=subprocess.STDOUT, text=True))
+            for p, f in zip(procs, files):
+                killed = False
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                    killed = True
+                f.seek(0)
+                out = f.read()
+                if killed:
+                    out += ("\n[elastic] killed: neither completed nor "
+                            "aborted within the round timeout")
+                outs.append(out)
+                codes.append(p.returncode)
+        finally:
+            for p in procs:        # never leak workers from a failed round
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.close()
+        if all(rc == 0 for rc in codes):
+            if round_idx:
+                log.info("elastic: completed on round %d with %d/%d hosts",
+                         round_idx + 1, n, num_hosts)
+            return outs, n, round_idx
+        # Survivors: clean completions (finished before the abort fanned
+        # out) and coordinated aborts.  Everything else — SIGKILL,
+        # self-isolated, crashes, timeouts — is dead hardware.
+        survivors = [slot for slot, rc in enumerate(codes)
+                     if rc in (0, EXIT_PEER_LOST)]
+        why = "; ".join(f"slot {s} rc={rc}" for s, rc in enumerate(codes)
+                        if rc not in (0, EXIT_PEER_LOST))
+        history.append((round_idx,
+                        f"{n} host(s) -> {len(survivors)} survivor(s) "
+                        f"({why or 'no host failed?'})"))
+        log.warning("elastic: round %d lost %d host(s) (%s); survivors %s",
+                    round_idx + 1, n - len(survivors), why, survivors)
+        if len(survivors) < min_hosts or not survivors:
+            break
+        n = len(survivors)
+    raise SupervisorGaveUp(max_rounds, history)

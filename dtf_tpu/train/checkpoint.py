@@ -138,7 +138,12 @@ class CheckpointManager:
                 step_dir = self.step_dir(step)
                 if step_dir is None:  # pruned by max_to_keep or failed
                     continue
-                manifest = {"step": step, "files": _tree_manifest(step_dir)}
+                # nproc: elastic restarts restore on a DIFFERENT process
+                # count than saved; recording the writer's makes the
+                # reshard explicit (restore_robust logs it) instead of
+                # silent.
+                manifest = {"step": step, "nproc": jax.process_count(),
+                            "files": _tree_manifest(step_dir)}
                 tmp = self._manifest_path(step) + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(manifest, f)
@@ -174,6 +179,31 @@ class CheckpointManager:
                     os.remove(os.path.join(mdir, name))
                 except OSError:
                     pass
+
+    def manifest_meta(self, step: int) -> dict:
+        """The manifest's metadata (step, writer nproc) — {} when the
+        manifest is missing or unreadable (legacy layout)."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                meta = json.load(f)
+            meta.pop("files", None)
+            return meta
+        except (OSError, ValueError):
+            return {}
+
+    def _log_reshard(self, step: int) -> None:
+        """Restoring under a different process count than the checkpoint's
+        writer is the elastic-restart path: the state template just
+        resharded the trajectory onto the current (usually shrunken) mesh.
+        Loud by design — a silent topology change is how 'why is my step
+        time different' mysteries are born."""
+        saved_n = self.manifest_meta(step).get("nproc")
+        if saved_n and saved_n != jax.process_count():
+            log.warning(
+                "elastic restore: checkpoint step %d was written by %d "
+                "process(es), restored onto %d — state resharded onto the "
+                "current mesh via the template", step, saved_n,
+                jax.process_count())
 
     def verify(self, step: int) -> tuple[bool, str]:
         """Check a landed step against its manifest.  (True, reason) means
@@ -213,6 +243,7 @@ class CheckpointManager:
             return state_template, None
         restored = self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(state_template))
+        self._log_reshard(step)
         log.info("checkpoint restored from step %d", step)
         return restored, step
 
@@ -329,6 +360,7 @@ class CheckpointManager:
                             type(exc).__name__, exc)
                 candidates = [s for s in candidates if s < step]
                 continue
+            self._log_reshard(step)
             log.info("checkpoint restored from step %d", step)
             return restored, step
         if had_any:

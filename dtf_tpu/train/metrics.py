@@ -81,6 +81,23 @@ class MetricLogger:
             # the post-mortem metrics must already be on disk.
             self._tb.flush()
 
+    def stragglers(self, step: int, per_host_ms, flagged) -> None:
+        """Cluster-health feed (resilience/health.flag_stragglers): each
+        host's avg step time as ``health/step_ms_p<k>`` so TensorBoard
+        overlays the whole fleet on one axis, plus a ``health/stragglers``
+        count; flagged hosts get a console line (they are where the next
+        host_down usually comes from)."""
+        for k, ms in enumerate(per_host_ms):
+            self.scalar(step, f"health/step_ms_p{k}", float(ms))
+        self.scalar(step, "health/stragglers", float(len(flagged)))
+        if flagged:
+            from dtf_tpu.resilience.health import finite_median
+            detail = ", ".join(
+                f"p{k}={float(per_host_ms[k]):.1f}ms" for k in flagged)
+            self.print(f"[dtf_tpu] straggler(s) at step {step}: {detail} "
+                       f"(cluster median "
+                       f"{finite_median(per_host_ms):.1f}ms/step)")
+
     def event(self, step: int, name: str, detail: str = "") -> None:
         """Resilience/lifecycle event: one console line + a unit-valued
         ``event/<name>`` scalar so rollbacks, retries and restarts are

@@ -42,10 +42,14 @@ TrainState = dict  # {"params": pytree, "opt_state": pytree, "step": i32}
 class TrainingDiverged(RuntimeError):
     """Persistent non-finite loss/gradients the in-step guard could not
     heal: ``bad_step_limit`` consecutive skipped steps with no checkpoint
-    to roll back to, or the rollback budget spent.  The restart supervisor
-    (resilience/supervisor.py) treats this like any other crash — restore
-    and retry — while a bare fit fails fast instead of burning the budget
-    skipping every step."""
+    to roll back to, or the rollback budget spent.  Deterministic by
+    construction — batches and rng are keyed by the global step, and the
+    rollback already retried from the last good checkpoint — so an outer
+    restart replays the identical divergence: the supervisor
+    (resilience/supervisor.classify_exit) fails fast on it instead of
+    consuming its restart budget in an unwinnable loop."""
+
+    no_restart = True
 
 
 def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
@@ -719,10 +723,24 @@ class Trainer:
         if cfg.hang_timeout_s > 0:
             from dtf_tpu.utils.watchdog import HangWatchdog
             self._watchdog = HangWatchdog(cfg.hang_timeout_s)
+        # Multi-host failure domain (resilience/health.py): heartbeats +
+        # poison-pill coordinated abort, armed for the duration of fit.
+        # The monitor's daemon thread beats independently of step
+        # progress, so a dead/partitioned PEER is detected (and this host
+        # freed from the wedged collective, exit 71) within the miss
+        # budget — while this host's own hang is still the watchdog's job.
+        health = self.cluster.start_health(print_fn=self.logger.print)
+        if health is not None and self._chaos is not None:
+            self._chaos.bind_partition(health.partition)
+        straggling = (cfg.straggler_factor > 1.0 and nproc > 1)
+        if straggling:
+            from jax.experimental import multihost_utils
+            from dtf_tpu.resilience.health import flag_stragglers
         preempt = None
         if self.ckpt is not None and cfg.preemption_save:
             from dtf_tpu.utils.preemption import PreemptionHandler
-            preempt = PreemptionHandler()
+            preempt = PreemptionHandler(
+                signals=PreemptionHandler.signals_for(cfg.preempt_sigint))
         preempted = False
         # Data-path robustness: transient I/O errors (flaky filesystem,
         # chaos loader_error) get a bounded retry; ValueError and the
@@ -740,6 +758,7 @@ class Trainer:
                 self._chaos.maybe_loader_error(self._host_step)
             return train.next_batch(feed_bs)
 
+        fit_completed = False
         try:
             hit_cap = False
             for epoch in range(start_epoch, epochs):
@@ -799,10 +818,14 @@ class Trainer:
                         with self._suspended_watchdog():
                             self.ckpt.save(self._host_step, self.state,
                                            force=True)
-                        self.logger.print(
-                            f"[dtf_tpu] preempted: checkpointed step "
-                            f"{self._host_step}; exiting (resume with "
-                            f"--resume)")
+                        # logger.event, not a bare print: the agreed-save
+                        # decision lands as an `event/preempted` scalar in
+                        # the TensorBoard stream, so drains are countable
+                        # on the same time axis as the loss they cut short.
+                        self.logger.event(
+                            self._host_step, "preempted",
+                            f"checkpointed step {self._host_step}; exiting "
+                            f"(resume with --resume)")
                         preempted = True
                         break
                     if at_sync:
@@ -816,6 +839,23 @@ class Trainer:
                                               batch_count, cost, avg_ms)
                         self.logger.scalar(step, "cost", cost)
                         self.logger.scalar(step, "avg_ms", avg_ms)
+                        if straggling:
+                            # Per-host step timing, allgathered at a
+                            # boundary every process reaches together
+                            # (same rule as the preemption allgather):
+                            # hosts slower than median * straggler_factor
+                            # are flagged to metrics and the published
+                            # health snapshot.
+                            per_host = np.asarray(
+                                multihost_utils.process_allgather(
+                                    np.asarray([avg_ms], np.float32))
+                            ).reshape(-1)
+                            flagged = flag_stragglers(
+                                per_host, cfg.straggler_factor)
+                            self.logger.stragglers(step, per_host, flagged)
+                            if health is not None:
+                                health.note_stragglers(step, per_host,
+                                                       flagged)
                         count = 0
                         last_cost = cost
                         # Guard policy (DESIGN.md §5): the device-side
@@ -847,7 +887,15 @@ class Trainer:
                 # resumed past the budget: report eval
                 with self._suspended_watchdog():
                     ev = self.eval_fn(self.state, splits.test)
+            fit_completed = True
         finally:
+            if health is not None:
+                # A COMPLETED fit (incl. agreed preemption) departs
+                # cleanly — peers still finishing their epoch must not
+                # read the exit as a death.  A crash path must NOT write
+                # DEPARTED: this host is going down mid-job, and the
+                # peers' coordinated abort is the correct response.
+                health.close(mark_departed=fit_completed)
             if preempt is not None:
                 preempt.restore()
             # Disarm before post-loop host work — and on ANY exit path: a

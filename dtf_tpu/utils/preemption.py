@@ -1,4 +1,5 @@
-"""Preemption-safe training: SIGTERM -> checkpoint at the next step boundary.
+"""Preemption-safe training: SIGTERM (optionally SIGINT) -> checkpoint at
+the next step boundary.
 
 The reference lost all state on any interruption (no Saver, SURVEY.md §5.4).
 TPU VMs are routinely preempted (maintenance events, spot reclamation) with
@@ -36,9 +37,21 @@ class PreemptionHandler:
     and says so, rather than crashing the trainer.
     """
 
+    @classmethod
+    def signals_for(cls, include_sigint: bool = False) -> tuple:
+        """The signal set for a config: SIGTERM always (TPU preemption /
+        spot reclamation), plus SIGINT when ``--preempt_sigint`` asks for
+        ctrl-C / scheduler-nudge drains to checkpoint instead of dying
+        with KeyboardInterrupt mid-step."""
+        return ((signal.SIGTERM, signal.SIGINT) if include_sigint
+                else (signal.SIGTERM,))
+
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
         self._flag = threading.Event()
         self._prev = {}
+        # Deliveries observed (signum per delivery): `received` feeds the
+        # preemption metrics counter so drains are countable post-mortem.
+        self.received: list = []
         try:
             for s in signals:
                 self._prev[s] = signal.signal(s, self._on_signal)
@@ -49,11 +62,17 @@ class PreemptionHandler:
                   flush=True)
 
     def _on_signal(self, signum, frame) -> None:
+        self.received.append(signum)
         self._flag.set()
         # print() is not strictly async-signal-safe but CPython serializes
         # handler execution on the main thread; keep it one short line.
         print(f"[dtf_tpu] signal {signum}: preemption — will checkpoint at "
               f"the next sync boundary and exit", file=sys.stderr, flush=True)
+
+    @property
+    def trigger_count(self) -> int:
+        """How many preemption signals have been delivered locally."""
+        return len(self.received)
 
     @property
     def triggered(self) -> bool:

@@ -79,6 +79,14 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
         # clean measurement.
         logger.print(f"[dtf_tpu] CHAOS plan active ({train_cfg.chaos}): "
                      f"timings/MFU below include injected faults")
+    if train_cfg.straggler_factor > 1.0 and jax.process_count() > 1:
+        # Benchmarks inherit straggler detection through the Trainer; the
+        # per-host timing allgather at each logging sync point is a small
+        # DCN collective the clean numbers don't pay.
+        logger.print(
+            f"[dtf_tpu] straggler detection active (factor "
+            f"{train_cfg.straggler_factor:g}): Step-Time includes the "
+            f"per-host timing allgather at logging sync points")
     if train_cfg.max_restarts > 0:
         # An accepted-but-ignored flag would let the user believe the job
         # is supervised when it is not.  Benchmark runs are single-attempt

@@ -78,10 +78,12 @@ def main(argv=None) -> int:
            else optim.sgd(lr))
 
     if supervised:
-        # Self-healing mode: crashes (incl. TrainingDiverged) and SIGTERM
-        # preemptions restore the last checkpoint and go again, under a
-        # bounded restart budget (resilience.run_supervised_fit owns the
-        # shared-plan / fresh-trainer-per-attempt mechanics).
+        # Self-healing mode: retryable crashes and SIGTERM preemptions
+        # restore the last checkpoint and go again, under a bounded
+        # restart budget (resilience.run_supervised_fit owns the
+        # shared-plan / fresh-trainer-per-attempt mechanics).  Terminal
+        # failures — TrainingDiverged, checkpoint schema mismatches —
+        # fail fast (supervisor.classify_exit).
         from dtf_tpu.resilience import run_supervised_fit
         result = run_supervised_fit(
             lambda cfg, plan: Trainer(

@@ -13,6 +13,9 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+# tests/_mp_health.py imports dtf_tpu when spawned as a script (pytest's
+# rig injects the repo root via child_env; here we do it ourselves).
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
 fail=0
 
@@ -53,6 +56,54 @@ if python -m dtf_tpu.workloads.mnist \
     echo "FAIL: persistent NaNs should not exit 0"; fail=1
 fi
 grep -q "TrainingDiverged" "$logdir/div.err" || { echo "FAIL: no TrainingDiverged"; fail=1; }
+
+echo "== CLI: diverged under supervision fails FAST (no restart burned) =="
+# Terminal-failure classification: a deterministic divergence must raise
+# through the supervisor on attempt 0, not replay through --max_restarts.
+python -m dtf_tpu.workloads.mnist \
+    --epochs 1 --batch_size 512 --init fan_in --log_frequency 1 \
+    --logdir "$logdir/div2" --bad_step_limit 2 --max_restarts 3 \
+    --checkpoint_every 1000000 \
+    --chaos "nan_grad@3,nan_grad@4" > "$logdir/div2.log" 2>&1
+rc=$?
+if [ "$rc" -eq 0 ]; then
+    echo "FAIL: supervised persistent NaNs should not exit 0"; fail=1
+fi
+grep -q "TrainingDiverged" "$logdir/div2.log" || { echo "FAIL: no TrainingDiverged"; fail=1; }
+if grep -q "restarting from last" "$logdir/div2.log"; then
+    echo "FAIL: supervisor burned a restart on a terminal failure"; fail=1
+fi
+
+echo "== CLI: host-fault matrix (host_down -> coordinated abort -> elastic restart) =="
+# Two simulated hosts sharing a rendezvous dir; host 1 dies abruptly at
+# its step 20, host 0 must exit 71 via the poison pill, and the elastic
+# relaunch of the survivor must resume and complete (tests/_mp_health.py
+# is the same worker the pytest acceptance pair drives).
+hostdir=$(mktemp -d)
+chaos_spec="slow_host@0:0:250ms,slow_host@0:1:100ms,host_down@20:1"
+python tests/_mp_health.py 0 2 "$hostdir" 2000 4 "$chaos_spec" > "$logdir/h0.log" 2>&1 &
+pid0=$!
+python tests/_mp_health.py 1 2 "$hostdir" 2000 4 "$chaos_spec" > "$logdir/h1.log" 2>&1 &
+pid1=$!
+wait "$pid1"; rc1=$?
+wait "$pid0"; rc0=$?
+if [ "$rc0" -ne 71 ]; then
+    echo "FAIL: healthy host should exit EXIT_PEER_LOST(71), got $rc0"; fail=1
+fi
+if [ "$rc1" -ne 137 ] && [ "$rc1" -ne 9 ]; then
+    echo "FAIL: host_down host should die by SIGKILL, got $rc1"; fail=1
+fi
+[ -f "$hostdir/health/poison.json" ] || { echo "FAIL: no poison pill planted"; fail=1; }
+python tests/_mp_health.py 0 1 "$hostdir" 30 2 > "$logdir/h_elastic.log" 2>&1
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "FAIL: elastic relaunch on the survivor failed (rc=$rc)"; fail=1
+fi
+grep -q "resumed from step" "$logdir/h_elastic.log" \
+    || { echo "FAIL: elastic relaunch did not resume the checkpoint"; fail=1; }
+grep -q "MP_HEALTH_DONE" "$logdir/h_elastic.log" \
+    || { echo "FAIL: elastic relaunch did not complete"; fail=1; }
+rm -rf "$hostdir"
 
 rm -rf "$logdir"
 if [ "$fail" -ne 0 ]; then

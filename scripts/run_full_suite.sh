@@ -15,5 +15,14 @@ for f in tests/test_*.py; do
   rc=$?
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: $f (rc=$rc)"; }
 done
+# Chaos lane: the full fault-injection matrix (pytest -m chaos plus the
+# CLI-level injection runs, including the host-fault matrix) so ONE
+# command covers the whole suite.  Skip with NO_CHAOS_LANE=1.
+if [ "${NO_CHAOS_LANE:-0}" != "1" ]; then
+  echo "=== chaos lane (scripts/run_chaos_suite.sh) ==="
+  bash scripts/run_chaos_suite.sh
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: chaos lane (rc=$rc)"; }
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

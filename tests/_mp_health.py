@@ -1,0 +1,116 @@
+"""Multi-host failure-domain worker (spawned by tests/test_multiprocess.py
+and resilience.supervisor.run_elastic_hosts).
+
+One "host" of an N-host job with the health subsystem armed for real:
+heartbeats in a shared rendezvous dir, chaos host faults targeted by
+process index, the poison-pill coordinated abort, and checkpoint/resume on
+a mesh sized by ``devices``.  The hosts form the health mesh EXPLICITLY
+(process_index/num_processes passed in) rather than via
+``jax.distributed`` — heartbeating, abort and elastic restart are
+deliberately independent of the collective runtime (a dead peer's
+collectives are exactly what you can no longer rely on), and this keeps
+the scenario runnable on jaxlib builds whose CPU backend lacks
+multiprocess collectives (where the rest of the rig skips).
+
+Only host 0 owns the shared logdir/checkpoints (the survivor the elastic
+supervisor relaunches); other hosts train a decoy replica in a scratch
+logdir — their role is to heartbeat, straggle, partition, and die on cue.
+
+Usage:
+    _mp_health.py <task> <nproc> <shared_dir> <max_steps> <devices> [chaos]
+
+Exits 0 on completion, 71/72 through the coordinated abort, or dies
+outright under ``host_down``.  Host 0 prints
+``MP_HEALTH_DONE steps=<n> final_cost=<loss>`` on completion.
+"""
+
+import os
+import sys
+
+
+def tiny_splits(n=1024, seed=0):
+    """Deterministic, learnable 10-class data — identical on every host."""
+    import numpy as np
+
+    from dtf_tpu.data.datasets import Dataset, DataSplits
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    protos = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    x = (protos[y] + rng.normal(0, 2.0, (n, 784))).astype(np.float32)
+    return DataSplits(train=Dataset(x, np.eye(10, dtype=np.float32)[y],
+                                    seed=1), test=None)
+
+
+def main(task: int, nproc: int, shared: str, max_steps: int,
+         devices: int, chaos: str = "") -> int:
+    from dtf_tpu import optim
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.resilience.chaos import FaultPlan
+    from dtf_tpu.resilience.health import HealthMonitor, make_transport
+    from dtf_tpu.train.trainer import Trainer
+
+    cluster = bootstrap(ClusterConfig(simulated_devices=devices,
+                                      mesh="data=-1"))
+    logdir = (os.path.join(shared, "logs") if task == 0
+              else os.path.join(shared, f"logs_task{task}"))
+    cfg = TrainConfig(
+        batch_size=64, learning_rate=0.05, epochs=100,
+        log_frequency=2, seed=1, logdir=logdir,
+        checkpoint_every=5, resume=True)
+    # The chaos plan and the health mesh carry THIS host's identity; one
+    # spec string describes the whole cluster's failure schedule.
+    plan = FaultPlan.parse(chaos, process_index=task) if chaos else None
+    monitor = None
+    if nproc > 1:
+        monitor = HealthMonitor(
+            make_transport(os.path.join(shared, "health"), task,
+                           is_coordinator=task == 0),
+            task, nproc, interval_s=0.25, miss_budget=4,
+            boot_grace_s=120.0, is_coordinator=task == 0).start()
+        if plan is not None:
+            plan.bind_partition(monitor.partition)
+    trainer = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                      optim.sgd(0.05), cfg, chaos=plan)
+    if monitor is not None:
+        # Warm the step compile BEFORE the startup barrier, on a
+        # throwaway state copy (step_fn donates its first argument) and a
+        # dummy batch, so every host enters the fault schedule in
+        # lockstep: compile skew must not let a fast host die before a
+        # slow host has checkpointed anything.
+        import jax
+        import numpy as np
+
+        from dtf_tpu.train.trainer import put_global_batch
+
+        dummy = put_global_batch(
+            cluster.mesh, (np.zeros((cfg.batch_size, 784), np.float32),
+                           np.zeros((cfg.batch_size, 10), np.float32)))
+        throwaway = jax.tree_util.tree_map(lambda x: x + 0, trainer.state)
+        jax.block_until_ready(
+            trainer.step_fn(throwaway, dummy, jax.random.key(0)))
+        monitor.wait_for_peers(120.0)
+    completed = False
+    try:
+        result = trainer.fit(tiny_splits(), max_steps=max_steps)
+        completed = True
+    finally:
+        if monitor is not None:
+            # Same protocol as the trainer's own close: only a COMPLETED
+            # fit departs cleanly; a crash lets the beats stop so peers
+            # run the coordinated abort.
+            monitor.close(mark_departed=completed)
+        if trainer.ckpt is not None:
+            trainer.ckpt.close()
+    if task == 0:
+        print(f"MP_HEALTH_DONE steps={result['steps']} "
+              f"final_cost={result['final_cost']:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                  int(sys.argv[4]), int(sys.argv[5]),
+                  sys.argv[6] if len(sys.argv) > 6 else ""))

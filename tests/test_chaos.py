@@ -62,9 +62,91 @@ class TestFaultPlanParse:
 
     def test_bad_specs_fail_loudly(self):
         for bad in ("frobnicate@3", "nan_grad@latest", "stall@5",
-                    "nan_grad", "nan_grad@@3"):
+                    "nan_grad", "nan_grad@@3", "host_down@3",
+                    "slow_host@3:1", "sigterm@every:5", "stall@every:0:1s",
+                    "host_down@every:5:1", "partition@3:1:2",
+                    "sigterm@40:1"):
             with pytest.raises(ValueError):
                 FaultPlan.parse(bad)
+
+    def test_host_fault_grammar(self):
+        plan = FaultPlan.parse(
+            "host_down@30:1,slow_host@10:2:250ms,partition@12,"
+            "partition@15:0")
+        spec = [(f.kind, f.step, f.process) for f in plan.faults]
+        assert spec == [("host_down", 30, 1), ("slow_host", 10, 2),
+                        ("partition", 12, None), ("partition", 15, 0)]
+        assert plan.faults[1].duration_s == pytest.approx(0.25)
+
+    def test_repeating_fault_grammar(self):
+        plan = FaultPlan.parse("stall@every:50:1s,nan_grad@every:7")
+        assert plan.faults[0].period == 50
+        assert plan.faults[0].duration_s == 1.0
+        assert plan.faults[1].period == 7
+        assert plan.faults[1].step is None
+
+    @pytest.mark.parametrize("spec", [
+        "nan_grad@17,corrupt_ckpt@latest,sigterm@40,stall@25:3s,"
+        "loader_error@9,corrupt_ckpt@30",
+        "host_down@30:1,slow_host@10:1:250ms,partition@12,partition@15:0",
+        "stall@every:50:1s,nan_grad@every:7,loader_error@every:3",
+    ])
+    def test_spec_round_trips(self, spec):
+        """str(parse(spec)) == spec, and re-parsing the printed form is a
+        fixed point — the replayability contract for every fault kind."""
+        plan = FaultPlan.parse(spec)
+        assert str(plan) == spec
+        assert str(FaultPlan.parse(str(plan))) == spec
+
+    def test_repeating_fault_fires_on_every_period(self):
+        sleeps = []
+        plan = FaultPlan.parse("stall@every:10:0.5s", sleep=sleeps.append,
+                               process_index=0)
+        for step in range(31):
+            plan.maybe_step_faults(step)
+        assert sleeps == [0.5, 0.5, 0.5]               # steps 10, 20, 30
+        assert plan.pending() == []                    # standing schedule,
+                                                       # never "pending"
+
+    def test_repeating_loader_error_fires_once_per_step(self):
+        """The data path RETRIES a failed fetch at the same step; a
+        periodic fault must latch per step so the retry recovers (one
+        raise per period, not one per attempt)."""
+        plan = FaultPlan.parse("loader_error@every:5", process_index=0)
+        with pytest.raises(ChaosLoaderError):
+            plan.maybe_loader_error(5)
+        plan.maybe_loader_error(5)                     # retry: recovers
+        plan.maybe_loader_error(5)
+        with pytest.raises(ChaosLoaderError):
+            plan.maybe_loader_error(10)                # next period fires
+
+    def test_host_targeted_faults_respect_process_index(self):
+        kills = []
+        here = FaultPlan.parse("host_down@5:1", process_index=1,
+                               kill=lambda pid, sig: kills.append(sig))
+        other = FaultPlan.parse("host_down@5:1", process_index=0,
+                                kill=lambda pid, sig: kills.append(sig))
+        other.maybe_step_faults(5)
+        assert kills == []                             # not this host
+        here.maybe_step_faults(5)
+        assert kills == [signal.SIGKILL]               # abrupt, no goodbye
+
+    def test_slow_host_delay_is_persistent(self):
+        sleeps = []
+        plan = FaultPlan.parse("slow_host@3:0:100ms", process_index=0,
+                               sleep=sleeps.append)
+        for step in range(6):
+            plan.maybe_step_faults(step)
+        assert sleeps == [0.1, 0.1, 0.1]               # steps 3, 4, 5
+
+    def test_partition_calls_bound_monitor(self):
+        fired = []
+        plan = FaultPlan.parse("partition@4", process_index=0)
+        plan.bind_partition(lambda: fired.append(True))
+        plan.maybe_step_faults(3)
+        assert fired == []
+        plan.maybe_step_faults(4)
+        assert fired == [True]
 
     def test_each_fault_fires_once(self):
         sleeps, kills = [], []

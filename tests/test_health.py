@@ -1,0 +1,557 @@
+"""Multi-host failure domain (resilience/health.py) — unit level.
+
+Every piece of the heartbeat / coordinated-abort / elastic-restart
+machinery runs in-process here with injected clocks and abort hooks;
+tests/test_multiprocess.py drives the same code across real processes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dtf_tpu.resilience.health import (
+    DEPARTED, EXIT_PEER_LOST, EXIT_SELF_ISOLATED, FileHeartbeatTransport,
+    HealthMonitor, TcpHeartbeatTransport, flag_stragglers, make_transport,
+)
+from dtf_tpu.resilience.supervisor import (
+    SupervisorGaveUp, classify_exit, run_elastic_hosts, run_supervised,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def wait_for(predicate, timeout_s=10.0, poll_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return predicate()
+
+
+class TestStragglerPolicy:
+    def test_flags_slower_than_median_factor(self):
+        assert flag_stragglers([10.0, 10.0, 25.0, 10.0], 2.0) == [2]
+
+    def test_factor_at_most_one_disables(self):
+        assert flag_stragglers([10.0, 1000.0], 1.0) == []
+        assert flag_stragglers([10.0, 1000.0], 0.0) == []
+
+    def test_single_host_never_flags(self):
+        assert flag_stragglers([999.0], 2.0) == []
+
+    def test_median_not_mean(self):
+        """One dying host must not drag the baseline up and mask itself
+        (mean of [10,10,10,1000] is 257 — a 2x-mean rule would miss a
+        500ms host; the median rule does not)."""
+        assert flag_stragglers([10.0, 10.0, 10.0, 500.0], 2.0) == [3]
+
+    def test_nonfinite_timing_is_flagged(self):
+        assert flag_stragglers([float("nan"), 10.0, 10.0], 1.5) == [0]
+
+
+class TestFileTransport:
+    def test_beat_roundtrip_and_departed(self, tmp_path):
+        a = FileHeartbeatTransport(str(tmp_path), 0)
+        b = FileHeartbeatTransport(str(tmp_path), 1)
+        a.beat(3)
+        b.beat(7)
+        assert a.read_beats() == {0: 3, 1: 7}
+        b.beat(DEPARTED)
+        assert a.read_beats()[1] == DEPARTED
+
+    def test_poison_plant_and_overwrite(self, tmp_path):
+        """Planting overwrites: a pill left by a previous elastic round
+        (which relaunched monitors ignore by identity) must not block
+        this round's verdict."""
+        t = FileHeartbeatTransport(str(tmp_path), 0)
+        assert t.read_poison() is None
+        t.plant_poison("peer 1 missed budget", source=0)
+        assert t.read_poison()["source"] == 0
+        t.plant_poison("this round's verdict", source=1)
+        p = t.read_poison()
+        assert p["reason"] == "this round's verdict" and p["source"] == 1
+
+    def test_beat_returns_poison(self, tmp_path):
+        t = FileHeartbeatTransport(str(tmp_path), 0)
+        assert t.beat(1) is None
+        t.plant_poison("why", source=1)
+        assert t.beat(2)["reason"] == "why"
+
+    def test_make_transport_selects_scheme(self, tmp_path):
+        t = make_transport(str(tmp_path / "hb"), 0, True)
+        assert isinstance(t, FileHeartbeatTransport)
+        t2 = make_transport("tcp://127.0.0.1:0", 0, True)
+        assert isinstance(t2, TcpHeartbeatTransport)
+        t2.close()
+
+
+class TestTcpTransport:
+    def test_beat_and_poison_over_socket(self):
+        coord = TcpHeartbeatTransport("127.0.0.1:0", 0, True)
+        try:
+            addr = "127.0.0.1:%d" % coord._server.address[1]
+            client = make_transport(f"tcp://{addr}", 1, False)
+            assert client.beat(1) is None
+            assert coord.read_beats() == {1: 1}
+            assert not client.observes_peers and coord.observes_peers
+            coord.plant_poison("host 2 missed budget", source=0)
+            assert client.beat(2)["reason"] == "host 2 missed budget"
+            assert client.read_poison()["source"] == 0
+        finally:
+            coord.close()
+
+    def test_client_can_plant_poison(self):
+        coord = TcpHeartbeatTransport("127.0.0.1:0", 0, True)
+        try:
+            addr = "127.0.0.1:%d" % coord._server.address[1]
+            client = make_transport(f"tcp://{addr}", 1, False)
+            client.plant_poison("I saw it first", source=1)
+            assert coord.read_poison()["reason"] == "I saw it first"
+        finally:
+            coord.close()
+
+    def test_unreachable_coordinator_counts_failures(self):
+        client = TcpHeartbeatTransport("127.0.0.1:1", 1, False)
+        client.beat(1)
+        client.beat(2)
+        assert client.consecutive_failures == 2
+
+    def test_malformed_requests_do_not_kill_the_server(self):
+        """A port scanner / HTTP probe / buggy client must get an err
+        reply, not kill the serve thread (a dead beat sink would read as
+        a dead coordinator and self-isolate every healthy client)."""
+        import socket
+
+        coord = TcpHeartbeatTransport("127.0.0.1:0", 0, True)
+        try:
+            addr = coord._server.address
+
+            def raw(line):
+                with socket.create_connection(addr, timeout=2) as c:
+                    c.sendall((line + "\n").encode())
+                    return c.makefile("r").readline().strip()
+
+            assert raw("beat notanint alsonot").startswith("err")
+            assert raw("GET / HTTP/1.1").startswith("err")
+            assert raw("poison }{garbage").startswith("err")
+            # the server is still alive and serving real beats
+            client = make_transport(
+                "tcp://127.0.0.1:%d" % addr[1], 1, False)
+            assert client.beat(1) is None
+            assert coord.read_beats() == {1: 1}
+        finally:
+            coord.close()
+
+
+class _Recorder:
+    """Injected abort hook: records instead of os._exit."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, code, reason):
+        self.calls.append((code, reason))
+
+
+def _monitor(tmp_path, pid, nproc, recorder, **kw):
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("miss_budget", 3)
+    kw.setdefault("boot_grace_s", 1.0)
+    return HealthMonitor(FileHeartbeatTransport(str(tmp_path), pid),
+                         pid, nproc, on_abort=recorder,
+                         print_fn=lambda msg: None, **kw)
+
+
+class TestHealthMonitor:
+    def test_dead_peer_plants_poison_and_aborts(self, tmp_path):
+        """A peer whose beats stop past the miss budget: the observer
+        plants the pill and exits EXIT_PEER_LOST — the no-more-hanging-
+        in-psum guarantee."""
+        rec0, rec1 = _Recorder(), _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, rec1).start()
+        try:
+            time.sleep(0.4)
+            assert not rec0.calls and not rec1.calls   # both healthy
+            m1._stop.set()                             # abrupt death: no
+            m1._thread.join()                          # DEPARTED written
+            assert wait_for(lambda: rec0.calls), "no abort"
+            code, reason = rec0.calls[0]
+            assert code == EXIT_PEER_LOST
+            assert "missed" in reason
+            assert m0.aborted == reason
+            poison = json.load(open(tmp_path / "poison.json"))
+            assert poison["source"] == 0
+        finally:
+            m0._stop.set()
+            m1._stop.set()
+
+    def test_clean_departure_is_not_death(self, tmp_path):
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+        try:
+            time.sleep(0.3)
+            m1.close()                                 # writes DEPARTED
+            time.sleep(0.6)
+            assert not rec0.calls, rec0.calls
+        finally:
+            m0._stop.set()
+
+    def test_poison_pill_aborts_observers(self, tmp_path):
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        try:
+            FileHeartbeatTransport(str(tmp_path), 1).plant_poison(
+                "process 2 lost", source=1)
+            assert wait_for(lambda: rec0.calls)
+            assert rec0.calls[0][0] == EXIT_PEER_LOST
+            assert "poison" in rec0.calls[0][1]
+        finally:
+            m0._stop.set()
+
+    def test_own_poison_does_not_reabort(self, tmp_path):
+        """The planter already aborted once; seeing its own pill on a
+        later loop must not double-fire (source check)."""
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0)
+        m0.transport.plant_poison("mine", source=0)
+        m0.start()
+        time.sleep(0.3)
+        m0._stop.set()
+        assert all("mine" not in r for _, r in rec0.calls)
+
+    def test_coordinator_publishes_snapshot(self, tmp_path):
+        m0 = _monitor(tmp_path, 0, 2, _Recorder()).start()
+        m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+        try:
+            assert wait_for(
+                lambda: os.path.exists(tmp_path / "health.json"))
+            snap = json.load(open(tmp_path / "health.json"))
+            assert snap["coordinator"] == 0
+            assert set(snap["processes"]) == {"0", "1"}
+            assert snap["miss_budget"] == 3
+        finally:
+            m0._stop.set()
+            m1._stop.set()
+
+    def test_partitioned_host_self_isolates(self, tmp_path):
+        """partition@S semantics: the cut-off side exits
+        EXIT_SELF_ISOLATED (never mistaken for a survivor), the majority
+        side plants the pill and exits EXIT_PEER_LOST."""
+        rec0, rec1 = _Recorder(), _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, rec1).start()
+        try:
+            time.sleep(0.3)
+            m1.partition()
+            assert wait_for(lambda: rec0.calls and rec1.calls)
+            assert rec1.calls[0][0] == EXIT_SELF_ISOLATED
+            assert rec0.calls[0][0] == EXIT_PEER_LOST
+        finally:
+            m0._stop.set()
+            m1._stop.set()
+
+    def test_all_peers_quiet_means_self_isolated(self, tmp_path):
+        """>= 2 independent peers all going quiet at once: the observer
+        concludes IT is the partitioned one (exit 72, not 71)."""
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 3, rec0, boot_grace_s=0.2).start()
+        try:
+            assert wait_for(lambda: rec0.calls)
+            assert rec0.calls[0][0] == EXIT_SELF_ISOLATED
+        finally:
+            m0._stop.set()
+
+    def test_single_peer_quiet_is_peer_lost(self, tmp_path):
+        """With ONE peer the evidence is symmetric — default to survivor
+        semantics (71) so a 2-host job's healthy half elastically
+        restarts."""
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0, boot_grace_s=0.2).start()
+        try:
+            assert wait_for(lambda: rec0.calls)
+            assert rec0.calls[0][0] == EXIT_PEER_LOST
+        finally:
+            m0._stop.set()
+
+    def test_stale_pill_from_previous_round_is_ignored(self, tmp_path):
+        """Elastic relaunch over the same rendezvous dir: the previous
+        round's pill must not abort the new round on arrival — but a NEW
+        pill must still fire."""
+        FileHeartbeatTransport(str(tmp_path), 9).plant_poison(
+            "last round's casualty", source=9)
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+        try:
+            time.sleep(0.4)
+            assert not rec0.calls, rec0.calls      # stale pill ignored
+            m0.transport.plant_poison("fresh verdict", source=1)
+            assert wait_for(lambda: rec0.calls)
+            assert "fresh verdict" in rec0.calls[0][1]
+        finally:
+            m0._stop.set()
+            m1._stop.set()
+
+    def test_departed_unlatches_for_reused_slot(self, tmp_path):
+        """After an elastic relaunch a slot's beat file may still hold the
+        previous owner's DEPARTED marker; fresh beats must resurrect the
+        slot — and its later death must be detected again."""
+        FileHeartbeatTransport(str(tmp_path), 1).beat(DEPARTED)
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+        try:
+            time.sleep(0.4)
+            assert not rec0.calls                  # peer 1 alive again
+            m1._stop.set()                         # abrupt death
+            m1._thread.join()
+            assert wait_for(lambda: rec0.calls), \
+                "DEPARTED latch masked a real death"
+            assert rec0.calls[0][0] == EXIT_PEER_LOST
+        finally:
+            m0._stop.set()
+
+    def test_crash_close_does_not_mark_departed(self, tmp_path):
+        """fit's crash path closes with mark_departed=False: the beats
+        just stop, and the peers' abort protocol (correctly) fires."""
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0).start()
+        m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+        try:
+            time.sleep(0.3)
+            m1.close(mark_departed=False)          # crashed, not done
+            assert wait_for(lambda: rec0.calls)
+            assert rec0.calls[0][0] == EXIT_PEER_LOST
+        finally:
+            m0._stop.set()
+
+    def test_wait_for_peers_barrier(self, tmp_path):
+        """Startup rendezvous: returns once every peer has beaten, times
+        out (False) when one never shows."""
+        m0 = _monitor(tmp_path, 0, 2, _Recorder()).start()
+        try:
+            assert not m0.wait_for_peers(timeout_s=0.3)   # peer absent
+            m1 = _monitor(tmp_path, 1, 2, _Recorder()).start()
+            try:
+                assert m0.wait_for_peers(timeout_s=10.0)
+                assert m1.wait_for_peers(timeout_s=10.0)
+            finally:
+                m1._stop.set()
+        finally:
+            m0._stop.set()
+
+    def test_straggler_feed_reaches_metrics(self, tmp_path):
+        """The trainer's sync-point feed: per-host step times land as
+        health/ scalars in metrics.csv, flagged hosts get a console
+        line."""
+        from dtf_tpu.train.metrics import MetricLogger
+
+        logger = MetricLogger(str(tmp_path / "logs"), is_coordinator=True,
+                              quiet=True)
+        logger.stragglers(7, [10.0, 30.0], flagged=[1])
+        logger.close()
+        rows = open(tmp_path / "logs" / "metrics.csv").read()
+        assert "health/step_ms_p0" in rows and "health/step_ms_p1" in rows
+        assert "health/stragglers" in rows
+
+    def test_boot_grace_covers_slow_starters(self, tmp_path):
+        rec0 = _Recorder()
+        m0 = _monitor(tmp_path, 0, 2, rec0, boot_grace_s=10.0).start()
+        try:
+            time.sleep(0.5)      # way past miss budget, inside boot grace
+            assert not rec0.calls
+        finally:
+            m0._stop.set()
+
+
+class TestExitClassification:
+    def test_classify(self):
+        from dtf_tpu.train.checkpoint import CheckpointMismatchError
+        from dtf_tpu.train.trainer import TrainingDiverged
+
+        assert classify_exit(TrainingDiverged("nan storm")) == "terminal"
+        assert classify_exit(CheckpointMismatchError("x")) == "terminal"
+        assert classify_exit(RuntimeError("transient")) == "retryable"
+        flagged = RuntimeError("refused resume")
+        flagged.no_restart = True
+        assert classify_exit(flagged) == "terminal"
+
+    def test_training_diverged_does_not_burn_restarts(self):
+        """The unwinnable-loop fix: a deterministic divergence fails fast
+        on attempt 0 instead of replaying through the whole budget."""
+        from dtf_tpu.train.trainer import TrainingDiverged
+
+        calls = []
+
+        def fit_once(attempt):
+            calls.append(attempt)
+            raise TrainingDiverged("persists across rollbacks")
+
+        with pytest.raises(TrainingDiverged):
+            run_supervised(fit_once, max_restarts=5, sleep=lambda s: None)
+        assert calls == [0]
+
+
+def _exit_cmd(code):
+    return [sys.executable, "-c", f"import sys; sys.exit({code})"]
+
+
+class TestElasticHosts:
+    def test_completes_on_survivors_after_host_loss(self):
+        """Round 0: slot 1 dies (rc 9), slot 0 coordinated-aborts (71).
+        Round 1 relaunches ONLY the survivor, reindexed to slot 0, and
+        completes."""
+        rounds = []
+
+        def build_cmd(slot, n_hosts, round_idx):
+            rounds.append((round_idx, slot, n_hosts))
+            if round_idx == 0:
+                return _exit_cmd(9 if slot == 1 else EXIT_PEER_LOST)
+            return _exit_cmd(0)
+
+        outs, n_final, used = run_elastic_hosts(build_cmd, 2, max_rounds=2)
+        assert (n_final, used) == (1, 1)
+        assert len(outs) == 1
+        assert rounds == [(0, 0, 2), (0, 1, 2), (1, 0, 1)]
+
+    def test_self_isolated_host_is_not_a_survivor(self):
+        """Exit 72 (partitioned side) must be excluded from the relaunch
+        set — only 71/0 count."""
+        seen = []
+
+        def build_cmd(slot, n_hosts, round_idx):
+            seen.append((round_idx, n_hosts))
+            if round_idx == 0:
+                return _exit_cmd(EXIT_SELF_ISOLATED if slot == 2
+                                 else EXIT_PEER_LOST)
+            return _exit_cmd(0)
+
+        outs, n_final, used = run_elastic_hosts(build_cmd, 3, max_rounds=1)
+        assert (n_final, used) == (2, 1)
+        assert (1, 2) in seen
+
+    def test_gives_up_when_rounds_exhausted(self):
+        def build_cmd(slot, n_hosts, round_idx):
+            return _exit_cmd(9 if slot == n_hosts - 1 else EXIT_PEER_LOST)
+
+        with pytest.raises(SupervisorGaveUp) as ei:
+            run_elastic_hosts(build_cmd, 3, max_rounds=1)
+        assert len(ei.value.history) == 2
+
+    def test_gives_up_when_no_survivors(self):
+        def build_cmd(slot, n_hosts, round_idx):
+            return _exit_cmd(9)
+
+        with pytest.raises(SupervisorGaveUp):
+            run_elastic_hosts(build_cmd, 2, max_rounds=5)
+
+    def test_hung_host_is_killed_and_counted_dead(self):
+        def build_cmd(slot, n_hosts, round_idx):
+            if round_idx == 0 and slot == 1:
+                return [sys.executable, "-c",
+                        "import time; time.sleep(600)"]
+            return _exit_cmd(EXIT_PEER_LOST if round_idx == 0 else 0)
+
+        outs, n_final, used = run_elastic_hosts(
+            build_cmd, 2, max_rounds=1, timeout_s=3.0)
+        assert (n_final, used) == (1, 1)
+        assert "killed" in outs[0] or n_final == 1
+
+
+class TestPreemptionExtensions:
+    def test_sigint_optional(self):
+        from dtf_tpu.utils.preemption import PreemptionHandler
+
+        assert PreemptionHandler.signals_for(False) == (signal.SIGTERM,)
+        assert PreemptionHandler.signals_for(True) == (signal.SIGTERM,
+                                                       signal.SIGINT)
+        h = PreemptionHandler(signals=PreemptionHandler.signals_for(True))
+        try:
+            assert h.trigger_count == 0
+            os.kill(os.getpid(), signal.SIGINT)
+            time.sleep(0.05)
+            assert h.triggered and h.trigger_count == 1
+            assert h.received == [signal.SIGINT]
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+            assert h.trigger_count == 2
+        finally:
+            h.restore()
+
+
+class TestMeshShrink:
+    def test_shrinks_data_axis(self):
+        from dtf_tpu.parallel.mesh import shrink_to_devices
+
+        spec = shrink_to_devices("data=8", 4)
+        assert spec.sizes == (4,)
+        spec = shrink_to_devices("data=4,tensor=2", 4)
+        assert spec.names == ("data", "tensor") and spec.sizes == (2, 2)
+
+    def test_inferred_axis_unchanged(self):
+        from dtf_tpu.parallel.mesh import shrink_to_devices
+
+        assert shrink_to_devices("data=-1", 3).sizes == (-1,)
+
+    def test_model_axes_never_degrade(self):
+        from dtf_tpu.parallel.mesh import shrink_to_devices
+
+        with pytest.raises(ValueError, match="model axes"):
+            shrink_to_devices("data=4,tensor=2", 3)
+        with pytest.raises(ValueError, match="no data axis"):
+            shrink_to_devices("tensor=4", 2)
+
+    def test_bootstrap_elastic_refits_fixed_mesh(self):
+        """--elastic: a fixed data=16 spec sized for the pre-failure
+        cluster re-fits onto this rig's 8 simulated devices."""
+        from dtf_tpu.cluster import bootstrap
+        from dtf_tpu.config import ClusterConfig
+
+        cluster = bootstrap(ClusterConfig(mesh="data=16", elastic=True))
+        assert cluster.mesh.shape["data"] == 8
+
+    def test_manifest_records_writer_nproc(self, tmp_path, mesh8):
+        from dtf_tpu import optim
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.checkpoint import CheckpointManager
+        from dtf_tpu.train.trainer import init_state
+
+        state = init_state(MnistMLP(init_scale="fan_in"), optim.sgd(0.1),
+                           seed=1, mesh=mesh8)
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        mgr.save(5, state, force=True)
+        mgr.wait()
+        assert mgr.manifest_meta(5)["nproc"] == 1
+        mgr.close()
+
+
+class TestClusterStartHealth:
+    def test_single_process_returns_none(self):
+        from dtf_tpu.cluster import Cluster
+        from dtf_tpu.config import ClusterConfig
+        from dtf_tpu.parallel.mesh import make_mesh
+
+        c = Cluster(config=ClusterConfig(hb_interval_s=0.5,
+                                         health_dir="/tmp/x"),
+                    mesh=make_mesh("data=8"))
+        assert c.start_health() is None
+
+    def test_requires_health_dir_at_config_time(self):
+        """Cross-field validation at construction, not first at fit time:
+        a multi-host job must not burn bootstrap + compile before
+        learning its heartbeat config is incomplete."""
+        from dtf_tpu.config import ClusterConfig
+
+        with pytest.raises(ValueError, match="health_dir"):
+            ClusterConfig(hb_interval_s=0.5)
+        ClusterConfig(hb_interval_s=0.5, health_dir="/shared/hb")
+        ClusterConfig(hb_interval_s=0.5,
+                      health_dir="tcp://coordinator:8099")

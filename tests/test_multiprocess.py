@@ -250,6 +250,105 @@ class TestMultiProcess:
              for task, job in ((0, "worker"), (1, "ps"))],
             n_local_devices=2, cwd=tmp_path)
 
+    # Cluster failure schedule for the ISSUE-2 scenarios: host 1 dies
+    # abruptly (SIGKILL) before step 8; per-step pacing keeps host 0
+    # demonstrably mid-run when the loss is detected (and makes host 1 a
+    # flagged straggler while it lives).
+    # Timeline after the lockstep barrier: host 1 (100ms/step) dies at
+    # its step 20 (~2s) — after host 0 (250ms/step) commits its step-5
+    # checkpoint (~1.3s), before either host's 30-step budget completes.
+    _HOST_DOWN_CHAOS = ("slow_host@0:0:250ms,slow_host@0:1:100ms,"
+                        "host_down@20:1")
+
+    @pytest.mark.chaos
+    def test_host_down_coordinated_abort(self, tmp_path):
+        """THE ISSUE-2 acceptance bar, detection half: host_down@20:1
+        kills process 1 abruptly (SIGKILL, no goodbye) mid-run.  Process 0 must
+        be freed by the health monitor's poison-pill coordinated abort
+        (exit 71) within the heartbeat budget — NOT run to its own
+        timeout, and NOT exit cleanly."""
+        import signal
+        import time
+
+        driver = os.path.join(REPO_ROOT, "tests", "_mp_health.py")
+        shared = str(tmp_path / "shared")
+        t0 = time.monotonic()
+        procs = [subprocess.Popen(
+            [sys.executable, driver, str(task), "2", shared, "2000", "4",
+             self._HOST_DOWN_CHAOS],
+            cwd=tmp_path, env=child_env(4),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for task in range(2)]
+        try:
+            outs = [p.communicate(timeout=300)[0] for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        elapsed = time.monotonic() - t0
+        # task 1 died by its own SIGKILL; task 0 took the coordinated
+        # abort exit, with the poison pill and stack dump on record.
+        assert procs[1].returncode in (-signal.SIGKILL,
+                                       128 + signal.SIGKILL), \
+            f"task 1 should die by SIGKILL:\n{outs[1][-2000:]}"
+        assert procs[0].returncode == 71, \
+            f"task 0 should exit EXIT_PEER_LOST(71), got " \
+            f"{procs[0].returncode}:\n{outs[0][-3000:]}"
+        assert "HEALTH" in outs[0] and "missed" in outs[0], outs[0][-2000:]
+        assert os.path.exists(os.path.join(shared, "health", "poison.json"))
+        # "within the heartbeat budget": max_steps=2000 means task 0 can
+        # ONLY exit through the abort; the whole run (jax startup + a few
+        # paced steps + detection) lands far below the rig timeout.
+        assert elapsed < 240, f"abort took {elapsed:.0f}s — wedged?"
+
+    @pytest.mark.chaos
+    def test_elastic_restart_resumes_on_survivor(self, tmp_path):
+        """THE ISSUE-2 acceptance bar, recovery half: a 2-host run loses
+        host 1; run_elastic_hosts relaunches the SURVIVOR as a 1-host job
+        on a SHRUNKEN mesh (4 -> 2 devices), which reshards the last
+        intact checkpoint through the restore template and finishes —
+        with the SAME final loss as a fault-free run (trajectory
+        invariance across the shrink)."""
+        import re
+
+        from dtf_tpu.resilience.supervisor import run_elastic_hosts
+
+        driver = os.path.join(REPO_ROOT, "tests", "_mp_health.py")
+        shared = str(tmp_path / "shared")
+
+        def build_cmd(slot, n_hosts, round_idx):
+            chaos = self._HOST_DOWN_CHAOS if round_idx == 0 else ""
+            devices = "4" if round_idx == 0 else "2"
+            return [sys.executable, driver, str(slot), str(n_hosts),
+                    shared, "30", devices, chaos]
+
+        outs, n_final, rounds = run_elastic_hosts(
+            build_cmd, 2, max_rounds=2, env=child_env(4),
+            cwd=str(tmp_path), timeout_s=300)
+        assert (n_final, rounds) == (1, 1), (n_final, rounds, outs)
+        done = re.search(r"MP_HEALTH_DONE steps=(\d+) "
+                         r"final_cost=([0-9.]+)", outs[0])
+        assert done, outs[0][-3000:]
+        assert int(done.group(1)) == 30
+        assert "resumed from step" in outs[0], outs[0][-3000:]
+
+        # Fault-free reference over the same trajectory (the restart
+        # resumed the last intact checkpoint of the SAME trajectory, so
+        # the two runs coincide step-for-step).
+        ref = subprocess.run(
+            [sys.executable, driver, "0", "1", str(tmp_path / "ref"),
+             "30", "2", ""],
+            cwd=tmp_path, env=child_env(4), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=300)
+        assert ref.returncode == 0, ref.stdout[-3000:]
+        ref_done = re.search(r"MP_HEALTH_DONE steps=(\d+) "
+                             r"final_cost=([0-9.]+)", ref.stdout)
+        assert ref_done, ref.stdout[-3000:]
+        assert abs(float(done.group(2))
+                   - float(ref_done.group(2))) < 2e-3, \
+            f"elastic-restart loss {done.group(2)} != fault-free " \
+            f"{ref_done.group(2)}"
+
     def test_two_process_restore_robust_fallback(self, tmp_path):
         """Multi-host restore_robust (tests/_mp_restore_robust.py): with
         the latest checkpoint corrupted on a shared directory, BOTH
