@@ -33,7 +33,7 @@ Typical use::
 """
 
 from dtf_tpu.version import __version__
-from dtf_tpu import cluster, config, optim
+from dtf_tpu import cluster, config, optim, telemetry
 from dtf_tpu.cluster import Cluster, bootstrap
 from dtf_tpu.config import ClusterConfig, TrainConfig, parse_args
 from dtf_tpu.parallel import mesh, sharding
@@ -44,6 +44,7 @@ from dtf_tpu.train.trainer import (Trainer, init_state, make_eval_fn,
 
 __all__ = [
     "__version__", "cluster", "config", "mesh", "sharding", "optim",
+    "telemetry",
     "Cluster", "bootstrap", "ClusterConfig", "TrainConfig", "parse_args",
     "make_mesh", "Trainer", "init_state", "make_eval_fn", "make_train_step",
     "put_global_batch", "put_process_batch",

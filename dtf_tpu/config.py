@@ -203,6 +203,18 @@ class TrainConfig:
     # crash or preemption -> restore the last checkpoint and go again.
     # 0 disables (single attempt).
     max_restarts: int = 0
+    # Telemetry spine (dtf_tpu/telemetry): span tracer to
+    # <logdir>/spans.p<k>.jsonl, registry snapshots to
+    # <logdir>/telemetry.json, goodput accounting.  --no-telemetry turns
+    # the on-disk artifacts off (the in-process registry still runs).
+    telemetry: bool = True
+    # Attempt tag for metrics.csv rows (telemetry/report de-duplicates
+    # overlapping step ranges by latest attempt).  0 = automatic: any
+    # resumed run — in-process supervisor restart or --resume relaunch —
+    # continues past the file's last recorded attempt (MetricLogger.
+    # for_config); set explicitly only when an external scheduler counts
+    # its own relaunches.
+    attempt: int = 0
 
     def __post_init__(self):
         if self.profile_summary and not self.profile_dir:

@@ -66,9 +66,11 @@ class _ShuffledSplit:
         return idx
 
     def next_batch(self, batch_size: int):
-        idx = self._advance(batch_size)
-        self.batches_consumed += 1
-        return self.take(idx)
+        from dtf_tpu import telemetry as tel
+        with tel.span("data/next_batch", n=batch_size):
+            idx = self._advance(batch_size)
+            self.batches_consumed += 1
+            return self.take(idx)
 
     def fast_forward(self, n_batches: int, batch_size: int) -> None:
         """Advance the shuffle cursor as if ``next_batch`` had been called

@@ -84,8 +84,12 @@ class NativeDataset:
         # producer thread is a different failure class: it blocks inside
         # the C++ wait, which the trainer's hang watchdog (not this retry)
         # converts into a fail-fast exit.
-        retry_call(pull, attempts=3, backoff=self._retry_backoff,
-                   retry_on=(OSError,), what="native loader next_batch")
+        from dtf_tpu import telemetry as tel
+        with tel.span("data/next_batch", n=batch_size, native=1):
+            retry_call(pull, attempts=3, backoff=self._retry_backoff,
+                       retry_on=(OSError,), what="native loader next_batch",
+                       on_retry=lambda a, e: tel.counter(
+                           "data/fetch_retries_total").inc())
         self.batches_consumed += 1
         return imgs, labs
 

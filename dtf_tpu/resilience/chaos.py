@@ -235,13 +235,26 @@ class FaultPlan:
                         and step != f.last_fired_step):
                     f.last_fired_step = step
                     log.warning("[chaos] firing %s (step %d)", f, step)
+                    self._mark_fired(f, step)
                     return f
                 continue
             if not f.fired and f.step == step:
                 f.fired = True
                 log.warning("[chaos] firing %s", f)
+                self._mark_fired(f, step)
                 return f
         return None
+
+    @staticmethod
+    def _mark_fired(fault: Fault, step: Optional[int]) -> None:
+        """Telemetry: an eagerly-flushed timeline instant + a fired
+        counter — written BEFORE the fault's side effect runs, because for
+        host_down/sigterm there is no after."""
+        from dtf_tpu import telemetry as tel
+        tel.counter("chaos/faults_fired_total").inc()
+        tel.instant(f"chaos/{fault.kind}",
+                    **({"step": step} if step is not None else {}),
+                    spec=str(fault))
 
     # -- injection hooks (trainer calls these) ------------------------------
 

@@ -506,6 +506,19 @@ class HealthMonitor:
         return self._aborted
 
     def _abort(self, code: int, reason: str) -> None:
+        # Timeline instant + counter, BEFORE the abort callback: the
+        # default callback is os._exit, so there is no after.  The
+        # eagerly-flushed span file is how the post-mortem learns which
+        # host pulled the pill and why.  Best-effort ONLY — a full disk /
+        # unwritable logdir (plausible in exactly the degraded scenarios
+        # that trigger aborts) must not skip the abort and convert
+        # fail-fast into a distributed hang.
+        try:
+            from dtf_tpu import telemetry as tel
+            tel.counter(f"event/health_abort_{code}").inc()
+            tel.instant("health/abort", code=code, reason=reason)
+        except Exception:
+            pass
         self._aborted = reason
         self._stop.set()
         self._on_abort(code, reason)
@@ -599,6 +612,12 @@ class HealthMonitor:
                     reason = (f"process(es) {sorted(stale)} missed "
                               f"{self.miss_budget} heartbeats "
                               f"({self.miss_budget * self.interval_s:g}s)")
+                    try:        # best-effort: never block the poison plant
+                        from dtf_tpu import telemetry as tel
+                        tel.instant("health/peer_stale",
+                                    peers=sorted(stale), reason=reason)
+                    except Exception:
+                        pass
                     try:
                         self.transport.plant_poison(reason,
                                                     self.process_index)

@@ -31,6 +31,7 @@ import subprocess
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from dtf_tpu import telemetry as tel
 from dtf_tpu.utils.retry import Backoff
 
 log = logging.getLogger("dtf_tpu")
@@ -95,6 +96,11 @@ def run_supervised(fit_once: Callable[[int], Any], *,
     last_exc: Optional[BaseException] = None
     for attempt in range(max_restarts + 1):
         try:
+            # (No span around the attempt itself: the trainer binds the
+            # tracer INSIDE fit_once, so a span entered here would capture
+            # the previous attempt's closed tracer and silently vanish.
+            # The restart instant + backoff span below land on the still-
+            # open tracer of the attempt that just failed.)
             result = fit_once(attempt)
         except retry_on as exc:
             if classify_exit(exc) == "terminal":
@@ -112,15 +118,23 @@ def run_supervised(fit_once: Callable[[int], Any], *,
                              "%d restart(s)", attempt + 1, attempt)
                 return result
             why = "preempted"
+        # Goodput: downtime starts HERE (the failure point) and runs
+        # until the next attempt's trainer starts building — the trainer
+        # closes the window (goodput.mark_up) into the restart bucket.
+        tel.get_tracker().mark_down()
         history.append((attempt, why))
         if attempt < max_restarts:
             d = backoff.delay_s(attempt)
             log.warning("supervisor: attempt %d %s; restarting from last "
                         "checkpoint in %.2fs (%d/%d restarts used)",
                         attempt + 1, why, d, attempt + 1, max_restarts)
+            tel.counter("supervisor/restarts_total").inc()
+            tel.instant("event/supervisor_restart", attempt=attempt,
+                        why=why)
             if on_restart is not None:
                 on_restart(attempt, why)
-            sleep(d)
+            with tel.span("supervisor/backoff", delay_s=round(d, 3)):
+                sleep(d)
     raise SupervisorGaveUp(max_restarts, history) from last_exc
 
 
@@ -154,6 +168,12 @@ def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
         plan = FaultPlan.parse(plan)
 
     def fit_once(attempt: int):
+        # No explicit attempt tag: resumed attempts auto-continue past the
+        # metrics.csv file's last recorded attempt (MetricLogger), which
+        # stays monotonic even when the file already holds attempts from a
+        # PREVIOUS supervised run of the same logdir — an absolute
+        # attempt=1 here could sort below them and corrupt the report's
+        # latest-attempt de-duplication.
         cfg = dataclasses.replace(base_cfg,
                                   resume=base_cfg.resume or attempt > 0)
         trainer = trainer_factory(cfg, plan)

@@ -1,0 +1,69 @@
+"""Unified telemetry spine (DESIGN.md §6 "Observability").
+
+Three coordinated pieces, every layer reports into them:
+
+* **Spans** (:mod:`.spans`) — host-side structured tracer; JSON-lines on
+  disk, exportable to Chrome-trace/Perfetto so it overlays with the XLA
+  profiler window.  ``with telemetry.span("checkpoint/save"): ...``
+* **Metric registry** (:mod:`.registry`) — process-wide counters /
+  gauges / histograms with deterministic snapshots; serialized to
+  ``<logdir>/telemetry.json`` and fed through the MetricLogger CSV/TB
+  stream at logging sync points.
+* **Goodput accounting** (:mod:`.goodput`) — productive vs. rollback /
+  restart / stall / checkpoint / compile wall-clock, plus the shared
+  MFU / tokens-per-sec formulas.
+
+``python -m dtf_tpu.telemetry.report <logdir>`` merges all of it (plus
+metrics.csv, health.json, and any XLA trace summary) into one run
+post-mortem.  Instrument and span names are registered in
+:mod:`.names` — ``scripts/check_telemetry_names.py`` lints the source
+against that table.
+
+Pure stdlib (no jax import at module load): safe to import from every
+layer, including ones that must work before devices exist.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from dtf_tpu.telemetry import names  # noqa: F401  (re-export)
+from dtf_tpu.telemetry.goodput import GoodputTracker, get_tracker
+from dtf_tpu.telemetry.registry import (MetricRegistry, counter, gauge,
+                                        get_registry, histogram)
+from dtf_tpu.telemetry.spans import (Tracer, configure, export_chrome_trace,
+                                     get_tracer, instant, span)
+
+TELEMETRY_FILE = "telemetry.json"
+
+__all__ = [
+    "GoodputTracker", "MetricRegistry", "Tracer", "TELEMETRY_FILE",
+    "configure", "counter", "export_chrome_trace", "gauge", "get_registry",
+    "get_tracer", "get_tracker", "histogram", "instant", "names",
+    "reset", "span", "write_telemetry_json",
+]
+
+
+def write_telemetry_json(logdir: str, extra: Optional[dict] = None) -> str:
+    """Serialize the registry snapshot + goodput books to
+    ``<logdir>/telemetry.json`` (atomic replace).  Cheap enough for every
+    logging sync point, so even a SIGKILL'd host leaves a recent file."""
+    path = os.path.join(logdir, TELEMETRY_FILE)
+    doc = {"goodput": get_tracker().snapshot(),
+           "written_unix": time.time()}
+    if extra:
+        doc.update(extra)
+    get_registry().write_json(path, extra=doc)
+    return path
+
+
+def reset() -> None:
+    """Forget all process-wide telemetry state (registry, goodput books,
+    tracer binding).  For tests and for a genuinely NEW run starting in a
+    process that already ran one — never called on the supervisor's
+    restart path, whose books must span attempts."""
+    get_registry().reset()
+    get_tracker().reset()
+    configure(None)

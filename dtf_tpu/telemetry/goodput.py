@@ -1,0 +1,212 @@
+"""Goodput accounting: where did the wall-clock go?
+
+Pod-scale TPU practice (MLPerf pods, pjit/TPUv4 LM runs) reports not just
+step time but **goodput** — the fraction of wall-clock spent on
+productive training versus everything self-healing costs: rollback
+restores, supervisor restart downtime, chaos/straggler stalls,
+checkpoint saves, compile time.  The resilience layer made those costs
+survivable (DESIGN.md §5); this module makes them *visible*.
+
+One process-wide :class:`GoodputTracker` that the trainer AND the
+supervisor both feed:
+
+* the trainer attributes every host-side phase of its loop
+  (``measure("productive")`` around step dispatch + sync reads,
+  ``"data"`` around fetch/put, ``"checkpoint"``, ``"rollback"``,
+  ``"eval"``, ``"stall"`` around injected/chaos sleeps, first-step
+  ``"compile"``);
+* the supervisor marks the down window (:meth:`mark_down` at crash /
+  preemption, closed by :meth:`mark_up` when the next attempt's trainer
+  starts building) as ``"restart"``;
+* a relaunched PROCESS (scheduler restart, elastic round) resumes the
+  books via :meth:`load_previous`: the buckets come off the previous
+  ``telemetry.json`` and the gap since its last write is accounted as
+  restart downtime — so productive + overhead sums to wall-clock across
+  the whole supervised run, not just one attempt.
+
+Every bucket mirrors into the registry as ``goodput/<category>_s`` so
+``telemetry.json`` and the report CLI need no side channel.  MFU /
+tokens-per-sec helpers live here too: one formula, used by the trainer's
+sync points and the benchmark driver alike.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+from dtf_tpu.telemetry import registry as _registry
+
+# Accounting categories.  "productive" is time the step pipeline is doing
+# model work (dispatch + the sync-point readback that blocks on it);
+# everything else is overhead a perfect run would not pay.  "init" covers
+# trainer construction (model init, sharding setup); "other" is the
+# explicit remainder so the report can show what escaped attribution.
+CATEGORIES = ("productive", "compile", "data", "checkpoint", "rollback",
+              "restart", "stall", "eval", "init", "other")
+
+
+class GoodputTracker:
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.buckets: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        # Lazy clock: wall-time starts at the FIRST accounted event (the
+        # trainer's mark_up), not at module import — the books describe
+        # the training run, not the Python process around it.
+        self._t0: Optional[float] = None
+        self._base_wall = 0.0          # carried over from a previous process
+        self._down_since: Optional[float] = None
+
+    def _start_clock(self) -> None:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    # -- feeding ------------------------------------------------------------
+
+    def add(self, category: str, seconds: float) -> None:
+        if category not in self.buckets:
+            raise ValueError(f"unknown goodput category {category!r}; "
+                             f"one of {CATEGORIES}")
+        self._start_clock()
+        self.buckets[category] += max(float(seconds), 0.0)
+
+    @contextlib.contextmanager
+    def measure(self, category: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(category, time.perf_counter() - t0)
+
+    def mark_down(self) -> None:
+        """Supervisor: an attempt just crashed / was preempted; downtime
+        starts now.  Idempotent (the first mark wins — the failure point,
+        not the last log line)."""
+        self._start_clock()
+        if self._down_since is None:
+            self._down_since = time.perf_counter()
+
+    def mark_up(self) -> None:
+        """Trainer construction: if a down window is open, close it into
+        the restart bucket."""
+        self._start_clock()
+        if self._down_since is not None:
+            self.add("restart", time.perf_counter() - self._down_since)
+            self._down_since = None
+
+    def load_previous(self, telemetry_json: dict) -> None:
+        """Resume the books from a previous process's ``telemetry.json``
+        (scheduler-driven --resume, elastic relaunch): restore its goodput
+        buckets and account the dead time since its last write as restart
+        downtime."""
+        prev = telemetry_json.get("goodput", {})
+        for c in CATEGORIES:
+            self.buckets[c] += float(prev.get(f"{c}_s", 0.0))
+        self._base_wall = float(prev.get("wall_s", 0.0))
+        written = telemetry_json.get("written_unix")
+        if written is not None:
+            down = time.time() - float(written)
+            if 0 < down < 7 * 24 * 3600:    # a stale file is not downtime
+                self.add("restart", down)
+                self._base_wall += down
+
+    # -- reading ------------------------------------------------------------
+
+    def wall_s(self) -> float:
+        if self._t0 is None:
+            return self._base_wall
+        return self._base_wall + (time.perf_counter() - self._t0)
+
+    def accounted_s(self) -> float:
+        return sum(self.buckets.values())
+
+    def goodput_fraction(self) -> float:
+        """Productive share of wall-clock (0 when nothing ran)."""
+        wall = self.wall_s()
+        return self.buckets["productive"] / wall if wall > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """The ``goodput`` section of telemetry.json; also mirrors every
+        bucket into the registry (``goodput/<cat>_s``) so the metric
+        stream and the JSON cannot drift."""
+        out = {f"{c}_s": round(self.buckets[c], 6) for c in CATEGORIES}
+        out["wall_s"] = round(self.wall_s(), 6)
+        out["accounted_s"] = round(self.accounted_s(), 6)
+        out["productive_fraction"] = round(self.goodput_fraction(), 6)
+        for c in CATEGORIES:
+            _registry.gauge(f"goodput/{c}_s").set(self.buckets[c])
+        _registry.gauge("goodput/productive_fraction").set(
+            out["productive_fraction"])
+        return out
+
+
+_TRACKER = GoodputTracker()
+
+
+def get_tracker() -> GoodputTracker:
+    return _TRACKER
+
+
+# -- MFU / throughput -------------------------------------------------------
+
+def tokens_per_example(model) -> float:
+    """Tokens one example contributes to throughput: the model's sequence
+    length when it has one, else 1 (classifiers)."""
+    return float(getattr(getattr(model, "cfg", None), "seq_len", 1) or 1)
+
+
+def peak_flops_for_model(model, device):
+    """``(peak_flops_per_chip, dtype_name)`` for the model's compute dtype
+    — THE MFU denominator, shared by the trainer's sync points and the
+    benchmark driver.  Peak is None when the chip is unknown (CPU)."""
+    import numpy as np
+    from dtf_tpu.bench.matmul import peak_flops_per_chip
+    dtype = np.dtype(getattr(getattr(model, "cfg", None), "dtype", None)
+                     or np.float32).name
+    return peak_flops_per_chip(device, dtype), dtype
+
+
+def train_flops_per_example(model, params) -> float:
+    """Model FLOPs for ONE training example — the numerator of MFU.
+
+    Prefers the model's own accounting (``train_flops_per_example``, e.g.
+    BERT's K-position MLM head); falls back to the standard ``6 · P · T``
+    (fwd 2PT + bwd 4PT) using the model's tokens-per-example when it has
+    a sequence dimension, else ``6 · P`` (one "token" per example —
+    mlp/resnet classifiers, where the dense matmuls dominate exactly as
+    in the LM case).  Remat recompute is correctly NOT counted.
+    """
+    if hasattr(model, "train_flops_per_example"):
+        return float(model.train_flops_per_example(params))
+    from dtf_tpu.nn.core import count_params
+    return 6.0 * float(count_params(params)) * tokens_per_example(model)
+
+
+def record_throughput(*, examples_per_s: float, tokens_per_example: float,
+                      step_ms: float, model_flops_per_example: float,
+                      n_chips: int, peak_flops_per_chip: Optional[float],
+                      ) -> dict:
+    """THE MFU/throughput formula — trainer sync points and the benchmark
+    driver both call this so there is exactly one copy.  Sets the
+    ``throughput/*`` and ``mfu/*`` gauges and returns them as a dict."""
+    tokens_per_s = examples_per_s * tokens_per_example
+    tflops_chip = (model_flops_per_example * examples_per_s
+                   / max(n_chips, 1) / 1e12)
+    out = {"examples_per_s": examples_per_s, "tokens_per_s": tokens_per_s,
+           "step_ms": step_ms, "model_tflops_per_chip": tflops_chip,
+           "mfu_pct": None}
+    _registry.gauge("throughput/examples_per_s").set(examples_per_s)
+    _registry.gauge("throughput/tokens_per_s").set(tokens_per_s)
+    _registry.gauge("throughput/step_ms").set(step_ms)
+    if model_flops_per_example > 0:
+        # No FLOPs model -> no MFU claim (a zero gauge would read as
+        # "measured zero", which is worse than absent).
+        _registry.gauge("mfu/model_tflops_per_chip").set(tflops_chip)
+        if peak_flops_per_chip:
+            out["mfu_pct"] = (tflops_chip * 1e12
+                              / peak_flops_per_chip * 100.0)
+            _registry.gauge("mfu/pct_peak").set(out["mfu_pct"])
+    return out

@@ -1,0 +1,167 @@
+"""The instrument/span naming scheme — ONE canonical table.
+
+Every metric and span name in the codebase is ``snake_case`` segments
+joined by ``/`` (scope separator): ``checkpoint/save``,
+``health/step_ms_p3``, ``goodput/rollback_s``.  Dynamic suffixes (a
+process index, an event kind) are declared here with a trailing ``*``
+wildcard.  Two consumers:
+
+* :func:`validate` — runtime guard: the registry and the tracer reject a
+  malformed name at creation time, so a typo'd scope never ships a run's
+  worth of garbage rows;
+* :func:`check_source_names` — the lint lane
+  (``scripts/check_telemetry_names.py`` and the tier-1 test): scans the
+  package source for name literals passed to ``span(``/``counter(``/
+  ``gauge(``/``histogram(``/``scalar(``/``instant(`` and fails on any
+  that is unregistered here or not scheme-shaped.  Registration is the
+  point: the report CLI and dashboards key on these strings, and an
+  undeclared name is a dashboard hole nobody notices until the
+  post-mortem needs it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Tuple
+
+# snake_case segments, slash-scoped: "cost", "train/step", "health/step_ms_p0"
+NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_]+)*$")
+# declaration patterns may end a segment with '*' (dynamic suffix)
+_DECL_RE = re.compile(r"^[a-z0-9_*]+(/[a-z0-9_*]+)*$")
+
+# -- the registered names ----------------------------------------------------
+# metrics (registry instruments / MetricLogger scalars)
+METRICS = (
+    "cost",
+    "avg_ms",
+    "test_accuracy",
+    "bad_steps_total",
+    "model_tflops_per_chip",
+    "health/step_ms_p*",          # per-host step-time overlay
+    "health/stragglers",
+    "event/*",                    # lifecycle events (rollback, preempted, ...)
+    "train/steps_total",
+    "train/bad_streak",
+    "throughput/examples_per_s",
+    "throughput/tokens_per_s",
+    "throughput/step_ms",
+    "mfu/model_tflops_per_chip",
+    "mfu/pct_peak",
+    "goodput/*",                  # per-category seconds + fraction
+    "compile/first_step_s",
+    "checkpoint/save_ms",
+    "checkpoint/saves_total",
+    "checkpoint/restores_total",
+    "checkpoint/rollbacks_total",
+    "supervisor/restarts_total",
+    "chaos/faults_fired_total",
+    "data/fetch_retries_total",
+)
+# spans (host-side tracer)
+SPANS = (
+    "train/fit",
+    "train/fetch",
+    "train/put",
+    "train/step",
+    "train/log",
+    "train/eval",
+    "checkpoint/save",
+    "checkpoint/restore",
+    "supervisor/backoff",
+    "data/next_batch",
+    "trainer/init",
+    # instants
+    "chaos/*",                    # chaos/<fault kind> firing marks
+    "health/*",                   # peer_stale / abort / poison marks
+    "event/*",
+)
+
+DECLARED: Tuple[str, ...] = tuple(sorted(set(METRICS) | set(SPANS)))
+
+
+def validate(name: str) -> str:
+    """Runtime shape check (scheme only, not registration).  Returns the
+    name so call sites can inline it."""
+    if not NAME_RE.match(name):
+        raise ValueError(
+            f"telemetry name {name!r} violates the naming scheme: "
+            f"snake_case segments joined by '/' (see telemetry/names.py)")
+    return name
+
+
+def is_declared(name: str, declared: Iterable[str] = DECLARED) -> bool:
+    """True when ``name`` matches a declaration (exact, or a ``*``-suffixed
+    pattern where ``*`` absorbs the rest of its segment and any further
+    segments)."""
+    for pat in declared:
+        if pat == name:
+            return True
+        if pat.endswith("*") and name.startswith(pat[:-1]):
+            return True
+    return False
+
+
+_NAME_FUNCS = frozenset(
+    ("span", "instant", "counter", "gauge", "histogram", "scalar"))
+
+
+def _string_literal(node) -> "str | None":
+    """A string constant or f-string (placeholders collapse to ``*`` so
+    ``f"health/step_ms_p{k}"`` lints against ``health/step_ms_p*``)."""
+    import ast
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return "".join(v.value if isinstance(v, ast.Constant)
+                       and isinstance(v.value, str) else "*"
+                       for v in node.values)
+    return None
+
+
+def extract_source_names(text: str) -> List[str]:
+    """Name literals passed to the telemetry call sites in ``text``.
+
+    AST-based (not a regex), so a complex first argument —
+    ``scalar(int(state["step"]), "name", v)`` — cannot smuggle a name
+    literal past the lint: for every call to a ``_NAME_FUNCS`` function
+    the first string literal among its first two positional arguments is
+    extracted."""
+    import ast
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = (func.attr if isinstance(func, ast.Attribute)
+                 else getattr(func, "id", None))
+        if fname not in _NAME_FUNCS:
+            continue
+        for arg in node.args[:2]:
+            name = _string_literal(arg)
+            if name is not None:
+                out.append(name)
+                break
+    return out
+
+
+def check_source_names(paths: Iterable[str]) -> List[str]:
+    """Lint: every telemetry name literal under ``paths`` must be scheme-
+    shaped and declared.  Returns a list of human-readable violations
+    (empty == clean)."""
+    problems = []
+    for path in paths:
+        with open(path) as f:
+            text = f.read()
+        for name in extract_source_names(text):
+            shape = name.replace("*", "x")      # '*' only from f-string holes
+            if not NAME_RE.match(shape):
+                problems.append(f"{path}: {name!r} is not snake_case/slash")
+            elif not is_declared(name):
+                problems.append(
+                    f"{path}: {name!r} is not declared in "
+                    f"dtf_tpu/telemetry/names.py")
+    return problems

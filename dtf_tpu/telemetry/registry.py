@@ -1,0 +1,189 @@
+"""Process-wide metric registry: counters, gauges, histograms.
+
+Replaces the ad-hoc "write a magic scalar string and hope the reader
+greps for it" pattern: an instrument is REGISTERED once (name validated
+against telemetry/names.py, type fixed), updated from anywhere in the
+process, and read back as one deterministic snapshot — the payload of
+``<logdir>/telemetry.json`` and the periodic feed into the existing
+MetricLogger CSV/TB stream.
+
+Determinism contract: :meth:`MetricRegistry.snapshot` is a pure function
+of the update history — sorted keys, plain Python floats/ints, no
+timestamps — so tests can golden it and two processes applying the same
+updates produce identical JSON.
+
+Thread-safe (one lock per registry; instruments share it).  Not
+cross-process: each process owns its registry, and only the coordinator
+serializes (same rule as MetricLogger).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from dtf_tpu.telemetry.names import validate
+
+
+class Counter:
+    """Monotonic count (events, retries, saves)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Last-written value (throughput, MFU, fractions)."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self._value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value = (self._value or 0.0) + float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max + mean) — enough for step-time
+    and save-latency distributions without a bucket-boundary bikeshed; the
+    full distribution lives in the span file anyway."""
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "count": self.count, "sum": self.total,
+                "min": self.min, "max": self.max, "mean": self.mean}
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        validate(name)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, self._lock)
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"instrument {name!r} already registered as "
+                f"{type(inst).__name__}, requested {cls.__name__}")
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._instruments)
+
+    def snapshot(self) -> dict:
+        """Deterministic: sorted by name, value types only."""
+        with self._lock:
+            items = sorted(self._instruments.items())
+        return {name: inst.snapshot() for name, inst in items}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    def load_counters(self, metrics_doc: dict) -> None:
+        """Seed lifetime counters from a previous process's
+        ``telemetry.json`` metrics section (resume path): counters are
+        cumulative by contract, so a relaunch must carry them forward.
+        Gauges/histograms stay fresh — they are point-in-time
+        observations of THIS process."""
+        for name, snap in metrics_doc.items():
+            if (isinstance(snap, dict) and snap.get("type") == "counter"
+                    and isinstance(snap.get("value"), int)):
+                try:
+                    self.counter(name).inc(snap["value"])
+                except (ValueError, TypeError):
+                    continue           # foreign/renamed instrument
+
+    def write_json(self, path: str, extra: Optional[dict] = None) -> None:
+        """Atomic ``telemetry.json`` write: {"metrics": snapshot, **extra}.
+        Called at logging sync points and on exit, so even an abrupt
+        SIGKILL leaves a recent machine-readable state on disk."""
+        doc = {"metrics": self.snapshot()}
+        if extra:
+            doc.update(extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+
+# -- the process-wide registry ----------------------------------------------
+
+_REGISTRY = MetricRegistry()
+
+
+def get_registry() -> MetricRegistry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _REGISTRY.histogram(name)

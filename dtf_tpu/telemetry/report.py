@@ -1,0 +1,277 @@
+"""Run-report CLI: one post-mortem from everything a run left on disk.
+
+    python -m dtf_tpu.telemetry.report <logdir> [--top N] [--json]
+        [--profile_dir DIR] [--export-trace OUT.json] [--check [--tol PCT]]
+
+Merges ``telemetry.json`` (goodput books + instrument snapshot),
+``metrics.csv`` (attempt-deduplicated), ``spans.p*.jsonl``,
+``health.json`` and — when an XLA profile is present — the device-op
+summary, into sections: goodput breakdown, throughput/MFU, event
+timeline, per-host step-time overlay, top spans, top XLA ops.
+
+``--check`` is the CI gate: exit non-zero unless the report renders and
+the goodput components sum to measured wall-clock within ``--tol``
+percent (default 10) — the acceptance contract for the telemetry lane.
+``--export-trace`` additionally writes the merged Chrome-trace JSON for
+Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from dtf_tpu.telemetry.goodput import CATEGORIES
+from dtf_tpu.telemetry.spans import find_span_files, read_spans
+
+
+def load_metrics_csv(path: str) -> List[Tuple[int, int, str, float]]:
+    """``[(step, attempt, metric, value)]``; legacy 3-column rows (written
+    before the attempt column existed) read as attempt 0."""
+    rows = []
+    with open(path, newline="") as f:
+        for rec in csv.reader(f):
+            if not rec or rec[0] == "step":
+                continue
+            try:
+                step, metric, value = int(rec[0]), rec[1], float(rec[2])
+                attempt = int(rec[3]) if len(rec) > 3 else 0
+            except (ValueError, IndexError):
+                continue               # torn tail from a hard kill
+            rows.append((step, attempt, metric, value))
+    return rows
+
+
+def dedupe_latest_attempt(rows) -> List[Tuple[int, int, str, float]]:
+    """A restart resumes from the last checkpoint, so attempts overlap in
+    step range; for each (step, metric) the LATEST attempt's row is the
+    one that fed the surviving trajectory."""
+    best: Dict[Tuple[int, str], Tuple[int, float]] = {}
+    for step, attempt, metric, value in rows:
+        key = (step, metric)
+        if key not in best or attempt >= best[key][0]:
+            best[key] = (attempt, value)
+    return sorted((s, a, m, v) for (s, m), (a, v) in best.items())
+
+
+def summarize_spans(paths: List[str]) -> Tuple[List[dict], List[dict]]:
+    """(per-name aggregate rows sorted by total time, instant events)."""
+    agg = defaultdict(lambda: [0, 0.0])     # name -> [count, total_us]
+    instants = []
+    for path in paths:
+        for rec in read_spans(path):
+            if rec.get("ph") == "X":
+                a = agg[rec["name"]]
+                a[0] += 1
+                a[1] += rec.get("dur", 0.0)
+            elif rec.get("ph") == "i":
+                instants.append(rec)
+    rows = [{"name": n, "count": c, "total_s": t / 1e6,
+             "mean_ms": t / 1e3 / c if c else 0.0}
+            for n, (c, t) in agg.items()]
+    rows.sort(key=lambda r: -r["total_s"])
+    instants.sort(key=lambda r: r.get("ts", 0.0))
+    return rows, instants
+
+
+def build_report(logdir: str, profile_dir: Optional[str] = None,
+                 top: int = 10) -> dict:
+    """Everything the printer / --json / --check consume, as one dict."""
+    out: dict = {"logdir": os.path.abspath(logdir)}
+
+    tpath = os.path.join(logdir, "telemetry.json")
+    if os.path.exists(tpath):
+        try:
+            with open(tpath) as f:
+                out["telemetry"] = json.load(f)
+        except ValueError as exc:
+            out["telemetry_error"] = str(exc)
+
+    cpath = os.path.join(logdir, "metrics.csv")
+    if os.path.exists(cpath):
+        raw = load_metrics_csv(cpath)
+        rows = dedupe_latest_attempt(raw)
+        out["attempts"] = sorted({a for _, a, _, _ in raw})
+        out["metrics_rows"] = len(rows)
+        out["duplicate_rows_dropped"] = len(raw) - len(rows)
+        steps = [s for s, _, m, _ in rows if m == "cost"]
+        costs = [v for _, _, m, v in rows if m == "cost"]
+        if steps:
+            out["steps"] = {"first": steps[0], "last": steps[-1],
+                            "final_cost": costs[-1]}
+        out["events"] = [(s, m[len("event/"):], v) for s, _, m, v in rows
+                         if m.startswith("event/")]
+        hosts = defaultdict(list)
+        for s, _, m, v in rows:
+            if m.startswith("health/step_ms_p"):
+                hosts[int(m.rsplit("p", 1)[1])].append(v)
+        out["per_host_step_ms"] = {
+            k: {"mean": sum(v) / len(v), "last": v[-1], "n": len(v)}
+            for k, v in sorted(hosts.items())}
+
+    span_files = find_span_files(logdir)
+    if span_files:
+        rows, instants = summarize_spans(span_files)
+        out["span_files"] = [os.path.basename(p) for p in span_files]
+        out["spans"] = rows[:top]
+        out["instants"] = [
+            {"name": r["name"], "ts": r.get("ts"), "pid": r.get("pid"),
+             "args": r.get("args", {})} for r in instants]
+
+    hpath = os.path.join(logdir, "health.json")
+    if os.path.exists(hpath):
+        try:
+            with open(hpath) as f:
+                out["health"] = json.load(f)
+        except ValueError:
+            pass
+
+    pdir = profile_dir or logdir
+    if os.path.isdir(os.path.join(pdir, "plugins", "profile")):
+        from dtf_tpu.utils.profiling import summarize_trace
+        try:
+            out["xla_ops"] = [{"name": n, "total_s": s}
+                              for n, s in summarize_trace(pdir, top=top)]
+        except Exception as exc:       # a summary must never fail a report
+            out["xla_error"] = str(exc)
+    return out
+
+
+def check_goodput(report: dict, tol_pct: float = 10.0
+                  ) -> Tuple[bool, str]:
+    """The acceptance arithmetic: accounted categories sum to measured
+    wall-clock within the tolerance."""
+    good = report.get("telemetry", {}).get("goodput")
+    if not good:
+        return False, "no goodput section in telemetry.json"
+    wall = float(good.get("wall_s", 0.0))
+    if wall <= 0:
+        return False, f"non-positive wall_s ({wall})"
+    total = sum(float(good.get(f"{c}_s", 0.0)) for c in CATEGORIES)
+    gap_pct = abs(wall - total) / wall * 100.0
+    verdict = (f"accounted {total:.2f}s of {wall:.2f}s wall "
+               f"({100 - gap_pct:.1f}% covered, tol {tol_pct:g}%)")
+    return gap_pct <= tol_pct, verdict
+
+
+def _fmt_goodput(good: dict, lines: List[str]) -> None:
+    wall = float(good.get("wall_s", 0.0)) or 1.0
+    lines.append("Goodput breakdown")
+    for c in CATEGORIES:
+        s = float(good.get(f"{c}_s", 0.0))
+        if s <= 0 and c not in ("productive",):
+            continue
+        bar = "#" * min(int(round(40 * s / wall)), 40)
+        lines.append(f"  {c:<11} {s:9.2f}s  {s / wall * 100:5.1f}%  {bar}")
+    lines.append(f"  {'wall_clock':<11} {float(good.get('wall_s', 0)):9.2f}s")
+    frac = good.get("productive_fraction")
+    if frac is not None:
+        lines.append(f"  goodput (productive/wall): "
+                     f"{float(frac) * 100:.1f}%")
+
+
+def render(report: dict, top: int = 10) -> str:
+    lines = [f"== dtf_tpu run report: {report['logdir']} =="]
+    tel = report.get("telemetry", {})
+    if tel.get("goodput"):
+        _fmt_goodput(tel["goodput"], lines)
+    metrics = tel.get("metrics", {})
+    thr = {n: m.get("value") for n, m in metrics.items()
+           if n.startswith(("throughput/", "mfu/")) and m.get("value")}
+    if thr:
+        lines.append("Throughput / MFU")
+        for n in sorted(thr):
+            lines.append(f"  {n:<28} {thr[n]:12.5g}")
+    if "steps" in report:
+        s = report["steps"]
+        lines.append(f"Steps: {s['first']}..{s['last']}  "
+                     f"final cost {s['final_cost']:.4f}  "
+                     f"(attempts: {report.get('attempts', [0])}, "
+                     f"{report.get('duplicate_rows_dropped', 0)} overlapping "
+                     f"rows superseded by the latest attempt)")
+    if report.get("events") or report.get("instants"):
+        lines.append("Event timeline")
+        for step, name, value in report.get("events", []):
+            lines.append(f"  step {step:>6}  event/{name} (count {value:g})")
+        for rec in report.get("instants", []):
+            args = rec.get("args") or {}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+            lines.append(f"  p{rec.get('pid', 0)}  {rec['name']}"
+                         + (f"  {detail}" if detail else ""))
+    if report.get("per_host_step_ms"):
+        lines.append("Per-host step time (ms, from health/step_ms_p*)")
+        for k, st in report["per_host_step_ms"].items():
+            lines.append(f"  p{k}: mean {st['mean']:8.2f}  "
+                         f"last {st['last']:8.2f}  ({st['n']} samples)")
+    if report.get("health"):
+        h = report["health"]
+        lines.append(f"Health snapshot: {json.dumps(h, sort_keys=True)[:200]}")
+    if report.get("spans"):
+        lines.append(f"Top spans (host-side, by total time; "
+                     f"{', '.join(report.get('span_files', []))})")
+        for r in report["spans"][:top]:
+            lines.append(f"  {r['total_s']:9.3f}s  {r['count']:>6}x  "
+                         f"mean {r['mean_ms']:8.3f}ms  {r['name']}")
+    if report.get("xla_ops"):
+        lines.append("Top XLA device ops (from the profiler trace)")
+        for r in report["xla_ops"][:top]:
+            lines.append(f"  {r['total_s']:9.3f}s  {r['name']}")
+    elif report.get("xla_error"):
+        lines.append(f"XLA trace summary unavailable: {report['xla_error']}")
+    if len(lines) == 1:
+        lines.append("(nothing found: no telemetry.json / metrics.csv / "
+                     "spans under this logdir)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.telemetry.report",
+        description="Merge a run's telemetry into one post-mortem.")
+    p.add_argument("logdir")
+    p.add_argument("--top", type=int, default=10)
+    p.add_argument("--json", action="store_true",
+                   help="emit the merged report as JSON instead of text")
+    p.add_argument("--profile_dir", default=None,
+                   help="XLA profile dir when not under <logdir>")
+    p.add_argument("--export-trace", default=None, metavar="OUT.json",
+                   help="also write the merged Chrome-trace for Perfetto")
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: fail unless goodput components sum to "
+                        "wall-clock within --tol percent")
+    p.add_argument("--tol", type=float, default=10.0)
+    ns = p.parse_args(argv)
+    if not os.path.isdir(ns.logdir):
+        print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
+        return 2
+    report = build_report(ns.logdir, profile_dir=ns.profile_dir, top=ns.top)
+    if ns.export_trace:
+        from dtf_tpu.telemetry.spans import export_chrome_trace
+        n = export_chrome_trace(ns.logdir, ns.export_trace)
+        report["exported_trace_events"] = n
+    if ns.json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        print(render(report, top=ns.top))
+        if ns.export_trace:
+            print(f"Chrome trace: {ns.export_trace} "
+                  f"({report['exported_trace_events']} events)")
+    if ns.check:
+        # check_goodput already fails on a missing/empty telemetry.json
+        # (no goodput section -> (False, ...)).  With --json the verdict
+        # goes to stderr so stdout stays parseable.
+        ok, verdict = check_goodput(report, ns.tol)
+        print(f"goodput check: {'OK' if ok else 'FAIL'} — {verdict}",
+              file=sys.stderr if ns.json else sys.stdout)
+        if not ok:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Host-side structured tracer: nested spans to JSON-lines, exportable to
+Chrome-trace/Perfetto.
+
+The XLA profiler (utils/profiling.py) answers "which device op is slow"
+inside a narrow trace window; it says nothing about the host-side life of
+a run — where the wall-clock went between checkpoint saves, rollback
+restores, supervisor restarts, data fetches and eval passes.  This tracer
+is that other half: every instrumented phase appends one JSON object per
+completed span to ``<logdir>/spans.p<k>.jsonl`` (k = process index), and
+:func:`export_chrome_trace` rewraps any set of those files as a Chrome
+``traceEvents`` JSON so Perfetto/chrome://tracing overlays them — on the
+same viewer the XLA profiler window loads into.
+
+Span records are already Chrome-trace "X" (complete) events::
+
+    {"name": "checkpoint/save", "ph": "X", "ts": <epoch µs>,
+     "dur": <µs>, "pid": <process>, "tid": <thread>, "args": {...}}
+
+``ts`` is epoch wall-clock (not a monotonic origin) so spans from
+different hosts land on one shared time axis; ``dur`` is measured with
+the monotonic clock so a clock step mid-span cannot produce negative
+durations.  Instants (``ph: "i"``) mark point events — a chaos fault
+firing, a health abort.
+
+Thread-safe; nesting is tracked per-thread (``depth`` in args) purely
+from the with-statement structure, no global state to corrupt.  A
+disabled tracer (no path) costs one attribute check per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from dtf_tpu.telemetry.names import validate
+
+_FLUSH_EVERY = 64          # buffered records between file flushes
+
+
+class Tracer:
+    """Span recorder bound to one JSONL file (or disabled when path=None)."""
+
+    def __init__(self, path: Optional[str] = None, process: int = 0):
+        self.path = path
+        self.process = process
+        self._f = None
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._local = threading.local()
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, "a", buffering=1 << 16)
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def _depth(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _emit(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._pending += 1
+            if self._pending >= _FLUSH_EVERY:
+                self._f.flush()
+                self._pending = 0
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[None]:
+        """Record ``name`` over the with-block.  Nesting is structural:
+        a span opened inside another (same thread) records its depth and
+        parent, so the export shows the call tree without any id
+        plumbing."""
+        if self._f is None:
+            yield
+            return
+        validate(name)
+        stack = self._depth()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            stack.pop()
+            args = dict(attrs)
+            args["depth"] = len(stack)
+            if parent:
+                args["parent"] = parent
+            self._emit({"name": name, "ph": "X",
+                        "ts": wall0 * 1e6, "dur": dur_us,
+                        "pid": self.process,
+                        "tid": threading.get_ident() & 0xFFFF,
+                        "args": args})
+
+    def instant(self, name: str, **attrs: Any) -> None:
+        """Point event (chaos fault fired, peer died, ...); flushed
+        eagerly — instants mark exactly the moments a post-mortem needs,
+        and the process may be about to die."""
+        if self._f is None:
+            return
+        validate(name)
+        self._emit({"name": name, "ph": "i", "ts": time.time() * 1e6,
+                    "s": "p", "pid": self.process,
+                    "tid": threading.get_ident() & 0xFFFF,
+                    "args": dict(attrs)})
+        self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                self._pending = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+# -- process-wide tracer ----------------------------------------------------
+
+_NULL = Tracer(None)
+_TRACER = _NULL
+
+
+def configure(logdir: Optional[str], process: int = 0) -> Tracer:
+    """Install the process-wide tracer writing to
+    ``<logdir>/spans.p<process>.jsonl`` (telemetry CONVENTION: per-process
+    files so multi-host runs on a shared logdir never interleave writes).
+    ``logdir=None`` uninstalls (back to the no-op tracer)."""
+    global _TRACER
+    if _TRACER is not _NULL:
+        _TRACER.close()
+    _TRACER = (Tracer(os.path.join(logdir, f"spans.p{process}.jsonl"),
+                      process=process) if logdir else _NULL)
+    return _TRACER
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, **attrs: Any):
+    """Module-level convenience: a span on the process-wide tracer."""
+    return _TRACER.span(name, **attrs)
+
+
+def instant(name: str, **attrs: Any) -> None:
+    _TRACER.instant(name, **attrs)
+
+
+# -- readers / export -------------------------------------------------------
+
+def read_spans(path: str) -> List[dict]:
+    """Parse one spans JSONL file; a torn final line (process killed
+    mid-write) is dropped, like the TB reader's torn-tail rule."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue               # torn tail / partial write
+    return out
+
+
+def find_span_files(logdir: str) -> List[str]:
+    import glob
+    return sorted(glob.glob(os.path.join(logdir, "spans.p*.jsonl")))
+
+
+def export_chrome_trace(logdir: str, out_path: str) -> int:
+    """Merge every ``spans.p*.jsonl`` under ``logdir`` into one Chrome-
+    trace JSON (load in Perfetto / chrome://tracing; overlays with the
+    XLA profiler's trace since both use epoch-µs timestamps).  Returns
+    the number of events written."""
+    events: List[dict] = []
+    for path in find_span_files(logdir):
+        events.extend(read_spans(path))
+    for k in {e.get("pid", 0) for e in events}:
+        events.append({"ph": "M", "pid": k, "name": "process_name",
+                       "args": {"name": f"dtf_tpu host p{k}"}})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)

@@ -30,6 +30,8 @@ from typing import Any, List, Optional
 
 import jax
 
+from dtf_tpu import telemetry as tel
+
 log = logging.getLogger("dtf_tpu")
 
 _MANIFEST_DIR = "manifests"
@@ -94,9 +96,18 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Async save; returns True if a save was queued/performed."""
-        saved = self._mgr.save(
-            step, args=self._ocp.args.StandardSave(state), force=force)
+        import time as _time
+        t0 = _time.perf_counter()
+        with tel.span("checkpoint/save", step=step):
+            saved = self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state), force=force)
         if saved:
+            tel.counter("checkpoint/saves_total").inc()
+            # Distribution, not just a last-value gauge: save latency is
+            # spiky (the async save's hidden wait for its predecessor),
+            # and the post-mortem wants min/max/mean.
+            tel.histogram("checkpoint/save_ms").observe(
+                (_time.perf_counter() - t0) * 1e3)
             # orbax's save just waited for the previous save internally,
             # so every EARLIER pending step is committed on disk; checksum
             # those on a background thread (pure file I/O — the hot loop
@@ -241,8 +252,10 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return state_template, None
-        restored = self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(state_template))
+        with tel.span("checkpoint/restore", step=step):
+            restored = self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(state_template))
+        tel.counter("checkpoint/restores_total").inc()
         self._log_reshard(step)
         log.info("checkpoint restored from step %d", step)
         return restored, step
@@ -307,9 +320,11 @@ class CheckpointManager:
             def attempt_restore():
                 restored, exc = None, None
                 try:
-                    restored = self._mgr.restore(
-                        step,
-                        args=self._ocp.args.StandardRestore(state_template))
+                    with tel.span("checkpoint/restore", step=step):
+                        restored = self._mgr.restore(
+                            step,
+                            args=self._ocp.args.StandardRestore(
+                                state_template))
                 except Exception as e:  # orbax raises many concrete types
                     exc = e
                 deterministic = exc is not None
@@ -360,6 +375,7 @@ class CheckpointManager:
                             type(exc).__name__, exc)
                 candidates = [s for s in candidates if s < step]
                 continue
+            tel.counter("checkpoint/restores_total").inc()
             self._log_reshard(step)
             log.info("checkpoint restored from step %d", step)
             return restored, step

@@ -24,7 +24,15 @@ from __future__ import annotations
 
 import csv
 import os
+import time
 from typing import Optional
+
+# TB/CSV flush batching: writes buffer until this much time or this many
+# rows accumulate; logging sync points, event() and close() force a flush
+# (the crash-safety contract — a fail-fast os._exit skips finalizers, so
+# the post-mortem metrics must already be on disk at every sync point).
+_FLUSH_INTERVAL_S = 2.0
+_FLUSH_MAX_PENDING = 64
 
 
 def format_step_line(step: int, epoch: int, batch: int, batch_count: int,
@@ -38,22 +46,64 @@ def format_step_line(step: int, epoch: int, batch: int, batch_count: int,
             " AvgTime: %3.2fms" % avg_ms)
 
 
+def _last_attempt(path: str) -> int:
+    """Largest attempt recorded in an existing metrics.csv (-1 when the
+    file is absent/empty or pre-dates the attempt column)."""
+    last = -1
+    try:
+        with open(path, newline="") as f:
+            for rec in csv.reader(f):
+                if len(rec) > 3 and rec[3].lstrip("-").isdigit():
+                    last = max(last, int(rec[3]))
+                elif rec and rec[0] != "step":
+                    last = max(last, 0)        # legacy row == attempt 0
+    except OSError:
+        pass
+    return last
+
+
 class MetricLogger:
     def __init__(self, logdir: Optional[str] = None, is_coordinator: bool = True,
-                 quiet: bool = False):
+                 quiet: bool = False, attempt: Optional[int] = 0):
+        """``attempt`` tags every CSV row so a rollback or supervisor
+        restart's overlapping step ranges stay distinguishable (the file
+        is append-mode by design — one run's attempts share it, and the
+        report CLI de-duplicates by latest attempt).  ``attempt=None``
+        auto-resumes: one past the largest attempt already in the file —
+        the scheduler-driven ``--resume`` path, where no in-process
+        supervisor is counting."""
         self.is_coordinator = is_coordinator
         self.quiet = quiet
         self._csv = None
         self._writer = None
         self._tb = None
+        self._pending = 0
+        self._last_flush = time.monotonic()
+        self.attempt = attempt if attempt is not None else 0
         if logdir and is_coordinator:
             os.makedirs(logdir, exist_ok=True)
-            self._csv = open(os.path.join(logdir, "metrics.csv"), "a", newline="")
+            path = os.path.join(logdir, "metrics.csv")
+            if attempt is None:
+                self.attempt = _last_attempt(path) + 1
+            self._csv = open(path, "a", newline="")
             self._writer = csv.writer(self._csv)
             if self._csv.tell() == 0:
-                self._writer.writerow(["step", "metric", "value"])
+                self._writer.writerow(["step", "metric", "value", "attempt"])
             from dtf_tpu.train.tbevents import TBEventWriter
             self._tb = TBEventWriter(logdir)
+
+    @classmethod
+    def for_config(cls, cfg, is_coordinator: bool = True,
+                   quiet: bool = False) -> "MetricLogger":
+        """THE attempt-tag rule, shared by the Trainer and the workload
+        CLIs that build their logger up front: an explicit ``cfg.attempt``
+        (an external scheduler counting its own relaunches) wins; any
+        resumed run — in-process supervisor restart or ``--resume``
+        relaunch — auto-continues past the file's last recorded attempt;
+        a fresh run is attempt 0."""
+        return cls(cfg.logdir, is_coordinator, quiet=quiet,
+                   attempt=(cfg.attempt if cfg.attempt
+                            else (None if cfg.resume else 0)))
 
     def print(self, msg: str) -> None:
         if self.is_coordinator and not self.quiet:
@@ -71,15 +121,33 @@ class MetricLogger:
             self._tb.flush()
 
     def scalar(self, step: int, name: str, value: float) -> None:
+        # Mirror into the telemetry registry (auto-registered gauge) so
+        # telemetry.json carries the last value of every scalar stream; a
+        # name already registered as a counter (event/*) keeps its type.
+        from dtf_tpu import telemetry
+        try:
+            telemetry.gauge(name).set(float(value))
+        except (ValueError, TypeError):
+            pass
         if self._writer:
-            self._writer.writerow([step, name, float(value)])
-            self._csv.flush()
+            self._writer.writerow([step, name, float(value), self.attempt])
         if self._tb:
             self._tb.scalar(step, name, float(value))
-            # Flush eagerly: scalar() is only called at logging sync points,
-            # and a fail-fast os._exit (utils/watchdog.py) skips finalizers —
-            # the post-mortem metrics must already be on disk.
+        self._pending += 1
+        now = time.monotonic()
+        if (self._pending >= _FLUSH_MAX_PENDING
+                or now - self._last_flush >= _FLUSH_INTERVAL_S):
+            self.flush()
+
+    def flush(self) -> None:
+        """Force buffered CSV/TB rows to disk — called by the trainer at
+        every logging sync point (and by event()/close())."""
+        if self._csv:
+            self._csv.flush()
+        if self._tb:
             self._tb.flush()
+        self._pending = 0
+        self._last_flush = time.monotonic()
 
     def stragglers(self, step: int, per_host_ms, flagged) -> None:
         """Cluster-health feed (resilience/health.flag_stragglers): each
@@ -99,12 +167,21 @@ class MetricLogger:
                        f"{finite_median(per_host_ms):.1f}ms/step)")
 
     def event(self, step: int, name: str, detail: str = "") -> None:
-        """Resilience/lifecycle event: one console line + a unit-valued
-        ``event/<name>`` scalar so rollbacks, retries and restarts are
-        visible on the same TensorBoard time axis as the loss they
-        disturbed (and countable from the CSV post-mortem)."""
+        """Resilience/lifecycle event: a REGISTERED ``event/<name>``
+        counter (telemetry registry — the machine-readable count), a span
+        instant (the timeline mark), one console line, and an
+        ``event/<name>`` scalar carrying the cumulative count so
+        rollbacks, retries and restarts stay visible on the same
+        TensorBoard time axis as the loss they disturbed.  Flushed
+        eagerly: events mark exactly the moments a post-mortem needs."""
+        from dtf_tpu import telemetry
+        count = telemetry.counter(f"event/{name}")
+        count.inc()
+        telemetry.instant(f"event/{name}", step=step,
+                          **({"detail": detail} if detail else {}))
         self.print(f"[dtf_tpu] {name}" + (f": {detail}" if detail else ""))
-        self.scalar(step, f"event/{name}", 1.0)
+        self.scalar(step, f"event/{name}", float(count.value))
+        self.flush()
 
     def epoch_summary(self, test_accuracy: float, total_s: float,
                       final_cost: float) -> None:
@@ -114,6 +191,7 @@ class MetricLogger:
         self.print("Final Cost: %.4f" % final_cost)
 
     def close(self) -> None:
+        self.flush()
         if self._csv:
             self._csv.close()
             self._csv = self._writer = None

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -30,6 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtf_tpu import optim as optim_lib
+from dtf_tpu import telemetry as tel
 from dtf_tpu.cluster import Cluster
 from dtf_tpu.config import TrainConfig
 from dtf_tpu.parallel import sharding as sh
@@ -481,8 +483,51 @@ class Trainer:
 
     def __post_init__(self):
         mesh = self.cluster.mesh
-        self.logger = self.logger or MetricLogger(
-            self.cfg.logdir, self.cluster.is_coordinator)
+        # Telemetry spine: close any supervisor down-window into the
+        # restart bucket, bind the span tracer to this run's logdir, and
+        # — in a FRESH process resuming an interrupted run — pick up the
+        # previous attempt's goodput books plus the dead time since its
+        # last telemetry.json write (in-process restarts keep the live
+        # tracker; accounted_s()>0 detects that and skips the load).
+        tracker = tel.get_tracker()
+        tracker.mark_up()
+        _t_init = time.perf_counter()
+        # Disabled telemetry must UNINSTALL any tracer a previous run in
+        # this process configured, or this run's spans would pollute the
+        # earlier run's span file.
+        tel.configure(self.cfg.logdir
+                      if self.cfg.telemetry and self.cfg.logdir else None,
+                      jax.process_index())
+        if (self.cfg.resume and self.cfg.logdir
+                and self.cluster.is_coordinator
+                and tracker.accounted_s() == 0):
+            import json as _json
+            import os as _os
+            tpath = _os.path.join(self.cfg.logdir, tel.TELEMETRY_FILE)
+            if _os.path.exists(tpath):
+                try:
+                    with open(tpath) as f:
+                        doc = _json.load(f)
+                    tracker.load_previous(doc)
+                    # Lifetime counters (restarts, saves, events) carry
+                    # across the relaunch too, or the resumed process's
+                    # first snapshot would atomically replace the file
+                    # with counts regressed to zero while the goodput
+                    # books correctly remember the history.
+                    tel.get_registry().load_counters(
+                        doc.get("metrics", {}))
+                except (OSError, ValueError):
+                    pass               # a torn file must not block a resume
+        # Checkpoint watermark for the init booking below — sampled AFTER
+        # load_previous, whose merged-in previous-run checkpoint_s must
+        # not be subtracted from THIS ctor's elapsed time.
+        _ck0 = tracker.buckets["checkpoint"]
+        # Attempt tag for metrics.csv rows: resumed runs (in-process
+        # supervisor restarts AND scheduler-driven --resume relaunches)
+        # auto-continue past the file's last recorded attempt; an explicit
+        # cfg.attempt from an external scheduler overrides.
+        self.logger = self.logger or MetricLogger.for_config(
+            self.cfg, self.cluster.is_coordinator)
         self._chaos = self.chaos if self.chaos is not None else self.cfg.chaos
         if isinstance(self._chaos, str):
             from dtf_tpu.resilience.chaos import FaultPlan
@@ -530,35 +575,39 @@ class Trainer:
             self.ckpt = CheckpointManager(
                 f"{self.cfg.logdir}/checkpoints")
             if self.cfg.resume:
-                if self._chaos is not None:
-                    # corrupt_ckpt@latest models bit rot / a crash mid-save
-                    # discovered only when the restart tries to restore.
-                    self._chaos.maybe_corrupt_latest(self.ckpt)
-                had_steps = self.ckpt.all_steps()
-                try:
-                    self.state, step = self.ckpt.restore_robust(self.state)
-                except Exception as exc:
-                    from dtf_tpu.train.checkpoint import (
-                        CheckpointMismatchError)
-                    if (not isinstance(exc, CheckpointMismatchError)
-                            or not self._guarded):
-                        raise
-                    # Legacy checkpoints (saved before the guard existed /
-                    # with --no-nonfinite_guard) lack the counter leaves.
-                    # Backfill: restore without them, re-attach the fresh
-                    # zeros from init — the trajectory is too valuable to
-                    # discard over two scalar counters.
-                    legacy = {k: v for k, v in self.state.items()
-                              if k not in ("skipped", "bad_streak")}
-                    restored, step = self.ckpt.restore_robust(legacy)
-                    if step is None:
-                        raise
-                    restored["skipped"] = self.state["skipped"]
-                    restored["bad_streak"] = self.state["bad_streak"]
-                    self.state = restored
-                    self.logger.print(
-                        f"[dtf_tpu] resumed a pre-guard checkpoint "
-                        f"(step {step}); guard counters start at zero")
+                with tracker.measure("checkpoint"):
+                    if self._chaos is not None:
+                        # corrupt_ckpt@latest models bit rot / a crash
+                        # mid-save discovered only when the restart tries
+                        # to restore.
+                        self._chaos.maybe_corrupt_latest(self.ckpt)
+                    had_steps = self.ckpt.all_steps()
+                    try:
+                        self.state, step = self.ckpt.restore_robust(
+                            self.state)
+                    except Exception as exc:
+                        from dtf_tpu.train.checkpoint import (
+                            CheckpointMismatchError)
+                        if (not isinstance(exc, CheckpointMismatchError)
+                                or not self._guarded):
+                            raise
+                        # Legacy checkpoints (saved before the guard
+                        # existed / with --no-nonfinite_guard) lack the
+                        # counter leaves.  Backfill: restore without them,
+                        # re-attach the fresh zeros from init — the
+                        # trajectory is too valuable to discard over two
+                        # scalar counters.
+                        legacy = {k: v for k, v in self.state.items()
+                                  if k not in ("skipped", "bad_streak")}
+                        restored, step = self.ckpt.restore_robust(legacy)
+                        if step is None:
+                            raise
+                        restored["skipped"] = self.state["skipped"]
+                        restored["bad_streak"] = self.state["bad_streak"]
+                        self.state = restored
+                        self.logger.print(
+                            f"[dtf_tpu] resumed a pre-guard checkpoint "
+                            f"(step {step}); guard counters start at zero")
                 if step is not None:
                     self.logger.print(f"[dtf_tpu] resumed from step {step}")
                 elif had_steps:
@@ -590,12 +639,43 @@ class Trainer:
         # Armed at fit() start, disarmed in its finally (arming here would
         # let slow pre-fit host work trip a hard exit).
         self._watchdog = None
+        # MFU/throughput numerators (telemetry/goodput.py): model FLOPs for
+        # one training example and its token count — reported from the
+        # logging sync points so every workload (not just the benchmark
+        # driver) gets tokens/sec and, when the chip peak is known, MFU.
+        self._tokens_per_example = tel.goodput.tokens_per_example(self.model)
+        try:
+            self._flops_per_example = tel.goodput.train_flops_per_example(
+                self.model, self.state["params"])
+        except Exception:              # a model without countable params
+            self._flops_per_example = None
+        try:
+            self._peak_flops, _ = tel.goodput.peak_flops_for_model(
+                self.model, mesh.devices.flat[0])
+        except Exception:
+            self._peak_flops = None
+        # One compiled-step flag: the FIRST dispatch pays trace+compile
+        # synchronously, so its wall time books as "compile", not
+        # "productive" (goodput category table).
+        self._compile_seen = False
+        tracker.add("init", max(
+            time.perf_counter() - _t_init
+            - (tracker.buckets["checkpoint"] - _ck0), 0.0))
+        # fit() books the ctor->fit gap (data loading by the caller) so
+        # the goodput columns keep summing to wall-clock; the accounted
+        # watermark keeps phases booked in between (e.g. the benchmark
+        # driver's measured warmup steps) from being counted twice.
+        self._ctor_done = time.perf_counter()
+        self._ctor_acc = tracker.accounted_s()
 
     def _print_trace_summary(self, steps_traced: int) -> None:
         from dtf_tpu.utils.profiling import summarize_trace
 
         try:
-            rows = summarize_trace(self.cfg.profile_dir, top=10)
+            # steps= makes summarize_trace itself normalize to per-step
+            # seconds (callers no longer divide by hand).
+            rows = summarize_trace(self.cfg.profile_dir, top=10,
+                                   steps=steps_traced)
         except Exception as exc:       # a summary must never fail a run
             self.logger.print(f"[trace] summary unavailable: {exc}")
             return
@@ -611,10 +691,9 @@ class Trainer:
         self.logger.print(
             f"[trace] device-op time per traced step ({steps_traced} "
             f"steps; durations summed over the run dir's trace files):")
-        for name, secs in rows:
+        for name, per_step_s in rows:
             self.logger.print(
-                f"[trace] {secs * 1e3 / steps_traced:9.3f} ms/step  "
-                f"{name}")
+                f"[trace] {per_step_s * 1e3:9.3f} ms/step  {name}")
 
     def _suspended_watchdog(self):
         """Disarm the hang watchdog across a legitimately-slow blocking host
@@ -643,10 +722,12 @@ class Trainer:
                 f"instability persists across restores; failing fast")
         cur_step = self.state["step"]
         cur_skipped = self.state["skipped"]
-        with self._suspended_watchdog():
+        with self._suspended_watchdog(), \
+                tel.get_tracker().measure("rollback"):
             restored, good_step = self.ckpt.restore_robust(self.state)
         if good_step is None:
             raise TrainingDiverged(f"{why} and no restorable checkpoint")
+        tel.counter("checkpoint/rollbacks_total").inc()
         # Values roll back; counters carry forward (eager elementwise ops
         # preserve the replicated sharding of their inputs).
         restored["step"] = cur_step
@@ -759,6 +840,22 @@ class Trainer:
             return train.next_batch(feed_bs)
 
         fit_completed = False
+        # Goodput attribution (telemetry/goodput.py): every host-side
+        # phase of the loop books into a category; the ctor->fit gap
+        # (caller-side data loading) and the loop's own residue (rng
+        # folds, watchdog ticks, span bookkeeping) book as "other", so
+        # productive + overhead sums to wall-clock.  Spans mirror the
+        # same phases to the JSONL tracer for the Perfetto timeline.
+        tracker = tel.get_tracker()
+        if getattr(self, "_ctor_done", None) is not None:
+            tracker.add("other", max(
+                (time.perf_counter() - self._ctor_done)
+                - (tracker.accounted_s() - self._ctor_acc), 0.0))
+            self._ctor_done = None      # once: a second fit has no gap
+        _fit_t0 = time.perf_counter()
+        _fit_acc0 = tracker.accounted_s()
+        _fit_span = tel.get_tracer().span("train/fit", epochs=epochs)
+        _fit_span.__enter__()
         try:
             hit_cap = False
             for epoch in range(start_epoch, epochs):
@@ -769,17 +866,34 @@ class Trainer:
                         hit_cap = True
                         break
                     if self._chaos is not None:
-                        self._chaos.maybe_step_faults(self._host_step)
-                    host_batch = retry_call(
-                        fetch_batch, attempts=3, backoff=fetch_backoff,
-                        retry_on=(OSError,), what="train batch fetch")
+                        # stall / slow_host faults sleep in here — injected
+                        # non-productive time, booked as such.
+                        with tracker.measure("stall"):
+                            self._chaos.maybe_step_faults(self._host_step)
+                    with tel.span("train/fetch"), tracker.measure("data"):
+                        host_batch = retry_call(
+                            fetch_batch, attempts=3, backoff=fetch_backoff,
+                            retry_on=(OSError,), what="train batch fetch",
+                            on_retry=lambda a, e: tel.counter(
+                                "data/fetch_retries_total").inc())
                     if self._chaos is not None:
                         host_batch = self._chaos.maybe_poison_batch(
                             self._host_step, host_batch)
-                    batch = put(mesh, host_batch)
+                    with tel.span("train/put"), tracker.measure("data"):
+                        batch = put(mesh, host_batch)
                     step_rng = jax.random.fold_in(rng_base, self._host_step)
-                    self.state, metrics = self.step_fn(self.state, batch,
-                                                       step_rng)
+                    # The first dispatch pays trace+compile synchronously:
+                    # that wall time is "compile", not "productive".
+                    _cat = ("productive" if self._compile_seen
+                            else "compile")
+                    _t_step = time.perf_counter()
+                    with tel.span("train/step"), tracker.measure(_cat):
+                        self.state, metrics = self.step_fn(self.state, batch,
+                                                           step_rng)
+                    if not self._compile_seen:
+                        self._compile_seen = True
+                        tel.gauge("compile/first_step_s").set(
+                            time.perf_counter() - _t_step)
                     self.last_metrics = metrics
                     count += 1
                     self._host_step += 1
@@ -796,7 +910,8 @@ class Trainer:
                             what=f"step {self._host_step} metrics")
                     if (self.ckpt is not None and self.cfg.checkpoint_every > 0
                             and self._host_step % self.cfg.checkpoint_every == 0):
-                        with self._suspended_watchdog():
+                        with self._suspended_watchdog(), \
+                                tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state)
                             if self._chaos is not None:
                                 # Inside the suspended window: the hook
@@ -815,7 +930,8 @@ class Trainer:
                     if preempt is not None and (
                             preempt.triggered if jax.process_count() == 1
                             else (at_sync and preempt.agreed())):
-                        with self._suspended_watchdog():
+                        with self._suspended_watchdog(), \
+                                tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state,
                                            force=True)
                         # logger.event, not a bare print: the agreed-save
@@ -831,33 +947,61 @@ class Trainer:
                     if at_sync:
                         # Sync point: read back the metrics (the reference
                         # paid this every step via sess.run; we pay it only
-                        # when logging).
-                        cost = float(metrics["loss"])
-                        step = int(self.state["step"])
+                        # when logging).  The read blocks on the whole
+                        # dispatched step pipeline, so it books as
+                        # productive time — the device was doing model
+                        # work while the host waited.
+                        with tracker.measure("productive"):
+                            cost = float(metrics["loss"])
+                            step = int(self.state["step"])
                         avg_ms = timer.window_avg_ms(count)
-                        self.logger.step_line(step, epoch + 1, i + 1,
-                                              batch_count, cost, avg_ms)
-                        self.logger.scalar(step, "cost", cost)
-                        self.logger.scalar(step, "avg_ms", avg_ms)
+                        with tel.span("train/log", step=step):
+                            self.logger.step_line(step, epoch + 1, i + 1,
+                                                  batch_count, cost, avg_ms)
+                            self.logger.scalar(step, "cost", cost)
+                            self.logger.scalar(step, "avg_ms", avg_ms)
                         if straggling:
                             # Per-host step timing, allgathered at a
                             # boundary every process reaches together
                             # (same rule as the preemption allgather):
                             # hosts slower than median * straggler_factor
                             # are flagged to metrics and the published
-                            # health snapshot.
-                            per_host = np.asarray(
-                                multihost_utils.process_allgather(
-                                    np.asarray([avg_ms], np.float32))
-                            ).reshape(-1)
+                            # health snapshot.  The allgather waits on the
+                            # slowest host, so it books as stall time.
+                            with tracker.measure("stall"):
+                                per_host = np.asarray(
+                                    multihost_utils.process_allgather(
+                                        np.asarray([avg_ms], np.float32))
+                                ).reshape(-1)
                             flagged = flag_stragglers(
                                 per_host, cfg.straggler_factor)
                             self.logger.stragglers(step, per_host, flagged)
                             if health is not None:
                                 health.note_stragglers(step, per_host,
                                                        flagged)
+                        # Telemetry sync point: steps/throughput/MFU
+                        # gauges, then the registry->disk snapshot and the
+                        # forced flush that keeps the crash-safety
+                        # contract (metrics already on disk if the next
+                        # instant is a SIGKILL).
+                        tel.gauge("train/steps_total").set(step)
+                        if avg_ms > 0:
+                            tel.goodput.record_throughput(
+                                examples_per_s=bs * 1000.0 / avg_ms,
+                                tokens_per_example=self._tokens_per_example,
+                                step_ms=avg_ms,
+                                model_flops_per_example=(
+                                    self._flops_per_example or 0.0),
+                                n_chips=mesh.size,
+                                peak_flops_per_chip=self._peak_flops)
                         count = 0
                         last_cost = cost
+                        # Flush BEFORE the guard/rollback below: the rows
+                        # explaining an imminent rollback must not sit in
+                        # the batch buffer across a multi-second restore
+                        # (a health abort's os._exit there would lose
+                        # exactly the evidence the post-mortem needs).
+                        self.logger.flush()
                         # Guard policy (DESIGN.md §5): the device-side
                         # streak counter means the hot loop never syncs
                         # per step; the sync boundary is where the host
@@ -869,26 +1013,43 @@ class Trainer:
                             if skipped_total:
                                 self.logger.scalar(step, "bad_steps_total",
                                                    skipped_total)
+                            tel.gauge("train/bad_streak").set(
+                                int(metrics["bad_streak"]))
                             if (cfg.bad_step_limit > 0
                                     and int(metrics["bad_streak"])
                                     >= cfg.bad_step_limit):
                                 self._rollback_or_fail(
                                     int(metrics["bad_streak"]))
+                        self.logger.flush()   # rollback event rows too
+                        if (self.cfg.telemetry and self.cfg.logdir
+                                and self.cluster.is_coordinator):
+                            try:      # best-effort: a full disk must not
+                                tel.write_telemetry_json(self.cfg.logdir)
+                            except OSError:   # kill the training loop
+                                pass
                 if preempted or hit_cap:
                     break
                 if splits.test is not None:
-                    with self._suspended_watchdog():
+                    with self._suspended_watchdog(), \
+                            tel.span("train/eval"), tracker.measure("eval"):
                         ev = self.eval_fn(self.state, splits.test)
                     self.logger.epoch_summary(ev["accuracy"], timer.total_s(),
                                               last_cost)
                     self.logger.scalar(int(self.state["step"]),
                                        "test_accuracy", ev["accuracy"])
+                    # Epoch boundary is a crash-safety sync point too: the
+                    # eval row must not sit in the batched-flush buffer
+                    # until the NEXT logging sync (a watchdog os._exit
+                    # skips finalizers).
+                    self.logger.flush()
             if start_epoch >= epochs and splits.test is not None:
                 # resumed past the budget: report eval
-                with self._suspended_watchdog():
+                with self._suspended_watchdog(), \
+                        tel.span("train/eval"), tracker.measure("eval"):
                     ev = self.eval_fn(self.state, splits.test)
             fit_completed = True
         finally:
+            _fit_span.__exit__(None, None, None)
             if health is not None:
                 # A COMPLETED fit (incl. agreed preemption) departs
                 # cleanly — peers still finishing their epoch must not
@@ -907,6 +1068,27 @@ class Trainer:
                 # In the finally: a raise out of the loop must still
                 # stop_trace, or the trace file is never written.
                 self._profiler.close(self.state)
+            # Residual sweep: whatever this fit's wall time the measured
+            # phases didn't cover (rng folds, condition checks, span
+            # bookkeeping) books as "other" — the accounted columns must
+            # sum to wall-clock even on a crash path.
+            tracker.add("other", max(
+                (time.perf_counter() - _fit_t0)
+                - (tracker.accounted_s() - _fit_acc0), 0.0))
+            # A crash path must still leave the telemetry books — and any
+            # buffered metric rows — on disk: they are exactly what the
+            # post-mortem reads.
+            try:
+                self.logger.flush()
+            except Exception:
+                pass
+            if self.cfg.telemetry and self.cfg.logdir:
+                if self.cluster.is_coordinator:
+                    try:
+                        tel.write_telemetry_json(self.cfg.logdir)
+                    except OSError:
+                        pass
+                tel.get_tracer().flush()
         if self._profiler is not None:
             steps_traced = self._profiler.captured_steps - pre_traced
             if (self.cfg.profile_summary and self.cluster.is_coordinator
@@ -920,7 +1102,8 @@ class Trainer:
                         "beyond the last step?)")
                 else:
                     self._print_trace_summary(steps_traced)
-        block(self.state)
+        with tracker.measure("productive"):   # drain the dispatch pipeline
+            block(self.state)
         if self._chaos is not None and not preempted:
             pend = self._chaos.pending()
             if pend:
@@ -933,10 +1116,20 @@ class Trainer:
                     f"reached, or corrupt_ckpt step not a checkpoint "
                     f"boundary) — this run did NOT exercise them")
         if self.ckpt is not None:
-            if (not preempted and self.cfg.checkpoint_every > 0
-                    and self.ckpt.latest_step() != self._host_step):
-                self.ckpt.save(self._host_step, self.state, force=True)
-            self.ckpt.wait()
+            with tracker.measure("checkpoint"):
+                if (not preempted and self.cfg.checkpoint_every > 0
+                        and self.ckpt.latest_step() != self._host_step):
+                    self.ckpt.save(self._host_step, self.state, force=True)
+                self.ckpt.wait()
+        if (self.cfg.telemetry and self.cfg.logdir
+                and self.cluster.is_coordinator):
+            # Final books: the tail (drain + last save) is now accounted.
+            # Best-effort — a full disk at run end must not turn a
+            # COMPLETED training run into a crash.
+            try:
+                tel.write_telemetry_json(self.cfg.logdir)
+            except OSError:
+                pass
         return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
                 "steps": int(self.state["step"]), "total_s": timer.total_s(),
                 "preempted": preempted,

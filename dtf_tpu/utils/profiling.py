@@ -107,7 +107,8 @@ class StepWindowProfiler:
         self.done = True
 
 
-def summarize_trace(logdir: str, top: int = 20) -> list:
+def summarize_trace(logdir: str, top: int = 20,
+                    steps: Optional[int] = None) -> list:
     """Aggregate device-op wall time from a captured XLA trace.
 
     Reads the ``*.trace.json.gz`` Chrome-trace file that
@@ -119,8 +120,24 @@ def summarize_trace(logdir: str, top: int = 20) -> list:
     over all occurrences and every host's file in the run, restricted to
     each device pid's "XLA Ops" lane when the trace labels one (the
     Steps/Modules lanes cover the same wall time and would double-count
-    2-3x); a multi-step window reports per-window totals (divide by the
-    step count yourself).
+    2-3x).
+
+    ``steps``: the number of training steps the trace window covered
+    (``StepWindowProfiler.captured_steps``).  When given, every returned
+    duration is normalized to PER-STEP seconds; when None the historical
+    per-window totals are returned."""
+    if steps is not None and steps <= 0:
+        raise ValueError(f"steps must be a positive traced-step count, "
+                         f"got {steps}")
+    rows = _trace_totals(logdir)[:top]
+    if steps is not None:
+        rows = [(name, secs / steps) for name, secs in rows]
+    return rows
+
+
+def _trace_totals(logdir: str) -> list:
+    """Per-window total device-op seconds, largest first (the raw sum
+    summarize_trace optionally normalizes).
 
     The reference's only observability was wall-clock prints around
     ``sess.run`` (tf_distributed.py:116-122); this closes the loop from
@@ -169,7 +186,7 @@ def summarize_trace(logdir: str, top: int = 20) -> list:
                     and (e["pid"], e.get("tid")) not in op_lanes):
                 continue
             total[e.get("name", "?")] += e["dur"] / 1e6
-    return sorted(total.items(), key=lambda kv: -kv[1])[:top]
+    return sorted(total.items(), key=lambda kv: -kv[1])
 
 
 def fingerprint(tree: Any) -> np.ndarray:

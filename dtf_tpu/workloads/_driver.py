@@ -17,7 +17,6 @@ import time
 from typing import Optional
 
 import jax
-import numpy as np
 
 
 def global_batch_size(cluster, train_cfg) -> int:
@@ -107,13 +106,19 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
     # uninterrupted run.
     rng_base = jax.random.key(train_cfg.seed + 17)
     if trainer._host_step == 0:
-        for _ in range(2):
+        from dtf_tpu import telemetry as _tel
+        tracker = _tel.get_tracker()
+        for k in range(2):
             batch = put_global_batch(mesh, train.next_batch(global_batch))
             step_rng = jax.random.fold_in(rng_base, trainer._host_step)
-            trainer.state, trainer.last_metrics = trainer.step_fn(
-                trainer.state, batch, step_rng)
-            trainer._host_step += 1
-            block(trainer.state)
+            # Warmup 0 pays trace+compile: goodput books it as compile
+            # time, and fit() must not re-book its own first step.
+            with tracker.measure("compile" if k == 0 else "productive"):
+                trainer.state, trainer.last_metrics = trainer.step_fn(
+                    trainer.state, batch, step_rng)
+                trainer._host_step += 1
+                block(trainer.state)
+        trainer._compile_seen = True
 
     if hasattr(model, "active_param_count"):
         n_params = int(model.active_param_count(trainer.state["params"]))
@@ -145,20 +150,39 @@ def pretrain_benchmark(cluster, logger, model, train_cfg, toks,
         batch = put_global_batch(mesh, train.next_batch(global_batch))
         metrics = jax.jit(model.eval_metrics)(trainer.state["params"], batch)
     ms_per_step = total_s * 1000.0 / steps_run
-    per_s = steps_run * global_batch * tokens_per_example / total_s
+    examples_per_s = steps_run * global_batch / total_s
+    per_s = examples_per_s * tokens_per_example
     logger.print("Total Time: %3.2fs" % total_s)
     logger.print(f"Step-Time: {ms_per_step:.2f}ms  "
                  f"Throughput: {per_s:.1f} {throughput_unit}/s  "
                  f"(global batch {global_batch}, mesh {dict(mesh.shape)})")
-    tflops_chip = model_flops / mesh.size / (ms_per_step / 1e3) / 1e12
-    from dtf_tpu.bench.matmul import peak_flops_per_chip
-    # Peak denominator follows the model's compute dtype, not a CLI flag.
-    dtype_str = np.dtype(getattr(model.cfg, "dtype", np.float32)).name
-    peak = peak_flops_per_chip(mesh.devices.flat[0], dtype_str)
-    mfu = (f"  MFU: {tflops_chip * 1e12 / peak * 100.0:.1f}% of "
-           f"{dtype_str} peak" if peak else "")
+    # ONE MFU/throughput formula (telemetry/goodput.py), shared with the
+    # Trainer's sync points; also lands the throughput/* and mfu/* gauges
+    # in the registry for telemetry.json and the report CLI.  Peak
+    # denominator follows the model's compute dtype, not a CLI flag.
+    from dtf_tpu import telemetry as tel
+    peak, dtype_str = tel.goodput.peak_flops_for_model(
+        model, mesh.devices.flat[0])
+    thr = tel.goodput.record_throughput(
+        examples_per_s=examples_per_s,
+        tokens_per_example=tokens_per_example,
+        step_ms=ms_per_step,
+        model_flops_per_example=model_flops / global_batch,
+        n_chips=mesh.size,
+        peak_flops_per_chip=peak)
+    tflops_chip = thr["model_tflops_per_chip"]
+    mfu = (f"  MFU: {thr['mfu_pct']:.1f}% of "
+           f"{dtype_str} peak" if thr["mfu_pct"] is not None else "")
     logger.print(f"Model-Compute: {tflops_chip:.1f} TFLOP/s/chip "
                  f"(6·P·T, {n_params / 1e6:.1f}M active params){mfu}")
     logger.scalar(int(trainer.state["step"]), "model_tflops_per_chip",
                   tflops_chip)
+    if train_cfg.telemetry and train_cfg.logdir and cluster.is_coordinator:
+        # Re-snapshot: the gauges above were set after fit()'s final
+        # write.  Best-effort — a full disk must not turn the completed
+        # benchmark into a crash.
+        try:
+            tel.write_telemetry_json(train_cfg.logdir)
+        except OSError:
+            pass
     return trainer.state, metrics, ms_per_step

@@ -122,7 +122,7 @@ def main(argv=None) -> int:
     train_cfg = _from_namespace(TrainConfig, ns)
 
     cluster = bootstrap(cluster_cfg)
-    logger = MetricLogger(train_cfg.logdir, cluster.is_coordinator)
+    logger = MetricLogger.for_config(train_cfg, cluster.is_coordinator)
 
     kw = {"dtype": jnp.bfloat16 if ns.bf16 else jnp.float32,
           "remat": ns.remat, "remat_policy": ns.remat_policy,

@@ -67,7 +67,7 @@ def main(argv=None) -> int:
 
     cluster = bootstrap(cluster_cfg)
     mesh = cluster.mesh
-    logger = MetricLogger(train_cfg.logdir, cluster.is_coordinator)
+    logger = MetricLogger.for_config(train_cfg, cluster.is_coordinator)
 
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if ns.bf16 else jnp.float32

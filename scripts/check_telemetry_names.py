@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Telemetry name lint (run by the full-suite telemetry lane and
+tests/test_telemetry.py): every metric/span name literal in the package
+must be snake_case/slash scoped AND declared in
+dtf_tpu/telemetry/names.py — the report CLI and dashboards key on those
+strings, and an undeclared name is a dashboard hole nobody notices until
+the post-mortem needs it.
+
+Usage: python scripts/check_telemetry_names.py
+Exit 0 when clean; prints one line per violation otherwise.
+"""
+
+import glob
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from dtf_tpu.telemetry.names import check_source_names  # noqa: E402
+
+
+def main() -> int:
+    paths = sorted(glob.glob(os.path.join(ROOT, "dtf_tpu", "**", "*.py"),
+                             recursive=True))
+    problems = check_source_names(paths)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} telemetry naming violation(s)")
+        return 1
+    print(f"telemetry names OK ({len(paths)} files scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

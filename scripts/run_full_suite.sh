@@ -24,5 +24,27 @@ if [ "${NO_CHAOS_LANE:-0}" != "1" ]; then
   rc=$?
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: chaos lane (rc=$rc)"; }
 fi
+# Telemetry lane (DESIGN.md §6): name lint, then a short chaos'd MNIST
+# job whose run report must render AND whose goodput categories must sum
+# to measured wall-clock within 10% (report --check).  Skip with
+# NO_TELEMETRY_LANE=1.
+if [ "${NO_TELEMETRY_LANE:-0}" != "1" ]; then
+  echo "=== telemetry lane (name lint + chaos'd run + report --check) ==="
+  python scripts/check_telemetry_names.py \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: telemetry name lint"; }
+  tdir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+      --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+      --logdir "$tdir" --checkpoint_every 5 --max_restarts 2 \
+      --chaos "nan_grad@4,stall@7:1s,sigterm@11" > "$tdir/run.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: telemetry lane run (rc=$rc)"; tail -5 "$tdir/run.log"; }
+  python -m dtf_tpu.telemetry.report "$tdir" --check | tee "$tdir/report.log"
+  rc=${PIPESTATUS[0]}       # the report's exit status, not tee's
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --check (rc=$rc)"; }
+  grep -q "Goodput breakdown" "$tdir/report.log" \
+    && grep -q "Top spans" "$tdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing sections"; }
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

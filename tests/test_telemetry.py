@@ -1,0 +1,410 @@
+"""Telemetry spine (dtf_tpu/telemetry): span nesting/export round-trip,
+registry snapshot determinism, goodput arithmetic (incl. under injected
+--chaos faults), metrics.csv attempt de-duplication, naming-scheme lint,
+and a golden-output test for the report CLI on a fixture logdir."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import dtf_tpu.telemetry as tel
+from dtf_tpu.telemetry.goodput import CATEGORIES, GoodputTracker
+from dtf_tpu.telemetry.registry import MetricRegistry
+from dtf_tpu.telemetry.spans import Tracer, export_chrome_trace, read_spans
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Process-wide registry/tracker/tracer state must not leak between
+    tests (or in from earlier test files in the same pytest process)."""
+    tel.reset()
+    yield
+    tel.reset()
+
+
+class TestSpans:
+    def test_nesting_and_roundtrip(self, tmp_path):
+        path = str(tmp_path / "spans.p0.jsonl")
+        tr = Tracer(path, process=0)
+        with tr.span("train/step", step=7):
+            with tr.span("checkpoint/save", step=7):
+                pass
+        tr.instant("chaos/nan_grad", step=17)
+        tr.close()
+        recs = read_spans(path)
+        by_name = {r["name"]: r for r in recs}
+        # inner span closes (and is written) first; both recorded
+        assert recs[0]["name"] == "checkpoint/save"
+        outer, inner = by_name["train/step"], by_name["checkpoint/save"]
+        assert outer["ph"] == inner["ph"] == "X"
+        # structural nesting: depth + parent, child window inside parent
+        assert outer["args"]["depth"] == 0 and "parent" not in outer["args"]
+        assert inner["args"]["depth"] == 1
+        assert inner["args"]["parent"] == "train/step"
+        assert inner["ts"] >= outer["ts"]
+        assert (inner["ts"] + inner["dur"]
+                <= outer["ts"] + outer["dur"] + 1e3)   # 1ms clock slack
+        assert outer["args"]["step"] == 7
+        inst = by_name["chaos/nan_grad"]
+        assert inst["ph"] == "i" and inst["args"]["step"] == 17
+
+    def test_export_chrome_trace(self, tmp_path):
+        tr = Tracer(str(tmp_path / "spans.p0.jsonl"), process=0)
+        with tr.span("train/fit"):
+            pass
+        tr.close()
+        tr1 = Tracer(str(tmp_path / "spans.p1.jsonl"), process=1)
+        with tr1.span("train/fit"):
+            pass
+        tr1.close()
+        out = str(tmp_path / "trace.json")
+        n = export_chrome_trace(str(tmp_path), out)
+        doc = json.load(open(out))
+        events = doc["traceEvents"]
+        assert n == len(events) == 4        # 2 spans + 2 process_name metas
+        assert {e["pid"] for e in events} == {0, 1}
+        assert any(e.get("ph") == "M" and e["name"] == "process_name"
+                   for e in events)
+
+    def test_disabled_tracer_is_noop(self, tmp_path):
+        tr = Tracer(None)
+        with tr.span("train/step"):
+            pass
+        tr.instant("chaos/stall")
+        assert not tr.enabled
+        assert not list((tmp_path).iterdir())
+
+    def test_bad_name_rejected(self, tmp_path):
+        tr = Tracer(str(tmp_path / "s.jsonl"))
+        with pytest.raises(ValueError, match="naming scheme"):
+            with tr.span("Not A Name"):
+                pass
+
+    def test_torn_tail_dropped(self, tmp_path):
+        path = str(tmp_path / "spans.p0.jsonl")
+        tr = Tracer(path)
+        with tr.span("train/step"):
+            pass
+        tr.close()
+        with open(path, "a") as f:
+            f.write('{"name": "train/')       # SIGKILL mid-write
+        assert [r["name"] for r in read_spans(path)] == ["train/step"]
+
+
+class TestRegistry:
+    def test_snapshot_deterministic(self):
+        def feed(reg):
+            # creation order must not matter
+            reg.gauge("throughput/tokens_per_s").set(10.0)
+            reg.counter("event/rollback").inc(2)
+            reg.histogram("throughput/step_ms").observe(4.0)
+            reg.histogram("throughput/step_ms").observe(8.0)
+        a, b = MetricRegistry(), MetricRegistry()
+        feed(a)
+        b.histogram("throughput/step_ms")     # registered earlier, same end
+        feed(b)
+        assert a.snapshot() == b.snapshot()
+        snap = a.snapshot()
+        assert list(snap) == sorted(snap)
+        assert snap["event/rollback"] == {"type": "counter", "value": 2}
+        h = snap["throughput/step_ms"]
+        assert (h["count"], h["sum"], h["min"], h["max"], h["mean"]) == \
+            (2, 12.0, 4.0, 8.0, 6.0)
+
+    def test_type_conflict_rejected(self):
+        reg = MetricRegistry()
+        reg.counter("event/rollback")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("event/rollback")
+
+    def test_write_json_atomic(self, tmp_path):
+        reg = MetricRegistry()
+        reg.gauge("mfu/pct_peak").set(41.5)
+        path = str(tmp_path / "telemetry.json")
+        reg.write_json(path, extra={"run": "x"})
+        doc = json.load(open(path))
+        assert doc["metrics"]["mfu/pct_peak"]["value"] == 41.5
+        assert doc["run"] == "x"
+        assert not os.path.exists(path + ".tmp")
+
+
+class TestGoodput:
+    def test_arithmetic(self):
+        t = GoodputTracker()
+        t.add("productive", 6.0)
+        t.add("rollback", 1.0)
+        t.add("checkpoint", 2.0)
+        assert t.accounted_s() == pytest.approx(9.0)
+        snap = t.snapshot()
+        assert snap["productive_s"] == 6.0 and snap["rollback_s"] == 1.0
+        # wall >= 0 and tiny here (clock started at first add)
+        assert 0 <= snap["wall_s"] < 5.0
+        with pytest.raises(ValueError, match="unknown goodput category"):
+            t.add("coffee", 1.0)
+
+    def test_measure_and_restart_window(self):
+        t = GoodputTracker()
+        with t.measure("eval"):
+            pass
+        t.mark_down()
+        t.mark_up()
+        t.mark_up()                          # idempotent: no open window
+        assert t.buckets["eval"] >= 0
+        assert t.buckets["restart"] >= 0
+        assert t.goodput_fraction() == pytest.approx(
+            t.buckets["productive"] / t.wall_s())
+
+    def test_load_previous_accounts_downtime(self):
+        import time
+        t = GoodputTracker()
+        t.load_previous({
+            "goodput": {"productive_s": 5.0, "checkpoint_s": 1.0,
+                        "wall_s": 7.0},
+            "written_unix": time.time() - 3.0})
+        assert t.buckets["productive"] == 5.0
+        assert t.buckets["restart"] == pytest.approx(3.0, abs=0.5)
+        assert t.wall_s() == pytest.approx(10.0, abs=0.5)
+
+    def test_every_category_snapshots(self):
+        snap = GoodputTracker().snapshot()
+        for c in CATEGORIES:
+            assert f"{c}_s" in snap
+
+
+class TestNames:
+    def test_validate(self):
+        from dtf_tpu.telemetry.names import validate
+        assert validate("checkpoint/save") == "checkpoint/save"
+        for bad in ("CamelCase", "has space", "trailing/", "/leading",
+                    "semi;colon"):
+            with pytest.raises(ValueError):
+                validate(bad)
+
+    def test_source_tree_is_clean(self):
+        """THE lint: every telemetry name literal in the package is
+        scheme-shaped and declared in telemetry/names.py."""
+        from dtf_tpu.telemetry.names import check_source_names
+        root = os.path.join(os.path.dirname(__file__), "..", "dtf_tpu")
+        paths = glob.glob(os.path.join(root, "**", "*.py"), recursive=True)
+        assert paths
+        assert check_source_names(paths) == []
+
+    def test_wildcard_declarations(self):
+        from dtf_tpu.telemetry.names import is_declared
+        assert is_declared("health/step_ms_p3")
+        assert is_declared("event/rollback")
+        assert not is_declared("nonexistent/thing")
+
+
+class TestMetricsCsvAttempts:
+    def test_attempt_column_and_auto_resume(self, tmp_path):
+        from dtf_tpu.train.metrics import MetricLogger
+        d = str(tmp_path)
+        lg = MetricLogger(d, attempt=0)
+        lg.scalar(5, "cost", 2.0)
+        lg.close()
+        lg = MetricLogger(d, attempt=1)
+        lg.scalar(5, "cost", 1.9)            # restart overlaps step 5
+        lg.close()
+        # attempt=None auto-continues past the file's last attempt
+        lg = MetricLogger(d, attempt=None)
+        assert lg.attempt == 2
+        lg.scalar(10, "cost", 1.5)
+        lg.close()
+        rows = open(os.path.join(d, "metrics.csv")).read().splitlines()
+        assert rows[0] == "step,metric,value,attempt"
+        assert rows[1:] == ["5,cost,2.0,0", "5,cost,1.9,1", "10,cost,1.5,2"]
+
+    def test_report_dedupes_latest_attempt(self):
+        from dtf_tpu.telemetry.report import dedupe_latest_attempt
+        rows = [(5, 0, "cost", 2.0), (10, 0, "cost", 1.95),
+                (10, 1, "cost", 1.9), (15, 1, "cost", 1.7)]
+        out = dedupe_latest_attempt(rows)
+        assert (10, 1, "cost", 1.9) in out
+        assert (10, 0, "cost", 1.95) not in out
+        assert len(out) == 3
+
+    def test_legacy_three_column_rows_read(self, tmp_path):
+        from dtf_tpu.telemetry.report import load_metrics_csv
+        p = tmp_path / "metrics.csv"
+        p.write_text("step,metric,value\n5,cost,2.0\n7,cost,1.0\n")
+        assert load_metrics_csv(str(p)) == [(5, 0, "cost", 2.0),
+                                            (7, 0, "cost", 1.0)]
+
+
+class TestSummarizeTraceSteps:
+    def _write_trace(self, tmp_path):
+        import gzip
+        run = tmp_path / "plugins" / "profile" / "2026_01_01"
+        run.mkdir(parents=True)
+        events = [
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1",
+             "dur": 4_000_000},
+        ]
+        with gzip.open(run / "vm.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def test_steps_normalizes_per_step(self, tmp_path):
+        from dtf_tpu.utils.profiling import summarize_trace
+        self._write_trace(tmp_path)
+        assert summarize_trace(str(tmp_path)) == [("fusion.1", 4.0)]
+        assert summarize_trace(str(tmp_path), steps=2) == [("fusion.1", 2.0)]
+
+    def test_nonpositive_steps_rejected(self, tmp_path):
+        from dtf_tpu.utils.profiling import summarize_trace
+        self._write_trace(tmp_path)
+        with pytest.raises(ValueError, match="positive traced-step"):
+            summarize_trace(str(tmp_path), steps=0)
+
+
+@pytest.mark.chaos
+class TestGoodputUnderChaos:
+    def _trainer(self, mesh8, cfg, chaos=None):
+        from dtf_tpu import optim
+        from dtf_tpu.cluster import Cluster
+        from dtf_tpu.config import ClusterConfig
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.trainer import Trainer
+        cluster = Cluster(config=ClusterConfig(), mesh=mesh8)
+        return Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                       optim.sgd(0.05), cfg, chaos=chaos)
+
+    def test_rollback_books_as_nonproductive(self, mesh8, tmp_path):
+        """nan_grad x2 with bad_step_limit=2 forces a rollback restore:
+        it must show up in the rollback bucket and the event counter, and
+        the goodput columns must still sum to wall-clock."""
+        from dtf_tpu.config import TrainConfig
+        from dtf_tpu.data import load_mnist
+        cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                          log_frequency=1, seed=1, logdir=str(tmp_path),
+                          checkpoint_every=2, bad_step_limit=2,
+                          max_rollbacks=2,
+                          chaos="nan_grad@3,nan_grad@4,stall@2:0.2s")
+        t = self._trainer(mesh8, cfg)
+        res = t.fit(load_mnist(seed=1), epochs=1, max_steps=8)
+        t.logger.close()
+        assert res["rollbacks"] == 1
+        tracker = tel.get_tracker()
+        assert tracker.buckets["rollback"] > 0
+        assert tracker.buckets["stall"] >= 0.2
+        assert tel.counter("event/rollback").value == 1
+        assert tel.counter("chaos/faults_fired_total").value == 3
+        doc = json.load(open(tmp_path / "telemetry.json"))
+        g = doc["goodput"]
+        total = sum(g[f"{c}_s"] for c in CATEGORIES)
+        assert total == pytest.approx(g["wall_s"], rel=0.10)
+        assert g["rollback_s"] > 0
+        # chaos marks landed in the span timeline
+        spans = read_spans(str(tmp_path / "spans.p0.jsonl"))
+        marks = [r["name"] for r in spans if r["ph"] == "i"]
+        assert "chaos/nan_grad" in marks and "chaos/stall" in marks
+
+    def test_supervisor_restart_books_downtime(self):
+        """A crash->restart cycle under run_supervised must land in the
+        restart bucket (supervisor marks down, next attempt marks up)."""
+        from dtf_tpu.resilience.supervisor import run_supervised
+        from dtf_tpu.utils.retry import Backoff
+        tracker = tel.get_tracker()
+
+        def fit_once(attempt):
+            if attempt == 0:
+                raise OSError("injected crash")
+            tracker.mark_up()              # the next Trainer's ctor does this
+            return {"preempted": False}
+
+        result = run_supervised(fit_once, max_restarts=1,
+                                backoff=Backoff(base_s=0.05, max_s=0.05,
+                                                jitter=0.0))
+        assert result == {"preempted": False}
+        assert tracker.buckets["restart"] >= 0.05
+        assert tel.counter("supervisor/restarts_total").value == 1
+
+
+class TestReportCLI:
+    def _fixture_logdir(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, "metrics.csv"), "w") as f:
+            f.write("step,metric,value,attempt\n"
+                    "5,cost,2.0,0\n10,cost,1.95,0\n"
+                    "10,cost,1.9,1\n15,cost,1.7,1\n"
+                    "10,event/rollback,1.0,1\n"
+                    "15,health/step_ms_p0,12.0,1\n"
+                    "15,health/step_ms_p1,30.0,1\n")
+        with open(os.path.join(d, "telemetry.json"), "w") as f:
+            json.dump({
+                "goodput": {"productive_s": 8.0, "checkpoint_s": 0.6,
+                            "rollback_s": 0.5, "restart_s": 0.5,
+                            "stall_s": 0.2, "compile_s": 0.2,
+                            "wall_s": 10.0, "accounted_s": 10.0,
+                            "productive_fraction": 0.8},
+                "metrics": {"throughput/tokens_per_s":
+                            {"type": "gauge", "value": 1234.5},
+                            "mfu/pct_peak":
+                            {"type": "gauge", "value": 41.5}},
+                "written_unix": 0}, f)
+        tr = Tracer(os.path.join(d, "spans.p0.jsonl"), process=0)
+        with tr.span("train/step"):
+            pass
+        tr.instant("chaos/host_down", step=30)
+        tr.close()
+        return d
+
+    def test_golden_sections(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        assert report.main([d]) == 0
+        out = capsys.readouterr().out
+        # golden contract: the section lines the post-mortem reads
+        assert f"== dtf_tpu run report: {d} ==" in out
+        assert "Goodput breakdown" in out
+        assert "goodput (productive/wall): 80.0%" in out
+        assert "throughput/tokens_per_s            1234.5" in out
+        assert "mfu/pct_peak                         41.5" in out
+        assert ("Steps: 5..15  final cost 1.7000  (attempts: [0, 1], "
+                "1 overlapping rows superseded by the latest attempt)"
+                in out)
+        assert "event/rollback (count 1)" in out
+        assert "chaos/host_down" in out
+        assert "p0: mean    12.00" in out and "p1: mean    30.00" in out
+        assert "Top spans" in out and "train/step" in out
+
+    def test_check_gate(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        assert report.main([d, "--check"]) == 0
+        assert "goodput check: OK" in capsys.readouterr().out
+        # break the books: components no longer sum to wall
+        doc = json.load(open(os.path.join(d, "telemetry.json")))
+        doc["goodput"]["productive_s"] = 1.0
+        json.dump(doc, open(os.path.join(d, "telemetry.json"), "w"))
+        assert report.main([d, "--check"]) == 1
+        assert "goodput check: FAIL" in capsys.readouterr().out
+
+    def test_export_trace(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        out = os.path.join(d, "merged.json")
+        assert report.main([d, "--export-trace", out]) == 0
+        assert json.load(open(out))["traceEvents"]
+
+    def test_json_mode(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        assert report.main([d, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["attempts"] == [0, 1]
+        assert doc["telemetry"]["goodput"]["wall_s"] == 10.0
+
+    def test_empty_logdir(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        assert report.main([str(tmp_path)]) == 0
+        assert "nothing found" in capsys.readouterr().out
+
+    def test_missing_dir_rejected(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report
+        assert report.main([str(tmp_path / "nope")]) == 2
