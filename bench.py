@@ -19,7 +19,11 @@ still prints exactly ONE JSON line — ``{"error": "tpu_unavailable", ...}``
 with a nonzero exit code — so ``BENCH_r*.json`` distinguishes "the relay is
 down" from "the harness is broken" without reading tracebacks.  Backend
 init runs under a watchdog (``DTF_BENCH_INIT_TIMEOUT_S``, default 600s —
-first compile on the relay can legitimately take tens of seconds).
+first compile on the relay can legitimately take tens of seconds).  Before
+any of that, a ~60s KILLABLE subprocess probe (``preflight_probe``,
+``DTF_BENCH_PREFLIGHT_TIMEOUT_S``; 0 disables) catches the hang mode fast:
+the watchdog thread can only flag a hang, not reclaim it, so without the
+preflight a dead relay still burned the full outer timeout.
 """
 
 import json
@@ -65,6 +69,51 @@ def _emit_once(line: dict, state: dict) -> bool:
 # threading.Thread would hijack unrelated threads).
 _Thread = threading.Thread
 
+# Preflight probe body: the minimal backend init, run in a KILLABLE
+# subprocess.  The daemon-thread watchdog below can only FLAG a hang (the
+# thread is stuck in C++ and unreclaimable), so a dead relay still burns
+# the full DTF_BENCH_INIT_TIMEOUT_S/deadline budget; a subprocess probe is
+# killed after ~60s and the run fails fast instead (BENCH_r05.json: "dead
+# relay hangs rather than raising").
+_PREFLIGHT_SRC = """\
+import os
+import jax
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.devices()
+"""
+
+
+def _want_preflight() -> bool:
+    """Probe only when the run may touch the TPU relay: JAX_PLATFORMS
+    unset (this image's plugin auto-selects the TPU) or explicitly
+    requesting tpu.  A cpu-only run cannot hit the relay's hang mode and
+    must not pay a ~2s interpreter+jax-import tax for it."""
+    req = [p.strip().lower() for p in
+           os.environ.get("JAX_PLATFORMS", "").split(",") if p.strip()]
+    return not req or "tpu" in req
+
+
+def preflight_probe(timeout_s: float) -> "tuple[bool, str]":
+    """Run backend init in a subprocess; returns ``(hung, reason)``.
+
+    Only the HANG mode is terminal here: a probe that *raises* exits
+    quickly, and the real ``init_backend`` will re-raise the same error
+    under main()'s existing outage/config classifiers — the preflight
+    must not duplicate that logic.  ``subprocess.run`` kills the child on
+    timeout, so a wedged probe cannot outlive the verdict."""
+    import subprocess
+    try:
+        subprocess.run([sys.executable, "-c", _PREFLIGHT_SRC],
+                       timeout=timeout_s, capture_output=True)
+        return False, ""
+    except subprocess.TimeoutExpired:
+        return True, (f"backend init probe subprocess hung past "
+                      f"DTF_BENCH_PREFLIGHT_TIMEOUT_S={timeout_s:.0f}s "
+                      f"(dead relay hang mode)")
+    except Exception as exc:       # no interpreter/fork: not outage evidence
+        return False, f"probe unavailable ({exc})"
+
 
 def init_backend(timeout_s: float):
     """Initialise the jax backend under a watchdog.
@@ -107,7 +156,7 @@ def init_backend(timeout_s: float):
     return result["devices"]
 
 
-def main(_init=init_backend) -> int:
+def main(_init=init_backend, _preflight=preflight_probe) -> int:
     emit_state: dict = {}
 
     def fail(error: str, stage: str, reason: str) -> int:
@@ -123,6 +172,8 @@ def main(_init=init_backend) -> int:
     try:
         timeout_s = float(os.environ.get("DTF_BENCH_INIT_TIMEOUT_S", "600"))
         deadline_s = float(os.environ.get("DTF_BENCH_DEADLINE_S", "1800"))
+        preflight_s = float(
+            os.environ.get("DTF_BENCH_PREFLIGHT_TIMEOUT_S", "60"))
         ns = tuple(int(n) for n in
                    os.environ.get("DTF_BENCH_NS", "1000,1024,2048,4096,8192")
                    .split(","))
@@ -139,9 +190,25 @@ def main(_init=init_backend) -> int:
                     "DTF_BENCH_INIT_TIMEOUT_S and DTF_BENCH_DEADLINE_S must "
                     f"be in (0, {threading.TIMEOUT_MAX:.0f}], "
                     f"got {timeout_s} / {deadline_s}")
+    # 0 disables the preflight (operators who know the relay is up and
+    # want the 2s back); NaN/inf rejected like the other knobs.
+    if not (0 <= preflight_s <= threading.TIMEOUT_MAX):
+        return fail("config_error", "config",
+                    "DTF_BENCH_PREFLIGHT_TIMEOUT_S must be in "
+                    f"[0, {threading.TIMEOUT_MAX:.0f}], got {preflight_s}")
     if not ns or not all(n > 0 for n in ns):
         return fail("config_error", "config",
                     f"DTF_BENCH_NS values must be positive, got {ns}")
+
+    # Fail-fast preflight: a dead relay's hang mode is caught by a
+    # killable ~60s subprocess probe instead of burning the full
+    # DTF_BENCH_INIT_TIMEOUT_S (600s) inside an unreclaimable daemon
+    # thread.  Raise-mode failures fall through to the real init, which
+    # classifies them (outage vs config vs harness) exactly as before.
+    if preflight_s > 0 and _preflight is not None and _want_preflight():
+        hung, why = _preflight(preflight_s)
+        if hung:
+            return fail("tpu_unavailable", "preflight", why)
 
     # Classify a deadline hit by where it struck: before backend init
     # succeeded it is the relay's hang mode; after, the backend provably

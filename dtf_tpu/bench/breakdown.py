@@ -307,9 +307,17 @@ def main(argv=None) -> int:
                         help="attention block-size sweep + Dh shape "
                              "ablation instead of the layer breakdown "
                              "(the r4 MFU close-or-retire evidence)")
+    parser.add_argument("--compile_cache", default=None, metavar="DIR",
+                        help="persistent XLA compile cache: every ladder "
+                             "point is its own 20-40s compile at these "
+                             "shapes, so a re-run against the same DIR "
+                             "skips straight to the timed region")
     ns = parser.parse_args(argv)
     if ns.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if ns.compile_cache:
+        from dtf_tpu.train.compile_cache import enable
+        enable(ns.compile_cache)
     peak = peak_flops_per_chip()
     if ns.attn_sweep:
         rows = attn_sweep(ns.family, ns.batch, ns.seq)

@@ -194,6 +194,30 @@ class TrainConfig:
     # (bad steps are still skipped and counted).
     bad_step_limit: int = 5
     max_rollbacks: int = 2
+    # Async device-prefetch input pipeline (data/prefetch.py): a background
+    # producer thread runs fetch -> chaos poison -> sharded device_put for
+    # the next N batches into a bounded queue of DEVICE-resident batches,
+    # so host data time overlaps the dispatched step instead of
+    # serializing with it.  Same trajectory bitwise (same batch order,
+    # same per-step rng); goodput books "data" time only when the loop
+    # actually blocks on an empty queue (data/prefetch_stall).  0 restores
+    # the serial fetch->put->dispatch path; 2 = double buffering.
+    prefetch: int = 2
+    # Persistent XLA compilation cache directory (train/compile_cache.py):
+    # compiled executables are keyed by HLO and reused ACROSS processes,
+    # so supervisor restarts / elastic relaunches / --resume relaunches
+    # skip the backend compile instead of re-paying it every attempt.
+    # Hits/misses surface as compile/cache_hit + compile/cache_miss
+    # counters.  None disables (jax default behavior).
+    compile_cache: Optional[str] = None
+    # AOT warmup: .lower().compile() the train step before the first loop
+    # dispatch (shapes probed from the dataset), overlapping the
+    # prefetcher's initial fill — the compile books into an explicit
+    # "compile" goodput bucket instead of hiding in the first step, and
+    # with --compile_cache a warm attempt's warmup is a cache read.
+    # Falls back silently to compile-on-first-dispatch for datasets that
+    # can't be shape-probed (no ``examples`` accessor).
+    aot_warmup: bool = True
     # Fault-injection spec for the chaos harness (resilience/chaos.py), e.g.
     # "nan_grad@17,corrupt_ckpt@latest,sigterm@40,stall@25:3s,
     # loader_error@9,seed=7".  None disables.
@@ -221,6 +245,10 @@ class TrainConfig:
             raise ValueError(
                 "--profile_summary aggregates a captured trace; it needs "
                 "--profile_dir to capture one")
+        if self.prefetch < 0:
+            raise ValueError(
+                f"--prefetch is a queue depth (0 disables the async input "
+                f"pipeline); got {self.prefetch}")
 
 
 def _field_type(cls, f: dataclasses.Field) -> type:

@@ -1,1 +1,2 @@
 from dtf_tpu.data.datasets import Dataset, DataSplits, load_mnist, load_cifar10, synthetic_text  # noqa: F401
+from dtf_tpu.data.prefetch import DevicePrefetcher  # noqa: F401

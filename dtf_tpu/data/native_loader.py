@@ -61,14 +61,22 @@ class NativeDataset:
     def feature_dim(self) -> int:
         return self._feat
 
-    def next_batch(self, batch_size: int) -> tuple:
+    def _check_batch_size(self, batch_size: int) -> None:
         if batch_size != self.batch_size:
             raise ValueError(
                 f"NativeDataset prefetches fixed batches of "
                 f"{self.batch_size}, got request for {batch_size}")
-        imgs = np.empty((self.batch_size, self._feat), np.float32)
-        labs = np.empty((self.batch_size, self.num_classes), np.float32)
 
+    def _pull_into(self, imgs: np.ndarray, labs: np.ndarray) -> None:
+        """Fill caller-owned buffers with the next prefetched batch.
+
+        A nonzero rc today means a closed/invalid handle (deterministic),
+        so the bounded retry exists for the error CONTRACT — any future
+        transient rc codes get a brief retry, and every failure ends in
+        a loud terminal RetryExhausted, never an unbounded loop.  A dead
+        producer thread is a different failure class: it blocks inside
+        the C++ wait, which the trainer's hang watchdog (not this retry)
+        converts into a fail-fast exit."""
         def pull():
             rc = self._lib.dtf_loader_next(
                 self._handle,
@@ -77,28 +85,38 @@ class NativeDataset:
             if rc != 0:
                 raise OSError(f"native loader dtf_loader_next rc={rc}")
 
-        # A nonzero rc today means a closed/invalid handle (deterministic),
-        # so the bounded retry exists for the error CONTRACT — any future
-        # transient rc codes get a brief retry, and every failure ends in
-        # a loud terminal RetryExhausted, never an unbounded loop.  A dead
-        # producer thread is a different failure class: it blocks inside
-        # the C++ wait, which the trainer's hang watchdog (not this retry)
-        # converts into a fail-fast exit.
+        from dtf_tpu import telemetry as tel
+        retry_call(pull, attempts=3, backoff=self._retry_backoff,
+                   retry_on=(OSError,), what="native loader next_batch",
+                   on_retry=lambda a, e: tel.counter(
+                       "data/fetch_retries_total").inc())
+
+    def next_batch(self, batch_size: int) -> tuple:
+        self._check_batch_size(batch_size)
+        imgs = np.empty((self.batch_size, self._feat), np.float32)
+        labs = np.empty((self.batch_size, self.num_classes), np.float32)
         from dtf_tpu import telemetry as tel
         with tel.span("data/next_batch", n=batch_size, native=1):
-            retry_call(pull, attempts=3, backoff=self._retry_backoff,
-                       retry_on=(OSError,), what="native loader next_batch",
-                       on_retry=lambda a, e: tel.counter(
-                           "data/fetch_retries_total").inc())
+            self._pull_into(imgs, labs)
         self.batches_consumed += 1
         return imgs, labs
 
     def fast_forward(self, n_batches: int, batch_size: int) -> None:
         """Resume support: drain n batches (the prefetcher computes them
-        anyway; draining keeps the shuffle stream aligned)."""
-        for _ in range(n_batches):
-            self.next_batch(batch_size)
-        # next_batch already counted them
+        anyway; draining keeps the shuffle stream aligned).  ONE scratch
+        buffer pair is reused for the whole drain — a multi-epoch resume
+        drains O(steps) batches and must not allocate O(steps) arrays the
+        way looping next_batch would."""
+        if n_batches <= 0:
+            return
+        self._check_batch_size(batch_size)
+        imgs = np.empty((self.batch_size, self._feat), np.float32)
+        labs = np.empty((self.batch_size, self.num_classes), np.float32)
+        from dtf_tpu import telemetry as tel
+        with tel.span("data/fast_forward", n=n_batches, native=1):
+            for _ in range(n_batches):
+                self._pull_into(imgs, labs)
+        self.batches_consumed += n_batches
 
     def close(self) -> None:
         if self._handle:

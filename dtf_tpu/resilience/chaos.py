@@ -53,6 +53,7 @@ import logging
 import os
 import re
 import signal
+import threading
 import time
 from typing import Any, Callable, List, Optional
 
@@ -131,6 +132,12 @@ class FaultPlan:
         self._process_index = process_index
         self._slow_delay_s = 0.0
         self._on_partition: Optional[Callable[[], None]] = None
+        # Fault selection is shared mutable state (fired/last_fired_step
+        # latches) and is now hit from TWO threads: the trainer's loop
+        # (step faults) and the device-prefetcher's producer
+        # (loader_error/nan_grad, step-keyed however far ahead it runs).
+        # One lock keeps a latch from double-firing across them.
+        self._take_lock = threading.Lock()
 
     @classmethod
     def parse(cls, spec: str, **kwargs) -> "FaultPlan":
@@ -225,6 +232,10 @@ class FaultPlan:
         return jax.process_index()
 
     def _take(self, kind: str, step: Optional[int]) -> Optional[Fault]:
+        with self._take_lock:
+            return self._take_locked(kind, step)
+
+    def _take_locked(self, kind: str, step: Optional[int]) -> Optional[Fault]:
         for f in self.faults:
             if f.kind != kind:
                 continue

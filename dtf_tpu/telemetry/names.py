@@ -49,6 +49,11 @@ METRICS = (
     "mfu/pct_peak",
     "goodput/*",                  # per-category seconds + fraction
     "compile/first_step_s",
+    "compile/aot_s",
+    "compile/cache_hit",
+    "compile/cache_miss",
+    "data/prefetch_depth",
+    "data/prefetch_stall_s",
     "checkpoint/save_ms",
     "checkpoint/saves_total",
     "checkpoint/restores_total",
@@ -69,6 +74,9 @@ SPANS = (
     "checkpoint/restore",
     "supervisor/backoff",
     "data/next_batch",
+    "data/fast_forward",
+    "data/prefetch_stall",
+    "compile/aot_warmup",
     "trainer/init",
     # instants
     "chaos/*",                    # chaos/<fault kind> firing marks

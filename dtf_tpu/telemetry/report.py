@@ -187,6 +187,20 @@ def render(report: dict, top: int = 10) -> str:
         lines.append("Throughput / MFU")
         for n in sorted(thr):
             lines.append(f"  {n:<28} {thr[n]:12.5g}")
+    # Input pipeline + compile reuse: how much host data time the device
+    # prefetcher left on the hot path (0 stall = fully overlapped) and
+    # whether the persistent compile cache actually saved this attempt a
+    # rebuild.  Values may legitimately be 0 — that IS the good reading —
+    # so presence is keyed on the instrument, not on a nonzero value.
+    pipe = {n: m.get("value") for n, m in metrics.items()
+            if n in ("data/prefetch_depth", "data/prefetch_stall_s",
+                     "compile/cache_hit", "compile/cache_miss",
+                     "compile/aot_s")
+            and m.get("value") is not None}
+    if pipe:
+        lines.append("Input pipeline / compile")
+        for n in sorted(pipe):
+            lines.append(f"  {n:<28} {pipe[n]:12.5g}")
     if "steps" in report:
         s = report["steps"]
         lines.append(f"Steps: {s['first']}..{s['last']}  "

@@ -1,0 +1,80 @@
+"""Persistent XLA compilation cache: stop re-paying trace+compile on
+every restart.
+
+Every supervisor restart, elastic relaunch and scheduler-driven
+``--resume`` builds a fresh ``jit`` and re-pays the full backend compile
+of a program that is byte-identical to the last attempt's — pure restart
+downtime.  jax's persistent compilation cache keys compiled executables
+by HLO fingerprint in a shared directory, so any process (attempt,
+relaunch, sibling host with the same program) gets a disk read instead
+of a compile — standard practice in pjit-era TPU training (PAPERS.md:
+arxiv 2204.06514).
+
+:func:`enable` points jax at ``--compile_cache DIR`` and drops the
+min-compile-time threshold so even fast CPU-test programs cache (the TPU
+programs this exists for are all above any threshold).  It also installs
+a ``jax.monitoring`` listener that mirrors the cache's hit/miss events
+into the telemetry registry as ``compile/cache_hit`` /
+``compile/cache_miss`` counters — so ``telemetry.json`` and the run
+report show compile *reuse* across attempts, not just a shrinking
+"compile" goodput bucket.  Idempotent: the supervisor's
+fresh-Trainer-per-attempt path calls it once per attempt.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("dtf_tpu")
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_state = {"listener": False, "dir": None}
+
+
+def _on_event(event: str, **kwargs) -> None:
+    # Counters, not gauges: lifetime totals that survive telemetry.json
+    # reloads across attempts (registry.load_counters).
+    from dtf_tpu import telemetry as tel
+    if event == _HIT_EVENT:
+        tel.counter("compile/cache_hit").inc()
+    elif event == _MISS_EVENT:
+        tel.counter("compile/cache_miss").inc()
+
+
+def enable(cache_dir: str) -> Optional[str]:
+    """Enable the persistent compilation cache at ``cache_dir`` (created
+    if absent) and install the hit/miss telemetry listener.  Returns the
+    directory, or None when this jax build lacks the cache config (the
+    run proceeds uncached — a missing optimization, not an error)."""
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache EVERYTHING: the default 1s threshold would skip the small
+        # CPU-rig test programs, and the cache exists precisely for the
+        # programs too expensive to rebuild.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as exc:           # feature-detect, don't crash a run
+        log.warning("persistent compile cache unavailable in this jax "
+                    "build (%s); continuing uncached", exc)
+        return None
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass                           # older builds: size gate keeps default
+    if not _state["listener"]:
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_on_event)
+            _state["listener"] = True
+        except Exception as exc:
+            log.warning("compile-cache hit/miss telemetry unavailable "
+                        "(%s); cache still active", exc)
+    _state["dir"] = cache_dir
+    return cache_dir

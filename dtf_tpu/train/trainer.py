@@ -528,6 +528,13 @@ class Trainer:
         # cfg.attempt from an external scheduler overrides.
         self.logger = self.logger or MetricLogger.for_config(
             self.cfg, self.cluster.is_coordinator)
+        # Persistent compile cache (train/compile_cache.py): enabled
+        # BEFORE the first trace so this attempt's compiles read/write the
+        # shared directory — supervisor restarts and elastic relaunches
+        # hit the cache instead of re-paying the backend compile.
+        if self.cfg.compile_cache:
+            from dtf_tpu.train import compile_cache
+            compile_cache.enable(self.cfg.compile_cache)
         self._chaos = self.chaos if self.chaos is not None else self.cfg.chaos
         if isinstance(self._chaos, str):
             from dtf_tpu.resilience.chaos import FaultPlan
@@ -658,6 +665,14 @@ class Trainer:
         # synchronously, so its wall time books as "compile", not
         # "productive" (goodput category table).
         self._compile_seen = False
+        # AOT warmup (fit() start): .lower().compile() of the train step,
+        # so the compile lands in an explicit goodput bucket (and, with
+        # --compile_cache, a warm attempt's warmup is a cache read)
+        # instead of hiding inside the first step's dispatch.
+        self._compiled_step = None
+        self._compiled_ok = False      # set after the first successful call
+        self._compiled_batch_sig = None
+        self._fit_step_call = None     # per-fit dispatch choice (see fit)
         tracker.add("init", max(
             time.perf_counter() - _t_init
             - (tracker.buckets["checkpoint"] - _ck0), 0.0))
@@ -741,6 +756,99 @@ class Trainer:
             f"{good_step} ({self._rollbacks}/{self.cfg.max_rollbacks} "
             f"rollbacks used)")
 
+    @staticmethod
+    def _batch_signature(batch) -> tuple:
+        """Shape/dtype signature of a batch pytree — the guard that keeps a
+        Compiled train step from being fed a differently-shaped fit."""
+        return tuple((tuple(x.shape), str(x.dtype))
+                     for x in jax.tree_util.tree_leaves(batch))
+
+    def _aot_warmup(self, train_split, global_bs: int) -> None:
+        """AOT-compile the train step (``.lower().compile()``) before the
+        first loop dispatch.  Batch shapes are probed via the dataset's
+        ``examples`` accessor (no cursor advance); datasets without one
+        (callable/native streams) silently keep compile-on-first-dispatch.
+        The compile books into the "compile" goodput bucket and — with
+        ``--compile_cache`` — is a disk read on warm attempts, surfacing
+        as ``compile/cache_hit``.  Runs while the prefetcher's producer
+        fills its queue, so compile and the initial data fill overlap."""
+        mesh = self.cluster.mesh
+        base = getattr(train_split, "base", train_split)   # ProcessShard
+        examples = getattr(base, "examples", None)
+        if examples is None:
+            return
+        try:
+            sample = examples(0, min(global_bs, base.num_examples))
+        except Exception:
+            return                     # probe-hostile dataset: not an error
+        def sds(x):
+            x = np.asarray(x)
+            if x.ndim == 0:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sh.replicate(mesh))
+            return jax.ShapeDtypeStruct((global_bs,) + x.shape[1:], x.dtype,
+                                        sharding=sh.batch_spec(mesh, x.ndim))
+        batch_sds = jax.tree_util.tree_map(sds, sample)
+        rng_like = jax.random.fold_in(jax.random.key(self.cfg.seed + 17),
+                                      self._host_step)
+        tracker = tel.get_tracker()
+        _t0 = time.perf_counter()
+        try:
+            with tel.span("compile/aot_warmup"), tracker.measure("compile"):
+                self._compiled_step = self.step_fn.lower(
+                    self.state, batch_sds, rng_like).compile()
+        except Exception as exc:       # lowering quirk -> jit path, loudly
+            self._compiled_step = None
+            self.logger.print(
+                f"[dtf_tpu] AOT warmup failed ({type(exc).__name__}: "
+                f"{exc}); compiling on first dispatch instead")
+            return
+        self._compiled_batch_sig = self._batch_signature(batch_sds)
+        self._compile_seen = True      # the loop's first step is productive
+        tel.gauge("compile/aot_s").set(time.perf_counter() - _t0)
+
+    def _dispatch_step(self, batch, step_rng):
+        """One train-step dispatch: the AOT-compiled executable when its
+        input signature matches this fit's batches, else the jit path
+        (identical program, identical trajectory).  The signature check
+        runs ONCE per fit (the first dispatch) — batch shapes are fixed
+        for a whole fit, and this is the hot loop the PR exists to
+        shrink.  The FIRST compiled call may be rejected at
+        argument-check time (a sharding/layout the lowering didn't
+        anticipate): only TypeError/ValueError are retried on the jit
+        path, because those are raised by input validation BEFORE
+        execution or donation; an execution failure (XlaRuntimeError —
+        OOM, interconnect) propagates as-is rather than retrying on
+        donated buffers and masking the real error."""
+        call = self._fit_step_call
+        if call is None:               # first dispatch of this fit
+            call = self._compiled_step
+            if call is not None and (
+                    self._compiled_batch_sig
+                    != self._batch_signature(batch)):
+                call = None            # a differently-shaped fit: jit path
+            call = self.step_fn if call is None else call
+            self._fit_step_call = call
+        if call is not self.step_fn:
+            try:
+                out = call(self.state, batch, step_rng)
+            except (TypeError, ValueError) as exc:
+                if self._compiled_ok:
+                    raise              # it worked before: a real error
+                self._compiled_step = None
+                self._fit_step_call = self.step_fn
+                # This retry pays the jit trace+compile the AOT warmup
+                # was supposed to cover; the loop books it (and sets
+                # compile/first_step_s) off this flag.
+                self._compile_seen = False
+                self.logger.print(
+                    f"[dtf_tpu] AOT-compiled step rejected its inputs "
+                    f"({type(exc).__name__}: {exc}); using the jit path")
+                return self.step_fn(self.state, batch, step_rng)
+            self._compiled_ok = True
+            return out
+        return self.step_fn(self.state, batch, step_rng)
+
     @property
     def global_batch_size(self) -> int:
         if self.cfg.per_device_batch:
@@ -799,6 +907,20 @@ class Trainer:
             else:   # foreign dataset with only the next_batch contract
                 for _ in range(behind):
                     train.next_batch(feed_bs)
+        elif (behind < 0 and batch_count and start_epoch < epochs
+                and (max_steps is None or self._host_step < max_steps)):
+            # The stream is AHEAD of the trajectory: a prefetching fit
+            # exited early on this dataset object (producer overrun) and
+            # a shuffle cursor cannot rewind.  Serving shifted batches
+            # would silently break the bitwise-exact trajectory contract
+            # — fail loud; the canonical restart paths (--resume
+            # relaunch, supervisor attempt) load a fresh stream and
+            # never hit this.
+            raise RuntimeError(
+                f"data stream is {-behind} batch(es) ahead of the "
+                f"trajectory (an earlier prefetching fit on this dataset "
+                f"object exited early); reuse cannot be positionally "
+                f"exact — resume from a fresh data stream instead")
 
         ev = {"accuracy": float("nan")}
         if cfg.hang_timeout_s > 0:
@@ -834,10 +956,44 @@ class Trainer:
         fetch_backoff = Backoff(base_s=0.1, max_s=2.0,
                                 seed=cfg.seed + jax.process_index())
 
-        def fetch_batch():
+        def produce(step: int):
+            """fetch -> chaos poison -> sharded device_put for ``step`` —
+            THE data path, shared verbatim by the serial loop (booked as
+            "data" time) and the prefetcher's producer thread (overlapped
+            with dispatched steps; only consumer stalls book).  Keyed by
+            the global step so chaos faults and error propagation stay
+            step-aligned however far ahead the producer runs."""
+            def attempt():
+                if self._chaos is not None:
+                    self._chaos.maybe_loader_error(step)
+                return train.next_batch(feed_bs)
+            with tel.span("train/fetch"):
+                host_batch = retry_call(
+                    attempt, attempts=3, backoff=fetch_backoff,
+                    retry_on=(OSError,), what="train batch fetch",
+                    on_retry=lambda a, e: tel.counter(
+                        "data/fetch_retries_total").inc())
             if self._chaos is not None:
-                self._chaos.maybe_loader_error(self._host_step)
-            return train.next_batch(feed_bs)
+                host_batch = self._chaos.maybe_poison_batch(step, host_batch)
+            with tel.span("train/put"):
+                return put(mesh, host_batch)
+
+        # Async device prefetch (data/prefetch.py): the production budget
+        # is EXACTLY the number of steps this fit will consume (epoch
+        # budget minus the resumed offset, capped by max_steps), so a
+        # completed fit leaves the dataset cursor precisely where the
+        # serial path would have.
+        planned = 0
+        if batch_count:
+            for _e in range(start_epoch, epochs):
+                planned += batch_count - (skip_batches
+                                          if _e == start_epoch else 0)
+        if max_steps is not None:
+            planned = min(planned, max(max_steps - self._host_step, 0))
+        prefetcher = None
+        # Re-resolve the compiled-vs-jit dispatch on this fit's first
+        # step (a second fit may feed different shapes).
+        self._fit_step_call = None
 
         fit_completed = False
         # Goodput attribution (telemetry/goodput.py): every host-side
@@ -857,6 +1013,16 @@ class Trainer:
         _fit_span = tel.get_tracer().span("train/fit", epochs=epochs)
         _fit_span.__enter__()
         try:
+            if cfg.prefetch > 0 and planned > 0:
+                from dtf_tpu.data.prefetch import DevicePrefetcher
+                prefetcher = DevicePrefetcher(
+                    produce, start_step=self._host_step,
+                    num_batches=planned, depth=cfg.prefetch)
+            if cfg.aot_warmup and not self._compile_seen and planned > 0:
+                # Overlaps the producer's initial queue fill: the main
+                # thread compiles while the background thread stages the
+                # first batches onto the devices.
+                self._aot_warmup(splits.train, bs)
             hit_cap = False
             for epoch in range(start_epoch, epochs):
                 count = 0
@@ -870,30 +1036,35 @@ class Trainer:
                         # non-productive time, booked as such.
                         with tracker.measure("stall"):
                             self._chaos.maybe_step_faults(self._host_step)
-                    with tel.span("train/fetch"), tracker.measure("data"):
-                        host_batch = retry_call(
-                            fetch_batch, attempts=3, backoff=fetch_backoff,
-                            retry_on=(OSError,), what="train batch fetch",
-                            on_retry=lambda a, e: tel.counter(
-                                "data/fetch_retries_total").inc())
-                    if self._chaos is not None:
-                        host_batch = self._chaos.maybe_poison_batch(
-                            self._host_step, host_batch)
-                    with tel.span("train/put"), tracker.measure("data"):
-                        batch = put(mesh, host_batch)
+                    if prefetcher is not None:
+                        # Already device-resident; only a genuine wait on
+                        # an empty queue books as "data" (the
+                        # data/prefetch_stall span inside get()).
+                        batch = prefetcher.get(self._host_step)
+                    else:
+                        with tracker.measure("data"):
+                            batch = produce(self._host_step)
                     step_rng = jax.random.fold_in(rng_base, self._host_step)
-                    # The first dispatch pays trace+compile synchronously:
-                    # that wall time is "compile", not "productive".
-                    _cat = ("productive" if self._compile_seen
-                            else "compile")
+                    # Without AOT warmup the first dispatch pays
+                    # trace+compile synchronously: that wall time is
+                    # "compile", not "productive".  The category is
+                    # decided AFTER the call: _dispatch_step clears
+                    # _compile_seen when it abandons a rejected AOT
+                    # executable, and that retry pays the jit
+                    # trace+compile — booking it as productive would
+                    # inflate goodput by whole compile seconds.
+                    _pre_seen = self._compile_seen
                     _t_step = time.perf_counter()
-                    with tel.span("train/step"), tracker.measure(_cat):
-                        self.state, metrics = self.step_fn(self.state, batch,
-                                                           step_rng)
+                    with tel.span("train/step"):
+                        self.state, metrics = self._dispatch_step(batch,
+                                                                  step_rng)
+                    _dt_step = time.perf_counter() - _t_step
+                    tracker.add("productive"
+                                if _pre_seen and self._compile_seen
+                                else "compile", _dt_step)
                     if not self._compile_seen:
                         self._compile_seen = True
-                        tel.gauge("compile/first_step_s").set(
-                            time.perf_counter() - _t_step)
+                        tel.gauge("compile/first_step_s").set(_dt_step)
                     self.last_metrics = metrics
                     count += 1
                     self._host_step += 1
@@ -1049,6 +1220,20 @@ class Trainer:
                     ev = self.eval_fn(self.state, splits.test)
             fit_completed = True
         finally:
+            if prefetcher is not None:
+                overrun = prefetcher.close()
+                if overrun:
+                    # The producer ran ahead of an early exit (preemption,
+                    # crash): this dataset OBJECT's cursor sits `overrun`
+                    # batches past the trajectory, so reusing it in-place
+                    # cannot be positionally exact.  The canonical restart
+                    # paths (supervisor attempts, --resume relaunches)
+                    # load a fresh stream and fast-forward — exact.
+                    self.logger.print(
+                        f"[dtf_tpu] prefetch: {overrun} produced-but-"
+                        f"unconsumed batch(es) dropped on early exit; a "
+                        f"resume must use a fresh data stream (supervisor "
+                        f"attempts and --resume relaunches do)")
             _fit_span.__exit__(None, None, None)
             if health is not None:
                 # A COMPLETED fit (incl. agreed preemption) departs

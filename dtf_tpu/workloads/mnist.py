@@ -7,6 +7,10 @@ Reference: ``python tf_distributed.py --job_name=worker --task_index=k``
         [--job_name worker --task_index k --coordinator_address h:p
          --num_processes N]           # multi-host
         [--mode explicit]             # literal psum shard_map step
+        [--prefetch N]                # async device-prefetch depth
+                                      # (default 2; 0 = serial feed)
+        [--compile_cache DIR]         # persistent XLA compile cache:
+                                      # restarts reuse executables
 
 Same architecture/hyperparams (784-100-10 sigmoid/softmax, SGD lr 5e-4,
 batch 100, seed 1) and the same console log contract.

@@ -46,5 +46,58 @@ if [ "${NO_TELEMETRY_LANE:-0}" != "1" ]; then
     && grep -q "Top spans" "$tdir/report.log" \
     || { FAILS=$((FAILS + 1)); echo "FAILED: report missing sections"; }
 fi
+# Prefetch/compile-cache lane (DESIGN.md "Compilation discipline"):
+# the same chaos'd MNIST job serial (--prefetch 0) then overlapped
+# (--prefetch 2), both against one --compile_cache dir.  Asserts the
+# goodput "data" fraction strictly drops with prefetch, the prefetch
+# instruments landed, the second run hit the persistent compile cache,
+# and its "compile" bucket shrank.  Skip with NO_PREFETCH_LANE=1.
+if [ "${NO_PREFETCH_LANE:-0}" != "1" ]; then
+  echo "=== prefetch/compile-cache lane (overlap A/B + cache reuse) ==="
+  pdir=$(mktemp -d)
+  # Three runs against ONE cache dir: "cold" primes the persistent
+  # compile cache (and is the compile-shrink baseline); p0/p2 then run
+  # WARM so their walls are comparable for the data-fraction A/B.
+  for run in cold p0 p2; do
+    case "$run" in
+      cold) pf=2 ;;
+      p0)   pf=0 ;;
+      p2)   pf=2 ;;
+    esac
+    JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+        --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+        --logdir "$pdir/$run" --prefetch "$pf" \
+        --compile_cache "$pdir/xla_cache" \
+        --chaos "nan_grad@4,loader_error@7" > "$pdir/$run.log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: $run run (rc=$rc)"; tail -5 "$pdir/$run.log"; }
+    python -m dtf_tpu.telemetry.report "$pdir/$run" --check > /dev/null \
+      || { FAILS=$((FAILS + 1)); echo "FAILED: report --check ($run)"; }
+  done
+  python - "$pdir" <<'PYEOF'
+import json, sys, os
+d = sys.argv[1]
+def load(p):
+    doc = json.load(open(os.path.join(d, p, "telemetry.json")))
+    return doc["goodput"], doc.get("metrics", {})
+gc, mc = load("cold")
+g0, m0 = load("p0")
+g2, m2 = load("p2")
+f0, f2 = g0["data_s"] / g0["wall_s"], g2["data_s"] / g2["wall_s"]
+assert f2 < f0, f"data fraction did not drop: prefetch2 {f2:.4f} >= prefetch0 {f0:.4f}"
+assert "data/prefetch_depth" in m2, "data/prefetch_depth missing from the report payload"
+assert "data/prefetch_stall_s" in m2, "data/prefetch_stall_s missing from the report payload"
+assert m2.get("compile/cache_hit", {}).get("value", 0) >= 1, \
+    "warm run recorded no compile cache hits"
+assert g2["compile_s"] < gc["compile_s"], \
+    f"warm compile bucket did not shrink: {g2['compile_s']:.2f}s >= {gc['compile_s']:.2f}s (cold)"
+print(f"prefetch lane OK: data fraction {f0:.4f} -> {f2:.4f}; "
+      f"compile cold {gc['compile_s']:.2f}s -> warm {g2['compile_s']:.2f}s "
+      f"(cache hits {m2['compile/cache_hit']['value']:.0f})")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: prefetch lane assertions (rc=$rc)"; }
+  rm -rf "$pdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

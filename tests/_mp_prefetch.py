@@ -1,0 +1,45 @@
+"""Child for the 2-process prefetch A/B (tests/test_prefetch.py): run a
+short Trainer.fit with the given --prefetch depth over the per-host
+sharded data path (``Dataset.process_shard`` + ``put_process_batch``).
+The coordinator's metrics.csv carries the per-step cost rows; the parent
+asserts they are bitwise-identical between prefetch 0 and prefetch 2 —
+the exact-trajectory proof in the true multi-process configuration.
+
+Usage: _mp_prefetch.py <task> <coordinator> <prefetch> <logdir>
+"""
+
+import sys
+
+
+def main() -> int:
+    task, coord = int(sys.argv[1]), sys.argv[2]
+    prefetch, logdir = int(sys.argv[3]), sys.argv[4]
+    import jax
+    jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                               process_id=task)
+
+    from dtf_tpu import optim
+    from dtf_tpu.cluster import Cluster
+    from dtf_tpu.config import ClusterConfig, TrainConfig
+    from dtf_tpu.data import load_mnist
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.parallel.mesh import make_mesh
+    from dtf_tpu.train.trainer import Trainer
+
+    mesh = make_mesh("data=-1")
+    cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                      log_frequency=1, seed=1, logdir=logdir,
+                      prefetch=prefetch)
+    cluster = Cluster(config=ClusterConfig(task_index=task,
+                                           num_processes=2), mesh=mesh)
+    trainer = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                      optim.sgd(0.05), cfg)
+    res = trainer.fit(load_mnist(seed=1), epochs=1, max_steps=6)
+    trainer.logger.close()
+    print(f"MP_PREFETCH_DONE steps={res['steps']} "
+          f"final_cost={res['final_cost']!r}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,7 @@
 """Matmul benchmark tests on the simulated 8-device mesh (SURVEY.md §4)."""
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -209,9 +211,106 @@ class TestOutageAwareEntry:
                                "platforms that are instances of tpu are "
                                "present.")
 
-        rc, line = self._run_main(capsys, _init=unregistered_init)
+        # _preflight=None: this test targets the raise-mode classifier;
+        # the real subprocess probe would just burn a jax import here.
+        rc, line = self._run_main(capsys, _init=unregistered_init,
+                                  _preflight=None)
         assert rc == 1
         assert line["error"] == "tpu_unavailable"
+
+    def test_preflight_hang_fails_fast_as_tpu_unavailable(self, capsys,
+                                                          monkeypatch):
+        """A hung preflight probe must fail the run BEFORE init_backend
+        ever runs — the fast path that replaces burning the full 600s
+        outer timeout on a dead relay."""
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+
+        def never_init(timeout_s):
+            raise AssertionError("init_backend must not run after a hung "
+                                 "preflight")
+
+        rc, line = self._run_main(
+            capsys, _init=never_init,
+            _preflight=lambda t: (True, f"probe hung past {t:.0f}s"))
+        assert rc == 1
+        assert line["error"] == "tpu_unavailable"
+        assert line["detail"]["stage"] == "preflight"
+        assert "hung" in line["detail"]["reason"]
+
+    def test_preflight_skipped_on_cpu_only_run(self, capsys, monkeypatch):
+        """JAX_PLATFORMS=cpu cannot hit the relay's hang mode: the probe
+        must not run (no subprocess tax), and raise-mode errors keep
+        their existing backend_init classification."""
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+        def must_not_probe(t):
+            raise AssertionError("preflight must be skipped on cpu")
+
+        def dead_init(timeout_s):
+            raise RuntimeError("UNAVAILABLE: failed to connect")
+
+        rc, line = self._run_main(capsys, _init=dead_init,
+                                  _preflight=must_not_probe)
+        assert rc == 1
+        assert line["detail"]["stage"] == "backend_init"
+
+    def test_preflight_raise_mode_falls_through_to_classifier(
+            self, capsys, monkeypatch):
+        """A probe that exits with an ERROR (not a hang) is not preflight's
+        verdict: the real init re-raises it under the existing outage/
+        config classifiers."""
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+
+        def dead_init(timeout_s):
+            raise RuntimeError("UNAVAILABLE: relay refused")
+
+        rc, line = self._run_main(
+            capsys, _init=dead_init,
+            _preflight=lambda t: (False, ""))   # probe raised quickly
+        assert rc == 1
+        assert line["error"] == "tpu_unavailable"
+        assert line["detail"]["stage"] == "backend_init"
+
+    def test_preflight_disabled_by_env(self, capsys, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        monkeypatch.setenv("DTF_BENCH_PREFLIGHT_TIMEOUT_S", "0")
+
+        def must_not_probe(t):
+            raise AssertionError("preflight disabled by env")
+
+        def dead_init(timeout_s):
+            raise RuntimeError("UNAVAILABLE")
+
+        rc, line = self._run_main(capsys, _init=dead_init,
+                                  _preflight=must_not_probe)
+        assert line["detail"]["stage"] == "backend_init"
+
+    def test_bad_preflight_env_is_config_error(self, capsys, monkeypatch):
+        monkeypatch.setenv("DTF_BENCH_PREFLIGHT_TIMEOUT_S", "-3")
+        rc, line = self._run_main(capsys, _init=lambda t: ["cpu:0"])
+        assert rc == 1
+        assert line["error"] == "config_error"
+        assert "PREFLIGHT" in line["detail"]["reason"]
+
+    def test_preflight_probe_kills_hung_subprocess(self, monkeypatch):
+        """The real probe against a wedged child: verdict within the short
+        timeout, child killed, no zombie."""
+        import bench
+
+        monkeypatch.setattr(bench, "_PREFLIGHT_SRC",
+                            "import time\ntime.sleep(60)\n")
+        t0 = time.perf_counter()
+        hung, why = bench.preflight_probe(1.0)
+        assert hung is True
+        assert "hung" in why
+        assert time.perf_counter() - t0 < 30    # killed, not waited out
+
+    def test_preflight_probe_ok_on_healthy_backend(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_PREFLIGHT_SRC", "pass\n")
+        hung, why = bench.preflight_probe(60)
+        assert hung is False
 
     def test_deadline_abort_fires_in_subprocess(self):
         """The whole-run deadline (the os._exit path no in-process test can

@@ -92,7 +92,27 @@ class TestNativeLoader:
         ds = NativeDataset.from_idx(ip, lp, batch_size=16)
         with pytest.raises(ValueError, match="fixed batches"):
             ds.next_batch(32)
+        with pytest.raises(ValueError, match="fixed batches"):
+            ds.fast_forward(2, 32)
         ds.close()
+
+    def test_fast_forward_matches_drained_stream(self, idx_files):
+        """fast_forward(n) must leave the shuffle stream exactly where n
+        next_batch calls would (same C++ prefetch stream), while reusing
+        one scratch buffer pair instead of allocating per batch."""
+        ip, lp, *_ = idx_files
+        a = NativeDataset.from_idx(ip, lp, batch_size=16, seed=5)
+        b = NativeDataset.from_idx(ip, lp, batch_size=16, seed=5)
+        want = [a.next_batch(16) for _ in range(4)][3]
+        b.fast_forward(3, 16)
+        got = b.next_batch(16)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+        assert b.batches_consumed == 4
+        b.fast_forward(0, 16)                  # no-op, no validation crash
+        assert b.batches_consumed == 4
+        a.close()
+        b.close()
 
     def test_bad_path_returns_none(self):
         assert NativeDataset.from_idx("/nonexistent/a", "/nonexistent/b",

@@ -1,0 +1,400 @@
+"""Async device-prefetch input pipeline + AOT/persistent-compile tests.
+
+The contracts under test (data/prefetch.py, train/compile_cache.py,
+Trainer integration):
+
+* exact trajectory — the loss sequence is BITWISE-identical between
+  ``--prefetch 0`` (serial fetch->put->dispatch) and ``--prefetch 2``
+  (background producer), single-process and in the simulated
+  multi-process (ProcessShard) configuration;
+* producer errors surface on the main thread at the step that would have
+  consumed the failed batch, not earlier and not from the wrong thread;
+* chaos ``loader_error@S`` / ``nan_grad@S`` keep firing at step S no
+  matter how far ahead the producer runs;
+* shutdown drains cleanly on completion, preemption and crash (no thread
+  leaks), and resume after ``fast_forward`` stays aligned;
+* goodput books "data" time only when the consumer actually stalls;
+* the persistent compile cache gives a second process a
+  ``compile/cache_hit`` and a smaller "compile" bucket.
+"""
+
+import csv
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dtf_tpu import optim
+from dtf_tpu import telemetry as tel
+from dtf_tpu.cluster import Cluster
+from dtf_tpu.config import ClusterConfig, TrainConfig
+from dtf_tpu.data import load_mnist
+from dtf_tpu.data.datasets import Dataset, DataSplits
+from dtf_tpu.data.prefetch import DevicePrefetcher
+from dtf_tpu.models.mlp import MnistMLP
+from dtf_tpu.train.trainer import Trainer
+
+
+def _costs(logdir):
+    """Full-precision cost rows from metrics.csv, in write order."""
+    out = []
+    with open(os.path.join(logdir, "metrics.csv")) as f:
+        for rec in csv.reader(f):
+            if rec and rec[0] != "step" and rec[1] == "cost":
+                out.append((int(rec[0]), rec[2]))
+    return out
+
+
+def _fit(mesh8, logdir, *, prefetch, aot_warmup=True, chaos=None,
+         max_steps=8, splits=None, optimizer=None, **cfg_kw):
+    """One fresh-telemetry Trainer.fit on the 8-device mesh; returns
+    (result, trainer)."""
+    tel.reset()
+    cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                      log_frequency=1, seed=1, logdir=str(logdir),
+                      prefetch=prefetch, aot_warmup=aot_warmup,
+                      chaos=chaos, **cfg_kw)
+    trainer = Trainer(Cluster(config=ClusterConfig(), mesh=mesh8),
+                      MnistMLP(init_scale="fan_in"),
+                      optimizer or optim.adam(1e-3), cfg)
+    result = trainer.fit(splits if splits is not None else load_mnist(seed=1),
+                         epochs=1, max_steps=max_steps)
+    trainer.logger.close()
+    return result, trainer
+
+
+def _no_prefetch_threads():
+    return not [t for t in threading.enumerate()
+                if t.name == "dtf-device-prefetch" and t.is_alive()]
+
+
+class TestDevicePrefetcher:
+    """Unit tests against a plain produce(step) callable — no mesh."""
+
+    def test_order_and_values_match_serial(self):
+        pf = DevicePrefetcher(lambda s: np.full((2,), s), start_step=0,
+                              num_batches=20, depth=3)
+        got = [pf.get(s)[0] for s in range(20)]
+        assert got == list(range(20))
+        assert pf.close() == 0                 # completed: no overrun
+
+    def test_error_surfaces_at_consuming_step(self):
+        def produce(step):
+            if step == 5:
+                raise ValueError("boom at 5")
+            return step
+        pf = DevicePrefetcher(produce, start_step=0, num_batches=10, depth=4)
+        assert [pf.get(s) for s in range(5)] == [0, 1, 2, 3, 4]
+        with pytest.raises(ValueError, match="boom at 5"):
+            pf.get(5)
+        assert pf.delivered == 5
+        pf.close()
+
+    def test_out_of_order_consumption_rejected(self):
+        pf = DevicePrefetcher(lambda s: s, start_step=3, num_batches=5)
+        with pytest.raises(RuntimeError, match="out of order"):
+            pf.get(4)
+        assert pf.get(3) == 3
+        pf.close()
+
+    def test_production_is_depth_bounded(self):
+        produced = []
+        pf = DevicePrefetcher(lambda s: produced.append(s) or s,
+                              start_step=0, num_batches=100, depth=2)
+        deadline = time.time() + 5.0
+        while len(produced) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)                        # would run away if unbounded
+        # depth items queued + one completed-but-blocked put in flight
+        assert len(produced) <= 3
+        assert pf.close() >= 2                 # those batches ARE consumed
+        assert _no_prefetch_threads()
+
+    def test_close_mid_stream_joins_and_reports_overrun(self):
+        pf = DevicePrefetcher(lambda s: s, start_step=0, num_batches=50,
+                              depth=2)
+        assert pf.get(0) == 0
+        overrun = pf.close()
+        assert 0 <= overrun <= 3
+        assert _no_prefetch_threads()
+        assert pf.close() == overrun           # idempotent
+
+    def test_stall_books_data_time_slow_producer(self):
+        tel.reset()
+        tracker = tel.get_tracker()
+        pf = DevicePrefetcher(lambda s: time.sleep(0.05) or s,
+                              start_step=0, num_batches=4, depth=2)
+        for s in range(4):
+            assert pf.get(s) == s
+        pf.close()
+        # the consumer outpaced the producer: real stalls were booked
+        assert tracker.buckets["data"] > 0.03
+        assert tel.gauge("data/prefetch_stall_s").value > 0.03
+
+    def test_no_stall_books_nothing_fast_producer(self):
+        tel.reset()
+        tracker = tel.get_tracker()
+        pf = DevicePrefetcher(lambda s: s, start_step=0, num_batches=4,
+                              depth=4)
+        time.sleep(0.3)                        # queue fills while we "compute"
+        for s in range(4):
+            pf.get(s)
+        pf.close()
+        # fully overlapped: the instrument exists but reads (near) zero
+        assert tracker.buckets["data"] < 0.05
+        assert tel.gauge("data/prefetch_stall_s").value is not None
+
+    def test_process_shard_streams_reassemble_under_prefetch(self):
+        """Simulated multi-process: each host's prefetched ProcessShard
+        stream must reassemble into exactly the serial global batches —
+        the multi-host feed contract survives the producer thread."""
+        def mk():
+            n = 64
+            imgs = np.arange(n, dtype=np.float32)[:, None]
+            labels = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+            return Dataset(imgs, labels, seed=3)
+
+        serial = mk()
+        views = [mk().process_shard(k, 2) for k in range(2)]
+        pfs = [DevicePrefetcher(lambda s, v=v: v.next_batch(8),
+                                start_step=0, num_batches=10, depth=2)
+               for v in views]
+        for step in range(10):                 # crosses an epoch reshuffle
+            gx, gy = serial.next_batch(16)
+            parts = [pf.get(step) for pf in pfs]
+            np.testing.assert_array_equal(
+                np.concatenate([p[0] for p in parts]), gx)
+            np.testing.assert_array_equal(
+                np.concatenate([p[1] for p in parts]), gy)
+        for pf in pfs:
+            assert pf.close() == 0
+
+
+class TestTrainerTrajectory:
+    def test_loss_sequence_bitwise_identical(self, mesh8, tmp_path):
+        """THE acceptance proof (single-process): serial path (prefetch 0,
+        no AOT — the exact pre-change loop) vs the full new path
+        (prefetch 2 + AOT-compiled step) produce bitwise-identical cost
+        rows; overlap shows up as a strictly smaller "data" bucket."""
+        _fit(mesh8, tmp_path / "p0", prefetch=0, aot_warmup=False)
+        d0 = json.load(open(tmp_path / "p0" / "telemetry.json"))
+        _fit(mesh8, tmp_path / "p2", prefetch=2, aot_warmup=True)
+        d2 = json.load(open(tmp_path / "p2" / "telemetry.json"))
+        c0, c2 = _costs(tmp_path / "p0"), _costs(tmp_path / "p2")
+        assert len(c0) == 8
+        assert c0 == c2
+        # overlap is measurable: data time off the hot path
+        assert d2["goodput"]["data_s"] < d0["goodput"]["data_s"]
+        # the new instruments landed
+        assert "data/prefetch_depth" in d2["metrics"]
+        assert "data/prefetch_stall_s" in d2["metrics"]
+        assert d2["metrics"]["compile/aot_s"]["value"] > 0
+        assert _no_prefetch_threads()
+
+    def test_chaos_fires_at_the_consumed_step(self, mesh8, tmp_path):
+        """nan_grad@3 + loader_error@2 with the producer running ahead:
+        the NaN lands exactly in the step-4 cost row (the update computed
+        from batch 3), the loader error is retried on the producer
+        thread, and the whole chaos'd trajectory stays bitwise-identical
+        to the serial chaos'd run."""
+        chaos = "nan_grad@3,loader_error@2"
+        r0, _ = _fit(mesh8, tmp_path / "p0", prefetch=0, aot_warmup=False,
+                     chaos=chaos, max_steps=6)
+        c0 = _costs(tmp_path / "p0")
+        r2, _ = _fit(mesh8, tmp_path / "p2", prefetch=2, chaos=chaos,
+                     max_steps=6)
+        c2 = _costs(tmp_path / "p2")
+        d2 = json.load(open(tmp_path / "p2" / "telemetry.json"))
+        assert c0 == c2
+        assert r0["skipped_steps"] == r2["skipped_steps"] == 1
+        nan_steps = [s for s, v in c2 if v == "nan"]
+        assert nan_steps == [4]
+        assert d2["metrics"]["data/fetch_retries_total"]["value"] == 1
+        assert d2["metrics"]["chaos/faults_fired_total"]["value"] == 2
+
+    def test_resume_after_fast_forward_stays_aligned(self, mesh8, tmp_path):
+        """checkpoint at 3 -> fresh trainer + fresh dataset resumes with
+        prefetch 2 -> the continued trajectory equals one uninterrupted
+        serial run, bitwise."""
+        _fit(mesh8, tmp_path / "ab", prefetch=2, max_steps=6,
+             checkpoint_every=3)
+        _fit(mesh8, tmp_path / "ab", prefetch=2, max_steps=12,
+             checkpoint_every=3, resume=True)
+        _fit(mesh8, tmp_path / "ref", prefetch=0, aot_warmup=False,
+             max_steps=12)
+        resumed = _costs(tmp_path / "ab")
+        ref = _costs(tmp_path / "ref")
+        # the resumed file holds both attempts; compare by step number
+        by_step = {s: v for s, v in resumed}          # latest attempt wins
+        assert {s: v for s, v in ref} == by_step
+
+    def test_producer_error_propagates_at_failing_step(self, mesh8,
+                                                       tmp_path):
+        """A persistently-failing fetch (retry budget exhausted on the
+        producer thread) must raise on the MAIN thread when the loop
+        reaches the failing step — after cleanly consuming every earlier
+        batch."""
+        from dtf_tpu.utils.retry import RetryExhausted
+
+        class FailsFrom:
+            """next_batch contract; batch index >= k always raises."""
+
+            def __init__(self, base, k):
+                self.base, self.k, self.batches_consumed = base, k, 0
+
+            @property
+            def num_examples(self):
+                return self.base.num_examples
+
+            def next_batch(self, bs):
+                if self.batches_consumed >= self.k:
+                    raise OSError("disk on fire")
+                self.batches_consumed += 1
+                return self.base.next_batch(bs)
+
+        base = load_mnist(seed=1).train
+        splits = DataSplits(train=FailsFrom(base, 3), test=None)
+        tel.reset()
+        cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                          log_frequency=1, seed=1,
+                          logdir=str(tmp_path), prefetch=2)
+        trainer = Trainer(Cluster(config=ClusterConfig(), mesh=mesh8),
+                          MnistMLP(init_scale="fan_in"), optim.adam(1e-3),
+                          cfg)
+        with pytest.raises(RetryExhausted):
+            trainer.fit(splits, epochs=1, max_steps=8)
+        trainer.logger.close()
+        assert trainer._host_step == 3         # steps 0..2 consumed cleanly
+        assert _no_prefetch_threads()
+
+    def test_preemption_drains_producer_cleanly(self, mesh8, tmp_path):
+        """chaos sigterm mid-epoch: the fit returns preempted=True and the
+        producer thread is joined (no leak); if the producer over-ran the
+        break point, re-fitting the SAME dataset object fails loud
+        instead of silently serving shifted batches."""
+        splits = load_mnist(seed=1)
+        res, trainer = _fit(mesh8, tmp_path, prefetch=2, max_steps=50,
+                            chaos="sigterm@3", checkpoint_every=100,
+                            splits=splits)
+        assert res["preempted"] is True
+        assert _no_prefetch_threads()
+        overrun = splits.train.batches_consumed - trainer._host_step
+        assert overrun >= 0
+        if overrun:                    # producer timing-dependent
+            with pytest.raises(RuntimeError, match="ahead of the"):
+                trainer.fit(splits, epochs=1, max_steps=50)
+            trainer.logger.close()
+
+    def test_aot_skipped_without_shape_probe(self, mesh8, tmp_path):
+        """CallableDataset has no ``examples`` accessor: AOT warmup must
+        fall back silently to compile-on-first-dispatch and still train
+        (through the prefetcher)."""
+        from dtf_tpu.data.datasets import CallableDataset
+
+        rng = np.random.default_rng(0)
+        x = rng.random((64, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[np.arange(64) % 10]
+        train = CallableDataset(lambda i: (x, y), 64, 10)
+        res, trainer = _fit(mesh8, tmp_path, prefetch=2, max_steps=3,
+                            splits=DataSplits(train=train, test=None))
+        assert res["steps"] == 3
+        assert trainer._compiled_step is None
+        assert trainer._compile_seen is True
+
+
+class TestCompileCache:
+    _CHILD = """\
+import sys
+import jax
+from dtf_tpu import optim
+from dtf_tpu.cluster import Cluster
+from dtf_tpu.config import ClusterConfig, TrainConfig
+from dtf_tpu.data import load_mnist
+from dtf_tpu.models.mlp import MnistMLP
+from dtf_tpu.parallel.mesh import make_mesh
+from dtf_tpu.train.trainer import Trainer
+
+cache, logdir = sys.argv[1], sys.argv[2]
+cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                  log_frequency=2, seed=1, logdir=logdir,
+                  compile_cache=cache)
+mesh = make_mesh("data=-1")
+t = Trainer(Cluster(config=ClusterConfig(), mesh=mesh),
+            MnistMLP(init_scale="fan_in"), optim.adam(1e-3), cfg)
+t.fit(load_mnist(seed=1), epochs=1, max_steps=3)
+t.logger.close()
+"""
+
+    def test_second_process_hits_cache_and_compiles_less(self, tmp_path):
+        """THE acceptance proof for compile reuse: two processes pointed
+        at the same --compile_cache dir; the second records
+        compile/cache_hit >= 1 and a smaller "compile" goodput bucket."""
+        import subprocess
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cache = str(tmp_path / "xla_cache")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": root + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        docs = []
+        for run in ("cold", "warm"):
+            logdir = str(tmp_path / run)
+            p = subprocess.run(
+                [sys.executable, "-c", self._CHILD, cache, logdir],
+                capture_output=True, text=True, timeout=240, env=env,
+                cwd=root)
+            assert p.returncode == 0, p.stdout + p.stderr
+            docs.append(json.load(open(os.path.join(logdir,
+                                                    "telemetry.json"))))
+        cold, warm = docs
+        assert cold["metrics"].get("compile/cache_miss",
+                                   {}).get("value", 0) >= 1
+        assert warm["metrics"].get("compile/cache_hit",
+                                   {}).get("value", 0) >= 1
+        assert (warm["goodput"]["compile_s"]
+                < cold["goodput"]["compile_s"])
+
+    def test_enable_is_idempotent_and_feature_gated(self, tmp_path):
+        import jax
+
+        from dtf_tpu.train import compile_cache
+        old_dir = jax.config.jax_compilation_cache_dir
+        old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            d = str(tmp_path / "cc")
+            assert compile_cache.enable(d) == os.path.abspath(d)
+            assert compile_cache.enable(d) == os.path.abspath(d)
+            assert jax.config.jax_compilation_cache_dir == os.path.abspath(d)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              old_min)
+
+
+@pytest.mark.slow
+class TestMultiProcessPrefetch:
+    def test_two_process_trajectory_identical(self, tmp_path):
+        """True 2-process run (per-host sharded feed): the coordinator's
+        cost rows are bitwise-identical between prefetch 0 and 2."""
+        import sys
+
+        from tests.test_multiprocess import REPO_ROOT, free_port, run_workers
+        script = os.path.join(REPO_ROOT, "tests", "_mp_prefetch.py")
+        rows = {}
+        for depth in (0, 2):
+            port = free_port()
+            logdir = str(tmp_path / f"pf{depth}")
+            outs = run_workers(
+                [[sys.executable, script, str(task), f"localhost:{port}",
+                  str(depth), logdir] for task in range(2)],
+                n_local_devices=4, timeout=300)
+            assert all("MP_PREFETCH_DONE" in o for o in outs)
+            # SPMD: both tasks report the identical final cost
+            finals = {o.split("final_cost=")[1].splitlines()[0]
+                      for o in outs}
+            assert len(finals) == 1
+            rows[depth] = _costs(logdir)
+        assert rows[0] and rows[0] == rows[2]
