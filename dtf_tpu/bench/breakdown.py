@@ -295,6 +295,141 @@ def attn_sweep(family: str = "bert", batch: Optional[int] = None,
     return rows
 
 
+def grad_sync_ab(steps: int = 8, batch: int = 512,
+                 bucket_mb: float = 0.1) -> dict:
+    """Dense vs zero1 vs zero1_overlap A/B on the MNIST MLP workload shapes
+    (ISSUE 5 acceptance): per-strategy full-step time, the ISOLATED
+    gradient-sync+update time (its own jitted shard_map program, timed
+    under the ``comm/grad_sync`` span and exported as ``comm/grad_sync_s``),
+    measured per-device optimizer-state bytes, per-device wire bytes, and
+    — where the backend reports memory_stats (TPU; CPU returns null) —
+    LIVE bytes in use right after state allocation (each strategy runs in
+    its own scope so the reading is per-strategy, not a process-lifetime
+    peak).  Returns the JSON-ready comparison dict."""
+    import time
+
+    import numpy as np
+
+    from dtf_tpu import optim
+    from dtf_tpu import telemetry as tel
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.parallel.collectives import shard_map_fn
+    from dtf_tpu.parallel.grad_sync import (GradSyncEngine, STRATEGIES,
+                                            opt_state_bytes_per_device)
+    from dtf_tpu.parallel.mesh import local_mesh
+    from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                       put_global_batch)
+    from dtf_tpu.utils.timing import block
+    from jax.sharding import PartitionSpec as P
+
+    mesh = local_mesh("data=-1")
+    model = MnistMLP(init_scale="fan_in")
+    opt = optim.adam(1e-3)
+    rng = np.random.default_rng(0)
+    host_batch = (rng.random((batch, 784)).astype(np.float32),
+                  np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+
+    def make_sync_only(eng):
+        """The sync+update REGION as its own program, so the A/B can time
+        it free of forward/backward noise."""
+        if eng is None:
+            def f(grads, opt_state, params):
+                g = jax.tree_util.tree_map(
+                    lambda v: lax.pmean(v, "data"), grads)
+                updates, new_opt = opt.update(g, opt_state, params)
+                return optim.apply_updates(params, updates), new_opt
+            spec = P()
+        else:
+            def f(grads, opt_state, params):
+                return eng.sync_and_update(grads, opt_state, params)
+            spec = eng.opt_state_spec
+        return jax.jit(shard_map_fn(
+            f, mesh=mesh, in_specs=(P(), spec, P()),
+            out_specs=(P(), spec)))
+
+    out = {"workload": "mnist_mlp_784_100_10", "backend": jax.default_backend(),
+           "data_axis": int(mesh.shape["data"]), "global_batch": batch,
+           "steps_timed": steps, "bucket_mb": bucket_mb, "strategies": {}}
+    if out["data_axis"] == 1:
+        # A 1-device mesh degenerates every strategy to the same math:
+        # zero1's "shard" is the whole vector plus padding, so the state
+        # bytes come out slightly ABOVE dense — the opposite of the
+        # (N-1)/N comparison this A/B exists to show.  Emit the JSON
+        # (step-time rows are still valid) but flag it loudly.
+        import sys as _sys
+        out["warning"] = ("data axis is 1 — the zero1 memory comparison "
+                          "is degenerate; run on a multi-device mesh "
+                          "(e.g. --simulated_devices 8 on CPU)")
+        print(f"# WARNING: {out['warning']}", file=_sys.stderr)
+    def run_strategy(strat):
+        """One strategy, in its own scope: the previous strategy's device
+        arrays are refcount-freed before this one allocates, so the LIVE
+        bytes_in_use reading below reflects THIS strategy's footprint
+        (the process-lifetime peak_bytes_in_use is monotone across
+        strategies sharing the process and could never show zero1's
+        savings)."""
+        eng = None
+        accum = 1
+        if strat != "dense":
+            eng = GradSyncEngine(strat, opt, mesh,
+                                 bucket_mb=bucket_mb).prepare(
+                jax.eval_shape(model.init, jax.random.key(1)))
+            if strat == "zero1_overlap":
+                accum = 2      # the overlap schedule needs microbatches
+        state = init_state(model, opt, seed=1, mesh=mesh, grad_sync=eng)
+        hbm_after_init = (jax.local_devices()[0].memory_stats()
+                          or {}).get("bytes_in_use")
+        step = make_train_step(model.loss, opt, mesh, mode="explicit",
+                               donate=False, grad_sync=eng,
+                               grad_accum=accum)
+        b = put_global_batch(mesh, host_batch)
+        state, _ = step(state, b, jax.random.key(0))      # compile
+        block(state)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, _ = step(state, b, jax.random.key(i + 1))
+        block(state)
+        step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+        # isolated sync+update: same replicated grads tree per strategy
+        grads = jax.tree_util.tree_map(
+            lambda p: (p * 1e-3).astype(jnp.float32), state["params"])
+        sync_fn = make_sync_only(eng)
+        p2, o2 = sync_fn(grads, state["opt_state"], state["params"])
+        block(p2)
+        with tel.span("comm/grad_sync", strategy=strat):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p2, o2 = sync_fn(grads, o2, p2)
+            block(p2)
+            sync_s = (time.perf_counter() - t0) / steps
+        tel.gauge("comm/grad_sync_s").set(sync_s)
+
+        stats = (eng.comm_stats(accum) if eng is not None else
+                 {"grad_sync_bytes": float(sum(
+                     np.prod(l.shape) * np.dtype(l.dtype).itemsize
+                     for l in jax.tree_util.tree_leaves(state["params"]))),
+                  "bucket_count": 0.0})
+        return {
+            "step_ms": round(step_ms, 4),
+            "grad_sync_ms": round(sync_s * 1e3, 4),
+            "grad_accum": accum,
+            "opt_state_bytes_per_device":
+                opt_state_bytes_per_device(state["opt_state"]),
+            "comm_bytes_per_step": stats["grad_sync_bytes"],
+            "bucket_count": int(stats["bucket_count"]),
+            "hbm_bytes_in_use_after_init": hbm_after_init,
+        }
+
+    for strat in STRATEGIES:
+        out["strategies"][strat] = run_strategy(strat)
+    d = out["strategies"]
+    out["opt_state_drop_ratio"] = round(
+        1.0 - (d["zero1"]["opt_state_bytes_per_device"]
+               / max(d["dense"]["opt_state_bytes_per_device"], 1.0)), 4)
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--family", choices=["bert", "gpt"], default="bert")
@@ -307,6 +442,20 @@ def main(argv=None) -> int:
                         help="attention block-size sweep + Dh shape "
                              "ablation instead of the layer breakdown "
                              "(the r4 MFU close-or-retire evidence)")
+    parser.add_argument("--grad_sync_ab", action="store_true",
+                        help="dense vs zero1 vs zero1_overlap A/B "
+                             "(parallel/grad_sync.py): JSON with per-"
+                             "strategy step time, isolated sync+update "
+                             "time, per-device optimizer-state bytes and "
+                             "wire bytes")
+    parser.add_argument("--ab_steps", type=int, default=8,
+                        help="timed steps per strategy in --grad_sync_ab")
+    parser.add_argument("--ab_batch", type=int, default=512,
+                        help="global batch in --grad_sync_ab")
+    parser.add_argument("--simulated_devices", type=int, default=0,
+                        help="run on N simulated CPU devices (the "
+                             "grad_sync A/B needs a multi-way data axis "
+                             "to show the zero1 memory drop)")
     parser.add_argument("--compile_cache", default=None, metavar="DIR",
                         help="persistent XLA compile cache: every ladder "
                              "point is its own 20-40s compile at these "
@@ -315,9 +464,27 @@ def main(argv=None) -> int:
     ns = parser.parse_args(argv)
     if ns.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if ns.simulated_devices > 0:
+        # Same mechanics as ClusterConfig.simulated_devices: must land
+        # before the first device query; older jax falls back to the
+        # XLA_FLAGS route (both are read at backend init).
+        import os
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", ns.simulated_devices)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{ns.simulated_devices}").strip()
     if ns.compile_cache:
         from dtf_tpu.train.compile_cache import enable
         enable(ns.compile_cache)
+    if ns.grad_sync_ab:
+        import json
+        print(json.dumps(grad_sync_ab(steps=ns.ab_steps, batch=ns.ab_batch),
+                         indent=1, sort_keys=True))
+        return 0
     peak = peak_flops_per_chip()
     if ns.attn_sweep:
         rows = attn_sweep(ns.family, ns.batch, ns.seq)

@@ -97,6 +97,37 @@ class Cluster:
         return monitor
 
 
+# --xla_overlap: the latency-hiding-scheduler preset.  These are libtpu
+# flags, so they ride LIBTPU_INIT_ARGS (read once when libtpu loads):
+# inert on CPU/simulated runs, and PREPENDED — an operator's own
+# LIBTPU_INIT_ARGS stays last and wins on conflicts (libtpu takes the
+# LAST value), so e.g. an explicit ...latency_hiding_scheduler=false
+# survives --xla_overlap.
+# What it buys: the scheduler reorders async collective start/done pairs
+# so zero1's bucket reduce-scatters and the param all-gather overlap the
+# backward's compute instead of serializing after it (DESIGN.md §4.1).
+_XLA_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def apply_xla_overlap_preset() -> str:
+    """Append the overlap preset to LIBTPU_INIT_ARGS (idempotent).  Must
+    run BEFORE the first device query — bootstrap does; calling it after a
+    TPU backend initialized leaves the env set for child processes but
+    cannot affect the live backend."""
+    current = os.environ.get("LIBTPU_INIT_ARGS", "")
+    missing = [f for f in _XLA_OVERLAP_FLAGS if f not in current]
+    if missing:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            filter(None, [*missing, current]))
+        log.info("xla_overlap: LIBTPU_INIT_ARGS = preset + %r", current)
+    return os.environ["LIBTPU_INIT_ARGS"]
+
+
 def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
     """Initialize the process and build the global mesh.
 
@@ -113,6 +144,8 @@ def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
     global _INITIALIZED
     config = config or ClusterConfig()
 
+    if config.xla_overlap:
+        apply_xla_overlap_preset()
     if config.platform:
         # Env vars are too late if jax was already imported (this image's
         # sitecustomize does); config.update is the reliable path.

@@ -70,6 +70,14 @@ class ClusterConfig:
     # count (a relaunch on fewer surviving hosts), shrink the data axis to
     # fit instead of failing (parallel/mesh.shrink_to_devices).
     elastic: bool = False
+    # XLA latency-hiding-scheduler preset (TPU): lets the compiler slide
+    # async collectives (zero1's bucket reduce-scatters, the param
+    # all-gather) under compute instead of serializing them at the end of
+    # the backward.  Applied by cluster.bootstrap via LIBTPU_INIT_ARGS
+    # BEFORE backend init, so it is inert on CPU/simulated runs (libtpu
+    # never loads) and a no-op once a backend exists.  Pair with
+    # --grad_sync zero1_overlap (DESIGN.md §4.1).
+    xla_overlap: bool = False
 
     def __post_init__(self):
         if self.job_name not in ("ps", "worker"):
@@ -138,6 +146,30 @@ class TrainConfig:
     # microbatches inside the compiled step (same trajectory, less
     # activation memory).
     grad_accum: int = 1
+    # Gradient-sync + weight-update strategy (parallel/grad_sync.py):
+    # "dense" = pmean the full gradient tree and run a fully replicated
+    # optimizer update (the default and correctness oracle); "zero1" =
+    # ZeRO-1 weight-update sharding — bucketed reduce-scatter of the
+    # gradients, per-shard optimizer update against SHARDED optimizer
+    # state (Adam moments cost 1/N per device on an N-way data axis),
+    # all-gather of the updated params; "zero1_overlap" = zero1 scheduled
+    # inside the grad-accumulation skeleton so each microbatch's bucket
+    # reduce-scatter overlaps the next microbatch's backward (pair with
+    # --grad_accum > 1 and, on TPU, --xla_overlap).  zero1* strategies
+    # run the explicit shard_map step (implicit mode auto-switches) and
+    # need an elementwise optimizer (sgd/momentum/adam/adamw).
+    grad_sync: str = "dense"
+    # Reduced-precision collective wire format for gradient sync
+    # (EQuARX-motivated, PAPERS.md): "bf16" ships (g/N).astype(bf16) —
+    # mean-preserving pre-scaling, one rounding per value — through the
+    # reduce-scatter/pmean; None/"f32" keeps the exact f32 wire.
+    # Composes with every --grad_sync strategy; requires the explicit
+    # step (shard_map owns the collectives).
+    grad_comm_dtype: Optional[str] = None
+    # zero1 bucket size (MB of f32 gradient per flattened bucket): smaller
+    # buckets pipeline the reduce-scatter earlier under zero1_overlap,
+    # larger buckets amortize per-collective latency.
+    grad_bucket_mb: float = 4.0
     # Multi-process data path: each host feeds only ITS contiguous slice of
     # every global batch (Dataset.process_shard + put_process_batch —
     # bitwise-identical trajectory to the global-batch path).  Disable to
@@ -249,6 +281,21 @@ class TrainConfig:
             raise ValueError(
                 f"--prefetch is a queue depth (0 disables the async input "
                 f"pipeline); got {self.prefetch}")
+        # Literal mirror of parallel.grad_sync.STRATEGIES — config must
+        # stay importable without jax (a pinned test keeps them in sync).
+        if self.grad_sync not in ("dense", "zero1", "zero1_overlap"):
+            raise ValueError(
+                f"--grad_sync must be one of "
+                f"('dense', 'zero1', 'zero1_overlap'), got "
+                f"{self.grad_sync!r}")
+        if self.grad_comm_dtype not in (None, "bf16", "bfloat16", "f32",
+                                        "float32"):
+            raise ValueError(
+                f"--grad_comm_dtype must be 'bf16' or 'f32', got "
+                f"{self.grad_comm_dtype!r}")
+        if self.grad_bucket_mb <= 0:
+            raise ValueError(
+                f"--grad_bucket_mb must be > 0, got {self.grad_bucket_mb}")
 
 
 def _field_type(cls, f: dataclasses.Field) -> type:

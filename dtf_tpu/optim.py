@@ -29,6 +29,15 @@ import jax.numpy as jnp
 class Optimizer(NamedTuple):
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any], tuple]   # (grads, state, params) -> (updates, state)
+    # True when the update rule is purely ELEMENTWISE over (grad, state,
+    # param) entries — no per-tensor norms, factored moments, or other
+    # cross-element structure.  Elementwise rules commute with any
+    # partitioning of the flattened parameter vector, which is exactly the
+    # property ZeRO-1 weight-update sharding (parallel/grad_sync.py) needs
+    # to run the update on disjoint shards: update(shard) == update(full)
+    # restricted to the shard.  adafactor (row/col means) and lamb
+    # (per-tensor trust ratios) are NOT elementwise and keep the default.
+    elementwise: bool = False
 
 
 class _Pair:
@@ -61,7 +70,7 @@ def sgd(lr: "float | Callable") -> Optimizer:
             lr_t = lr
         return jax.tree_util.tree_map(lambda g: -lr_t * g, grads), state
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, elementwise=True)
 
 
 def momentum(lr: "float | Callable", beta: float = 0.9,
@@ -85,7 +94,7 @@ def momentum(lr: "float | Callable", beta: float = 0.9,
             upd = jax.tree_util.tree_map(lambda m_: -lr_t * m_, m)
         return upd, {"m": m, **extra}
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, elementwise=True)
 
 
 def adam(lr: "float | Callable[[jax.Array], jax.Array]", b1: float = 0.9,
@@ -123,7 +132,7 @@ def adam(lr: "float | Callable[[jax.Array], jax.Array]", b1: float = 0.9,
             updates = jax.tree_util.tree_map(upd, m, v, params)
         return updates, {"m": m, "v": v, "step": step}
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, elementwise=True)
 
 
 def adamw(lr, weight_decay: float = 0.01, **kw) -> Optimizer:
@@ -231,18 +240,58 @@ def lamb(lr: "float | Callable", b1: float = 0.9, b2: float = 0.999,
     return Optimizer(inner.init, update)
 
 
-def clip_by_global_norm(opt: Optimizer, max_norm: float) -> Optimizer:
-    """Wrap an optimizer with global-norm gradient clipping."""
+def clip_by_global_norm(opt: Optimizer, max_norm: float, *,
+                        axis: "str | None" = None) -> Optimizer:
+    """Wrap an optimizer with global-norm gradient clipping.
+
+    ``axis=None`` (the default) assumes every device holds the FULL
+    gradient tree (implicit mode, or explicit mode after the pmean), so
+    the local sum of squares already IS the global one.  Under ZeRO-1
+    weight-update sharding each device holds a disjoint 1/N shard of the
+    reduced gradients — a local norm there would clip each shard by its
+    own magnitude and the trajectory would silently diverge from dense.
+    ``axis="data"`` is the partition-aware variant: local squared sums are
+    ``psum``'d over the mesh axis before the sqrt, so the clip scale is
+    the true global norm on every shard (grad_sync rebuilds its wrapped
+    optimizer with this automatically; see GradSyncEngine).
+    """
 
     def update(grads, state, params=None):
         leaves = jax.tree_util.tree_leaves(grads)
-        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                            for g in leaves))
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        if axis is not None:
+            from jax import lax
+            sq = lax.psum(sq, axis)
+        norm = jnp.sqrt(sq)
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
         grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
         return opt.update(grads, state, params)
 
-    return Optimizer(opt.init, update)
+    # Introspection hooks for grad_sync: the engine must re-derive this
+    # wrapper with the data axis when the optimizer runs on shards.
+    update._clip_inner = opt
+    update._clip_max_norm = max_norm
+    update._clip_axis = axis
+    return Optimizer(opt.init, update, elementwise=opt.elementwise)
+
+
+def init_partitioned(opt: Optimizer, params: Any, out_shardings: Any) -> Any:
+    """Partition-aware ``Optimizer.init``: materialize the optimizer state
+    with explicit per-leaf shardings instead of inheriting the params'
+    (usually replicated) placement.
+
+    This is the ZeRO-1 memory lever (cf. PAPERS.md, "Automatic
+    Cross-Replica Sharding of Weight Update"): Adam moments for ``params``
+    sharded over an N-way data axis cost 1/N the replicated HBM, because
+    the state is BORN sharded — there is never a replicated copy to shard
+    after the fact.  ``out_shardings`` is a sharding (or pytree of
+    shardings, prefix-broadcast like ``jax.jit``'s) for the state that
+    ``opt.init(params)`` returns; GSPMD materializes each leaf directly
+    into its shards.  States with no array leaves (plain SGD's ``()``)
+    return as-is."""
+    if not jax.tree_util.tree_leaves(jax.eval_shape(opt.init, params)):
+        return opt.init(params)
+    return jax.jit(opt.init, out_shardings=out_shardings)(params)
 
 
 #: Single source of the optimizer-name registry (the --optimizer CLI flag

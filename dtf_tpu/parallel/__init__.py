@@ -16,10 +16,12 @@ from dtf_tpu.parallel.sharding import (
     named_sharding, replicate, shard_batch, batch_spec, logical_to_spec,
     apply_rules,
 )
+from dtf_tpu.parallel.grad_sync import GradSyncEngine, STRATEGIES
 
 __all__ = [
     "AXES", "DATA", "FSDP", "TENSOR", "SEQ", "EXPERT", "PIPE",
     "MeshSpec", "make_mesh", "local_mesh",
     "named_sharding", "replicate", "shard_batch", "batch_spec",
     "logical_to_spec", "apply_rules",
+    "GradSyncEngine", "STRATEGIES",
 ]

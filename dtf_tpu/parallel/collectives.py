@@ -38,12 +38,34 @@ def all_reduce_sum(tree: Any, axis: "str | Sequence[str]") -> Any:
 
 
 def all_gather(x: jax.Array, axis: str, *, tiled_axis: int = 0) -> jax.Array:
-    """Gather shards along a mesh axis, concatenating on ``tiled_axis``."""
+    """Gather shards along a mesh axis, concatenating on ``tiled_axis``.
+
+    ``tiled=True`` semantics (pinned by tests/test_mesh.py): the output's
+    ``tiled_axis`` dim is ``axis_size * x.shape[tiled_axis]``, shards
+    concatenated in mesh-axis-index order — rank k's block sits at
+    ``[k*n : (k+1)*n]``.
+    """
     return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
 
 
 def reduce_scatter(x: jax.Array, axis: str, *, scatter_axis: int = 0) -> jax.Array:
-    """Sum-reduce over the mesh axis, leaving each device its shard."""
+    """Sum-reduce over the mesh axis, leaving each device its shard.
+
+    ``tiled=True`` semantics (pinned by tests/test_mesh.py): the input's
+    ``scatter_axis`` dim splits evenly over the axis; rank k keeps the
+    summed ``[k*m/n : (k+1)*m/n]`` block.  An indivisible dim is a layout
+    bug upstream (grad_sync's bucket layout pads for exactly this), so it
+    fails here with the shape arithmetic spelled out instead of deep in
+    XLA.
+    """
+    n = axis_size(axis)
+    dim = x.shape[scatter_axis]
+    if dim % n:
+        raise ValueError(
+            f"reduce_scatter: dim {dim} of axis {scatter_axis} is not "
+            f"divisible by mesh axis {axis!r} (size {n}); pad the scatter "
+            f"dim to a multiple of {n} (grad_sync's bucket layout does "
+            f"this for gradient vectors)")
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
 
 

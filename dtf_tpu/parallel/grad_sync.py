@@ -1,0 +1,387 @@
+"""Gradient-sync + weight-update engine: dense, ZeRO-1, and overlapped ZeRO-1.
+
+The reference's data plane pulled every parameter and pushed every gradient
+through the PS each step (SURVEY.md §5.8).  The framework's first
+replacement — ``all_reduce_mean`` over the full gradient tree followed by a
+fully REPLICATED optimizer update — fixed the topology but kept two costs
+the TPU does not have to pay:
+
+* **memory**: Adam moments (2x params in f32) live on every data-parallel
+  replica, so an N-way data axis spends N× the HBM a single copy needs;
+* **time**: the all-reduce moves 2·(N-1)/N of the gradient bytes and then
+  every device redundantly computes the SAME full update.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arxiv 2004.13336, PAPERS.md) is the TPU-native fix, implemented
+here as three selectable strategies (``--grad_sync``):
+
+``dense``
+    today's pmean path, kept as the default and the correctness oracle.
+``zero1``
+    ZeRO-1 / weight-update sharding inside the explicit ``shard_map`` step:
+    gradients are flattened into fixed **buckets** (padded so every bucket
+    divides the data axis), each bucket ``reduce_scatter``'d so device k
+    owns the k-th shard of the *mean* gradient; the optimizer update runs
+    on that shard only — against optimizer state that was **initialized
+    sharded** (:func:`dtf_tpu.optim.init_partitioned`), so the moments
+    cost 1/N per device — and the updated parameter shards are
+    ``all_gather``'d back into full replicated params for the next forward.
+``zero1_overlap``
+    the same math, scheduled inside the grad-accumulation skeleton: each
+    microbatch's bucket gradients are reduce-scatter'd IMMEDIATELY and the
+    accumulator holds 1/N-size shards, so bucket *i*'s collective overlaps
+    microbatch *i+1*'s backward (and accumulator memory drops N×).  On
+    real hardware pair it with ``--xla_overlap`` (latency-hiding-scheduler
+    preset, applied at backend init by :func:`dtf_tpu.cluster.bootstrap`)
+    so XLA actually interleaves the comm with the compute.
+
+A reduced-precision collective knob (``--grad_comm_dtype bf16``,
+EQuARX-motivated — arxiv 2506.17615) composes with every strategy: the
+wire payload is ``(g/N).astype(bf16)`` — the 1/N **mean-preserving
+pre-scaling** keeps the summed wire value the final mean, so there is
+exactly ONE rounding per hop and no post-hoc divide to round again (no
+stochastic rounding needed).
+
+Sharding the update requires the update rule to commute with partitioning
+the flattened parameter vector — true for ELEMENTWISE optimizers
+(sgd/momentum/adam/adamw, tagged ``Optimizer.elementwise``), not for
+adafactor's factored moments or LAMB's per-tensor trust ratios; the engine
+rejects those up front.  ``clip_by_global_norm`` wrappers are re-derived
+with the data axis so the clip scale psums local squared norms back into
+the true global norm (bit-for-bit the same policy as dense clipping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu import optim as optim_lib
+from dtf_tpu.parallel import collectives as col
+from dtf_tpu.parallel import sharding as sh
+
+#: The canonical strategy order.  telemetry gauges encode a strategy as its
+#: index here (``comm/strategy_idx``) and the report CLI maps it back — a
+#: pinned test (tests/test_grad_sync.py) keeps the report's literal in sync.
+STRATEGIES: Tuple[str, ...] = ("dense", "zero1", "zero1_overlap")
+
+#: Bucket sizes are padded to a multiple of lcm(data_axis, _PAD_QUANTUM).
+#: 128 keeps shards lane-aligned AND — because every power-of-two axis size
+#: up to 128 divides it — makes the padded (global) bucket shapes identical
+#: across those axis sizes, so an elastic 4->2 relaunch restores the SAME
+#: checkpoint arrays and only the NamedSharding in the template changes.
+_PAD_QUANTUM = 128
+
+_COMM_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                "f32": jnp.float32, "float32": jnp.float32}
+
+
+def comm_dtype_of(name: Optional[str]):
+    """Resolve a ``--grad_comm_dtype`` flag value to a dtype (None = exact
+    f32 wire); raises with the valid spellings."""
+    if name is None:
+        return None
+    try:
+        dt = _COMM_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"--grad_comm_dtype must be one of {sorted(_COMM_DTYPES)}, "
+            f"got {name!r}") from None
+    return None if dt == jnp.float32 else dt
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Static bookkeeping for flattening a pytree into padded buckets.
+
+    Leaves are raveled in ``tree_flatten`` order and concatenated greedily
+    into buckets of ~``bucket_bytes`` (f32) each; every bucket is padded to
+    a multiple of ``quantum`` so ``reduce_scatter`` divides evenly and
+    shard shapes stay aligned (see ``_PAD_QUANTUM``).  The padding region
+    is mathematically inert: zero grads against zero params produce zero
+    updates under every elementwise rule, so it stays zero forever and the
+    unflatten simply trims it.
+    """
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    bucket_leaves: Tuple[Tuple[int, ...], ...]   # leaf indices per bucket
+    padded: Tuple[int, ...]                      # padded elems per bucket
+    n_shards: int
+
+    @classmethod
+    def build(cls, tree: Any, n_shards: int,
+              bucket_bytes: float) -> "BucketLayout":
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            raise ValueError("grad_sync: empty parameter tree")
+        shapes = tuple(tuple(l.shape) for l in leaves)
+        dtypes = tuple(jnp.dtype(l.dtype) for l in leaves)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i, n in enumerate(sizes):
+            cur.append(i)
+            cur_bytes += n * 4                  # buckets carry f32
+            if cur_bytes >= bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            buckets.append(cur)
+        quantum = math.lcm(n_shards, _PAD_QUANTUM)
+        padded = tuple(
+            -(-sum(sizes[i] for i in b) // quantum) * quantum
+            for b in buckets)
+        return cls(treedef=treedef, shapes=shapes, dtypes=dtypes,
+                   sizes=sizes,
+                   bucket_leaves=tuple(tuple(b) for b in buckets),
+                   padded=padded, n_shards=n_shards)
+
+    @property
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(f"b{i}" for i in range(len(self.bucket_leaves)))
+
+    def shard_len(self, key: str) -> int:
+        return self.padded[int(key[1:])] // self.n_shards
+
+    def flatten(self, tree: Any) -> Dict[str, jax.Array]:
+        """Pytree -> {bucket key: padded f32 vector}."""
+        leaves = jax.tree_util.tree_flatten(tree)[0]
+        out = {}
+        for k, idxs, pad in zip(self.keys, self.bucket_leaves, self.padded):
+            parts = [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs]
+            fill = pad - sum(self.sizes[i] for i in idxs)
+            if fill:
+                parts.append(jnp.zeros((fill,), jnp.float32))
+            out[k] = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return out
+
+    def unflatten(self, vecs: Dict[str, jax.Array],
+                  cast: bool = True) -> Any:
+        """{bucket key: padded vector} -> pytree (padding trimmed).
+        ``cast=False`` keeps leaves in the vectors' dtype (f32) — the
+        optimizer-state conversion path, where f32 IS the native storage
+        regardless of param dtype."""
+        leaves: List[Any] = [None] * len(self.shapes)
+        for k, idxs in zip(self.keys, self.bucket_leaves):
+            v, off = vecs[k], 0
+            for i in idxs:
+                chunk = v[off:off + self.sizes[i]].reshape(self.shapes[i])
+                leaves[i] = chunk.astype(self.dtypes[i]) if cast else chunk
+                off += self.sizes[i]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+class GradSyncEngine:
+    """One per Trainer: owns the bucket layout, the sharded optimizer-state
+    lifecycle, and the per-device sync+update code the explicit train step
+    splices in.
+
+    Construction order: ``GradSyncEngine(...)`` validates the strategy /
+    optimizer / mesh pairing, then :meth:`prepare` (with the model's
+    eval_shape'd params) freezes the bucket layout and the optimizer-state
+    sharding specs.  Everything after that is either host-side state
+    management (:meth:`init_opt_state`, the dense<->zero1 converters) or
+    traced per-device code (:meth:`scatter`, :meth:`sync_and_update`).
+    """
+
+    def __init__(self, strategy: str, optimizer: optim_lib.Optimizer,
+                 mesh: Mesh, *, bucket_mb: float = 4.0,
+                 comm_dtype: Optional[str] = None):
+        if strategy not in STRATEGIES:
+            raise ValueError(f"--grad_sync must be one of {STRATEGIES}, "
+                             f"got {strategy!r}")
+        if strategy == "dense":
+            raise ValueError("dense gradient sync needs no engine; the "
+                             "trainer's pmean path is the dense strategy")
+        axes = sh.data_axes(mesh)
+        if len(axes) != 1:
+            raise ValueError(
+                f"--grad_sync {strategy} runs its reduce-scatter/all-gather "
+                f"over a single data axis; mesh has data-like axes {axes}")
+        if bucket_mb <= 0:
+            raise ValueError(f"--grad_bucket_mb must be > 0, got {bucket_mb}")
+        # A clip_by_global_norm wrapper computed on shards would clip each
+        # shard by its LOCAL norm; rebuild it partition-aware (psum over
+        # the data axis) so zero1 clipping applies the same global scale
+        # as dense.
+        inner = getattr(optimizer.update, "_clip_inner", None)
+        if inner is not None:
+            optimizer = optim_lib.clip_by_global_norm(
+                inner, optimizer.update._clip_max_norm, axis=axes[0])
+        if not optimizer.elementwise:
+            raise ValueError(
+                "--grad_sync zero1 requires an ELEMENTWISE optimizer "
+                "(sgd/momentum/adam/adamw): the sharded update must equal "
+                "the full update restricted to each shard, which "
+                "adafactor's factored moments and lamb's per-tensor trust "
+                "ratios violate — use --grad_sync dense for those")
+        self.strategy = strategy
+        self.opt = optimizer
+        self.mesh = mesh
+        self.axis = axes[0]
+        self.n_shards = int(mesh.shape[self.axis])
+        self.bucket_bytes = bucket_mb * (1 << 20)
+        self.comm_dtype = comm_dtype_of(comm_dtype)
+        self.layout: Optional[BucketLayout] = None
+
+    # -- host-side lifecycle ------------------------------------------------
+
+    def prepare(self, params_shapes: Any) -> "GradSyncEngine":
+        """Freeze the bucket layout + optimizer-state specs from the
+        model's (eval_shape'd or real) parameter tree."""
+        self.layout = BucketLayout.build(params_shapes, self.n_shards,
+                                         self.bucket_bytes)
+        bucket_sds = {
+            k: jax.ShapeDtypeStruct((pad,), jnp.float32)
+            for k, pad in zip(self.layout.keys, self.layout.padded)}
+        self._bucket_treedef = jax.tree_util.tree_structure(bucket_sds)
+        self._params_treedef = self.layout.treedef
+        state_sds = jax.eval_shape(self.opt.init, bucket_sds)
+        padded_set = set(self.layout.padded)
+        # Bucket-shaped state leaves (adam's m/v, momentum's m) shard over
+        # the data axis; everything else (step counters) replicates.
+        is_vec = lambda s: s.ndim == 1 and s.shape[0] in padded_set
+        self.opt_state_spec = jax.tree_util.tree_map(
+            lambda s: P(self.axis) if is_vec(s) else P(), state_sds)
+        self._opt_state_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec), self.opt_state_spec,
+            is_leaf=lambda x: isinstance(x, P))
+        self._vec_sharding = NamedSharding(self.mesh, P(self.axis))
+        self._rep_sharding = NamedSharding(self.mesh, P())
+        return self
+
+    def _require_layout(self) -> BucketLayout:
+        if self.layout is None:
+            raise RuntimeError("GradSyncEngine.prepare() was never called")
+        return self.layout
+
+    def init_opt_state(self, params: Any) -> Any:
+        """Optimizer state born SHARDED: bucket the real params (weight
+        decay and schedules may read them) onto the data axis, then
+        materialize ``opt.init`` through the partition-aware path."""
+        layout = self._require_layout()
+        bucket_params = jax.jit(
+            layout.flatten, out_shardings=self._vec_sharding)(params)
+        return optim_lib.init_partitioned(self.opt, bucket_params,
+                                          self._opt_state_shardings)
+
+    def shard_opt_state(self, dense_state: Any) -> Any:
+        """dense -> zero1 optimizer-state conversion (the restore path for
+        a checkpoint saved under ``--grad_sync dense``): every top-level
+        state entry congruent with the params tree is bucket-flattened
+        onto the data axis; scalars and everything else pass through."""
+        layout = self._require_layout()
+        to_buckets = jax.jit(layout.flatten, out_shardings=self._vec_sharding)
+
+        def conv(entry):
+            if (jax.tree_util.tree_structure(entry) == self._params_treedef
+                    and tuple(tuple(l.shape) for l in
+                              jax.tree_util.tree_leaves(entry))
+                    == layout.shapes):
+                return to_buckets(entry)
+            return entry
+        if isinstance(dense_state, dict):
+            return {k: conv(v) for k, v in dense_state.items()}
+        return conv(dense_state)
+
+    def unshard_opt_state(self, sharded_state: Any) -> Any:
+        """zero1 -> dense conversion (restoring a zero1 checkpoint under
+        ``--grad_sync dense``).  Leaves stay f32 (``cast=False``): f32 is
+        the moments' native storage whatever the param dtype."""
+        layout = self._require_layout()
+        from_buckets = jax.jit(
+            lambda vecs: layout.unflatten(vecs, cast=False),
+            out_shardings=self._rep_sharding)
+
+        def conv(entry):
+            if (jax.tree_util.tree_structure(entry) == self._bucket_treedef
+                    and tuple(l.shape[0] for l in
+                              jax.tree_util.tree_leaves(entry))
+                    == layout.padded):
+                return from_buckets(entry)
+            return entry
+        if isinstance(sharded_state, dict):
+            return {k: conv(v) for k, v in sharded_state.items()}
+        return conv(sharded_state)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def comm_stats(self, grad_accum: int = 1) -> dict:
+        """Static per-step comm facts for the ``comm/*`` gauges: wire
+        bytes per device per STEP (reduce-scatter payload in the comm
+        dtype, times the microbatch count under ``zero1_overlap`` — its
+        scatter runs once per microbatch — plus one all-gather payload in
+        f32) and the bucket count."""
+        layout = self._require_layout()
+        total = sum(layout.padded)
+        rs_item = jnp.dtype(self.comm_dtype or jnp.float32).itemsize
+        rs_rounds = (grad_accum if (self.strategy == "zero1_overlap"
+                                    and grad_accum > 1) else 1)
+        return {"grad_sync_bytes": float(total * (rs_item * rs_rounds + 4)),
+                "bucket_count": float(len(layout.padded))}
+
+    # -- traced per-device code (inside shard_map) --------------------------
+
+    def scatter(self, grads: Any) -> Dict[str, jax.Array]:
+        """Bucket + mean-reduce-scatter the local gradient tree: returns
+        {bucket: f32 MEAN-gradient shard}.  The 1/N pre-scaling makes the
+        summed wire value the mean directly (mean-preserving: one rounding
+        per value on a bf16 wire, no second rounding from a post-divide).
+        Also the ``zero1_overlap`` per-microbatch stage — called once per
+        microbatch inside the accumulation scan, so shard_map schedules
+        bucket i's reduce-scatter concurrently with microbatch i+1's
+        backward."""
+        layout = self._require_layout()
+        inv = 1.0 / self.n_shards
+        out = {}
+        for k, v in layout.flatten(grads).items():
+            w = v * inv
+            if self.comm_dtype is not None:
+                w = w.astype(self.comm_dtype)
+            out[k] = col.reduce_scatter(w, self.axis).astype(jnp.float32)
+        return out
+
+    def sync_and_update(self, grads: Any, opt_state: Any, params: Any, *,
+                        prescattered: bool = False) -> Tuple[Any, Any]:
+        """The sharded weight update: (local grads | mean shards) + sharded
+        opt state + full replicated params -> (full updated params, new
+        sharded opt state).  Per-device code; call inside ``shard_map``
+        with ``opt_state`` mapped over the data axis
+        (:attr:`opt_state_spec`) and everything else replicated."""
+        layout = self._require_layout()
+        g_sh = grads if prescattered else self.scatter(grads)
+        me = lax.axis_index(self.axis)
+        p_sh = {}
+        for k, v in layout.flatten(params).items():
+            n = layout.shard_len(k)
+            p_sh[k] = lax.dynamic_slice(v, (me * n,), (n,))
+        updates, new_opt = self.opt.update(g_sh, opt_state, p_sh)
+        new_vecs = {k: col.all_gather(p_sh[k] + updates[k], self.axis)
+                    for k in layout.keys}
+        return layout.unflatten(new_vecs), new_opt
+
+
+def opt_state_bytes_per_device(opt_state: Any) -> float:
+    """Per-device bytes of an optimizer-state pytree, honoring shardings:
+    a replicated leaf costs its full nbytes on every device, a data-sharded
+    leaf 1/N — the ``comm/optimizer_state_bytes`` gauge, so the zero1
+    memory claim is measured off the real arrays, not the design doc."""
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += shards[0].data.nbytes
+        else:
+            total += np.asarray(leaf).nbytes
+    return total

@@ -54,6 +54,13 @@ METRICS = (
     "compile/cache_miss",
     "data/prefetch_depth",
     "data/prefetch_stall_s",
+    # gradient sync / weight-update sharding (parallel/grad_sync.py)
+    "comm/strategy_idx",          # index into grad_sync.STRATEGIES
+    "comm/data_axis_size",
+    "comm/grad_sync_bytes",       # wire payload per device per step
+    "comm/bucket_count",
+    "comm/optimizer_state_bytes", # measured per-device opt-state HBM
+    "comm/grad_sync_s",           # isolated sync+update time (bench A/B)
     "checkpoint/save_ms",
     "checkpoint/saves_total",
     "checkpoint/restores_total",
@@ -77,6 +84,7 @@ SPANS = (
     "data/fast_forward",
     "data/prefetch_stall",
     "compile/aot_warmup",
+    "comm/grad_sync",
     "trainer/init",
     # instants
     "chaos/*",                    # chaos/<fault kind> firing marks

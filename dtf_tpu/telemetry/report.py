@@ -201,6 +201,22 @@ def render(report: dict, top: int = 10) -> str:
         lines.append("Input pipeline / compile")
         for n in sorted(pipe):
             lines.append(f"  {n:<28} {pipe[n]:12.5g}")
+    # Gradient sync (comm/* from parallel/grad_sync.py): which weight-
+    # update strategy ran, its wire payload, and the MEASURED per-device
+    # optimizer-state bytes — the zero1 (N-1)/N memory claim, readable off
+    # the report.  The strategy gauge is an index into
+    # grad_sync.STRATEGIES; the literal below mirrors it so this module
+    # stays jax-free (pinned by tests/test_grad_sync.py).
+    comm = {n: m.get("value") for n, m in metrics.items()
+            if n.startswith("comm/") and m.get("value") is not None}
+    if comm:
+        lines.append("Gradient sync")
+        strategies = ("dense", "zero1", "zero1_overlap")
+        idx = comm.pop("comm/strategy_idx", None)
+        if idx is not None and 0 <= int(idx) < len(strategies):
+            lines.append(f"  {'strategy':<28} {strategies[int(idx)]:>12}")
+        for n in sorted(comm):
+            lines.append(f"  {n:<28} {comm[n]:12.5g}")
     if "steps" in report:
         s = report["steps"]
         lines.append(f"Steps: {s['first']}..{s['last']}  "

@@ -73,10 +73,18 @@ class CheckpointManager:
     """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True,
+                 run_meta: Optional[dict] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        # Facts about the WRITER the restore side needs to interpret the
+        # state layout — the gradient-sync strategy (dense vs zero1
+        # optimizer-state sharding) and the data-axis width.  Recorded in
+        # every manifest; restore_robust compares against the current
+        # run's values and logs the reshard (dense<->zero1 conversion,
+        # elastic shrink) instead of leaving it silent.
+        self._run_meta = dict(run_meta) if run_meta else {}
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -155,6 +163,8 @@ class CheckpointManager:
                 # silent.
                 manifest = {"step": step, "nproc": jax.process_count(),
                             "files": _tree_manifest(step_dir)}
+                if self._run_meta:
+                    manifest["run"] = self._run_meta
                 tmp = self._manifest_path(step) + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(manifest, f)
@@ -208,13 +218,35 @@ class CheckpointManager:
         resharded the trajectory onto the current (usually shrunken) mesh.
         Loud by design — a silent topology change is how 'why is my step
         time different' mysteries are born."""
-        saved_n = self.manifest_meta(step).get("nproc")
+        meta = self.manifest_meta(step)
+        saved_n = meta.get("nproc")
         if saved_n and saved_n != jax.process_count():
             log.warning(
                 "elastic restore: checkpoint step %d was written by %d "
                 "process(es), restored onto %d — state resharded onto the "
                 "current mesh via the template", step, saved_n,
                 jax.process_count())
+        saved_run = meta.get("run") or {}
+        cur_run = self._run_meta
+        if saved_run and cur_run:
+            if (saved_run.get("grad_sync") != cur_run.get("grad_sync")
+                    and None not in (saved_run.get("grad_sync"),
+                                     cur_run.get("grad_sync"))):
+                log.warning(
+                    "grad_sync restore: checkpoint step %d was saved under "
+                    "--grad_sync %s, restoring under --grad_sync %s — "
+                    "optimizer state converted between the dense and "
+                    "sharded (zero1) layouts", step,
+                    saved_run["grad_sync"], cur_run["grad_sync"])
+            if (saved_run.get("data_axis") != cur_run.get("data_axis")
+                    and None not in (saved_run.get("data_axis"),
+                                     cur_run.get("data_axis"))):
+                log.warning(
+                    "grad_sync restore: checkpoint step %d was saved on a "
+                    "%s-way data axis, restoring onto %s-way — sharded "
+                    "optimizer state re-partitioned via the restore "
+                    "template", step, saved_run["data_axis"],
+                    cur_run["data_axis"])
 
     def verify(self, step: int) -> tuple[bool, str]:
         """Check a landed step against its manifest.  (True, reason) means

@@ -56,20 +56,29 @@ class TrainingDiverged(RuntimeError):
 
 def init_state(model, optimizer: optim_lib.Optimizer, seed: int,
                mesh: Mesh, param_shardings: Optional[Any] = None,
-               guard: bool = False) -> TrainState:
+               guard: bool = False,
+               grad_sync: Optional[Any] = None) -> TrainState:
     """Deterministic same-seed init on all processes — the SPMD replacement
     for the reference's chief-runs-init_op + non-chief-polls protocol
     (tf_distributed.py:92-96; SURVEY.md §2.13 'coordinated init').
 
     Models exposing ``init_model_state()`` (e.g. BatchNorm running stats in
     ResNet) get a ``model_state`` entry threaded through the train step.
+
+    ``grad_sync``: a prepared :class:`~dtf_tpu.parallel.grad_sync.
+    GradSyncEngine` routes the optimizer state through the partition-aware
+    init — the moments are born SHARDED over the data axis (1/N HBM per
+    device) instead of replicated.
     """
     params = model.init(jax.random.key(seed))
     if param_shardings is None:
         params = sh.replicate(mesh, params)
     else:
         params = jax.tree_util.tree_map(jax.device_put, params, param_shardings)
-    opt_state = optimizer.init(params)
+    if grad_sync is not None:
+        opt_state = grad_sync.init_opt_state(params)
+    else:
+        opt_state = optimizer.init(params)
     # Per-param leaves (m/v/...) inherit the params' committed shardings,
     # but fresh scalar leaves (e.g. adam's step counter) are uncommitted
     # single-device arrays — a checkpoint restore would pin them to device
@@ -170,7 +179,9 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     grad_accum: int = 1,
                     grad_compression: Optional[str] = None,
                     grads_fn: Optional[Callable] = None,
-                    guard: bool = False) -> Callable:
+                    guard: bool = False,
+                    grad_sync: Optional[Any] = None,
+                    grad_comm_dtype: Optional[str] = None) -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``guard=True`` adds the in-step non-finite guard (DESIGN.md §5): an
@@ -226,6 +237,37 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         raise ValueError(
             f"grad_compression='int8' runs its ring over a single data "
             f"axis; mesh has data axes {sh.data_axes(mesh)}")
+    if grad_sync is not None:
+        # grad_sync is a prepared GradSyncEngine (zero1 / zero1_overlap):
+        # the reduce-scatter + sharded update + all-gather is hand-
+        # scheduled per-device code, so it lives in the explicit step.
+        if mode != "explicit":
+            raise ValueError(
+                "grad_sync zero1/zero1_overlap is a hand-scheduled "
+                "shard_map schedule; it requires mode='explicit' (the "
+                "Trainer auto-switches)")
+        if grad_compression:
+            raise ValueError(
+                "grad_sync zero1 and grad_compression='int8' are both "
+                "gradient wire formats; pick one (zero1 composes with "
+                "--grad_comm_dtype bf16 instead)")
+        if grads_fn is not None:
+            raise ValueError("grad_sync zero1 requires jax.grad-produced "
+                             "gradients (no custom grads_fn schedules)")
+    if grad_comm_dtype is not None:
+        if mode != "explicit":
+            raise ValueError(
+                "grad_comm_dtype changes the collective wire format; that "
+                "requires mode='explicit' (GSPMD owns the collectives in "
+                "implicit mode)")
+        if grad_compression:
+            raise ValueError("grad_comm_dtype and grad_compression='int8' "
+                             "are both wire formats; pick one")
+    # The engine owns its comm dtype (set at construction); the flag here
+    # only drives the dense explicit pmean path.
+    from dtf_tpu.parallel.grad_sync import comm_dtype_of
+    _dense_comm_dtype = (comm_dtype_of(grad_comm_dtype)
+                         if grad_sync is None else None)
 
     def value_and_grads(params, model_state, batch, rng):
         if stateful:
@@ -237,12 +279,27 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             new_ms = None
         return loss, aux, new_ms, grads
 
+    # zero1_overlap: each microbatch's bucket gradients reduce-scatter
+    # IMMEDIATELY inside the accumulation scan, so bucket i's collective
+    # is independent of microbatch i+1's backward and the scheduler can
+    # overlap them (on TPU, arm --xla_overlap so it actually does).  The
+    # accumulator then holds 1/N-size mean shards instead of full
+    # gradients — N× less accumulator HBM as a side effect.
+    overlap_stage = (grad_sync.scatter
+                     if (grad_sync is not None
+                         and grad_sync.strategy == "zero1_overlap"
+                         and grad_accum > 1) else None)
+
     def accumulated(step_of_mb, model_state, batch, rng):
         """THE grad-accumulation skeleton, shared by the value_and_grad
         and custom-grads_fn paths: ``step_of_mb(ms, mb, rng) -> (loss,
         aux, new_ms, grads)`` runs per microbatch; gradients accumulate
         in FLOAT32 regardless of param dtype (bf16 summation rounds away
-        small contributions as grad_accum grows).
+        small contributions as grad_accum grows).  With ``overlap_stage``
+        the per-microbatch gradients are reduce-scatter'd to mean shards
+        before accumulation (sum of per-microbatch means == mean of the
+        summed gradients, so the trajectory is unchanged up to float
+        association).
 
         Strided split (microbatch i = rows i::grad_accum): each device's
         contiguous data-sharded rows contribute equally to every
@@ -263,6 +320,8 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             i, mb = inp
             loss, aux, new_ms, grads = step_of_mb(
                 ms, mb, jax.random.fold_in(rng, i))
+            if overlap_stage is not None:
+                grads = overlap_stage(grads)
             g_sum = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), g_sum, grads)
             aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
@@ -271,6 +330,8 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         first = jax.tree_util.tree_map(lambda x: x[0], micro)
         loss0, aux0, ms0, grads0 = step_of_mb(
             model_state, first, jax.random.fold_in(rng, 0))
+        if overlap_stage is not None:
+            grads0 = overlap_stage(grads0)
         rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
         (g_sum, l_sum, aux_sum, ms), _ = lax.scan(
             body, (f32(grads0), loss0, aux0, ms0),
@@ -312,20 +373,38 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                 ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
         grads, loss, aux, new_ms, ok = sync(grads, loss, aux, new_ms, ok)
         if guard:
-            def apply_update(_):
-                updates, new_opt = optimizer.update(grads, opt_state, params)
-                return (optim_lib.apply_updates(params, updates), new_opt,
-                        new_ms if stateful else ())
+            if grad_sync is not None:
+                # zero1: the collectives are FUSED with the update
+                # (reduce-scatter -> shard update -> all-gather), and
+                # collectives inside a lax.cond branch are off the table —
+                # so compute unconditionally and where-select against the
+                # old values.  A bad step pays the (wasted) comm, but bad
+                # steps are the rare path and the semantics match dense's
+                # skip exactly: params/opt state/model state pass through.
+                up_params, up_opt = grad_sync.sync_and_update(
+                    grads, opt_state, params,
+                    prescattered=overlap_stage is not None)
+                sel = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), new, old)
+                new_params = sel(up_params, params)
+                new_opt = sel(up_opt, opt_state)
+                kept_ms = (sel(new_ms, model_state) if stateful else ())
+            else:
+                def apply_update(_):
+                    updates, new_opt = optimizer.update(grads, opt_state,
+                                                        params)
+                    return (optim_lib.apply_updates(params, updates),
+                            new_opt, new_ms if stateful else ())
 
-            def skip_update(_):
-                # Skip semantics: values pass through untouched — including
-                # model_state, whose "new" batch statistics came from the
-                # same poisoned batch as the gradients.
-                return (params, opt_state,
-                        model_state if stateful else ())
+                def skip_update(_):
+                    # Skip semantics: values pass through untouched —
+                    # including model_state, whose "new" batch statistics
+                    # came from the same poisoned batch as the gradients.
+                    return (params, opt_state,
+                            model_state if stateful else ())
 
-            new_params, new_opt, kept_ms = lax.cond(
-                ok, apply_update, skip_update, None)
+                new_params, new_opt, kept_ms = lax.cond(
+                    ok, apply_update, skip_update, None)
             bad = 1 - ok.astype(jnp.int32)
             skipped = state["skipped"] + bad
             streak = (state["bad_streak"] + 1) * bad  # +1 if bad else reset
@@ -337,8 +416,13 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             metrics = {"loss": loss, "nonfinite": bad,
                        "skipped_total": skipped, "bad_streak": streak, **aux}
             return new_state, metrics
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optim_lib.apply_updates(params, updates)
+        if grad_sync is not None:
+            params, opt_state = grad_sync.sync_and_update(
+                grads, opt_state, params,
+                prescattered=overlap_stage is not None)
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optim_lib.apply_updates(params, updates)
         new_state = {"params": params, "opt_state": opt_state, "step": step + 1}
         if stateful:
             new_state["model_state"] = new_ms
@@ -384,7 +468,12 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     # diverge across replicas: all-reduce the local verdict
                     # (mean of {0,1} flags == 1.0 iff every shard is clean).
                     ok = lax.pmean(ok.astype(jnp.float32), data_axes) == 1.0
-                if grad_compression == "int8":
+                if grad_sync is not None:
+                    # zero1: gradients stay LOCAL here — the engine fuses
+                    # their reduce-scatter with the sharded update
+                    # (grads_and_update calls sync_and_update).
+                    g = grads
+                elif grad_compression == "int8":
                     # int8-wire ring all-reduce for the bandwidth-heavy
                     # gradients; scalars stay exact.  (Single data axis
                     # validated at make_train_step entry.)
@@ -393,6 +482,16 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     g = jax.tree_util.tree_map(
                         lambda v: quantized_ring_all_reduce_mean(
                             v, data_axes[0]), grads)
+                elif _dense_comm_dtype is not None:
+                    # Reduced-precision wire for the dense strategy:
+                    # psum of (g/N).astype(bf16) — the 1/N pre-scaling is
+                    # mean-preserving (the wire sum IS the mean; no second
+                    # rounding from a post-divide).
+                    inv = 1.0 / sh.data_axis_size(mesh)
+                    g = jax.tree_util.tree_map(
+                        lambda v: lax.psum(
+                            (v * inv).astype(_dense_comm_dtype),
+                            data_axes).astype(v.dtype), grads)
                 else:
                     g = pmean(grads)
                 return (g, pmean(loss), pmean(aux),
@@ -402,9 +501,24 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
 
         batch_p = P(data_axes)
         from dtf_tpu.parallel.collectives import shard_map_fn
+        if grad_sync is not None:
+            # The sharded optimizer state maps over the data axis; every
+            # other state entry is replicated.  The spec tree must mirror
+            # the state dict exactly (shard_map prefix matching is
+            # per-key for dicts).
+            state_spec = {"params": P(), "step": P(),
+                          "opt_state": grad_sync.opt_state_spec}
+            if guard:
+                state_spec["skipped"] = P()
+                state_spec["bad_streak"] = P()
+            if stateful:
+                state_spec["model_state"] = P()
+        else:
+            state_spec = P()
         mapped = shard_map_fn(
             per_device, mesh=mesh,
-            in_specs=(P(), batch_p, P()), out_specs=(P(), P()))
+            in_specs=(state_spec, batch_p, P()),
+            out_specs=(state_spec, P()))
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
     raise ValueError(f"mode must be 'implicit' or 'explicit', got {mode!r}")
@@ -546,12 +660,46 @@ class Trainer:
         # schedules interleave fwd/bwd and cannot be expressed as jax.grad
         # of a forward pass) expose custom_grads_fn.
         grads_fn = getattr(self.model, "custom_grads_fn", None)
+        # Gradient-sync strategy (parallel/grad_sync.py): zero1 strategies
+        # are hand-scheduled shard_map code, so they run the explicit step
+        # — an implicit-mode request auto-switches rather than failing
+        # (the two modes are tested trajectory-equal on data-only meshes).
+        self._grad_sync_engine = None
+        if self.cfg.grad_sync != "dense":
+            from dtf_tpu.parallel.grad_sync import GradSyncEngine
+            if self.mode == "implicit":
+                self.mode = "explicit"
+                import logging as _logging
+                _logging.getLogger("dtf_tpu").info(
+                    "grad_sync=%s runs the explicit (shard_map) step; "
+                    "switching mode implicit -> explicit",
+                    self.cfg.grad_sync)
+            self._grad_sync_engine = GradSyncEngine(
+                self.cfg.grad_sync, self.optimizer, mesh,
+                bucket_mb=self.cfg.grad_bucket_mb,
+                comm_dtype=self.cfg.grad_comm_dtype)
+            self._grad_sync_engine.prepare(
+                jax.eval_shape(self.model.init,
+                               jax.random.key(self.cfg.seed)))
+        elif self.cfg.grad_comm_dtype and self.mode == "implicit":
+            # The reduced-precision wire composes with the DENSE strategy
+            # too — but it changes the collective wire format, which only
+            # the explicit (shard_map) step owns; same auto-switch as
+            # grad_sync instead of a crash at make_train_step.
+            self.mode = "explicit"
+            import logging as _logging
+            _logging.getLogger("dtf_tpu").info(
+                "grad_comm_dtype=%s changes the collective wire format; "
+                "switching mode implicit -> explicit",
+                self.cfg.grad_comm_dtype)
         self.step_fn = make_train_step(self.model.loss, self.optimizer, mesh,
                                        mode=self.mode, stateful=stateful,
                                        grad_accum=self.cfg.grad_accum,
                                        grad_compression=self.grad_compression,
                                        grads_fn=grads_fn,
-                                       guard=self._guarded)
+                                       guard=self._guarded,
+                                       grad_sync=self._grad_sync_engine,
+                                       grad_comm_dtype=self.cfg.grad_comm_dtype)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         # Parameter placement from the model's logical axes: FSDP when the
         # mesh has an 'fsdp' axis, tensor/expert/... sharding per the rule
@@ -568,7 +716,29 @@ class Trainer:
                 pass
         self.state = init_state(self.model, self.optimizer, self.cfg.seed,
                                 mesh, param_shardings=shardings,
-                                guard=self._guarded)
+                                guard=self._guarded,
+                                grad_sync=self._grad_sync_engine)
+        # Gradient-sync observability (telemetry/names.py comm/*): the
+        # strategy, the data-axis width, the measured per-device optimizer-
+        # state footprint (off the real arrays — the zero1 memory claim is
+        # checked, not asserted), and the engine's static wire facts.
+        from dtf_tpu.parallel.grad_sync import (STRATEGIES,
+                                                opt_state_bytes_per_device)
+        tel.gauge("comm/strategy_idx").set(
+            STRATEGIES.index(self.cfg.grad_sync))
+        tel.gauge("comm/data_axis_size").set(sh.data_axis_size(mesh))
+        tel.gauge("comm/optimizer_state_bytes").set(
+            opt_state_bytes_per_device(self.state["opt_state"]))
+        if self._grad_sync_engine is not None:
+            stats = self._grad_sync_engine.comm_stats(self.cfg.grad_accum)
+            tel.gauge("comm/grad_sync_bytes").set(stats["grad_sync_bytes"])
+            tel.gauge("comm/bucket_count").set(stats["bucket_count"])
+        else:
+            # Dense: the pmean payload is the full gradient tree.
+            tel.gauge("comm/grad_sync_bytes").set(float(sum(
+                np.prod(l.shape) * jnp.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(self.state["params"]))))
+            tel.gauge("comm/bucket_count").set(0)
         # Model-structure graph to TensorBoard, once at startup — the
         # reference's writer.add_graph (tf_distributed.py:97).
         self.logger.graph(self.state["params"],
@@ -580,7 +750,15 @@ class Trainer:
         if self.cfg.checkpoint_every > 0 or self.cfg.resume:
             from dtf_tpu.train.checkpoint import CheckpointManager
             self.ckpt = CheckpointManager(
-                f"{self.cfg.logdir}/checkpoints")
+                f"{self.cfg.logdir}/checkpoints",
+                # Manifests record the weight-update strategy, data-axis
+                # width AND bucket size so restore_robust can see (and
+                # log) a dense<->zero1 or elastic reshard — and so a
+                # cross-strategy restore can rebuild the WRITER's bucket
+                # layout, not assume this run's.
+                run_meta={"grad_sync": self.cfg.grad_sync,
+                          "data_axis": sh.data_axis_size(mesh),
+                          "grad_bucket_mb": self.cfg.grad_bucket_mb})
             if self.cfg.resume:
                 with tracker.measure("checkpoint"):
                     if self._chaos is not None:
@@ -595,26 +773,38 @@ class Trainer:
                     except Exception as exc:
                         from dtf_tpu.train.checkpoint import (
                             CheckpointMismatchError)
-                        if (not isinstance(exc, CheckpointMismatchError)
-                                or not self._guarded):
+                        if not isinstance(exc, CheckpointMismatchError):
                             raise
-                        # Legacy checkpoints (saved before the guard
-                        # existed / with --no-nonfinite_guard) lack the
-                        # counter leaves.  Backfill: restore without them,
-                        # re-attach the fresh zeros from init — the
-                        # trajectory is too valuable to discard over two
-                        # scalar counters.
-                        legacy = {k: v for k, v in self.state.items()
-                                  if k not in ("skipped", "bad_streak")}
-                        restored, step = self.ckpt.restore_robust(legacy)
-                        if step is None:
+                        # A verified-intact step that won't restore: the
+                        # template mismatch may be a grad_sync strategy
+                        # change (dense<->zero1 optimizer-state layouts
+                        # differ) — the manifest records the writer's
+                        # strategy, so reshard through the other layout
+                        # before concluding schema breakage.
+                        cross = self._restore_cross_strategy()
+                        if cross is not None:
+                            self.state, step = cross
+                        elif not self._guarded:
                             raise
-                        restored["skipped"] = self.state["skipped"]
-                        restored["bad_streak"] = self.state["bad_streak"]
-                        self.state = restored
-                        self.logger.print(
-                            f"[dtf_tpu] resumed a pre-guard checkpoint "
-                            f"(step {step}); guard counters start at zero")
+                        else:
+                            # Legacy checkpoints (saved before the guard
+                            # existed / with --no-nonfinite_guard) lack the
+                            # counter leaves.  Backfill: restore without
+                            # them, re-attach the fresh zeros from init —
+                            # the trajectory is too valuable to discard
+                            # over two scalar counters.
+                            legacy = {k: v for k, v in self.state.items()
+                                      if k not in ("skipped", "bad_streak")}
+                            restored, step = self.ckpt.restore_robust(legacy)
+                            if step is None:
+                                raise
+                            restored["skipped"] = self.state["skipped"]
+                            restored["bad_streak"] = self.state["bad_streak"]
+                            self.state = restored
+                            self.logger.print(
+                                f"[dtf_tpu] resumed a pre-guard checkpoint "
+                                f"(step {step}); guard counters start at "
+                                f"zero")
                 if step is not None:
                     self.logger.print(f"[dtf_tpu] resumed from step {step}")
                 elif had_steps:
@@ -682,6 +872,70 @@ class Trainer:
         # driver's measured warmup steps) from being counted twice.
         self._ctor_done = time.perf_counter()
         self._ctor_acc = tracker.accounted_s()
+
+    def _restore_cross_strategy(self):
+        """Cross-layout checkpoint reshard: restore a checkpoint whose
+        manifest records a DIFFERENT optimizer-state layout than this
+        run's — a ``--grad_sync`` strategy change (dense<->zero1) or a
+        zero1 ``--grad_bucket_mb`` change — by restoring through the
+        WRITER's layout (strategy + bucket size from the manifest, never
+        this run's assumptions) and converting via the bucket
+        flatten/unflatten (parallel/grad_sync.py).  Returns (state,
+        step), or None when the mismatch is not a layout change (caller
+        keeps its own fallback chain).  zero1 <-> zero1_overlap at the
+        same bucket size share a layout and never get here."""
+        latest = self.ckpt.latest_step()
+        if latest is None:
+            return None
+        run = self.ckpt.manifest_meta(latest).get("run") or {}
+        saved = run.get("grad_sync")
+        cur = self.cfg.grad_sync
+        if saved is None:
+            return None
+        saved_dense = saved == "dense"
+        cur_dense = cur == "dense"
+        saved_mb = run.get("grad_bucket_mb", self.cfg.grad_bucket_mb)
+        if saved_dense == cur_dense and (
+                saved_dense or saved_mb == self.cfg.grad_bucket_mb):
+            return None                # same layout: not our mismatch
+        mesh = self.cluster.mesh
+
+        def writer_engine():
+            from dtf_tpu.parallel.grad_sync import GradSyncEngine
+            return GradSyncEngine(
+                "zero1", self.optimizer, mesh, bucket_mb=saved_mb).prepare(
+                    jax.eval_shape(self.model.init,
+                                   jax.random.key(self.cfg.seed)))
+
+        # 1. restore through the WRITER's layout; 2. normalize to dense;
+        # 3. re-shard through THIS run's engine if it has one.
+        tmpl = dict(self.state)
+        if saved_dense:
+            dense_opt = self.optimizer.init(self.state["params"])
+            rep = sh.replicate(mesh)
+            tmpl["opt_state"] = jax.tree_util.tree_map(
+                lambda x: x if getattr(x, "committed", False)
+                else jax.device_put(x, rep), dense_opt)
+            restored, step = self.ckpt.restore_robust(tmpl)
+            if step is None:
+                return None
+            dense_state = restored["opt_state"]
+        else:
+            eng = writer_engine()
+            tmpl["opt_state"] = eng.init_opt_state(self.state["params"])
+            restored, step = self.ckpt.restore_robust(tmpl)
+            if step is None:
+                return None
+            dense_state = eng.unshard_opt_state(restored["opt_state"])
+        restored["opt_state"] = (
+            dense_state if self._grad_sync_engine is None
+            else self._grad_sync_engine.shard_opt_state(dense_state))
+        self.logger.print(
+            f"[dtf_tpu] optimizer state resharded across grad_sync "
+            f"layouts: checkpoint step {step} was saved with '{saved}' "
+            f"(bucket {saved_mb:g} MB), restored under '{cur}' "
+            f"(bucket {self.cfg.grad_bucket_mb:g} MB)")
+        return restored, step
 
     def _print_trace_summary(self, steps_traced: int) -> None:
         from dtf_tpu.utils.profiling import summarize_trace

@@ -7,6 +7,9 @@ Reference: ``python tf_distributed.py --job_name=worker --task_index=k``
         [--job_name worker --task_index k --coordinator_address h:p
          --num_processes N]           # multi-host
         [--mode explicit]             # literal psum shard_map step
+        [--grad_sync zero1]           # ZeRO-1 weight-update sharding:
+                                      # sharded optimizer state + bucketed
+                                      # reduce-scatter (DESIGN.md §4.1)
         [--prefetch N]                # async device-prefetch depth
                                       # (default 2; 0 = serial feed)
         [--compile_cache DIR]         # persistent XLA compile cache:

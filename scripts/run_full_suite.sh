@@ -99,5 +99,72 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: prefetch lane assertions (rc=$rc)"; }
   rm -rf "$pdir"
 fi
+# Grad-sync lane (DESIGN.md §4.1): dense vs zero1 vs zero1_overlap on the
+# MNIST MLP — same seed, same batches.  Asserts the three loss
+# trajectories match within float tolerance, the measured per-device
+# optimizer-state bytes strictly drop under zero1 (~(N-1)/N), and the
+# run-report CLI renders the "Gradient sync" section from a chaos'd
+# SUPERVISED zero1 run.  Skip with NO_GRADSYNC_LANE=1.
+if [ "${NO_GRADSYNC_LANE:-0}" != "1" ]; then
+  echo "=== grad-sync lane (dense/zero1/zero1_overlap A/B + report section) ==="
+  sdir=$(mktemp -d)
+  for strat in dense zero1 zero1_overlap; do
+    extra=""
+    [ "$strat" = "zero1_overlap" ] && extra="--grad_accum 2"
+    JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+        --epochs 1 --batch_size 512 --init fan_in --log_frequency 20 \
+        --optimizer adam --learning_rate 1e-3 \
+        --grad_sync "$strat" --grad_bucket_mb 0.1 --simulated_devices 8 $extra \
+        --logdir "$sdir/$strat" > "$sdir/$strat.log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: grad-sync $strat run (rc=$rc)"; tail -5 "$sdir/$strat.log"; }
+  done
+  # Chaos'd supervised zero1 run: nan_grad exercises the where-select
+  # guard skip, sigterm+restart exercises restore of SHARDED optimizer
+  # state; the report must render the Gradient sync section from it.
+  JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+      --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+      --optimizer adam --learning_rate 1e-3 \
+      --grad_sync zero1 --grad_bucket_mb 0.1 --simulated_devices 8 \
+      --logdir "$sdir/chaos" --checkpoint_every 5 --max_restarts 2 \
+      --chaos "nan_grad@4,sigterm@11" > "$sdir/chaos.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: grad-sync chaos run (rc=$rc)"; tail -5 "$sdir/chaos.log"; }
+  python -m dtf_tpu.telemetry.report "$sdir/chaos" | tee "$sdir/report.log" > /dev/null
+  grep -q "Gradient sync" "$sdir/report.log" \
+    && grep -q "zero1" "$sdir/report.log" \
+    && grep -q "comm/optimizer_state_bytes" "$sdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing Gradient sync section"; }
+  python - "$sdir" <<'PYEOF'
+import csv, json, os, sys
+d = sys.argv[1]
+def costs(run):
+    out = {}
+    with open(os.path.join(d, run, "metrics.csv"), newline="") as f:
+        for rec in csv.reader(f):
+            if rec and rec[0] != "step" and rec[1] == "cost":
+                out[int(rec[0])] = float(rec[2])
+    return out
+def opt_bytes(run):
+    doc = json.load(open(os.path.join(d, run, "telemetry.json")))
+    return doc["metrics"]["comm/optimizer_state_bytes"]["value"]
+dense, z1, zo = costs("dense"), costs("zero1"), costs("zero1_overlap")
+steps = sorted(set(dense) & set(z1) & set(zo))
+assert steps, "no common cost steps across the A/B runs"
+for s in steps:
+    for name, c in (("zero1", z1[s]), ("zero1_overlap", zo[s])):
+        assert abs(c - dense[s]) <= 0.02 * abs(dense[s]) + 1e-3, \
+            f"{name} diverged from dense at step {s}: {c} vs {dense[s]}"
+bd, b1, bo = opt_bytes("dense"), opt_bytes("zero1"), opt_bytes("zero1_overlap")
+assert b1 < bd and bo < bd, f"optimizer-state bytes did not drop: {b1}/{bo} vs dense {bd}"
+assert b1 < 0.25 * bd, f"zero1 opt-state drop too small: {b1} vs dense {bd} (8-way axis)"
+print(f"grad-sync lane OK: {len(steps)} cost points within tolerance; "
+      f"opt-state bytes dense {bd:.0f} -> zero1 {b1:.0f} "
+      f"({1 - b1 / bd:.1%} drop)")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: grad-sync lane assertions (rc=$rc)"; }
+  rm -rf "$sdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

@@ -464,3 +464,24 @@ class TestKVQuality:
         assert 0.98 < r["kv_ppl_ratio"] < 1.02
         assert abs(r["fp_vs_parallel_delta"]) < 0.05
         assert r["tokens_scored"] == 2 * (48 - 1 - 8)
+
+
+class TestGradSyncAB:
+    def test_ab_structure_and_drop_ratio(self, devices):
+        """--grad_sync_ab on the simulated 8-device mesh: all three
+        strategies report, the zero1 optimizer-state drop lands near
+        (N-1)/N, and no degenerate-mesh warning fires."""
+        from dtf_tpu.bench.breakdown import grad_sync_ab
+
+        out = grad_sync_ab(steps=1, batch=64)
+        assert out["data_axis"] == 8
+        assert "warning" not in out
+        assert set(out["strategies"]) == {"dense", "zero1", "zero1_overlap"}
+        for row in out["strategies"].values():
+            assert row["step_ms"] > 0 and row["grad_sync_ms"] > 0
+            assert row["comm_bytes_per_step"] > 0
+        assert out["strategies"]["zero1_overlap"]["grad_accum"] == 2
+        # overlap's wire bytes scale with its microbatch count
+        assert (out["strategies"]["zero1_overlap"]["comm_bytes_per_step"]
+                > out["strategies"]["zero1"]["comm_bytes_per_step"])
+        assert 0.8 < out["opt_state_drop_ratio"] < 0.95   # ~7/8

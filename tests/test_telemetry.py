@@ -373,6 +373,36 @@ class TestReportCLI:
         assert "p0: mean    12.00" in out and "p1: mean    30.00" in out
         assert "Top spans" in out and "train/step" in out
 
+    def test_gradient_sync_section_golden(self, tmp_path, capsys):
+        """The comm/* instruments render as a 'Gradient sync' section with
+        the strategy index decoded back to its name (grad_sync.STRATEGIES
+        order)."""
+        import json as _json
+        import os as _os
+
+        from dtf_tpu.telemetry import report
+        d = str(tmp_path)
+        with open(_os.path.join(d, "telemetry.json"), "w") as f:
+            _json.dump({
+                "goodput": {"productive_s": 1.0, "wall_s": 1.0,
+                            "accounted_s": 1.0},
+                "metrics": {
+                    "comm/strategy_idx": {"type": "gauge", "value": 1.0},
+                    "comm/data_axis_size": {"type": "gauge", "value": 8.0},
+                    "comm/bucket_count": {"type": "gauge", "value": 2.0},
+                    "comm/grad_sync_bytes":
+                        {"type": "gauge", "value": 636928.0},
+                    "comm/optimizer_state_bytes":
+                        {"type": "gauge", "value": 79620.0}},
+                "written_unix": 0}, f)
+        assert report.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "Gradient sync" in out
+        assert "strategy" in out and "zero1" in out
+        assert "comm/optimizer_state_bytes" in out
+        assert "79620" in out
+        assert "comm/bucket_count" in out
+
     def test_check_gate(self, tmp_path, capsys):
         from dtf_tpu.telemetry import report
         d = self._fixture_logdir(tmp_path)
