@@ -30,6 +30,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 _METRIC = "matmul_tflops_per_chip"
 
@@ -174,6 +175,17 @@ def main(_init=init_backend, _preflight=preflight_probe) -> int:
         deadline_s = float(os.environ.get("DTF_BENCH_DEADLINE_S", "1800"))
         preflight_s = float(
             os.environ.get("DTF_BENCH_PREFLIGHT_TIMEOUT_S", "60"))
+        # Retry-next-window: the r03-r05 relay hangs were TRANSIENT (the
+        # relay cycles), so one probe at one instant under-samples the
+        # window.  On a hung probe, wait and re-probe up to RETRIES more
+        # times with doubling waits starting at RETRY_WAIT_S — bounded,
+        # so a genuinely dead relay still fails this run in minutes, but
+        # a relay that comes back mid-window gets the round recorded
+        # instead of another stalled BENCH_r*.json.
+        preflight_retries = int(
+            os.environ.get("DTF_BENCH_PREFLIGHT_RETRIES", "2"))
+        preflight_wait_s = float(
+            os.environ.get("DTF_BENCH_PREFLIGHT_RETRY_WAIT_S", "30"))
         ns = tuple(int(n) for n in
                    os.environ.get("DTF_BENCH_NS", "1000,1024,2048,4096,8192")
                    .split(","))
@@ -196,6 +208,15 @@ def main(_init=init_backend, _preflight=preflight_probe) -> int:
         return fail("config_error", "config",
                     "DTF_BENCH_PREFLIGHT_TIMEOUT_S must be in "
                     f"[0, {threading.TIMEOUT_MAX:.0f}], got {preflight_s}")
+    if not 0 <= preflight_retries <= 100:
+        return fail("config_error", "config",
+                    "DTF_BENCH_PREFLIGHT_RETRIES must be in [0, 100], "
+                    f"got {preflight_retries}")
+    if not (0 <= preflight_wait_s <= threading.TIMEOUT_MAX):
+        return fail("config_error", "config",
+                    "DTF_BENCH_PREFLIGHT_RETRY_WAIT_S must be in "
+                    f"[0, {threading.TIMEOUT_MAX:.0f}], "
+                    f"got {preflight_wait_s}")
     if not ns or not all(n > 0 for n in ns):
         return fail("config_error", "config",
                     f"DTF_BENCH_NS values must be positive, got {ns}")
@@ -205,10 +226,37 @@ def main(_init=init_backend, _preflight=preflight_probe) -> int:
     # DTF_BENCH_INIT_TIMEOUT_S (600s) inside an unreclaimable daemon
     # thread.  Raise-mode failures fall through to the real init, which
     # classifies them (outage vs config vs harness) exactly as before.
+    run_deadline = time.monotonic() + deadline_s
     if preflight_s > 0 and _preflight is not None and _want_preflight():
+        # The whole-run deadline bounds the retry windows too: the
+        # doubling waits could otherwise dwarf DTF_BENCH_DEADLINE_S
+        # (retries=12 at the 30s base is a ~17h final window) with no
+        # JSON line and no watchdog armed yet.  ONE budget for the whole
+        # run: the watchdog below is armed with whatever the retries
+        # left, so preflight + init + run never exceed deadline_s total.
+        retry_deadline = run_deadline
         hung, why = _preflight(preflight_s)
+        probes, waited = 1, 0.0
+        while hung and probes <= preflight_retries:
+            # Doubling window between probes (bounded by the retry
+            # budget): a relay mid-cycle gets time to come back without
+            # this run waiting forever on one that is down for the day.
+            wait = preflight_wait_s * (2 ** (probes - 1))
+            # Never sleep past the deadline, and stop probing once it
+            # has no room left for another probe window.
+            room = retry_deadline - time.monotonic() - preflight_s
+            if room <= 0:
+                break
+            time.sleep(min(wait, room))
+            waited += min(wait, room)
+            hung, why = _preflight(preflight_s)
+            probes += 1
         if hung:
-            return fail("tpu_unavailable", "preflight", why)
+            return fail(
+                "tpu_unavailable", "preflight",
+                f"{why} ({probes} probe(s) over ~{waited:.0f}s of "
+                f"retry windows; DTF_BENCH_PREFLIGHT_RETRIES="
+                f"{preflight_retries})")
 
     # Classify a deadline hit by where it struck: before backend init
     # succeeded it is the relay's hang mode; after, the backend provably
@@ -226,7 +274,12 @@ def main(_init=init_backend, _preflight=preflight_probe) -> int:
         if _emit_once(line, emit_state):  # a finished run wins the race
             os._exit(1)
 
-    deadline = threading.Timer(deadline_s, deadline_abort)
+    # Armed with what the preflight retries left of the budget (>= 1s so
+    # a last-instant recovery still gets a beat to emit its JSON line),
+    # so a run that burned most of deadline_s waiting on the relay can't
+    # hold the slot for another full deadline_s.
+    deadline = threading.Timer(
+        max(1.0, run_deadline - time.monotonic()), deadline_abort)
     deadline.daemon = True
     deadline.start()
     try:

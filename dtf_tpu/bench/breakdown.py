@@ -305,7 +305,15 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
     — where the backend reports memory_stats (TPU; CPU returns null) —
     LIVE bytes in use right after state allocation (each strategy runs in
     its own scope so the reading is per-strategy, not a process-lifetime
-    peak).  Returns the JSON-ready comparison dict."""
+    peak).
+
+    Wire-dtype dimension (ISSUE 6 acceptance): ``wire_dtypes`` re-runs
+    zero1 under each ``--grad_comm_dtype`` (f32 / bf16 / int8) at the
+    SAME bucket layout class, reporting per-dtype step time, sync time,
+    gradient wire bytes (int8 counts its per-block scales) and the
+    measured quantization error; ``int8_vs_bf16_wire_ratio`` is the
+    headline (~0.51: 1 payload byte + 1.6% scales vs 2 bytes).  Returns
+    the JSON-ready comparison dict."""
     import time
 
     import numpy as np
@@ -315,6 +323,7 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
     from dtf_tpu.models.mlp import MnistMLP
     from dtf_tpu.parallel.collectives import shard_map_fn
     from dtf_tpu.parallel.grad_sync import (GradSyncEngine, STRATEGIES,
+                                            WIRE_DTYPES,
                                             opt_state_bytes_per_device)
     from dtf_tpu.parallel.mesh import local_mesh
     from dtf_tpu.train.trainer import (init_state, make_train_step,
@@ -341,7 +350,8 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
             spec = P()
         else:
             def f(grads, opt_state, params):
-                return eng.sync_and_update(grads, opt_state, params)
+                p, o, _ = eng.sync_and_update(grads, opt_state, params)
+                return p, o
             spec = eng.opt_state_spec
         return jax.jit(shard_map_fn(
             f, mesh=mesh, in_specs=(P(), spec, P()),
@@ -349,7 +359,8 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
 
     out = {"workload": "mnist_mlp_784_100_10", "backend": jax.default_backend(),
            "data_axis": int(mesh.shape["data"]), "global_batch": batch,
-           "steps_timed": steps, "bucket_mb": bucket_mb, "strategies": {}}
+           "steps_timed": steps, "bucket_mb": bucket_mb, "strategies": {},
+           "wire_dtypes": {}}
     if out["data_axis"] == 1:
         # A 1-device mesh degenerates every strategy to the same math:
         # zero1's "shard" is the whole vector plus padding, so the state
@@ -361,18 +372,18 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
                           "is degenerate; run on a multi-device mesh "
                           "(e.g. --simulated_devices 8 on CPU)")
         print(f"# WARNING: {out['warning']}", file=_sys.stderr)
-    def run_strategy(strat):
-        """One strategy, in its own scope: the previous strategy's device
-        arrays are refcount-freed before this one allocates, so the LIVE
-        bytes_in_use reading below reflects THIS strategy's footprint
-        (the process-lifetime peak_bytes_in_use is monotone across
-        strategies sharing the process and could never show zero1's
-        savings)."""
+    def run_strategy(strat, comm_dtype=None):
+        """One (strategy, wire dtype) cell, in its own scope: the
+        previous cell's device arrays are refcount-freed before this one
+        allocates, so the LIVE bytes_in_use reading below reflects THIS
+        cell's footprint (the process-lifetime peak_bytes_in_use is
+        monotone across cells sharing the process and could never show
+        zero1's savings)."""
         eng = None
         accum = 1
         if strat != "dense":
-            eng = GradSyncEngine(strat, opt, mesh,
-                                 bucket_mb=bucket_mb).prepare(
+            eng = GradSyncEngine(strat, opt, mesh, bucket_mb=bucket_mb,
+                                 comm_dtype=comm_dtype).prepare(
                 jax.eval_shape(model.init, jax.random.key(1)))
             if strat == "zero1_overlap":
                 accum = 2      # the overlap schedule needs microbatches
@@ -381,13 +392,15 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
                           or {}).get("bytes_in_use")
         step = make_train_step(model.loss, opt, mesh, mode="explicit",
                                donate=False, grad_sync=eng,
-                               grad_accum=accum)
+                               grad_accum=accum,
+                               grad_comm_dtype=(comm_dtype
+                                                if eng is None else None))
         b = put_global_batch(mesh, host_batch)
-        state, _ = step(state, b, jax.random.key(0))      # compile
+        state, m = step(state, b, jax.random.key(0))      # compile
         block(state)
         t0 = time.perf_counter()
         for i in range(steps):
-            state, _ = step(state, b, jax.random.key(i + 1))
+            state, m = step(state, b, jax.random.key(i + 1))
         block(state)
         step_ms = (time.perf_counter() - t0) / steps * 1e3
 
@@ -405,28 +418,51 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
             sync_s = (time.perf_counter() - t0) / steps
         tel.gauge("comm/grad_sync_s").set(sync_s)
 
-        stats = (eng.comm_stats(accum) if eng is not None else
-                 {"grad_sync_bytes": float(sum(
-                     np.prod(l.shape) * np.dtype(l.dtype).itemsize
-                     for l in jax.tree_util.tree_leaves(state["params"]))),
-                  "bucket_count": 0.0})
-        return {
+        if eng is not None:
+            stats = eng.comm_stats(accum)
+        else:
+            from dtf_tpu.parallel.grad_sync import (comm_dtype_of,
+                                                    wire_bytes_per_elem)
+            wire = float(sum(
+                np.prod(l.shape)
+                for l in jax.tree_util.tree_leaves(state["params"]))
+                * wire_bytes_per_elem(comm_dtype_of(comm_dtype)))
+            stats = {"grad_sync_bytes": wire, "wire_bytes": wire,
+                     "bucket_count": 0.0}
+        row = {
             "step_ms": round(step_ms, 4),
             "grad_sync_ms": round(sync_s * 1e3, 4),
             "grad_accum": accum,
             "opt_state_bytes_per_device":
                 opt_state_bytes_per_device(state["opt_state"]),
             "comm_bytes_per_step": stats["grad_sync_bytes"],
+            "wire_bytes_per_step": stats["wire_bytes"],
             "bucket_count": int(stats["bucket_count"]),
             "hbm_bytes_in_use_after_init": hbm_after_init,
         }
+        if "quant_error" in m:
+            row["quant_error_rms"] = float(m["quant_error"])
+        return row
 
     for strat in STRATEGIES:
         out["strategies"][strat] = run_strategy(strat)
+    # Wire-dtype dimension: zero1 at every --grad_comm_dtype, equal
+    # bucket layout class (the int8 cell's padding quantum grows by
+    # QBLOCK, which is exactly what a real int8 run pays).
+    out["wire_dtypes"]["f32"] = out["strategies"]["zero1"]
+    for dt in WIRE_DTYPES[1:]:
+        out["wire_dtypes"][dt] = run_strategy("zero1", comm_dtype=dt)
     d = out["strategies"]
     out["opt_state_drop_ratio"] = round(
         1.0 - (d["zero1"]["opt_state_bytes_per_device"]
                / max(d["dense"]["opt_state_bytes_per_device"], 1.0)), 4)
+    w = out["wire_dtypes"]
+    out["int8_vs_bf16_wire_ratio"] = round(
+        w["int8"]["wire_bytes_per_step"]
+        / max(w["bf16"]["wire_bytes_per_step"], 1.0), 4)
+    out["int8_vs_f32_wire_ratio"] = round(
+        w["int8"]["wire_bytes_per_step"]
+        / max(w["f32"]["wire_bytes_per_step"], 1.0), 4)
     return out
 
 
@@ -465,18 +501,8 @@ def main(argv=None) -> int:
     if ns.cpu:
         jax.config.update("jax_platforms", "cpu")
     if ns.simulated_devices > 0:
-        # Same mechanics as ClusterConfig.simulated_devices: must land
-        # before the first device query; older jax falls back to the
-        # XLA_FLAGS route (both are read at backend init).
-        import os
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update("jax_num_cpu_devices", ns.simulated_devices)
-        except AttributeError:
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count="
-                  f"{ns.simulated_devices}").strip()
+        from dtf_tpu.cluster import simulate_cpu_devices
+        simulate_cpu_devices(ns.simulated_devices)
     if ns.compile_cache:
         from dtf_tpu.train.compile_cache import enable
         enable(ns.compile_cache)

@@ -128,6 +128,23 @@ def apply_xla_overlap_preset() -> str:
     return os.environ["LIBTPU_INIT_ARGS"]
 
 
+def simulate_cpu_devices(n: int) -> None:
+    """Pin the backend to ``n`` simulated CPU devices (the CLI version of
+    the tests' simulated mesh).  Must run before the first device query:
+    config.update works post-import as long as no backend initialized
+    yet; older jax (< 0.5) has no ``jax_num_cpu_devices`` option, and
+    there the XLA_FLAGS route works for the same reason (read at backend
+    init).  The one definition behind ``--simulated_devices`` everywhere
+    (bootstrap and the bench CLIs)."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+
+
 def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
     """Initialize the process and build the global mesh.
 
@@ -155,20 +172,7 @@ def bootstrap(config: Optional[ClusterConfig] = None) -> Cluster:
             raise ValueError(
                 f"--simulated_devices runs on CPU; conflicting "
                 f"--platform={config.platform}")
-        # CLI version of the tests' simulated mesh (SURVEY.md §4): N CPU
-        # devices on one host.  config.update works post-import as long as
-        # no backend has been initialized yet.  Older jax (< 0.5) has no
-        # jax_num_cpu_devices option; there the XLA_FLAGS route works for
-        # the same reason (read at backend init, which hasn't happened).
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update("jax_num_cpu_devices",
-                              config.simulated_devices)
-        except AttributeError:
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count="
-                  f"{config.simulated_devices}").strip()
+        simulate_cpu_devices(config.simulated_devices)
 
     if config.num_processes > 1 and not _INITIALIZED:
         if not config.coordinator_address:

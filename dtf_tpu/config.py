@@ -162,10 +162,17 @@ class TrainConfig:
     # Reduced-precision collective wire format for gradient sync
     # (EQuARX-motivated, PAPERS.md): "bf16" ships (g/N).astype(bf16) —
     # mean-preserving pre-scaling, one rounding per value — through the
-    # reduce-scatter/pmean; None/"f32" keeps the exact f32 wire.
-    # Composes with every --grad_sync strategy; requires the explicit
-    # step (shard_map owns the collectives).
+    # reduce-scatter/pmean; "int8" ships the block-scaled format
+    # (parallel/quantize.py: int8 payload + one f32 scale per 256
+    # values, ~4x less wire than f32, ~2x less than bf16);
+    # None/"f32" keeps the exact f32 wire.  Composes with every
+    # --grad_sync strategy; requires the explicit step (shard_map owns
+    # the collectives).
     grad_comm_dtype: Optional[str] = None
+    # int8-wire rounding mode: "nearest" (deterministic) or "stochastic"
+    # (unbiased floor(v/s + u) draws seeded from the step rng, so
+    # trajectories stay reproducible run-to-run).
+    quant_rounding: str = "nearest"
     # zero1 bucket size (MB of f32 gradient per flattened bucket): smaller
     # buckets pipeline the reduce-scatter earlier under zero1_overlap,
     # larger buckets amortize per-collective latency.
@@ -289,10 +296,25 @@ class TrainConfig:
                 f"('dense', 'zero1', 'zero1_overlap'), got "
                 f"{self.grad_sync!r}")
         if self.grad_comm_dtype not in (None, "bf16", "bfloat16", "f32",
-                                        "float32"):
+                                        "float32", "int8"):
             raise ValueError(
-                f"--grad_comm_dtype must be 'bf16' or 'f32', got "
+                f"--grad_comm_dtype must be 'f32', 'bf16' or 'int8', got "
                 f"{self.grad_comm_dtype!r}")
+        # Literal mirror of parallel.quantize.ROUNDINGS (jax-free import,
+        # same pinning rule as the STRATEGIES mirror above).
+        if self.quant_rounding not in ("nearest", "stochastic"):
+            raise ValueError(
+                f"--quant_rounding must be 'nearest' or 'stochastic', "
+                f"got {self.quant_rounding!r}")
+        if (self.quant_rounding == "stochastic"
+                and self.grad_comm_dtype != "int8"):
+            # Only the block-scaled int8 wire consults the rounding mode;
+            # silently running nearest under a flag that asked for
+            # stochastic would poison trajectory attribution.
+            raise ValueError(
+                "--quant_rounding stochastic only applies to the "
+                "--grad_comm_dtype int8 wire (the f32/bf16 wires have no "
+                "quantizer); drop the flag or switch the wire to int8")
         if self.grad_bucket_mb <= 0:
             raise ValueError(
                 f"--grad_bucket_mb must be > 0, got {self.grad_bucket_mb}")

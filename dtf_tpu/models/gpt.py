@@ -84,6 +84,13 @@ class GPTConfig:
     # a packed up|gate matmul).  Decode/prefill keep their own paths
     # (the fused decode stack kernel serves generation).
     fused_block: bool = False
+    # Training-forward matmul compute format (nn/lowp.py): "fp32" |
+    # "bf16" | "int8" | "fp8".  Applies to the block's projections
+    # (qkv/o/fc1/fc_gate/fc2) with per-channel scaling and a straight-
+    # through backward; the inner attention, norms, loss, and the tied
+    # LM head keep full precision.  Quality-gated by
+    # bench.int8_quality --trajectory (pinned loss envelope).
+    matmul_dtype: str = "fp32"
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -136,6 +143,13 @@ class GPTBlock(Module):
 
     def __init__(self, cfg: GPTConfig):
         self.cfg = cfg
+        from dtf_tpu.nn.lowp import check_matmul_dtype
+        check_matmul_dtype(cfg.matmul_dtype)
+        if cfg.fused_block and cfg.matmul_dtype != "fp32":
+            raise ValueError(
+                "--matmul_dtype and fused_block are exclusive: the fused "
+                "Pallas block kernels own their operand precision; drop "
+                "one of the two")
         if cfg.fused_block:
             from dtf_tpu.ops.block_kernel import _check_block_args
             # fail at construction, not first apply: T checked per-call
@@ -150,19 +164,23 @@ class GPTBlock(Module):
         self.ln2 = LayerNorm(cfg.dim)
         self.attn = MultiHeadAttention(cfg.dim, cfg.num_heads, cfg.dtype,
                                        attn_impl=impl,
-                                       num_kv_heads=cfg.num_kv_heads)
+                                       num_kv_heads=cfg.num_kv_heads,
+                                       matmul_dtype=cfg.matmul_dtype)
         # SwiGLU: gate and up are SEPARATE column-parallel projections, not
         # one packed matmul split at the midpoint — under the "mlp"->tensor
         # sharding rule a midpoint split would land gate and up on different
         # shards and force a reshard before silu(gate)*up; two projections
         # keep the elementwise product local on every tensor shard.
         self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
-                         axes_in="embed", axes_out="mlp")
+                         axes_in="embed", axes_out="mlp",
+                         matmul_dtype=cfg.matmul_dtype)
         self.fc_gate = (Dense(cfg.dim, cfg.mlp_dim, dtype=cfg.dtype,
-                              axes_in="embed", axes_out="mlp")
+                              axes_in="embed", axes_out="mlp",
+                              matmul_dtype=cfg.matmul_dtype)
                         if cfg.mlp_act == "swiglu" else None)
         self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=cfg.dtype,
-                         axes_in="mlp", axes_out="embed")
+                         axes_in="mlp", axes_out="embed",
+                         matmul_dtype=cfg.matmul_dtype)
 
     def init(self, key):
         k1, k2, ka, kf1, kf2, kg = jax.random.split(key, 6)
@@ -232,27 +250,40 @@ class GPTBlock(Module):
         actual generation length (init_cache ``length=``), not max_len.
 
         ``packed``: this layer's slice of GPT._decode_pack's container —
-        {"qkv": {"w", "b"[, "scale"]}} at minimum (the q/k/v projections
-        pre-concatenated into ONE matmul; decode at B~1 is
-        op-latency-bound, so fewer, wider matmuls win), plus optional
+        {"qkv": {"wq", "bq", "wkv", "bkv"}} at minimum (q plus the k/v
+        pair stacked into one matmul operand; decode at B~1 is
+        op-latency-bound, so fewer, wider matmuls win), or the int8 form
+        {"qkv": {"wq", "sq", "bq", "wkv", "skv", "bkv"}} (same layout,
+        int8 operands + per-column scales), plus optional
         int8-quantized "o"/"fc1"/"fc_gate"/"fc2" entries ({"w" int8,
         "scale"}) that halve the per-token HBM weight traffic.
         """
         p = params["attn"]
         h = self.ln1.apply(params["ln1"], x_t)
         if packed is not None:
-            hd = self.cfg.dim // self.cfg.num_heads
-            nh, kvh = self.cfg.num_heads, self.attn.kv_heads
             pq = packed["qkv"]
-            if "scale" in pq:
-                qkv = _dequant_matmul(h, pq["w"], pq["scale"],
-                                      h.dtype) + pq["b"]
+            if "sq" in pq:
+                # int8 pack: same q + stacked-kv layout as the f32 pack,
+                # int8 operands with per-output-column scales.
+                hd = self.cfg.dim // self.cfg.num_heads
+                nh, kvh = self.cfg.num_heads, self.attn.kv_heads
+                bsz = x_t.shape[0]
+                q = (_dequant_matmul(h, pq["wq"], pq["sq"], h.dtype)
+                     + pq["bq"]).reshape(bsz, 1, nh, hd)
+                kv = ((jnp.einsum("btd,sdp->sbtp", h,
+                                  pq["wkv"].astype(h.dtype),
+                                  preferred_element_type=jnp.float32)
+                       * pq["skv"][:, None]).astype(h.dtype)
+                      + pq["bkv"][:, None, None])
+                k_t = kv[0].reshape(bsz, 1, kvh, hd)
+                v_t = kv[1].reshape(bsz, 1, kvh, hd)
             else:
-                qkv = jnp.einsum("btd,dp->btp", h, pq["w"]) + pq["b"]
-            bsz = x_t.shape[0]
-            q = qkv[..., :nh * hd].reshape(bsz, 1, nh, hd)
-            k_t = qkv[..., nh * hd:(nh + kvh) * hd].reshape(bsz, 1, kvh, hd)
-            v_t = qkv[..., (nh + kvh) * hd:].reshape(bsz, 1, kvh, hd)
+                # f32 pack: q plus the k/v pair as ONE stacked matmul
+                # operand (see GPT._packed_qkv for why stack, not concat).
+                q = jnp.einsum("btd,dhk->bthk", h, pq["wq"]) + pq["bq"]
+                kv = (jnp.einsum("btd,sdhk->sbthk", h, pq["wkv"])
+                      + pq["bkv"][:, None, None])
+                k_t, v_t = kv[0], kv[1]
         else:
             q, k_t, v_t = self.attn.qkv(p, h)
         if self.cfg.rope:
@@ -616,31 +647,48 @@ class GPT(Module):
         return cache, self.tok.attend(params["tok"], x)[:, p_len - 1, :]
 
     def _packed_qkv(self, params, int8: bool = False):
-        """Concatenate every layer's q/k/v projection weights into one
-        (L, D, (H+2KVH)·Dh) matmul operand for the decode hot loop (see
-        GPTBlock.decode_step).  Computed once per generate call, outside
-        the decode scan.
+        """Pack every layer's q/k/v projection weights for the decode hot
+        loop (see GPTBlock.decode_step).  Computed once per generate call,
+        outside the decode scan.
+
+        f32 layout: ``{"wq" (L, D, H, Dh), "bq", "wkv" (L, 2, D, KVH, Dh),
+        "bkv"}`` — k and v are STACKED on a fresh axis, never concatenated
+        along the head dim.  The head dim is ``'tensor'``-sharded under
+        the TP serving mesh, and GSPMD (jax 0.4.37) miscompiles a
+        concatenate whose concat dim is sharded: every value comes back
+        multiplied by the product of the OTHER mesh axes' sizes (the
+        resharding all-gather is summed over them too).
+        ``tests/test_gpt.py::test_generate_tp_mesh_matches_single``
+        caught it; ``jnp.stack`` introduces an unsharded axis and stays
+        exact under every sharding.
 
         ``int8``: symmetric per-output-channel weight quantization —
         decode streams every weight from HBM each token, so int8 halves
         the dominant traffic; the matmul runs on dequantized tiles
         (y = (x @ w8) * scale), exact up to the ~0.4% per-channel
-        rounding."""
+        rounding.  Same concat-free q + stacked-kv layout as f32, so the
+        miscompile above is unreachable from this path too."""
         attn = params["layers"]["attn"]
         n_layers, d = self.cfg.num_layers, self.cfg.dim
-        flat_w = lambda t: t["w"].reshape(n_layers, d, -1)
-        flat_b = lambda t: t["b"].reshape(n_layers, -1)
-        out = {
-            "w": jnp.concatenate(
-                [flat_w(attn["q"]), flat_w(attn["k"]), flat_w(attn["v"])],
-                axis=-1),
-            "b": jnp.concatenate(
-                [flat_b(attn["q"]), flat_b(attn["k"]), flat_b(attn["v"])],
-                axis=-1),
-        }
         if int8:
-            out["w"], out["scale"] = _quantize_cols(out["w"])
-        return out
+            # Same concat-free shape discipline as the f32 pack below —
+            # q on its own, k/v STACKED on a fresh axis — so the int8
+            # path can never hit the concat-along-sharded-dim miscompile
+            # either.  quantize_cols is per-output-column (axis=-2 is the
+            # contraction dim), so quantizing the stack == quantizing
+            # k and v separately.
+            flat_w = lambda t: t["w"].reshape(n_layers, d, -1)
+            flat_b = lambda t: t["b"].reshape(n_layers, -1)
+            wq, sq = _quantize_cols(flat_w(attn["q"]))
+            wkv, skv = _quantize_cols(jnp.stack(
+                [flat_w(attn["k"]), flat_w(attn["v"])], axis=1))
+            return {"wq": wq, "sq": sq, "bq": flat_b(attn["q"]),
+                    "wkv": wkv, "skv": skv,
+                    "bkv": jnp.stack([flat_b(attn["k"]),
+                                      flat_b(attn["v"])], axis=1)}
+        return {"wq": attn["q"]["w"], "bq": attn["q"]["b"],
+                "wkv": jnp.stack([attn["k"]["w"], attn["v"]["w"]], axis=1),
+                "bkv": jnp.stack([attn["k"]["b"], attn["v"]["b"]], axis=1)}
 
     def _decode_pack(self, params, int8: bool = False):
         """The decode loop's weight container: packed q/k/v always; with

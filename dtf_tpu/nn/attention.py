@@ -55,6 +55,11 @@ class MultiHeadAttention(Module):
     # num_heads); queries share each KV head in groups.  None = classic MHA.
     # Shrinks the KV cache (and its HBM traffic) by num_heads/num_kv_heads.
     num_kv_heads: Optional[int] = None
+    # Forward compute format for the q/k/v/o PROJECTIONS (nn/lowp.py):
+    # "fp32" | "bf16" | "int8" | "fp8".  The inner attention (scores,
+    # softmax, values) keeps full precision — its fp32 statistics are a
+    # correctness anchor, and the projections hold the matmul FLOPs.
+    matmul_dtype: str = "fp32"
 
     @property
     def head_dim(self) -> int:
@@ -91,17 +96,28 @@ class MultiHeadAttention(Module):
         k, v = self.kv_proj(params, x if kv_input is None else kv_input)
         return q, k, v
 
+    def _proj_in(self, x, entry):
+        """x (B, T, D) @ w (D, NH, Dh) + b -> (B, T, NH, Dh), through the
+        low-precision seam when ``matmul_dtype`` asks for it (the weight
+        flattens to (D, NH*Dh) so the per-output-channel scales cover
+        every (head, lane) column)."""
+        w = entry["w"]
+        if self.matmul_dtype != "fp32":
+            from dtf_tpu.nn.lowp import lowp_matmul
+            y = lowp_matmul(x, w.reshape(w.shape[0], -1), self.matmul_dtype)
+            return y.reshape(*x.shape[:-1], *w.shape[1:]) + entry["b"]
+        return jnp.einsum("btd,dhk->bthk", x, w) + entry["b"]
+
     def q_proj(self, params, x):
         """Project only q from ``x`` (B, T, D) — for cross-attention decode
         where k/v come from a precomputed cache."""
-        return (jnp.einsum("btd,dhk->bthk", x, params["q"]["w"])
-                + params["q"]["b"])
+        return self._proj_in(x, params["q"])
 
     def kv_proj(self, params, s):
         """Project only k/v from ``s`` (B, T, D) — for cross-attention
         caches where q is not needed."""
-        k = jnp.einsum("btd,dhk->bthk", s, params["k"]["w"]) + params["k"]["b"]
-        v = jnp.einsum("btd,dhk->bthk", s, params["v"]["w"]) + params["v"]["b"]
+        k = self._proj_in(s, params["k"])
+        v = self._proj_in(s, params["v"])
         return k, v
 
     def expand_kv(self, kv):
@@ -112,7 +128,13 @@ class MultiHeadAttention(Module):
 
     def out_proj(self, params, out):
         """(B, T, H, Dh) attention output -> (B, T, D)."""
-        return (jnp.einsum("bthk,hkd->btd", out, params["o"]["w"])
+        w = params["o"]["w"]
+        if self.matmul_dtype != "fp32":
+            from dtf_tpu.nn.lowp import lowp_matmul
+            flat = out.reshape(*out.shape[:-2], -1)      # (B, T, H*Dh)
+            return (lowp_matmul(flat, w.reshape(-1, w.shape[-1]),
+                                self.matmul_dtype) + params["o"]["b"])
+        return (jnp.einsum("bthk,hkd->btd", out, w)
                 + params["o"]["b"])
 
     def apply(self, params, x, *, kv_input=None, mask=None, train=False,

@@ -38,6 +38,11 @@ class Dense(Module):
     init_scale: "float | str" = "fan_in"   # "fan_in" | "reference" | float stddev
     axes_in: Optional[str] = "embed"
     axes_out: Optional[str] = "mlp"
+    # Forward-pass compute format (nn/lowp.py): "fp32" (default) |
+    # "bf16" | "int8" | "fp8".  int8/fp8 quantize per output channel
+    # (weight) and per token (activation) with a straight-through
+    # backward — the --matmul_dtype training compute path.
+    matmul_dtype: str = "fp32"
 
     def init(self, key):
         kw, _ = jax.random.split(key)
@@ -55,7 +60,11 @@ class Dense(Module):
         return p
 
     def apply(self, params, x, *, train=False, rng=None):
-        y = x @ params["w"]
+        if self.matmul_dtype != "fp32":
+            from dtf_tpu.nn.lowp import lowp_matmul
+            y = lowp_matmul(x, params["w"], self.matmul_dtype)
+        else:
+            y = x @ params["w"]
         if self.use_bias:
             y = y + params["b"]
         return y

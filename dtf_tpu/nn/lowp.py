@@ -1,0 +1,141 @@
+"""Low-precision matmul compute paths for TRAINING forward passes.
+
+The serving stack already runs int8 weights (ops/decode_kernel.py,
+``--decode_int8``); this module pushes reduced precision into the
+*training* forward per "Scalable Training of Language Models using JAX
+pjit and TPUv4" (PAPERS.md, arxiv 2204.06514) and the EQuARX
+low-precision direction: a ``--matmul_dtype`` knob on the dense layers
+and the GPT blocks.
+
+Formats:
+
+``fp32``
+    the default — plain ``x @ w``, nothing changes.
+``bf16``
+    both operands cast to bf16, MXU accumulates in f32
+    (``preferred_element_type``).  Gradients flow through the casts
+    naturally (d(astype)/dx == astype).
+``int8``
+    symmetric quantization, **per output channel** for the weight (one
+    f32 scale per column — training grows outlier channels, and
+    per-channel scales are exactly the serving path's defense) and per
+    row (token) for the activation; the product runs int8 x int8 -> i32
+    on the MXU and the two scales fold into the f32 output.  Exact
+    integer arithmetic: |q| <= 127 so row sums stay far inside i32.
+``fp8``
+    operands scaled per channel/row into float8_e4m3fn range (max 448)
+    and rounded through the f8 lattice; the contraction runs in f32 on
+    CPU (numerically identical to an f8-operand MXU matmul with f32
+    accumulation, since f8 values are exact in f32) — the TPU kernel
+    swap is a lowering detail, not a semantics change.
+
+Backward: quantization rounds, and ``round`` has zero gradient — so the
+int8/fp8 paths use the **straight-through estimator** (the standard QAT
+move): the forward computes the quantized product, the backward
+differentiates as if the matmul had run on the full-precision operands.
+The quality harness (``bench.int8_quality --trajectory``) measures the
+end-to-end loss-trajectory cost of exactly this approximation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: The ``--matmul_dtype`` spellings, canonical order.
+MATMUL_DTYPES: Tuple[str, ...] = ("fp32", "bf16", "int8", "fp8")
+
+_TINY = 1e-30
+
+
+def check_matmul_dtype(name: str) -> str:
+    if name not in MATMUL_DTYPES:
+        raise ValueError(f"--matmul_dtype must be one of {MATMUL_DTYPES}, "
+                         f"got {name!r}")
+    return name
+
+
+def _int8_pair(v: jax.Array, axis: int):
+    """Symmetric int8 quantization of ``v`` with one f32 scale per slice
+    along every axis EXCEPT ``axis`` (the contraction axis the scale
+    must not span)."""
+    # The division stays in f32 (like quantize.encode): dividing by a
+    # scale downcast to a bf16 operand dtype can land on 127.5 -> 128 ->
+    # clip, biasing exactly the outlier channel the scale protects.
+    amax = jnp.max(jnp.abs(v.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(v.astype(jnp.float32)
+                           / jnp.maximum(scale, _TINY)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _fp8_cast(v: jax.Array, axis: int):
+    """Scale per non-contraction slice into e4m3 range, round through the
+    f8 lattice, return (f8-valued f32 tensor, f32 scale)."""
+    f8max = float(jnp.finfo(jnp.float8_e4m3fn).max)          # 448
+    amax = jnp.max(jnp.abs(v), axis=axis, keepdims=True)
+    scale = (amax.astype(jnp.float32) / f8max)
+    safe = jnp.maximum(scale, _TINY)
+    q = (v.astype(jnp.float32) / safe).astype(jnp.float8_e4m3fn)
+    return q.astype(jnp.float32), scale
+
+
+def _matmul_2d_int8(x2, w):
+    xq, sx = _int8_pair(x2, axis=1)               # per-row (token) scale
+    wq, sw = _int8_pair(w, axis=0)                # per-output-channel
+    y = lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * sx * sw
+
+
+def _matmul_2d_fp8(x2, w):
+    xq, sx = _fp8_cast(x2, axis=1)
+    wq, sw = _fp8_cast(w, axis=0)
+    y = lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return y * sx * sw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ste_matmul(x2, w, dtype: str):
+    """(m, k) @ (k, n) through the quantized format ``dtype`` with a
+    straight-through backward (gradients as if fp32)."""
+    return (_matmul_2d_int8 if dtype == "int8" else _matmul_2d_fp8)(x2, w)
+
+
+def _ste_fwd(x2, w, dtype):
+    return _ste_matmul(x2, w, dtype), (x2, w)
+
+
+def _ste_bwd(dtype, res, g):
+    x2, w = res
+    g = g.astype(jnp.float32)
+    dx = (g @ w.astype(jnp.float32).T).astype(x2.dtype)
+    dw = (x2.astype(jnp.float32).T @ g).astype(w.dtype)
+    return dx, dw
+
+
+_ste_matmul.defvjp(_ste_fwd, _ste_bwd)
+
+
+def lowp_matmul(x: jax.Array, w: jax.Array, dtype: str) -> jax.Array:
+    """``x (..., k) @ w (k, n)`` through the compute format ``dtype``;
+    output in the fp32-matmul's result dtype.  The seam every
+    ``--matmul_dtype`` consumer (nn.Dense, MultiHeadAttention
+    projections) routes through, so the formats live in one place."""
+    check_matmul_dtype(dtype)
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    if dtype == "fp32":
+        return jnp.matmul(x, w)
+    if dtype == "bf16":
+        return jnp.matmul(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32).astype(out_dtype)
+    lead = x.shape[:-1]
+    y = _ste_matmul(x.reshape(-1, x.shape[-1]), w, dtype)
+    return y.reshape(*lead, w.shape[-1]).astype(out_dtype)

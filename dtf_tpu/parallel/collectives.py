@@ -90,22 +90,25 @@ def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) ->
 # outlier then only inflates the step size of its own block instead of the
 # whole chunk (~an order of magnitude less error on heavy-tailed gradient
 # distributions), for 4 bytes of scale overhead per 256 int8 payload bytes
-# (~1.6% extra wire traffic).
+# (~1.6% extra wire traffic).  THE block format lives in
+# parallel/quantize.py (the grad_sync wire shares it); the ring below
+# delegates so there is exactly one quantizer definition.
 _QBLOCK = 256
 
 
 def _quantize_int8(v: jax.Array) -> tuple:
     """Symmetric per-block int8 quantization of a flat (m,) chunk whose m
-    is a _QBLOCK multiple: (q int8 (nb, B), scales f32 (nb, 1))."""
-    vb = v.reshape(-1, _QBLOCK)
-    scale = jnp.max(jnp.abs(vb), axis=1, keepdims=True) / 127.0
-    safe = jnp.maximum(scale, 1e-30)
-    q = jnp.clip(jnp.round(vb / safe), -127, 127).astype(jnp.int8)
-    return q, scale
+    is a _QBLOCK multiple: (q int8 (nb, B), scales f32 (nb, 1)).
+    Delegates to quantize.encode (nearest rounding — the ring
+    re-quantizes per hop and must stay deterministic)."""
+    from dtf_tpu.parallel import quantize as qz
+    assert qz.QBLOCK == _QBLOCK
+    return qz.encode(v)
 
 
 def _dequantize_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
-    return (q.astype(jnp.float32) * scale).reshape(-1)
+    from dtf_tpu.parallel import quantize as qz
+    return qz.decode(q, scale)
 
 
 def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:

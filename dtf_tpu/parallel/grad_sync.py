@@ -35,12 +35,16 @@ here as three selectable strategies (``--grad_sync``):
     preset, applied at backend init by :func:`dtf_tpu.cluster.bootstrap`)
     so XLA actually interleaves the comm with the compute.
 
-A reduced-precision collective knob (``--grad_comm_dtype bf16``,
+A reduced-precision collective knob (``--grad_comm_dtype``,
 EQuARX-motivated — arxiv 2506.17615) composes with every strategy: the
-wire payload is ``(g/N).astype(bf16)`` — the 1/N **mean-preserving
-pre-scaling** keeps the summed wire value the final mean, so there is
-exactly ONE rounding per hop and no post-hoc divide to round again (no
-stochastic rounding needed).
+wire payload is the 1/N **mean-preserving pre-scaled** gradient, so the
+summed wire value is the final mean and there is exactly ONE rounding
+per hop with no post-hoc divide to round again.  ``bf16`` casts the
+pre-scaled payload; ``int8`` ships the block-scaled format from
+:mod:`dtf_tpu.parallel.quantize` (int8 payload + one f32 scale per
+QBLOCK values, ~2x less wire than bf16, ~4x less than f32) with
+``--quant_rounding nearest|stochastic`` (stochastic draws are seeded
+from the step rng, so trajectories stay reproducible).
 
 Sharding the update requires the update rule to commute with partitioning
 the flattened parameter vector — true for ELEMENTWISE optimizers
@@ -65,6 +69,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtf_tpu import optim as optim_lib
 from dtf_tpu.parallel import collectives as col
+from dtf_tpu.parallel import quantize as qz
 from dtf_tpu.parallel import sharding as sh
 
 #: The canonical strategy order.  telemetry gauges encode a strategy as its
@@ -80,12 +85,19 @@ STRATEGIES: Tuple[str, ...] = ("dense", "zero1", "zero1_overlap")
 _PAD_QUANTUM = 128
 
 _COMM_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                "f32": jnp.float32, "float32": jnp.float32}
+                "f32": jnp.float32, "float32": jnp.float32,
+                "int8": "int8"}
+
+#: Canonical wire-format order for the ``comm/wire_dtype_idx`` gauge; the
+#: report CLI carries a literal mirror (pinned by tests/test_grad_sync.py).
+WIRE_DTYPES: Tuple[str, ...] = ("f32", "bf16", "int8")
 
 
 def comm_dtype_of(name: Optional[str]):
-    """Resolve a ``--grad_comm_dtype`` flag value to a dtype (None = exact
-    f32 wire); raises with the valid spellings."""
+    """Resolve a ``--grad_comm_dtype`` flag value to a wire format: None
+    (exact f32 wire), ``jnp.bfloat16``, or the string ``"int8"`` (the
+    block-scaled format from parallel/quantize.py — not a plain cast, so
+    no jnp dtype).  Raises with the valid spellings."""
     if name is None:
         return None
     try:
@@ -95,6 +107,19 @@ def comm_dtype_of(name: Optional[str]):
             f"--grad_comm_dtype must be one of {sorted(_COMM_DTYPES)}, "
             f"got {name!r}") from None
     return None if dt == jnp.float32 else dt
+
+
+def wire_dtype_name(resolved) -> str:
+    """Inverse of :func:`comm_dtype_of` onto :data:`WIRE_DTYPES`."""
+    if resolved is None:
+        return "f32"
+    return "int8" if resolved == "int8" else "bf16"
+
+
+def wire_bytes_per_elem(resolved) -> float:
+    """Wire bytes per f32 gradient element for a resolved comm dtype
+    (int8 includes its per-block scale overhead)."""
+    return qz.WIRE_BYTES_PER_ELEM[wire_dtype_name(resolved)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,7 +222,8 @@ class GradSyncEngine:
 
     def __init__(self, strategy: str, optimizer: optim_lib.Optimizer,
                  mesh: Mesh, *, bucket_mb: float = 4.0,
-                 comm_dtype: Optional[str] = None):
+                 comm_dtype: Optional[str] = None,
+                 quant_rounding: str = "nearest"):
         if strategy not in STRATEGIES:
             raise ValueError(f"--grad_sync must be one of {STRATEGIES}, "
                              f"got {strategy!r}")
@@ -233,6 +259,13 @@ class GradSyncEngine:
         self.n_shards = int(mesh.shape[self.axis])
         self.bucket_bytes = bucket_mb * (1 << 20)
         self.comm_dtype = comm_dtype_of(comm_dtype)
+        # "int8" is a wire FORMAT (block-scaled payload + scales, not a
+        # cast): the scatter routes through parallel/quantize.py.  The
+        # bucket layout is wire-independent — block alignment happens
+        # inside the collective — so checkpoints reshard across wire
+        # dtypes without a layout conversion.
+        self.quantized = self.comm_dtype == "int8"
+        self.quant_rounding = qz.check_rounding(quant_rounding)
         self.layout: Optional[BucketLayout] = None
 
     # -- host-side lifecycle ------------------------------------------------
@@ -318,33 +351,69 @@ class GradSyncEngine:
     # -- telemetry ----------------------------------------------------------
 
     def comm_stats(self, grad_accum: int = 1) -> dict:
-        """Static per-step comm facts for the ``comm/*`` gauges: wire
-        bytes per device per STEP (reduce-scatter payload in the comm
-        dtype, times the microbatch count under ``zero1_overlap`` — its
-        scatter runs once per microbatch — plus one all-gather payload in
-        f32) and the bucket count."""
+        """Static per-step comm facts for the ``comm/*`` gauges:
+        ``wire_bytes`` is the GRADIENT wire per device per step (the
+        reduce-scatter payload in the comm format — int8 counts its
+        per-block scales — times the microbatch count under
+        ``zero1_overlap``, whose scatter runs once per microbatch);
+        ``grad_sync_bytes`` adds the f32 param all-gather payload (kept
+        exact: quantizing updated PARAMS would inject error straight into
+        the weights rather than the gradients)."""
         layout = self._require_layout()
         total = sum(layout.padded)
-        rs_item = jnp.dtype(self.comm_dtype or jnp.float32).itemsize
         rs_rounds = (grad_accum if (self.strategy == "zero1_overlap"
                                     and grad_accum > 1) else 1)
-        return {"grad_sync_bytes": float(total * (rs_item * rs_rounds + 4)),
+        if self.quantized:
+            # Exact: per-chunk block round-up (quantize.wire_elems), int8
+            # payload + f32 scale per QBLOCK.
+            wire_total = sum(qz.wire_elems(p, self.n_shards)
+                             for p in layout.padded)
+            wire = float(wire_total
+                         * qz.WIRE_BYTES_PER_ELEM["int8"] * rs_rounds)
+        else:
+            wire = float(total * wire_bytes_per_elem(self.comm_dtype)
+                         * rs_rounds)
+        return {"grad_sync_bytes": wire + float(total * 4),
+                "wire_bytes": wire,
                 "bucket_count": float(len(layout.padded))}
 
     # -- traced per-device code (inside shard_map) --------------------------
 
-    def scatter(self, grads: Any) -> Dict[str, jax.Array]:
+    def scatter(self, grads: Any,
+                rng: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
         """Bucket + mean-reduce-scatter the local gradient tree: returns
         {bucket: f32 MEAN-gradient shard}.  The 1/N pre-scaling makes the
         summed wire value the mean directly (mean-preserving: one rounding
-        per value on a bf16 wire, no second rounding from a post-divide).
-        Also the ``zero1_overlap`` per-microbatch stage — called once per
-        microbatch inside the accumulation scan, so shard_map schedules
-        bucket i's reduce-scatter concurrently with microbatch i+1's
-        backward."""
+        per value on a reduced wire, no second rounding from a
+        post-divide).  Also the ``zero1_overlap`` per-microbatch stage —
+        called once per microbatch inside the accumulation scan, so
+        shard_map schedules bucket i's reduce-scatter concurrently with
+        microbatch i+1's backward.
+
+        On the int8 wire the dict carries an extra ``"qerr"`` entry — the
+        local encode-error accumulator ((2,) vector, see
+        quantize.encode_error) summed over buckets; it rides the same
+        pytree so zero1_overlap's accumulation scan aggregates it across
+        microbatches for free.  ``rng`` seeds stochastic rounding
+        (derived from the step rng by the caller; each bucket folds in
+        its index so draws never repeat across buckets)."""
         layout = self._require_layout()
         inv = 1.0 / self.n_shards
-        out = {}
+        out: Dict[str, jax.Array] = {}
+        if self.quantized:
+            qerr = jnp.zeros((2,), jnp.float32)
+            if self.quant_rounding == "stochastic" and rng is None:
+                raise ValueError("stochastic quant_rounding needs the step "
+                                 "rng threaded into scatter()")
+            for i, (k, v) in enumerate(layout.flatten(grads).items()):
+                bucket_rng = (jax.random.fold_in(rng, i)
+                              if rng is not None else None)
+                out[k], e = qz.reduce_scatter_quantized(
+                    v * inv, self.axis, rounding=self.quant_rounding,
+                    rng=bucket_rng, return_error=True)
+                qerr = qerr + e
+            out["qerr"] = qerr
+            return out
         for k, v in layout.flatten(grads).items():
             w = v * inv
             if self.comm_dtype is not None:
@@ -353,14 +422,21 @@ class GradSyncEngine:
         return out
 
     def sync_and_update(self, grads: Any, opt_state: Any, params: Any, *,
-                        prescattered: bool = False) -> Tuple[Any, Any]:
+                        prescattered: bool = False,
+                        rng: Optional[jax.Array] = None
+                        ) -> Tuple[Any, Any, Optional[jax.Array]]:
         """The sharded weight update: (local grads | mean shards) + sharded
         opt state + full replicated params -> (full updated params, new
-        sharded opt state).  Per-device code; call inside ``shard_map``
-        with ``opt_state`` mapped over the data axis
-        (:attr:`opt_state_spec`) and everything else replicated."""
+        sharded opt state, quant-error scalar or None).  Per-device code;
+        call inside ``shard_map`` with ``opt_state`` mapped over the data
+        axis (:attr:`opt_state_spec`) and everything else replicated.
+        The error scalar (int8 wire only) is psum'd over the data axis so
+        every replica reports the same global relative-RMS value."""
         layout = self._require_layout()
-        g_sh = grads if prescattered else self.scatter(grads)
+        g_sh = dict(grads) if prescattered else self.scatter(grads, rng)
+        qerr = g_sh.pop("qerr", None)
+        if qerr is not None:
+            qerr = qz.error_ratio(lax.psum(qerr, self.axis))
         me = lax.axis_index(self.axis)
         p_sh = {}
         for k, v in layout.flatten(params).items():
@@ -369,7 +445,7 @@ class GradSyncEngine:
         updates, new_opt = self.opt.update(g_sh, opt_state, p_sh)
         new_vecs = {k: col.all_gather(p_sh[k] + updates[k], self.axis)
                     for k in layout.keys}
-        return layout.unflatten(new_vecs), new_opt
+        return layout.unflatten(new_vecs), new_opt, qerr
 
 
 def opt_state_bytes_per_device(opt_state: Any) -> float:

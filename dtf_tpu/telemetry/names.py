@@ -56,8 +56,11 @@ METRICS = (
     "data/prefetch_stall_s",
     # gradient sync / weight-update sharding (parallel/grad_sync.py)
     "comm/strategy_idx",          # index into grad_sync.STRATEGIES
+    "comm/wire_dtype_idx",        # index into grad_sync.WIRE_DTYPES
     "comm/data_axis_size",
-    "comm/grad_sync_bytes",       # wire payload per device per step
+    "comm/grad_sync_bytes",       # full sync payload per device per step
+    "comm/wire_bytes",            # gradient-wire payload (dtype-scaled)
+    "comm/quant_error",           # int8 wire: measured relative-RMS error
     "comm/bucket_count",
     "comm/optimizer_state_bytes", # measured per-device opt-state HBM
     "comm/grad_sync_s",           # isolated sync+update time (bench A/B)

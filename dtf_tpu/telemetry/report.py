@@ -215,6 +215,12 @@ def render(report: dict, top: int = 10) -> str:
         idx = comm.pop("comm/strategy_idx", None)
         if idx is not None and 0 <= int(idx) < len(strategies):
             lines.append(f"  {'strategy':<28} {strategies[int(idx)]:>12}")
+        # mirror of grad_sync.WIRE_DTYPES (same jax-free pinning rule)
+        wire_dtypes = ("f32", "bf16", "int8")
+        widx = comm.pop("comm/wire_dtype_idx", None)
+        if widx is not None and 0 <= int(widx) < len(wire_dtypes):
+            lines.append(f"  {'wire dtype':<28} "
+                         f"{wire_dtypes[int(widx)]:>12}")
         for n in sorted(comm):
             lines.append(f"  {n:<28} {comm[n]:12.5g}")
     if "steps" in report:

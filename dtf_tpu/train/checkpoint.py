@@ -247,6 +247,26 @@ class CheckpointManager:
                     "optimizer state re-partitioned via the restore "
                     "template", step, saved_run["data_axis"],
                     cur_run["data_axis"])
+            def _wire(run):
+                # Canonicalize the manifest spelling ("bfloat16" and
+                # "float32" were valid flag inputs, and manifests written
+                # before the normalization recorded them raw) so alias
+                # spellings can't fake a wire-format change.
+                name = run.get("grad_comm_dtype")
+                return {"bfloat16": "bf16", "float32": "f32"}.get(name, name)
+
+            if (_wire(saved_run) != _wire(cur_run)
+                    and None not in (_wire(saved_run), _wire(cur_run))):
+                # Loud on purpose: the wire format changes the gradient
+                # rounding noise, so a post-mortem comparing loss curves
+                # across the restore needs this attribution line.
+                log.warning(
+                    "grad_comm_dtype restore: checkpoint step %d was "
+                    "trained on a %s gradient wire, resuming on %s — "
+                    "trajectory deltas past this point may be wire-format "
+                    "noise, not regressions", step,
+                    saved_run["grad_comm_dtype"],
+                    cur_run["grad_comm_dtype"])
 
     def verify(self, step: int) -> tuple[bool, str]:
         """Check a landed step against its manifest.  (True, reason) means

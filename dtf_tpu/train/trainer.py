@@ -181,7 +181,8 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     grads_fn: Optional[Callable] = None,
                     guard: bool = False,
                     grad_sync: Optional[Any] = None,
-                    grad_comm_dtype: Optional[str] = None) -> Callable:
+                    grad_comm_dtype: Optional[str] = None,
+                    quant_rounding: str = "nearest") -> Callable:
     """Build the compiled train step: (state, batch, rng) -> (state, metrics).
 
     ``guard=True`` adds the in-step non-finite guard (DESIGN.md §5): an
@@ -264,10 +265,17 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             raise ValueError("grad_comm_dtype and grad_compression='int8' "
                              "are both wire formats; pick one")
     # The engine owns its comm dtype (set at construction); the flag here
-    # only drives the dense explicit pmean path.
+    # only drives the dense explicit pmean path.  "int8" resolves to the
+    # block-scaled wire (parallel/quantize.py), not a cast.
     from dtf_tpu.parallel.grad_sync import comm_dtype_of
+    from dtf_tpu.parallel.quantize import check_rounding
     _dense_comm_dtype = (comm_dtype_of(grad_comm_dtype)
                          if grad_sync is None else None)
+    check_rounding(quant_rounding)
+    # Decorrelate quantization draws from the loss/dropout stream: the
+    # quant rng is a constant-salted fold of the (already per-device)
+    # step rng, and the microbatch/bucket indices fold in downstream.
+    _QSALT = 0x51_8008
 
     def value_and_grads(params, model_state, batch, rng):
         if stateful:
@@ -285,10 +293,13 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
     # overlap them (on TPU, arm --xla_overlap so it actually does).  The
     # accumulator then holds 1/N-size mean shards instead of full
     # gradients — N× less accumulator HBM as a side effect.
-    overlap_stage = (grad_sync.scatter
-                     if (grad_sync is not None
-                         and grad_sync.strategy == "zero1_overlap"
-                         and grad_accum > 1) else None)
+    overlap_stage = None
+    if (grad_sync is not None and grad_sync.strategy == "zero1_overlap"
+            and grad_accum > 1):
+        # (grads, mb_rng) -> mean shards; the per-microbatch rng seeds
+        # stochastic rounding so no two microbatches share a draw.
+        overlap_stage = lambda g, r: grad_sync.scatter(
+            g, jax.random.fold_in(r, _QSALT))
 
     def accumulated(step_of_mb, model_state, batch, rng):
         """THE grad-accumulation skeleton, shared by the value_and_grad
@@ -318,20 +329,20 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         def body(carry, inp):
             g_sum, l_sum, aux_sum, ms = carry
             i, mb = inp
-            loss, aux, new_ms, grads = step_of_mb(
-                ms, mb, jax.random.fold_in(rng, i))
+            mb_rng = jax.random.fold_in(rng, i)
+            loss, aux, new_ms, grads = step_of_mb(ms, mb, mb_rng)
             if overlap_stage is not None:
-                grads = overlap_stage(grads)
+                grads = overlap_stage(grads, mb_rng)
             g_sum = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), g_sum, grads)
             aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
             return (g_sum, l_sum + loss, aux_sum, new_ms), None
 
         first = jax.tree_util.tree_map(lambda x: x[0], micro)
-        loss0, aux0, ms0, grads0 = step_of_mb(
-            model_state, first, jax.random.fold_in(rng, 0))
+        rng0 = jax.random.fold_in(rng, 0)
+        loss0, aux0, ms0, grads0 = step_of_mb(model_state, first, rng0)
         if overlap_stage is not None:
-            grads0 = overlap_stage(grads0)
+            grads0 = overlap_stage(grads0, rng0)
         rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
         (g_sum, l_sum, aux_sum, ms), _ = lax.scan(
             body, (f32(grads0), loss0, aux0, ms0),
@@ -372,6 +383,7 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
             for g in jax.tree_util.tree_leaves(grads):
                 ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
         grads, loss, aux, new_ms, ok = sync(grads, loss, aux, new_ms, ok)
+        qerr = None
         if guard:
             if grad_sync is not None:
                 # zero1: the collectives are FUSED with the update
@@ -381,9 +393,10 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                 # old values.  A bad step pays the (wasted) comm, but bad
                 # steps are the rare path and the semantics match dense's
                 # skip exactly: params/opt state/model state pass through.
-                up_params, up_opt = grad_sync.sync_and_update(
+                up_params, up_opt, qerr = grad_sync.sync_and_update(
                     grads, opt_state, params,
-                    prescattered=overlap_stage is not None)
+                    prescattered=overlap_stage is not None,
+                    rng=jax.random.fold_in(rng, _QSALT))
                 sel = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(ok, a, b), new, old)
                 new_params = sel(up_params, params)
@@ -415,11 +428,14 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                 new_state["model_state"] = kept_ms
             metrics = {"loss": loss, "nonfinite": bad,
                        "skipped_total": skipped, "bad_streak": streak, **aux}
+            if qerr is not None:
+                metrics["quant_error"] = qerr
             return new_state, metrics
         if grad_sync is not None:
-            params, opt_state = grad_sync.sync_and_update(
+            params, opt_state, qerr = grad_sync.sync_and_update(
                 grads, opt_state, params,
-                prescattered=overlap_stage is not None)
+                prescattered=overlap_stage is not None,
+                rng=jax.random.fold_in(rng, _QSALT))
         else:
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optim_lib.apply_updates(params, updates)
@@ -427,6 +443,8 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
         if stateful:
             new_state["model_state"] = new_ms
         metrics = {"loss": loss, **aux}
+        if qerr is not None:
+            metrics["quant_error"] = qerr
         return new_state, metrics
 
     if mode == "implicit":
@@ -482,6 +500,20 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     g = jax.tree_util.tree_map(
                         lambda v: quantized_ring_all_reduce_mean(
                             v, data_axes[0]), grads)
+                elif _dense_comm_dtype == "int8":
+                    # Block-scaled int8 wire for the DENSE strategy
+                    # (parallel/quantize.py): quantized reduce-scatter +
+                    # quantized all-gather over the whole flattened tree,
+                    # mean-preserving 1/N pre-scale, two roundings per
+                    # value.  The local encode error psums into the
+                    # replica-uniform quant_error metric.
+                    from dtf_tpu.parallel import quantize as qz
+                    g, qe = qz.all_reduce_mean_quantized(
+                        grads, data_axes[0], rounding=quant_rounding,
+                        rng=jax.random.fold_in(rng, _QSALT))
+                    aux = dict(aux)
+                    aux["quant_error"] = qz.error_ratio(
+                        lax.psum(qe, data_axes[0]))
                 elif _dense_comm_dtype is not None:
                     # Reduced-precision wire for the dense strategy:
                     # psum of (g/N).astype(bf16) — the 1/N pre-scaling is
@@ -677,7 +709,8 @@ class Trainer:
             self._grad_sync_engine = GradSyncEngine(
                 self.cfg.grad_sync, self.optimizer, mesh,
                 bucket_mb=self.cfg.grad_bucket_mb,
-                comm_dtype=self.cfg.grad_comm_dtype)
+                comm_dtype=self.cfg.grad_comm_dtype,
+                quant_rounding=self.cfg.quant_rounding)
             self._grad_sync_engine.prepare(
                 jax.eval_shape(self.model.init,
                                jax.random.key(self.cfg.seed)))
@@ -699,7 +732,8 @@ class Trainer:
                                        grads_fn=grads_fn,
                                        guard=self._guarded,
                                        grad_sync=self._grad_sync_engine,
-                                       grad_comm_dtype=self.cfg.grad_comm_dtype)
+                                       grad_comm_dtype=self.cfg.grad_comm_dtype,
+                                       quant_rounding=self.cfg.quant_rounding)
         self.eval_fn = make_eval_fn(self.model, mesh, stateful=stateful)
         # Parameter placement from the model's logical axes: FSDP when the
         # mesh has an 'fsdp' axis, tensor/expert/... sharding per the rule
@@ -722,22 +756,48 @@ class Trainer:
         # strategy, the data-axis width, the measured per-device optimizer-
         # state footprint (off the real arrays — the zero1 memory claim is
         # checked, not asserted), and the engine's static wire facts.
-        from dtf_tpu.parallel.grad_sync import (STRATEGIES,
-                                                opt_state_bytes_per_device)
+        from dtf_tpu.parallel.grad_sync import (STRATEGIES, WIRE_DTYPES,
+                                                comm_dtype_of,
+                                                opt_state_bytes_per_device,
+                                                wire_bytes_per_elem,
+                                                wire_dtype_name)
         tel.gauge("comm/strategy_idx").set(
             STRATEGIES.index(self.cfg.grad_sync))
+        tel.gauge("comm/wire_dtype_idx").set(WIRE_DTYPES.index(
+            wire_dtype_name(comm_dtype_of(self.cfg.grad_comm_dtype))))
         tel.gauge("comm/data_axis_size").set(sh.data_axis_size(mesh))
         tel.gauge("comm/optimizer_state_bytes").set(
             opt_state_bytes_per_device(self.state["opt_state"]))
         if self._grad_sync_engine is not None:
             stats = self._grad_sync_engine.comm_stats(self.cfg.grad_accum)
             tel.gauge("comm/grad_sync_bytes").set(stats["grad_sync_bytes"])
+            tel.gauge("comm/wire_bytes").set(stats["wire_bytes"])
             tel.gauge("comm/bucket_count").set(stats["bucket_count"])
         else:
-            # Dense: the pmean payload is the full gradient tree.
-            tel.gauge("comm/grad_sync_bytes").set(float(sum(
-                np.prod(l.shape) * jnp.dtype(l.dtype).itemsize
-                for l in jax.tree_util.tree_leaves(self.state["params"]))))
+            # Dense: the pmean/all-reduce payload is the full gradient
+            # tree at the wire format's bytes-per-element.
+            n_elems = int(sum(
+                np.prod(l.shape)
+                for l in jax.tree_util.tree_leaves(self.state["params"])))
+            resolved = comm_dtype_of(self.cfg.grad_comm_dtype)
+            if resolved == "int8":
+                # all_reduce_mean_quantized ships TWO quantized legs
+                # (reduce-scatter + all-gather), each with per-chunk
+                # block round-up — mirror zero1's split: wire_bytes is
+                # the gradient scatter leg, grad_sync_bytes adds the
+                # gather leg (here quantized too, unlike zero1's f32
+                # param gather).
+                from dtf_tpu.parallel import quantize as qz
+                n_dev = sh.data_axis_size(mesh)
+                flat = -(-n_elems // n_dev) * n_dev   # _flatten_tree pad
+                leg = float(qz.wire_elems(flat, n_dev)
+                            * qz.WIRE_BYTES_PER_ELEM["int8"])
+                tel.gauge("comm/grad_sync_bytes").set(2.0 * leg)
+                tel.gauge("comm/wire_bytes").set(leg)
+            else:
+                wire = float(n_elems) * wire_bytes_per_elem(resolved)
+                tel.gauge("comm/grad_sync_bytes").set(wire)
+                tel.gauge("comm/wire_bytes").set(wire)
             tel.gauge("comm/bucket_count").set(0)
         # Model-structure graph to TensorBoard, once at startup — the
         # reference's writer.add_graph (tf_distributed.py:97).
@@ -749,16 +809,27 @@ class Trainer:
         self.ckpt = None
         if self.cfg.checkpoint_every > 0 or self.cfg.resume:
             from dtf_tpu.train.checkpoint import CheckpointManager
+            from dtf_tpu.parallel.grad_sync import (comm_dtype_of,
+                                                    wire_dtype_name)
             self.ckpt = CheckpointManager(
                 f"{self.cfg.logdir}/checkpoints",
                 # Manifests record the weight-update strategy, data-axis
-                # width AND bucket size so restore_robust can see (and
-                # log) a dense<->zero1 or elastic reshard — and so a
-                # cross-strategy restore can rebuild the WRITER's bucket
-                # layout, not assume this run's.
+                # width, bucket size AND gradient wire format so
+                # restore_robust can see (and log) a dense<->zero1,
+                # elastic, or wire-dtype change — post-mortems attribute
+                # trajectory deltas to the wire — and so a cross-strategy
+                # restore can rebuild the WRITER's bucket layout.  The
+                # wire format does NOT affect that layout (block padding
+                # lives inside the collective); it is recorded purely for
+                # attribution.
                 run_meta={"grad_sync": self.cfg.grad_sync,
                           "data_axis": sh.data_axis_size(mesh),
-                          "grad_bucket_mb": self.cfg.grad_bucket_mb})
+                          "grad_bucket_mb": self.cfg.grad_bucket_mb,
+                          # canonical spelling ("f32"|"bf16"|"int8"), so
+                          # "bfloat16" vs "bf16" can't fake a wire change
+                          # in the restore warning
+                          "grad_comm_dtype": wire_dtype_name(
+                              comm_dtype_of(self.cfg.grad_comm_dtype))})
             if self.cfg.resume:
                 with tracker.measure("checkpoint"):
                     if self._chaos is not None:
@@ -897,7 +968,12 @@ class Trainer:
         saved_mb = run.get("grad_bucket_mb", self.cfg.grad_bucket_mb)
         if saved_dense == cur_dense and (
                 saved_dense or saved_mb == self.cfg.grad_bucket_mb):
-            return None                # same layout: not our mismatch
+            # Same layout: not our mismatch.  (A --grad_comm_dtype change
+            # is NOT a layout change — block alignment for the int8 wire
+            # lives inside the collective, so checkpoints restore across
+            # wire dtypes through the ordinary template; restore_robust
+            # logs the wire change for trajectory attribution.)
+            return None
         mesh = self.cluster.mesh
 
         def writer_engine():
@@ -1410,6 +1486,18 @@ class Trainer:
                         # contract (metrics already on disk if the next
                         # instant is a SIGKILL).
                         tel.gauge("train/steps_total").set(step)
+                        if "quant_error" in metrics:
+                            # int8 wire: measured relative-RMS encode
+                            # error of this step's gradients (already
+                            # psum'd replica-uniform in the step).  A
+                            # guard-skipped step's error pair is NaN by
+                            # design (non-finite scale) — keep it out of
+                            # the gauge so telemetry.json stays strict
+                            # JSON and the last value reflects a real
+                            # step.
+                            qe = float(metrics["quant_error"])
+                            if np.isfinite(qe):
+                                tel.gauge("comm/quant_error").set(qe)
                         if avg_ms > 0:
                             tel.goodput.record_throughput(
                                 examples_per_s=bs * 1000.0 / avg_ms,

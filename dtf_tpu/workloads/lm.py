@@ -67,6 +67,14 @@ def main(argv=None) -> int:
                         default="auto",
                         help="inner attention: pallas flash kernel vs XLA "
                              "softmax attention (auto = flash on TPU)")
+    parser.add_argument("--matmul_dtype",
+                        choices=["fp32", "bf16", "int8", "fp8"],
+                        default="fp32",
+                        help="training-forward compute format for the "
+                             "block projections (nn/lowp.py): int8/fp8 "
+                             "quantize per channel with a straight-"
+                             "through backward; quality-gate with "
+                             "bench.int8_quality --trajectory")
     parser.add_argument("--fused_block", action="store_true",
                         help="run each decoder block as two fused Pallas "
                              "megakernels (attention + MLP halves; "
@@ -128,7 +136,8 @@ def main(argv=None) -> int:
           "remat": ns.remat, "remat_policy": ns.remat_policy,
           "layer_loop": ns.layer_loop, "fused_block": ns.fused_block,
           "label_smoothing": ns.label_smoothing,
-          "loss_chunk": ns.loss_chunk}
+          "loss_chunk": ns.loss_chunk,
+          "matmul_dtype": ns.matmul_dtype}
     if ns.attn != "auto":
         kw["use_flash"] = ns.attn == "flash"
     if ns.seq_len:

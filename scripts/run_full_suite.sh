@@ -166,5 +166,80 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: grad-sync lane assertions (rc=$rc)"; }
   rm -rf "$sdir"
 fi
+# Quantized-comm lane (DESIGN.md §4.2): 3-way wire-dtype A/B
+# (f32/bf16/int8) on the simulated 8-device mesh — same seed, same
+# batches, zero1 — asserting the int8 wire-bytes drop (~4x vs f32,
+# ~2x vs bf16 from the comm/wire_bytes gauge), loss trajectories within
+# tolerance of the exact wire, and the quant-error gauge present; then
+# a chaos'd zero1+int8 run whose report must render the wire dtype in
+# the Gradient sync section.  Skip with NO_QUANTCOMM_LANE=1.
+if [ "${NO_QUANTCOMM_LANE:-0}" != "1" ]; then
+  echo "=== quantized-comm lane (f32/bf16/int8 wire A/B + chaos'd int8 run) ==="
+  qdir=$(mktemp -d)
+  for wire in f32 bf16 int8; do
+    JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+        --epochs 1 --batch_size 512 --init fan_in --log_frequency 20 \
+        --optimizer adam --learning_rate 1e-3 \
+        --grad_sync zero1 --grad_bucket_mb 0.1 --simulated_devices 8 \
+        --grad_comm_dtype "$wire" \
+        --logdir "$qdir/$wire" > "$qdir/$wire.log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: quant-comm $wire run (rc=$rc)"; tail -5 "$qdir/$wire.log"; }
+  done
+  # Chaos'd supervised zero1+int8 run: nan_grad exercises the pre-sync
+  # guard under the quantized wire (a NaN must be skipped, not laundered
+  # into finite garbage), sigterm+restart exercises resume with the wire
+  # format recorded in the manifest.
+  JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+      --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+      --optimizer adam --learning_rate 1e-3 \
+      --grad_sync zero1 --grad_bucket_mb 0.1 --simulated_devices 8 \
+      --grad_comm_dtype int8 --quant_rounding stochastic \
+      --logdir "$qdir/chaos" --checkpoint_every 5 --max_restarts 2 \
+      --chaos "nan_grad@4,sigterm@11" > "$qdir/chaos.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: quant-comm chaos run (rc=$rc)"; tail -5 "$qdir/chaos.log"; }
+  python -m dtf_tpu.telemetry.report "$qdir/chaos" | tee "$qdir/report.log" > /dev/null
+  grep -q "Gradient sync" "$qdir/report.log" \
+    && grep -q "int8" "$qdir/report.log" \
+    && grep -q "comm/wire_bytes" "$qdir/report.log" \
+    && grep -q "comm/quant_error" "$qdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing int8 wire section"; }
+  python - "$qdir" <<'PYEOF'
+import csv, json, os, sys
+d = sys.argv[1]
+def costs(run):
+    out = {}
+    with open(os.path.join(d, run, "metrics.csv"), newline="") as f:
+        for rec in csv.reader(f):
+            if rec and rec[0] != "step" and rec[1] == "cost":
+                out[int(rec[0])] = float(rec[2])
+    return out
+def gauge(run, name):
+    doc = json.load(open(os.path.join(d, run, "telemetry.json")))
+    m = doc["metrics"].get(name)
+    return None if m is None else m["value"]
+f32, bf16, i8 = costs("f32"), costs("bf16"), costs("int8")
+steps = sorted(set(f32) & set(bf16) & set(i8))
+assert steps, "no common cost steps across the wire A/B runs"
+for s in steps:
+    for name, c in (("bf16", bf16[s]), ("int8", i8[s])):
+        assert abs(c - f32[s]) <= 0.02 * abs(f32[s]) + 1e-3, \
+            f"{name} wire diverged from f32 at step {s}: {c} vs {f32[s]}"
+w = {r: gauge(r, "comm/wire_bytes") for r in ("f32", "bf16", "int8")}
+assert w["int8"] <= 0.30 * w["f32"], f"int8 wire not ~4x below f32: {w}"
+assert w["int8"] <= 0.55 * w["bf16"], f"int8 wire not ~2x below bf16: {w}"
+qe = gauge("int8", "comm/quant_error")
+assert qe is not None and 0 < qe < 0.1, f"quant error gauge off: {qe}"
+assert gauge("chaos", "comm/wire_dtype_idx") == 2     # int8
+print(f"quantized-comm lane OK: {len(steps)} cost points within "
+      f"tolerance; wire bytes f32 {w['f32']:.0f} -> bf16 {w['bf16']:.0f} "
+      f"-> int8 {w['int8']:.0f} ({w['int8']/w['f32']:.2f}x of f32, "
+      f"{w['int8']/w['bf16']:.2f}x of bf16); quant error rms {qe:.1e}")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: quantized-comm lane assertions (rc=$rc)"; }
+  rm -rf "$qdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

@@ -328,6 +328,24 @@ class TestShardedDecode:
                                                   temperature=0.0))(sp, pr)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    def test_generate_tp_mesh_matches_single_int8(self, mesh_2d):
+        """The int8 decode pack uses the same concat-free q + stacked-kv
+        layout as f32, so TP-sharded params must decode identically to
+        the single-device int8 run (the concat-along-sharded-dim
+        miscompile is unreachable from either pack)."""
+        model = GPT(GPTConfig.tiny())
+        params = model.init(jax.random.key(0))
+        prompt = jnp.asarray(
+            np.random.default_rng(9).integers(0, 128, (4, 8)), jnp.int32)
+        ref = model.generate(params, prompt, 10, temperature=0.0,
+                             int8_weights=True)
+        sp = self._sharded(model, params, mesh_2d)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pr = jax.device_put(prompt, NamedSharding(mesh_2d, P("data", None)))
+        out = jax.jit(lambda p, t: model.generate(
+            p, t, 10, temperature=0.0, int8_weights=True))(sp, pr)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
     def test_beam_tp_mesh_matches_single(self, mesh_2d):
         model = GPT(GPTConfig.tiny())
         params = model.init(jax.random.key(0))

@@ -388,7 +388,37 @@ class TestTrainerIntegration:
         t.ckpt.close()
         meta = t.ckpt.manifest_meta(t.ckpt.latest_step())
         assert meta["run"] == {"grad_sync": "zero1", "data_axis": 8,
-                               "grad_bucket_mb": 0.1}
+                               "grad_bucket_mb": 0.1,
+                               "grad_comm_dtype": "f32"}
+
+    def test_wire_dtype_change_logged_on_restore(self, mesh8, tmp_path,
+                                                 caplog):
+        """ISSUE 6 satellite: the manifest records grad_comm_dtype and a
+        resume under a DIFFERENT wire format logs the attribution line
+        (post-mortems need to tell wire noise from regressions)."""
+        from dtf_tpu.data import load_mnist
+
+        t = make_trainer(mesh8, tmp_path / "run", "zero1")
+        t.fit(load_mnist(seed=1), epochs=1, max_steps=2)
+        t.ckpt.close()
+        meta = t.ckpt.manifest_meta(t.ckpt.latest_step())
+        assert meta["run"]["grad_comm_dtype"] == "f32"
+
+        tel.reset()
+        cfg = TrainConfig(batch_size=64, learning_rate=1e-3, epochs=1,
+                          log_frequency=20, seed=1,
+                          logdir=str(tmp_path / "run"),
+                          checkpoint_every=2, resume=True,
+                          grad_sync="zero1", grad_bucket_mb=0.1,
+                          grad_comm_dtype="int8", optimizer="adam")
+        cluster = Cluster(config=ClusterConfig(), mesh=mesh8)
+        with caplog.at_level(logging.WARNING, logger="dtf_tpu"):
+            t2 = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                         optim.adam(1e-3), cfg)
+        assert t2._host_step == 2      # same layout: ordinary restore
+        assert any("grad_comm_dtype" in r.message and "f32" in r.message
+                   for r in caplog.records)
+        t2.ckpt.close()
 
 
 class TestCrossStrategyRestore:

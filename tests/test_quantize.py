@@ -1,0 +1,472 @@
+"""Block-scaled int8 wire format (parallel/quantize.py) + low-precision
+compute paths (nn/lowp.py): encode/decode round-trip bounds, mean
+preservation under reduce, stochastic-rounding unbiasedness, non-finite
+edge handling feeding the guard, wire-byte accounting, and the
+straight-through matmul paths (ISSUE 6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu import optim
+from dtf_tpu.models.mlp import MnistMLP
+from dtf_tpu.parallel import quantize as qz
+from dtf_tpu.parallel.collectives import shard_map_fn
+from dtf_tpu.parallel.grad_sync import (GradSyncEngine, WIRE_DTYPES,
+                                        comm_dtype_of, wire_bytes_per_elem,
+                                        wire_dtype_name)
+from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                   put_global_batch)
+
+
+def mlp_batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((n, 784)).astype(np.float32),
+            np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)])
+
+
+def make_engine(strategy, opt, mesh, **kw):
+    model = MnistMLP(init_scale="fan_in")
+    return GradSyncEngine(strategy, opt, mesh, **kw).prepare(
+        jax.eval_shape(model.init, jax.random.key(1)))
+
+
+class TestEncodeDecode:
+    def test_roundtrip_error_bounded_by_block_scale(self):
+        """Nearest rounding: |decode - v| <= scale/2 per element, where
+        scale is the element's OWN block's max/127 — the per-block
+        granularity claim (a big block elsewhere must not hurt)."""
+        rng = np.random.default_rng(0)
+        v = rng.normal(size=(4 * qz.QBLOCK,)).astype(np.float32)
+        v[qz.QBLOCK:2 * qz.QBLOCK] *= 1000.0     # one heavy block
+        q, s = qz.encode(jnp.asarray(v))
+        back = np.asarray(qz.decode(q, s))
+        scales = np.repeat(np.asarray(s).reshape(-1), qz.QBLOCK)
+        assert np.all(np.abs(back - v) <= scales / 2 + 1e-12)
+        # the heavy block must NOT inflate its neighbors' error
+        light = slice(0, qz.QBLOCK)
+        assert np.abs(back[light] - v[light]).max() < np.abs(v[light]).max() / 200
+
+    def test_relative_rms_error_small_on_gaussian(self):
+        rng = np.random.default_rng(1)
+        v = jnp.asarray(rng.normal(size=(16 * qz.QBLOCK,)), jnp.float32)
+        err = float(qz.error_ratio(qz.encode_error(v)))
+        assert 1e-4 < err < 0.02      # ~1e-2 for N(0,1) at 8 bits/block
+
+    def test_zero_block_exact(self):
+        v = jnp.zeros((qz.QBLOCK,), jnp.float32)
+        back = qz.decode(*qz.encode(v))
+        np.testing.assert_array_equal(np.asarray(back), np.zeros(qz.QBLOCK))
+
+    @pytest.mark.parametrize("bad", [np.nan, np.inf, -np.inf])
+    def test_nonfinite_never_becomes_finite_garbage(self, bad):
+        """A NaN/inf in a block must decode to non-finite values — the
+        wire can never launder a poisoned gradient into numbers the
+        guard would wave through."""
+        v = np.ones((qz.QBLOCK,), np.float32)
+        v[7] = bad
+        back = np.asarray(qz.decode(*qz.encode(jnp.asarray(v))))
+        assert not np.isfinite(back).all()
+
+    def test_misaligned_length_rejected_and_pad_helper(self):
+        with pytest.raises(ValueError, match="QBLOCK"):
+            qz.encode(jnp.ones((qz.QBLOCK + 1,)))
+        padded = qz.pad_to_blocks(jnp.ones((qz.QBLOCK + 1,)))
+        assert padded.shape[0] == 2 * qz.QBLOCK
+        assert float(padded[qz.QBLOCK + 1:].sum()) == 0.0
+
+
+class TestStochasticRounding:
+    def test_unbiased_over_repeated_draws(self):
+        """E[decode(encode(v, stochastic))] -> v: the mean over many
+        seeds converges to the input (the property that lets quantized
+        gradient noise average out across steps)."""
+        rng = np.random.default_rng(2)
+        v = jnp.asarray(rng.normal(size=(qz.QBLOCK,)), jnp.float32)
+        draws = 400
+
+        @jax.jit
+        def one(key):
+            return qz.decode(*qz.encode(v, "stochastic", key))
+
+        total = np.zeros(qz.QBLOCK, np.float64)
+        for i in range(draws):
+            total += np.asarray(one(jax.random.key(i)), np.float64)
+        mean = total / draws
+        scale = float(jnp.max(jnp.abs(v))) / 127.0
+        # std of one draw <= scale; mean of 400 draws ~ scale/20
+        assert np.abs(mean - np.asarray(v, np.float64)).max() < scale / 4
+
+    def test_nearest_is_deterministic_stochastic_keyed(self):
+        v = jnp.asarray(np.random.default_rng(3).normal(size=(qz.QBLOCK,)),
+                        jnp.float32)
+        a = qz.decode(*qz.encode(v))
+        b = qz.decode(*qz.encode(v))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        s1 = qz.decode(*qz.encode(v, "stochastic", jax.random.key(0)))
+        s2 = qz.decode(*qz.encode(v, "stochastic", jax.random.key(0)))
+        s3 = qz.decode(*qz.encode(v, "stochastic", jax.random.key(1)))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        assert np.abs(np.asarray(s1) - np.asarray(s3)).max() > 0
+
+    def test_stochastic_requires_rng(self):
+        with pytest.raises(ValueError, match="rng"):
+            qz.encode(jnp.ones((qz.QBLOCK,)), "stochastic")
+
+    def test_bad_rounding_rejected(self):
+        with pytest.raises(ValueError, match="quant_rounding"):
+            qz.check_rounding("banker")
+
+
+class TestQuantizedCollectives:
+    def test_reduce_scatter_sum_matches_dense_mean(self, mesh8):
+        """The mean-preservation property: each device ships its (g_i/N)
+        quantized; the summed shards must reassemble to the dense mean
+        within the per-block quantization bound."""
+        n = 8
+        length = n * 1000              # NOT a QBLOCK multiple: chunk pad
+        rng = np.random.default_rng(4)
+        locals_ = rng.normal(size=(n, length)).astype(np.float32)
+        dense_mean = locals_.mean(axis=0)
+
+        def f(vs):
+            shard = qz.reduce_scatter_quantized(vs[0] * (1.0 / n), "data")
+            return qz.all_gather_quantized(shard, "data")[None]
+
+        out = np.asarray(shard_map_fn(
+            f, mesh=mesh8, in_specs=P("data"),
+            out_specs=P("data"))(locals_))
+        for row in out:                # replica-identical by construction
+            np.testing.assert_array_equal(row, out[0])
+        tol = np.abs(locals_).max() / 127.0 * 2   # one rounding per leg
+        np.testing.assert_allclose(out[0], dense_mean, atol=tol)
+
+    def test_indivisible_length_rejected(self, mesh8):
+        def f(v):
+            return qz.reduce_scatter_quantized(v, "data")[None]
+        with pytest.raises(ValueError, match="divisible"):
+            shard_map_fn(f, mesh=mesh8, in_specs=P("data"),
+                         out_specs=P("data"))(np.ones((8, 12), np.float32))
+
+    def test_all_reduce_mean_quantized_tree(self, mesh8):
+        """The dense-path helper: pytree in, replica-identical mean tree
+        out, error pair populated."""
+        rng = np.random.default_rng(5)
+        tree = {"w": rng.normal(size=(8, 37, 5)).astype(np.float32),
+                "b": rng.normal(size=(8, 11)).astype(np.float32)}
+
+        def f(t):
+            out, err = qz.all_reduce_mean_quantized(
+                {"w": t["w"][0], "b": t["b"][0]}, "data")
+            return {"w": out["w"][None], "b": out["b"][None]}, err[None]
+
+        got, err = shard_map_fn(
+            f, mesh=mesh8, in_specs=({"w": P("data"), "b": P("data")},),
+            out_specs=({"w": P("data"), "b": P("data")}, P("data")))(tree)
+        for k in ("w", "b"):
+            ref = tree[k].mean(axis=0)
+            tol = np.abs(tree[k]).max() / 127.0 * 2
+            for row in np.asarray(got[k]):
+                np.testing.assert_allclose(row, ref, atol=tol)
+        assert np.asarray(err).sum() > 0
+
+    def test_wire_elems_accounting(self):
+        # 8 chunks of 1000 -> each pads to 4*QBLOCK=1024
+        assert qz.wire_elems(8000, 8) == 8 * 1024
+        # exact multiples pay zero padding
+        assert qz.wire_elems(8 * qz.QBLOCK, 8) == 8 * qz.QBLOCK
+
+
+class TestWireDtypePlumbing:
+    def test_wire_dtype_resolution_and_bytes(self):
+        assert WIRE_DTYPES == ("f32", "bf16", "int8")
+        assert comm_dtype_of("int8") == "int8"
+        assert wire_dtype_name(comm_dtype_of("int8")) == "int8"
+        assert wire_dtype_name(comm_dtype_of("bf16")) == "bf16"
+        assert wire_dtype_name(comm_dtype_of(None)) == "f32"
+        ratio = (wire_bytes_per_elem("int8")
+                 / wire_bytes_per_elem(jnp.bfloat16))
+        assert ratio <= 0.55           # the ISSUE acceptance bound
+
+    def test_report_wire_literal_pinned(self):
+        """telemetry/report.py carries a jax-free literal mirror of
+        WIRE_DTYPES; pin it (same rule as the STRATEGIES mirror)."""
+        import inspect
+
+        from dtf_tpu.telemetry import report
+        assert '("f32", "bf16", "int8")' in inspect.getsource(report.render)
+
+    def test_config_accepts_int8_and_rounding(self):
+        from dtf_tpu.config import TrainConfig
+        TrainConfig(grad_comm_dtype="int8", quant_rounding="stochastic")
+        with pytest.raises(ValueError, match="quant_rounding"):
+            TrainConfig(quant_rounding="up")
+        with pytest.raises(ValueError, match="grad_comm_dtype"):
+            TrainConfig(grad_comm_dtype="int4")
+        # stochastic without the int8 wire would be silently inert — the
+        # bf16/f32 wires have no quantizer — so it is rejected loud.
+        with pytest.raises(ValueError, match="stochastic"):
+            TrainConfig(grad_comm_dtype="bf16",
+                        quant_rounding="stochastic")
+        with pytest.raises(ValueError, match="stochastic"):
+            TrainConfig(quant_rounding="stochastic")
+
+    def test_engine_wire_stats_ratios(self, mesh8):
+        """comm_stats at equal bucket layout: int8 wire <= 0.55x bf16 and
+        <= 0.28x f32 (the ~2x / ~4x claims with chunk-padding slack)."""
+        opt = optim.adam(1e-3)
+        stats = {}
+        layouts = {}
+        for cd in (None, "bf16", "int8"):
+            eng = make_engine("zero1", opt, mesh8, bucket_mb=0.1,
+                              comm_dtype=cd)
+            stats[cd] = eng.comm_stats(1)
+            layouts[cd] = eng.layout.padded
+        assert layouts[None] == layouts["bf16"] == layouts["int8"]
+        assert (stats["int8"]["wire_bytes"]
+                <= 0.55 * stats["bf16"]["wire_bytes"])
+        assert (stats["int8"]["wire_bytes"]
+                <= 0.28 * stats[None]["wire_bytes"])
+        # grad_sync_bytes adds the f32 param all-gather for all three
+        for cd in stats:
+            assert (stats[cd]["grad_sync_bytes"]
+                    > stats[cd]["wire_bytes"])
+
+
+class TestInt8WireTraining:
+    @pytest.mark.parametrize("strat", ["dense", "zero1"])
+    def test_trajectory_close_to_exact(self, mesh8, strat):
+        """3 steps of MNIST, int8 wire vs exact f32: params within the
+        quantization tolerance (same bound class as the bf16 wire test
+        in test_grad_sync.py)."""
+        batch = mlp_batch()
+        model = MnistMLP(init_scale="fan_in")
+        out = {}
+        for cd in (None, "int8"):
+            opt = optim.adam(1e-3)
+            eng = (make_engine(strat, opt, mesh8, bucket_mb=0.1,
+                               comm_dtype=cd)
+                   if strat != "dense" else None)
+            state = init_state(model, opt, seed=1, mesh=mesh8,
+                               grad_sync=eng)
+            step = make_train_step(model.loss, opt, mesh8,
+                                   mode="explicit", donate=False,
+                                   grad_sync=eng,
+                                   grad_comm_dtype=cd if eng is None
+                                   else None)
+            b = put_global_batch(mesh8, batch)
+            for i in range(3):
+                state, m = step(state, b, jax.random.key(i))
+            out[cd] = (state["params"], m)
+        for la, lb in zip(jax.tree_util.tree_leaves(out[None][0]),
+                          jax.tree_util.tree_leaves(out["int8"][0])):
+            # Wider than the bf16-wire bound: 8-bit block scales are a
+            # coarser lattice, and Adam's rsqrt(v) amplifies noise on
+            # near-zero entries.
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=5e-2, atol=5e-3)
+        assert 0 < float(out["int8"][1]["quant_error"]) < 0.1
+        assert "quant_error" not in out[None][1]
+
+    def test_stochastic_rounding_reproducible_trajectory(self, mesh8):
+        """Same seed -> bitwise-identical params across two stochastic
+        int8 runs (draws derive from the step rng); a different seed
+        moves them."""
+        batch = mlp_batch()
+        model = MnistMLP(init_scale="fan_in")
+
+        def train(rng_seed):
+            opt = optim.adam(1e-3)
+            eng = make_engine("zero1", opt, mesh8, bucket_mb=0.1,
+                              comm_dtype="int8",
+                              quant_rounding="stochastic")
+            state = init_state(model, opt, seed=1, mesh=mesh8,
+                               grad_sync=eng)
+            step = make_train_step(model.loss, opt, mesh8,
+                                   mode="explicit", donate=False,
+                                   grad_sync=eng,
+                                   quant_rounding="stochastic")
+            b = put_global_batch(mesh8, batch)
+            for i in range(2):
+                state, _ = step(state, b, jax.random.key(i + rng_seed))
+            return state["params"]
+
+        a, b_, c = train(0), train(0), train(100)
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b_)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        deltas = [float(jnp.abs(x - y).max()) for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(c))]
+        assert max(deltas) > 0
+
+    def test_guard_skips_poisoned_step_on_int8_wire(self, mesh8):
+        """The satellite's guard hook: NaNs in the batch under the int8
+        wire — the PRE-sync isfinite verdict skips the step (params and
+        sharded opt state untouched) even though the wire itself would
+        have decoded the NaN block to NaN anyway."""
+        opt = optim.adam(1e-3)
+        model = MnistMLP(init_scale="fan_in")
+        eng = make_engine("zero1", opt, mesh8, bucket_mb=0.1,
+                          comm_dtype="int8")
+        state = init_state(model, opt, seed=1, mesh=mesh8, guard=True,
+                           grad_sync=eng)
+        step = make_train_step(model.loss, opt, mesh8, mode="explicit",
+                               donate=False, guard=True, grad_sync=eng)
+        x, y = mlp_batch()
+        x[3, 5] = np.nan
+        new, m = step(state, put_global_batch(mesh8, (x, y)),
+                      jax.random.key(0))
+        assert int(m["nonfinite"]) == 1
+        assert int(new["skipped"]) == 1
+        for la, lb in zip(jax.tree_util.tree_leaves(state["params"]),
+                          jax.tree_util.tree_leaves(new["params"])):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_overlap_int8_composes_with_grad_accum(self, mesh8):
+        """zero1_overlap + int8 wire + grad_accum: per-microbatch
+        quantized scatters accumulate to a trajectory near the exact
+        accumulated step."""
+        batch = mlp_batch()
+        model = MnistMLP(init_scale="fan_in")
+        out = {}
+        for cd in (None, "int8"):
+            opt = optim.adam(1e-3)
+            eng = make_engine("zero1_overlap", opt, mesh8, bucket_mb=0.1,
+                              comm_dtype=cd)
+            state = init_state(model, opt, seed=1, mesh=mesh8,
+                               grad_sync=eng)
+            step = make_train_step(model.loss, opt, mesh8,
+                                   mode="explicit", donate=False,
+                                   grad_sync=eng, grad_accum=4)
+            state, m = step(state, put_global_batch(mesh8, batch),
+                            jax.random.key(0))
+            out[cd] = (state["params"], m)
+        for la, lb in zip(jax.tree_util.tree_leaves(out[None][0]),
+                          jax.tree_util.tree_leaves(out["int8"][0])):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=5e-2, atol=5e-3)
+        assert float(out["int8"][1]["quant_error"]) > 0
+
+
+class TestLowPrecisionMatmul:
+    def _xw(self, m=24, k=48, n=32, seed=0):
+        rng = np.random.default_rng(seed)
+        return (jnp.asarray(rng.normal(size=(4, m, k)), jnp.float32),
+                jnp.asarray(rng.normal(size=(k, n)), jnp.float32))
+
+    @pytest.mark.parametrize("dt,tol", [("bf16", 0.02), ("int8", 0.03),
+                                        ("fp8", 0.08)])
+    def test_forward_close_to_fp32(self, dt, tol):
+        from dtf_tpu.nn.lowp import lowp_matmul
+        x, w = self._xw()
+        y0, y = x @ w, lowp_matmul(x, w, dt)
+        rel = float(jnp.linalg.norm(y - y0) / jnp.linalg.norm(y0))
+        assert rel < tol
+
+    def test_per_channel_scale_tames_outlier_column(self):
+        """One huge output channel must not destroy the others' precision
+        — the reason the scales are per channel, not per tensor."""
+        from dtf_tpu.nn.lowp import lowp_matmul
+        x, w = self._xw()
+        w = w.at[:, 3].mul(1000.0)
+        y0, y = x @ w, lowp_matmul(x, w, "int8")
+        others = jnp.delete(jnp.arange(w.shape[1]), 3)
+        rel = float(jnp.linalg.norm(y[..., others] - y0[..., others])
+                    / jnp.linalg.norm(y0[..., others]))
+        assert rel < 0.03
+
+    @pytest.mark.parametrize("dt", ["int8", "fp8"])
+    def test_straight_through_gradients(self, dt):
+        """round() has zero gradient; the STE backward must deliver the
+        fp32 matmul's gradients (else training silently stalls)."""
+        from dtf_tpu.nn.lowp import lowp_matmul
+        x, w = self._xw()
+        g = jax.grad(lambda w_: jnp.sum(lowp_matmul(x, w_, dt) ** 2))(w)
+        g0 = jax.grad(lambda w_: jnp.sum((x @ w_) ** 2))(w)
+        rel = float(jnp.linalg.norm(g - g0) / jnp.linalg.norm(g0))
+        assert rel < 0.05
+        assert float(jnp.abs(g).max()) > 0
+
+    def test_unknown_dtype_rejected(self):
+        from dtf_tpu.nn.lowp import lowp_matmul
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            lowp_matmul(jnp.ones((2, 4)), jnp.ones((4, 2)), "int4")
+
+
+class TestGPTMatmulDtype:
+    @pytest.mark.parametrize("dt", ["int8", "fp8"])
+    def test_tiny_gpt_trains_and_loss_drops(self, dt):
+        from dtf_tpu.data.datasets import synthetic_text
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+
+        model = GPT(GPTConfig.tiny(matmul_dtype=dt))
+        params = model.init(jax.random.key(0))
+        toks = jnp.asarray(synthetic_text(16, 64, 128, seed=3))
+        opt = optim.adam(1e-3)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            (l, _), g = jax.value_and_grad(
+                lambda p_: model.loss(p_, {"tokens": toks}),
+                has_aux=True)(p)
+            u, s = opt.update(g, s, p)
+            return optim.apply_updates(p, u), s, l
+
+        losses = []
+        for _ in range(8):
+            params, state, l = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] - 0.05   # actually learning
+
+    def test_logits_close_to_fp32_forward(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        toks = jnp.asarray(np.random.default_rng(6).integers(
+            0, 128, (2, 32)), jnp.int32)
+        m0 = GPT(GPTConfig.tiny())
+        p = m0.init(jax.random.key(0))
+        l0 = m0.apply(p, toks)
+        # fp8 e4m3 carries 3 mantissa bits vs int8's ~7 — its lattice is
+        # coarser, so its directional bound is looser by construction.
+        for dt, bound in (("int8", 0.998), ("fp8", 0.98)):
+            lq = GPT(GPTConfig.tiny(matmul_dtype=dt)).apply(p, toks)
+            cos = jnp.sum(l0 * lq, -1) / (
+                jnp.linalg.norm(l0, axis=-1) * jnp.linalg.norm(lq, axis=-1))
+            assert float(cos.min()) > bound, (dt, float(cos.min()))
+
+    def test_fused_block_conflict_rejected(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            GPT(GPTConfig.tiny(matmul_dtype="int8", fused_block=True))
+
+    def test_bad_dtype_rejected_at_construction(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            GPT(GPTConfig.tiny(matmul_dtype="int4"))
+
+
+class TestTrajectoryHarness:
+    def test_traj_run_within_envelope_int8_wire(self, mesh8):
+        """The quality gate itself: tiny-GPT LM, int8 wire vs fp32,
+        within the pinned envelope on the 8-device sim mesh.  (mesh8
+        fixture guarantees the 8 simulated devices exist; traj_run
+        builds its own data mesh.)"""
+        from dtf_tpu.bench.int8_quality import TRAJ_ENVELOPE, traj_run
+
+        r = traj_run(steps=6, batch=16, seq=32, grad_sync="zero1",
+                     grad_comm_dtype="int8")
+        assert r["data_axis"] == 8
+        assert len(r["loss_fp32"]) == len(r["loss_quant"]) == 6
+        assert r["within_envelope"], (r["max_rel_dev"], r["final_rel_dev"])
+        assert r["envelope"] == TRAJ_ENVELOPE
+        assert r["quant_error_rms"] > 0
+
+    def test_traj_run_matmul_dtype_leg(self, mesh8):
+        from dtf_tpu.bench.int8_quality import traj_run
+
+        r = traj_run(steps=4, batch=16, seq=32, grad_sync="dense",
+                     grad_comm_dtype=None, matmul_dtype="int8")
+        assert r["within_envelope"], (r["max_rel_dev"], r["final_rel_dev"])
+        assert r["quant_error_rms"] is None   # no wire quantization
