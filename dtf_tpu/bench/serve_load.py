@@ -1,0 +1,240 @@
+"""Closed-loop serving load generator: latency vs offered QPS.
+
+The MLPerf-pods acceptance discipline (PAPERS.md, arxiv 1909.09756)
+applied to the serving engine: the gate is **measured latency under
+load**, not a ladder slope.  For each offered-QPS point the generator
+
+* draws a seeded Poisson arrival trace with mixed prompt/output lengths
+  (the heavy-traffic shape: short chat turns next to long documents),
+* drives ONE :class:`~dtf_tpu.serve.engine.ServingEngine` closed-loop —
+  requests are submitted as the engine's own clock passes their arrival
+  instants, so an overloaded server sees its queue grow exactly as a
+  real one would (no open-loop "fire and forget" flattery),
+* reports p50/p99 TTFT and TPOT, completed QPS, and — against an SLO
+  TTFT budget — **goodput QPS** (completed requests that met the
+  budget, per second of makespan).
+
+Running the sweep in ``--mode both`` replays the *same* trace through
+the continuous-batching engine and the static-batching baseline
+(identical kernels, identical cache — only the admission policy
+differs), so the headline number
+
+    sustained goodput QPS at p99 TTFT <= budget,  continuous / static
+
+is an A/B attribution to continuous batching alone, not a claim.
+
+Deterministic CI mode: ``--clock virtual`` swaps wall time for the
+seeded VirtualClock cost model, making every percentile reproducible —
+the full-suite ``serve`` lane asserts the continuous/static ratio on
+the CPU sim with it.  ``--clock wall`` measures the real engine.
+
+    python -m dtf_tpu.bench.serve_load --preset tiny --clock virtual \
+        --qps 4,8,16,24 --requests 48 --mode both --json /tmp/serve_ab.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: The A/B acceptance bar the full-suite serve lane enforces (ISSUE 7):
+#: continuous batching must sustain at least this multiple of the
+#: static baseline's goodput QPS at the same p99 TTFT budget.
+AB_MIN_RATIO = 1.5
+
+
+def poisson_trace(*, seed: int, n_requests: int, qps: float,
+                  prompt_lens: List[int], output_lens: List[int],
+                  vocab_size: int,
+                  temperature: float = 0.0) -> List[Tuple[float, dict]]:
+    """Seeded Poisson arrivals with lengths drawn uniformly from the
+    mixed pools.  The arrival process is a UNIT-RATE exponential chain
+    scaled by ``1/qps``: every sweep point (and both modes of the A/B)
+    replays the same requests with the same relative burst structure,
+    only faster — so the latency-vs-QPS curve is a monotone load
+    experiment, not per-point trace lottery."""
+    rng = np.random.default_rng(seed)
+    trace: List[Tuple[float, dict]] = []
+    t = 0.0
+    for rid in range(n_requests):
+        t += float(rng.exponential(1.0)) / qps
+        p = int(rng.choice(prompt_lens))
+        trace.append((t, {
+            "rid": rid,
+            "prompt": rng.integers(0, vocab_size, (p,)).astype(np.int32),
+            "max_new_tokens": int(rng.choice(output_lens)),
+            "temperature": temperature,
+        }))
+    return trace
+
+
+def run_point(model, params, *, mode: str, qps: float, ns) -> Dict:
+    """One sweep point: fresh engine + fresh clock, the seeded trace for
+    this QPS, closed-loop to drain.  Returns the engine summary plus the
+    offered rate."""
+    from dtf_tpu.serve import ServingEngine, VirtualClock, WallClock
+
+    clock = VirtualClock() if ns.clock == "virtual" else WallClock()
+    engine = ServingEngine(
+        model, params, num_slots=ns.slots, block_size=ns.block_size,
+        num_blocks=ns.pool_blocks, mode=mode, seed=ns.seed, clock=clock,
+        max_queue=ns.max_queue, top_k=ns.top_k, top_p=ns.top_p)
+    trace = poisson_trace(
+        seed=ns.seed, n_requests=ns.requests,
+        qps=qps, prompt_lens=ns.prompt_lens_list,
+        output_lens=ns.output_lens_list,
+        vocab_size=model.cfg.vocab_size, temperature=ns.temperature)
+    engine.run(trace)
+    out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
+    out["offered_qps"] = qps
+    out["requests_offered"] = ns.requests
+    return out
+
+
+def sustained_goodput(points: List[Dict], budget_ms: float) -> Dict:
+    """The headline scalar per mode: the best goodput QPS among sweep
+    points whose p99 TTFT stayed inside the budget.  A mode that blows
+    the budget at every offered rate sustains 0 — it cannot serve this
+    SLO at any load level the sweep tried."""
+    ok = [p for p in points
+          if p.get("ttft_ms_p99") is not None
+          and p["ttft_ms_p99"] <= budget_ms]
+    if not ok:
+        return {"sustained_goodput_qps": 0.0, "at_offered_qps": None}
+    best = max(ok, key=lambda p: p.get("goodput_qps", 0.0))
+    return {"sustained_goodput_qps": float(best.get("goodput_qps", 0.0)),
+            "at_offered_qps": best["offered_qps"]}
+
+
+def sweep(model, params, ns) -> Dict:
+    modes = (["continuous", "static"] if ns.mode == "both" else [ns.mode])
+    points: List[Dict] = []
+    for mode in modes:
+        for qps in ns.qps_list:
+            pt = run_point(model, params, mode=mode, qps=qps, ns=ns)
+            points.append(pt)
+            print(f"  [{mode:>10}] offered {qps:6.1f} qps -> "
+                  f"ttft p50/p99 {pt.get('ttft_ms_p50', float('nan')):7.1f}"
+                  f"/{pt.get('ttft_ms_p99', float('nan')):7.1f} ms  "
+                  f"tpot p50 {pt.get('tpot_ms_p50', float('nan')):6.2f} ms  "
+                  f"goodput {pt.get('goodput_qps', 0.0):6.2f} qps  "
+                  f"rejected {pt.get('rejected', 0)}", flush=True)
+    out: Dict = {"slo_ttft_ms": ns.slo_ttft_ms, "clock": ns.clock,
+                 "requests_per_point": ns.requests, "points": points}
+    by_mode = {m: [p for p in points if p["mode"] == m] for m in modes}
+    out["sustained"] = {m: sustained_goodput(by_mode[m], ns.slo_ttft_ms)
+                        for m in modes}
+    if len(modes) == 2:
+        cont = out["sustained"]["continuous"]["sustained_goodput_qps"]
+        stat = out["sustained"]["static"]["sustained_goodput_qps"]
+        if cont <= 0.0:
+            ratio = 0.0          # continuous sustained nothing: hard fail
+        elif stat <= 0.0:
+            # static cannot serve this SLO at any offered rate: no finite
+            # ratio exists.  None (JSON null) rather than float('inf') —
+            # json.dump would emit the non-standard token Infinity and
+            # break every strict parser reading the --json artifact.
+            ratio = None
+        else:
+            ratio = cont / stat
+        out["ab"] = {
+            "continuous_sustained_qps": cont,
+            "static_sustained_qps": stat,
+            "ratio": ratio,
+            "min_ratio": AB_MIN_RATIO,
+        }
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.bench.serve_load",
+        description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "gpt2_small", "llama"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mode", choices=["continuous", "static", "both"],
+                   default="both")
+    p.add_argument("--qps", default="6,12,20,28",
+                   help="comma-separated offered-QPS sweep points")
+    p.add_argument("--requests", type=int, default=64,
+                   help="requests per sweep point")
+    p.add_argument("--prompt_lens", default="4,8,16")
+    # Wide output spread on purpose: static batching holds every slot
+    # until the LONGEST member drains (utilization ~ mean/max output
+    # length), so a mixed 2..32 pool is exactly the traffic shape that
+    # separates the two policies — and the realistic one.
+    p.add_argument("--output_lens", default="2,8,32")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=1.0)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--pool_blocks", type=int, default=None)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--slo_ttft_ms", type=float, default=400.0,
+                   help="the p99 TTFT budget goodput is gated on")
+    p.add_argument("--clock", choices=["wall", "virtual"],
+                   default="virtual",
+                   help="virtual = deterministic cost-model time (CI); "
+                        "wall = measure the real engine")
+    p.add_argument("--json", default=None,
+                   help="write the full sweep result here")
+    p.add_argument("--check", action="store_true",
+                   help="exit nonzero unless continuous sustains >= "
+                        f"{AB_MIN_RATIO}x static goodput at the budget "
+                        f"(requires --mode both)")
+    p.add_argument("--cpu", action="store_true")
+    ns = p.parse_args(argv)
+    if ns.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ns.qps_list = [float(x) for x in ns.qps.split(",")]
+    ns.prompt_lens_list = [int(x) for x in ns.prompt_lens.split(",")]
+    ns.output_lens_list = [int(x) for x in ns.output_lens.split(",")]
+    if ns.check and ns.mode != "both":
+        p.error("--check needs --mode both (it asserts the A/B ratio)")
+
+    import jax
+
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.from_preset(ns.preset)
+    model = GPT(cfg)
+    params = model.init(jax.random.key(ns.seed))
+    print(f"serve_load: preset={ns.preset} slots={ns.slots} "
+          f"block_size={ns.block_size} clock={ns.clock} "
+          f"slo_ttft_ms={ns.slo_ttft_ms}", flush=True)
+    result = sweep(model, params, ns)
+    if "ab" in result:
+        ab = result["ab"]
+        shown = ("inf (static sustains 0)" if ab["ratio"] is None
+                 else f"{ab['ratio']:.2f}")
+        print(f"A/B at p99 TTFT <= {ns.slo_ttft_ms:.0f} ms: continuous "
+              f"sustains {ab['continuous_sustained_qps']:.2f} qps vs "
+              f"static {ab['static_sustained_qps']:.2f} qps "
+              f"(ratio {shown}, bar {AB_MIN_RATIO})",
+              flush=True)
+    if ns.json:
+        with open(ns.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+        print(f"wrote {ns.json}")
+    if ns.check:
+        ab = result["ab"]
+        # ratio None = static sustained nothing at the SLO: continuous
+        # wins by any margin, so the gate passes.
+        if ab["ratio"] is not None and ab["ratio"] < AB_MIN_RATIO:
+            print(f"CHECK FAILED: continuous/static sustained-goodput "
+                  f"ratio {ab['ratio']:.3f} < {AB_MIN_RATIO}",
+                  file=sys.stderr)
+            return 1
+        print("CHECK OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -145,11 +145,12 @@ class GPTBlock(Module):
         self.cfg = cfg
         from dtf_tpu.nn.lowp import check_matmul_dtype
         check_matmul_dtype(cfg.matmul_dtype)
-        if cfg.fused_block and cfg.matmul_dtype != "fp32":
+        if cfg.fused_block and cfg.matmul_dtype not in ("fp32", "int8"):
             raise ValueError(
-                "--matmul_dtype and fused_block are exclusive: the fused "
-                "Pallas block kernels own their operand precision; drop "
-                "one of the two")
+                f"--matmul_dtype {cfg.matmul_dtype} and fused_block are "
+                f"exclusive: the fused Pallas block kernels take fp32 or "
+                f"int8 operands (bf16 compute comes from the model dtype; "
+                f"fp8 has no fused path) — drop one of the two")
         if cfg.fused_block:
             from dtf_tpu.ops.block_kernel import _check_block_args
             # fail at construction, not first apply: T checked per-call
@@ -227,11 +228,13 @@ class GPTBlock(Module):
                                  num_heads=self.cfg.num_heads,
                                  num_kv_heads=self.cfg.num_kv_heads,
                                  causal=True, prenorm=True,
-                                 rope=self.cfg.rope)
+                                 rope=self.cfg.rope,
+                                 matmul_dtype=self.cfg.matmul_dtype)
             return fused_mlp_block(x, params["fc1"], params["fc2"],
                                    params["ln2"],
                                    fc_gate_params=params.get("fc_gate"),
-                                   prenorm=True)
+                                   prenorm=True,
+                                   matmul_dtype=self.cfg.matmul_dtype)
         y, _, _ = self.prefill(params, x)
         return y
 

@@ -29,13 +29,23 @@ def rope_angles(positions: jax.Array, head_dim: int,
 def apply_rope(x: jax.Array, positions: jax.Array,
                theta: float = 10000.0) -> jax.Array:
     """Rotate q or k.  x: (B, T, H, D) with D even; positions: (T,) token
-    indices (shared across the batch).  Returns x's dtype."""
+    indices shared across the batch, or (B, T) PER-ROW indices (the
+    serving engine's continuous batches sit at different sequence
+    positions per slot).  Returns x's dtype."""
     d = x.shape[-1]
     if d % 2:
         raise ValueError(f"RoPE needs an even head dim, got {d}")
-    cos, sin = rope_angles(positions, d, theta)       # (T, D/2)
-    cos = cos[None, :, None, :]                       # (1, T, 1, D/2)
-    sin = sin[None, :, None, :]
+    cos, sin = rope_angles(positions, d, theta)   # (T, D/2) or (B, T, D/2)
+    if positions.ndim == 1:
+        cos = cos[None, :, None, :]                   # (1, T, 1, D/2)
+        sin = sin[None, :, None, :]
+    elif positions.ndim == 2:
+        cos = cos[:, :, None, :]                      # (B, T, 1, D/2)
+        sin = sin[:, :, None, :]
+    else:
+        raise ValueError(
+            f"positions must be (T,) or (B, T), got shape "
+            f"{positions.shape}")
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2:].astype(jnp.float32)
     return jnp.concatenate(

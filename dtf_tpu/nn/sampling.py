@@ -76,6 +76,29 @@ def sample_token(rng: jax.Array, logits: jax.Array, *,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_token_batched(keys: jax.Array, logits: jax.Array, *,
+                         temperature: jax.Array, top_k: int = 0,
+                         top_p: float = 1.0) -> jax.Array:
+    """Per-row sampling for the serving engine: each row of a continuous
+    batch carries its OWN request's temperature and rng key.
+
+    keys: (B,) typed key array (one independent stream per request, so a
+    request's draws do not depend on which batch composition it rode);
+    temperature: (B,) fp32 — 0 selects greedy for that row; top_k/top_p
+    are static engine-wide filters (shared sort, same composition
+    semantics as :func:`filter_logits`).
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = filter_logits(logits / safe_t, top_k=top_k, top_p=top_p)
+    drawn = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(keys, filtered)
+    return jnp.where(temperature == 0.0, greedy,
+                     drawn.astype(jnp.int32))
+
+
 def filter_logits(logits: jax.Array, *, top_k: int = 0,
                   top_p: float = 1.0) -> jax.Array:
     """``top_p_filter(top_k_filter(x, k), p)`` with ONE descending sort.

@@ -114,6 +114,67 @@ def _q_block(t):
 # actionable error instead of an opaque Mosaic allocation failure.
 VMEM_BUDGET = 100 * 1024 * 1024
 
+# ---------------------------------------------------------------------------
+# int8 operand path (--matmul_dtype int8 composing with --fused_block)
+# ---------------------------------------------------------------------------
+# Same quantization discipline as nn/lowp.py: per-OUTPUT-CHANNEL weight
+# scales (computed OUTSIDE the pallas_call, inside the custom_vjp
+# forward, so the saved residuals stay f32 and the existing
+# XLA-recompute backwards become straight-through estimators for free),
+# per-row (token) activation scales computed in-kernel, int8 x int8 ->
+# i32 on the MXU with both scales folded into the f32 result.  Only the
+# PROJECTIONS quantize (qkv / out / fc1 / gate / fc2) — the attention
+# core, norms and residuals keep full precision, exactly like the
+# unfused lowp path, so fused-int8 vs unfused-int8 parity is a
+# reduction-order statement, not a formats one.
+
+_Q_TINY = 1e-30
+
+
+def _quant_cols(w):
+    """(k, n) f32 weight -> (int8 (k, n), sublane-replicated (8, n) f32
+    scale).  Column-wise symmetric quantization is independent per
+    column, so quantizing a packed (D, W) qkv matrix == quantizing each
+    projection separately (the parity tests lean on this)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32)
+                           / jnp.maximum(scale, _Q_TINY)),
+                 -127, 127).astype(jnp.int8)
+    return q, jnp.broadcast_to(scale, (8, w.shape[1]))
+
+
+def _q_rows(a32):
+    """In-kernel per-row activation quantization: (rows, k) f32 ->
+    (int8, (rows, 1) f32 scale).  Mirrors lowp._int8_pair(axis=1)."""
+    amax = jnp.max(jnp.abs(a32), axis=1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(a32 / jnp.maximum(scale, _Q_TINY)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dot_maybe_q(h32, w_ref, scale_ref, cdt):
+    """One projection matmul inside a kernel body: int8 path when a
+    scale ref is present (quantize rows, i32 accumulate, fold both
+    scales), the plain cdt-operand dot otherwise.  Returns f32."""
+    if scale_ref is None:
+        return jax.lax.dot(h32.astype(cdt), w_ref[:],
+                           preferred_element_type=jnp.float32)
+    hq, hs = _q_rows(h32)
+    y = jax.lax.dot(hq, w_ref[:], preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * hs * scale_ref[:1, :]
+
+
+def _check_fused_matmul_dtype(matmul_dtype):
+    if matmul_dtype not in ("fp32", "int8"):
+        raise ValueError(
+            f"fused block kernels support matmul_dtype 'fp32' or 'int8' "
+            f"(got {matmul_dtype!r}); bf16 compute comes from the model "
+            f"dtype itself, and fp8 has no fused operand path — use the "
+            f"unfused block for those")
+    return matmul_dtype == "int8"
+
 
 def _check_vmem(estimate_bytes, what):
     if estimate_bytes > VMEM_BUDGET:
@@ -158,7 +219,8 @@ def _rope_rotate(x32, cos, sin):
 
 
 def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
-                       norm, eps, has_mask, has_rope, has_rel, emit_aux):
+                       norm, eps, has_mask, has_rope, has_rel, emit_aux,
+                       quant=False):
     """One batch row: LN/qkv/attention/out-proj/residual(/LN) in VMEM.
 
     refs (has_rope adds cos/sin tables, has_rel the T5-style (H,T,T)
@@ -167,14 +229,22 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
     absent, so a no-grad forward never writes them to HBM).
     W = D + 2·KVH·hd (GQA packs KVH k/v heads):
       x_ref (1,T,D), wqkv_ref (D,W), bqkv_ref (8,W), wo_ref (D,D),
-      bo_ref (8,D), lns_ref (8,D), lnb_ref (8,D) [, cos_ref (T,hd/2),
-      sin_ref (T,hd/2)] [, rel_ref (H,T,T)] [, bias_ref (1,8,T)],
-      y_ref (1,T,D) [, raw_ref (1,T,D), lse_ref (1,H,T,8)],
-      qkv_scr (T,W) f32, acc_scr (T,D) f32
+      bo_ref (8,D), lns_ref (8,D), lnb_ref (8,D) [, swqkv_ref (8,W),
+      swo_ref (8,D) — the int8 weights' per-column scales when quant]
+      [, cos_ref (T,hd/2), sin_ref (T,hd/2)] [, rel_ref (H,T,T)]
+      [, bias_ref (1,8,T)], y_ref (1,T,D) [, raw_ref (1,T,D),
+      lse_ref (1,H,T,8)], qkv_scr (T,W) f32, acc_scr (T,D) f32
+
+    ``quant``: wqkv/wo arrive int8; the two projection matmuls run
+    int8 x int8 -> i32 with per-row activation scales computed here
+    (the attention core below stays full precision either way).
     """
     (x_ref, wqkv_ref, bqkv_ref, wo_ref, bo_ref, lns_ref, lnb_ref,
      *rest) = refs
     rest = list(rest)
+    swqkv_ref = swo_ref = None
+    if quant:
+        swqkv_ref, swo_ref = rest.pop(0), rest.pop(0)
     cos_ref = sin_ref = None
     if has_rope:
         cos_ref, sin_ref = rest.pop(0), rest.pop(0)
@@ -198,10 +268,8 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
     h = (_ln(x32, lns_ref[:1, :].astype(jnp.float32),
              lnb_ref[:1, :].astype(jnp.float32), eps, norm)
          if prenorm else x32)
-    qkv_scr[:] = jax.lax.dot(
-        h.astype(cdt), wqkv_ref[:],
-        preferred_element_type=jnp.float32) + bqkv_ref[:1, :].astype(
-            jnp.float32)
+    qkv_scr[:] = _dot_maybe_q(h, wqkv_ref, swqkv_ref, cdt) + bqkv_ref[
+        :1, :].astype(jnp.float32)
 
     # Causal q-block loop (static python unroll): each q block only
     # multiplies against keys [0, q_end) — at T=1024/bq=256 that skips
@@ -253,10 +321,8 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
 
     if raw_ref is not None:
         raw_ref[0] = acc_scr[:].astype(raw_ref.dtype)
-    a = jax.lax.dot(
-        acc_scr[:].astype(cdt), wo_ref[:],
-        preferred_element_type=jnp.float32) + bo_ref[:1, :].astype(
-            jnp.float32)
+    a = _dot_maybe_q(acc_scr[:], wo_ref, swo_ref, cdt) + bo_ref[
+        :1, :].astype(jnp.float32)
     u = x32 + a
     y = u if prenorm else _ln(u, lns_ref[:1, :].astype(jnp.float32),
                               lnb_ref[:1, :].astype(jnp.float32), eps,
@@ -266,7 +332,7 @@ def _attn_block_kernel(*refs, num_heads, num_kv_heads, causal, prenorm,
 
 def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
               num_heads, num_kv_heads, causal, prenorm, norm, eps,
-              interpret, emit_aux=True):
+              interpret, emit_aux=True, quant=False):
     b, t, d = x.shape
     w = wqkv.shape[1]                 # D + 2·KVH·hd
     hh = d // num_heads // 2
@@ -282,7 +348,17 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
         pl.BlockSpec((8, d), lambda bi: (0, 0)),
         pl.BlockSpec((8, d), lambda bi: (0, 0)),
     ]
+    if quant:
+        # Quantize here — outside the pallas_call but inside the
+        # custom_vjp forward — so the backward's residuals keep the f32
+        # weights (straight-through estimator, nn/lowp.py semantics).
+        wqkv, swqkv = _quant_cols(wqkv)
+        wo, swo = _quant_cols(wo)
+        in_specs += [pl.BlockSpec((8, w), lambda bi: (0, 0)),
+                     pl.BlockSpec((8, d), lambda bi: (0, 0))]
     args = [x, wqkv, bqkv8, wo, bo8, lns8, lnb8]
+    if quant:
+        args += [swqkv, swo]
     if has_rope:
         in_specs += [pl.BlockSpec((t, hh), lambda bi: (0, 0)),
                      pl.BlockSpec((t, hh), lambda bi: (0, 0))]
@@ -310,7 +386,8 @@ def _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
                           num_kv_heads=num_kv_heads, causal=causal,
                           prenorm=prenorm, norm=norm, eps=eps,
                           has_mask=has_mask, has_rope=has_rope,
-                          has_rel=has_rel, emit_aux=emit_aux),
+                          has_rel=has_rel, emit_aux=emit_aux,
+                          quant=quant),
         grid=(b,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -409,21 +486,22 @@ def _attn_ref(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, rel, cos, sin, bias,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(11, 12, 13, 14, 15,
-                                                    16, 17))
+                                                    16, 17, 18))
 def _fused_attn(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias,
                 num_heads, num_kv_heads, causal, prenorm, norm, eps,
-                interpret):
+                interpret, quant):
     # No-grad forward (eval/inference): the y-only kernel variant — the
     # raw/lse residuals are never written to HBM.
     y, _, _ = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
                         rel, bias, num_heads, num_kv_heads, causal,
-                        prenorm, norm, eps, interpret, emit_aux=False)
+                        prenorm, norm, eps, interpret, emit_aux=False,
+                        quant=quant)
     return y
 
 
 def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
                          rel, bias, num_heads, num_kv_heads, causal,
-                         prenorm, norm, eps, interpret):
+                         prenorm, norm, eps, interpret, quant):
     # With a rel bias the backward is the XLA-reference vjp (see
     # _fused_attn_bwd_rule), which rebuilds everything from the inputs —
     # skip emitting (and saving) raw/lse entirely.
@@ -431,7 +509,7 @@ def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
     y, raw, lse = _attn_fwd(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos,
                             sin, rel, bias, num_heads, num_kv_heads,
                             causal, prenorm, norm, eps, interpret,
-                            emit_aux=emit_aux)
+                            emit_aux=emit_aux, quant=quant)
     if emit_aux:
         from jax.ad_checkpoint import checkpoint_name
         # Same names as ops.flash_attention: the "attn" remat policy
@@ -444,14 +522,16 @@ def _fused_attn_fwd_rule(x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin,
 
 
 def _fused_attn_bwd_rule(num_heads, num_kv_heads, causal, prenorm, norm,
-                         eps, interpret, res, dy):
+                         eps, interpret, quant, res, dy):
     """XLA recompute (qkv projection, RoPE, LN statistics) + the fused
     flash dq/dk/dv kernel.  Matmul grads are plain XLA dots — the r3
     breakdown measured those at ~84% of roofline, so only attention's
     O(T^2) work runs in Pallas here.  With a T5-style rel bias the whole
     backward is instead the vjp of the XLA reference (the flash backward
     has no per-head bias input, and the learned relpos table needs its
-    cotangent)."""
+    cotangent).  Under ``quant`` the residuals are the f32 weights, so
+    this recompute IS the straight-through estimator — gradients as if
+    the forward had run full precision (nn/lowp.py's int8 semantics)."""
     (x, wqkv, bqkv8, wo, bo8, lns8, lnb8, cos, sin, rel, bias, raw,
      lse) = res
     if rel is not None:
@@ -556,11 +636,17 @@ _fused_attn.defvjp(_fused_attn_fwd_rule, _fused_attn_bwd_rule)
 def fused_attn_block(x, attn_params, ln_params, *, num_heads,
                      num_kv_heads=None, causal=False, prenorm=False,
                      rope=False, kv_mask=None, rel_bias=None,
-                     norm="layernorm", eps=1e-6, interpret=None):
+                     norm="layernorm", eps=1e-6, interpret=None,
+                     matmul_dtype="fp32"):
     """Fused attention half-block.
 
     post-LN (BERT, ``prenorm=False``): ``LN(x + Attn(x))``
     pre-LN (GPT/T5, ``prenorm=True``): ``x + Attn(LN(x))``
+
+    ``matmul_dtype="int8"`` runs the qkv and output projections as
+    int8 x int8 -> i32 MXU matmuls (per-output-channel weight scales,
+    per-token activation scales — nn/lowp.py's exact format) with a
+    straight-through backward; the attention core stays full precision.
 
     ``attn_params`` is the MultiHeadAttention param tree (q/k/v/o with
     (D, H|KVH, hd) weights — GQA packs the smaller k/v projections);
@@ -578,6 +664,7 @@ def fused_attn_block(x, attn_params, ln_params, *, num_heads,
     """
     b, t, d = x.shape
     _check_block_args(t, d, num_heads, num_kv_heads, rope=rope)
+    quant = _check_fused_matmul_dtype(matmul_dtype)
     kvh = num_kv_heads or num_heads
     w_pack = d + 2 * kvh * (d // num_heads)
     isz = x.dtype.itemsize
@@ -610,14 +697,14 @@ def fused_attn_block(x, attn_params, ln_params, *, num_heads,
                        rep8(attn_params["o"]["b"]),
                        rep8(ln_params["scale"]), rep8(lnb),
                        cos, sin, rel, bias, num_heads, num_kv_heads,
-                       causal, prenorm, norm, eps, interpret)
+                       causal, prenorm, norm, eps, interpret, quant)
 
 
 # --------------------------------------------------------------------------
 # MLP megakernel
 # --------------------------------------------------------------------------
 
-def _mlp_block_kernel(*refs, has_gate, prenorm, norm, eps):
+def _mlp_block_kernel(*refs, has_gate, prenorm, norm, eps, quant=False):
     """One (rows, D) block: LN/fc1/act/fc2/residual(/LN); the (rows, F)
     hidden exists only in VMEM.  With ``has_gate`` (SwiGLU) the gate is
     a SEPARATE matmul operand — NOT packed into fc1 — mirroring the
@@ -626,33 +713,39 @@ def _mlp_block_kernel(*refs, has_gate, prenorm, norm, eps):
     GPTBlock comment).
 
     refs: x (bn,D), w1 (D,F), b1 (8,F) [, wg (D,F), bg (8,F)],
-    w2 (F,D), b2 (8,D), lns (8,D), lnb (8,D), y (bn,D)
+    w2 (F,D), b2 (8,D), lns (8,D), lnb (8,D)
+    [, s1 (8,F) [, sg (8,F)], s2 (8,D) — int8 weight scales when
+    ``quant``], y (bn,D)
     """
+    rest = list(refs)
+    x_ref, w1_ref, b1_ref = rest.pop(0), rest.pop(0), rest.pop(0)
+    wg_ref = bg_ref = None
     if has_gate:
-        (x_ref, w1_ref, b1_ref, wg_ref, bg_ref, w2_ref, b2_ref, lns_ref,
-         lnb_ref, y_ref) = refs
-    else:
-        (x_ref, w1_ref, b1_ref, w2_ref, b2_ref, lns_ref, lnb_ref,
-         y_ref) = refs
-        wg_ref = bg_ref = None
+        wg_ref, bg_ref = rest.pop(0), rest.pop(0)
+    w2_ref, b2_ref, lns_ref, lnb_ref = (rest.pop(0), rest.pop(0),
+                                        rest.pop(0), rest.pop(0))
+    s1_ref = sg_ref = s2_ref = None
+    if quant:
+        s1_ref = rest.pop(0)
+        if has_gate:
+            sg_ref = rest.pop(0)
+        s2_ref = rest.pop(0)
+    (y_ref,) = rest
     cdt = x_ref.dtype
     x32 = x_ref[:].astype(jnp.float32)
     lns = lns_ref[:1, :].astype(jnp.float32)
     lnb = lnb_ref[:1, :].astype(jnp.float32)
     h = _ln(x32, lns, lnb, eps, norm) if prenorm else x32
-    h1 = jax.lax.dot(h.astype(cdt), w1_ref[:],
-                     preferred_element_type=jnp.float32) + b1_ref[
-                         :1, :].astype(jnp.float32)
+    h1 = _dot_maybe_q(h, w1_ref, s1_ref, cdt) + b1_ref[:1, :].astype(
+        jnp.float32)
     if has_gate:
-        hg = jax.lax.dot(h.astype(cdt), wg_ref[:],
-                         preferred_element_type=jnp.float32) + bg_ref[
-                             :1, :].astype(jnp.float32)
+        hg = _dot_maybe_q(h, wg_ref, sg_ref, cdt) + bg_ref[:1, :].astype(
+            jnp.float32)
         g = jax.nn.silu(hg) * h1
     else:
         g = jax.nn.gelu(h1)
-    h2 = jax.lax.dot(g.astype(cdt), w2_ref[:],
-                     preferred_element_type=jnp.float32) + b2_ref[
-                         :1, :].astype(jnp.float32)
+    h2 = _dot_maybe_q(g, w2_ref, s2_ref, cdt) + b2_ref[:1, :].astype(
+        jnp.float32)
     u = x32 + h2
     y_ref[:] = (u if prenorm else _ln(u, lns, lnb, eps,
                                      norm)).astype(y_ref.dtype)
@@ -667,11 +760,19 @@ def _mlp_rows(n):
 
 
 def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
-             eps, interpret):
+             eps, interpret, quant=False):
     n, d = x2.shape
     f = w1.shape[1]
     has_gate = wg is not None
     bn = _mlp_rows(n)
+    s1 = sg = s2 = None
+    if quant:
+        # Outside the pallas_call, inside the custom_vjp forward — the
+        # backward's residuals stay f32 (straight-through estimator).
+        w1, s1 = _quant_cols(w1)
+        w2, s2 = _quant_cols(w2)
+        if has_gate:
+            wg, sg = _quant_cols(wg)
     in_specs = [
         pl.BlockSpec((bn, d), lambda i: (i, 0)),
         pl.BlockSpec((d, f), lambda i: (0, 0)),
@@ -689,9 +790,18 @@ def _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
         pl.BlockSpec((8, d), lambda i: (0, 0)),
     ]
     args += [w2, b28, lns8, lnb8]
+    if quant:
+        in_specs.append(pl.BlockSpec((8, f), lambda i: (0, 0)))
+        args.append(s1)
+        if has_gate:
+            in_specs.append(pl.BlockSpec((8, f), lambda i: (0, 0)))
+            args.append(sg)
+        in_specs.append(pl.BlockSpec((8, d), lambda i: (0, 0)))
+        args.append(s2)
     return pl.pallas_call(
         functools.partial(_mlp_block_kernel, has_gate=has_gate,
-                          prenorm=prenorm, norm=norm, eps=eps),
+                          prenorm=prenorm, norm=norm, eps=eps,
+                          quant=quant),
         grid=(n // bn,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
@@ -727,23 +837,25 @@ def _mlp_ref(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
                                   norm)).astype(x2.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
 def _fused_mlp(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm, norm,
-               eps, interpret):
+               eps, interpret, quant):
     return _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm,
-                    norm, eps, interpret)
+                    norm, eps, interpret, quant=quant)
 
 
 def _fused_mlp_fwd_rule(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8,
-                        prenorm, norm, eps, interpret):
+                        prenorm, norm, eps, interpret, quant):
     y = _mlp_fwd(x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8, prenorm,
-                 norm, eps, interpret)
+                 norm, eps, interpret, quant=quant)
     return y, (x2, w1, b18, wg, bg8, w2, b28, lns8, lnb8)
 
 
-def _fused_mlp_bwd_rule(prenorm, norm, eps, interpret, res, dy):
+def _fused_mlp_bwd_rule(prenorm, norm, eps, interpret, quant, res, dy):
     # Rebuilding the (rows, F) hidden costs two matmuls XLA runs near
-    # roofline — cheaper than saving ~190 MB/layer of it to HBM.
+    # roofline — cheaper than saving ~190 MB/layer of it to HBM.  The
+    # residuals are the f32 weights even under ``quant``, so the int8
+    # backward is the straight-through estimator by construction.
     _, vjp = jax.vjp(
         lambda *a: _mlp_ref(*a, prenorm=prenorm, norm=norm, eps=eps),
         *res)
@@ -755,7 +867,7 @@ _fused_mlp.defvjp(_fused_mlp_fwd_rule, _fused_mlp_bwd_rule)
 
 def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
                     fc_gate_params=None, prenorm=False, norm="layernorm",
-                    eps=1e-6, interpret=None):
+                    eps=1e-6, interpret=None, matmul_dtype="fp32"):
     """Fused MLP half-block.
 
     post-LN (BERT):    ``LN(x + fc2(act(fc1(x))))``
@@ -767,8 +879,11 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
     axis keeps the elementwise product local per shard (the model's
     split-projection rationale).  ``norm`` selects LayerNorm or RMSNorm
     (T5; no bias).  Operates on flattened (B·T, D) rows — no cross-row
-    coupling."""
+    coupling.  ``matmul_dtype="int8"``: fc1/gate/fc2 run int8 with
+    per-channel/per-token scales and a straight-through backward
+    (nn/lowp.py's format; the activation nonlinearity stays f32)."""
     b, t, d = x.shape
+    quant = _check_fused_matmul_dtype(matmul_dtype)
     f = fc1_params["w"].shape[1]
     isz = x.dtype.itemsize
     n_mats = 3 if fc_gate_params is not None else 2
@@ -787,7 +902,7 @@ def fused_mlp_block(x, fc1_params, fc2_params, ln_params, *,
     y = _fused_mlp(x.reshape(b * t, d), fc1_params["w"],
                    rep8(fc1_params["b"]), wg, bg8, fc2_params["w"],
                    rep8(fc2_params["b"]), rep8(ln_params["scale"]),
-                   rep8(lnb), prenorm, norm, eps, interpret)
+                   rep8(lnb), prenorm, norm, eps, interpret, quant)
     return y.reshape(b, t, d)
 
 

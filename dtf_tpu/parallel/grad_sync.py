@@ -247,11 +247,16 @@ class GradSyncEngine:
                 inner, optimizer.update._clip_max_norm, axis=axes[0])
         if not optimizer.elementwise:
             raise ValueError(
-                "--grad_sync zero1 requires an ELEMENTWISE optimizer "
-                "(sgd/momentum/adam/adamw): the sharded update must equal "
-                "the full update restricted to each shard, which "
-                "adafactor's factored moments and lamb's per-tensor trust "
-                "ratios violate — use --grad_sync dense for those")
+                f"--grad_sync zero1 requires an ELEMENTWISE optimizer "
+                f"(sgd/momentum/adam/adamw): the sharded update must equal "
+                f"the full update restricted to each shard, which "
+                f"adafactor's factored moments and lamb's per-tensor trust "
+                f"ratios violate.  Fall back to `--grad_sync dense`: it "
+                f"supports every optimizer but REPLICATES the full "
+                f"optimizer state on all {int(mesh.shape[axes[0]])} "
+                f"devices of the '{axes[0]}' axis — N x the per-device "
+                f"state bytes zero1 would pay (DESIGN.md §4.1 quantifies "
+                f"the cost; comm/optimizer_state_bytes measures it)")
         self.strategy = strategy
         self.opt = optimizer
         self.mesh = mesh

@@ -1,0 +1,36 @@
+"""Serving engine: continuous batching over the decode path.
+
+The inference half of the stack used to be all parts, no engine —
+``ops/decode_kernel.py`` and ``nn/sampling.py`` could time fused decode
+but nothing accepted *requests*.  This package is the engine:
+
+* :mod:`.paged_kv` — paged/blocked KV cache: one shared HBM pool of
+  fixed-size blocks, a deterministic free-list allocator, per-request
+  block tables (streams of different lengths share the pool instead of
+  each padding to max_len);
+* :mod:`.scheduler` — admission control + continuous (in-flight)
+  batching with prefill/decode phase separation, plus the
+  static-batching baseline policy and the wall/virtual clocks;
+* :mod:`.decode` — the jitted paged prefill/decode steps (one compile
+  per geometry; token-identical to the contiguous cache path — pinned
+  by parity tests, single-device and TP mesh);
+* :mod:`.engine` — :class:`ServingEngine`: streaming per-request
+  output, TTFT/TPOT histograms into the telemetry spine, goodput books.
+
+``python -m dtf_tpu.serve`` runs a server process (supervisor restarts,
+health beats); ``python -m dtf_tpu.bench.serve_load`` is the
+closed-loop load generator (p50/p99 TTFT/TPOT vs offered QPS, with the
+static-batching A/B).
+"""
+
+from dtf_tpu.serve.engine import ServingEngine
+from dtf_tpu.serve.paged_kv import (BlockAllocator, KVPool, PoolExhausted,
+                                    blocks_for, contiguous_table)
+from dtf_tpu.serve.scheduler import (Request, Scheduler, VirtualClock,
+                                     WallClock)
+
+__all__ = [
+    "BlockAllocator", "KVPool", "PoolExhausted", "Request", "Scheduler",
+    "ServingEngine", "VirtualClock", "WallClock", "blocks_for",
+    "contiguous_table",
+]

@@ -1,0 +1,195 @@
+"""Serving CLI: run the continuous-batching engine as a process.
+
+    # demo traffic: 32 seeded requests at ~8 QPS through the tiny preset
+    python -m dtf_tpu.serve --preset tiny --demo 32 --qps 8 \
+        --logdir /tmp/dtf_serve
+
+    # requests from a JSONL file (one {"prompt": [...ids...],
+    # "max_new_tokens": N, "temperature": T} per line), streamed tokens
+    python -m dtf_tpu.serve --preset tiny --requests reqs.jsonl --stream
+
+Resilience spine reuse (DESIGN.md §5): ``--max_restarts N`` wraps the
+serve session in the bounded-restart supervisor — a crashed or wedged
+server restarts and REPLAYS the unfinished requests (completed results
+survive the attempt boundary); ``--health_dir`` publishes a liveness
+heartbeat per engine iteration through ``resilience.health``'s file
+transport, so an external monitor (or the chaos suite) can tell a
+serving process that is decoding from one that is wedged.
+``--wedge_at K`` injects a crash at iteration K of the first attempt —
+the supervisor-path proof the CI lane drives.
+
+Weights are seeded-random (this repo has no trained checkpoints to
+ship); the engine, scheduler, cache, and telemetry paths are exactly
+the production ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
+    """The request trace: JSONL file or a seeded Poisson demo mix."""
+    trace: List[Tuple[float, dict]] = []
+    if ns.requests:
+        with open(ns.requests) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                trace.append((float(doc.get("arrival_s", 0.0)), {
+                    "rid": i,
+                    "prompt": np.asarray(doc["prompt"], np.int32),
+                    "max_new_tokens": int(doc.get("max_new_tokens", 16)),
+                    "temperature": float(doc.get("temperature",
+                                                 ns.temperature)),
+                }))
+        trace.sort(key=lambda e: e[0])
+        return trace
+    # ONE Poisson trace generator in the repo (the load bench's
+    # unit-rate chain, rate-scaling invariant included).
+    from dtf_tpu.bench.serve_load import poisson_trace
+    return poisson_trace(
+        seed=ns.seed, n_requests=ns.demo, qps=ns.qps,
+        prompt_lens=[int(x) for x in ns.prompt_lens.split(",")],
+        output_lens=[int(x) for x in ns.output_lens.split(",")],
+        vocab_size=vocab_size, temperature=ns.temperature)
+
+
+def serve_session(ns, model, params, trace) -> Dict:
+    """Run the trace to completion under the supervisor: unfinished
+    requests replay on restart (arrival re-stamped to the new attempt's
+    clock — an external client would keep its own latency books across
+    the gap), completed results survive."""
+    from dtf_tpu.resilience.supervisor import run_supervised
+    from dtf_tpu.serve import ServingEngine, VirtualClock, WallClock
+
+    completed: Dict[int, object] = {}
+
+    def printer(req, token, done):
+        if ns.stream:
+            tail = " <end>" if done else ""
+            print(f"  [req {req.rid}] +{token}{tail}", flush=True)
+
+    def make_heartbeat():
+        if not ns.health_dir:
+            return None
+        from dtf_tpu.resilience.health import FileHeartbeatTransport
+        transport = FileHeartbeatTransport(ns.health_dir, 0)
+        return lambda count: transport.beat(count)
+
+    def fit_once(attempt: int):
+        clock = (VirtualClock() if ns.clock == "virtual" else WallClock())
+        engine = ServingEngine(
+            model, params, num_slots=ns.slots, block_size=ns.block_size,
+            num_blocks=ns.pool_blocks, mode=ns.mode, top_k=ns.top_k,
+            top_p=ns.top_p, eos_id=ns.eos_id, seed=ns.seed, clock=clock,
+            max_queue=ns.max_queue, on_token=printer,
+            heartbeat=make_heartbeat())
+        if ns.wedge_at is not None and attempt == 0:
+            real_step = engine.step
+
+            def wedged_step():
+                if engine.iterations == ns.wedge_at:
+                    raise RuntimeError(
+                        "chaos: serve wedged (injected --wedge_at)")
+                return real_step()
+
+            engine.step = wedged_step
+        pending = [(0.0 if attempt else t, kw) for t, kw in trace
+                   if kw["rid"] not in completed]
+        try:
+            engine.run(pending)
+        finally:
+            completed.update(
+                {rid: r for rid, r in engine.results.items()
+                 if r.status == "completed"})
+            if ns.logdir:
+                import os
+                os.makedirs(ns.logdir, exist_ok=True)
+                engine.write_telemetry(ns.logdir,
+                                       slo_ttft_ms=ns.slo_ttft_ms)
+        return engine
+
+    engine = run_supervised(fit_once, max_restarts=ns.max_restarts,
+                            needs_restart=lambda r: False)
+    return {"engine": engine, "completed": completed}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.serve",
+        description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "gpt2_small", "llama"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mode", choices=["continuous", "static"],
+                   default="continuous")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--pool_blocks", type=int, default=None,
+                   help="KV pool size in blocks (default: every slot "
+                        "can hold a full window)")
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=1.0)
+    p.add_argument("--eos_id", type=int, default=None)
+    p.add_argument("--requests", default=None,
+                   help="JSONL request file (see module docstring)")
+    p.add_argument("--demo", type=int, default=16,
+                   help="no --requests: serve this many seeded demo "
+                        "requests")
+    p.add_argument("--qps", type=float, default=8.0,
+                   help="demo arrival rate (Poisson)")
+    p.add_argument("--prompt_lens", default="4,8,16")
+    p.add_argument("--output_lens", default="4,8,16")
+    p.add_argument("--clock", choices=["wall", "virtual"], default="wall")
+    p.add_argument("--stream", action="store_true",
+                   help="print each token as it is emitted")
+    p.add_argument("--logdir", default=None)
+    p.add_argument("--slo_ttft_ms", type=float, default=500.0)
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("--health_dir", default=None,
+                   help="publish per-iteration liveness beats here "
+                        "(resilience/health.py file transport)")
+    p.add_argument("--wedge_at", type=int, default=None,
+                   help="fault injection: crash at this iteration of "
+                        "attempt 0 (supervisor-restart proof)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
+    ns = p.parse_args(argv)
+    if ns.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.from_preset(ns.preset)
+    model = GPT(cfg)
+    params = model.init(jax.random.key(ns.seed))
+    trace = build_trace(ns, cfg.vocab_size)
+    out = serve_session(ns, model, params, trace)
+    engine = out["engine"]
+    summary = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
+    summary["completed_all_attempts"] = len(out["completed"])
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    wanted = {kw["rid"] for _, kw in trace}
+    missing = wanted - set(out["completed"])
+    if missing:
+        print(f"error: {len(missing)} request(s) never completed: "
+              f"{sorted(missing)[:8]}...", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,235 @@
+"""Jitted paged prefill / decode steps for the serving engine.
+
+Mirrors the op-per-op decode math of ``GPTBlock.decode_step`` /
+``GPT._decode_logits`` EXACTLY (same einsum contractions, same fp32
+softmax statistics, same cache-dtype discipline) with two serving
+generalizations the training-side entry points don't have:
+
+* **per-slot positions** — a continuous batch's requests sit at
+  different sequence lengths, so ``pos`` is a ``(slots,)`` vector and
+  the attention visibility mask is per-slot (``arange(T) <= pos[b]``),
+  where the contiguous path's is a scalar broadcast;
+* **block-table indirection** — the KV cache rows come from the shared
+  block pool (serve/paged_kv.py): each layer gathers ``pool[table]``
+  into logical order, folds the current token's k/v in at its slot
+  position, and the new rows are scattered back to
+  ``(table[b, pos//bs], pos % bs)`` after the layer stack.
+
+Because the gathered view of an identity block table is bit-identical
+to a contiguous per-slot cache, "paged decode == contiguous decode" is
+a pure statement about this indirection — the parity tests pin it
+token-for-token (greedy and sampled, single-device and TP mesh).
+
+Functions are built once per (model, static shape) and cached, so every
+engine over the same model/geometry shares one compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_BIG = -1e30
+
+
+def _donate_pools():
+    """Donate the pool buffers so the functional update is in-place on
+    backends that implement donation; CPU does not (and logs a warning
+    per compile), so the sim path keeps plain arguments."""
+    return (1, 2) if jax.default_backend() != "cpu" else ()
+
+def _cached(model, tag, statics, build):
+    """Per-(model, static geometry) compiled-step cache, stored ON the
+    model object so its lifetime is exactly the model's — no global
+    registry pinning dead models (and their executables) for the
+    process lifetime, no id-recycling hazards."""
+    cache: Dict[tuple, object] = model.__dict__.setdefault(
+        "_serve_fn_cache", {})
+    key = (tag, statics)
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def _apply_rope_at(x, pos):
+    """RoPE for one decode token per slot: x (B, 1, H, Dh), pos (B,).
+    Same split-half convention as nn.rope.apply_rope (which it calls
+    with per-slot positions)."""
+    from dtf_tpu.nn.rope import apply_rope
+    return apply_rope(x, pos[:, None])
+
+
+def _sample_keys(seeds, counts):
+    """Per-slot sampling keys: fold the request seed and its token
+    counter so a request's rng stream is independent of batch
+    composition — the same request draws the same tokens whether it
+    rode a continuous batch or a static one (tested)."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c))(seeds, counts)
+
+
+def _block_decode_paged(block, lp, x_t, pk, pv, table, pos, visible_bias):
+    """One decoder block, one token per slot, against gathered pool
+    blocks.  The attention body is a line-for-line mirror of
+    ``GPTBlock.decode_step`` (grouped cache, fp32 softmax stats, cache
+    dtype end-to-end); only the cache materialization differs."""
+    cfg = block.cfg
+    p = lp["attn"]
+    b = x_t.shape[0]
+    h = block.ln1.apply(lp["ln1"], x_t)
+    q, k_t, v_t = block.attn.qkv(p, h)          # (B,1,H,Dh) / (B,1,KVH,Dh)
+    if cfg.rope:
+        q = _apply_rope_at(q, pos)
+        k_t = _apply_rope_at(k_t, pos)
+
+    nbs = table.shape[1]
+    bs = pk.shape[1]
+    t_cache = nbs * bs
+    kvh = k_t.shape[2]
+    hd = k_t.shape[3]
+    safe = jnp.maximum(table, 0)                # -1 -> trash block
+    ck = pk[safe].reshape(b, t_cache, kvh, hd)  # logical-order gather
+    cv = pv[safe].reshape(b, t_cache, kvh, hd)
+    rows = jnp.arange(b)
+    ck = ck.at[rows, pos].set(k_t[:, 0].astype(ck.dtype))
+    cv = cv.at[rows, pos].set(v_t[:, 0].astype(cv.dtype))
+
+    h_all = q.shape[2]
+    g = h_all // kvh
+    qg = q.reshape(b, kvh, g, hd).astype(ck.dtype)
+    scale = hd ** -0.5
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + visible_bias                        # (B, KVH, G, T)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgt,btkd->bkgd", w.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(b, 1, h_all, hd).astype(x_t.dtype)
+    x_t = x_t + block.attn.out_proj(p, out)
+    y = block._mlp_residual(lp, x_t)
+    return y, k_t[:, 0].reshape(b, -1), v_t[:, 0].reshape(b, -1)
+
+
+def _paged_logits(model, params, pool_k, pool_v, table, tok, pos):
+    """tok/pos (B,) -> (logits (B, V), new pools).  The layer walk is the
+    same unrolled scan as ``GPT._decode_logits`` (decode is latency-
+    bound; unrolling lets XLA overlap weight streaming across layers)."""
+    bs = pool_k.shape[2]
+    nbs = table.shape[1]
+    b = tok.shape[0]
+    x = model._embed(params, tok[:, None], pos[:, None])     # (B, 1, D)
+    # per-slot visibility, hoisted out of the layer loop like the
+    # contiguous path's visible_bias
+    t_cache = nbs * bs
+    visible_bias = jnp.where(
+        jnp.arange(t_cache)[None, None, None, :]
+        <= pos[:, None, None, None], 0.0, NEG_BIG)
+
+    def layer_scan(carry_x, inputs):
+        lp, pk, pv = inputs
+        y, k_row, v_row = _block_decode_paged(
+            model.block, lp, carry_x, pk, pv, table, pos, visible_bias)
+        return y, (k_row, v_row)
+
+    x, (k_new, v_new) = lax.scan(
+        layer_scan, x, (params["layers"], pool_k, pool_v), unroll=True)
+    x = model.ln_f.apply(params["ln_f"], x)
+    logits = model.tok.attend(params["tok"], x)[:, 0, :]
+
+    # scatter the new rows: physical (block, offset) per slot; dead
+    # slots' table entries are -1 -> trash block 0 (paged_kv.TRASH_BLOCK)
+    blk = jnp.take_along_axis(table, (pos // bs)[:, None], axis=1)[:, 0]
+    blk = jnp.maximum(blk, 0)
+    off = pos % bs
+    pool_k = pool_k.at[:, blk, off].set(k_new.astype(pool_k.dtype))
+    pool_v = pool_v.at[:, blk, off].set(v_new.astype(pool_v.dtype))
+    return logits, pool_k, pool_v
+
+
+def build_decode_fn(model, *, num_slots: int, blocks_per_slot: int,
+                    block_size: int, top_k: int = 0, top_p: float = 1.0):
+    """The engine's one compiled decode iteration.
+
+    ``fn(params, pool_k, pool_v, table (B,nbs) i32, tok (B,) i32,
+    pos (B,) i32, temps (B,) f32, seeds (B,) u32, counts (B,) i32)
+    -> (next_tok (B,) i32, pool_k, pool_v)``
+
+    Static shape per (slots, window): ONE compile covers every batch
+    composition — that is what makes continuous batching free of
+    recompiles.  Pools are donated (the update is in-place where the
+    backend allows).
+    """
+    from dtf_tpu.nn.sampling import sample_token_batched
+
+    statics = (num_slots, blocks_per_slot, block_size, top_k, float(top_p))
+
+    def build():
+        def step(params, pool_k, pool_v, table, tok, pos, temps, seeds,
+                 counts):
+            logits, pool_k, pool_v = _paged_logits(
+                model, params, pool_k, pool_v, table, tok, pos)
+            keys = _sample_keys(seeds, counts)
+            nxt = sample_token_batched(keys, logits, temperature=temps,
+                                       top_k=top_k, top_p=top_p)
+            return nxt, pool_k, pool_v
+
+        return jax.jit(step, donate_argnums=_donate_pools())
+
+    return _cached(model, "decode", statics, build)
+
+
+def build_prefill_fn(model, *, padded_len: int, num_blocks_req: int,
+                     top_k: int = 0, top_p: float = 1.0):
+    """One request's prefill: the whole prompt in ONE batched forward
+    (MXU matmuls, not P sequential decode steps), k/v scattered into the
+    request's pool blocks, first token sampled from the last-prompt
+    logits.
+
+    ``fn(params, pool_k, pool_v, prompt (1, P_pad) i32, p_len () i32,
+    blocks (nb,) i32, temp (1,) f32, seed (1,) u32)
+    -> (first_tok () i32, pool_k, pool_v)``
+
+    Compiled per padded prompt length (= per block count — prompts pad
+    to whole blocks), so a serving process warms one executable per
+    length bucket.
+    """
+    from dtf_tpu.nn.sampling import sample_token_batched
+
+    statics = (padded_len, num_blocks_req, top_k, float(top_p))
+
+    def build():
+        def prefill(params, pool_k, pool_v, prompt, p_len, blocks, temp,
+                    seed):
+            x = model._embed(params, prompt, jnp.arange(padded_len))
+
+            def prefill_layer(cx, lp):
+                y, k, v = model.block.prefill(lp, cx)
+                return y, (k, v)
+
+            x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
+            # logits at the LAST REAL prompt position (padding rows are
+            # causal-invisible to it)
+            x_last = lax.dynamic_slice_in_dim(x, p_len - 1, 1, axis=1)
+            x_last = model.ln_f.apply(params["ln_f"], x_last)
+            logits = model.tok.attend(params["tok"], x_last)[:, 0, :]
+
+            # (L, 1, P_pad, KVH, Dh) -> (L, nb, bs, KVH*Dh) -> pool blocks
+            l = ks.shape[0]
+            bs = pool_k.shape[2]
+            chunk = lambda a: a.reshape(l, num_blocks_req, bs, -1)
+            pool_k = pool_k.at[:, blocks].set(
+                chunk(ks).astype(pool_k.dtype))
+            pool_v = pool_v.at[:, blocks].set(
+                chunk(vs).astype(pool_v.dtype))
+
+            keys = _sample_keys(seed, jnp.zeros((1,), jnp.int32))
+            first = sample_token_batched(keys, logits, temperature=temp,
+                                         top_k=top_k, top_p=top_p)
+            return first[0], pool_k, pool_v
+
+        return jax.jit(prefill, donate_argnums=_donate_pools())
+
+    return _cached(model, "prefill", statics, build)

@@ -1,0 +1,391 @@
+"""The serving engine: request-driven continuous-batching decode.
+
+Turns the repo's decode machinery into a system that accepts *requests*:
+
+* one :class:`~dtf_tpu.serve.scheduler.Scheduler` (admission control,
+  continuous or static batching, prefill/decode phase separation);
+* one shared :class:`~dtf_tpu.serve.paged_kv.KVPool` of fixed-size KV
+  blocks with per-request block tables;
+* ONE compiled decode step per (slots, window) geometry — batch
+  composition changes never recompile — plus one compiled prefill per
+  prompt-length bucket;
+* streaming output per request (``on_token`` fires as every token is
+  emitted) and per-request TTFT/TPOT wired into the telemetry spine
+  (``serve/*`` instruments, goodput books, ``telemetry.report``'s
+  Serving section).
+
+The engine is single-host and synchronous by design: one iteration =
+(admit + prefill the admissions) + (one decode step for every occupied
+slot).  Wall-clock honesty comes from the injected clock —
+:class:`~dtf_tpu.serve.scheduler.WallClock` for real serving,
+:class:`~dtf_tpu.serve.scheduler.VirtualClock` for deterministic
+scheduling A/Bs (the load bench's CI mode).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dtf_tpu import telemetry as tel
+from dtf_tpu.serve import decode as dec
+from dtf_tpu.serve.paged_kv import BlockAllocator, KVPool, blocks_for
+from dtf_tpu.serve.scheduler import Request, Scheduler, WallClock
+
+
+def _request_seed(engine_seed: int, rid: int) -> int:
+    """Deterministic per-request rng seed (uint32 range): independent of
+    batch composition, stable across engine restarts — a replayed
+    request redraws the same tokens."""
+    return (int(engine_seed) * 2654435761 + int(rid) * 40503) % (1 << 32)
+
+
+class ServingEngine:
+    """See module docstring.  ``model`` is a :class:`dtf_tpu.models.gpt.
+    GPT` (params may be sharded under a mesh — GSPMD inserts the
+    collectives, same tokens as single-device; tested)."""
+
+    def __init__(self, model, params, *, num_slots: int = 4,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 blocks_per_slot: Optional[int] = None,
+                 mode: str = "continuous", top_k: int = 0,
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 seed: int = 0, clock=None, max_queue: int = 64,
+                 prefill_token_budget: Optional[int] = None,
+                 static_batch_wait_s: float = 0.05,
+                 on_token: Optional[Callable] = None,
+                 heartbeat: Optional[Callable[[int], None]] = None):
+        t_init = time.perf_counter()
+        # Close any open supervisor down-window into the restart bucket
+        # (run_supervised marks down at the crash; construction of the
+        # next attempt's engine is "up" — same contract as Trainer).
+        tel.get_tracker().mark_up()
+        self.model = model
+        self.params = params
+        cfg = model.cfg
+        if cfg.flash_enabled() and block_size % 8:
+            raise ValueError(
+                f"block_size must be a multiple of 8 when the flash "
+                f"prefill kernel is active (sublane tiling), got "
+                f"{block_size}")
+        self.block_size = block_size
+        self.blocks_per_slot = (blocks_per_slot
+                                or blocks_for(cfg.max_len, block_size))
+        if num_blocks is None:
+            # no-sharing default: every slot can hold a full window;
+            # size it down to see paging's pool-sharing win
+            num_blocks = 1 + num_slots * self.blocks_per_slot
+        self.pool = KVPool.create(cfg, num_blocks, block_size)
+        self.clock = clock or WallClock()
+        self.scheduler = Scheduler(
+            num_slots=num_slots, allocator=BlockAllocator(num_blocks),
+            block_size=block_size, blocks_per_slot=self.blocks_per_slot,
+            mode=mode, max_queue=max_queue,
+            prefill_token_budget=prefill_token_budget,
+            static_batch_wait_s=static_batch_wait_s, max_len=cfg.max_len)
+        self.mode = mode
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.seed = seed
+        self.on_token = on_token
+        self.heartbeat = heartbeat
+
+        self.num_slots = num_slots
+        self._table = np.full((num_slots, self.blocks_per_slot), -1,
+                              np.int32)
+        self._tok = np.zeros((num_slots,), np.int32)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._temps = np.zeros((num_slots,), np.float32)
+        self._seeds = np.zeros((num_slots,), np.uint32)
+        self._counts = np.zeros((num_slots,), np.int32)
+
+        self._decode_fn = dec.build_decode_fn(
+            model, num_slots=num_slots,
+            blocks_per_slot=self.blocks_per_slot, block_size=block_size,
+            top_k=top_k, top_p=top_p)
+        self._compiled: set = set()
+
+        self._next_rid = 0
+        self.results: Dict[int, Request] = {}
+        self.iterations = 0
+        self.batch_log: List[Tuple] = []    # scheduling trace (tests pin)
+        self._blocks_peak = 0
+
+        tel.gauge("serve/slots").set(num_slots)
+        tel.gauge("serve/kv_blocks_total").set(num_blocks - 1)
+        tel.get_tracker().add("init", time.perf_counter() - t_init)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               arrival_s: Optional[float] = None,
+               rid: Optional[int] = None) -> Request:
+        """Admission-controlled submit.  Returns the Request; check
+        ``.status`` — ``rejected`` means the queue pushed back (the
+        closed-loop client's backpressure signal), ``queued`` means it
+        will stream tokens via ``on_token`` and land in ``results``."""
+        if rid is None:
+            rid = self._next_rid
+        self._next_rid = max(self._next_rid, rid + 1)
+        req = Request(rid=rid,
+                      prompt=np.asarray(prompt, np.int32).reshape(-1),
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature),
+                      eos_id=self.eos_id if eos_id is None else eos_id)
+        now = self.clock.now() if arrival_s is None else arrival_s
+        self.submit_request(req, now)
+        return req
+
+    def submit_request(self, req: Request, now: float) -> str:
+        verdict = self.scheduler.submit(req, now)
+        tel.counter("serve/submissions_total").inc()
+        if verdict != "queued":
+            tel.counter("serve/requests_rejected").inc()
+            self.results[req.rid] = req
+        return verdict
+
+    # -- the iteration ------------------------------------------------------
+
+    def _book(self, bucket, seconds: float) -> None:
+        """First call per compiled bucket is dominated by the backend
+        compile — book it there so serving goodput stays honest."""
+        if bucket in self._compiled:
+            tel.get_tracker().add("productive", seconds)
+        else:
+            self._compiled.add(bucket)
+            tel.get_tracker().add("compile", seconds)
+
+    def _emit(self, req: Request, token: int, done: bool) -> None:
+        if self.on_token is not None:
+            self.on_token(req, int(token), done)
+
+    def _finish(self, req: Request, now: float) -> None:
+        req.status = "completed"
+        req.done_s = now
+        slot = req.slot
+        self.scheduler.release(req)
+        self._table[slot] = -1
+        self._tok[slot] = 0
+        self._pos[slot] = 0
+        self._temps[slot] = 0.0
+        self._seeds[slot] = 0
+        self._counts[slot] = 0
+        self.results[req.rid] = req
+        tel.counter("serve/requests_completed").inc()
+        ttft = req.ttft_s()
+        if ttft is not None:
+            tel.histogram("serve/ttft_ms").observe(ttft * 1e3)
+        tpot = req.tpot_s()
+        if tpot is not None:
+            tel.histogram("serve/tpot_ms").observe(tpot * 1e3)
+
+    def _token_out(self, req: Request, token: int, now: float) -> bool:
+        """Record one emitted token; returns done."""
+        req.tokens.append(int(token))
+        if req.first_token_s is None:
+            req.first_token_s = now
+        req.last_token_s = now
+        done = (len(req.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and int(token) == req.eos_id))
+        self._emit(req, token, done)
+        if done:
+            self._finish(req, now)
+        return done
+
+    def _prefill(self, slot: int, req: Request) -> None:
+        import jax.numpy as jnp
+
+        p_len = req.prompt_len
+        p_pad = req.padded_prompt_len(self.block_size)
+        nb_prompt = p_pad // self.block_size
+        fn = dec.build_prefill_fn(self.model, padded_len=p_pad,
+                                  num_blocks_req=nb_prompt,
+                                  top_k=self.top_k, top_p=self.top_p)
+        prompt = np.zeros((1, p_pad), np.int32)
+        prompt[0, :p_len] = req.prompt
+        seed = _request_seed(self.seed, req.rid)
+        t0 = time.perf_counter()
+        with tel.span("serve/prefill", tokens=p_pad):
+            first, self.pool.k, self.pool.v = fn(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(prompt), jnp.int32(p_len),
+                jnp.asarray(np.asarray(req.blocks[:nb_prompt], np.int32)),
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([seed], jnp.uint32))
+            first = int(first)
+        self._book(("prefill", p_pad), time.perf_counter() - t0)
+        self.clock.charge("prefill", tokens=p_pad)
+        tel.counter("serve/prefill_tokens_total").inc(p_pad)
+        self.batch_log.append(("prefill", req.rid))
+
+        req.pos = p_len
+        self._table[slot] = -1
+        self._table[slot, :len(req.blocks)] = req.blocks
+        self._tok[slot] = first
+        self._pos[slot] = p_len
+        self._temps[slot] = req.temperature
+        self._seeds[slot] = seed
+        self._counts[slot] = 1
+        self._token_out(req, first, self.clock.now())
+
+    def _decode(self, active: List[Request]) -> None:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        with tel.span("serve/decode", batch=len(active)):
+            nxt, self.pool.k, self.pool.v = self._decode_fn(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(self._table), jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._temps),
+                jnp.asarray(self._seeds), jnp.asarray(self._counts))
+            nxt = np.asarray(nxt)
+        self._book(("decode",), time.perf_counter() - t0)
+        self.clock.charge("decode", batch=len(active))
+        now = self.clock.now()
+        tel.counter("serve/decode_iterations_total").inc()
+        tel.counter("serve/tokens_generated_total").inc(len(active))
+        self.batch_log.append(
+            ("decode", tuple(sorted(r.rid for r in active))))
+        for req in active:
+            slot = req.slot
+            tok = int(nxt[slot])
+            req.pos += 1
+            self._pos[slot] += 1
+            self._counts[slot] += 1
+            self._tok[slot] = tok
+            self._token_out(req, tok, now)
+
+    def step(self) -> bool:
+        """One engine iteration: admit + prefill, then one decode step
+        for every occupied slot.  Continuous mode refills freed slots on
+        the SAME iteration a request finishes (the eviction happened in
+        ``_finish`` before this admit runs).  Returns whether any work
+        ran — False means the scheduler is batch-forming (static mode's
+        fill-or-timeout wait) and the caller should advance the clock to
+        the next actionable instant instead of spinning."""
+        it0 = time.perf_counter()
+        prod0 = tel.get_tracker().buckets["productive"]
+        comp0 = tel.get_tracker().buckets["compile"]
+        admitted = self.scheduler.admit(self.clock.now())
+        for slot, req in admitted:
+            self._prefill(slot, req)
+        active = self.scheduler.active()
+        if active:
+            self._decode(active)
+        self.iterations += 1
+        if self.heartbeat is not None:
+            self.heartbeat(self.iterations)
+        used = self.scheduler.allocator.used_blocks
+        self._blocks_peak = max(self._blocks_peak, used)
+        tel.gauge("serve/kv_blocks_used").set(used)
+        tel.gauge("serve/kv_blocks_peak").set(self._blocks_peak)
+        tel.gauge("serve/queue_depth").set(len(self.scheduler.queue))
+        tel.gauge("serve/active_requests").set(self.scheduler.num_active())
+        tracker = tel.get_tracker()
+        booked = ((tracker.buckets["productive"] - prod0)
+                  + (tracker.buckets["compile"] - comp0))
+        tracker.add("other",
+                    max(0.0, time.perf_counter() - it0 - booked))
+        return bool(admitted or active)
+
+    # -- closed-loop driving ------------------------------------------------
+
+    def run(self, trace=None, max_iterations: int = 1_000_000) -> Dict:
+        """Drive the engine until idle.  ``trace`` is an optional sorted
+        ``[(arrival_s, request_kwargs), ...]`` — requests are submitted
+        as the clock passes their arrival instants (closed loop: the
+        server's own pace decides when it looks at the queue).  Returns
+        ``self.results``."""
+        trace = list(trace or [])
+        i = 0
+        it = 0
+        while i < len(trace) or self.scheduler.has_work():
+            if it >= max_iterations:
+                raise RuntimeError(
+                    f"engine did not drain within {max_iterations} "
+                    f"iterations — wedged scheduler?")
+            now = self.clock.now()
+            while i < len(trace) and trace[i][0] <= now:
+                t_arr, kw = trace[i]
+                self.submit(arrival_s=t_arr, **kw)
+                i += 1
+            if not self.scheduler.has_work():
+                t0 = time.perf_counter()
+                self.clock.advance_to(trace[i][0])
+                tel.get_tracker().add(
+                    "stall", time.perf_counter() - t0)
+                continue
+            progress = self.step()
+            it += 1
+            if not progress:
+                # batch-forming (static fill-or-timeout): jump to the
+                # earliest instant something can happen — the next
+                # arrival or the oldest queued request aging past the
+                # batch wait — instead of spinning the iteration loop.
+                horizon = []
+                if i < len(trace):
+                    horizon.append(trace[i][0])
+                if self.scheduler.queue:
+                    horizon.append(self.scheduler.queue[0].arrival_s
+                                   + self.scheduler.static_batch_wait_s)
+                if horizon:
+                    t0 = time.perf_counter()
+                    self.clock.advance_to(min(horizon))
+                    tel.get_tracker().add(
+                        "stall", time.perf_counter() - t0)
+        return self.results
+
+    # -- reporting ----------------------------------------------------------
+
+    def summary(self, slo_ttft_ms: Optional[float] = None) -> dict:
+        """Latency/goodput aggregate for the report CLI and the load
+        bench: TTFT/TPOT percentiles over completed requests, completed
+        QPS over the measured makespan, and — given an SLO budget —
+        **goodput QPS**: completed requests whose TTFT met the budget,
+        per second of makespan (the MLPerf-style gate: latency under
+        load, not a ladder slope)."""
+        done = [r for r in self.results.values()
+                if r.status == "completed"]
+        rej = sum(1 for r in self.results.values()
+                  if r.status == "rejected")
+        out = {"mode": self.mode, "completed": len(done), "rejected": rej,
+               "slots": self.num_slots,
+               "kv_blocks_total": self.pool.num_blocks - 1,
+               "kv_blocks_peak": self._blocks_peak,
+               "kv_block_size": self.block_size,
+               "decode_iterations": sum(
+                   1 for e in self.batch_log if e[0] == "decode")}
+        if not done:
+            return out
+        ttft = np.array([r.ttft_s() for r in done]) * 1e3
+        tpots = [r.tpot_s() for r in done if r.tpot_s() is not None]
+        t0 = min(r.arrival_s for r in done)
+        t1 = max(r.done_s for r in done)
+        makespan = max(t1 - t0, 1e-9)
+        pct = lambda a, q: float(np.percentile(np.asarray(a), q))
+        out.update({
+            "ttft_ms_p50": pct(ttft, 50), "ttft_ms_p99": pct(ttft, 99),
+            "makespan_s": makespan,
+            "completed_qps": len(done) / makespan,
+            "tokens_out": int(sum(r.n_generated() for r in done)),
+        })
+        if tpots:
+            tpot = np.array(tpots) * 1e3
+            out["tpot_ms_p50"] = pct(tpot, 50)
+            out["tpot_ms_p99"] = pct(tpot, 99)
+        if slo_ttft_ms is not None:
+            good = int(np.sum(ttft <= slo_ttft_ms))
+            out["slo_ttft_ms"] = float(slo_ttft_ms)
+            out["goodput_qps"] = good / makespan
+            out["slo_attainment"] = good / len(done)
+        return out
+
+    def write_telemetry(self, logdir: str,
+                        slo_ttft_ms: Optional[float] = None,
+                        extra: Optional[dict] = None) -> str:
+        doc = {"serving": {**self.summary(slo_ttft_ms), **(extra or {})}}
+        return tel.write_telemetry_json(logdir, extra=doc)

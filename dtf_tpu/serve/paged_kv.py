@@ -1,0 +1,181 @@
+"""Paged (blocked) KV cache: fixed-size HBM blocks + a free-list allocator.
+
+The serving memory problem the contiguous cache cannot solve: a decode
+batch's requests have *different* lengths, and a per-slot contiguous
+cache must pad every slot to the model window — a 32-slot GPT-2-small
+server at max_len 1024 reserves ~0.6 GB of KV rows it mostly never
+writes.  Here the cache is ONE shared pool of fixed-size blocks
+(``block_size`` token rows each); a request owns only the blocks its
+actual prompt+generation needs, recorded in a per-request **block
+table** that maps logical position -> physical block.  Finished
+requests return their blocks to the free list, so short and long
+streams share the same HBM pool (the vLLM paged-attention memory
+model, applied to this repo's decode path).
+
+Split of responsibilities:
+
+* :class:`BlockAllocator` — pure-Python, deterministic free-list
+  (lowest-id-first so identical schedules produce identical physical
+  layouts; tests pin this).
+* :class:`KVPool` — the device arrays: ``k``/``v`` of shape
+  ``(L, num_blocks, block_size, KVH·Dh)`` plus scatter helpers.  Block
+  0 is the **trash block**: never allocated, the write target for
+  inactive decode slots (a static-shape decode step writes a row for
+  every slot; pointing dead slots at block 0 keeps their garbage out
+  of live blocks, and gathered trash rows are masked before softmax).
+* Per-request block tables live host-side in the scheduler; the decode
+  step receives them as a dense ``(slots, blocks_per_slot)`` int32
+  array where ``-1`` means "no block" (gathers clamp to the trash
+  block; masking makes the value irrelevant).
+
+CPU-sim honesty note: the decode step *gathers* each slot's blocks
+into logical order before attention (``pool[table]``), which
+materializes a transient contiguous view — correct everywhere, and
+exactly what the parity test leans on (the gather of a permuted table
+is bit-identical to the contiguous layout).  On real TPU hardware the
+gather would instead be a block-indexed DMA inside a paged decode
+kernel (a future ops/ kernel); the *pool residency* — the HBM claim —
+is what paging buys at either maturity level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+#: Physical block id reserved as the write sink for inactive slots /
+#: unassigned table entries.  Never handed out by the allocator.
+TRASH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Allocation failed — the admission path treats this as "stay
+    queued", never as a crash."""
+
+
+class BlockAllocator:
+    """Deterministic free-list over physical block ids ``1..num_blocks-1``
+    (block 0 is the trash block).
+
+    Lowest-id-first allocation: the same admission schedule always
+    produces the same physical layout, which the scheduler-determinism
+    tests pin (and which makes paged-vs-contiguous parity failures
+    reproducible instead of heisenbugs).
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (block {TRASH_BLOCK} is the reserved "
+                f"trash block), got {num_blocks}")
+        self.num_blocks = num_blocks
+        # sorted free list; pop from the front = lowest id first
+        self._free: List[int] = list(range(1, num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"asked for {n} KV blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks - 1} usable)")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"double free within one release: {blocks}")
+        for b in blocks:
+            if not (0 < b < self.num_blocks):
+                raise ValueError(f"freeing block {b} outside the pool")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        # keep the free list sorted so allocation order stays canonical
+        self._free = sorted(self._free + list(blocks))
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` KV rows (ceil division)."""
+    return -(-max(tokens, 0) // block_size)
+
+
+@dataclasses.dataclass
+class KVPool:
+    """The device-resident block pool for one model.
+
+    ``k``/``v``: ``(num_layers, num_blocks, block_size, KVH·Dh)`` in the
+    model dtype.  Functional updates (jax arrays are immutable): the
+    scatter helpers return NEW pool arrays; the engine threads them
+    through its jitted step exactly like the contiguous cache threads
+    through ``lax.scan`` in ``GPT.generate``.
+    """
+
+    k: "object"            # jax array
+    v: "object"
+    block_size: int
+
+    @classmethod
+    def create(cls, cfg, num_blocks: int, block_size: int,
+               dtype=None) -> "KVPool":
+        import jax.numpy as jnp
+
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        kvh = cfg.num_kv_heads or cfg.num_heads
+        hd = cfg.dim // cfg.num_heads
+        shape = (cfg.num_layers, num_blocks, block_size, kvh * hd)
+        dt = dtype or cfg.dtype
+        return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   block_size=block_size)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    def bytes_per_block(self) -> int:
+        """HBM bytes one block pins across both pool arrays."""
+        per = self.k.dtype.itemsize
+        l, _, bs, w = self.k.shape
+        return 2 * l * bs * w * per
+
+
+def dense_table(block_tables: List[Optional[List[int]]],
+                blocks_per_slot: int) -> np.ndarray:
+    """Host block tables (``None`` = empty slot) -> the dense
+    ``(slots, blocks_per_slot)`` int32 array the decode step consumes.
+    Unassigned entries are ``-1`` (the gather clamps them to the trash
+    block; the visibility mask makes the gathered value irrelevant)."""
+    out = np.full((len(block_tables), blocks_per_slot), -1, np.int32)
+    for i, tbl in enumerate(block_tables):
+        if tbl:
+            if len(tbl) > blocks_per_slot:
+                raise ValueError(
+                    f"slot {i} holds {len(tbl)} blocks > window "
+                    f"{blocks_per_slot}")
+            out[i, :len(tbl)] = tbl
+    return out
+
+
+def contiguous_table(num_slots: int, blocks_per_slot: int) -> np.ndarray:
+    """The identity block table: slot ``i`` owns blocks
+    ``[1 + i·nbs, 1 + (i+1)·nbs)`` of a pool sized
+    ``1 + num_slots·blocks_per_slot`` (block 0 stays the trash block).
+    This IS the contiguous per-slot cache — same decode code path, no
+    indirection benefit — and the baseline the paged parity test
+    compares against: paged gather(permuted table) must emit the same
+    tokens as gather(identity table)."""
+    base = 1 + np.arange(num_slots, dtype=np.int32)[:, None] * blocks_per_slot
+    return base + np.arange(blocks_per_slot, dtype=np.int32)[None, :]

@@ -71,6 +71,24 @@ METRICS = (
     "supervisor/restarts_total",
     "chaos/faults_fired_total",
     "data/fetch_retries_total",
+    # serving engine (dtf_tpu/serve): request lifecycle + SLO latency.
+    # submissions_total counts SUBMIT calls — a supervisor restart's
+    # replay re-counts its unfinished requests here, so it can exceed
+    # completed+rejected; those two reconcile per unique request.
+    "serve/submissions_total",
+    "serve/requests_completed",
+    "serve/requests_rejected",
+    "serve/tokens_generated_total",
+    "serve/prefill_tokens_total",
+    "serve/decode_iterations_total",
+    "serve/queue_depth",
+    "serve/active_requests",
+    "serve/slots",
+    "serve/kv_blocks_total",
+    "serve/kv_blocks_used",
+    "serve/kv_blocks_peak",
+    "serve/ttft_ms",              # per-request time-to-first-token
+    "serve/tpot_ms",              # per-request time-per-output-token
 )
 # spans (host-side tracer)
 SPANS = (
@@ -88,6 +106,8 @@ SPANS = (
     "data/prefetch_stall",
     "compile/aot_warmup",
     "comm/grad_sync",
+    "serve/prefill",
+    "serve/decode",
     "trainer/init",
     # instants
     "chaos/*",                    # chaos/<fault kind> firing marks

@@ -223,6 +223,40 @@ def render(report: dict, top: int = 10) -> str:
                          f"{wire_dtypes[int(widx)]:>12}")
         for n in sorted(comm):
             lines.append(f"  {n:<28} {comm[n]:12.5g}")
+    # Serving (dtf_tpu/serve): the SLO/goodput section — per-request
+    # TTFT/TPOT percentiles and goodput QPS come from the engine's
+    # summary (telemetry.json "serving"); the serve/* instruments below
+    # it are the raw lifecycle counters.  Keyed on presence, not on
+    # nonzero values (0 rejected IS the good reading).
+    serving = tel.get("serving")
+    srv = {}
+    for n, m in metrics.items():
+        if not n.startswith("serve/"):
+            continue
+        if m.get("type") == "histogram":
+            # never print a bare count under an ms-suffixed name — it
+            # reads as a latency; show the mean and the sample count
+            if m.get("count"):
+                srv[n + "_mean"] = m["sum"] / m["count"]
+                srv[n + "_count"] = m["count"]
+        elif m.get("value") is not None:
+            srv[n] = m["value"]
+    if serving or srv:
+        lines.append("Serving (SLO / goodput)")
+        if serving:
+            order = ("mode", "completed", "rejected", "completed_qps",
+                     "goodput_qps", "slo_ttft_ms", "slo_attainment",
+                     "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                     "tpot_ms_p99", "makespan_s", "tokens_out",
+                     "kv_blocks_peak", "kv_blocks_total")
+            for k in order:
+                if k in serving:
+                    v = serving[k]
+                    lines.append(f"  {k:<28} "
+                                 + (f"{v:>12}" if isinstance(v, str)
+                                    else f"{v:12.5g}"))
+        for n in sorted(srv):
+            lines.append(f"  {n:<28} {srv[n]:12.5g}")
     if "steps" in report:
         s = report["steps"]
         lines.append(f"Steps: {s['first']}..{s['last']}  "

@@ -241,5 +241,59 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: quantized-comm lane assertions (rc=$rc)"; }
   rm -rf "$qdir"
 fi
+# Serve lane (DESIGN.md §7): the closed-loop load generator on the CPU
+# sim under the deterministic virtual clock — continuous batching must
+# sustain >= 1.5x the static baseline's goodput QPS at the same p99
+# TTFT budget (serve_load --check); then a chaos'd supervised serve
+# session (--wedge_at crash + restart + health beats) whose telemetry
+# must render the Serving SLO section with the TTFT/TPOT instruments
+# and pass report --check.  Skip with NO_SERVE_LANE=1.
+if [ "${NO_SERVE_LANE:-0}" != "1" ]; then
+  echo "=== serve lane (continuous-vs-static load A/B + chaos'd server) ==="
+  sdir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.serve_load --preset tiny \
+      --clock virtual --qps 4,8,16,24 --requests 48 --mode both \
+      --check --json "$sdir/ab.json" > "$sdir/ab.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve load A/B (rc=$rc)"; tail -8 "$sdir/ab.log"; }
+  grep -q "CHECK OK" "$sdir/ab.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: serve A/B check line missing"; }
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 12 \
+      --qps 20 --clock virtual --wedge_at 3 --max_restarts 1 \
+      --health_dir "$sdir/health" --logdir "$sdir/run" \
+      > "$sdir/serve.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: chaos'd serve session (rc=$rc)"; tail -8 "$sdir/serve.log"; }
+  [ -s "$sdir/health/hb_0" ] \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: serve health beats missing"; }
+  python -m dtf_tpu.telemetry.report "$sdir/run" --check \
+      > "$sdir/report.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve report --check (rc=$rc)"; tail -5 "$sdir/report.log"; }
+  grep -q "Serving (SLO / goodput)" "$sdir/report.log" \
+    && grep -q "serve/ttft_ms" "$sdir/report.log" \
+    && grep -q "serve/tpot_ms" "$sdir/report.log" \
+    && grep -q "goodput_qps" "$sdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing serving SLO section"; }
+  python - "$sdir/ab.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+ab = doc["ab"]
+# ratio null = static sustained nothing at the SLO (continuous wins)
+assert ab["ratio"] is None or ab["ratio"] >= ab["min_ratio"], ab
+pts = doc["points"]
+assert all("ttft_ms_p50" in p and "ttft_ms_p99" in p for p in pts), \
+    "latency-vs-QPS curve incomplete"
+shown = "inf" if ab["ratio"] is None else f"{ab['ratio']:.2f}"
+print(f"serve lane OK: continuous {ab['continuous_sustained_qps']:.2f} "
+      f"qps vs static {ab['static_sustained_qps']:.2f} qps sustained at "
+      f"p99 TTFT <= {doc['slo_ttft_ms']:.0f} ms "
+      f"(ratio {shown}, bar {ab['min_ratio']}); "
+      f"{len(pts)} curve points")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve lane assertions (rc=$rc)"; }
+  rm -rf "$sdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

@@ -27,6 +27,9 @@ def pytest_configure(config):
         "markers", "slow: multi-process / long-running integration tests")
     config.addinivalue_line(
         "markers", "chaos: fault-injection / self-healing resilience tests")
+    config.addinivalue_line(
+        "markers", "serve: serving-engine tests (paged KV, scheduler, "
+                   "load bench)")
 
 
 # ---------------------------------------------------------------------------

@@ -293,6 +293,73 @@ class TestGuards:
                                     attn_impl=lambda q, k, v, m: q))
 
 
+class TestInt8Fused:
+    """--matmul_dtype int8 composing with --fused_block: the fused
+    kernels quantize the projection operands with nn/lowp.py's exact
+    format (per-output-channel weight scales quantized OUTSIDE the
+    pallas_call, per-token activation scales in-kernel, int8 x int8 ->
+    i32), so fused-int8 must track unfused-int8 — the quantization is
+    identical in both paths and integer accumulation is exact, leaving
+    only fp reduction-order noise in the attention core."""
+
+    @pytest.mark.parametrize("extra", [
+        {},
+        {"rope": True, "num_kv_heads": 2, "mlp_act": "swiglu"},
+    ])
+    def test_int8_loss_and_grads_match_unfused(self, extra):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        m0 = GPT(GPTConfig.tiny(use_flash=False, matmul_dtype="int8",
+                                **extra))
+        m1 = GPT(GPTConfig.tiny(use_flash=False, matmul_dtype="int8",
+                                fused_block=True, **extra))
+        p = m0.init(jax.random.key(1))
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(0, 128, (4, 32)), jnp.int32)
+        l0, g0 = jax.value_and_grad(lambda p: m0.loss(p, toks)[0])(p)
+        l1, g1 = jax.value_and_grad(lambda p: m1.loss(p, toks)[0])(p)
+        # forward: both paths quantize identically, int8 sums are exact
+        assert abs(float(l0) - float(l1)) < 3e-5, (float(l0), float(l1))
+        # backward: both are straight-through estimators, but the fused
+        # path recomputes attention from f32-weight q/k/v while the
+        # unfused STE saw the quantized activations — looser tolerance
+        _tree_close(g0, g1, 1e-2, 1e-2)
+
+    def test_int8_halfblocks_match_lowp_matmul(self):
+        """The attn/mlp half-block wrappers with matmul_dtype='int8'
+        reproduce a hand-built lowp reference: quantizing the packed
+        (D, W) qkv matrix per column == quantizing q/k/v separately."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        m0 = GPT(GPTConfig.tiny(use_flash=False, matmul_dtype="int8"))
+        p = m0.init(jax.random.key(2))
+        lp = jax.tree.map(lambda a: a[0], p["layers"])
+        x = jnp.asarray(
+            np.random.default_rng(2).standard_normal((2, 16, 32)),
+            jnp.float32)
+        y_ref = m0.block.apply(lp, x)            # unfused int8 block
+        x1 = fused_attn_block(x, lp["attn"], lp["ln1"], num_heads=4,
+                              causal=True, prenorm=True,
+                              matmul_dtype="int8")
+        y = fused_mlp_block(x1, lp["fc1"], lp["fc2"], lp["ln2"],
+                            prenorm=True, matmul_dtype="int8")
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_fused_rejects_bf16_fp8_still(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        for md in ("bf16", "fp8"):
+            with pytest.raises(ValueError, match="fused"):
+                GPT(GPTConfig.tiny(fused_block=True, matmul_dtype=md))
+        with pytest.raises(ValueError, match="int8"):
+            fused_mlp_block(jnp.zeros((1, 8, 32)),
+                            {"w": jnp.zeros((32, 64)),
+                             "b": jnp.zeros((64,))},
+                            {"w": jnp.zeros((64, 32)),
+                             "b": jnp.zeros((32,))},
+                            {"scale": jnp.ones((32,)),
+                             "bias": jnp.zeros((32,))},
+                            matmul_dtype="fp8")
+
+
 @pytest.mark.slow
 class TestModelIntegration:
     """fused_block=True must reproduce the unfused model's loss and grads

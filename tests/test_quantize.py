@@ -436,10 +436,15 @@ class TestGPTMatmulDtype:
                 jnp.linalg.norm(l0, axis=-1) * jnp.linalg.norm(lq, axis=-1))
             assert float(cos.min()) > bound, (dt, float(cos.min()))
 
-    def test_fused_block_conflict_rejected(self):
+    def test_fused_block_composition_contract(self):
+        """int8 now COMPOSES with fused_block (the fused kernels grew an
+        int8 operand path — tests/test_block_kernel.py::TestInt8Fused
+        pins parity); bf16/fp8 still conflict, loudly."""
         from dtf_tpu.models.gpt import GPT, GPTConfig
-        with pytest.raises(ValueError, match="matmul_dtype"):
-            GPT(GPTConfig.tiny(matmul_dtype="int8", fused_block=True))
+        GPT(GPTConfig.tiny(matmul_dtype="int8", fused_block=True))
+        for md in ("bf16", "fp8"):
+            with pytest.raises(ValueError, match="matmul_dtype"):
+                GPT(GPTConfig.tiny(matmul_dtype=md, fused_block=True))
 
     def test_bad_dtype_rejected_at_construction(self):
         from dtf_tpu.models.gpt import GPT, GPTConfig

@@ -1,0 +1,558 @@
+"""Serving engine (dtf_tpu/serve): paged-KV parity, scheduler
+determinism, admission control, continuous-batching behavior, and the
+closed-loop load generator.
+
+The two ISSUE-level pins live here:
+
+* **paged parity** — the paged/blocked KV cache must emit tokens
+  IDENTICAL to the contiguous-cache decode path (``GPT.generate``)
+  under a pinned seed, greedy and sampled, single-device and TP mesh,
+  including pool layouts fragmented by request churn;
+* **scheduler determinism** — the same seeded arrival trace under the
+  virtual clock reproduces the same batch-composition sequence exactly
+  (``engine.batch_log``), which is what makes the load bench's
+  continuous-vs-static A/B a measurement instead of a lottery.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.serve import (BlockAllocator, PoolExhausted, Request,
+                           Scheduler, ServingEngine, VirtualClock,
+                           blocks_for, contiguous_table)
+from dtf_tpu.serve.paged_kv import TRASH_BLOCK, dense_table
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    """One model object for the whole module: serve/decode.py caches
+    compiled steps on the model keyed by geometry, so sharing it means
+    every engine in this file reuses the same executables."""
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _mk_engine(model, params, **kw):
+    kw.setdefault("clock", VirtualClock())
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 8)
+    return ServingEngine(model, params, **kw)
+
+
+def _mk_trace(rng, n, *, qps=50.0, p_lens=(3, 5, 8, 12), o_lens=(3, 6, 10),
+              temperature=0.0, vocab=128):
+    trace, t = [], 0.0
+    for rid in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        p = int(rng.choice(p_lens))
+        trace.append((t, {
+            "rid": rid,
+            "prompt": rng.integers(0, vocab, (p,)).astype(np.int32),
+            "max_new_tokens": int(rng.choice(o_lens)),
+            "temperature": temperature,
+        }))
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# allocator + tables (pure Python, no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestBlockAllocator:
+    def test_lowest_id_first_and_canonical_reuse(self):
+        a = BlockAllocator(8)                      # usable ids 1..7
+        assert a.allocate(3) == [1, 2, 3]
+        assert a.allocate(2) == [4, 5]
+        a.free([2, 4])
+        # freed ids come back sorted: same schedule -> same layout
+        assert a.allocate(3) == [2, 4, 6]
+        assert a.used_blocks == 6 and a.free_blocks == 1
+
+    def test_exhaustion_is_backpressure_not_crash(self):
+        a = BlockAllocator(4)
+        a.allocate(2)
+        assert not a.can_allocate(2)
+        with pytest.raises(PoolExhausted):
+            a.allocate(2)
+        assert a.free_blocks == 1                  # failed alloc took nothing
+
+    def test_free_validation(self):
+        a = BlockAllocator(4)
+        got = a.allocate(2)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(got + got[:1])
+        with pytest.raises(ValueError, match="outside"):
+            a.free([TRASH_BLOCK])
+        with pytest.raises(ValueError, match="outside"):
+            a.free([99])
+        with pytest.raises(ValueError, match=">= 2"):
+            BlockAllocator(1)
+
+    def test_blocks_for(self):
+        assert blocks_for(0, 4) == 0
+        assert blocks_for(1, 4) == 1
+        assert blocks_for(4, 4) == 1
+        assert blocks_for(5, 4) == 2
+
+
+class TestTables:
+    def test_dense_table_padding_and_overflow(self):
+        t = dense_table([None, [3, 5], [2]], 3)
+        np.testing.assert_array_equal(
+            t, [[-1, -1, -1], [3, 5, -1], [2, -1, -1]])
+        with pytest.raises(ValueError, match="window"):
+            dense_table([[1, 2, 3, 4]], 3)
+
+    def test_contiguous_table_is_identity_layout(self):
+        t = contiguous_table(3, 4)
+        np.testing.assert_array_equal(
+            t, [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]])
+        assert TRASH_BLOCK not in t
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, p_len=4, max_new=4, t=0.0):
+    return Request(rid=rid, prompt=np.zeros((p_len,), np.int32),
+                   max_new_tokens=max_new, arrival_s=t)
+
+
+def _sched(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 4)
+    kw.setdefault("allocator",
+                  BlockAllocator(1 + kw["num_slots"] * kw["blocks_per_slot"]))
+    return Scheduler(**kw)
+
+
+class TestScheduler:
+    def test_continuous_refills_on_release(self):
+        s = _sched()
+        for i in range(3):
+            assert s.submit(_req(i), 0.0) == "queued"
+        got = s.admit(0.0)
+        assert [r.rid for _, r in got] == [0, 1]
+        assert s.admit(0.0) == []                 # slots full
+        s.release(got[0][1])
+        got2 = s.admit(0.0)
+        assert [r.rid for _, r in got2] == [2]    # same-iteration refill
+        assert got2[0][0] == got[0][0]            # reuses the freed slot
+
+    def test_admission_rejections(self):
+        s = _sched(max_queue=1)
+        assert s.submit(_req(0, p_len=14, max_new=4), 0.0) == \
+            "rejected_too_long"                   # 18 > window 16
+        assert s.submit(_req(1, max_new=0), 0.0) == "rejected_empty"
+        assert s.submit(_req(2), 0.0) == "queued"
+        assert s.submit(_req(3), 0.0) == "rejected_queue_full"
+
+    def test_worst_case_block_reservation(self):
+        s = _sched()
+        # prompt 5 pads to 8 rows (2 blocks); decode writes rows 5..7
+        # land inside the padding, so 2 blocks cover prompt+4 new tokens
+        assert s._blocks_needed(_req(0, p_len=5, max_new=4)) == 2
+        # 6 new tokens write rows 5..9 -> 3 blocks
+        assert s._blocks_needed(_req(0, p_len=5, max_new=6)) == 3
+
+    def test_request_larger_than_pool_rejected_not_wedged(self):
+        """A request needing more blocks than the WHOLE pool holds must
+        be rejected at submit — queued, it could never be admitted
+        (nothing in flight can free enough) and would head-of-line
+        block everything behind it forever."""
+        s = _sched(num_slots=2, blocks_per_slot=8,
+                   allocator=BlockAllocator(5))     # 4 usable blocks
+        big = _req(0, p_len=14, max_new=8)          # needs 6 blocks <= window
+        assert s._blocks_needed(big) <= s.blocks_per_slot
+        assert s.submit(big, 0.0) == "rejected_too_long"
+        assert s.submit(_req(1, p_len=4, max_new=4), 0.0) == "queued"
+        assert [r.rid for _, r in s.admit(0.0)] == [1]
+
+    def test_reservation_makes_midflight_exhaustion_impossible(self):
+        # pool of 3 usable blocks, requests need 2 each: second stays
+        # QUEUED (not admitted then crashed) until the first releases
+        s = _sched(num_slots=2, allocator=BlockAllocator(4))
+        s.submit(_req(0, p_len=5, max_new=4), 0.0)
+        s.submit(_req(1, p_len=5, max_new=4), 0.0)
+        got = s.admit(0.0)
+        assert [r.rid for _, r in got] == [0]
+        assert len(s.queue) == 1
+        s.release(got[0][1])
+        assert [r.rid for _, r in s.admit(0.0)] == [1]
+
+    def test_prefill_budget_drips_long_prompts(self):
+        # budget = one 16-token window; three 16-token prompts arrive at
+        # once -> one prefill per admit call (the first always goes
+        # through), so in-flight decodes never stall behind a wave
+        s = _sched(num_slots=3, prefill_token_budget=16,
+                   allocator=BlockAllocator(64))
+        for i in range(3):
+            s.submit(_req(i, p_len=12, max_new=4), 0.0)
+        assert len(s.admit(0.0)) == 1
+        assert len(s.admit(0.0)) == 1
+        assert len(s.admit(0.0)) == 1
+
+    def test_static_mode_fill_or_timeout(self):
+        s = _sched(num_slots=3, mode="static", static_batch_wait_s=0.05)
+        s.submit(_req(0), 0.0)
+        s.submit(_req(1), 0.01)
+        assert s.admit(0.02) == []                # not full, not aged
+        got = s.admit(0.05)                       # aged out: batch forms
+        assert [r.rid for _, r in got] == [0, 1]
+        s.submit(_req(2), 0.06)
+        assert s.admit(1.0) == []                 # batch still active
+        for _, r in got:
+            s.release(r)
+        assert [r.rid for _, r in s.admit(1.0)] == [2]
+
+    def test_static_full_batch_goes_immediately(self):
+        s = _sched(num_slots=2, mode="static", static_batch_wait_s=99.0)
+        s.submit(_req(0), 0.0)
+        s.submit(_req(1), 0.0)
+        assert len(s.admit(0.0)) == 2             # full: no wait
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            _sched(mode="bursty")
+
+
+# ---------------------------------------------------------------------------
+# paged-KV parity (the ISSUE pin)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedParity:
+    """Paged decode == contiguous decode, token for token.  The solo
+    reference (one request, fresh engine, blocks 1..n in order) IS the
+    identity block table — the contiguous per-slot cache; the shared
+    engines run permuted/fragmented tables over a churning pool."""
+
+    def _reference_greedy(self, model, params, prompts, new):
+        outs = []
+        for p, n in zip(prompts, new):
+            out = model.generate(params, jnp.asarray(p)[None], n,
+                                 temperature=0.0)
+            outs.append(np.asarray(out)[0, len(p):].tolist())
+        return outs
+
+    def test_greedy_matches_contiguous_generate(self, tiny_model):
+        """4 requests churn through 3 slots of a shared 18-block pool:
+        the block tables fragment (freed blocks are reused out of
+        order), yet every request's tokens equal the contiguous-cache
+        ``GPT.generate`` run."""
+        model, params = tiny_model
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 8, 3, 12)]
+        new = [10, 6, 12, 7]
+        refs = self._reference_greedy(model, params, prompts, new)
+        eng = _mk_engine(model, params, num_blocks=1 + 3 * 6,
+                         blocks_per_slot=6)
+        res = eng.run([(0.01 * i, dict(rid=i, prompt=p, max_new_tokens=n))
+                       for i, (p, n) in enumerate(zip(prompts, new))])
+        for i in range(4):
+            assert res[i].tokens == refs[i], f"request {i} diverged"
+
+    def test_greedy_tp_mesh_matches_single(self, tiny_model, mesh_2d):
+        """TP-sharded params through the paged engine: GSPMD inserts the
+        collectives, the tokens must not change (the serving-side analog
+        of test_gpt's TestShardedDecode)."""
+        from dtf_tpu.parallel import sharding as sh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        model, params = tiny_model
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 9)]
+        new = [8, 8]
+        refs = self._reference_greedy(model, params, prompts, new)
+        sp = jax.device_put(params,
+                            sh.apply_rules(model.axes(), mesh_2d))
+        eng = _mk_engine(model, sp, num_slots=2)
+        res = eng.run([(0.0, dict(rid=i, prompt=p, max_new_tokens=n))
+                       for i, (p, n) in enumerate(zip(prompts, new))])
+        for i in range(2):
+            assert res[i].tokens == refs[i], f"request {i} diverged on TP"
+
+    def test_sampled_pinned_seed_composition_independent(self, tiny_model):
+        """temperature=1.0 under a pinned engine seed: a request's draws
+        come from its own (seed, rid) stream, so solo (= identity/
+        contiguous table), continuous (fragmented shared pool), and
+        static batching all emit IDENTICAL tokens."""
+        model, params = tiny_model
+        rng = np.random.default_rng(11)
+        trace = _mk_trace(rng, 5, temperature=1.0)
+
+        def run(mode, solo_rid=None):
+            eng = _mk_engine(model, params, mode=mode, seed=42,
+                             num_blocks=1 + 3 * 8)
+            t = (trace if solo_rid is None else
+                 [(0.0, kw) for _, kw in trace if kw["rid"] == solo_rid])
+            return {r.rid: r.tokens for r in eng.run(t).values()
+                    if r.status == "completed"}
+
+        cont = run("continuous")
+        stat = run("static")
+        solo = {}
+        for rid in cont:
+            solo.update(run("continuous", solo_rid=rid))
+        assert cont == stat, "continuous vs static tokens diverged"
+        assert cont == solo, "shared-pool vs solo tokens diverged"
+
+    def test_pool_fully_recycled_after_drain(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params, num_blocks=1 + 3 * 8)
+        rng = np.random.default_rng(5)
+        eng.run(_mk_trace(rng, 6))
+        assert eng.scheduler.allocator.used_blocks == 0
+        assert eng._blocks_peak > 0
+        assert eng.scheduler.allocator.allocate(1) == [1]  # canonical again
+
+
+# ---------------------------------------------------------------------------
+# scheduler determinism (the other ISSUE pin)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerDeterminism:
+    def test_same_trace_same_batch_compositions(self, tiny_model):
+        model, params = tiny_model
+
+        def run():
+            eng = _mk_engine(model, params, seed=7,
+                             num_blocks=1 + 3 * 8)
+            eng.run(_mk_trace(np.random.default_rng(13), 8, qps=30.0))
+            return eng.batch_log
+
+        log_a, log_b = run(), run()
+        assert log_a == log_b
+        assert any(e[0] == "decode" for e in log_a)
+
+    def test_continuous_batching_actually_joins_in_flight(self, tiny_model):
+        """The whole point: decode batch composition must CHANGE while
+        earlier members are still in flight (a joined request decodes
+        next to one admitted earlier)."""
+        model, params = tiny_model
+        eng = _mk_engine(model, params, num_blocks=1 + 3 * 8)
+        eng.run(_mk_trace(np.random.default_rng(17), 8, qps=25.0,
+                          o_lens=(4, 16)))
+        decodes = [set(e[1]) for e in eng.batch_log if e[0] == "decode"]
+        joined = any(b - a and b & a
+                     for a, b in zip(decodes, decodes[1:]))
+        assert joined, "no decode batch gained a member mid-flight"
+
+    def test_static_never_mixes_generations(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params, mode="static",
+                         num_blocks=1 + 3 * 8)
+        eng.run(_mk_trace(np.random.default_rng(19), 6, qps=25.0))
+        decodes = [set(e[1]) for e in eng.batch_log if e[0] == "decode"]
+        for a, b in zip(decodes, decodes[1:]):
+            assert not (b - a) or not (b & a), \
+                "static batch admitted mid-flight"
+
+
+# ---------------------------------------------------------------------------
+# engine behavior
+# ---------------------------------------------------------------------------
+
+
+class TestEngineBehavior:
+    def test_streaming_tokens_arrive_in_order(self, tiny_model):
+        model, params = tiny_model
+        seen = []
+        eng = _mk_engine(model, params,
+                         on_token=lambda r, t, d: seen.append(
+                             (r.rid, t, d)))
+        res = eng.run(_mk_trace(np.random.default_rng(23), 3))
+        for rid, req in res.items():
+            stream = [(t, d) for r, t, d in seen if r == rid]
+            assert [t for t, _ in stream] == req.tokens
+            assert [d for _, d in stream] == \
+                [False] * (len(stream) - 1) + [True]
+
+    def test_eos_stops_early_and_frees_blocks(self, tiny_model):
+        """Deterministic EOS: pick the greedy path's 3rd token as the
+        eos id — the engine must stop there (3 tokens, not max_new)."""
+        model, params = tiny_model
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, 128, (6,)).astype(np.int32)
+        ref = np.asarray(model.generate(
+            params, jnp.asarray(prompt)[None], 10,
+            temperature=0.0))[0, 6:].tolist()
+        eos = ref[2]
+        eng = _mk_engine(model, params)
+        res = eng.run([(0.0, dict(rid=0, prompt=prompt,
+                                  max_new_tokens=10, eos_id=eos))])
+        assert res[0].tokens == ref[:3]
+        assert res[0].tokens[-1] == eos
+        assert eng.scheduler.allocator.used_blocks == 0
+
+    def test_rejected_requests_land_in_results(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params, max_queue=64)
+        req = eng.submit(np.zeros((40,), np.int32), 40)  # > window 32
+        assert req.status == "rejected"
+        assert eng.results[req.rid] is req
+        assert eng.summary()["rejected"] == 1
+
+    def test_tiny_pool_defers_but_completes_all(self, tiny_model):
+        """Pool sharing under pressure: 8 requests through a pool that
+        holds ~2 windows — admissions wait for blocks, nothing crashes,
+        everything completes, and peak usage respects the pool."""
+        model, params = tiny_model
+        eng = _mk_engine(model, params, num_blocks=9)   # 8 usable blocks
+        res = eng.run(_mk_trace(np.random.default_rng(31), 8, qps=100.0))
+        assert sum(r.status == "completed" for r in res.values()) == 8
+        assert eng._blocks_peak <= 8
+
+    def test_summary_latency_and_goodput(self, tiny_model):
+        import dtf_tpu.telemetry as tel
+        model, params = tiny_model
+        tel.reset()
+        eng = _mk_engine(model, params)
+        eng.run(_mk_trace(np.random.default_rng(37), 5, qps=40.0))
+        s = eng.summary(slo_ttft_ms=1e6)
+        assert s["completed"] == 5
+        assert s["ttft_ms_p50"] <= s["ttft_ms_p99"]
+        assert s["tpot_ms_p50"] > 0
+        assert s["goodput_qps"] == pytest.approx(s["completed_qps"])
+        assert s["slo_attainment"] == 1.0
+        # an impossible SLO zeroes goodput but not completion
+        s2 = eng.summary(slo_ttft_ms=0.0)
+        assert s2["goodput_qps"] == 0.0 and s2["completed"] == 5
+        h = tel.histogram("serve/ttft_ms")
+        assert h.count == 5 and h.min >= 0.0
+
+    def test_write_telemetry_report_renders_serving(self, tiny_model,
+                                                    tmp_path):
+        from dtf_tpu.telemetry import report as rep
+        import dtf_tpu.telemetry as tel
+        model, params = tiny_model
+        tel.reset()
+        eng = _mk_engine(model, params)
+        eng.run(_mk_trace(np.random.default_rng(41), 4))
+        path = eng.write_telemetry(str(tmp_path), slo_ttft_ms=500.0)
+        doc = json.load(open(path))
+        assert doc["serving"]["completed"] == 4
+        text = rep.render(rep.build_report(str(tmp_path)))
+        assert "Serving (SLO / goodput)" in text
+        assert "goodput_qps" in text and "serve/requests_completed" in text
+
+    def test_flash_block_size_guard(self):
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny(use_flash=True))
+        with pytest.raises(ValueError, match="multiple of 8"):
+            ServingEngine(model, None, block_size=4)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop load generator
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestServeCLI:
+    """``python -m dtf_tpu.serve`` end to end, in-process (each call
+    builds a fresh model, so these carry the slow marker; the full-suite
+    serve lane drives the same paths from the shell)."""
+
+    def test_demo_completes_and_reports(self, capsys):
+        from dtf_tpu.serve.__main__ import main
+        rc = main(["--preset", "tiny", "--demo", "5", "--qps", "20",
+                   "--clock", "virtual", "--seed", "1"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["completed"] == 5
+        assert summary["completed_all_attempts"] == 5
+        assert summary["ttft_ms_p99"] >= summary["ttft_ms_p50"] >= 0
+
+    def test_wedge_supervisor_restart_replays(self, tmp_path, capsys):
+        """Resilience spine reuse: a server wedged at iteration 2 of
+        attempt 0 restarts under the supervisor and REPLAYS the
+        unfinished requests; health beats land in --health_dir."""
+        import os
+        from dtf_tpu.serve.__main__ import main
+        hdir = str(tmp_path / "health")
+        rc = main(["--preset", "tiny", "--demo", "4", "--qps", "50",
+                   "--clock", "virtual", "--seed", "2",
+                   "--wedge_at", "2", "--max_restarts", "1",
+                   "--health_dir", hdir])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["completed_all_attempts"] == 4
+        beat = os.path.join(hdir, "hb_0")
+        assert os.path.exists(beat)
+        assert int(open(beat).read().split()[0]) > 0
+
+    def test_wedge_without_restart_budget_fails(self, capsys):
+        from dtf_tpu.resilience.supervisor import SupervisorGaveUp
+        from dtf_tpu.serve.__main__ import main
+        with pytest.raises(SupervisorGaveUp):
+            main(["--preset", "tiny", "--demo", "4", "--qps", "50",
+                  "--clock", "virtual", "--wedge_at", "1",
+                  "--max_restarts", "0"])
+
+
+class TestLoadGen:
+    def test_poisson_trace_seeded_and_rate_scaled(self):
+        from dtf_tpu.bench.serve_load import poisson_trace
+        kw = dict(seed=5, n_requests=12, prompt_lens=[4, 8],
+                  output_lens=[2, 6], vocab_size=128)
+        a = poisson_trace(qps=4.0, **kw)
+        b = poisson_trace(qps=4.0, **kw)
+        fast = poisson_trace(qps=8.0, **kw)
+        assert [t for t, _ in a] == [t for t, _ in b]
+        for (ta, kwa), (tf, kwf) in zip(a, fast):
+            assert tf == pytest.approx(ta / 2.0)   # unit-rate chain
+            np.testing.assert_array_equal(kwa["prompt"], kwf["prompt"])
+
+    def test_sustained_goodput_selection(self):
+        from dtf_tpu.bench.serve_load import sustained_goodput
+        pts = [{"offered_qps": 4, "ttft_ms_p99": 50, "goodput_qps": 3.5},
+               {"offered_qps": 8, "ttft_ms_p99": 90, "goodput_qps": 7.0},
+               {"offered_qps": 16, "ttft_ms_p99": 900, "goodput_qps": 9.0}]
+        out = sustained_goodput(pts, budget_ms=100.0)
+        assert out["sustained_goodput_qps"] == 7.0
+        assert out["at_offered_qps"] == 8
+        none = sustained_goodput(pts, budget_ms=10.0)
+        assert none["sustained_goodput_qps"] == 0.0
+        assert none["at_offered_qps"] is None
+
+    def test_check_needs_both_modes(self):
+        from dtf_tpu.bench import serve_load
+        with pytest.raises(SystemExit):
+            serve_load.main(["--check", "--mode", "continuous"])
+
+    def test_ab_continuous_beats_static_on_goodput(self, tiny_model):
+        """The acceptance bar, in-process on the virtual clock: at the
+        same p99 TTFT budget, continuous batching sustains >= 1.5x the
+        static baseline's goodput QPS (deterministic — the cost model
+        and trace are seeded)."""
+        import argparse
+        from dtf_tpu.bench.serve_load import AB_MIN_RATIO, sweep
+        model, params = tiny_model
+        ns = argparse.Namespace(
+            mode="both", qps_list=[8.0, 20.0], requests=32,
+            prompt_lens_list=[4, 8, 16], output_lens_list=[2, 8, 32],
+            temperature=0.0, top_k=0, top_p=1.0, slots=4, block_size=16,
+            pool_blocks=None, max_queue=256, slo_ttft_ms=300.0,
+            clock="virtual", seed=0)
+        out = sweep(model, params, ns)
+        ab = out["ab"]
+        assert ab["ratio"] >= AB_MIN_RATIO, ab
+        # the curve exists: every point carries the percentile fields
+        for pt in out["points"]:
+            assert {"ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                    "offered_qps"} <= set(pt)
