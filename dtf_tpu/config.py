@@ -157,7 +157,8 @@ class TrainConfig:
     # reduce-scatter overlaps the next microbatch's backward (pair with
     # --grad_accum > 1 and, on TPU, --xla_overlap).  zero1* strategies
     # run the explicit shard_map step (implicit mode auto-switches) and
-    # need an elementwise optimizer (sgd/momentum/adam/adamw).
+    # need an elementwise optimizer (sgd/momentum/adam/adamw) or lamb
+    # (trust-ratio norms psum'd across shards); adafactor is rejected.
     grad_sync: str = "dense"
     # Reduced-precision collective wire format for gradient sync
     # (EQuARX-motivated, PAPERS.md): "bf16" ships (g/N).astype(bf16) —

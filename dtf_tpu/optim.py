@@ -218,7 +218,18 @@ def lamb(lr: "float | Callable", b1: float = 0.9, b2: float = 0.999,
          eps: float = 1e-6, weight_decay: float = 0.01) -> Optimizer:
     """LAMB (You et al. 2020): Adam with per-layer trust-ratio scaling —
     the large-batch BERT optimizer (the BASELINE.json BERT config's path
-    to big global batches on wide meshes)."""
+    to big global batches on wide meshes).
+
+    Not elementwise (the trust ratio is a per-TENSOR norm pair), but the
+    norms are plain sums of squares — so ZeRO-1 weight-update sharding
+    can still run it by segment-summing each shard's contribution and
+    ``psum``-ing across the data axis (the same trick
+    :func:`clip_by_global_norm` uses for the global clip norm).  The
+    ``_lamb_args`` introspection attribute below is that path's hook:
+    :class:`~dtf_tpu.parallel.grad_sync.GradSyncEngine` rebuilds the
+    update against its bucket layout from these hyperparameters
+    (``grad_sync._build_sharded_lamb``), exactly as the clip wrapper is
+    rebuilt partition-aware from ``_clip_max_norm``."""
     inner = adam(1.0, b1=b1, b2=b2, eps=eps)   # raw Adam direction
 
     def update(grads, state, params):
@@ -237,6 +248,8 @@ def lamb(lr: "float | Callable", b1: float = 0.9, b2: float = 0.999,
 
         return jax.tree_util.tree_map(per_leaf, dirs, params), state
 
+    update._lamb_args = {"lr": lr, "b1": b1, "b2": b2, "eps": eps,
+                         "weight_decay": weight_decay}
     return Optimizer(inner.init, update)
 
 

@@ -48,11 +48,16 @@ from the step rng, so trajectories stay reproducible).
 
 Sharding the update requires the update rule to commute with partitioning
 the flattened parameter vector — true for ELEMENTWISE optimizers
-(sgd/momentum/adam/adamw, tagged ``Optimizer.elementwise``), not for
-adafactor's factored moments or LAMB's per-tensor trust ratios; the engine
-rejects those up front.  ``clip_by_global_norm`` wrappers are re-derived
-with the data axis so the clip scale psums local squared norms back into
-the true global norm (bit-for-bit the same policy as dense clipping).
+(sgd/momentum/adam/adamw, tagged ``Optimizer.elementwise``), and for LAMB
+via a shard-aware rebuild: its per-tensor trust-ratio norms are plain
+sums of squares, so each shard segment-sums its contribution per tensor
+and one psum over the data axis recovers the global norms (the
+large-batch path for zero1 scenario cells; see ``_build_sharded_lamb``).
+adafactor's factored row/col moments need whole-tensor geometry the flat
+bucket layout destroys and stay rejected up front.
+``clip_by_global_norm`` wrappers are re-derived with the data axis so the
+clip scale psums local squared norms back into the true global norm
+(bit-for-bit the same policy as dense clipping).
 """
 
 from __future__ import annotations
@@ -238,26 +243,44 @@ class GradSyncEngine:
         if bucket_mb <= 0:
             raise ValueError(f"--grad_bucket_mb must be > 0, got {bucket_mb}")
         # A clip_by_global_norm wrapper computed on shards would clip each
-        # shard by its LOCAL norm; rebuild it partition-aware (psum over
-        # the data axis) so zero1 clipping applies the same global scale
-        # as dense.
+        # shard by its LOCAL norm; unwrap it here and re-derive it
+        # partition-aware (psum over the data axis) in prepare(), so zero1
+        # clipping applies the same global scale as dense.
+        self._clip_max_norm: Optional[float] = None
         inner = getattr(optimizer.update, "_clip_inner", None)
         if inner is not None:
-            optimizer = optim_lib.clip_by_global_norm(
-                inner, optimizer.update._clip_max_norm, axis=axes[0])
+            self._clip_max_norm = optimizer.update._clip_max_norm
+            optimizer = inner
+        # Non-elementwise updates don't commute with partitioning the
+        # flattened parameter vector in general — but LAMB's only
+        # cross-element structure is per-TENSOR norm pairs, and a norm is
+        # a plain sum of squares: prepare() re-derives it shard-aware
+        # (segment sums over the bucket layout + psum over the data axis,
+        # the clip wrapper's trick — see _build_sharded_lamb).  adafactor
+        # stays rejected: its factored row/col moments need whole-tensor
+        # geometry the flat bucket layout destroys.
+        self._lamb_args: Optional[dict] = None
         if not optimizer.elementwise:
-            raise ValueError(
-                f"--grad_sync zero1 requires an ELEMENTWISE optimizer "
-                f"(sgd/momentum/adam/adamw): the sharded update must equal "
-                f"the full update restricted to each shard, which "
-                f"adafactor's factored moments and lamb's per-tensor trust "
-                f"ratios violate.  Fall back to `--grad_sync dense`: it "
-                f"supports every optimizer but REPLICATES the full "
-                f"optimizer state on all {int(mesh.shape[axes[0]])} "
-                f"devices of the '{axes[0]}' axis — N x the per-device "
-                f"state bytes zero1 would pay (DESIGN.md §4.1 quantifies "
-                f"the cost; comm/optimizer_state_bytes measures it)")
+            self._lamb_args = getattr(optimizer.update, "_lamb_args", None)
+            if self._lamb_args is None:
+                raise ValueError(
+                    f"--grad_sync zero1 requires an optimizer whose update "
+                    f"commutes with partitioning the flattened parameter "
+                    f"vector: elementwise rules (sgd/momentum/adam/adamw), "
+                    f"or lamb (its per-tensor trust-ratio norms are psum'd "
+                    f"across shards).  adafactor's factored row/col "
+                    f"moments need whole-tensor geometry the bucket layout "
+                    f"destroys.  Fall back to `--grad_sync dense`: it "
+                    f"supports every optimizer but REPLICATES the full "
+                    f"optimizer state on all {int(mesh.shape[axes[0]])} "
+                    f"devices of the '{axes[0]}' axis — N x the per-device "
+                    f"state bytes zero1 would pay (DESIGN.md §4.1 "
+                    f"quantifies the cost; comm/optimizer_state_bytes "
+                    f"measures it)")
         self.strategy = strategy
+        # The base (clip-unwrapped) optimizer; prepare() derives the
+        # layout-aware self.opt from it, so prepare stays idempotent.
+        self._opt_base = optimizer
         self.opt = optimizer
         self.mesh = mesh
         self.axis = axes[0]
@@ -277,9 +300,18 @@ class GradSyncEngine:
 
     def prepare(self, params_shapes: Any) -> "GradSyncEngine":
         """Freeze the bucket layout + optimizer-state specs from the
-        model's (eval_shape'd or real) parameter tree."""
+        model's (eval_shape'd or real) parameter tree, and re-derive the
+        partition-aware optimizer wrappers that need the layout (the
+        sharded LAMB update, the psum'd clip wrapper)."""
         self.layout = BucketLayout.build(params_shapes, self.n_shards,
                                          self.bucket_bytes)
+        opt = self._opt_base
+        if self._lamb_args is not None:
+            opt = self._build_sharded_lamb()
+        if self._clip_max_norm is not None:
+            opt = optim_lib.clip_by_global_norm(
+                opt, self._clip_max_norm, axis=self.axis)
+        self.opt = opt
         bucket_sds = {
             k: jax.ShapeDtypeStruct((pad,), jnp.float32)
             for k, pad in zip(self.layout.keys, self.layout.padded)}
@@ -303,6 +335,72 @@ class GradSyncEngine:
         if self.layout is None:
             raise RuntimeError("GradSyncEngine.prepare() was never called")
         return self.layout
+
+    def _build_sharded_lamb(self) -> optim_lib.Optimizer:
+        """LAMB against the bucket layout: the trust ratio needs
+        ``||p|| / ||u||`` per PARAMETER TENSOR, but each device holds a
+        1/N slice of a flat bucket that concatenates many tensors.  Both
+        norms are plain sums of squares, so they partition exactly like
+        the global clip norm: a static segment-id array (leaf index per
+        bucket element; padding gets its own segment) maps every shard
+        element back to its tensor, ``segment_sum`` accumulates each
+        shard's per-tensor contribution, and one ``psum`` over the data
+        axis makes the sums global — every device then applies the SAME
+        per-tensor trust ratios to its shard, so the sharded trajectory
+        matches dense LAMB up to float reduction order.
+
+        Adam moments stay elementwise (the inner direction), so the
+        optimizer state keeps the ordinary sharded bucket shapes and the
+        dense<->zero1 checkpoint reshard works unchanged."""
+        layout = self._require_layout()
+        args = self._lamb_args
+        inner = optim_lib.adam(1.0, b1=args["b1"], b2=args["b2"],
+                               eps=args["eps"])
+        lr, wd, eps = args["lr"], args["weight_decay"], args["eps"]
+        axis = self.axis
+        n_leaves = len(layout.shapes)
+        n_seg = n_leaves + 1            # +1: the padding segment
+        seg_ids = {}
+        for k, idxs, pad in zip(layout.keys, layout.bucket_leaves,
+                                layout.padded):
+            ids = np.full((pad,), n_leaves, np.int32)
+            off = 0
+            for i in idxs:
+                ids[off:off + layout.sizes[i]] = i
+                off += layout.sizes[i]
+            seg_ids[k] = ids
+
+        def shard_seg(k):
+            # This device's slice of the bucket's segment ids — sliced in
+            # the traced code (axis_index), same as the param shards.
+            n = layout.shard_len(k)
+            me = lax.axis_index(axis)
+            return lax.dynamic_slice(jnp.asarray(seg_ids[k]), (me * n,),
+                                     (n,))
+
+        def update(grads, state, params):
+            dirs, state = inner.update(grads, state, None)
+            lr_t = lr(state["step"]) if callable(lr) else lr
+            u_sh, p_sq, u_sq = {}, jnp.zeros((n_seg,), jnp.float32), \
+                jnp.zeros((n_seg,), jnp.float32)
+            for k in layout.keys:
+                p = params[k].astype(jnp.float32)
+                u = -dirs[k] + wd * p
+                u_sh[k] = u
+                seg = shard_seg(k)
+                p_sq = p_sq + jax.ops.segment_sum(jnp.square(p), seg,
+                                                  num_segments=n_seg)
+                u_sq = u_sq + jax.ops.segment_sum(jnp.square(u), seg,
+                                                  num_segments=n_seg)
+            pn = jnp.sqrt(lax.psum(p_sq, axis))
+            un = jnp.sqrt(lax.psum(u_sq, axis))
+            trust = jnp.where((pn > 0) & (un > 0),
+                              pn / jnp.maximum(un, eps), 1.0)
+            updates = {k: -lr_t * trust[shard_seg(k)] * u_sh[k]
+                       for k in layout.keys}
+            return updates, state
+
+        return optim_lib.Optimizer(inner.init, update)
 
     def init_opt_state(self, params: Any) -> Any:
         """Optimizer state born SHARDED: bucket the real params (weight

@@ -15,6 +15,16 @@ run.  Spec grammar (comma-separated)::
                          watchdog; '3s' or bare '3' both parse)
     sigterm@S            deliver SIGTERM to this process before step S
                          (drives the preemption save/exit path)
+    preempt@S            alias of sigterm that is ALSO valid with @every —
+                         the spot-reclamation schedule: each firing is a
+                         clean checkpoint + exit, and the supervisor's
+                         restart resumes past it, so the semantics survive
+                         refiring (e.g. 'preempt@every:12')
+    ckpt_stall@S:DURms   the step-S checkpoint save stalls DUR extra
+                         (slow/contended shared filesystem; '200ms' or
+                         bare ms, @every:N:DUR for a persistent slow
+                         store) — books as checkpoint time, so the
+                         goodput gate sees it
     corrupt_ckpt@S       after the step-S checkpoint save lands, scribble
                          over its files (drives restore_robust fallback)
     corrupt_ckpt@latest  corrupt the newest checkpoint right before the
@@ -61,11 +71,16 @@ import numpy as np
 
 log = logging.getLogger("dtf_tpu")
 
-_KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "corrupt_ckpt",
-          "host_down", "slow_host", "partition")
-# Kinds whose semantics survive refiring (a sigterm/host_down process is
-# gone; corruption of the same step proves nothing twice).
-_PERIODIC_OK = ("nan_grad", "loader_error", "stall")
+_KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "preempt",
+          "ckpt_stall", "corrupt_ckpt", "host_down", "slow_host",
+          "partition")
+# Kinds whose semantics survive refiring (a host_down process is gone;
+# corruption of the same step proves nothing twice).  preempt refires
+# safely BECAUSE each firing ends in a clean checkpoint + supervisor
+# restart that resumes past it; plain sigterm stays one-shot as the
+# single-preemption scenario's spelling.
+_PERIODIC_OK = ("nan_grad", "loader_error", "stall", "preempt",
+                "ckpt_stall")
 
 _DUR_RE = re.compile(r"^([0-9]+(?:\.[0-9]+)?)(ms|s)?$")
 
@@ -108,6 +123,8 @@ class Fault:
         extra = ""
         if self.kind == "stall":
             extra = f":{self.duration_s:g}s"
+        elif self.kind == "ckpt_stall":
+            extra = f":{self.duration_s * 1e3:g}ms"
         elif self.kind == "host_down":
             extra = f":{self.process}"
         elif self.kind == "slow_host":
@@ -155,15 +172,20 @@ class FaultPlan:
                     f"bad chaos entry {entry!r}; expected kind@step with "
                     f"kind in {_KINDS} (e.g. 'nan_grad@17,sigterm@40,"
                     f"stall@25:3s,host_down@30:1,slow_host@10:1:250ms,"
-                    f"stall@every:50:1s,corrupt_ckpt@latest,seed=7')")
+                    f"stall@every:50:1s,preempt@every:12,"
+                    f"ckpt_stall@10:200ms,corrupt_ckpt@latest,seed=7')")
             args = rest.split(":") if rest else [""]
             step: Optional[int] = None
             period: Optional[int] = None
             if args[0] == "every":
                 if kind not in _PERIODIC_OK:
+                    hint = (" (for recurring preemption use "
+                            "'preempt@every:N' — each firing checkpoints "
+                            "cleanly, so it refires safely)"
+                            if kind == "sigterm" else "")
                     raise ValueError(
                         f"@every is only valid for {_PERIODIC_OK}, got "
-                        f"{entry!r}")
+                        f"{entry!r}{hint}")
                 if len(args) < 2 or not args[1].isdigit() or int(args[1]) < 1:
                     raise ValueError(f"@every needs a positive period, "
                                      f"e.g. '{kind}@every:50'; got {entry!r}")
@@ -186,6 +208,13 @@ class FaultPlan:
                                      f"'stall@{rest.split(':')[0]}:3s'; "
                                      f"got {entry!r}")
                 duration_s = _parse_duration(args[0], "s", entry)
+            elif kind == "ckpt_stall":
+                if len(args) != 1 or not args[0]:
+                    raise ValueError(
+                        f"ckpt_stall needs a duration, e.g. "
+                        f"'ckpt_stall@10:200ms' or "
+                        f"'ckpt_stall@every:5:150ms'; got {entry!r}")
+                duration_s = _parse_duration(args[0], "ms", entry)
             elif kind == "host_down":
                 if len(args) != 1 or not args[0].isdigit():
                     raise ValueError(f"host_down needs a process, e.g. "
@@ -291,6 +320,12 @@ class FaultPlan:
                             "no-op", step)
         if self._take("sigterm", step) is not None:
             self._kill(os.getpid(), signal.SIGTERM)
+        if self._take("preempt", step) is not None:
+            # Same delivery as sigterm; a separate kind because it is
+            # periodic-capable — each firing drains through the clean
+            # preemption save, and the supervisor's restart resumes past
+            # it, so the schedule keeps firing across attempts.
+            self._kill(os.getpid(), signal.SIGTERM)
         if self._take("host_down", step) is not None:
             # SIGKILL, not SIGTERM or sys.exit: a lost host gets no
             # goodbye — no preemption save, no clean shutdown, no flushed
@@ -330,6 +365,17 @@ class FaultPlan:
                 "chaos nan_grad: batch has no float leaf to poison (token-"
                 "only data); inject at a float-input workload instead")
         return batch
+
+    def maybe_ckpt_stall(self, step: int) -> None:
+        """ckpt_stall@S / @every:N: the step-S checkpoint write stalls an
+        extra duration — a slow or contended shared filesystem.  The
+        trainer calls this inside its checkpoint-measured (and watchdog-
+        suspended) window, so the injected latency books as checkpoint
+        time and degrades the goodput fraction the scenario gate reads —
+        never trips the hang watchdog."""
+        f = self._take("ckpt_stall", step)
+        if f is not None:
+            self._sleep(f.duration_s)
 
     def maybe_corrupt_after_save(self, step: int, ckpt) -> None:
         """corrupt_ckpt@S: wait for the step-S save to land, then scribble

@@ -143,6 +143,7 @@ def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
                        chaos: Any = None,
                        initial_splits: Any = None,
                        backoff: Optional[Backoff] = None,
+                       fit_kwargs: Optional[dict] = None,
                        sleep: Callable[[float], None] = time.sleep) -> Any:
     """The supervised-workload pattern, shared by the Trainer-style CLIs
     (mnist, cifar) and tests:
@@ -158,8 +159,12 @@ def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
     DataSplits`` (or anything ``Trainer.fit`` accepts).  A caller that
     already loaded the data (e.g. to size its lr schedule) passes it as
     ``initial_splits`` — attempt 0 trains on it instead of loading twice;
-    only restarts need a fresh, rewound stream.  Returns the completed
-    fit result."""
+    only restarts need a fresh, rewound stream.  ``fit_kwargs`` forwards
+    extra ``Trainer.fit`` arguments (``max_steps``/``epochs`` — the
+    scenario cells' fixed-step budgets) to EVERY attempt; resume
+    fast-forwards to the restored step, so a capped budget completes
+    across attempts exactly like an uninterrupted run.  Returns the
+    completed fit result."""
     import dataclasses
 
     plan = chaos
@@ -180,7 +185,7 @@ def run_supervised_fit(trainer_factory: Callable, splits_factory: Callable,
         splits = (initial_splits if attempt == 0
                   and initial_splits is not None else splits_factory())
         try:
-            return trainer.fit(splits)
+            return trainer.fit(splits, **(fit_kwargs or {}))
         finally:
             if trainer.ckpt is not None:
                 trainer.ckpt.close()

@@ -1,0 +1,27 @@
+"""Scenario matrix: workload zoo x chaos x triple gate (DESIGN.md §8).
+
+The north star's "handles as many scenarios as you can imagine" as a CI
+matrix instead of a claim: every cell runs a workload at a declared
+scale under a declared fault schedule and must pass ALL THREE gates —
+convergence to a pinned target, a goodput-fraction floor, and a
+throughput/MFU floor — read from the telemetry spine the run left on
+disk.  PR 1-2's chaos/self-healing/elastic machinery supplies the
+faults and the recovery; PR 3's goodput/MFU accounting supplies the
+measurements; this package supplies the enforceable contract between
+them.
+
+    python -m dtf_tpu.scenarios --matrix default --check
+
+* :mod:`.spec` — declarative cell specs + the curated matrices;
+* :mod:`.zoo` — per-workload (model, optimizer, data) builders;
+* :mod:`.runner` — child-process cell execution + gate evaluation
+  (gates via :func:`dtf_tpu.telemetry.report.check_gates`, shared with
+  ``report --check``);
+* :mod:`._host` — the per-host child (supervised or elastic-health
+  shape).
+"""
+
+from dtf_tpu.scenarios.spec import (Gate, MATRICES, ScenarioSpec,  # noqa: F401
+                                    WORKLOADS, default_matrix,
+                                    load_matrix, mini_matrix)
+from dtf_tpu.scenarios.runner import CellResult, run_cell  # noqa: F401

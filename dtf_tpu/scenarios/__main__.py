@@ -1,0 +1,133 @@
+"""Scenario-matrix CLI: run cells, emit per-cell JSON, summarize, gate.
+
+    python -m dtf_tpu.scenarios --matrix default --check
+    python -m dtf_tpu.scenarios --matrix mini --out results/ --check
+    python -m dtf_tpu.scenarios --matrix my_cells.json --only gpt_baseline
+
+``--matrix`` is a built-in name (``default``, ``mini``) or a path to a
+JSON list of spec documents.  Each cell writes ``<out>/<name>.json``
+(spec + measured quantities + per-gate verdicts) and the run ends with a
+summary table.  ``--check`` exits non-zero unless EVERY cell passes all
+its gates — the CI entry point that turns "handles many scenarios" from
+a claim into a matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+from dtf_tpu.scenarios.runner import CellResult, run_cell
+from dtf_tpu.scenarios.spec import MATRICES, load_matrix
+
+
+def _fmt(v, width=9, digits=4) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:{width}.{digits}g}"
+    return str(v).rjust(width)
+
+
+def summary_table(results: List[CellResult]) -> str:
+    lines = [f"{'cell':<30} {'workload':<9} {'chaos':<7} "
+             f"{'final':>9} {'goodput':>9} {'ex/s':>9} {'tok/s':>9} "
+             f"{'rnds':>4}  verdict"]
+    for r in results:
+        m = r.measured
+        lines.append(
+            f"{r.spec.name:<30} {r.spec.workload:<9} "
+            f"{'yes' if r.spec.chaos else 'off':<7} "
+            f"{_fmt(m.get('final_cost'))} "
+            f"{_fmt(m.get('goodput_fraction'))} "
+            f"{_fmt(m.get('examples_per_s'), digits=5)} "
+            f"{_fmt(m.get('tokens_per_s'), digits=5)} "
+            f"{r.rounds:>4}  {'PASS' if r.ok else 'FAIL'}")
+    passed = sum(r.ok for r in results)
+    lines.append(f"{passed}/{len(results)} cells passed")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.scenarios",
+        description="Run the workload x chaos x triple-gate scenario "
+                    "matrix (DESIGN.md §8).")
+    p.add_argument("--matrix", default="default",
+                   help=f"built-in matrix name ({sorted(MATRICES)}) or a "
+                        f"path to a JSON list of cell specs")
+    p.add_argument("--only", default=None,
+                   help="comma-separated cell names to run (subset)")
+    p.add_argument("--out", default=None,
+                   help="results directory (per-cell JSON + summary); "
+                        "default: a fresh temp dir, printed")
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: exit non-zero unless every cell passes "
+                        "all three gates")
+    p.add_argument("--list", action="store_true",
+                   help="print the resolved cells and exit")
+    ns = p.parse_args(argv)
+
+    try:
+        cells = load_matrix(ns.matrix)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if ns.only:
+        want = {n.strip() for n in ns.only.split(",") if n.strip()}
+        unknown = want - {c.name for c in cells}
+        if unknown:
+            print(f"error: --only names not in the matrix: "
+                  f"{sorted(unknown)}", file=sys.stderr)
+            return 2
+        cells = [c for c in cells if c.name in want]
+    if ns.list:
+        for c in cells:
+            print(f"{c.name:<30} {c.workload:<9} hosts={c.hosts} "
+                  f"devices={c.devices} steps={c.steps} "
+                  f"chaos={c.chaos or '-'}")
+        return 0
+
+    out = ns.out or tempfile.mkdtemp(prefix="dtf_scenarios_")
+    os.makedirs(out, exist_ok=True)
+    workdir = os.path.join(out, "work")
+    os.makedirs(workdir, exist_ok=True)
+    print(f"[scenarios] matrix {ns.matrix!r}: {len(cells)} cell(s), "
+          f"results under {out}", flush=True)
+
+    results: List[CellResult] = []
+    for i, spec in enumerate(cells):
+        print(f"[scenarios] [{i + 1}/{len(cells)}] {spec.name} "
+              f"(workload={spec.workload}, hosts={spec.hosts}, "
+              f"chaos={spec.chaos or 'off'}) ...", flush=True)
+        res = run_cell(spec, workdir)
+        results.append(res)
+        with open(os.path.join(out, f"{spec.name}.json"), "w") as f:
+            json.dump(res.to_doc(), f, indent=1, sort_keys=True)
+        status = "PASS" if res.ok else "FAIL"
+        print(f"[scenarios]   -> {status} in {res.duration_s:.1f}s", flush=True)
+        if res.error:
+            print(f"[scenarios]   error: {res.error}", flush=True)
+        for line in res.gates:
+            print(f"[scenarios]   {line}", flush=True)
+
+    table = summary_table(results)
+    print(table)
+    with open(os.path.join(out, "summary.txt"), "w") as f:
+        f.write(table + "\n")
+    if ns.check and not all(r.ok for r in results):
+        failed = [r.spec.name for r in results if not r.ok]
+        print(f"scenario check: FAIL — {failed}", flush=True)
+        return 1
+    if ns.check:
+        print("scenario check: OK — all cells passed the triple gate",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

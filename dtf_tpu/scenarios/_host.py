@@ -1,0 +1,157 @@
+"""Per-host scenario-cell driver (spawned by scenarios/runner.py).
+
+One process = one "host" of a cell.  Two shapes, chosen by the spec:
+
+* ``spec.hosts == 1`` — the SUPERVISED shape: the whole cell lives in
+  this process under :func:`~dtf_tpu.resilience.supervisor.
+  run_supervised_fit` (one chaos plan across attempts, fresh trainer +
+  data stream per attempt, bounded restarts), exactly like the
+  ``--max_restarts`` workload CLIs.
+* ``spec.hosts > 1`` — the ELASTIC shape (the tests/_mp_health.py
+  pattern): this process is host ``task`` of an N-host round driven by
+  ``run_elastic_hosts``.  The hosts form the health mesh EXPLICITLY
+  (process_index/nproc passed in, heartbeats over a shared dir) rather
+  than via jax.distributed — liveness detection must not depend on the
+  collective runtime a dead peer just wedged, and this keeps the cell
+  runnable on jaxlib builds whose CPU backend lacks multiprocess
+  collectives.  Host 0 owns the shared logdir/checkpoints (the survivor
+  the relaunch resumes); other hosts train a decoy replica in a scratch
+  logdir — their role is to heartbeat, straggle, and die on cue.  A
+  relaunch round passes the SURVIVOR count (possibly 1) and a shrunken
+  device count; ``resume=True`` reshards the last intact checkpoint onto
+  the smaller mesh.
+
+Usage::
+
+    _host.py <spec_json> <task> <nproc> <shared_dir> <devices> [chaos]
+
+``chaos`` comes from argv, not the spec: the runner arms it on round 0
+and strips it from relaunch rounds (the fault already fired; replaying
+it would kill the recovery the cell exists to prove).
+
+Exits 0 on completion, 71/72 through the coordinated abort, or dies
+outright under ``host_down``.  Host 0 prints
+``SCENARIO_DONE steps=<n> final_cost=<loss> rollbacks=<k> skipped=<s>``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(spec_json: str, task: int, nproc: int, shared: str,
+         devices: int, chaos: str = "") -> int:
+    from dtf_tpu import telemetry as tel
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig
+    from dtf_tpu.resilience.chaos import FaultPlan
+    from dtf_tpu.scenarios import zoo
+    from dtf_tpu.scenarios.spec import ScenarioSpec
+    from dtf_tpu.train.trainer import Trainer
+
+    spec = ScenarioSpec.from_json(spec_json)
+    cluster = bootstrap(ClusterConfig(simulated_devices=devices,
+                                      mesh="data=-1"))
+    elastic = spec.hosts > 1
+    logdir = (os.path.join(shared, "logs") if task == 0
+              else os.path.join(shared, f"logs_task{task}"))
+    kit = zoo.build(spec)
+    splits = kit.splits_factory()
+    batch_count = max(splits.train.num_examples // spec.batch_size, 1)
+    epochs = -(-spec.steps // batch_count) + 1     # ceil + resume slack
+    cfg = TrainConfig(
+        batch_size=spec.batch_size, learning_rate=spec.learning_rate,
+        optimizer=spec.optimizer, epochs=epochs,
+        log_frequency=spec.log_frequency, seed=spec.seed, logdir=logdir,
+        checkpoint_every=spec.checkpoint_every,
+        grad_sync=spec.grad_sync, grad_bucket_mb=spec.grad_bucket_mb,
+        # Elastic relaunch rounds are FRESH processes: they re-read the
+        # persistent compile cache instead of re-paying the backend
+        # compile (the PR-4 machinery).  Per-TASK dir, not per-cell:
+        # same-geometry hosts produce identical HLO, so a shared dir
+        # means two processes racing writes to the same cache key —
+        # observed heap corruption (SIGABRT/SIGSEGV) on this jaxlib's
+        # CPU backend; rounds of one task are sequential, so a per-task
+        # dir has exactly one writer.  Supervised cells must NOT arm it
+        # either: their restarts are in-PROCESS, and deserializing a
+        # cached executable into a process that already compiled it
+        # corrupts the heap the same way (the in-memory jit cache is
+        # the right reuse there anyway).
+        compile_cache=(os.path.join(shared, f"compile_cache_t{task}")
+                       if elastic else None),
+        resume=elastic)
+    fit_kwargs = {"max_steps": spec.steps, "epochs": epochs}
+
+    if not elastic:
+        from dtf_tpu.resilience.supervisor import run_supervised_fit
+        result = run_supervised_fit(
+            lambda c, plan: Trainer(cluster, kit.model,
+                                    kit.make_optimizer(), c, chaos=plan),
+            kit.splits_factory, cfg, max_restarts=spec.max_restarts,
+            chaos=chaos or None, initial_splits=splits,
+            fit_kwargs=fit_kwargs)
+    else:
+        from dtf_tpu.resilience.health import HealthMonitor, make_transport
+
+        plan = (FaultPlan.parse(chaos, process_index=task) if chaos
+                else None)
+        monitor = None
+        if nproc > 1:
+            # 0.5s x 8 = a 4s miss budget (vs the mp rig's 1s): matrix
+            # cells run back-to-back on a loaded CI box where a GC or
+            # compile pause past 1s makes BOTH hosts poison each other
+            # (observed: round ends "2 -> 2 survivors", every host 71).
+            # Detection still lands well inside the paced survivor's
+            # remaining run.
+            monitor = HealthMonitor(
+                make_transport(os.path.join(shared, "health"), task,
+                               is_coordinator=task == 0),
+                task, nproc, interval_s=0.5, miss_budget=8,
+                boot_grace_s=120.0, is_coordinator=task == 0).start()
+            if plan is not None:
+                plan.bind_partition(monitor.partition)
+        trainer = Trainer(cluster, kit.model, kit.make_optimizer(), cfg,
+                          chaos=plan)
+        if monitor is not None:
+            # Warm the step compile BEFORE the startup barrier, on a
+            # throwaway state copy (step_fn donates its first argument)
+            # and a dummy batch, so every host enters the fault schedule
+            # in lockstep: compile skew must not let a fast host die
+            # before a slow host has checkpointed anything.
+            import jax
+
+            from dtf_tpu.train.trainer import put_global_batch
+
+            dummy = put_global_batch(
+                cluster.mesh, splits.train.next_batch(spec.batch_size))
+            splits = kit.splits_factory()      # rewind the probe batch
+            throwaway = jax.tree_util.tree_map(lambda x: x + 0,
+                                               trainer.state)
+            jax.block_until_ready(
+                trainer.step_fn(throwaway, dummy, jax.random.key(0)))
+            monitor.wait_for_peers(120.0)
+        completed = False
+        try:
+            result = trainer.fit(splits, **fit_kwargs)
+            completed = True
+        finally:
+            if monitor is not None:
+                # Only a COMPLETED fit departs cleanly; a crash lets the
+                # beats stop so peers run the coordinated abort.
+                monitor.close(mark_departed=completed)
+            if trainer.ckpt is not None:
+                trainer.ckpt.close()
+
+    if task == 0:
+        print(f"SCENARIO_DONE steps={result['steps']} "
+              f"final_cost={result['final_cost']:.6f} "
+              f"rollbacks={result.get('rollbacks', 0)} "
+              f"skipped={result.get('skipped_steps', 0)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                  sys.argv[4], int(sys.argv[5]),
+                  sys.argv[6] if len(sys.argv) > 6 else ""))

@@ -1,0 +1,175 @@
+"""Scenario-cell runner: spawn the cell, then judge it off the telemetry.
+
+Each cell runs in CHILD processes (one per host) so every cell gets its
+own simulated-device count, fresh jax backend, and fresh telemetry books
+— the runner itself never imports jax.  Supervised cells are one child;
+elastic cells go through :func:`~dtf_tpu.resilience.supervisor.
+run_elastic_hosts` (the same decision procedure production's job
+scheduler runs), which relaunches survivors on a shrunken mesh.
+
+Judgement is deliberately OUT-of-band: the runner reads what the run
+left on disk — ``telemetry.json`` goodput books, ``metrics.csv``
+(attempt-deduplicated final cost), the instrument snapshot — through
+:func:`dtf_tpu.telemetry.report.build_report` and gates it with
+:func:`~dtf_tpu.telemetry.report.check_gates`, the SAME implementation
+behind ``report --check``'s threshold flags.  A cell that trained but
+left no legible books is a failing cell: the matrix's contract is that
+recovery is *observable*, not just that the process exited 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+import dtf_tpu
+from dtf_tpu.scenarios.spec import ScenarioSpec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(dtf_tpu.__file__)))
+
+
+@dataclasses.dataclass
+class CellResult:
+    spec: ScenarioSpec
+    ok: bool
+    gates: List[str]                   # one verdict line per armed gate
+    measured: dict                     # the quantities the gates read
+    duration_s: float
+    rounds: int = 0                    # elastic relaunch rounds used
+    logdir: str = ""
+    error: Optional[str] = None        # run-level failure (no gates ran)
+
+    def to_doc(self) -> dict:
+        import json
+        return {"name": self.spec.name, "ok": self.ok,
+                "gates": self.gates, "measured": self.measured,
+                "duration_s": round(self.duration_s, 3),
+                "rounds": self.rounds, "logdir": self.logdir,
+                "error": self.error,
+                "spec": json.loads(self.spec.to_json())}
+
+
+def child_env(extra_pythonpath: str = REPO_ROOT) -> dict:
+    """Cell-child environment: CPU backend, repo importable, and any
+    sitecustomize shim dirs dropped (a sitecustomize that imports jax
+    initializes the backend before ClusterConfig.simulated_devices can
+    set the device count)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    inherited = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
+    env["PYTHONPATH"] = os.pathsep.join([extra_pythonpath, *inherited])
+    return env
+
+
+def _host_cmd(spec: ScenarioSpec, task: int, nproc: int, shared: str,
+              devices: int, chaos: str) -> List[str]:
+    return [sys.executable, "-m", "dtf_tpu.scenarios._host",
+            spec.to_json(), str(task), str(nproc), shared, str(devices),
+            chaos]
+
+
+def _tail(text: str, n: int = 2000) -> str:
+    return text[-n:] if text else ""
+
+
+def run_cell(spec: ScenarioSpec, workdir: str) -> CellResult:
+    """Run one cell to completion (or failure) and gate it."""
+    shared = os.path.join(workdir, spec.name)
+    os.makedirs(shared, exist_ok=True)
+    logdir = os.path.join(shared, "logs")
+    env = child_env()
+    t0 = time.monotonic()
+    rounds = 0
+    try:
+        if spec.hosts == 1:
+            proc = subprocess.run(
+                _host_cmd(spec, 0, 1, shared, spec.devices,
+                          spec.chaos or ""),
+                cwd=workdir, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+                timeout=spec.timeout_s)
+            with open(os.path.join(shared, "host.log"), "w") as f:
+                f.write(proc.stdout or "")
+            if proc.returncode != 0:
+                return CellResult(
+                    spec, False, [], {}, time.monotonic() - t0,
+                    logdir=logdir,
+                    error=f"host exited {proc.returncode}:\n"
+                          f"{_tail(proc.stdout)}")
+        else:
+            from dtf_tpu.resilience.supervisor import (SupervisorGaveUp,
+                                                       run_elastic_hosts)
+
+            def build_cmd(slot, n_hosts, round_idx):
+                # The fault schedule arms on round 0 only: a relaunch
+                # must prove RECOVERY, not re-die on the same fault.
+                chaos = spec.chaos if round_idx == 0 else ""
+                devices = (spec.devices if round_idx == 0
+                           else (spec.shrink_devices or spec.devices))
+                return _host_cmd(spec, slot, n_hosts, shared, devices,
+                                 chaos)
+
+            try:
+                outs, _, rounds = run_elastic_hosts(
+                    build_cmd, spec.hosts, max_rounds=spec.max_rounds,
+                    env=env, cwd=workdir, timeout_s=spec.timeout_s)
+            except SupervisorGaveUp as exc:
+                return CellResult(
+                    spec, False, [], {}, time.monotonic() - t0,
+                    logdir=logdir, error=f"elastic gave up: {exc}")
+            with open(os.path.join(shared, "host.log"), "w") as f:
+                f.write(outs[0] or "")
+    except subprocess.TimeoutExpired:
+        return CellResult(spec, False, [], {}, time.monotonic() - t0,
+                          logdir=logdir,
+                          error=f"cell timed out after {spec.timeout_s}s")
+    duration = time.monotonic() - t0
+
+    # -- the triple gate, off the on-disk telemetry -------------------------
+    from dtf_tpu.telemetry.report import (build_report, check_gates,
+                                          check_goodput)
+
+    report = build_report(logdir)
+    measured = _measured(report)
+    gates: List[str] = []
+    # books-consistency first: gating quantities read from books that
+    # don't sum to wall-clock would be unfalsifiable
+    books_ok, verdict = check_goodput(report)
+    gates.append(f"gate goodput_books: {'OK' if books_ok else 'FAIL'} — "
+                 f"{verdict}")
+    gated_ok, lines = check_gates(report, **spec.gate.thresholds())
+    gates.extend(lines)
+    return CellResult(spec, books_ok and gated_ok, gates, measured,
+                      duration, rounds=rounds, logdir=logdir)
+
+
+def _measured(report: dict) -> dict:
+    """The quantities the gates read, surfaced for the summary table and
+    the per-cell JSON whether or not their gate is armed."""
+    tel = report.get("telemetry", {})
+    metrics = tel.get("metrics", {})
+
+    def metric(name):
+        m = metrics.get(name)
+        return None if m is None else m.get("value")
+
+    return {
+        "final_cost": report.get("steps", {}).get("final_cost"),
+        "steps": report.get("steps", {}).get("last"),
+        "goodput_fraction": tel.get("goodput", {})
+        .get("productive_fraction"),
+        "examples_per_s": metric("throughput/examples_per_s"),
+        "tokens_per_s": metric("throughput/tokens_per_s"),
+        "mfu_pct": metric("mfu/pct_peak"),
+        "rollbacks": metric("checkpoint/rollbacks_total") or 0,
+        "restarts": metric("supervisor/restarts_total") or 0,
+        "faults_fired": metric("chaos/faults_fired_total") or 0,
+        "attempts": report.get("attempts"),
+    }

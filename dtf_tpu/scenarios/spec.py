@@ -1,0 +1,289 @@
+"""Declarative scenario specs: workload x scale x chaos plan x triple gate.
+
+A :class:`ScenarioSpec` is one CELL of the scenario matrix — everything
+needed to (a) run a workload at a given scale under a fault schedule and
+(b) judge the outcome.  The judgement is the **triple gate** (MLPerf-pods
+style, arxiv 1909.09756, plus the fault axis that harness never had):
+
+* **convergence** — the run's final cost must reach a PINNED per-workload
+  target (the trajectory is deterministic: synthetic data + fixed seeds,
+  so the target is a property of the cell, not of the machine);
+* **goodput** — the productive fraction of wall-clock must clear a floor
+  even with the injected faults' restarts/rollbacks/stalls on the books;
+* **throughput/MFU** — examples-or-tokens per second (and, where the chip
+  peak is known, MFU percent) must clear a floor, so a cell that
+  "recovers" by grinding 10x slower still fails.
+
+A cell passes only when it *recovers and still trains well enough, fast
+enough*.  Specs are plain dataclasses with a JSON round-trip so matrices
+can live in code (:data:`MATRICES`) or in a user's JSON file
+(``python -m dtf_tpu.scenarios --matrix my_matrix.json``).
+
+This module is jax-free (the CLI parses matrices before any backend
+exists); chaos specs are validated by parsing them with the real
+:class:`~dtf_tpu.resilience.chaos.FaultPlan` grammar so a typo'd fault
+fails at matrix-load time, not minutes into the run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+#: Workload-zoo keys (mirrored by scenarios/zoo.py's builder table; a
+#: pinned test keeps the two in sync so this module stays jax-free).
+WORKLOADS = ("mnist", "cifar", "gpt", "seq2seq")
+
+
+@dataclasses.dataclass(frozen=True)
+class Gate:
+    """The triple gate's thresholds for one cell.  ``max_final_cost`` and
+    ``min_goodput`` are always armed; throughput arms whichever floors
+    are > 0 (the CPU sim has no known chip peak, so cells there gate on
+    examples/tokens per second and leave ``min_mfu_pct`` at 0 — on real
+    chips set it and the MFU gate arms via ``mfu/pct_peak``)."""
+
+    max_final_cost: float
+    min_goodput: float
+    min_examples_per_s: float = 0.0
+    min_tokens_per_s: float = 0.0
+    min_mfu_pct: float = 0.0
+    max_rollbacks: Optional[int] = None
+
+    def thresholds(self) -> dict:
+        """Kwargs for :func:`dtf_tpu.telemetry.report.check_gates` — the
+        ONE gate implementation, shared with ``report --check``."""
+        out = {"max_final_cost": self.max_final_cost,
+               "min_goodput": self.min_goodput}
+        if self.min_examples_per_s > 0:
+            out["min_examples_per_s"] = self.min_examples_per_s
+        if self.min_tokens_per_s > 0:
+            out["min_tokens_per_s"] = self.min_tokens_per_s
+        if self.min_mfu_pct > 0:
+            out["min_mfu"] = self.min_mfu_pct
+        if self.max_rollbacks is not None:
+            out["max_rollbacks"] = self.max_rollbacks
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One matrix cell: a workload at a scale, under a chaos plan,
+    against a :class:`Gate`.
+
+    ``hosts == 1`` runs the cell as ONE supervised process
+    (:func:`~dtf_tpu.resilience.supervisor.run_supervised_fit`: crashes
+    and preemptions restore the last checkpoint under the
+    ``max_restarts`` budget).  ``hosts > 1`` runs it as a multi-host
+    elastic job (:func:`~dtf_tpu.resilience.supervisor.run_elastic_hosts`
+    over per-host child processes with the health subsystem armed): a
+    ``host_down`` fault kills a host, survivors abort coordinated (exit
+    71), and the relaunch resumes host 0's trajectory on a mesh shrunk to
+    ``shrink_devices`` — the elastic-restart scenario."""
+
+    name: str
+    workload: str
+    gate: Gate
+    chaos: Optional[str] = None
+    devices: int = 2                 # simulated CPU devices per host
+    steps: int = 30                  # total optimizer-step budget
+    batch_size: int = 64
+    optimizer: str = "adam"
+    learning_rate: float = 1e-3
+    grad_sync: str = "dense"
+    grad_bucket_mb: float = 0.1
+    checkpoint_every: int = 5
+    max_restarts: int = 2
+    log_frequency: int = 5
+    seed: int = 1
+    hosts: int = 1
+    shrink_devices: int = 0          # elastic relaunch mesh (0 = devices)
+    max_rounds: int = 2              # elastic relaunch budget
+    timeout_s: float = 420.0
+    extra: tuple = ()                # workload knobs as sorted (k, v) pairs
+
+    def __post_init__(self):
+        if self.workload not in WORKLOADS:
+            raise ValueError(f"unknown workload {self.workload!r}; "
+                             f"one of {WORKLOADS}")
+        if self.hosts > 1 and "host_down" not in (self.chaos or ""):
+            raise ValueError(
+                f"cell {self.name!r}: hosts={self.hosts} is the elastic-"
+                f"restart runner — its chaos plan must include a "
+                f"host_down fault (otherwise nothing exercises the "
+                f"relaunch and the extra hosts only slow the cell)")
+        if self.chaos:
+            # Fail at matrix-load time, with the cell named: the chaos
+            # grammar is the real FaultPlan parser, not a mirror.
+            from dtf_tpu.resilience.chaos import FaultPlan
+            try:
+                FaultPlan.parse(self.chaos, process_index=0)
+            except ValueError as exc:
+                raise ValueError(
+                    f"cell {self.name!r}: bad chaos spec: {exc}") from exc
+
+    @property
+    def extra_dict(self) -> dict:
+        return dict(self.extra)
+
+    # -- JSON round-trip ----------------------------------------------------
+
+    def to_json(self) -> str:
+        doc = dataclasses.asdict(self)
+        doc["gate"] = dataclasses.asdict(self.gate)
+        doc["extra"] = dict(self.extra)
+        return json.dumps(doc, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScenarioSpec":
+        doc = json.loads(text)
+        doc["gate"] = Gate(**doc["gate"])
+        doc["extra"] = tuple(sorted((doc.get("extra") or {}).items()))
+        return cls(**doc)
+
+
+# ---------------------------------------------------------------------------
+# Curated matrices.  Gate thresholds are PINNED from measured CPU-sim runs
+# (see DESIGN.md §8's table): convergence targets sit between the measured
+# final cost and the run's EARLY loss (a target the untrained model would
+# pass proves nothing — every target here is well under the step-5 cost),
+# goodput floors at ~50% of measured (restart/rollback cost is
+# deterministic, wall-clock is not; on the CPU sim compile dominates toy
+# steps, so absolute fractions are small — the floor still catches a run
+# whose wall-clock doubles), throughput floors at ~30% of measured (CI
+# machines vary widely).
+# ---------------------------------------------------------------------------
+
+
+def default_matrix() -> List[ScenarioSpec]:
+    """The CI matrix: >= 4 workloads, chaos-off baselines vs host-down /
+    straggler / recurring-preemption / nan+corrupt-checkpoint plans, one
+    elastic-restart cell, one LAMB+zero1 large-batch cell."""
+    return [
+        # -- chaos-off baselines (the control row: the same gates the
+        #    chaos cells must clear, no faults to blame) ------------------
+        ScenarioSpec(
+            # measured: final 4.42 (step-5 cost 4.79), goodput
+            # 0.06-0.12 and 2.9k-7.4k tok/s across runs (box-load
+            # variance; floors sit at ~half the worst observed)
+            name="gpt_baseline", workload="gpt", devices=2,
+            steps=30, batch_size=32, learning_rate=3e-3,
+            chaos=None, max_restarts=0,
+            gate=Gate(max_final_cost=4.6, min_goodput=0.03,
+                      min_tokens_per_s=900.0, max_rollbacks=0)),
+        ScenarioSpec(
+            # measured: final 1.72 (step-5 cost 2.32), goodput
+            # 0.51-0.56, 32-71 ex/s (conv steps are ~1-2 s on the sim)
+            name="cifar_baseline", workload="cifar", devices=2,
+            steps=20, batch_size=64, learning_rate=3e-3,
+            chaos=None, max_restarts=0,
+            gate=Gate(max_final_cost=2.0, min_goodput=0.25,
+                      min_examples_per_s=10.0, max_rollbacks=0)),
+        # -- fault cells --------------------------------------------------
+        ScenarioSpec(
+            # nan-spike + checkpoint corruption + one preemption: the
+            # guard skips the poisoned steps, restore_robust falls back
+            # past the corrupted step, the supervisor restarts — and the
+            # run must STILL converge fast enough.
+            # measured: final 0.15 (step-5 cost 1.66), goodput
+            # 0.034-0.05, 1.4k-1.7k ex/s — one restart, one
+            # guard-skipped step
+            name="mnist_nan_corrupt", workload="mnist", devices=2,
+            steps=40, batch_size=128, learning_rate=1e-3,
+            chaos="nan_grad@7,corrupt_ckpt@10,sigterm@17,seed=3",
+            max_restarts=2,
+            gate=Gate(max_final_cost=0.5, min_goodput=0.018,
+                      min_examples_per_s=450.0, max_rollbacks=1)),
+        ScenarioSpec(
+            # recurring spot reclamation: every 12th step is a clean
+            # preemption + supervisor restart; the budget completes
+            # across attempts with the goodput books carrying the
+            # restart windows.
+            # measured: final 4.41 (step-5 cost 4.79), goodput
+            # 0.049-0.05, 0.9k-1.8k tok/s — two preemptions, three
+            # attempts
+            name="gpt_preempt_recurring", workload="gpt", devices=2,
+            steps=30, batch_size=32, learning_rate=3e-3,
+            chaos="preempt@every:12", max_restarts=4,
+            gate=Gate(max_final_cost=4.6, min_goodput=0.025,
+                      min_tokens_per_s=300.0, max_rollbacks=0)),
+        ScenarioSpec(
+            # persistent straggler + checkpoint-write stalls: no restart
+            # at all, just injected slowness — the goodput and throughput
+            # floors are what catch it (and must still clear).
+            # measured: final 3.68 (step-5 cost 4.07), goodput
+            # 0.12-0.16, 77-184 ex/s — 40ms/step injected drag + 6
+            # ckpt stalls
+            name="seq2seq_straggler_ckpt_stall", workload="seq2seq",
+            devices=2, steps=60, batch_size=32, learning_rate=1e-2,
+            chaos="slow_host@5:0:40ms,ckpt_stall@every:10:250ms",
+            max_restarts=1,
+            gate=Gate(max_final_cost=3.85, min_goodput=0.04,
+                      min_examples_per_s=25.0, max_rollbacks=0)),
+        ScenarioSpec(
+            # THE elastic cell: 2 hosts, host 1 dies abruptly (SIGKILL)
+            # mid-run; host 0 exits via the coordinated abort (71) and
+            # the relaunch resumes its checkpoint on a 4->2 shrunken
+            # mesh.  Gates read host 0's books across both rounds.
+            # Timing: host 1 (100ms/step) dies at its step 12 (~1.2s
+            # past the lockstep barrier); host 0 (250ms/step, 40-step
+            # budget ~10s) detects the loss at ~5s — reliably MID-run,
+            # so the abort+relaunch path is exercised even when a loaded
+            # box skews either side — and the relaunch round runs ~20
+            # unpaced steps, enough sync windows to re-measure
+            # throughput (gauges are per-process by contract).
+            # measured: final 0.60 (step-5 cost 2.13), goodput
+            # 0.013-0.034 (the pacing dominates wall-clock), ex/s noisy
+            # across runs (last-window gauge) — floors stay loose
+            name="mnist_host_down_elastic", workload="mnist",
+            devices=4, shrink_devices=2, hosts=2, max_rounds=2,
+            steps=40, batch_size=64, learning_rate=5e-2,
+            optimizer="sgd",
+            chaos=("slow_host@0:0:250ms,slow_host@0:1:100ms,"
+                   "host_down@12:1"),
+            timeout_s=600.0,
+            gate=Gate(max_final_cost=0.9, min_goodput=0.006,
+                      min_examples_per_s=50.0, max_rollbacks=0)),
+        ScenarioSpec(
+            # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
+            # psum'd across shards) on the 8-way mesh, with a nan spike
+            # to prove the guard composes with the sharded update.
+            # measured: final 0.44 (step-5 cost 2.07), goodput
+            # 0.18-0.21, 3.5k-10.4k ex/s — one guard-skipped step
+            name="mnist_lamb_zero1_large_batch", workload="mnist",
+            devices=8, steps=30, batch_size=512, learning_rate=1e-2,
+            optimizer="lamb", grad_sync="zero1",
+            chaos="nan_grad@9,seed=5", max_restarts=1,
+            gate=Gate(max_final_cost=0.9, min_goodput=0.06,
+                      min_examples_per_s=1200.0, max_rollbacks=0)),
+    ]
+
+
+def mini_matrix() -> List[ScenarioSpec]:
+    """The full-suite lane's 2-cell smoke matrix: one chaos-off GPT cell,
+    one host-down elastic MNIST cell — the cheapest pair that still
+    exercises a clean baseline AND the detect/abort/relaunch path."""
+    cells = {c.name: c for c in default_matrix()}
+    return [cells["gpt_baseline"], cells["mnist_host_down_elastic"]]
+
+
+MATRICES: Dict[str, "callable"] = {"default": default_matrix,
+                                   "mini": mini_matrix}
+
+
+def load_matrix(name_or_path: str) -> List[ScenarioSpec]:
+    """Resolve ``--matrix``: a built-in name (:data:`MATRICES`) or a path
+    to a JSON file holding a list of spec documents."""
+    if name_or_path in MATRICES:
+        return MATRICES[name_or_path]()
+    with open(name_or_path) as f:
+        docs = json.load(f)
+    if not isinstance(docs, list) or not docs:
+        raise ValueError(f"{name_or_path}: expected a non-empty JSON list "
+                         f"of scenario specs")
+    out = [ScenarioSpec.from_json(json.dumps(d)) for d in docs]
+    names = [s.name for s in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"{name_or_path}: duplicate cell names {names}")
+    return out

@@ -1,0 +1,140 @@
+"""Workload-zoo cell builders: spec -> (model, optimizer, data) kits.
+
+One builder per :data:`dtf_tpu.scenarios.spec.WORKLOADS` entry, all with
+the same contract so the host driver (:mod:`dtf_tpu.scenarios._host`) is
+workload-agnostic:
+
+* ``model`` — anything the Trainer drives (loss / init / optional
+  model_state), at TEST scale: the matrix's job is failure x recovery x
+  efficiency coverage on the CPU sim, not model quality, so every cell
+  uses the tiny config of its family (the real-scale knobs are the same
+  dataclasses — a pod matrix swaps the preset, not the harness);
+* ``make_optimizer()`` — a FRESH optimizer per call (supervisor attempts
+  rebuild the trainer; optimizer state lives in the train state, but the
+  wrapper objects carry introspection hooks that must not be shared);
+* ``splits_factory()`` — a FRESH, rewound data stream per call (resume
+  fast-forwards the cursor; a reused mid-stream dataset cannot rewind).
+
+Data is synthetic and deterministic per seed (zero-egress, and the
+convergence gate depends on a replayable trajectory).  nan_grad chaos
+needs a float batch leaf, so token-only workloads (gpt, seq2seq) must use
+the other fault kinds — the spec validation cannot see this, the fault
+fails loudly at injection time instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from dtf_tpu.scenarios.spec import ScenarioSpec, WORKLOADS
+
+
+@dataclasses.dataclass
+class CellKit:
+    model: Any
+    make_optimizer: Callable[[], Any]
+    splits_factory: Callable[[], Any]
+
+
+def _classification_splits(n: int, shape: tuple, classes: int, seed: int,
+                           noise: float = 2.0):
+    """Learnable prototype data (the chaos-suite recipe): class
+    prototypes + gaussian noise, identical on every host."""
+    import numpy as np
+
+    from dtf_tpu.data.datasets import Dataset, DataSplits
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n)
+    protos = rng.normal(0, 1, (classes,) + shape).astype(np.float32)
+    x = (protos[y] + rng.normal(0, noise, (n,) + shape)).astype(np.float32)
+    return DataSplits(train=Dataset(x, np.eye(classes,
+                                             dtype=np.float32)[y],
+                                    seed=seed),
+                      test=None)
+
+
+def _make_opt(spec: ScenarioSpec):
+    from dtf_tpu import optim
+    return optim.get(spec.optimizer)(spec.learning_rate)
+
+
+def _mnist(spec: ScenarioSpec) -> CellKit:
+    from dtf_tpu.models.mlp import MnistMLP
+
+    n = spec.batch_size * 8
+    return CellKit(
+        model=MnistMLP(init_scale="fan_in"),
+        make_optimizer=lambda: _make_opt(spec),
+        splits_factory=lambda: _classification_splits(
+            n, (784,), 10, spec.seed))
+
+
+def _cifar(spec: ScenarioSpec) -> CellKit:
+    from dtf_tpu.models.resnet import ResNet, ResNetConfig
+
+    n = spec.batch_size * 4
+    return CellKit(
+        model=ResNet(ResNetConfig.tiny()),
+        make_optimizer=lambda: _make_opt(spec),
+        splits_factory=lambda: _classification_splits(
+            n, (32, 32, 3), 10, spec.seed, noise=1.0))
+
+
+def _gpt(spec: ScenarioSpec) -> CellKit:
+    from dtf_tpu.data.datasets import DataSplits, TokenDataset, synthetic_text
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+
+    seq_len = int(spec.extra_dict.get("seq_len", 32))
+    cfg = GPTConfig.tiny(max_len=seq_len)
+    toks = synthetic_text(spec.batch_size * 8, seq_len, cfg.vocab_size,
+                          seed=spec.seed)
+    return CellKit(
+        model=GPT(cfg),
+        make_optimizer=lambda: _make_opt(spec),
+        splits_factory=lambda: DataSplits(
+            train=TokenDataset(toks, seed=spec.seed), test=None))
+
+
+def _seq2seq(spec: ScenarioSpec) -> CellKit:
+    import numpy as np
+
+    from dtf_tpu.data.datasets import CallableDataset, DataSplits
+    from dtf_tpu.models.t5 import T5, T5Config
+
+    seq_len = int(spec.extra_dict.get("seq_len", 12))
+    pad_to = max(seq_len, 16)
+    cfg = T5Config.tiny(max_src_len=pad_to, max_tgt_len=pad_to)
+
+    def batch_at(i):
+        # the lm workload's reverse task, per-index rng: deterministic
+        # and position-addressable, so resume replays the exact stream
+        r = np.random.default_rng(spec.seed * 100003 + i)
+        src = r.integers(2, cfg.vocab_size,
+                         (spec.batch_size, seq_len)).astype(np.int32)
+        tgt = src[:, ::-1].copy()
+        pad = pad_to - seq_len
+        if pad:
+            src = np.pad(src, ((0, 0), (0, pad)),
+                         constant_values=cfg.pad_id)
+            tgt = np.pad(tgt, ((0, 0), (0, pad)),
+                         constant_values=cfg.pad_id)
+        return {"src": src, "tgt": tgt}
+
+    return CellKit(
+        model=T5(cfg),
+        make_optimizer=lambda: _make_opt(spec),
+        splits_factory=lambda: DataSplits(
+            train=CallableDataset(batch_at, spec.batch_size,
+                                  spec.steps + 8),
+            test=None))
+
+
+BUILDERS = {"mnist": _mnist, "cifar": _cifar, "gpt": _gpt,
+            "seq2seq": _seq2seq}
+assert tuple(sorted(BUILDERS)) == tuple(sorted(WORKLOADS))
+
+
+def build(spec: ScenarioSpec) -> CellKit:
+    return BUILDERS[spec.workload](spec)

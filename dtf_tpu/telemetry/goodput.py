@@ -154,8 +154,11 @@ def get_tracker() -> GoodputTracker:
 
 def tokens_per_example(model) -> float:
     """Tokens one example contributes to throughput: the model's sequence
-    length when it has one, else 1 (classifiers)."""
-    return float(getattr(getattr(model, "cfg", None), "seq_len", 1) or 1)
+    length when it has one (``cfg.seq_len``, or ``cfg.max_len`` — the
+    GPT spelling), else 1 (classifiers)."""
+    cfg = getattr(model, "cfg", None)
+    return float(getattr(cfg, "seq_len", None)
+                 or getattr(cfg, "max_len", None) or 1)
 
 
 def peak_flops_for_model(model, device):

@@ -142,6 +142,82 @@ def build_report(logdir: str, profile_dir: Optional[str] = None,
     return out
 
 
+def _metric_value(report: dict, name: str, default=None):
+    m = report.get("telemetry", {}).get("metrics", {}).get(name)
+    if m is None or m.get("value") is None:
+        return default
+    return float(m["value"])
+
+
+def check_gates(report: dict, *, min_goodput: Optional[float] = None,
+                min_mfu: Optional[float] = None,
+                max_rollbacks: Optional[int] = None,
+                min_examples_per_s: Optional[float] = None,
+                min_tokens_per_s: Optional[float] = None,
+                max_final_cost: Optional[float] = None,
+                ) -> Tuple[bool, List[str]]:
+    """Threshold gates over a built report — THE gate implementation the
+    ``report --check`` CLI flags, the scenario matrix runner, and the
+    full-suite lanes share.  Every threshold is optional (None = not
+    gated); returns ``(all_ok, verdict lines)``, one line per active
+    gate.  A gated quantity that is MISSING from the report fails its
+    gate (absence of evidence is a failure, not a pass):
+
+    * ``min_goodput`` — goodput fraction floor (``productive_fraction``
+      from the goodput books, 0..1);
+    * ``min_mfu`` — MFU floor in percent of chip peak (``mfu/pct_peak``;
+      unknown-peak backends like the CPU sim should gate on the
+      throughput floors instead);
+    * ``max_rollbacks`` — ceiling on ``checkpoint/rollbacks_total``
+      (absent counter = 0: a run that never rolled back passes);
+    * ``min_examples_per_s`` / ``min_tokens_per_s`` — throughput floors
+      (``throughput/*`` gauges);
+    * ``max_final_cost`` — convergence: the metrics.csv final cost
+      (latest attempt) must be at or under the pinned target.
+    """
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, value, bound, at_most: bool):
+        nonlocal ok
+        if value is None:
+            ok = False
+            lines.append(f"gate {name}: FAIL — not measured "
+                         f"(bound {bound:g})")
+            return
+        passed = value <= bound if at_most else value >= bound
+        ok = ok and passed
+        op = "<=" if at_most else ">="
+        lines.append(f"gate {name}: {'OK' if passed else 'FAIL'} — "
+                     f"{value:g} {op} {bound:g}")
+
+    if min_goodput is not None:
+        frac = report.get("telemetry", {}).get("goodput", {}) \
+            .get("productive_fraction")
+        gate("min_goodput", None if frac is None else float(frac),
+             min_goodput, at_most=False)
+    if min_mfu is not None:
+        gate("min_mfu", _metric_value(report, "mfu/pct_peak"), min_mfu,
+             at_most=False)
+    if max_rollbacks is not None:
+        gate("max_rollbacks",
+             _metric_value(report, "checkpoint/rollbacks_total", 0.0),
+             float(max_rollbacks), at_most=True)
+    if min_examples_per_s is not None:
+        gate("min_examples_per_s",
+             _metric_value(report, "throughput/examples_per_s"),
+             min_examples_per_s, at_most=False)
+    if min_tokens_per_s is not None:
+        gate("min_tokens_per_s",
+             _metric_value(report, "throughput/tokens_per_s"),
+             min_tokens_per_s, at_most=False)
+    if max_final_cost is not None:
+        cost = report.get("steps", {}).get("final_cost")
+        gate("max_final_cost", None if cost is None else float(cost),
+             max_final_cost, at_most=True)
+    return ok, lines
+
+
 def check_goodput(report: dict, tol_pct: float = 10.0
                   ) -> Tuple[bool, str]:
     """The acceptance arithmetic: accounted categories sum to measured
@@ -313,8 +389,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="also write the merged Chrome-trace for Perfetto")
     p.add_argument("--check", action="store_true",
                    help="CI gate: fail unless goodput components sum to "
-                        "wall-clock within --tol percent")
+                        "wall-clock within --tol percent (implied by any "
+                        "threshold flag below)")
     p.add_argument("--tol", type=float, default=10.0)
+    # Threshold gates (check_gates) — the ONE gate implementation the
+    # scenario matrix runner and the full-suite lanes share; each flag
+    # arms its gate, and any of them implies --check.
+    p.add_argument("--min_goodput", type=float, default=None,
+                   help="goodput-fraction floor (productive/wall, 0..1)")
+    p.add_argument("--min_mfu", type=float, default=None,
+                   help="MFU floor in percent of chip peak (mfu/pct_peak)")
+    p.add_argument("--max_rollbacks", type=int, default=None,
+                   help="ceiling on checkpoint/rollbacks_total")
+    p.add_argument("--min_examples_per_s", type=float, default=None,
+                   help="throughput floor (throughput/examples_per_s)")
+    p.add_argument("--min_tokens_per_s", type=float, default=None,
+                   help="throughput floor (throughput/tokens_per_s)")
+    p.add_argument("--max_final_cost", type=float, default=None,
+                   help="convergence gate: metrics.csv final cost ceiling")
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.logdir):
         print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
@@ -331,13 +423,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.export_trace:
             print(f"Chrome trace: {ns.export_trace} "
                   f"({report['exported_trace_events']} events)")
-    if ns.check:
+    thresholds = {"min_goodput": ns.min_goodput, "min_mfu": ns.min_mfu,
+                  "max_rollbacks": ns.max_rollbacks,
+                  "min_examples_per_s": ns.min_examples_per_s,
+                  "min_tokens_per_s": ns.min_tokens_per_s,
+                  "max_final_cost": ns.max_final_cost}
+    armed = {k: v for k, v in thresholds.items() if v is not None}
+    if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json
         # (no goodput section -> (False, ...)).  With --json the verdict
         # goes to stderr so stdout stays parseable.
+        out = sys.stderr if ns.json else sys.stdout
         ok, verdict = check_goodput(report, ns.tol)
         print(f"goodput check: {'OK' if ok else 'FAIL'} — {verdict}",
-              file=sys.stderr if ns.json else sys.stdout)
+              file=out)
+        if armed:
+            gates_ok, lines = check_gates(report, **armed)
+            for line in lines:
+                print(line, file=out)
+            ok = ok and gates_ok
         if not ok:
             return 1
     return 0

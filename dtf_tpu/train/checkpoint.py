@@ -29,6 +29,7 @@ import zlib
 from typing import Any, List, Optional
 
 import jax
+import jax.numpy as jnp
 
 from dtf_tpu import telemetry as tel
 
@@ -85,6 +86,7 @@ class CheckpointManager:
         # run's values and logs the reshard (dense<->zero1 conversion,
         # elastic shrink) instead of leaving it silent.
         self._run_meta = dict(run_meta) if run_meta else {}
+        self._async_save = async_save
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -106,6 +108,21 @@ class CheckpointManager:
         """Async save; returns True if a save was queued/performed."""
         import time as _time
         t0 = _time.perf_counter()
+        if self._async_save and jax.default_backend() == "cpu":
+            # On the CPU backend orbax's "transfer to host" is zero-copy
+            # aliasing of the LIVE device buffers — and the train step
+            # donates its state, so the next dispatched step reuses those
+            # buffers in place while the async writer is still
+            # serializing.  Observed (scenario matrix, loaded box): torn
+            # checkpoints whose label-N tree holds step-N+1 bytes, which
+            # the CRC manifest cannot catch (it checksums whatever
+            # landed) and which silently forks the resumed trajectory.
+            # Snapshot on-device first: one extra copy of the state,
+            # sharding preserved, bytes pinned.  Real accelerators pay a
+            # genuine D2H copy inside orbax before save() returns, and a
+            # synchronous save finishes serializing before the next step
+            # can dispatch — neither needs (or gets) the extra copy.
+            state = jax.tree_util.tree_map(jnp.copy, state)
         with tel.span("checkpoint/save", step=step):
             saved = self._mgr.save(
                 step, args=self._ocp.args.StandardSave(state), force=force)

@@ -1415,9 +1415,12 @@ class Trainer:
                                 tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state)
                             if self._chaos is not None:
-                                # Inside the suspended window: the hook
-                                # drains the async save + checksums files,
-                                # which must not read as a training hang.
+                                # Inside the suspended window: the hooks
+                                # drain the async save + checksum files /
+                                # sleep out an injected write stall, which
+                                # must not read as a training hang.
+                                self._chaos.maybe_ckpt_stall(
+                                    self._host_step)
                                 self._chaos.maybe_corrupt_after_save(
                                     self._host_step, self.ckpt)
                     # Preemption decision: single-process polls the local
@@ -1435,6 +1438,12 @@ class Trainer:
                                 tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state,
                                            force=True)
+                            if self._chaos is not None:
+                                # A slow store delays the preemption
+                                # drain too — same measured window as
+                                # the periodic save's stall hook.
+                                self._chaos.maybe_ckpt_stall(
+                                    self._host_step)
                         # logger.event, not a bare print: the agreed-save
                         # decision lands as an `event/preempted` scalar in
                         # the TensorBoard stream, so drains are countable
@@ -1640,8 +1649,9 @@ class Trainer:
                 self.logger.print(
                     f"[dtf_tpu] WARNING: chaos faults never fired: "
                     f"{','.join(str(f) for f in pend)} (step never "
-                    f"reached, or corrupt_ckpt step not a checkpoint "
-                    f"boundary) — this run did NOT exercise them")
+                    f"reached, or a corrupt_ckpt/ckpt_stall step not a "
+                    f"checkpoint boundary) — this run did NOT exercise "
+                    f"them")
         if self.ckpt is not None:
             with tracker.measure("checkpoint"):
                 if (not preempted and self.cfg.checkpoint_every > 0

@@ -39,9 +39,16 @@ if [ "${NO_TELEMETRY_LANE:-0}" != "1" ]; then
       --chaos "nan_grad@4,stall@7:1s,sigterm@11" > "$tdir/run.log" 2>&1
   rc=$?
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: telemetry lane run (rc=$rc)"; tail -5 "$tdir/run.log"; }
-  python -m dtf_tpu.telemetry.report "$tdir" --check | tee "$tdir/report.log"
+  # --max_rollbacks/--max_final_cost arm the same check_gates the
+  # scenario matrix gates with (one gate implementation, DESIGN.md §8);
+  # the run above restarts once but never rolls back, and MNIST at
+  # these settings lands well under cost 1.0.
+  python -m dtf_tpu.telemetry.report "$tdir" --check \
+      --max_rollbacks 0 --max_final_cost 1.0 | tee "$tdir/report.log"
   rc=${PIPESTATUS[0]}       # the report's exit status, not tee's
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --check (rc=$rc)"; }
+  grep -q "gate max_final_cost: OK" "$tdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report threshold gates missing"; }
   grep -q "Goodput breakdown" "$tdir/report.log" \
     && grep -q "Top spans" "$tdir/report.log" \
     || { FAILS=$((FAILS + 1)); echo "FAILED: report missing sections"; }
@@ -294,6 +301,45 @@ PYEOF
   rc=$?
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve lane assertions (rc=$rc)"; }
   rm -rf "$sdir"
+fi
+# Scenario lane (DESIGN.md §8): the 2-cell mini-matrix through the real
+# cell runner with --check — one chaos-off GPT baseline cell (the
+# control row) and the host_down MNIST elastic cell (SIGKILL mid-run ->
+# coordinated abort -> relaunch on a 4->2 shrunken mesh), each gated on
+# all three of pinned convergence / goodput floor / throughput floor
+# read from the on-disk telemetry.  Skip with NO_SCENARIO_LANE=1.
+if [ "${NO_SCENARIO_LANE:-0}" != "1" ]; then
+  echo "=== scenario lane (mini matrix: baseline + elastic, triple gate) ==="
+  scdir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.scenarios --matrix mini \
+      --out "$scdir" --check > "$scdir/lane.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: scenario mini-matrix --check (rc=$rc)"; tail -20 "$scdir/lane.log"; }
+  grep -q "scenario check: OK" "$scdir/lane.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: scenario check line missing"; }
+  python - "$scdir" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+cells = {}
+for name in ("gpt_baseline", "mnist_host_down_elastic"):
+    doc = json.load(open(os.path.join(d, f"{name}.json")))
+    assert doc["ok"], (name, doc["gates"], doc["error"])
+    # all three gate families produced verdicts (plus the books check)
+    text = "\n".join(doc["gates"])
+    assert "goodput_books" in text and "min_goodput" in text \
+        and "max_final_cost" in text, text
+    assert any(k in text for k in ("min_examples_per_s",
+                                   "min_tokens_per_s", "min_mfu")), text
+    cells[name] = doc
+# the elastic cell really relaunched on the shrunken mesh
+assert cells["mnist_host_down_elastic"]["rounds"] == 1, \
+    cells["mnist_host_down_elastic"]["rounds"]
+print("scenario lane OK: 2/2 cells passed the triple gate "
+      f"(elastic relaunch rounds={cells['mnist_host_down_elastic']['rounds']})")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: scenario lane assertions (rc=$rc)"; }
+  rm -rf "$scdir"
 fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

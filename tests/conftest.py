@@ -30,6 +30,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serve: serving-engine tests (paged KV, scheduler, "
                    "load bench)")
+    config.addinivalue_line(
+        "markers", "scenarios: scenario-matrix tests (spec/zoo/runner/"
+                   "CLI + real cells)")
 
 
 # ---------------------------------------------------------------------------

@@ -90,6 +90,13 @@ class TestFaultPlanParse:
         "loader_error@9,corrupt_ckpt@30",
         "host_down@30:1,slow_host@10:1:250ms,partition@12,partition@15:0",
         "stall@every:50:1s,nan_grad@every:7,loader_error@every:3",
+        # the scenario-matrix kinds: recurring preemption, one-shot and
+        # persistent checkpoint-write stalls
+        "preempt@every:12,ckpt_stall@10:200ms,ckpt_stall@every:5:150ms",
+        "preempt@8",
+        # a compound plan mixing every fault family in one spec
+        "preempt@every:12,ckpt_stall@10:200ms,host_down@20:1,"
+        "slow_host@5:0:50ms,nan_grad@every:7,corrupt_ckpt@latest",
     ])
     def test_spec_round_trips(self, spec):
         """str(parse(spec)) == spec, and re-parsing the printed form is a
@@ -119,6 +126,57 @@ class TestFaultPlanParse:
         plan.maybe_loader_error(5)
         with pytest.raises(ChaosLoaderError):
             plan.maybe_loader_error(10)                # next period fires
+
+    def test_preempt_fires_sigterm_on_every_period(self):
+        """preempt@every:N delivers SIGTERM at N, 2N, ... — the recurring
+        spot-reclamation schedule the scenario matrix cells use (each
+        firing ends in a clean checkpoint; the shared plan keeps the
+        schedule across supervisor attempts)."""
+        kills = []
+        plan = FaultPlan.parse("preempt@every:10", process_index=0,
+                               kill=lambda pid, sig: kills.append(sig))
+        for step in range(31):
+            plan.maybe_step_faults(step)
+        assert kills == [signal.SIGTERM] * 3           # steps 10, 20, 30
+        assert plan.pending() == []                    # standing schedule
+        # replaying the firing step (a resumed attempt) must not refire
+        plan.maybe_step_faults(30)
+        assert len(kills) == 3
+
+    def test_one_shot_preempt_fires_once(self):
+        kills = []
+        plan = FaultPlan.parse("preempt@4", process_index=0,
+                               kill=lambda pid, sig: kills.append(sig))
+        for _ in range(2):
+            plan.maybe_step_faults(4)
+        assert kills == [signal.SIGTERM]
+
+    def test_sigterm_every_is_rejected_with_preempt_hint(self):
+        with pytest.raises(ValueError, match="preempt@every"):
+            FaultPlan.parse("sigterm@every:10")
+
+    def test_ckpt_stall_sleeps_at_checkpoint_hook(self):
+        """ckpt_stall sleeps only via maybe_ckpt_stall (the trainer's
+        checkpoint window), default-ms durations, one-shot and periodic."""
+        sleeps = []
+        plan = FaultPlan.parse("ckpt_stall@10:200ms", process_index=0,
+                               sleep=sleeps.append)
+        plan.maybe_step_faults(10)                     # not a step fault
+        assert sleeps == []
+        plan.maybe_ckpt_stall(5)
+        assert sleeps == []                            # wrong step
+        plan.maybe_ckpt_stall(10)
+        plan.maybe_ckpt_stall(10)                      # one-shot
+        assert sleeps == [0.2]
+        periodic = FaultPlan.parse("ckpt_stall@every:5:150ms",
+                                   process_index=0, sleep=sleeps.append)
+        for step in (5, 10, 12):
+            periodic.maybe_ckpt_stall(step)
+        assert sleeps == [0.2, 0.15, 0.15]             # 5 and 10 fire
+
+    def test_ckpt_stall_needs_duration(self):
+        with pytest.raises(ValueError, match="ckpt_stall"):
+            FaultPlan.parse("ckpt_stall@10")
 
     def test_host_targeted_faults_respect_process_index(self):
         kills = []

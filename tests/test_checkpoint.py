@@ -42,6 +42,48 @@ class TestCheckpointManager:
         assert step is None and restored is state
         mgr.close()
 
+    def test_cpu_save_never_aliases_live_buffers(self, mesh8, tmp_path):
+        """On the CPU backend orbax's transfer-to-host is zero-copy, so an
+        async save of the LIVE (donated) train state can serialize bytes
+        the next dispatched step already overwrote — a torn checkpoint
+        whose label-N tree holds step-N+1 values (caught in the wild by
+        the scenario matrix's elastic cell; the CRC manifest can't see it
+        because it checksums whatever landed).  Pin the fix: the tree
+        handed to orbax must be a SNAPSHOT, sharing no buffer with the
+        caller's state."""
+        model = MnistMLP(init_scale="fan_in")
+        state = init_state(model, optim.sgd(0.1), seed=1, mesh=mesh8)
+        mgr = CheckpointManager(str(tmp_path / "snap"), async_save=True)
+        captured = {}
+        real_save = mgr._mgr.save
+
+        def spy(step, args=None, force=False):
+            captured["tree"] = args.item
+            return real_save(step, args=args, force=force)
+
+        mgr._mgr.save = spy
+        mgr.save(3, state, force=True)
+        mgr.wait()
+        assert "tree" in captured
+
+        def ptrs(tree):
+            return {s.data.unsafe_buffer_pointer()
+                    for x in jax.tree_util.tree_leaves(tree)
+                    if isinstance(x, jax.Array)
+                    for s in x.addressable_shards}
+
+        live, saved = ptrs(state), ptrs(captured["tree"])
+        assert live and saved
+        assert not (live & saved), "saved tree aliases live state buffers"
+        # and the snapshot really landed with the right contents
+        template = init_state(model, optim.sgd(0.1), seed=2, mesh=mesh8)
+        restored, step = mgr.restore(template)
+        assert step == 3
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["l1"]["w"]),
+            np.asarray(state["params"]["l1"]["w"]))
+        mgr.close()
+
 
 class TestTrainerResume:
     def test_crash_resume_continues_trajectory(self, mesh8, tmp_path):

@@ -390,6 +390,80 @@ class TestMultiProcess:
             f"elastic-restart loss {done.group(2)} != fault-free " \
             f"{ref_done.group(2)}"
 
+    @pytest.mark.chaos
+    @pytest.mark.scenarios
+    def test_elastic_restart_zero1_transformer(self, tmp_path):
+        """Elastic 4 -> 2 restart under --grad_sync zero1 on a
+        TRANSFORMER workload (ISSUE-8 satellite: the acceptance pair
+        above only covers the MLP path).  A 2-host tiny-GPT cell with
+        ZeRO-1 sharded optimizer state loses host 1 mid-run; the
+        relaunch reshards the bucketed opt state onto the shrunken mesh
+        (PR 5's N-stable padding) and must finish with the SAME final
+        loss as a fault-free run of the same trajectory."""
+        import json
+        import re
+
+        from dtf_tpu.resilience.supervisor import run_elastic_hosts
+        from dtf_tpu.scenarios.spec import Gate, ScenarioSpec
+
+        # Same timing discipline as the scenario matrix's elastic cell:
+        # host 1 (100ms/step) dies at its step 12 (~1.2s past the
+        # lockstep barrier) while host 0 (250ms/step pacing, 40-step
+        # budget ~11s) is reliably MID-run when the loss is detected
+        # (~5s) — the abort must interrupt training, not lose a race
+        # with completion.
+        spec = ScenarioSpec(
+            name="gpt_zero1_elastic", workload="gpt", hosts=2,
+            devices=4, shrink_devices=2, grad_sync="zero1",
+            steps=40, batch_size=16, learning_rate=3e-3,
+            checkpoint_every=4, log_frequency=4,
+            chaos=("slow_host@0:0:250ms,slow_host@0:1:100ms,"
+                   "host_down@12:1"),
+            gate=Gate(max_final_cost=10.0, min_goodput=0.0))
+        shared = str(tmp_path / "shared")
+
+        def build_cmd(slot, n_hosts, round_idx):
+            chaos = spec.chaos if round_idx == 0 else ""
+            devices = spec.devices if round_idx == 0 \
+                else spec.shrink_devices
+            return [sys.executable, "-m", "dtf_tpu.scenarios._host",
+                    spec.to_json(), str(slot), str(n_hosts), shared,
+                    str(devices), chaos]
+
+        outs, n_final, rounds = run_elastic_hosts(
+            build_cmd, 2, max_rounds=2, env=child_env(4),
+            cwd=str(tmp_path), timeout_s=360)
+        assert (n_final, rounds) == (1, 1), (n_final, rounds, outs)
+        done = re.search(r"SCENARIO_DONE steps=(\d+) "
+                         r"final_cost=([0-9.]+)", outs[0])
+        assert done, outs[0][-3000:]
+        assert int(done.group(1)) == 40
+        assert "resumed from step" in outs[0], outs[0][-3000:]
+        # the restored checkpoint really carried zero1-sharded state
+        mdir = os.path.join(shared, "logs", "checkpoints", "manifests")
+        manifests = [json.load(open(os.path.join(mdir, f)))
+                     for f in os.listdir(mdir) if f.endswith(".json")]
+        assert manifests and all(
+            m["run"].get("grad_sync") == "zero1"
+            for m in manifests), manifests
+
+        # Fault-free reference on the shrunken mesh: the elastic run
+        # resumed the same trajectory, so final losses must coincide.
+        ref_shared = str(tmp_path / "ref")
+        ref = subprocess.run(
+            [sys.executable, "-m", "dtf_tpu.scenarios._host",
+             spec.to_json(), "0", "1", ref_shared, "2", ""],
+            cwd=tmp_path, env=child_env(4), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=360)
+        assert ref.returncode == 0, ref.stdout[-3000:]
+        ref_done = re.search(r"SCENARIO_DONE steps=(\d+) "
+                             r"final_cost=([0-9.]+)", ref.stdout)
+        assert ref_done, ref.stdout[-3000:]
+        assert abs(float(done.group(2))
+                   - float(ref_done.group(2))) < 5e-3, \
+            f"elastic zero1 loss {done.group(2)} != fault-free " \
+            f"{ref_done.group(2)}"
+
     def test_two_process_restore_robust_fallback(self, tmp_path):
         """Multi-host restore_robust (tests/_mp_restore_robust.py): with
         the latest checkpoint corrupted on a shared directory, BOTH

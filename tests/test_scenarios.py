@@ -1,0 +1,261 @@
+"""Scenario matrix (dtf_tpu/scenarios, DESIGN.md §8): spec grammar,
+curated matrices, zoo builders, gate wiring, CLI — plus a slow
+end-to-end supervised cell through the real child-process runner.
+
+The fast tests are deliberately jax-free (spec/runner/CLI import no
+backend); the zoo tests build models but never train; only the
+``slow``-marked end-to-end tests spawn cells.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dtf_tpu.scenarios.spec import (Gate, MATRICES, ScenarioSpec, WORKLOADS,
+                                    default_matrix, load_matrix, mini_matrix)
+
+pytestmark = pytest.mark.scenarios
+
+
+def tiny_spec(**kw) -> ScenarioSpec:
+    base = dict(name="t", workload="mnist",
+                gate=Gate(max_final_cost=2.5, min_goodput=0.01,
+                          min_examples_per_s=1.0))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+class TestSpec:
+    def test_json_round_trip(self):
+        spec = tiny_spec(name="rt", workload="gpt", chaos="preempt@every:9",
+                         steps=12, grad_sync="zero1",
+                         extra=(("seq_len", 16),),
+                         gate=Gate(max_final_cost=5.0, min_goodput=0.1,
+                                   min_tokens_per_s=10.0, max_rollbacks=2))
+        again = ScenarioSpec.from_json(spec.to_json())
+        assert again == spec
+        assert again.extra_dict == {"seq_len": 16}
+        # the doc is plain JSON — what <out>/<name>.json embeds
+        doc = json.loads(spec.to_json())
+        assert doc["gate"]["max_final_cost"] == 5.0
+
+    def test_unknown_workload_rejected(self):
+        with pytest.raises(ValueError, match="unknown workload"):
+            tiny_spec(workload="resnet152")
+
+    def test_bad_chaos_rejected_at_load_time_with_cell_name(self):
+        """A typo'd fault fails when the matrix loads — through the REAL
+        FaultPlan grammar — with the cell named."""
+        with pytest.raises(ValueError, match="'bad_cell'.*bad chaos"):
+            tiny_spec(name="bad_cell", chaos="sigquit@7")
+
+    def test_elastic_without_host_down_rejected(self):
+        with pytest.raises(ValueError, match="host_down"):
+            tiny_spec(hosts=2, chaos="nan_grad@3")
+
+    def test_gate_thresholds_arm_only_set_floors(self):
+        """Gate -> check_gates kwargs: convergence + goodput always armed,
+        throughput/MFU/rollbacks only when set — the exact contract the
+        runner feeds report.check_gates."""
+        g = Gate(max_final_cost=1.0, min_goodput=0.2)
+        assert g.thresholds() == {"max_final_cost": 1.0,
+                                  "min_goodput": 0.2}
+        g = Gate(max_final_cost=1.0, min_goodput=0.2, min_mfu_pct=30.0,
+                 min_tokens_per_s=5.0, max_rollbacks=0)
+        assert g.thresholds() == {"max_final_cost": 1.0,
+                                  "min_goodput": 0.2, "min_mfu": 30.0,
+                                  "min_tokens_per_s": 5.0,
+                                  "max_rollbacks": 0}
+
+
+class TestMatrices:
+    def test_default_matrix_covers_the_contract(self):
+        """ISSUE-8 shape: >= 6 cells, >= 4 workloads, chaos-off baselines
+        AND host_down/straggler/recurring-preemption/nan+corrupt plans,
+        at least one elastic (shrunken-mesh) cell, one zero1 cell."""
+        cells = default_matrix()
+        assert len(cells) >= 6
+        assert len({c.workload for c in cells}) >= 4
+        assert len({c.name for c in cells}) == len(cells)
+        chaos = ",".join(c.chaos or "" for c in cells)
+        assert any(c.chaos is None for c in cells)
+        for kind in ("host_down", "slow_host", "preempt@every",
+                     "nan_grad", "corrupt_ckpt", "ckpt_stall"):
+            assert kind in chaos, f"no cell injects {kind}"
+        elastic = [c for c in cells if c.hosts > 1]
+        assert elastic and all(0 < c.shrink_devices < c.devices
+                               for c in elastic)
+        assert any(c.grad_sync == "zero1" for c in cells)
+
+    def test_default_matrix_chaos_parses_for_every_host(self):
+        """Host-targeted faults must parse under every process index the
+        cell will spawn (the _host child parses with its own task id)."""
+        from dtf_tpu.resilience.chaos import FaultPlan
+        for c in default_matrix():
+            if not c.chaos:
+                continue
+            for task in range(c.hosts):
+                FaultPlan.parse(c.chaos, process_index=task)
+
+    def test_mini_matrix_is_the_lane_pair(self):
+        names = [c.name for c in mini_matrix()]
+        assert names == ["gpt_baseline", "mnist_host_down_elastic"]
+        by_name = {c.name: c for c in default_matrix()}
+        assert all(by_name[n] == c for n, c in
+                   zip(names, mini_matrix()))
+
+    def test_load_matrix_builtin_and_file(self, tmp_path):
+        assert load_matrix("mini") == mini_matrix()
+        path = tmp_path / "m.json"
+        docs = [json.loads(c.to_json()) for c in mini_matrix()]
+        path.write_text(json.dumps(docs))
+        assert load_matrix(str(path)) == mini_matrix()
+
+    def test_load_matrix_rejects_duplicates_and_non_lists(self, tmp_path):
+        dup = tmp_path / "dup.json"
+        doc = json.loads(tiny_spec().to_json())
+        dup.write_text(json.dumps([doc, doc]))
+        with pytest.raises(ValueError, match="duplicate"):
+            load_matrix(str(dup))
+        empty = tmp_path / "empty.json"
+        empty.write_text("[]")
+        with pytest.raises(ValueError, match="non-empty"):
+            load_matrix(str(empty))
+
+    def test_matrices_registry(self):
+        assert set(MATRICES) >= {"default", "mini"}
+
+
+class TestZoo:
+    def test_builders_in_sync_with_spec_workloads(self):
+        """spec.WORKLOADS (jax-free) mirrors zoo.BUILDERS (jax-heavy);
+        this is the pinned sync the spec docstring promises."""
+        from dtf_tpu.scenarios import zoo
+        assert tuple(sorted(zoo.BUILDERS)) == tuple(sorted(WORKLOADS))
+
+    @pytest.mark.parametrize("workload", WORKLOADS)
+    def test_kits_build_and_data_streams_rewind(self, workload):
+        """Every builder yields a model + fresh optimizer per call + a
+        splits_factory whose streams REWIND (restart attempts replay the
+        same data — the convergence gate depends on it)."""
+        import numpy as np
+
+        from dtf_tpu.scenarios import zoo
+        kit = zoo.build(tiny_spec(workload=workload, batch_size=8,
+                                  steps=4))
+        assert kit.make_optimizer() is not kit.make_optimizer()
+        a = kit.splits_factory().train.next_batch(8)
+        b = kit.splits_factory().train.next_batch(8)
+        for la, lb in zip(*[list(x.values()) if isinstance(x, dict)
+                            else list(x) for x in (a, b)]):
+            np.testing.assert_array_equal(la, lb)
+
+
+class TestRunnerPieces:
+    def test_cell_result_doc_is_json(self):
+        from dtf_tpu.scenarios.runner import CellResult
+        res = CellResult(tiny_spec(), True,
+                         ["gate min_goodput: OK — 0.5 >= 0.2"],
+                         {"final_cost": 1.0}, 2.5, logdir="/tmp/x")
+        doc = res.to_doc()
+        assert json.loads(json.dumps(doc))["ok"] is True
+        assert doc["spec"]["name"] == "t"
+
+    def test_summary_table_renders_missing_measurements(self):
+        from dtf_tpu.scenarios.__main__ import summary_table
+        from dtf_tpu.scenarios.runner import CellResult
+        table = summary_table([
+            CellResult(tiny_spec(), False, [], {}, 1.0,
+                       error="host exited 1")])
+        assert "FAIL" in table and "0/1 cells passed" in table
+
+    def test_child_env_strips_sitecustomize_and_forces_cpu(self, tmp_path):
+        from dtf_tpu.scenarios.runner import child_env
+        shim = tmp_path / "shim"
+        shim.mkdir()
+        (shim / "sitecustomize.py").write_text("")
+        old = os.environ.get("PYTHONPATH")
+        os.environ["PYTHONPATH"] = str(shim)
+        try:
+            env = child_env()
+        finally:
+            if old is None:
+                os.environ.pop("PYTHONPATH", None)
+            else:
+                os.environ["PYTHONPATH"] = old
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert str(shim) not in env["PYTHONPATH"]
+
+
+class TestCLI:
+    def test_list_and_bad_inputs(self, capsys):
+        from dtf_tpu.scenarios.__main__ import main
+        assert main(["--matrix", "mini", "--list"]) == 0
+        out = capsys.readouterr().out
+        assert "gpt_baseline" in out and "mnist_host_down_elastic" in out
+        assert main(["--matrix", "/nonexistent/m.json"]) == 2
+        assert main(["--matrix", "mini", "--only", "nope"]) == 2
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    """One real supervised cell through the child-process runner: the
+    fault fires, the supervisor restarts, the triple gate reads the
+    books the run left on disk.  (The elastic shape is covered by
+    tests/test_multiprocess.py's zero1-transformer pair and the
+    full-suite scenario lane.)"""
+
+    def _cell(self):
+        return tiny_spec(
+            name="e2e_mnist_preempt", workload="mnist", devices=2,
+            steps=16, batch_size=64, learning_rate=5e-2, optimizer="sgd",
+            checkpoint_every=4, chaos="preempt@9", max_restarts=1,
+            gate=Gate(max_final_cost=2.5, min_goodput=0.005,
+                      min_examples_per_s=10.0, max_rollbacks=0))
+
+    def test_run_cell_passes_triple_gate_despite_preemption(self, tmp_path):
+        from dtf_tpu.scenarios.runner import run_cell
+        res = run_cell(self._cell(), str(tmp_path))
+        assert res.ok, (res.error, res.gates)
+        assert res.measured["steps"] == 16
+        assert res.measured["restarts"] == 1      # the preempt fired
+        assert res.measured["faults_fired"] == 1
+        # every armed gate produced a verdict line, all OK
+        assert len(res.gates) == 5 and all("OK" in g for g in res.gates)
+        # recovery is OBSERVABLE: books survived the restart
+        assert os.path.isfile(os.path.join(res.logdir, "telemetry.json"))
+
+    def test_cli_check_emits_json_and_summary(self, tmp_path):
+        from dtf_tpu.scenarios.runner import REPO_ROOT, child_env
+        matrix = tmp_path / "m.json"
+        matrix.write_text(json.dumps(
+            [json.loads(self._cell().to_json())]))
+        out = tmp_path / "results"
+        proc = subprocess.run(
+            [sys.executable, "-m", "dtf_tpu.scenarios",
+             "--matrix", str(matrix), "--out", str(out), "--check"],
+            cwd=REPO_ROOT, env=child_env(), text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=420)
+        assert proc.returncode == 0, proc.stdout[-3000:]
+        assert "scenario check: OK" in proc.stdout
+        doc = json.loads((out / "e2e_mnist_preempt.json").read_text())
+        assert doc["ok"] and doc["spec"]["chaos"] == "preempt@9"
+        assert (out / "summary.txt").read_text().strip()
+
+    def test_failing_gate_fails_the_check(self, tmp_path):
+        """An absurd convergence target must FAIL the cell and the CLI
+        exit code — the gate is falsifiable, not decorative."""
+        from dtf_tpu.scenarios.runner import run_cell
+        spec = self._cell()
+        bad = ScenarioSpec(**{**{f.name: getattr(spec, f.name)
+                                 for f in spec.__dataclass_fields__.values()},
+                              "name": "e2e_impossible",
+                              "gate": Gate(max_final_cost=1e-9,
+                                           min_goodput=0.005)})
+        res = run_cell(bad, str(tmp_path))
+        assert not res.ok
+        assert any("max_final_cost" in g and "FAIL" in g
+                   for g in res.gates)

@@ -415,6 +415,46 @@ class TestReportCLI:
         assert report.main([d, "--check"]) == 1
         assert "goodput check: FAIL" in capsys.readouterr().out
 
+    def test_threshold_gates(self, tmp_path, capsys):
+        """check_gates: the shared gate implementation behind the
+        --min_goodput/--min_mfu/--max_rollbacks flags (and the scenario
+        matrix runner).  Fixture: goodput 0.8, mfu 41.5%, tokens/s
+        1234.5, final cost 1.7, no rollbacks counter."""
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        rep = report.build_report(d)
+        ok, lines = report.check_gates(
+            rep, min_goodput=0.5, min_mfu=40.0, max_rollbacks=1,
+            min_tokens_per_s=1000.0, max_final_cost=2.0)
+        assert ok, lines
+        assert len(lines) == 5 and all("OK" in ln for ln in lines)
+        # each bound individually violated flips only its own gate
+        for kw, bad in (("min_goodput", 0.9), ("min_mfu", 50.0),
+                        ("min_tokens_per_s", 2000.0),
+                        ("max_final_cost", 1.0)):
+            ok, lines = report.check_gates(rep, **{kw: bad})
+            assert not ok and "FAIL" in lines[0], (kw, lines)
+        # absent rollbacks counter reads as 0 (passes a ceiling of 0)
+        ok, _ = report.check_gates(rep, max_rollbacks=0)
+        assert ok
+        # a gated-but-unmeasured quantity fails, never silently passes
+        ok, lines = report.check_gates(rep, min_examples_per_s=1.0)
+        assert not ok and "not measured" in lines[0]
+
+    def test_threshold_gate_flags_imply_check(self, tmp_path, capsys):
+        """The CLI flags arm the same gates and fail the exit code —
+        without needing an explicit --check."""
+        from dtf_tpu.telemetry import report
+        d = self._fixture_logdir(tmp_path)
+        assert report.main([d, "--min_goodput", "0.5", "--min_mfu", "40",
+                            "--max_rollbacks", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "gate min_goodput: OK" in out
+        assert "gate min_mfu: OK" in out
+        assert "gate max_rollbacks: OK" in out
+        assert report.main([d, "--min_goodput", "0.95"]) == 1
+        assert "gate min_goodput: FAIL" in capsys.readouterr().out
+
     def test_export_trace(self, tmp_path, capsys):
         from dtf_tpu.telemetry import report
         d = self._fixture_logdir(tmp_path)
