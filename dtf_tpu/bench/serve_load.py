@@ -49,26 +49,40 @@ AB_MIN_RATIO = 1.5
 
 def poisson_trace(*, seed: int, n_requests: int, qps: float,
                   prompt_lens: List[int], output_lens: List[int],
-                  vocab_size: int,
-                  temperature: float = 0.0) -> List[Tuple[float, dict]]:
+                  vocab_size: int, temperature: float = 0.0,
+                  deadline_ms: Optional[float] = None,
+                  priorities: Optional[List[int]] = None,
+                  ) -> List[Tuple[float, dict]]:
     """Seeded Poisson arrivals with lengths drawn uniformly from the
     mixed pools.  The arrival process is a UNIT-RATE exponential chain
     scaled by ``1/qps``: every sweep point (and both modes of the A/B)
     replays the same requests with the same relative burst structure,
     only faster — so the latency-vs-QPS curve is a monotone load
-    experiment, not per-point trace lottery."""
+    experiment, not per-point trace lottery.
+
+    ``deadline_ms`` attaches one completion deadline to every request;
+    ``priorities`` is a pool each request's priority class is drawn
+    from (uniform, seeded — drawn LAST so traces with the default
+    single-class pool keep the exact token streams of older traces)."""
     rng = np.random.default_rng(seed)
     trace: List[Tuple[float, dict]] = []
     t = 0.0
     for rid in range(n_requests):
         t += float(rng.exponential(1.0)) / qps
         p = int(rng.choice(prompt_lens))
-        trace.append((t, {
+        kw = {
             "rid": rid,
             "prompt": rng.integers(0, vocab_size, (p,)).astype(np.int32),
             "max_new_tokens": int(rng.choice(output_lens)),
             "temperature": temperature,
-        }))
+        }
+        if deadline_ms is not None:
+            kw["deadline_ms"] = float(deadline_ms)
+        if priorities and len(priorities) > 1:
+            kw["priority"] = int(rng.choice(priorities))
+        elif priorities:
+            kw["priority"] = int(priorities[0])
+        trace.append((t, kw))
     return trace
 
 
@@ -108,6 +122,97 @@ def sustained_goodput(points: List[Dict], budget_ms: float) -> Dict:
     best = max(ok, key=lambda p: p.get("goodput_qps", 0.0))
     return {"sustained_goodput_qps": float(best.get("goodput_qps", 0.0)),
             "at_offered_qps": best["offered_qps"]}
+
+
+def run_chaos_point(model, params, *, controller: bool, ns) -> Dict:
+    """One overload run: the seeded trace (deadlines + mixed priority
+    classes) through a chaos'd engine, with or without the brownout
+    controller.  Fresh engine, fresh clock, fresh fault plan (fired
+    latches are per-run state) — the ONLY difference between the two
+    arms is the controller."""
+    from dtf_tpu.resilience.chaos import FaultPlan
+    from dtf_tpu.serve import (BrownoutController, ServingEngine,
+                               VirtualClock, WallClock)
+
+    clock = VirtualClock() if ns.clock == "virtual" else WallClock()
+    brownout = (BrownoutController(ns.slo_ttft_ms,
+                                   degrade_max_new=ns.degrade_max_new)
+                if controller else None)
+    chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    engine = ServingEngine(
+        model, params, num_slots=ns.slots, block_size=ns.block_size,
+        num_blocks=ns.pool_blocks, mode="continuous", seed=ns.seed,
+        clock=clock, max_queue=ns.max_queue, top_k=ns.top_k,
+        top_p=ns.top_p, brownout=brownout, chaos=chaos)
+    trace = poisson_trace(
+        seed=ns.seed, n_requests=ns.requests, qps=ns.qps_list[0],
+        prompt_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
+        vocab_size=model.cfg.vocab_size, temperature=ns.temperature,
+        deadline_ms=ns.deadline_ms or None,
+        priorities=ns.priorities_list)
+    engine.run(trace)
+    out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
+    out["controller"] = controller
+    out["offered_qps"] = ns.qps_list[0]
+    out["chaos"] = ns.chaos
+    return out
+
+
+def chaos_gates(on: Dict, off: Dict) -> Tuple[bool, List[str]]:
+    """The overload acceptance gates (ISSUE 10):
+
+    * **zero deadline violations** among admitted-and-completed
+      requests in the controller arm (beyond the SLO grace the summary
+      already folds in) — overload must shed, not silently blow
+      deadlines;
+    * **sheds are booked with reasons** — load was actually dropped at
+      the front door, observably;
+    * **the controller strictly improves goodput QPS** on the same
+      trace under the same injected spike — brownout pays for itself.
+    """
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, passed, detail):
+        nonlocal ok
+        ok = ok and passed
+        lines.append(f"gate {name}: {'OK' if passed else 'FAIL'} — "
+                     f"{detail}")
+
+    viol = on.get("deadline_violations")
+    gate("deadline_violations",
+         viol == 0,
+         f"{viol} violation(s) among "
+         f"{on.get('deadline_requests_completed', 0)} completed "
+         f"deadline-carrying request(s) (controller arm)"
+         if viol is not None else "deadlines not armed (set "
+         "--deadline_ms)")
+    shed = on.get("shed", 0)
+    gate("sheds_booked", shed > 0 and bool(on.get("shed_reasons")),
+         f"{shed} shed with reasons {on.get('shed_reasons')}")
+    g_on = on.get("goodput_qps", 0.0)
+    g_off = off.get("goodput_qps", 0.0)
+    gate("controller_improves_goodput", g_on > g_off,
+         f"goodput {g_on:.3f} qps with controller vs {g_off:.3f} "
+         f"without (same trace, same spike)")
+    return ok, lines
+
+
+def chaos_ab(model, params, ns) -> Dict:
+    """Same-trace controller-on/off A/B under the injected spike."""
+    on = run_chaos_point(model, params, controller=True, ns=ns)
+    off = run_chaos_point(model, params, controller=False, ns=ns)
+    ok, lines = chaos_gates(on, off)
+    for arm, s in (("controller", on), ("no_controller", off)):
+        print(f"  [{arm:>13}] completed {s.get('completed', 0):3d}  "
+              f"shed {s.get('shed', 0):3d}  "
+              f"ttft p99 {s.get('ttft_ms_p99', float('nan')):8.1f} ms  "
+              f"goodput {s.get('goodput_qps', 0.0):6.2f} qps  "
+              f"violations {s.get('deadline_violations', '-')}",
+              flush=True)
+    return {"chaos": ns.chaos, "slo_ttft_ms": ns.slo_ttft_ms,
+            "clock": ns.clock, "controller": on, "no_controller": off,
+            "gates": lines, "ok": ok}
 
 
 def sweep(model, params, ns) -> Dict:
@@ -178,6 +283,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--slo_ttft_ms", type=float, default=400.0,
                    help="the p99 TTFT budget goodput is gated on")
+    p.add_argument("--chaos", default=None,
+                   help="serving fault plan for the overload gate, e.g. "
+                        "'slow_decode@30:60ms:50' (engine-iteration "
+                        "keyed; needs --mode continuous).  --check then "
+                        "gates zero deadline violations + booked sheds "
+                        "+ controller-on beats controller-off on the "
+                        "same trace")
+    p.add_argument("--deadline_ms", type=float, default=0.0,
+                   help="attach this completion deadline to every "
+                        "request (0 = none); the scheduler sheds "
+                        "hopeless requests before prefill")
+    p.add_argument("--priorities", default="0",
+                   help="comma-separated priority pool requests draw "
+                        "from (brownout level 2 sheds priority <= 0)")
+    p.add_argument("--degrade_max_new", type=int, default=8,
+                   help="brownout level-1 output-length ceiling")
     p.add_argument("--clock", choices=["wall", "virtual"],
                    default="virtual",
                    help="virtual = deterministic cost-model time (CI); "
@@ -196,8 +317,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ns.qps_list = [float(x) for x in ns.qps.split(",")]
     ns.prompt_lens_list = [int(x) for x in ns.prompt_lens.split(",")]
     ns.output_lens_list = [int(x) for x in ns.output_lens.split(",")]
-    if ns.check and ns.mode != "both":
-        p.error("--check needs --mode both (it asserts the A/B ratio)")
+    ns.priorities_list = [int(x) for x in ns.priorities.split(",")]
+    if ns.chaos and ns.mode != "continuous":
+        p.error("--chaos is the overload/brownout gate; it runs the "
+                "continuous engine (--mode continuous)")
+    if ns.check and not ns.chaos and ns.mode != "both":
+        p.error("--check needs --mode both (it asserts the A/B ratio) "
+                "or --chaos (the overload gates)")
 
     import jax
 
@@ -208,7 +334,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     params = model.init(jax.random.key(ns.seed))
     print(f"serve_load: preset={ns.preset} slots={ns.slots} "
           f"block_size={ns.block_size} clock={ns.clock} "
-          f"slo_ttft_ms={ns.slo_ttft_ms}", flush=True)
+          f"slo_ttft_ms={ns.slo_ttft_ms}"
+          + (f" chaos={ns.chaos}" if ns.chaos else ""), flush=True)
+    if ns.chaos:
+        result = chaos_ab(model, params, ns)
+        for line in result["gates"]:
+            print(line, flush=True)
+        if ns.json:
+            with open(ns.json, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {ns.json}")
+        if ns.check:
+            if not result["ok"]:
+                print("CHECK FAILED: overload gates (see above)",
+                      file=sys.stderr)
+                return 1
+            print("CHECK OK")
+        return 0
     result = sweep(model, params, ns)
     if "ab" in result:
         ab = result["ab"]

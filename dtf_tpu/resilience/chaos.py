@@ -40,10 +40,28 @@ run.  Spec grammar (comma-separated)::
                          observations stop; the minority side self-
                          isolates (exit 72), the majority plants the pill
                          (exit 71)
+    slow_decode@S:DURms[:N]  serving: from engine ITERATION S every decode
+                         iteration pays DUR extra — a decode-rate brownout
+                         (contended HBM, a slow replica).  Optional :N
+                         bounds the spike to N iterations; without it the
+                         slowness is persistent.  '@every:K:DUR' instead
+                         hits every Kth iteration once.
+    client_drop@S        serving: at engine iteration S the oldest active
+                         request's client "disconnects" — the engine must
+                         cancel it and free its KV blocks immediately
+    kv_poison@S          serving: at engine iteration S the oldest active
+                         request's KV blocks are NaN-scribbled (HBM
+                         corruption); the engine must detect the
+                         non-finite logits, evict ONLY the victim, and
+                         keep serving the rest
     KIND@every:N[...]    repeating variant: fire at steps N, 2N, 3N, ...
                          instead of once (nan_grad/loader_error/stall
                          only), e.g. 'stall@every:50:1s'
     seed=N               seed for corruption bytes (default 0)
+
+Serving kinds (``slow_decode``/``client_drop``/``kv_poison``) are keyed
+on the ENGINE ITERATION, not the optimizer step — the serving engine
+calls their ``maybe_*`` hooks from its iteration loop.
 
 One-shot faults fire once; ``@every`` faults fire on every multiple of
 their period.  A plan is shared state: an in-process supervisor must pass
@@ -73,14 +91,17 @@ log = logging.getLogger("dtf_tpu")
 
 _KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "preempt",
           "ckpt_stall", "corrupt_ckpt", "host_down", "slow_host",
-          "partition")
+          "partition", "slow_decode", "client_drop", "kv_poison")
 # Kinds whose semantics survive refiring (a host_down process is gone;
 # corruption of the same step proves nothing twice).  preempt refires
 # safely BECAUSE each firing ends in a clean checkpoint + supervisor
 # restart that resumes past it; plain sigterm stays one-shot as the
-# single-preemption scenario's spelling.
+# single-preemption scenario's spelling.  Serving: a periodic
+# slow_decode is a recurring latency hiccup, a periodic client_drop is
+# flappy clients — both meaningful on every firing; kv_poison stays
+# one-shot (corrupting the same pool twice proves nothing twice).
 _PERIODIC_OK = ("nan_grad", "loader_error", "stall", "preempt",
-                "ckpt_stall")
+                "ckpt_stall", "slow_decode", "client_drop")
 
 _DUR_RE = re.compile(r"^([0-9]+(?:\.[0-9]+)?)(ms|s)?$")
 
@@ -105,9 +126,10 @@ class ChaosLoaderError(OSError):
 class Fault:
     kind: str
     step: Optional[int]          # None for corrupt_ckpt@latest / periodic
-    duration_s: float = 0.0      # stall / slow_host
+    duration_s: float = 0.0      # stall / slow_host / slow_decode
     process: Optional[int] = None  # host-targeted kinds; None = every host
     period: Optional[int] = None   # @every:N repeating faults
+    count: Optional[int] = None    # slow_decode spike width (iterations)
     fired: bool = False
     # Periodic latch: a repeating fault fires ONCE per matching step —
     # without it, loader_error@every:N would re-raise on every attempt of
@@ -131,6 +153,10 @@ class Fault:
             extra = f":{self.process}:{self.duration_s * 1e3:g}ms"
         elif self.kind == "partition" and self.process is not None:
             extra = f":{self.process}"
+        elif self.kind == "slow_decode":
+            extra = f":{self.duration_s * 1e3:g}ms"
+            if self.count is not None:
+                extra += f":{self.count}"
         return f"{self.kind}@{at}{extra}"
 
 
@@ -148,6 +174,9 @@ class FaultPlan:
         self._kill = kill
         self._process_index = process_index
         self._slow_delay_s = 0.0
+        # serving: persistent/windowed decode slowdown state
+        self._slow_decode_s = 0.0
+        self._slow_decode_until: Optional[int] = None
         self._on_partition: Optional[Callable[[], None]] = None
         # Fault selection is shared mutable state (fired/last_fired_step
         # latches) and is now hit from TWO threads: the trainer's loop
@@ -201,8 +230,29 @@ class FaultPlan:
                     raise ValueError(f"bad step in chaos entry {entry!r}")
                 step = int(args[0])
                 args = args[1:]
-            duration_s, process = 0.0, None
-            if kind == "stall":
+            duration_s, process, count = 0.0, None, None
+            if kind == "slow_decode":
+                if not args or not args[0]:
+                    raise ValueError(
+                        f"slow_decode needs a per-iteration delay, e.g. "
+                        f"'slow_decode@40:80ms' or "
+                        f"'slow_decode@40:80ms:60' (60-iteration spike); "
+                        f"got {entry!r}")
+                duration_s = _parse_duration(args[0], "ms", entry)
+                if len(args) == 2:
+                    if not args[1].isdigit() or int(args[1]) < 1:
+                        raise ValueError(
+                            f"slow_decode spike width must be a positive "
+                            f"iteration count; got {entry!r}")
+                    if period is not None:
+                        raise ValueError(
+                            f"slow_decode@every takes only a delay (each "
+                            f"firing is one hit); got {entry!r}")
+                    count = int(args[1])
+                elif len(args) > 2:
+                    raise ValueError(f"slow_decode takes delay[:count]; "
+                                     f"got {entry!r}")
+            elif kind == "stall":
                 if len(args) != 1 or not args[0]:
                     raise ValueError(f"stall needs a duration, e.g. "
                                      f"'stall@{rest.split(':')[0]}:3s'; "
@@ -237,7 +287,8 @@ class FaultPlan:
                 raise ValueError(f"{kind} takes no extra arguments; "
                                  f"got {entry!r}")
             faults.append(Fault(kind, step, duration_s=duration_s,
-                                process=process, period=period))
+                                process=process, period=period,
+                                count=count))
         return cls(faults, seed=seed, **kwargs)
 
     def __str__(self) -> str:
@@ -376,6 +427,42 @@ class FaultPlan:
         f = self._take("ckpt_stall", step)
         if f is not None:
             self._sleep(f.duration_s)
+
+    # -- serving hooks (the engine calls these per ITERATION) ---------------
+
+    def maybe_slow_decode(self, iteration: int) -> float:
+        """Extra seconds this decode iteration must pay (0.0 = none).
+        One-shot ``slow_decode@S:DUR`` arms a persistent slowdown from
+        iteration S (``:N`` bounds it to N iterations); periodic
+        ``@every:K:DUR`` is a single hit per firing."""
+        delay = 0.0
+        f = self._take("slow_decode", iteration)
+        if f is not None:
+            if f.period is not None:
+                delay = f.duration_s
+            else:
+                self._slow_decode_s = f.duration_s
+                self._slow_decode_until = (
+                    None if f.count is None else iteration + f.count)
+        if self._slow_decode_s > 0:
+            if (self._slow_decode_until is not None
+                    and iteration >= self._slow_decode_until):
+                self._slow_decode_s = 0.0       # spike over
+            else:
+                delay = max(delay, self._slow_decode_s)
+        return delay
+
+    def maybe_client_drop(self, iteration: int) -> bool:
+        """True when iteration S's injected client disconnect fires —
+        the engine cancels its oldest active request and must free the
+        request's KV blocks immediately."""
+        return self._take("client_drop", iteration) is not None
+
+    def maybe_kv_poison(self, iteration: int) -> bool:
+        """True when the iteration-S KV-corruption fires — the engine
+        NaN-scribbles its oldest active request's pool blocks and must
+        then detect + evict exactly that victim."""
+        return self._take("kv_poison", iteration) is not None
 
     def maybe_corrupt_after_save(self, step: int, ckpt) -> None:
         """corrupt_ckpt@S: wait for the step-S save to land, then scribble

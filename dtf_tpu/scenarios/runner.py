@@ -160,6 +160,7 @@ def _measured(report: dict) -> dict:
         m = metrics.get(name)
         return None if m is None else m.get("value")
 
+    serving = tel.get("serving", {})
     return {
         "final_cost": report.get("steps", {}).get("final_cost"),
         "steps": report.get("steps", {}).get("last"),
@@ -172,4 +173,9 @@ def _measured(report: dict) -> dict:
         "restarts": metric("supervisor/restarts_total") or 0,
         "faults_fired": metric("chaos/faults_fired_total") or 0,
         "attempts": report.get("attempts"),
+        # serving cells (absent for training cells)
+        "goodput_qps": serving.get("goodput_qps"),
+        "ttft_ms_p99": serving.get("ttft_ms_p99"),
+        "shed": serving.get("shed"),
+        "deadline_violations": serving.get("deadline_violations"),
     }

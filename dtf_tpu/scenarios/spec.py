@@ -31,31 +31,45 @@ import dataclasses
 import json
 from typing import Dict, List, Optional
 
-#: Workload-zoo keys (mirrored by scenarios/zoo.py's builder table; a
-#: pinned test keeps the two in sync so this module stays jax-free).
-WORKLOADS = ("mnist", "cifar", "gpt", "seq2seq")
+#: Training workload-zoo keys (mirrored by scenarios/zoo.py's builder
+#: table; a pinned test keeps the two in sync so this module stays
+#: jax-free).
+TRAIN_WORKLOADS = ("mnist", "cifar", "gpt", "seq2seq")
+#: All cell kinds: training workloads plus the SERVING cell — a chaos'd
+#: closed-loop load run through the continuous-batching engine
+#: (scenarios/_host.py's serve branch), judged on the serving gates
+#: (goodput-QPS floor + p99 TTFT ceiling) instead of convergence.
+WORKLOADS = TRAIN_WORKLOADS + ("serve",)
 
 
 @dataclasses.dataclass(frozen=True)
 class Gate:
-    """The triple gate's thresholds for one cell.  ``max_final_cost`` and
-    ``min_goodput`` are always armed; throughput arms whichever floors
-    are > 0 (the CPU sim has no known chip peak, so cells there gate on
-    examples/tokens per second and leave ``min_mfu_pct`` at 0 — on real
-    chips set it and the MFU gate arms via ``mfu/pct_peak``)."""
+    """The triple gate's thresholds for one cell.  ``min_goodput`` is
+    always armed; ``max_final_cost`` is armed for every TRAINING cell
+    (``None`` only for serve cells, which have no loss curve);
+    throughput arms whichever floors are > 0 (the CPU sim has no known
+    chip peak, so cells there gate on examples/tokens per second and
+    leave ``min_mfu_pct`` at 0 — on real chips set it and the MFU gate
+    arms via ``mfu/pct_peak``).  Serve cells gate on ``min_goodput_qps``
+    (SLO-met completions per second of makespan) and ``max_ttft_p99_ms``
+    instead — same :func:`~dtf_tpu.telemetry.report.check_gates`
+    implementation, read off the telemetry the run left on disk."""
 
-    max_final_cost: float
+    max_final_cost: Optional[float]
     min_goodput: float
     min_examples_per_s: float = 0.0
     min_tokens_per_s: float = 0.0
     min_mfu_pct: float = 0.0
     max_rollbacks: Optional[int] = None
+    min_goodput_qps: float = 0.0
+    max_ttft_p99_ms: float = 0.0
 
     def thresholds(self) -> dict:
         """Kwargs for :func:`dtf_tpu.telemetry.report.check_gates` — the
         ONE gate implementation, shared with ``report --check``."""
-        out = {"max_final_cost": self.max_final_cost,
-               "min_goodput": self.min_goodput}
+        out = {"min_goodput": self.min_goodput}
+        if self.max_final_cost is not None:
+            out["max_final_cost"] = self.max_final_cost
         if self.min_examples_per_s > 0:
             out["min_examples_per_s"] = self.min_examples_per_s
         if self.min_tokens_per_s > 0:
@@ -64,6 +78,10 @@ class Gate:
             out["min_mfu"] = self.min_mfu_pct
         if self.max_rollbacks is not None:
             out["max_rollbacks"] = self.max_rollbacks
+        if self.min_goodput_qps > 0:
+            out["min_goodput_qps"] = self.min_goodput_qps
+        if self.max_ttft_p99_ms > 0:
+            out["max_ttft_p99_ms"] = self.max_ttft_p99_ms
         return out
 
 
@@ -107,6 +125,26 @@ class ScenarioSpec:
         if self.workload not in WORKLOADS:
             raise ValueError(f"unknown workload {self.workload!r}; "
                              f"one of {WORKLOADS}")
+        if self.workload == "serve":
+            if self.hosts > 1:
+                raise ValueError(
+                    f"cell {self.name!r}: serve cells are single-host "
+                    f"(the engine is one process; multi-host serving is "
+                    f"a load balancer's job, not a mesh's)")
+            if self.gate.max_final_cost is not None:
+                raise ValueError(
+                    f"cell {self.name!r}: serve cells have no loss "
+                    f"curve; set gate.max_final_cost=None and arm "
+                    f"min_goodput_qps / max_ttft_p99_ms instead")
+            if self.gate.min_goodput_qps <= 0:
+                raise ValueError(
+                    f"cell {self.name!r}: a serve cell must arm the "
+                    f"goodput-QPS floor (gate.min_goodput_qps > 0) — "
+                    f"without it the cell proves nothing about serving")
+        elif self.gate.max_final_cost is None:
+            raise ValueError(
+                f"cell {self.name!r}: training cells must pin a "
+                f"convergence target (gate.max_final_cost)")
         if self.hosts > 1 and "host_down" not in (self.chaos or ""):
             raise ValueError(
                 f"cell {self.name!r}: hosts={self.hosts} is the elastic-"
@@ -245,6 +283,30 @@ def default_matrix() -> List[ScenarioSpec]:
             timeout_s=600.0,
             gate=Gate(max_final_cost=0.9, min_goodput=0.006,
                       min_examples_per_s=50.0, max_rollbacks=0)),
+        ScenarioSpec(
+            # THE serving cell (ISSUE 10): a closed-loop Poisson load
+            # run with completion deadlines and mixed priority classes
+            # through the continuous-batching engine, under a PERSISTENT
+            # decode-rate brownout (slow_decode from iteration 30) plus
+            # a client disconnect and a KV-corruption hit — the engine
+            # must shed at the front door (never blow an admitted
+            # deadline), evict exactly the poisoned victim, free the
+            # dropped client's blocks, and still clear a goodput-QPS
+            # floor at the p99 TTFT ceiling.  The SLO quantities are
+            # DETERMINISTIC (virtual clock + seeded trace + seeded
+            # fault plan); only the goodput fraction is wall-clock (a
+            # fresh child pays the compile, so that floor sits low).
+            # measured: 30 completed / 28 shed (20 brownout_admissions
+            # + 8 low-priority) / 1 client drop / 1 kv eviction,
+            # goodput 7.14 qps, ttft p99 519 ms, 0 deadline violations,
+            # goodput fraction 0.08 (compile-dominated child)
+            name="serve_overload_brownout", workload="serve", devices=1,
+            chaos="slow_decode@30:60ms,client_drop@10,kv_poison@20",
+            max_restarts=0,
+            extra=(("deadline_ms", 2500.0), ("qps", 10.0),
+                   ("requests", 60), ("slo_ttft_ms", 400.0)),
+            gate=Gate(max_final_cost=None, min_goodput=0.02,
+                      min_goodput_qps=3.5, max_ttft_p99_ms=1200.0)),
         ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike

@@ -15,14 +15,23 @@ but nothing accepted *requests*.  This package is the engine:
   per geometry; token-identical to the contiguous cache path — pinned
   by parity tests, single-device and TP mesh);
 * :mod:`.engine` — :class:`ServingEngine`: streaming per-request
-  output, TTFT/TPOT histograms into the telemetry spine, goodput books.
+  output, TTFT/TPOT histograms into the telemetry spine, goodput books,
+  deadline-aware shedding, graceful drain with replay checkpointing;
+* :mod:`.brownout` — :class:`BrownoutController`: hysteretic overload
+  control (degrade -> reject-low-priority -> reject-all) off a smoothed
+  p99 TTFT vs the SLO budget;
+* :mod:`.frontend` — the line-oriented JSON-over-TCP front end
+  (per-connection timeouts, malformed-request rejection, disconnects
+  free KV blocks immediately).
 
 ``python -m dtf_tpu.serve`` runs a server process (supervisor restarts,
-health beats); ``python -m dtf_tpu.bench.serve_load`` is the
-closed-loop load generator (p50/p99 TTFT/TPOT vs offered QPS, with the
-static-batching A/B).
+health beats, ``--listen`` for the TCP front end, SIGTERM drains
+gracefully); ``python -m dtf_tpu.bench.serve_load`` is the closed-loop
+load generator (p50/p99 TTFT/TPOT vs offered QPS, the static-batching
+A/B, and the ``--chaos`` overload/brownout gate).
 """
 
+from dtf_tpu.serve.brownout import BrownoutController
 from dtf_tpu.serve.engine import ServingEngine
 from dtf_tpu.serve.paged_kv import (BlockAllocator, KVPool, PoolExhausted,
                                     blocks_for, contiguous_table)
@@ -30,7 +39,7 @@ from dtf_tpu.serve.scheduler import (Request, Scheduler, VirtualClock,
                                      WallClock)
 
 __all__ = [
-    "BlockAllocator", "KVPool", "PoolExhausted", "Request", "Scheduler",
-    "ServingEngine", "VirtualClock", "WallClock", "blocks_for",
-    "contiguous_table",
+    "BlockAllocator", "BrownoutController", "KVPool", "PoolExhausted",
+    "Request", "Scheduler", "ServingEngine", "VirtualClock", "WallClock",
+    "blocks_for", "contiguous_table",
 ]

@@ -5,18 +5,35 @@
         --logdir /tmp/dtf_serve
 
     # requests from a JSONL file (one {"prompt": [...ids...],
-    # "max_new_tokens": N, "temperature": T} per line), streamed tokens
+    # "max_new_tokens": N, "temperature": T, "deadline_ms": D,
+    # "priority": P} per line), streamed tokens
     python -m dtf_tpu.serve --preset tiny --requests reqs.jsonl --stream
 
-Resilience spine reuse (DESIGN.md §5): ``--max_restarts N`` wraps the
-serve session in the bounded-restart supervisor — a crashed or wedged
-server restarts and REPLAYS the unfinished requests (completed results
-survive the attempt boundary); ``--health_dir`` publishes a liveness
-heartbeat per engine iteration through ``resilience.health``'s file
-transport, so an external monitor (or the chaos suite) can tell a
-serving process that is decoding from one that is wedged.
-``--wedge_at K`` injects a crash at iteration K of the first attempt —
-the supervisor-path proof the CI lane drives.
+    # the TCP front end: line-oriented JSON over a socket
+    # (serve/frontend.py documents the framing)
+    python -m dtf_tpu.serve --preset tiny --listen :8100
+
+Resilience spine reuse (DESIGN.md §5, §7.4): ``--max_restarts N`` wraps
+the serve session in the bounded-restart supervisor — a crashed or
+wedged server restarts and REPLAYS the unfinished requests (completed
+results survive the attempt boundary); ``--health_dir`` publishes a
+liveness heartbeat per engine iteration through ``resilience.health``'s
+file transport.  ``--wedge_at K`` injects a crash at iteration K of the
+first attempt — the supervisor-path proof the CI lane drives.
+
+Overload & preemption (PR 10): **SIGTERM drains gracefully** — admissions
+freeze, in-flight decodes finish inside ``--drain_timeout_s``, and every
+accepted-but-unfinished request is checkpointed to ``<logdir>/
+drain.jsonl`` (a ``--requests``-compatible replay file) AND replayed
+in-process when the supervisor has restart budget; replay is
+token-identical (per-request rng streams are (seed, rid)-keyed).
+``--drain_at K`` fires the same drain deterministically at iteration K
+(the CI spelling — real signal delivery is timing-racy).  ``--brownout``
+arms the hysteretic overload controller against ``--slo_ttft_ms``;
+``--deadline_ms`` attaches completion deadlines to demo traffic (the
+scheduler sheds hopeless requests before prefill); ``--chaos`` takes the
+serving fault kinds (``slow_decode@S:80ms:N``, ``client_drop@S``,
+``kv_poison@S``).
 
 Weights are seeded-random (this repo has no trained checkpoints to
 ship); the engine, scheduler, cache, and telemetry paths are exactly
@@ -27,6 +44,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -44,11 +63,13 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
                     continue
                 doc = json.loads(line)
                 trace.append((float(doc.get("arrival_s", 0.0)), {
-                    "rid": i,
+                    "rid": int(doc.get("rid", i)),
                     "prompt": np.asarray(doc["prompt"], np.int32),
                     "max_new_tokens": int(doc.get("max_new_tokens", 16)),
                     "temperature": float(doc.get("temperature",
                                                  ns.temperature)),
+                    "deadline_ms": doc.get("deadline_ms"),
+                    "priority": int(doc.get("priority", 0)),
                 }))
         trace.sort(key=lambda e: e[0])
         return trace
@@ -59,18 +80,72 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
         seed=ns.seed, n_requests=ns.demo, qps=ns.qps,
         prompt_lens=[int(x) for x in ns.prompt_lens.split(",")],
         output_lens=[int(x) for x in ns.output_lens.split(",")],
-        vocab_size=vocab_size, temperature=ns.temperature)
+        vocab_size=vocab_size, temperature=ns.temperature,
+        deadline_ms=ns.deadline_ms or None,
+        priorities=[int(x) for x in ns.priorities.split(",")])
 
 
-def serve_session(ns, model, params, trace) -> Dict:
+def _write_drain_file(engine, logdir: str) -> Optional[str]:
+    """Checkpoint a drain's unfinished requests as a --requests-
+    compatible JSONL replay file (arrival 0: they are due NOW).  An
+    attempt that finished WITHOUT leaving unfinished work removes any
+    previous attempt's file instead — after a successful supervisor
+    replay, a stale drain.jsonl would tell the operator to re-serve
+    requests that already completed."""
+    if not logdir:
+        return None
+    path = os.path.join(logdir, "drain.jsonl")
+    if not engine.drained or not engine.drain_docs:
+        if os.path.exists(path):
+            os.remove(path)
+        return None
+    os.makedirs(logdir, exist_ok=True)
+    with open(path, "w") as f:
+        for doc in engine.drain_docs:
+            f.write(json.dumps({**doc, "arrival_s": 0.0},
+                               sort_keys=True) + "\n")
+    return path
+
+
+def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
+    from dtf_tpu.serve import BrownoutController, ServingEngine
+
+    brownout = None
+    if ns.brownout:
+        brownout = BrownoutController(
+            ns.slo_ttft_ms, degrade_max_new=ns.degrade_max_new)
+    return ServingEngine(
+        model, params, num_slots=ns.slots, block_size=ns.block_size,
+        num_blocks=ns.pool_blocks, mode=ns.mode, top_k=ns.top_k,
+        top_p=ns.top_p, eos_id=ns.eos_id, seed=ns.seed, clock=clock,
+        max_queue=ns.max_queue, aging_s=ns.aging_s, on_token=printer,
+        heartbeat=heartbeat, brownout=brownout, chaos=chaos)
+
+
+def serve_session(ns, model, params, trace,
+                  drain_target: Optional[Dict] = None) -> Dict:
     """Run the trace to completion under the supervisor: unfinished
     requests replay on restart (arrival re-stamped to the new attempt's
     clock — an external client would keep its own latency books across
-    the gap), completed results survive."""
+    the gap), completed results survive.  A SIGTERM drain consumes a
+    restart (the replay is the supervisor's) when budget exists;
+    otherwise the drain file is the hand-off and the exit is clean.
+
+    ``drain_target`` is the SIGTERM mailbox main() installed at process
+    start (the handler must exist before the multi-second jax/model
+    init, or an early preemption signal just kills the process): the
+    session registers each attempt's engine there and honors a signal
+    that arrived before any engine existed."""
     from dtf_tpu.resilience.supervisor import run_supervised
-    from dtf_tpu.serve import ServingEngine, VirtualClock, WallClock
+    from dtf_tpu.serve import VirtualClock, WallClock
 
     completed: Dict[int, object] = {}
+    current: Dict[str, object] = (drain_target if drain_target is not None
+                                  else {})
+    chaos = None
+    if ns.chaos:
+        from dtf_tpu.resilience.chaos import FaultPlan
+        chaos = FaultPlan.parse(ns.chaos, process_index=0)
 
     def printer(req, token, done):
         if ns.stream:
@@ -86,12 +161,13 @@ def serve_session(ns, model, params, trace) -> Dict:
 
     def fit_once(attempt: int):
         clock = (VirtualClock() if ns.clock == "virtual" else WallClock())
-        engine = ServingEngine(
-            model, params, num_slots=ns.slots, block_size=ns.block_size,
-            num_blocks=ns.pool_blocks, mode=ns.mode, top_k=ns.top_k,
-            top_p=ns.top_p, eos_id=ns.eos_id, seed=ns.seed, clock=clock,
-            max_queue=ns.max_queue, on_token=printer,
-            heartbeat=make_heartbeat())
+        engine = _make_engine(ns, model, params, clock, printer,
+                              make_heartbeat(), chaos)
+        current["engine"] = engine
+        if current.pop("early_sigterm", None):
+            # preemption arrived during init: drain immediately — the
+            # whole trace becomes the hand-off/replay set
+            engine.request_drain()
         if ns.wedge_at is not None and attempt == 0:
             real_step = engine.step
 
@@ -102,24 +178,81 @@ def serve_session(ns, model, params, trace) -> Dict:
                 return real_step()
 
             engine.step = wedged_step
+        if ns.drain_at is not None and attempt == 0:
+            real_step2 = engine.step
+
+            def draining_step():
+                if engine.iterations == ns.drain_at:
+                    engine.request_drain()
+                return real_step2()
+
+            engine.step = draining_step
         pending = [(0.0 if attempt else t, kw) for t, kw in trace
                    if kw["rid"] not in completed]
         try:
-            engine.run(pending)
+            engine.run(pending, drain_timeout_s=ns.drain_timeout_s)
         finally:
             completed.update(
                 {rid: r for rid, r in engine.results.items()
                  if r.status == "completed"})
             if ns.logdir:
-                import os
                 os.makedirs(ns.logdir, exist_ok=True)
                 engine.write_telemetry(ns.logdir,
                                        slo_ttft_ms=ns.slo_ttft_ms)
+                _write_drain_file(engine, ns.logdir)
         return engine
 
+    def drained_needs_restart(engine) -> bool:
+        # A drain that left trace work undone restarts (the supervisor's
+        # replay completes checkpointed requests AND serves the trace
+        # tail that never arrived before the preemption) when budget
+        # exists; with --max_restarts 0 the drain.jsonl file is the
+        # hand-off and this process exits clean.
+        return (ns.max_restarts > 0 and engine.drained
+                and len(completed) < len(trace))
+
     engine = run_supervised(fit_once, max_restarts=ns.max_restarts,
-                            needs_restart=lambda r: False)
+                            needs_restart=drained_needs_restart)
     return {"engine": engine, "completed": completed}
+
+
+def serve_listen(ns, model, params,
+                 drain_target: Optional[Dict] = None) -> int:
+    """The TCP front end: one engine on the wall clock, socket handlers
+    feeding it through the frontend bridge, SIGTERM = graceful drain."""
+    from dtf_tpu.serve import WallClock
+    from dtf_tpu.serve.frontend import TCPFrontend, parse_listen
+
+    chaos = None
+    if ns.chaos:
+        from dtf_tpu.resilience.chaos import FaultPlan
+        chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    engine = _make_engine(ns, model, params, WallClock(), None, None,
+                          chaos)
+    if drain_target is not None:
+        drain_target["engine"] = engine
+        if drain_target.pop("early_sigterm", None):
+            engine.request_drain()
+    signal.signal(signal.SIGINT, lambda s, f: engine.request_drain())
+    host, port = parse_listen(ns.listen)
+    frontend = TCPFrontend(engine, host, port,
+                           conn_timeout_s=ns.conn_timeout_s)
+    addr = frontend.address
+    print(f"serving on tcp://{addr[0]}:{addr[1]} "
+          f"(preset={ns.preset}, slots={ns.slots}, "
+          f"brownout={'on' if engine.brownout else 'off'})", flush=True)
+    drain = frontend.run_loop(drain_timeout_s=ns.drain_timeout_s)
+    if ns.logdir:
+        os.makedirs(ns.logdir, exist_ok=True)
+        engine.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
+        path = _write_drain_file(engine, ns.logdir)
+        if path:
+            print(f"drained: {len(engine.drain_docs)} unfinished "
+                  f"request(s) checkpointed to {path} "
+                  f"(replay with --requests)", flush=True)
+    print(json.dumps(engine.summary(slo_ttft_ms=ns.slo_ttft_ms),
+                     indent=1, sort_keys=True))
+    return 0 if (drain is None or not drain.get("timed_out")) else 1
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -142,7 +275,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--top_p", type=float, default=1.0)
     p.add_argument("--eos_id", type=int, default=None)
     p.add_argument("--requests", default=None,
-                   help="JSONL request file (see module docstring)")
+                   help="JSONL request file (see module docstring; a "
+                        "drain.jsonl replays here)")
     p.add_argument("--demo", type=int, default=16,
                    help="no --requests: serve this many seeded demo "
                         "requests")
@@ -150,6 +284,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="demo arrival rate (Poisson)")
     p.add_argument("--prompt_lens", default="4,8,16")
     p.add_argument("--output_lens", default="4,8,16")
+    p.add_argument("--deadline_ms", type=float, default=0.0,
+                   help="attach this completion deadline to every demo "
+                        "request (0 = none); hopeless requests are shed "
+                        "BEFORE prefill")
+    p.add_argument("--priorities", default="0",
+                   help="comma-separated priority pool demo requests "
+                        "draw from (higher = sooner; brownout level 2 "
+                        "sheds priority <= 0)")
+    p.add_argument("--aging_s", type=float, default=2.0,
+                   help="queue aging: +1 effective priority level per "
+                        "this many seconds waited (anti-starvation)")
+    p.add_argument("--brownout", action="store_true",
+                   help="arm the overload controller against "
+                        "--slo_ttft_ms (serve/brownout.py)")
+    p.add_argument("--degrade_max_new", type=int, default=8,
+                   help="brownout level-1 output-length ceiling")
+    p.add_argument("--chaos", default=None,
+                   help="serving fault plan, e.g. "
+                        "'slow_decode@40:80ms:60,client_drop@20,"
+                        "kv_poison@30' (iteration-keyed)")
     p.add_argument("--clock", choices=["wall", "virtual"], default="wall")
     p.add_argument("--stream", action="store_true",
                    help="print each token as it is emitted")
@@ -162,12 +316,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--wedge_at", type=int, default=None,
                    help="fault injection: crash at this iteration of "
                         "attempt 0 (supervisor-restart proof)")
+    p.add_argument("--drain_at", type=int, default=None,
+                   help="deterministic preemption: request a graceful "
+                        "drain at this iteration of attempt 0 (the CI "
+                        "spelling of SIGTERM)")
+    p.add_argument("--drain_timeout_s", type=float, default=30.0,
+                   help="graceful-drain grace window (in-flight decodes "
+                        "past it are checkpointed, not finished)")
+    p.add_argument("--listen", default=None, metavar="HOST:PORT",
+                   help="run the TCP front end instead of a trace "
+                        "(':8100' binds 127.0.0.1:8100; wall clock)")
+    p.add_argument("--conn_timeout_s", type=float, default=30.0,
+                   help="TCP per-connection idle/read timeout")
+    p.add_argument("--tokens_out", default=None,
+                   help="write {rid: tokens} JSON for all completed "
+                        "requests (the drain-replay identity check)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend")
     ns = p.parse_args(argv)
     if ns.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if ns.listen and ns.clock == "virtual":
+        p.error("--listen serves real clients; it needs --clock wall")
+
+    # Install the preemption handler BEFORE the multi-second jax/model
+    # init: a SIGTERM that lands mid-init must buffer into a drain of
+    # the first engine, not kill the process (the grace window starts
+    # at signal delivery, not at "server finally came up").
+    drain_target: Dict[str, object] = {}
+
+    def _on_sigterm(signum, frame):
+        eng = drain_target.get("engine")
+        if eng is not None:
+            eng.request_drain()
+        else:
+            drain_target["early_sigterm"] = True
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:               # not the main thread (tests)
+        pass
 
     import jax
 
@@ -176,14 +365,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = GPTConfig.from_preset(ns.preset)
     model = GPT(cfg)
     params = model.init(jax.random.key(ns.seed))
+    if ns.listen:
+        return serve_listen(ns, model, params, drain_target)
     trace = build_trace(ns, cfg.vocab_size)
-    out = serve_session(ns, model, params, trace)
+    out = serve_session(ns, model, params, trace, drain_target)
     engine = out["engine"]
     summary = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
     summary["completed_all_attempts"] = len(out["completed"])
     print(json.dumps(summary, indent=1, sort_keys=True))
+    if ns.tokens_out:
+        with open(ns.tokens_out, "w") as f:
+            json.dump({str(rid): r.tokens
+                       for rid, r in sorted(out["completed"].items())},
+                      f, sort_keys=True)
     wanted = {kw["rid"] for _, kw in trace}
-    missing = wanted - set(out["completed"])
+    never_accepted = {
+        r.rid for r in engine.results.values()
+        if r.status in ("rejected", "shed", "cancelled", "failed",
+                        "drained")}
+    missing = wanted - set(out["completed"]) - never_accepted
+    if missing and engine.drained:
+        # clean preemption hand-off: everything missing is in the drain
+        # file (or was never accepted); nothing accepted was lost
+        in_drain = {d["rid"] for d in engine.drain_docs}
+        missing -= in_drain
+        # trace entries that never arrived before the drain were never
+        # accepted either
+        missing -= {kw["rid"] for t, kw in trace
+                    if kw["rid"] not in engine.results}
     if missing:
         print(f"error: {len(missing)} request(s) never completed: "
               f"{sorted(missing)[:8]}...", file=sys.stderr)

@@ -1,0 +1,146 @@
+"""Brownout overload controller: degrade progressively, never fall over.
+
+The serving engine's only overload responses used to be queue rejection
+(loud but binary) and growing latency (silent SLO death).  This
+controller watches a smoothed p99 TTFT against the SLO budget and walks
+a small state machine of progressively cheaper service levels::
+
+    level 0  normal      full service
+    level 1  degrade     max_new_tokens clamped to ``degrade_max_new``
+                         (shorter answers, same admission)
+    level 2  reject_low  level 1 + low-priority submissions
+                         (priority <= ``low_priority_max``) are shed
+    level 3  reject_all  no new admissions at all; in-flight requests
+                         and the already-admitted queue still finish
+
+Escalation/de-escalation is hysteretic: the controller escalates one
+level when the signal exceeds ``enter_ratio * slo`` and de-escalates one
+level when it falls under ``exit_ratio * slo``, and either transition
+must be ``dwell_iters`` engine iterations after the previous one — so a
+single outlier cannot flap the service level.
+
+The signal is ``max(EWMA of windowed p99 TTFT, current head-of-queue
+wait)``.  The second term is the early-warning half: under a hard spike
+nothing completes, so TTFT observations stop arriving exactly when the
+controller most needs to act — but the oldest queued request's wait
+keeps rising and bounds every future TTFT from below.
+
+Deliberately jax-free and clock-agnostic (the engine feeds it instants
+from its own wall/virtual clock), so controller behavior is exactly
+reproducible under the seeded VirtualClock — the chaos lane asserts the
+controller-on vs controller-off goodput A/B against it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+LEVELS = ("normal", "degrade", "reject_low", "reject_all")
+
+
+class BrownoutController:
+    """See module docstring.  The engine calls :meth:`observe_ttft` as
+    first tokens land, :meth:`update` once per iteration, and consults
+    :meth:`max_new_cap` / :meth:`submit_verdict` at admission time."""
+
+    def __init__(self, slo_ttft_ms: float, *,
+                 enter_ratio: float = 1.0,
+                 exit_ratio: float = 0.5,
+                 dwell_iters: int = 8,
+                 window: int = 32,
+                 ewma_alpha: float = 0.3,
+                 degrade_max_new: int = 8,
+                 low_priority_max: int = 0,
+                 idle_decay: float = 0.93):
+        if slo_ttft_ms <= 0:
+            raise ValueError(f"slo_ttft_ms must be > 0, got {slo_ttft_ms}")
+        if not 0 < exit_ratio < enter_ratio:
+            raise ValueError(
+                f"hysteresis needs 0 < exit_ratio < enter_ratio, got "
+                f"exit={exit_ratio} enter={enter_ratio}")
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.enter_ratio = enter_ratio
+        self.exit_ratio = exit_ratio
+        self.dwell_iters = dwell_iters
+        self.window = window
+        self.ewma_alpha = ewma_alpha
+        self.degrade_max_new = degrade_max_new
+        self.low_priority_max = low_priority_max
+        self.idle_decay = idle_decay
+
+        self.level = 0
+        self._ttfts: Deque[float] = deque(maxlen=window)
+        self._p99_ewma_ms = 0.0
+        self._fresh_obs = False
+        self._last_transition_iter: Optional[int] = None
+        self.transitions: list = []       # (iteration, old, new) history
+
+    # -- signal -------------------------------------------------------------
+
+    def observe_ttft(self, ttft_ms: float) -> None:
+        self._ttfts.append(float(ttft_ms))
+        self._fresh_obs = True
+        xs = sorted(self._ttfts)
+        p99 = xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        a = self.ewma_alpha
+        self._p99_ewma_ms = (p99 if self._p99_ewma_ms == 0.0
+                             else a * p99 + (1 - a) * self._p99_ewma_ms)
+
+    def signal_ms(self, queue_head_wait_s: float = 0.0) -> float:
+        """The controller input: smoothed p99 TTFT, floored by the
+        current head-of-queue wait (that wait IS a lower bound on the
+        head request's eventual TTFT)."""
+        return max(self._p99_ewma_ms, queue_head_wait_s * 1e3)
+
+    # -- state machine ------------------------------------------------------
+
+    def update(self, iteration: int,
+               queue_head_wait_s: float = 0.0) -> int:
+        """One hysteretic transition decision; returns the (possibly
+        new) level.  Call once per engine iteration."""
+        if not self._fresh_obs and queue_head_wait_s <= 0.0:
+            # No completion landed and nothing is waiting: the smoothed
+            # p99 is STALE — at reject_all this is exactly the moment
+            # observations stop arriving, and a frozen signal would
+            # latch the brownout forever.  Decay toward "recovered" so
+            # the controller probes its way back down.
+            self._p99_ewma_ms *= self.idle_decay
+        self._fresh_obs = False
+        sig = self.signal_ms(queue_head_wait_s)
+        dwelled = (self._last_transition_iter is None
+                   or iteration - self._last_transition_iter
+                   >= self.dwell_iters)
+        new = self.level
+        if sig > self.enter_ratio * self.slo_ttft_ms:
+            if dwelled and self.level < len(LEVELS) - 1:
+                new = self.level + 1
+        elif sig < self.exit_ratio * self.slo_ttft_ms:
+            if dwelled and self.level > 0:
+                new = self.level - 1
+        if new != self.level:
+            self.transitions.append((iteration, self.level, new))
+            self.level = new
+            self._last_transition_iter = iteration
+        return self.level
+
+    # -- admission-time queries ---------------------------------------------
+
+    def max_new_cap(self) -> Optional[int]:
+        """The brownout output-length ceiling (None = no clamp)."""
+        return self.degrade_max_new if self.level >= 1 else None
+
+    def submit_verdict(self, priority: int) -> Optional[str]:
+        """Shed reason for a new submission at the current level, or
+        None to let it through to the scheduler's own checks."""
+        if self.level >= 3:
+            return "brownout_admissions"
+        if self.level >= 2 and priority <= self.low_priority_max:
+            return "brownout_low_priority"
+        return None
+
+    def state(self) -> dict:
+        return {"level": self.level, "level_name": LEVELS[self.level],
+                "p99_ttft_ewma_ms": round(self._p99_ewma_ms, 3),
+                "slo_ttft_ms": self.slo_ttft_ms,
+                "transitions": len(self.transitions)}

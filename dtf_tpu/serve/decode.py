@@ -155,7 +155,16 @@ def build_decode_fn(model, *, num_slots: int, blocks_per_slot: int,
 
     ``fn(params, pool_k, pool_v, table (B,nbs) i32, tok (B,) i32,
     pos (B,) i32, temps (B,) f32, seeds (B,) u32, counts (B,) i32)
-    -> (next_tok (B,) i32, pool_k, pool_v)``
+    -> (next_tok (B,) i32, ok (B,) bool, pool_k, pool_v)``
+
+    ``ok[b]`` is the per-slot health flag: False when slot b's logits
+    went non-finite — corrupted KV rows (the ``kv_poison`` chaos kind
+    models HBM bit-rot), a NaN'd weight, any numeric breakage.  The
+    engine evicts ONLY that slot's request and keeps serving the rest;
+    without the flag a poisoned slot silently streams garbage tokens
+    (sampling over NaN logits still returns an index).  Dead slots
+    gather the zeroed trash block, so their logits stay finite and the
+    flag never false-positives on them.
 
     Static shape per (slots, window): ONE compile covers every batch
     composition — that is what makes continuous batching free of
@@ -171,10 +180,11 @@ def build_decode_fn(model, *, num_slots: int, blocks_per_slot: int,
                  counts):
             logits, pool_k, pool_v = _paged_logits(
                 model, params, pool_k, pool_v, table, tok, pos)
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)
             keys = _sample_keys(seeds, counts)
             nxt = sample_token_batched(keys, logits, temperature=temps,
                                        top_k=top_k, top_p=top_p)
-            return nxt, pool_k, pool_v
+            return nxt, ok, pool_k, pool_v
 
         return jax.jit(step, donate_argnums=_donate_pools())
 

@@ -20,6 +20,23 @@ slot).  Wall-clock honesty comes from the injected clock —
 :class:`~dtf_tpu.serve.scheduler.WallClock` for real serving,
 :class:`~dtf_tpu.serve.scheduler.VirtualClock` for deterministic
 scheduling A/Bs (the load bench's CI mode).
+
+Overload & failure model (DESIGN.md §7.4):
+
+* **shed** — a request dropped BEFORE prefill, by the scheduler's
+  deadline feasibility check or the :class:`~dtf_tpu.serve.brownout.
+  BrownoutController`'s service level; booked under ``serve/shed_total``
+  with a per-reason breakdown (``serve/shed_*``) and surfaced in
+  :meth:`ServingEngine.summary`.
+* **evict** — an in-flight request torn out mid-decode: client
+  disconnect (:meth:`ServingEngine.cancel`) or detected KV corruption
+  (the decode step's per-slot finite-logits flag).  Its blocks free
+  immediately — the pool never bleeds.
+* **drain** — :meth:`ServingEngine.drain`: admissions freeze, in-flight
+  decodes finish inside the timeout, everything accepted-but-unfinished
+  is checkpointed as replay docs; a supervisor replay completes them
+  token-identically (per-request rng streams are (seed, rid)-keyed, so
+  replay does not depend on batch composition).
 """
 
 from __future__ import annotations
@@ -55,8 +72,10 @@ class ServingEngine:
                  seed: int = 0, clock=None, max_queue: int = 64,
                  prefill_token_budget: Optional[int] = None,
                  static_batch_wait_s: float = 0.05,
+                 aging_s: float = 2.0,
                  on_token: Optional[Callable] = None,
-                 heartbeat: Optional[Callable[[int], None]] = None):
+                 heartbeat: Optional[Callable[[int], None]] = None,
+                 brownout=None, chaos=None):
         t_init = time.perf_counter()
         # Close any open supervisor down-window into the restart bucket
         # (run_supervised marks down at the crash; construction of the
@@ -84,7 +103,15 @@ class ServingEngine:
             block_size=block_size, blocks_per_slot=self.blocks_per_slot,
             mode=mode, max_queue=max_queue,
             prefill_token_budget=prefill_token_budget,
-            static_batch_wait_s=static_batch_wait_s, max_len=cfg.max_len)
+            static_batch_wait_s=static_batch_wait_s, max_len=cfg.max_len,
+            aging_s=aging_s)
+        self.scheduler.on_shed = self._book_shed
+        #: Brownout overload controller (serve/brownout.py); None = no
+        #: controller — the engine degrades only via queue rejection.
+        self.brownout = brownout
+        #: Serving chaos plan (resilience/chaos.py slow_decode /
+        #: client_drop / kv_poison, keyed on the engine iteration).
+        self.chaos = chaos
         self.mode = mode
         self.top_k = top_k
         self.top_p = top_p
@@ -113,6 +140,10 @@ class ServingEngine:
         self.iterations = 0
         self.batch_log: List[Tuple] = []    # scheduling trace (tests pin)
         self._blocks_peak = 0
+        self.shed_reasons: Dict[str, int] = {}
+        self._drain_requested = False       # set (signal-safely) by SIGTERM
+        self.drained = False
+        self.drain_docs: List[dict] = []    # replay docs of a drain
 
         tel.gauge("serve/slots").set(num_slots)
         tel.gauge("serve/kv_blocks_total").set(num_blocks - 1)
@@ -123,11 +154,14 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                arrival_s: Optional[float] = None,
+               deadline_ms: Optional[float] = None, priority: int = 0,
                rid: Optional[int] = None) -> Request:
         """Admission-controlled submit.  Returns the Request; check
         ``.status`` — ``rejected`` means the queue pushed back (the
-        closed-loop client's backpressure signal), ``queued`` means it
-        will stream tokens via ``on_token`` and land in ``results``."""
+        closed-loop client's backpressure signal), ``shed`` means
+        overload control dropped it (``shed_reason`` says why),
+        ``queued`` means it will stream tokens via ``on_token`` and
+        land in ``results``."""
         if rid is None:
             rid = self._next_rid
         self._next_rid = max(self._next_rid, rid + 1)
@@ -135,17 +169,45 @@ class ServingEngine:
                       prompt=np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
-                      eos_id=self.eos_id if eos_id is None else eos_id)
+                      eos_id=self.eos_id if eos_id is None else eos_id,
+                      deadline_ms=deadline_ms, priority=int(priority))
         now = self.clock.now() if arrival_s is None else arrival_s
         self.submit_request(req, now)
         return req
 
+    def _book_shed(self, req: Request, reason: str) -> None:
+        """ONE booking path for every shed — scheduler deadline sheds
+        (submit-time and admit-time) and brownout sheds alike."""
+        tel.counter("serve/shed_total").inc()
+        tel.counter(f"serve/shed_{reason}").inc()
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self.results[req.rid] = req
+
     def submit_request(self, req: Request, now: float) -> str:
-        verdict = self.scheduler.submit(req, now)
         tel.counter("serve/submissions_total").inc()
-        if verdict != "queued":
+        if self.brownout is not None:
+            # Brownout first: at reject_low/reject_all the submission is
+            # shed before it costs a queue entry; at degrade the output
+            # ceiling is clamped BEFORE the scheduler sizes the block
+            # reservation, so degraded requests also reserve less.
+            verdict = self.brownout.submit_verdict(req.priority)
+            if verdict is not None:
+                req.arrival_s = now
+                req.status = "shed"
+                req.shed_reason = verdict
+                self._book_shed(req, verdict)
+                return f"shed_{verdict}"
+            cap = self.brownout.max_new_cap()
+            if cap is not None and req.max_new_tokens > cap:
+                req.max_new_tokens = cap
+                req.degraded = True
+                tel.counter("serve/degraded_total").inc()
+        verdict = self.scheduler.submit(req, now)
+        if verdict.startswith("rejected"):
             tel.counter("serve/requests_rejected").inc()
             self.results[req.rid] = req
+        elif verdict.startswith("shed"):
+            pass                    # booked via the on_shed hook already
         return verdict
 
     # -- the iteration ------------------------------------------------------
@@ -163,25 +225,75 @@ class ServingEngine:
         if self.on_token is not None:
             self.on_token(req, int(token), done)
 
-    def _finish(self, req: Request, now: float) -> None:
-        req.status = "completed"
-        req.done_s = now
-        slot = req.slot
-        self.scheduler.release(req)
+    def _clear_slot(self, slot: int) -> None:
         self._table[slot] = -1
         self._tok[slot] = 0
         self._pos[slot] = 0
         self._temps[slot] = 0.0
         self._seeds[slot] = 0
         self._counts[slot] = 0
+
+    def _finish(self, req: Request, now: float) -> None:
+        req.status = "completed"
+        req.done_s = now
+        slot = req.slot
+        self.scheduler.release(req)
+        self._clear_slot(slot)
         self.results[req.rid] = req
         tel.counter("serve/requests_completed").inc()
         ttft = req.ttft_s()
         if ttft is not None:
             tel.histogram("serve/ttft_ms").observe(ttft * 1e3)
+            if self.brownout is not None:
+                self.brownout.observe_ttft(ttft * 1e3)
         tpot = req.tpot_s()
         if tpot is not None:
             tel.histogram("serve/tpot_ms").observe(tpot * 1e3)
+
+    def _scrub_blocks(self, blocks) -> None:
+        """Zero a request's pool blocks (corruption eviction): bad rows
+        must not outlive their victim into the free list."""
+        if not blocks:
+            return
+        b = np.asarray(blocks, np.int32)
+        self.pool.k = self.pool.k.at[:, b].set(0)
+        self.pool.v = self.pool.v.at[:, b].set(0)
+
+    def _evict(self, req: Request, status: str, counter: str) -> None:
+        """Tear an IN-FLIGHT or queued request out right now: blocks
+        free on this iteration (the pool never waits for a dead
+        client), slot-side state is scrubbed so the next decode writes
+        its row into the trash block."""
+        slot = req.slot
+        where = self.scheduler.cancel(req, status=status)
+        if slot is not None and where == "running":
+            self._clear_slot(slot)
+        req.done_s = self.clock.now()
+        self.results[req.rid] = req
+        tel.counter(counter).inc()
+
+    def cancel(self, rid: int, status: str = "cancelled") -> bool:
+        """Client disconnect / caller cancel for a request anywhere in
+        its lifecycle (queued, mid-prefill reservation, mid-decode).
+        Returns True when something was actually torn down.  NOT
+        thread-safe — call from the engine-driving thread (the TCP
+        front end posts cancels through its mailbox)."""
+        req = self.results.get(rid)
+        if req is None:
+            for r in list(self.scheduler.queue) + self.scheduler.active():
+                if r.rid == rid:
+                    req = r
+                    break
+        if req is None or req.status in ("completed", "rejected", "shed",
+                                         "cancelled", "failed"):
+            return False
+        self._evict(req, status, "serve/cancelled_total")
+        # terminal notification: streaming consumers (the TCP bridge's
+        # per-request stream map, --stream printers) must learn the
+        # request ended, or their per-rid state leaks for the process
+        # lifetime on a long-lived server
+        self._emit(req, -1, True)
+        return True
 
     def _token_out(self, req: Request, token: int, now: float) -> bool:
         """Record one emitted token; returns done."""
@@ -191,9 +303,12 @@ class ServingEngine:
         req.last_token_s = now
         done = (len(req.tokens) >= req.max_new_tokens
                 or (req.eos_id is not None and int(token) == req.eos_id))
-        self._emit(req, token, done)
         if done:
+            # finish BEFORE the emit so a streaming consumer (the TCP
+            # front end's terminal line) reads the final status, not
+            # "running"
             self._finish(req, now)
+        self._emit(req, token, done)
         return done
 
     def _prefill(self, slot: int, req: Request) -> None:
@@ -208,6 +323,7 @@ class ServingEngine:
         prompt = np.zeros((1, p_pad), np.int32)
         prompt[0, :p_len] = req.prompt
         seed = _request_seed(self.seed, req.rid)
+        c0 = self.clock.now()
         t0 = time.perf_counter()
         with tel.span("serve/prefill", tokens=p_pad):
             first, self.pool.k, self.pool.v = fn(
@@ -219,6 +335,10 @@ class ServingEngine:
             first = int(first)
         self._book(("prefill", p_pad), time.perf_counter() - t0)
         self.clock.charge("prefill", tokens=p_pad)
+        # Feed the deadline estimator from the SAME clock latencies a
+        # client experiences (wall or virtual), so feasibility math and
+        # measured TTFT cannot disagree about what "slow" means.
+        self.scheduler.observe_prefill(p_pad, self.clock.now() - c0)
         tel.counter("serve/prefill_tokens_total").inc(p_pad)
         self.batch_log.append(("prefill", req.rid))
 
@@ -235,16 +355,19 @@ class ServingEngine:
     def _decode(self, active: List[Request]) -> None:
         import jax.numpy as jnp
 
+        c0 = self.clock.now()
         t0 = time.perf_counter()
         with tel.span("serve/decode", batch=len(active)):
-            nxt, self.pool.k, self.pool.v = self._decode_fn(
+            nxt, ok, self.pool.k, self.pool.v = self._decode_fn(
                 self.params, self.pool.k, self.pool.v,
                 jnp.asarray(self._table), jnp.asarray(self._tok),
                 jnp.asarray(self._pos), jnp.asarray(self._temps),
                 jnp.asarray(self._seeds), jnp.asarray(self._counts))
             nxt = np.asarray(nxt)
+            ok = np.asarray(ok)
         self._book(("decode",), time.perf_counter() - t0)
         self.clock.charge("decode", batch=len(active))
+        self.scheduler.observe_decode(self.clock.now() - c0)
         now = self.clock.now()
         tel.counter("serve/decode_iterations_total").inc()
         tel.counter("serve/tokens_generated_total").inc(len(active))
@@ -252,12 +375,54 @@ class ServingEngine:
             ("decode", tuple(sorted(r.rid for r in active))))
         for req in active:
             slot = req.slot
+            if not bool(ok[slot]):
+                # Non-finite logits = this slot's KV rows (or weights)
+                # went bad.  Evict ONLY the victim — emitting a token
+                # sampled from NaN logits would be silent garbage — and
+                # keep serving every healthy slot.  Scrub the blocks
+                # BEFORE they return to the free list: recycled NaN
+                # rows would otherwise poison every later request that
+                # reuses them (the additive visibility mask cannot mask
+                # NaN), permanently degrading the pool.
+                self._scrub_blocks(req.blocks)
+                self._evict(req, "failed", "serve/kv_evictions_total")
+                self._emit(req, -1, True)
+                continue
             tok = int(nxt[slot])
             req.pos += 1
             self._pos[slot] += 1
             self._counts[slot] += 1
             self._tok[slot] = tok
             self._token_out(req, tok, now)
+
+    def _oldest_active(self) -> Optional[Request]:
+        act = self.scheduler.active()
+        return min(act, key=lambda r: r.rid) if act else None
+
+    def _serve_chaos(self) -> None:
+        """Iteration-keyed serving faults (resilience/chaos.py):
+        slow_decode advances the engine clock (virtual) or sleeps
+        (wall) — the injected latency is indistinguishable from a slow
+        decode to everything downstream (TTFT stamps, rate estimator,
+        brownout signal); client_drop cancels the oldest active request
+        the way a vanished TCP peer would; kv_poison NaN-scribbles the
+        oldest active request's pool blocks so the decode step's
+        finite-logits flag must catch it."""
+        it = self.iterations
+        delay = self.chaos.maybe_slow_decode(it)
+        if delay > 0:
+            self.clock.advance_to(self.clock.now() + delay)
+        if self.chaos.maybe_client_drop(it):
+            victim = self._oldest_active()
+            if victim is not None:
+                self.cancel(victim.rid)
+        if self.chaos.maybe_kv_poison(it):
+            victim = self._oldest_active()
+            if victim is not None and victim.blocks:
+                import jax.numpy as jnp
+                blocks = np.asarray(victim.blocks, np.int32)
+                self.pool.k = self.pool.k.at[:, blocks].set(jnp.nan)
+                self.pool.v = self.pool.v.at[:, blocks].set(jnp.nan)
 
     def step(self) -> bool:
         """One engine iteration: admit + prefill, then one decode step
@@ -270,12 +435,19 @@ class ServingEngine:
         it0 = time.perf_counter()
         prod0 = tel.get_tracker().buckets["productive"]
         comp0 = tel.get_tracker().buckets["compile"]
+        if self.chaos is not None:
+            self._serve_chaos()
         admitted = self.scheduler.admit(self.clock.now())
         for slot, req in admitted:
             self._prefill(slot, req)
         active = self.scheduler.active()
         if active:
             self._decode(active)
+        if self.brownout is not None:
+            level = self.brownout.update(
+                self.iterations,
+                self.scheduler.oldest_queued_wait_s(self.clock.now()))
+            tel.gauge("serve/brownout_level").set(level)
         self.iterations += 1
         if self.heartbeat is not None:
             self.heartbeat(self.iterations)
@@ -292,9 +464,48 @@ class ServingEngine:
                     max(0.0, time.perf_counter() - it0 - booked))
         return bool(admitted or active)
 
+    # -- graceful drain -----------------------------------------------------
+
+    def request_drain(self) -> None:
+        """Signal-handler-safe drain request (sets one flag; the engine
+        loop performs the actual drain at the next iteration boundary —
+        same discipline as utils/preemption.py)."""
+        self._drain_requested = True
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful shutdown: freeze admissions, keep decoding until the
+        in-flight batch finishes (or the wall-clock timeout — the
+        preemption grace window — runs out), then checkpoint every
+        accepted-but-unfinished request as a replay doc.  Replay in a
+        fresh engine is token-identical: per-request rng streams are
+        (seed, rid)-keyed, so an interrupted request redraws the exact
+        same tokens from scratch (tested).  Queued requests and
+        timeout-stranded in-flight requests both land in
+        ``drain_docs``; zero accepted work is lost."""
+        t0 = time.monotonic()
+        self.scheduler.draining = True
+        tel.instant("event/serve_drain", iteration=self.iterations,
+                    active=self.scheduler.num_active(),
+                    queued=len(self.scheduler.queue))
+        while (self.scheduler.num_active()
+               and time.monotonic() - t0 < timeout_s):
+            self.step()
+        timed_out = self.scheduler.num_active() > 0
+        unfinished: List[dict] = []
+        for req in self.scheduler.active() + list(self.scheduler.queue):
+            unfinished.append(req.replay_doc())
+            self._evict(req, "drained", "serve/drained_total")
+            self._emit(req, -1, True)
+        self.drain_docs = sorted(unfinished, key=lambda d: d["rid"])
+        self.drained = True
+        return {"unfinished": self.drain_docs,
+                "drain_s": time.monotonic() - t0,
+                "timed_out": timed_out}
+
     # -- closed-loop driving ------------------------------------------------
 
-    def run(self, trace=None, max_iterations: int = 1_000_000) -> Dict:
+    def run(self, trace=None, max_iterations: int = 1_000_000,
+            drain_timeout_s: float = 30.0) -> Dict:
         """Drive the engine until idle.  ``trace`` is an optional sorted
         ``[(arrival_s, request_kwargs), ...]`` — requests are submitted
         as the clock passes their arrival instants (closed loop: the
@@ -304,6 +515,13 @@ class ServingEngine:
         i = 0
         it = 0
         while i < len(trace) or self.scheduler.has_work():
+            if self._drain_requested and not self.drained:
+                # Preemption (SIGTERM): drain instead of dying mid-batch.
+                # Trace entries not yet submitted were never ACCEPTED —
+                # a real client would retry them against the next
+                # process; accepted-but-unfinished work is checkpointed.
+                self.drain(drain_timeout_s)
+                break
             if it >= max_iterations:
                 raise RuntimeError(
                     f"engine did not drain within {max_iterations} "
@@ -314,6 +532,8 @@ class ServingEngine:
                 self.submit(arrival_s=t_arr, **kw)
                 i += 1
             if not self.scheduler.has_work():
+                if i >= len(trace):
+                    break       # tail of the trace was shed at submit
                 t0 = time.perf_counter()
                 self.clock.advance_to(trace[i][0])
                 tel.get_tracker().add(
@@ -350,15 +570,39 @@ class ServingEngine:
         load, not a ladder slope)."""
         done = [r for r in self.results.values()
                 if r.status == "completed"]
-        rej = sum(1 for r in self.results.values()
-                  if r.status == "rejected")
-        out = {"mode": self.mode, "completed": len(done), "rejected": rej,
+        by_status = {}
+        for r in self.results.values():
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        out = {"mode": self.mode, "completed": len(done),
+               "rejected": by_status.get("rejected", 0),
+               "shed": by_status.get("shed", 0),
+               "shed_reasons": dict(sorted(self.shed_reasons.items())),
+               "cancelled": by_status.get("cancelled", 0),
+               "failed": by_status.get("failed", 0),
+               "drained_unfinished": by_status.get("drained", 0),
+               "degraded": sum(1 for r in self.results.values()
+                               if r.degraded),
                "slots": self.num_slots,
                "kv_blocks_total": self.pool.num_blocks - 1,
                "kv_blocks_peak": self._blocks_peak,
                "kv_block_size": self.block_size,
                "decode_iterations": sum(
                    1 for e in self.batch_log if e[0] == "decode")}
+        if self.brownout is not None:
+            out["brownout"] = self.brownout.state()
+        # Deadline accounting over ADMITTED-and-completed requests: a
+        # violation is a completion later than (deadline + the SLO TTFT
+        # budget) — the grace the SLO already tolerates at the front
+        # door.  Sheds are NOT violations; shedding before prefill is
+        # the contract working.
+        with_dl = [r for r in done if r.deadline_ms is not None]
+        if with_dl:
+            grace_s = (slo_ttft_ms or 0.0) / 1e3
+            viol = sum(1 for r in with_dl
+                       if r.completion_s()
+                       > r.deadline_ms / 1e3 + grace_s)
+            out["deadline_requests_completed"] = len(with_dl)
+            out["deadline_violations"] = viol
         if not done:
             return out
         ttft = np.array([r.ttft_s() for r in done]) * 1e3

@@ -78,6 +78,11 @@ class BlockAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    #: alias used by the leak assertions: the number of free blocks must
+    #: return to its initial value after any churn of allocate/free —
+    #: including client disconnects and mid-prefill cancels (tested).
+    free_count = free_blocks
+
     @property
     def used_blocks(self) -> int:
         return (self.num_blocks - 1) - len(self._free)

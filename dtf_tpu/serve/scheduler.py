@@ -28,6 +28,29 @@ policy unit-tests run without compiling anything.  Responsibilities:
   generator: same engine, same kernels, only the admission policy
   differs — so the measured goodput gap is attributable to continuous
   batching alone.
+* **Deadline-aware shedding** — a request carrying ``deadline_ms``
+  (completion budget relative to arrival) is SHED — cheaply, before
+  any prefill work — the moment the scheduler can prove it hopeless:
+  already expired, or unmeetable under the current decode-rate
+  estimate (EWMAs of measured prefill-per-token and decode-iteration
+  cost, fed by the engine).  Shedding before prefill is the whole
+  point: an evicted mid-decode request has already burned prefill
+  FLOPs and KV blocks; a shed one cost a queue entry.  Sheds are
+  reported through ``on_shed`` with a reason so the engine can book
+  them (``serve/shed_total`` + per-reason counters).
+* **Priority with aging** — ``Request.priority`` (higher = sooner)
+  orders continuous-mode admission; FIFO within a class (stable sort
+  on arrival), and a queued request gains one effective priority level
+  per ``aging_s`` waited, so a stream of high-priority arrivals cannot
+  starve a low-priority request forever.  Candidates are considered
+  in effective-priority order and the walk STOPS at the first
+  candidate that does not fit (no skip-ahead past a block-starved
+  request): a long request at the head keeps its claim on the next
+  freed blocks — the other half of the starvation story.  Both halves
+  are pinned by tests.
+* **Draining** — ``draining = True`` freezes the front door (submits
+  rejected, nothing admitted) while in-flight decodes finish; the
+  engine's graceful-drain path (SIGTERM) owns the flag.
 
 Determinism: decisions depend only on (queue order, slot/allocator
 state, the injected clock).  Under a seeded virtual clock the same
@@ -40,7 +63,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Callable, Deque, List, Optional, Tuple
 
 import numpy as np
 
@@ -67,6 +90,8 @@ class Request:
     temperature: float = 0.0
     eos_id: Optional[int] = None
     arrival_s: float = 0.0             # stamped at submit
+    deadline_ms: Optional[float] = None  # completion budget from arrival
+    priority: int = 0                  # higher = admitted sooner
 
     # runtime state (engine/scheduler owned)
     slot: Optional[int] = None
@@ -76,7 +101,10 @@ class Request:
     first_token_s: Optional[float] = None
     last_token_s: Optional[float] = None
     done_s: Optional[float] = None
-    status: str = "queued"             # queued|running|completed|rejected
+    # queued|running|completed|rejected|shed|cancelled|failed|drained
+    status: str = "queued"
+    shed_reason: Optional[str] = None  # set when status == "shed"
+    degraded: bool = False             # brownout clamped max_new_tokens
 
     @property
     def prompt_len(self) -> int:
@@ -100,6 +128,33 @@ class Request:
         if n < 2 or self.last_token_s is None or self.first_token_s is None:
             return None
         return (self.last_token_s - self.first_token_s) / (n - 1)
+
+    def deadline_at_s(self) -> Optional[float]:
+        """Absolute completion deadline on the engine clock (None = no
+        deadline)."""
+        if self.deadline_ms is None:
+            return None
+        return self.arrival_s + self.deadline_ms / 1e3
+
+    def completion_s(self) -> Optional[float]:
+        """Arrival-to-done latency; None until completed."""
+        if self.done_s is None:
+            return None
+        return self.done_s - self.arrival_s
+
+    def replay_doc(self) -> dict:
+        """The request's replayable identity — everything a restarted
+        engine needs to redraw the SAME tokens (per-request rng streams
+        are seeded by (engine seed, rid), so replay is token-identical
+        regardless of batch composition).  Runtime state is deliberately
+        absent: a drained request replays from scratch."""
+        return {"rid": int(self.rid),
+                "prompt": np.asarray(self.prompt).tolist(),
+                "max_new_tokens": int(self.max_new_tokens),
+                "temperature": float(self.temperature),
+                "eos_id": None if self.eos_id is None else int(self.eos_id),
+                "deadline_ms": self.deadline_ms,
+                "priority": int(self.priority)}
 
 
 # ---------------------------------------------------------------------------
@@ -183,7 +238,8 @@ class Scheduler:
                  mode: str = "continuous", max_queue: int = 64,
                  prefill_token_budget: Optional[int] = None,
                  static_batch_wait_s: float = 0.05,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 aging_s: float = 2.0):
         if mode not in MODES:
             raise ValueError(f"serving mode must be one of {MODES}, "
                              f"got {mode!r}")
@@ -202,8 +258,22 @@ class Scheduler:
                                      or blocks_per_slot * block_size)
         self.static_batch_wait_s = static_batch_wait_s
         self.max_len = max_len
+        self.aging_s = aging_s
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * num_slots
+        #: Front door freeze for graceful drain: submits are rejected,
+        #: admit returns nothing, in-flight decodes keep stepping.
+        self.draining = False
+        #: Engine hook — called with (request, reason) for every shed so
+        #: sheds are booked exactly once, wherever they happen.
+        self.on_shed: Optional[Callable[[Request, str], None]] = None
+        # Decode-rate estimate (EWMA, fed by the engine's measured
+        # clock durations).  0.0 = no observation yet: the estimator is
+        # optimistic until the first prefill/decode lands, so a cold
+        # engine never sheds on a fictitious rate.
+        self.prefill_s_per_token = 0.0
+        self.decode_iter_s = 0.0
+        self._ewma_alpha = 0.3
 
     # -- state queries ------------------------------------------------------
 
@@ -215,6 +285,65 @@ class Scheduler:
 
     def has_work(self) -> bool:
         return bool(self.queue) or self.num_active() > 0
+
+    def oldest_queued_wait_s(self, now: float) -> float:
+        """Longest current queue wait (0 when empty) — the brownout
+        controller's early-warning signal: under overload nothing
+        completes, so TTFT observations dry up exactly when they matter;
+        the head-of-queue wait keeps rising regardless."""
+        if not self.queue:
+            return 0.0
+        return max(0.0, now - min(r.arrival_s for r in self.queue))
+
+    # -- decode-rate estimate (engine feeds, shedding reads) ----------------
+
+    def observe_prefill(self, tokens: int, seconds: float) -> None:
+        if tokens <= 0 or seconds <= 0:
+            return
+        per = seconds / tokens
+        a = self._ewma_alpha
+        self.prefill_s_per_token = (
+            per if self.prefill_s_per_token == 0.0
+            else a * per + (1 - a) * self.prefill_s_per_token)
+
+    def observe_decode(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        a = self._ewma_alpha
+        self.decode_iter_s = (
+            seconds if self.decode_iter_s == 0.0
+            else a * seconds + (1 - a) * self.decode_iter_s)
+
+    def estimate_completion_s(self, req: Request) -> float:
+        """Best-effort time from "admitted now" to the request's LAST
+        token under the current rate estimate: one prefill (yields the
+        first token) plus one decode iteration per remaining token.
+        0.0 on a cold engine (no observations yet) — optimistic by
+        design, so shedding only ever acts on measured slowness."""
+        prefill = self.prefill_s_per_token * req.padded_prompt_len(
+            self.block_size)
+        return prefill + max(req.max_new_tokens - 1, 0) * self.decode_iter_s
+
+    # -- shedding -----------------------------------------------------------
+
+    def _shed(self, req: Request, reason: str) -> str:
+        req.status = "shed"
+        req.shed_reason = reason
+        if self.on_shed is not None:
+            self.on_shed(req, reason)
+        return f"shed_{reason}"
+
+    def _deadline_verdict(self, req: Request, now: float) -> Optional[str]:
+        """None = keep; otherwise the shed reason.  Called BEFORE any
+        prefill work — the cheap moment to drop a hopeless request."""
+        at = req.deadline_at_s()
+        if at is None:
+            return None
+        if now >= at:
+            return "deadline_expired"
+        if now + self.estimate_completion_s(req) > at:
+            return "deadline_unmeetable"
+        return None
 
     # -- admission ----------------------------------------------------------
 
@@ -234,12 +363,21 @@ class Scheduler:
         rejected request carries the reason in ``req.tokens is None`` +
         the return value; the engine counts both)."""
         req.arrival_s = now
+        if self.draining:
+            req.status = "rejected"
+            return "rejected_draining"
         total = req.prompt_len + req.max_new_tokens
         window = self.blocks_per_slot * self.block_size
         limit = min(window, self.max_len) if self.max_len else window
         if req.max_new_tokens < 1 or req.prompt_len < 1:
             req.status = "rejected"
             return "rejected_empty"
+        # A deadline the rate estimate already rules out is shed at the
+        # front door — the cheapest possible outcome for the request AND
+        # the queue (it never occupies an entry another request wants).
+        verdict = self._deadline_verdict(req, now)
+        if verdict is not None:
+            return self._shed(req, verdict)
         # Reject against BOTH ceilings: the per-slot window and the whole
         # pool.  A request needing more blocks than the pool holds would
         # otherwise queue forever (nothing in flight can free enough) and
@@ -260,13 +398,34 @@ class Scheduler:
     def release(self, req: Request) -> None:
         """Return a finished request's slot and blocks to the pool (the
         continuous-batching eviction half; admissions refill the slot on
-        the same iteration)."""
+        the same iteration).  ALSO the one true release path for every
+        early exit — cancel, kv-poison eviction, drain timeout — so a
+        request's blocks cannot leak no matter how it dies: blocks are
+        freed iff ``req.blocks`` is set, and the field is cleared
+        atomically with the free (a second release is a no-op, not a
+        double free)."""
         if req.slot is not None:
             self.slots[req.slot] = None
             req.slot = None
         if req.blocks:
             self.allocator.free(req.blocks)
             req.blocks = None
+
+    def cancel(self, req: Request, status: str = "cancelled") -> str:
+        """Tear a request out wherever it currently lives — queued (drop
+        the entry), or running (free slot + every reserved block,
+        including blocks a prefill wrote moments ago).  Returns where it
+        was found: ``queued`` / ``running`` / ``gone`` (already
+        finished or never here — cancel is idempotent)."""
+        if req in self.queue:
+            self.queue.remove(req)
+            req.status = status
+            return "queued"
+        if req.slot is not None or req.blocks:
+            self.release(req)
+            req.status = status
+            return "running"
+        return "gone"
 
     def _assign(self, req: Request) -> Tuple[int, Request]:
         slot = self.slots.index(None)
@@ -277,11 +436,21 @@ class Scheduler:
         self.slots[slot] = req
         return slot, req
 
+    def effective_priority(self, req: Request, now: float) -> int:
+        """Declared priority plus one level per ``aging_s`` waited — the
+        anti-starvation escalator (aging_s <= 0 disables aging)."""
+        if self.aging_s <= 0:
+            return req.priority
+        return req.priority + int(max(0.0, now - req.arrival_s)
+                                  / self.aging_s)
+
     def admit(self, now: float) -> List[Tuple[int, Request]]:
         """The per-iteration admission decision (see module docstring
         for both policies).  Returns ``(slot, request)`` pairs the engine
         must prefill this iteration."""
         out: List[Tuple[int, Request]] = []
+        if self.draining:
+            return out
         if self.mode == "static":
             if self.num_active() or not self.queue:
                 return out
@@ -303,15 +472,32 @@ class Scheduler:
                 out.append(self._assign(req))
             return out
 
+        # Continuous mode: walk candidates in (effective priority desc,
+        # arrival, rid) order — FIFO within a class, aging lifts
+        # long-waiters across classes.  The walk STOPS at the first
+        # candidate that doesn't fit (budget or blocks): skipping past a
+        # block-starved request would let a stream of small requests
+        # starve a big one forever, so the head keeps its claim on the
+        # next freed blocks.  Deadline sheds happen IN the walk, before
+        # the fit checks — a hopeless request must not block the line.
         budget = self.prefill_token_budget
-        while self.queue and self.num_active() < self.num_slots:
-            req = self.queue[0]
+        order = sorted(self.queue,
+                       key=lambda r: (-self.effective_priority(r, now),
+                                      r.arrival_s, r.rid))
+        for req in order:
+            if self.num_active() >= self.num_slots:
+                break
+            verdict = self._deadline_verdict(req, now)
+            if verdict is not None:
+                self.queue.remove(req)
+                self._shed(req, verdict)
+                continue
             p_pad = req.padded_prompt_len(self.block_size)
             if out and p_pad > budget:
                 break                   # phase separation: drip prefills
             if not self.allocator.can_allocate(self._blocks_needed(req)):
                 break                   # blocks come back as decodes finish
-            self.queue.popleft()
+            self.queue.remove(req)
             out.append(self._assign(req))
             budget -= p_pad
         return out

@@ -89,6 +89,23 @@ METRICS = (
     "serve/kv_blocks_peak",
     "serve/ttft_ms",              # per-request time-to-first-token
     "serve/tpot_ms",              # per-request time-per-output-token
+    # overload control / resilience (PR 10): sheds happen BEFORE prefill
+    # (deadline feasibility or brownout level), evictions tear out
+    # in-flight requests (client disconnect / detected KV corruption),
+    # drains checkpoint accepted-but-unfinished work for replay.
+    "serve/shed_total",
+    "serve/shed_*",               # per-reason: deadline_expired,
+                                  # deadline_unmeetable,
+                                  # brownout_low_priority,
+                                  # brownout_admissions
+    "serve/degraded_total",       # brownout max_new_tokens clamps
+    "serve/brownout_level",       # 0..3 (serve/brownout.py LEVELS)
+    "serve/cancelled_total",      # client disconnects / caller cancels
+    "serve/kv_evictions_total",   # non-finite-logits evictions
+    "serve/drained_total",        # unfinished requests checkpointed by
+                                  # a graceful drain (each replays)
+    "serve/conn_total",           # TCP front end: connections accepted
+    "serve/conn_errors_total",    # malformed requests + timeouts + drops
 )
 # spans (host-side tracer)
 SPANS = (

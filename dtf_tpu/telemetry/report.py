@@ -155,6 +155,8 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 min_examples_per_s: Optional[float] = None,
                 min_tokens_per_s: Optional[float] = None,
                 max_final_cost: Optional[float] = None,
+                min_goodput_qps: Optional[float] = None,
+                max_ttft_p99_ms: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -173,7 +175,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
     * ``min_examples_per_s`` / ``min_tokens_per_s`` — throughput floors
       (``throughput/*`` gauges);
     * ``max_final_cost`` — convergence: the metrics.csv final cost
-      (latest attempt) must be at or under the pinned target.
+      (latest attempt) must be at or under the pinned target;
+    * ``min_goodput_qps`` / ``max_ttft_p99_ms`` — the SERVING gates
+      (telemetry.json's ``serving`` section, written by the engine):
+      goodput-QPS floor (completed requests that met the SLO TTFT
+      budget per second of makespan) and p99 TTFT ceiling — the
+      scenario matrix's serve cell gates on these, so serving
+      robustness is CI-judged exactly like training.
     """
     lines: List[str] = []
     ok = True
@@ -215,6 +223,15 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         cost = report.get("steps", {}).get("final_cost")
         gate("max_final_cost", None if cost is None else float(cost),
              max_final_cost, at_most=True)
+    serving = report.get("telemetry", {}).get("serving", {})
+    if min_goodput_qps is not None:
+        v = serving.get("goodput_qps")
+        gate("min_goodput_qps", None if v is None else float(v),
+             min_goodput_qps, at_most=False)
+    if max_ttft_p99_ms is not None:
+        v = serving.get("ttft_ms_p99")
+        gate("max_ttft_p99_ms", None if v is None else float(v),
+             max_ttft_p99_ms, at_most=True)
     return ok, lines
 
 
@@ -320,7 +337,10 @@ def render(report: dict, top: int = 10) -> str:
     if serving or srv:
         lines.append("Serving (SLO / goodput)")
         if serving:
-            order = ("mode", "completed", "rejected", "completed_qps",
+            order = ("mode", "completed", "rejected", "shed", "cancelled",
+                     "failed", "drained_unfinished", "degraded",
+                     "deadline_requests_completed", "deadline_violations",
+                     "completed_qps",
                      "goodput_qps", "slo_ttft_ms", "slo_attainment",
                      "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
                      "tpot_ms_p99", "makespan_s", "tokens_out",
@@ -331,6 +351,18 @@ def render(report: dict, top: int = 10) -> str:
                     lines.append(f"  {k:<28} "
                                  + (f"{v:>12}" if isinstance(v, str)
                                     else f"{v:12.5g}"))
+            reasons = serving.get("shed_reasons")
+            if reasons:
+                detail = " ".join(f"{k}={v}"
+                                  for k, v in sorted(reasons.items()))
+                lines.append(f"  {'shed_reasons':<28} {detail}")
+            bo = serving.get("brownout")
+            if bo:
+                lines.append(
+                    f"  {'brownout':<28} level {bo.get('level')} "
+                    f"({bo.get('level_name')}), p99 ewma "
+                    f"{bo.get('p99_ttft_ewma_ms'):g} ms, "
+                    f"{bo.get('transitions')} transition(s)")
         for n in sorted(srv):
             lines.append(f"  {n:<28} {srv[n]:12.5g}")
     if "steps" in report:
@@ -407,6 +439,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="throughput floor (throughput/tokens_per_s)")
     p.add_argument("--max_final_cost", type=float, default=None,
                    help="convergence gate: metrics.csv final cost ceiling")
+    p.add_argument("--min_goodput_qps", type=float, default=None,
+                   help="serving gate: goodput-QPS floor (telemetry "
+                        "'serving' section)")
+    p.add_argument("--max_ttft_p99_ms", type=float, default=None,
+                   help="serving gate: p99 TTFT ceiling in ms")
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.logdir):
         print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
@@ -427,7 +464,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_rollbacks": ns.max_rollbacks,
                   "min_examples_per_s": ns.min_examples_per_s,
                   "min_tokens_per_s": ns.min_tokens_per_s,
-                  "max_final_cost": ns.max_final_cost}
+                  "max_final_cost": ns.max_final_cost,
+                  "min_goodput_qps": ns.min_goodput_qps,
+                  "max_ttft_p99_ms": ns.max_ttft_p99_ms}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

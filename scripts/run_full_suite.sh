@@ -302,6 +302,71 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve lane assertions (rc=$rc)"; }
   rm -rf "$sdir"
 fi
+# Serve-chaos lane (DESIGN.md §7.4): the overload/brownout gate
+# (deadline'd load under an injected decode-rate spike, controller
+# on/off same-trace A/B: zero deadline violations + sheds booked +
+# controller strictly improves goodput-QPS), then a REAL SIGTERM mid-run
+# against a wall-clock server — the drain must checkpoint unfinished
+# requests, the supervisor replay must complete every accepted request
+# TOKEN-IDENTICALLY to an uninterrupted run, and report --check must
+# stay green with the shed/drain instruments present.  Finally the
+# slow-marked TCP front-end tests (the `serve` marker split keeps them
+# out of tier-1).  Skip with NO_SERVE_CHAOS_LANE=1.
+if [ "${NO_SERVE_CHAOS_LANE:-0}" != "1" ]; then
+  echo "=== serve-chaos lane (brownout gate + SIGTERM drain/replay + TCP tests) ==="
+  scdir2=$(mktemp -d)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.serve_load --preset tiny \
+      --clock virtual --mode continuous --chaos 'slow_decode@30:60ms' \
+      --deadline_ms 2500 --priorities 0,0,1 --output_lens 2,8,16 \
+      --qps 10 --requests 60 \
+      --check --json "$scdir2/chaos_ab.json" > "$scdir2/chaos.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve overload gate (rc=$rc)"; tail -8 "$scdir2/chaos.log"; }
+  grep -q "CHECK OK" "$scdir2/chaos.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: overload CHECK OK line missing"; }
+  # reference tokens from an uninterrupted run (tokens are clock- and
+  # chaos-independent: per-request rng streams are (seed, rid)-keyed)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 16 \
+      --qps 6 --clock virtual --seed 11 \
+      --tokens_out "$scdir2/ref_tokens.json" > "$scdir2/ref.log" 2>&1 \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: drain reference run"; }
+  # the loaded wall-clock server (slow_decode keeps it busy), SIGTERM'd
+  # mid-run: graceful drain + in-process supervisor replay
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 16 \
+      --qps 6 --clock wall --seed 11 --chaos 'slow_decode@5:40ms' \
+      --max_restarts 1 --drain_timeout_s 2 --logdir "$scdir2/drain_run" \
+      --tokens_out "$scdir2/drain_tokens.json" \
+      > "$scdir2/drain.log" 2>&1 &
+  spid=$!
+  sleep 4
+  kill -TERM "$spid" 2>/dev/null
+  wait "$spid"
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: SIGTERM drain/replay run (rc=$rc)"; tail -10 "$scdir2/drain.log"; }
+  python - "$scdir2" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+ref = json.load(open(os.path.join(d, "ref_tokens.json")))
+got = json.load(open(os.path.join(d, "drain_tokens.json")))
+assert got == ref, "drain+replay tokens diverged from uninterrupted run"
+assert ref, "reference token map is empty"
+print(f"drain replay OK: {len(got)} request(s) token-identical")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: drain replay token identity (rc=$rc)"; }
+  python -m dtf_tpu.telemetry.report "$scdir2/drain_run" --check \
+      > "$scdir2/report.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: drain-run report --check (rc=$rc)"; tail -5 "$scdir2/report.log"; }
+  grep -q "drained_unfinished" "$scdir2/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing drain accounting"; }
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py \
+      -q -m "serve and slow" -p no:cacheprovider \
+      > "$scdir2/tcp.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: TCP front-end tests (rc=$rc)"; tail -10 "$scdir2/tcp.log"; }
+  rm -rf "$scdir2"
+fi
 # Scenario lane (DESIGN.md §8): the 2-cell mini-matrix through the real
 # cell runner with --check — one chaos-off GPT baseline cell (the
 # control row) and the host_down MNIST elastic cell (SIGKILL mid-run ->

@@ -65,9 +65,37 @@ class TestFaultPlanParse:
                     "nan_grad", "nan_grad@@3", "host_down@3",
                     "slow_host@3:1", "sigterm@every:5", "stall@every:0:1s",
                     "host_down@every:5:1", "partition@3:1:2",
-                    "sigterm@40:1"):
+                    "sigterm@40:1",
+                    # serving kinds: delay required, spike width must be
+                    # positive, kv_poison is one-shot, no extra args
+                    "slow_decode@5", "slow_decode@5:10ms:0",
+                    "slow_decode@every:3:10ms:5", "kv_poison@every:3",
+                    "client_drop@3:1", "kv_poison@3:4"):
             with pytest.raises(ValueError):
                 FaultPlan.parse(bad)
+
+    def test_serving_fault_grammar(self):
+        plan = FaultPlan.parse(
+            "slow_decode@30:60ms,slow_decode@10:80ms:40,"
+            "client_drop@7,kv_poison@9,client_drop@every:4")
+        spec = [(f.kind, f.step, f.period) for f in plan.faults]
+        assert spec == [("slow_decode", 30, None),
+                        ("slow_decode", 10, None),
+                        ("client_drop", 7, None), ("kv_poison", 9, None),
+                        ("client_drop", None, 4)]
+        assert plan.faults[0].duration_s == pytest.approx(0.06)
+        assert plan.faults[0].count is None          # persistent
+        assert plan.faults[1].count == 40            # bounded spike
+
+    def test_slow_decode_window_semantics(self):
+        """One-shot = persistent from S (optionally :N iterations);
+        periodic = one hit per firing."""
+        plan = FaultPlan.parse("slow_decode@3:50ms:2", process_index=0)
+        assert [plan.maybe_slow_decode(i) for i in range(7)] == \
+            [0, 0, 0, 0.05, 0.05, 0, 0]
+        per = FaultPlan.parse("slow_decode@every:3:20ms", process_index=0)
+        assert [per.maybe_slow_decode(i) for i in range(7)] == \
+            [0, 0, 0, 0.02, 0, 0, 0.02]
 
     def test_host_fault_grammar(self):
         plan = FaultPlan.parse(
@@ -97,6 +125,10 @@ class TestFaultPlanParse:
         # a compound plan mixing every fault family in one spec
         "preempt@every:12,ckpt_stall@10:200ms,host_down@20:1,"
         "slow_host@5:0:50ms,nan_grad@every:7,corrupt_ckpt@latest",
+        # the serving kinds (ISSUE 10): persistent + bounded decode
+        # slowdowns, client drops, KV corruption
+        "slow_decode@30:60ms,client_drop@10,kv_poison@20",
+        "slow_decode@10:80ms:40,client_drop@every:4",
     ])
     def test_spec_round_trips(self, spec):
         """str(parse(spec)) == spec, and re-parsing the printed form is a

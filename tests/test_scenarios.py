@@ -14,7 +14,8 @@ import sys
 
 import pytest
 
-from dtf_tpu.scenarios.spec import (Gate, MATRICES, ScenarioSpec, WORKLOADS,
+from dtf_tpu.scenarios.spec import (Gate, MATRICES, ScenarioSpec,
+                                    TRAIN_WORKLOADS, WORKLOADS,
                                     default_matrix, load_matrix, mini_matrix)
 
 pytestmark = pytest.mark.scenarios
@@ -89,6 +90,15 @@ class TestMatrices:
         assert elastic and all(0 < c.shrink_devices < c.devices
                                for c in elastic)
         assert any(c.grad_sync == "zero1" for c in cells)
+        # the serving cell (ISSUE 10): chaos'd load run gated on
+        # goodput-QPS + p99 TTFT like training cells gate on loss
+        serve = [c for c in cells if c.workload == "serve"]
+        assert serve, "no serving cell in the default matrix"
+        for kind in ("slow_decode", "client_drop", "kv_poison"):
+            assert kind in (serve[0].chaos or ""), kind
+        assert serve[0].gate.min_goodput_qps > 0
+        assert serve[0].gate.max_ttft_p99_ms > 0
+        assert serve[0].gate.max_final_cost is None
 
     def test_default_matrix_chaos_parses_for_every_host(self):
         """Host-targeted faults must parse under every process index the
@@ -131,12 +141,15 @@ class TestMatrices:
 
 class TestZoo:
     def test_builders_in_sync_with_spec_workloads(self):
-        """spec.WORKLOADS (jax-free) mirrors zoo.BUILDERS (jax-heavy);
-        this is the pinned sync the spec docstring promises."""
+        """spec.TRAIN_WORKLOADS (jax-free) mirrors zoo.BUILDERS
+        (jax-heavy); this is the pinned sync the spec docstring
+        promises.  The serve cell kind rides WORKLOADS but never goes
+        through the zoo (scenarios/_host.py drives the engine)."""
         from dtf_tpu.scenarios import zoo
-        assert tuple(sorted(zoo.BUILDERS)) == tuple(sorted(WORKLOADS))
+        assert tuple(sorted(zoo.BUILDERS)) == tuple(sorted(TRAIN_WORKLOADS))
+        assert set(WORKLOADS) == set(TRAIN_WORKLOADS) | {"serve"}
 
-    @pytest.mark.parametrize("workload", WORKLOADS)
+    @pytest.mark.parametrize("workload", TRAIN_WORKLOADS)
     def test_kits_build_and_data_streams_rewind(self, workload):
         """Every builder yields a model + fresh optimizer per call + a
         splits_factory whose streams REWIND (restart attempts replay the

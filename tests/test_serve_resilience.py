@@ -1,0 +1,858 @@
+"""Overload-safe serving (ISSUE 10): deadline-aware shedding, the
+brownout controller, priority + aging (starvation policy), cancel /
+client-disconnect block release, graceful drain with token-identical
+replay, serving chaos kinds, and the TCP front end.
+
+The ISSUE-level pins:
+
+* **shed before prefill** — a request that cannot meet its deadline
+  under the current decode-rate estimate is dropped at the front door,
+  booked under ``serve/shed_total`` with a reason, never prefilled;
+* **no leaks** — after any churn of completions, cancels, drops, and
+  evictions, ``allocator.free_count`` returns to its initial value;
+* **drain loses zero accepted work** — a drained engine's replay docs,
+  run through a fresh engine, produce token-identical results to an
+  uninterrupted run (per-request rng streams are (seed, rid)-keyed);
+* **starvation policy** — FIFO within a priority class, aging lifts
+  waiters across classes, and the admission walk never skips past a
+  block-starved request.
+"""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from dtf_tpu.serve import (BlockAllocator, BrownoutController, Request,
+                           Scheduler, ServingEngine, VirtualClock)
+from dtf_tpu.serve.brownout import LEVELS
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _mk_engine(model, params, **kw):
+    kw.setdefault("clock", VirtualClock())
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 8)
+    return ServingEngine(model, params, **kw)
+
+
+def _mk_trace(rng, n, *, qps=50.0, p_lens=(3, 5, 8), o_lens=(3, 6, 10),
+              vocab=128, **extra):
+    trace, t = [], 0.0
+    for rid in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        trace.append((t, {
+            "rid": rid,
+            "prompt": rng.integers(0, vocab,
+                                   (int(rng.choice(p_lens)),)).astype(
+                                       np.int32),
+            "max_new_tokens": int(rng.choice(o_lens)),
+            **extra,
+        }))
+    return trace
+
+
+def _req(rid, p_len=4, max_new=4, t=0.0, **kw):
+    return Request(rid=rid, prompt=np.zeros((p_len,), np.int32),
+                   max_new_tokens=max_new, arrival_s=t, **kw)
+
+
+def _sched(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 4)
+    kw.setdefault("allocator",
+                  BlockAllocator(1 + kw["num_slots"] * kw["blocks_per_slot"]))
+    return Scheduler(**kw)
+
+
+# ---------------------------------------------------------------------------
+# deadline shedding (jax-free scheduler policy)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineShedding:
+    def test_hopeless_deadline_shed_at_submit(self):
+        """The measured rate already rules this one out: shed at the
+        front door, before it costs a queue entry."""
+        s = _sched()
+        s.decode_iter_s = 1.0               # 1s per token, measured
+        sheds = []
+        s.on_shed = lambda r, why: sheds.append((r.rid, why))
+        r = _req(0, max_new=8, deadline_ms=500.0)
+        assert s.submit(r, now=1.0) == "shed_deadline_unmeetable"
+        assert r.status == "shed"
+        assert r.shed_reason == "deadline_unmeetable"
+        assert sheds == [(0, "deadline_unmeetable")]
+        assert not s.queue                  # never cost a queue entry
+
+    def test_deadline_expires_while_queued(self):
+        """Feasible at submit, but the queue wait ate the budget: the
+        admit walk sheds it with deadline_expired."""
+        s = _sched()
+        sheds = []
+        s.on_shed = lambda r, why: sheds.append((r.rid, why))
+        assert s.submit(_req(0, deadline_ms=50.0), now=0.0) == "queued"
+        assert s.admit(now=1.0) == []       # 1s > 50ms deadline
+        assert sheds == [(0, "deadline_expired")]
+        assert not s.queue
+
+    def test_unmeetable_deadline_shed_before_prefill(self):
+        """The rate estimate says 8 remaining tokens need ~800ms; a
+        500ms deadline is hopeless — shed at admit, BEFORE any prefill
+        (the request never reaches the slot assignment)."""
+        s = _sched()
+        s.decode_iter_s = 0.1               # 100ms per token, measured
+        sheds = []
+        s.on_shed = lambda r, why: sheds.append(why)
+        s.submit(_req(0, max_new=9, deadline_ms=500.0), now=0.0)
+        got = s.admit(now=0.0)
+        assert got == []
+        assert sheds == ["deadline_unmeetable"]
+
+    def test_cold_engine_never_sheds_on_estimates(self):
+        """No observations yet -> estimator is 0 -> optimistic: the
+        deadline check cannot fire on a fictitious rate."""
+        s = _sched()
+        assert s.submit(_req(0, max_new=8, deadline_ms=10.0),
+                        now=0.0) == "queued"
+        assert len(s.admit(0.0)) == 1
+
+    def test_feasible_deadline_admits(self):
+        s = _sched()
+        s.decode_iter_s = 0.01
+        s.prefill_s_per_token = 0.001
+        s.submit(_req(0, max_new=4, deadline_ms=500.0), now=0.0)
+        assert len(s.admit(0.0)) == 1
+
+    def test_estimator_ewma_updates(self):
+        s = _sched()
+        s.observe_decode(0.1)
+        assert s.decode_iter_s == pytest.approx(0.1)
+        s.observe_decode(0.2)
+        assert 0.1 < s.decode_iter_s < 0.2
+        s.observe_prefill(10, 0.05)
+        assert s.prefill_s_per_token == pytest.approx(0.005)
+
+
+# ---------------------------------------------------------------------------
+# priority + aging (the starvation policy, pinned)
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityAndStarvation:
+    def test_priority_order_fifo_within_class(self):
+        s = _sched(num_slots=4, aging_s=0.0)
+        s.submit(_req(0, priority=0), 0.0)
+        s.submit(_req(1, priority=1), 0.1)
+        s.submit(_req(2, priority=1), 0.2)
+        s.submit(_req(3, priority=0), 0.3)
+        got = [r.rid for _, r in s.admit(0.3)]
+        # high class first, FIFO within each class
+        assert got == [1, 2, 0, 3]
+
+    def test_aging_lifts_a_low_priority_waiter(self):
+        """SATELLITE PIN: a stream of high-priority shorts must not
+        starve a low-priority request forever — after aging_s the
+        waiter gains a level and admits ahead of fresher high-pri
+        arrivals."""
+        s = _sched(num_slots=1, aging_s=1.0)
+        s.submit(_req(0, priority=0), 0.0)    # the would-be starved one
+        s.submit(_req(1, priority=1), 0.1)
+        got = s.admit(0.1)
+        assert [r.rid for _, r in got] == [1]  # high pri wins while fresh
+        s.release(got[0][1])
+        # the stream keeps coming: each arrival is FRESH (effective
+        # priority 1), while request 0's wait has lifted it to 0+2=2
+        s.submit(_req(2, priority=1), 2.05)
+        got2 = s.admit(2.1)
+        assert [r.rid for _, r in got2] == [0]
+        # and on an effective-priority TIE, earlier arrival wins (FIFO)
+        s2 = _sched(num_slots=1, aging_s=1.0)
+        s2.submit(_req(0, priority=0), 0.0)
+        s2.submit(_req(1, priority=1), 1.15)   # fresh high: eff 1
+        got3 = s2.admit(1.2)                   # waiter: eff 0+1=1, older
+        assert [r.rid for _, r in got3] == [0]
+
+    def test_no_skip_ahead_past_block_starved_head(self):
+        """The other starvation half: when the head candidate cannot get
+        blocks, later (smaller) candidates must NOT jump the line — the
+        head keeps its claim on the next freed blocks."""
+        s = _sched(num_slots=2, blocks_per_slot=4,
+                   allocator=BlockAllocator(6))   # 5 usable blocks
+        big = _req(0, p_len=14, max_new=2)        # needs 4 blocks
+        small = _req(1, p_len=2, max_new=2)       # needs 1 block
+        hog = _req(2, p_len=8, max_new=2)         # holds 2 blocks
+        s.submit(hog, 0.0)
+        assert len(s.admit(0.0)) == 1
+        s.submit(big, 0.1)
+        s.submit(small, 0.2)
+        assert s.admit(0.2) == []                 # big can't fit: STOP
+        s.release(hog)
+        got = [r.rid for _, r in s.admit(0.3)]
+        assert got[0] == 0                        # big goes first
+
+    def test_effective_priority_math(self):
+        s = _sched(aging_s=2.0)
+        r = _req(0, priority=1, t=0.0)
+        assert s.effective_priority(r, 1.9) == 1
+        assert s.effective_priority(r, 2.1) == 2
+        assert s.effective_priority(r, 6.5) == 4
+        s2 = _sched(aging_s=0.0)
+        assert s2.effective_priority(r, 100.0) == 1   # aging disabled
+
+
+# ---------------------------------------------------------------------------
+# cancel / release (the leak audit)
+# ---------------------------------------------------------------------------
+
+
+class TestCancelAndRelease:
+    def test_cancel_queued_running_gone(self):
+        s = _sched(num_slots=1)               # b stays queued behind a
+        a, b = _req(0), _req(1)
+        s.submit(a, 0.0)
+        s.submit(b, 0.0)
+        (slot, ra), = [x for x in s.admit(0.0) if x[1] is a]
+        free0 = s.allocator.free_count
+        assert s.cancel(b) == "queued"
+        assert b.status == "cancelled" and not s.queue
+        assert s.cancel(a) == "running"
+        assert s.allocator.free_count > free0
+        assert s.cancel(a) == "gone"              # idempotent
+        assert s.allocator.free_count == s.allocator.num_blocks - 1
+
+    def test_release_is_idempotent_not_double_free(self):
+        s = _sched()
+        s.submit(_req(0), 0.0)
+        (slot, r), = s.admit(0.0)
+        s.release(r)
+        s.release(r)                              # no ValueError
+        assert s.allocator.free_count == s.allocator.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# brownout controller (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutController:
+    def test_escalates_with_dwell_hysteresis(self):
+        c = BrownoutController(100.0, dwell_iters=3)
+        for i in range(20):
+            c.observe_ttft(500.0)
+            c.update(i)
+        assert c.level == 3                       # reached reject_all
+        # transitions respected the dwell: gaps >= 3 iterations
+        its = [t[0] for t in c.transitions]
+        assert all(b - a >= 3 for a, b in zip(its, its[1:]))
+        assert [t[1:] for t in c.transitions] == [(0, 1), (1, 2), (2, 3)]
+
+    def test_deescalates_when_signal_recovers(self):
+        c = BrownoutController(100.0, dwell_iters=2, exit_ratio=0.5)
+        for i in range(10):
+            c.observe_ttft(500.0)
+            c.update(i)
+        assert c.level == 3
+        for i in range(10, 60):
+            c.observe_ttft(10.0)                  # fast again
+            c.update(i)
+        assert c.level == 0
+        assert LEVELS[c.level] == "normal"
+
+    def test_idle_decay_unlatches_reject_all(self):
+        """At reject_all nothing completes, so TTFT observations stop —
+        the stale signal must decay or the brownout latches forever."""
+        c = BrownoutController(100.0, dwell_iters=2)
+        for i in range(10):
+            c.observe_ttft(1000.0)
+            c.update(i)
+        assert c.level == 3
+        for i in range(10, 200):                  # silence: no obs, no queue
+            c.update(i)
+        assert c.level == 0
+
+    def test_queue_wait_is_an_early_warning(self):
+        """No completions at all (hard wedge): the head-of-queue wait
+        alone must escalate the controller."""
+        c = BrownoutController(100.0, dwell_iters=1)
+        for i in range(10):
+            c.update(i, queue_head_wait_s=1.0)    # 1000ms >> 100ms SLO
+        assert c.level >= 1
+
+    def test_levels_gate_admissions(self):
+        c = BrownoutController(100.0, degrade_max_new=4,
+                               low_priority_max=0)
+        assert c.max_new_cap() is None and c.submit_verdict(0) is None
+        c.level = 1
+        assert c.max_new_cap() == 4 and c.submit_verdict(0) is None
+        c.level = 2
+        assert c.submit_verdict(0) == "brownout_low_priority"
+        assert c.submit_verdict(1) is None
+        c.level = 3
+        assert c.submit_verdict(1) == "brownout_admissions"
+
+    def test_rejects_bad_hysteresis(self):
+        with pytest.raises(ValueError, match="hysteresis"):
+            BrownoutController(100.0, enter_ratio=0.5, exit_ratio=0.7)
+        with pytest.raises(ValueError, match="slo"):
+            BrownoutController(0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine: shed booking, churn leak audit, chaos kinds
+# ---------------------------------------------------------------------------
+
+
+class TestEngineOverload:
+    def test_sheds_booked_with_reasons(self, tiny_model):
+        import dtf_tpu.telemetry as tel
+        model, params = tiny_model
+        tel.reset()
+        eng = _mk_engine(model, params)
+        eng.scheduler.decode_iter_s = 1.0     # measured-slow engine
+        r = eng.submit(np.arange(4), 8, deadline_ms=500.0)
+        assert r.status == "shed"
+        s = eng.summary()
+        assert s["shed"] == 1
+        assert s["shed_reasons"] == {"deadline_unmeetable": 1}
+        assert tel.get_registry().counter("serve/shed_total").value == 1
+        assert tel.get_registry().counter(
+            "serve/shed_deadline_unmeetable").value == 1
+
+    def test_churn_with_random_cancels_leaks_nothing(self, tiny_model):
+        """SATELLITE PIN: allocator.free_count returns to initial after
+        a churn run where a third of the requests are cancelled at
+        random iterations (queued, mid-prefill reservation, and
+        mid-decode alike)."""
+        model, params = tiny_model
+        eng = _mk_engine(model, params, num_blocks=1 + 3 * 8)
+        free0 = eng.scheduler.allocator.free_count
+        rng = np.random.default_rng(41)
+        trace = _mk_trace(rng, 12, qps=60.0)
+        cancel_at = {int(r): int(rng.integers(1, 10))
+                     for r in rng.choice(12, size=4, replace=False)}
+        i = 0
+        while i < len(trace) or eng.scheduler.has_work():
+            now = eng.clock.now()
+            while i < len(trace) and trace[i][0] <= now:
+                eng.submit(arrival_s=trace[i][0], **trace[i][1])
+                i += 1
+            for rid, it in list(cancel_at.items()):
+                if eng.iterations >= it:
+                    eng.cancel(rid)
+                    del cancel_at[rid]
+            if eng.scheduler.has_work():
+                eng.step()
+            elif i < len(trace):
+                eng.clock.advance_to(trace[i][0])
+        assert eng.scheduler.allocator.free_count == free0
+        s = eng.summary()
+        assert s["cancelled"] >= 1
+        assert s["completed"] + s["cancelled"] == 12
+
+    def test_client_drop_chaos_frees_blocks(self, tiny_model):
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        plan = FaultPlan.parse("client_drop@3", process_index=0)
+        eng = _mk_engine(model, params, chaos=plan, num_slots=2)
+        rng = np.random.default_rng(7)
+        res = eng.run([(0.0, dict(rid=i,
+                                  prompt=rng.integers(0, 128, (5,))
+                                  .astype(np.int32),
+                                  max_new_tokens=12))
+                       for i in range(2)])
+        statuses = sorted(r.status for r in res.values())
+        assert statuses == ["cancelled", "completed"]
+        assert res[0].status == "cancelled"       # oldest active dropped
+        assert eng.scheduler.allocator.free_count == \
+            eng.scheduler.allocator.num_blocks - 1
+
+    def test_kv_poison_evicts_only_the_victim(self, tiny_model):
+        """HBM corruption of one request's blocks: the decode step's
+        finite-logits flag must catch it, the engine evicts exactly the
+        victim (status failed, blocks freed), and every other request
+        completes with untouched tokens."""
+        import jax.numpy as jnp
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 128, (5,)).astype(np.int32)
+                   for _ in range(3)]
+        # reference tokens for the survivors
+        refs = [np.asarray(model.generate(
+            params, jnp.asarray(p)[None], 10,
+            temperature=0.0))[0, 5:].tolist() for p in prompts]
+        plan = FaultPlan.parse("kv_poison@4", process_index=0)
+        eng = _mk_engine(model, params, chaos=plan)
+        res = eng.run([(0.0, dict(rid=i, prompt=p, max_new_tokens=10))
+                       for i, p in enumerate(prompts)])
+        assert res[0].status == "failed"          # the oldest = victim
+        for i in (1, 2):
+            assert res[i].status == "completed"
+            assert res[i].tokens == refs[i], f"survivor {i} corrupted"
+        assert eng.scheduler.allocator.free_count == \
+            eng.scheduler.allocator.num_blocks - 1
+        # the poisoned blocks were SCRUBBED before returning to the
+        # free list: a post-poison churn that recycles every block
+        # (lowest-id-first reuses the victim's) must complete cleanly —
+        # unscrubbed NaN rows would evict innocent requests forever
+        res2 = eng.run([(0.0, dict(rid=10 + i, prompt=p,
+                                   max_new_tokens=10))
+                        for i, p in enumerate(prompts * 2)])
+        assert all(r.status == "completed" for r in res2.values()
+                   if r.rid >= 10), {r.rid: r.status
+                                     for r in res2.values()}
+        assert res2[10].tokens == refs[0]         # victim's prompt, clean
+
+    def test_slow_decode_chaos_inflates_measured_latency(self, tiny_model):
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        rng = np.random.default_rng(13)
+        trace = _mk_trace(rng, 4, qps=100.0)
+
+        def run(chaos):
+            plan = (FaultPlan.parse(chaos, process_index=0)
+                    if chaos else None)
+            eng = _mk_engine(model, params, chaos=plan)
+            eng.run([(t, dict(kw)) for t, kw in trace])
+            return eng.summary(slo_ttft_ms=1e9)
+
+        base = run(None)
+        slow = run("slow_decode@2:100ms")
+        assert slow["ttft_ms_p99"] > base["ttft_ms_p99"] + 50.0
+        assert slow["completed"] == base["completed"] == 4
+
+
+# ---------------------------------------------------------------------------
+# brownout end-to-end: the overload A/B gates (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadGates:
+    def test_chaos_ab_controller_wins_under_spike(self, tiny_model):
+        """The acceptance gates, in-process on the virtual clock: zero
+        deadline violations in the controller arm, sheds booked with
+        reasons, controller strictly improves goodput-QPS on the same
+        trace under the same persistent decode-rate spike."""
+        import argparse
+        from dtf_tpu.bench.serve_load import chaos_ab
+        model, params = tiny_model
+        ns = argparse.Namespace(
+            clock="virtual", seed=0, slots=4, block_size=16,
+            pool_blocks=None, max_queue=256, top_k=0, top_p=1.0,
+            temperature=0.0, requests=60, qps_list=[10.0],
+            prompt_lens_list=[4, 8, 16], output_lens_list=[2, 8, 16],
+            slo_ttft_ms=400.0, deadline_ms=2500.0,
+            priorities_list=[0, 0, 1], degrade_max_new=8,
+            chaos="slow_decode@30:60ms")
+        out = chaos_ab(model, params, ns)
+        assert out["ok"], out["gates"]
+        on, off = out["controller"], out["no_controller"]
+        assert on["deadline_violations"] == 0
+        assert on["shed"] > 0 and on["shed_reasons"]
+        assert on["goodput_qps"] > off["goodput_qps"]
+        # the brownout actually engaged and is observable
+        assert on["brownout"]["transitions"] >= 1
+
+    def test_degrade_level_clamps_max_new(self, tiny_model):
+        model, params = tiny_model
+        bo = BrownoutController(100.0, degrade_max_new=3)
+        bo.level = 1
+        eng = _mk_engine(model, params, brownout=bo)
+        r = eng.submit(np.arange(4), 20)
+        assert r.max_new_tokens == 3 and r.degraded
+        eng.run([])
+        assert eng.summary()["degraded"] == 1
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + replay
+# ---------------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_drain_finishes_inflight_and_checkpoints_queue(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params, num_slots=2)
+        rng = np.random.default_rng(17)
+        trace = _mk_trace(rng, 6, qps=200.0)
+        real_step = eng.step
+
+        def step():
+            if eng.iterations == 3:
+                eng.request_drain()
+            return real_step()
+
+        eng.step = step
+        eng.run(trace)
+        assert eng.drained
+        s = eng.summary()
+        # nothing accepted was lost: every request is completed or in
+        # the drain docs (none merely vanished)
+        drained_rids = {d["rid"] for d in eng.drain_docs}
+        completed = {rid for rid, r in eng.results.items()
+                     if r.status == "completed"}
+        accepted = completed | drained_rids
+        assert s["drained_unfinished"] == len(drained_rids) > 0
+        assert all(r.status in ("completed", "drained")
+                   for r in eng.results.values())
+        assert accepted == set(range(len(eng.results)))
+        # blocks all came home
+        assert eng.scheduler.allocator.free_count == \
+            eng.scheduler.allocator.num_blocks - 1
+
+    def test_drain_replay_is_token_identical(self, tiny_model):
+        """ACCEPTANCE PIN: replaying a drain's checkpointed requests in
+        a fresh engine yields the SAME tokens an uninterrupted run
+        produces — the PR 7 determinism guarantee extended across
+        preemption."""
+        model, params = tiny_model
+        rng = np.random.default_rng(19)
+        trace = _mk_trace(rng, 6, qps=150.0, temperature=1.0)
+
+        ref_eng = _mk_engine(model, params, seed=5)
+        refs = ref_eng.run([(0.0, dict(kw)) for _, kw in trace])
+
+        eng = _mk_engine(model, params, seed=5)
+        real_step = eng.step
+
+        def step():
+            if eng.iterations == 4:
+                eng.request_drain()
+            return real_step()
+
+        eng.step = step
+        eng.run(trace)
+        assert eng.drain_docs, "nothing was drained — no preemption?"
+        replay_eng = _mk_engine(model, params, seed=5)
+        replayed = replay_eng.run(
+            [(0.0, {**d, "prompt": np.asarray(d["prompt"], np.int32)})
+             for d in eng.drain_docs])
+        for doc in eng.drain_docs:
+            rid = doc["rid"]
+            assert replayed[rid].tokens == refs[rid].tokens, rid
+
+    def test_submit_rejected_while_draining(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params)
+        eng.scheduler.draining = True
+        r = eng.submit(np.arange(4), 4)
+        assert r.status == "rejected"
+
+    def test_drain_timeout_checkpoints_inflight(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params)
+        eng.submit(np.arange(6), 24)    # in-window, can't finish in 0s
+        eng.step()                      # prefill + first decode
+        out = eng.drain(timeout_s=0.0)
+        assert out["timed_out"]
+        assert [d["rid"] for d in out["unfinished"]] == [0]
+        assert eng.scheduler.allocator.free_count == \
+            eng.scheduler.allocator.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# serving gates in report.check_gates (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestServingGates:
+    def _report(self, **serving):
+        return {"telemetry": {"serving": serving}}
+
+    def test_serving_gates_pass_and_fail(self):
+        from dtf_tpu.telemetry.report import check_gates
+        rep = self._report(goodput_qps=5.0, ttft_ms_p99=300.0)
+        ok, lines = check_gates(rep, min_goodput_qps=2.0,
+                                max_ttft_p99_ms=400.0)
+        assert ok and len(lines) == 2
+        ok, lines = check_gates(rep, min_goodput_qps=9.0)
+        assert not ok
+        ok, lines = check_gates(rep, max_ttft_p99_ms=100.0)
+        assert not ok
+
+    def test_missing_serving_section_fails_armed_gates(self):
+        from dtf_tpu.telemetry.report import check_gates
+        ok, lines = check_gates({}, min_goodput_qps=1.0)
+        assert not ok and "not measured" in lines[0]
+
+    def test_serve_spec_validation(self):
+        from dtf_tpu.scenarios.spec import Gate, ScenarioSpec
+        with pytest.raises(ValueError, match="goodput-QPS floor"):
+            ScenarioSpec(name="s", workload="serve",
+                         gate=Gate(max_final_cost=None, min_goodput=0.1))
+        with pytest.raises(ValueError, match="no loss curve"):
+            ScenarioSpec(name="s", workload="serve",
+                         gate=Gate(max_final_cost=1.0, min_goodput=0.1,
+                                   min_goodput_qps=1.0))
+        with pytest.raises(ValueError, match="convergence target"):
+            ScenarioSpec(name="t", workload="mnist",
+                         gate=Gate(max_final_cost=None, min_goodput=0.1))
+        # the real serve cell round-trips through JSON like any other
+        spec = ScenarioSpec(
+            name="ok", workload="serve",
+            chaos="slow_decode@10:50ms",
+            gate=Gate(max_final_cost=None, min_goodput=0.05,
+                      min_goodput_qps=1.0, max_ttft_p99_ms=900.0))
+        assert ScenarioSpec.from_json(spec.to_json()) == spec
+        assert "min_goodput_qps" in spec.gate.thresholds()
+
+
+# ---------------------------------------------------------------------------
+# TCP front end — protocol units (fast) + socket end-to-end (slow)
+# ---------------------------------------------------------------------------
+
+
+class TestFrontendProtocol:
+    def test_parse_listen(self):
+        from dtf_tpu.serve.frontend import parse_listen
+        assert parse_listen(":8100") == ("127.0.0.1", 8100)
+        assert parse_listen("0.0.0.0:9") == ("0.0.0.0", 9)
+        for bad in ("8100", "host:", "host:abc"):
+            with pytest.raises(ValueError):
+                parse_listen(bad)
+
+    def test_parse_request_line_valid(self):
+        from dtf_tpu.serve.frontend import parse_request_line
+        kw = parse_request_line(json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 4,
+             "deadline_ms": 100, "priority": 1,
+             "temperature": 0.5}).encode())
+        assert kw["max_new_tokens"] == 4 and kw["priority"] == 1
+        assert kw["deadline_ms"] == 100
+        np.testing.assert_array_equal(kw["prompt"], [1, 2, 3])
+
+    @pytest.mark.parametrize("line", [
+        b"not json at all",
+        b'"just a string"',
+        b'{"max_new_tokens": 4}',                      # no prompt
+        b'{"prompt": []}',                             # empty prompt
+        b'{"prompt": ["a", "b"]}',                     # non-int tokens
+        b'{"prompt": [1], "max_new_tokens": 0}',
+        b'{"prompt": [1], "deadline_ms": -5}',
+        b'{"prompt": [1], "priority": "high"}',
+        b'{"prompt": [1], "temperature": -1}',
+    ])
+    def test_parse_request_line_rejects_garbage(self, line):
+        from dtf_tpu.serve.frontend import parse_request_line
+        with pytest.raises(ValueError):
+            parse_request_line(line)
+
+
+def _client(addr, lines, read_until_done=True, keep_open=False):
+    """Tiny line-protocol client: send request lines, collect response
+    docs until the terminal status line."""
+    out = []
+    sock = socket.create_connection(addr, timeout=30.0)
+    try:
+        f = sock.makefile("rwb")
+        for line in lines:
+            f.write(line.encode() + b"\n")
+            f.flush()
+            while read_until_done:
+                resp = f.readline()
+                if not resp:
+                    return out
+                doc = json.loads(resp)
+                out.append(doc)
+                if "error" in doc or "status" in doc:
+                    break
+    finally:
+        if not keep_open:
+            sock.close()
+    return out
+
+
+@pytest.mark.slow
+class TestTCPFrontend:
+    """Socket end-to-end (slow marker: stays out of the tier-1 budget;
+    the full-suite serve-chaos lane runs these via `pytest -m "serve
+    and slow"`)."""
+
+    def _serve(self, model, params, drain_timeout_s=30.0, **kw):
+        from dtf_tpu.serve import WallClock
+        from dtf_tpu.serve.frontend import TCPFrontend
+        # wide window (the tiny preset's max_len 64) so the long-stream
+        # tests can keep a request in flight while the client misbehaves
+        kw.setdefault("blocks_per_slot", 16)
+        eng = _mk_engine(model, params, clock=WallClock(), **kw)
+        fe = TCPFrontend(eng, "127.0.0.1", 0, conn_timeout_s=5.0)
+        thread = threading.Thread(
+            target=fe.run_loop, kwargs={"drain_timeout_s": drain_timeout_s},
+            daemon=True)
+        thread.start()
+        return eng, fe, thread
+
+    def test_request_streams_reference_tokens(self, tiny_model):
+        import jax.numpy as jnp
+        model, params = tiny_model
+        rng = np.random.default_rng(23)
+        prompt = rng.integers(0, 128, (5,)).astype(np.int32)
+        ref = np.asarray(model.generate(
+            params, jnp.asarray(prompt)[None], 6,
+            temperature=0.0))[0, 5:].tolist()
+        eng, fe, thread = self._serve(model, params)
+        try:
+            docs = _client(fe.address, [json.dumps(
+                {"prompt": prompt.tolist(), "max_new_tokens": 6})])
+            tokens = [d["token"] for d in docs if "token" in d]
+            assert tokens == ref
+            assert docs[-1]["status"] == "completed"
+            assert docs[-1]["n_tokens"] == 6
+        finally:
+            fe.shutdown()
+            thread.join(timeout=10)
+
+    def test_malformed_request_gets_error_line(self, tiny_model):
+        model, params = tiny_model
+        eng, fe, thread = self._serve(model, params)
+        try:
+            docs = _client(fe.address, ['{"prompt": "garbage"}'])
+            assert "error" in docs[0]
+            # the server survives: a good request still works
+            docs2 = _client(fe.address, [json.dumps(
+                {"prompt": [1, 2], "max_new_tokens": 2})])
+            assert docs2[-1]["status"] == "completed"
+        finally:
+            fe.shutdown()
+            thread.join(timeout=10)
+
+    def test_disconnect_mid_stream_frees_blocks(self, tiny_model):
+        import time as _time
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        # slow the engine (50ms/iteration via the chaos hook — the wall
+        # clock really sleeps) so the 56-token stream is still in
+        # flight when the client vanishes
+        eng, fe, thread = self._serve(
+            model, params,
+            chaos=FaultPlan.parse("slow_decode@1:50ms", process_index=0))
+        free0 = eng.scheduler.allocator.num_blocks - 1
+        try:
+            sock = socket.create_connection(fe.address, timeout=10.0)
+            f = sock.makefile("rwb")
+            f.write((json.dumps({"prompt": [3, 1, 4],
+                                 "max_new_tokens": 56}) + "\n").encode())
+            f.flush()
+            first = json.loads(f.readline())
+            assert "token" in first
+            sock.close()                  # vanish mid-stream
+            deadline = _time.monotonic() + 20.0
+            while _time.monotonic() < deadline:
+                if (eng.scheduler.allocator.free_count == free0
+                        and eng.scheduler.num_active() == 0):
+                    break
+                _time.sleep(0.05)
+            assert eng.scheduler.allocator.free_count == free0, \
+                "disconnect leaked KV blocks"
+            # the bridge's per-request stream map must not leak either
+            # (cancel emits a terminal event that pops the entry)
+            assert not fe.bridge._streams, \
+                "stream map leaked after disconnect"
+        finally:
+            fe.shutdown()
+            thread.join(timeout=10)
+
+    def test_sigterm_drain_tells_waiting_clients(self, tiny_model):
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        eng, fe, thread = self._serve(
+            model, params, drain_timeout_s=0.5,
+            chaos=FaultPlan.parse("slow_decode@1:50ms", process_index=0))
+        try:
+            sock = socket.create_connection(fe.address, timeout=10.0)
+            f = sock.makefile("rwb")
+            f.write((json.dumps({"prompt": [3, 1, 4],
+                                 "max_new_tokens": 56}) + "\n").encode())
+            f.flush()
+            assert "token" in json.loads(f.readline())
+            eng.request_drain()           # what SIGTERM does
+            docs = []
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                doc = json.loads(line)
+                docs.append(doc)
+                if "status" in doc:
+                    break
+            # ~2.8s of stream cannot finish inside the 0.5s grace: the
+            # engine checkpoints it and the client hears "drained"
+            assert docs and docs[-1].get("status") == "drained"
+            thread.join(timeout=30)
+            assert not thread.is_alive()
+            assert [d["rid"] for d in eng.drain_docs] == [0]
+            sock.close()
+        finally:
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve CLI: --drain_at + supervisor replay (slow, like TestServeCLI)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDrainCLI:
+    def test_drain_at_with_restart_budget_replays_everything(
+            self, tmp_path, capsys):
+        from dtf_tpu.serve.__main__ import main
+        tokens_a = tmp_path / "drained.json"
+        rc = main(["--preset", "tiny", "--demo", "6", "--qps", "50",
+                   "--clock", "virtual", "--seed", "3",
+                   "--drain_at", "3", "--max_restarts", "1",
+                   "--logdir", str(tmp_path / "run"),
+                   "--tokens_out", str(tokens_a)])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["completed_all_attempts"] == 6
+        # the supervisor replay completed everything, so the drain
+        # hand-off file must be GONE — a stale drain.jsonl would tell
+        # the operator to re-serve requests that already completed
+        drain_file = tmp_path / "run" / "drain.jsonl"
+        assert not drain_file.exists()
+        # ACCEPTANCE: token-identical to an uninterrupted run
+        tokens_b = tmp_path / "clean.json"
+        rc = main(["--preset", "tiny", "--demo", "6", "--qps", "50",
+                   "--clock", "virtual", "--seed", "3",
+                   "--tokens_out", str(tokens_b)])
+        assert rc == 0
+        capsys.readouterr()
+        assert json.loads(tokens_a.read_text()) == \
+            json.loads(tokens_b.read_text())
+
+    def test_drain_at_without_budget_exits_clean_with_handoff(
+            self, tmp_path, capsys):
+        """--max_restarts 0: the drain file is the hand-off; the exit
+        is clean (nothing accepted was LOST — it is checkpointed)."""
+        from dtf_tpu.serve.__main__ import main
+        rc = main(["--preset", "tiny", "--demo", "6", "--qps", "500",
+                   "--clock", "virtual", "--seed", "3",
+                   "--drain_at", "3", "--max_restarts", "0",
+                   "--logdir", str(tmp_path / "run")])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["drained_unfinished"] > 0
+        drain_file = tmp_path / "run" / "drain.jsonl"
+        docs = [json.loads(x) for x in
+                drain_file.read_text().splitlines()]
+        assert len(docs) == summary["drained_unfinished"]
+        # the hand-off replays through --requests and completes
+        rc = main(["--preset", "tiny", "--requests", str(drain_file),
+                   "--clock", "virtual", "--seed", "3"])
+        assert rc == 0
+        replay = json.loads(capsys.readouterr().out)
+        assert replay["completed"] == len(docs)
