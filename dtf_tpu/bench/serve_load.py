@@ -133,17 +133,22 @@ def run_chaos_point(model, params, *, controller: bool, ns) -> Dict:
     from dtf_tpu.resilience.chaos import FaultPlan
     from dtf_tpu.serve import (BrownoutController, ServingEngine,
                                VirtualClock, WallClock)
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
 
     clock = VirtualClock() if ns.clock == "virtual" else WallClock()
     brownout = (BrownoutController(ns.slo_ttft_ms,
                                    degrade_max_new=ns.degrade_max_new)
                 if controller else None)
     chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    # burn-rate monitor in BOTH arms (it is passive): the controller arm
+    # additionally gates alert-leads-control — the fast-burn alert must
+    # fire before brownout walks to reject_all under the same spike
+    slo = BurnRateMonitor.for_serving(ns.slo_ttft_ms)
     engine = ServingEngine(
         model, params, num_slots=ns.slots, block_size=ns.block_size,
         num_blocks=ns.pool_blocks, mode="continuous", seed=ns.seed,
         clock=clock, max_queue=ns.max_queue, top_k=ns.top_k,
-        top_p=ns.top_p, brownout=brownout, chaos=chaos)
+        top_p=ns.top_p, brownout=brownout, chaos=chaos, slo=slo)
     trace = poisson_trace(
         seed=ns.seed, n_requests=ns.requests, qps=ns.qps_list[0],
         prompt_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
@@ -168,7 +173,12 @@ def chaos_gates(on: Dict, off: Dict) -> Tuple[bool, List[str]]:
     * **sheds are booked with reasons** — load was actually dropped at
       the front door, observably;
     * **the controller strictly improves goodput QPS** on the same
-      trace under the same injected spike — brownout pays for itself.
+      trace under the same injected spike — brownout pays for itself;
+    * **alert leads control** (ISSUE 11) — the SLO monitor's fast-burn
+      alert fires STRICTLY before the brownout controller escalates to
+      ``reject_all`` on the same trace: the operator's pager rings
+      while the system is still degrading gracefully, not after it has
+      already slammed the front door.
     """
     lines: List[str] = []
     ok = True
@@ -195,6 +205,18 @@ def chaos_gates(on: Dict, off: Dict) -> Tuple[bool, List[str]]:
     gate("controller_improves_goodput", g_on > g_off,
          f"goodput {g_on:.3f} qps with controller vs {g_off:.3f} "
          f"without (same trace, same spike)")
+    # alert-leads-control: compare iteration marks on the SAME run (the
+    # controller arm) — both events must exist under the pinned spike,
+    # and the alert must be strictly earlier.
+    slo = on.get("slo", {})
+    first = (slo.get("objectives", {}).get("ttft", {})
+             .get("first_alert", {}).get("fast"))
+    alert_it = None if first is None else first.get("iteration")
+    ra_it = on.get("brownout", {}).get("reject_all_iteration")
+    gate("alert_leads_control",
+         alert_it is not None and ra_it is not None and alert_it < ra_it,
+         f"fast-burn alert at iteration {alert_it} vs brownout "
+         f"reject_all at iteration {ra_it} (alert must exist and lead)")
     return ok, lines
 
 

@@ -272,6 +272,12 @@ class TrainConfig:
     # <logdir>/telemetry.json, goodput accounting.  --no-telemetry turns
     # the on-disk artifacts off (the in-process registry still runs).
     telemetry: bool = True
+    # Live introspection endpoint (telemetry/live.py): mount
+    # /statz /healthz /tracez /slo on 127.0.0.1:admin_port for the whole
+    # process life (supervisor restarts rebind onto the same server; 0 =
+    # ephemeral port).  None disables.  Long training runs get the same
+    # live window as the serving CLI's --admin_port.
+    admin_port: Optional[int] = None
     # Attempt tag for metrics.csv rows (telemetry/report de-duplicates
     # overlapping step ranges by latest attempt).  0 = automatic: any
     # resumed run — in-process supervisor restart or --resume relaunch —

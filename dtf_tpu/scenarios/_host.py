@@ -49,11 +49,13 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     ``slo_ttft_ms`` / ``deadline_ms`` / ``slots``."""
     import jax
 
+    from dtf_tpu import telemetry as tel
     from dtf_tpu.bench.serve_load import poisson_trace
     from dtf_tpu.models.gpt import GPT, GPTConfig
     from dtf_tpu.resilience.chaos import FaultPlan
     from dtf_tpu.serve import (BrownoutController, ServingEngine,
                                VirtualClock)
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
 
     ex = spec.extra_dict
     qps = float(ex.get("qps", 10.0))
@@ -62,6 +64,11 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     deadline_ms = float(ex.get("deadline_ms", 2500.0))
     slots = int(ex.get("slots", 4))
 
+    # span tracer into the judged logdir: the cell's
+    # min_trace_complete_frac gate reads the per-request trace chains
+    # back off these files (runner judges out-of-band, from disk)
+    os.makedirs(logdir, exist_ok=True)
+    tel.configure(logdir)
     cfg = GPTConfig.tiny()
     model = GPT(cfg)
     params = model.init(jax.random.key(spec.seed))
@@ -69,15 +76,16 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     engine = ServingEngine(
         model, params, num_slots=slots, seed=spec.seed,
         clock=VirtualClock(), max_queue=256,
-        brownout=BrownoutController(slo_ttft_ms), chaos=plan)
+        brownout=BrownoutController(slo_ttft_ms), chaos=plan,
+        slo=BurnRateMonitor.for_serving(slo_ttft_ms))
     trace = poisson_trace(
         seed=spec.seed, n_requests=n_requests, qps=qps,
         prompt_lens=[4, 8, 16], output_lens=[2, 8, 16],
         vocab_size=cfg.vocab_size, deadline_ms=deadline_ms,
         priorities=[0, 0, 1])
     engine.run(trace)
-    os.makedirs(logdir, exist_ok=True)
     engine.write_telemetry(logdir, slo_ttft_ms=slo_ttft_ms)
+    tel.get_tracer().flush()
     s = engine.summary(slo_ttft_ms=slo_ttft_ms)
     print(f"SCENARIO_DONE completed={s['completed']} shed={s['shed']} "
           f"goodput_qps={s.get('goodput_qps', 0.0):.3f} "

@@ -178,4 +178,6 @@ def _measured(report: dict) -> dict:
         "ttft_ms_p99": serving.get("ttft_ms_p99"),
         "shed": serving.get("shed"),
         "deadline_violations": serving.get("deadline_violations"),
+        "trace_complete_frac": report.get("request_traces", {})
+        .get("complete_frac"),
     }

@@ -63,6 +63,12 @@ class Gate:
     max_rollbacks: Optional[int] = None
     min_goodput_qps: float = 0.0
     max_ttft_p99_ms: float = 0.0
+    #: Observability gate (ISSUE 11): floor on the fraction of COMPLETED
+    #: requests whose per-request trace reconstructs the full
+    #: admission->prefill->first_token->completion chain from the span
+    #: files (0 = not armed; serve cells arm it so recovery is not just
+    #: achieved but attributable).
+    min_trace_complete_frac: float = 0.0
 
     def thresholds(self) -> dict:
         """Kwargs for :func:`dtf_tpu.telemetry.report.check_gates` — the
@@ -82,6 +88,8 @@ class Gate:
             out["min_goodput_qps"] = self.min_goodput_qps
         if self.max_ttft_p99_ms > 0:
             out["max_ttft_p99_ms"] = self.max_ttft_p99_ms
+        if self.min_trace_complete_frac > 0:
+            out["min_trace_complete_frac"] = self.min_trace_complete_frac
         return out
 
 
@@ -299,14 +307,19 @@ def default_matrix() -> List[ScenarioSpec]:
             # measured: 30 completed / 28 shed (20 brownout_admissions
             # + 8 low-priority) / 1 client drop / 1 kv eviction,
             # goodput 7.14 qps, ttft p99 519 ms, 0 deadline violations,
-            # goodput fraction 0.08 (compile-dominated child)
+            # goodput fraction 0.08 (compile-dominated child).
+            # Observability gate (ISSUE 11): >= 99% of completed
+            # requests must leave a gap-free admission->completion
+            # trace chain in the span files, chaos notwithstanding
+            # (measured 1.0 — every completion fully attributed).
             name="serve_overload_brownout", workload="serve", devices=1,
             chaos="slow_decode@30:60ms,client_drop@10,kv_poison@20",
             max_restarts=0,
             extra=(("deadline_ms", 2500.0), ("qps", 10.0),
                    ("requests", 60), ("slo_ttft_ms", 400.0)),
             gate=Gate(max_final_cost=None, min_goodput=0.02,
-                      min_goodput_qps=3.5, max_ttft_p99_ms=1200.0)),
+                      min_goodput_qps=3.5, max_ttft_p99_ms=1200.0,
+                      min_trace_complete_frac=0.99)),
         ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike

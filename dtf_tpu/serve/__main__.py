@@ -70,6 +70,12 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
                                                  ns.temperature)),
                     "deadline_ms": doc.get("deadline_ms"),
                     "priority": int(doc.get("priority", 0)),
+                    # a drain.jsonl replay carries the ORIGINAL trace id
+                    # (and an explicit resubmit mark) so the replayed
+                    # request links to its pre-SIGTERM timeline
+                    # (reqtrace continuity)
+                    "trace_id": doc.get("trace_id"),
+                    "resubmit": bool(doc.get("resubmit")),
                 }))
         trace.sort(key=lambda e: e[0])
         return trace
@@ -109,17 +115,47 @@ def _write_drain_file(engine, logdir: str) -> Optional[str]:
 
 def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
     from dtf_tpu.serve import BrownoutController, ServingEngine
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
 
     brownout = None
     if ns.brownout:
         brownout = BrownoutController(
             ns.slo_ttft_ms, degrade_max_new=ns.degrade_max_new)
-    return ServingEngine(
+    # SLO burn-rate monitor: always armed (passive — it observes and
+    # alerts, never admits or sheds); surfaced on /slo and in summary()
+    slo = BurnRateMonitor.for_serving(ns.slo_ttft_ms)
+    probe = None
+    if ns.admin_port is not None:
+        from dtf_tpu.telemetry.live import LivenessProbe
+        probe = LivenessProbe()
+        inner_hb = heartbeat
+
+        def heartbeat(count, _inner=inner_hb, _probe=probe):
+            _probe.beat(count)
+            if _inner is not None:
+                _inner(count)
+
+    engine = ServingEngine(
         model, params, num_slots=ns.slots, block_size=ns.block_size,
         num_blocks=ns.pool_blocks, mode=ns.mode, top_k=ns.top_k,
         top_p=ns.top_p, eos_id=ns.eos_id, seed=ns.seed, clock=clock,
         max_queue=ns.max_queue, aging_s=ns.aging_s, on_token=printer,
-        heartbeat=heartbeat, brownout=brownout, chaos=chaos)
+        heartbeat=heartbeat, brownout=brownout, chaos=chaos, slo=slo)
+    if ns.admin_port is not None:
+        # one admin window per process; a supervisor's next attempt
+        # rebinds the fresh engine's ring + monitor onto the same server
+        from dtf_tpu.telemetry.live import (get_admin, health_file_fn,
+                                            start_admin)
+        fresh = get_admin() is None
+        admin = start_admin(
+            ns.admin_port, probe=probe,
+            trace_ring=engine.reqtrace.ring, slo=slo,
+            health_fn=(health_file_fn(ns.health_dir) if ns.health_dir
+                       else None))
+        if fresh:
+            print(f"admin endpoint on http://127.0.0.1:{admin.port} "
+                  f"(/statz /healthz /tracez /slo)", flush=True)
+    return engine
 
 
 def serve_session(ns, model, params, trace,
@@ -140,6 +176,18 @@ def serve_session(ns, model, params, trace,
     from dtf_tpu.serve import VirtualClock, WallClock
 
     completed: Dict[int, object] = {}
+    #: rid -> trace id seen on any previous attempt: the supervisor's
+    #: in-process replay re-submits under the SAME trace id, so the
+    #: replayed request's timeline links to its pre-crash/pre-drain
+    #: events (reqtrace continuity, mirrored by drain.jsonl for the
+    #: cross-process hand-off).
+    trace_ids: Dict[int, str] = {}
+    #: rids a previous attempt ACCEPTED (anything past the front door:
+    #: queued/running at the crash, drained, cancelled, failed).  Only
+    #: these replay with resubmit=True — a shed/rejected request's retry
+    #: keeps its trace id for continuity but is a fresh submission, not
+    #: a replay (Request.resubmit's documented invariant).
+    accepted_ids: set = set()
     current: Dict[str, object] = (drain_target if drain_target is not None
                                   else {})
     chaos = None
@@ -187,14 +235,33 @@ def serve_session(ns, model, params, trace,
                 return real_step2()
 
             engine.step = draining_step
-        pending = [(0.0 if attempt else t, kw) for t, kw in trace
-                   if kw["rid"] not in completed]
+        pending = []
+        for t, kw in trace:
+            if kw["rid"] in completed:
+                continue
+            if attempt:
+                # replay: same trace id as the previous attempt; the
+                # resubmit mark ONLY when that attempt accepted it
+                kw = {**kw,
+                      "trace_id": (kw.get("trace_id")
+                                   or trace_ids.get(kw["rid"])),
+                      "resubmit": kw.get("resubmit", False)
+                      or kw["rid"] in accepted_ids}
+                t = 0.0
+            pending.append((t, kw))
         try:
             engine.run(pending, drain_timeout_s=ns.drain_timeout_s)
         finally:
             completed.update(
                 {rid: r for rid, r in engine.results.items()
                  if r.status == "completed"})
+            for r in (list(engine.results.values())
+                      + list(engine.scheduler.queue)
+                      + engine.scheduler.active()):
+                if r.trace_id:
+                    trace_ids[r.rid] = r.trace_id
+                if r.status not in ("shed", "rejected"):
+                    accepted_ids.add(r.rid)
             if ns.logdir:
                 os.makedirs(ns.logdir, exist_ok=True)
                 engine.write_telemetry(ns.logdir,
@@ -323,6 +390,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--drain_timeout_s", type=float, default=30.0,
                    help="graceful-drain grace window (in-flight decodes "
                         "past it are checkpointed, not finished)")
+    p.add_argument("--admin_port", type=int, default=None,
+                   help="mount the live introspection endpoint on "
+                        "127.0.0.1:PORT (/statz /healthz /tracez /slo; "
+                        "0 = ephemeral port, printed at startup)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="run the TCP front end instead of a trace "
                         "(':8100' binds 127.0.0.1:8100; wall clock)")
@@ -339,6 +410,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     if ns.listen and ns.clock == "virtual":
         p.error("--listen serves real clients; it needs --clock wall")
+    if ns.logdir:
+        # span tracer (rotation-bounded): request lifecycle events and
+        # the engine's prefill/decode iteration spans land here, the
+        # inputs of `telemetry.report --request` and the Perfetto export
+        from dtf_tpu import telemetry as tel
+        tel.configure(ns.logdir)
 
     # Install the preemption handler BEFORE the multi-second jax/model
     # init: a SIGTERM that lands mid-init must buffer into a drain of

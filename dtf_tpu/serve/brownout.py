@@ -139,8 +139,22 @@ class BrownoutController:
             return "brownout_low_priority"
         return None
 
+    def first_transition_to(self, level: int) -> Optional[int]:
+        """Iteration of the first transition INTO ``level`` (None if
+        never reached) — the alert-leads-control gate compares the SLO
+        monitor's first fast-burn alert against the first ``reject_all``
+        (level 3) transition."""
+        for iteration, _old, new in self.transitions:
+            if new == level:
+                return iteration
+        return None
+
     def state(self) -> dict:
         return {"level": self.level, "level_name": LEVELS[self.level],
                 "p99_ttft_ewma_ms": round(self._p99_ewma_ms, 3),
                 "slo_ttft_ms": self.slo_ttft_ms,
-                "transitions": len(self.transitions)}
+                "transitions": len(self.transitions),
+                "max_level_reached": max(
+                    [new for _, _, new in self.transitions] or [0]),
+                "reject_all_iteration": self.first_transition_to(
+                    len(LEVELS) - 1)}

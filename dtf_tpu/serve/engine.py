@@ -50,6 +50,7 @@ from dtf_tpu import telemetry as tel
 from dtf_tpu.serve import decode as dec
 from dtf_tpu.serve.paged_kv import BlockAllocator, KVPool, blocks_for
 from dtf_tpu.serve.scheduler import Request, Scheduler, WallClock
+from dtf_tpu.telemetry.reqtrace import RequestTracer, mint_trace_id
 
 
 def _request_seed(engine_seed: int, rid: int) -> int:
@@ -75,7 +76,8 @@ class ServingEngine:
                  aging_s: float = 2.0,
                  on_token: Optional[Callable] = None,
                  heartbeat: Optional[Callable[[int], None]] = None,
-                 brownout=None, chaos=None):
+                 brownout=None, chaos=None, slo=None,
+                 trace_ring_capacity: int = 64):
         t_init = time.perf_counter()
         # Close any open supervisor down-window into the restart bucket
         # (run_supervised marks down at the crash; construction of the
@@ -112,6 +114,15 @@ class ServingEngine:
         #: Serving chaos plan (resilience/chaos.py slow_decode /
         #: client_drop / kv_poison, keyed on the engine iteration).
         self.chaos = chaos
+        #: SLO burn-rate monitor (telemetry/slo.py BurnRateMonitor);
+        #: None = not armed.  Passive: it observes completions and
+        #: raises alerts, it never touches admission.
+        self.slo = slo
+        #: Per-request distributed tracing (telemetry/reqtrace.py):
+        #: lifecycle events into the span file + the /tracez flight
+        #: recorder.  Always on — events are cheap and the ring is
+        #: bounded.
+        self.reqtrace = RequestTracer(trace_ring_capacity)
         self.mode = mode
         self.top_k = top_k
         self.top_p = top_p
@@ -155,7 +166,9 @@ class ServingEngine:
                temperature: float = 0.0, eos_id: Optional[int] = None,
                arrival_s: Optional[float] = None,
                deadline_ms: Optional[float] = None, priority: int = 0,
-               rid: Optional[int] = None) -> Request:
+               rid: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               resubmit: bool = False) -> Request:
         """Admission-controlled submit.  Returns the Request; check
         ``.status`` — ``rejected`` means the queue pushed back (the
         closed-loop client's backpressure signal), ``shed`` means
@@ -170,21 +183,37 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_id=self.eos_id if eos_id is None else eos_id,
-                      deadline_ms=deadline_ms, priority=int(priority))
+                      deadline_ms=deadline_ms, priority=int(priority),
+                      trace_id=trace_id, resubmit=bool(resubmit))
         now = self.clock.now() if arrival_s is None else arrival_s
         self.submit_request(req, now)
         return req
 
     def _book_shed(self, req: Request, reason: str) -> None:
         """ONE booking path for every shed — scheduler deadline sheds
-        (submit-time and admit-time) and brownout sheds alike."""
-        tel.counter("serve/shed_total").inc()
-        tel.counter(f"serve/shed_{reason}").inc()
+        (submit-time and admit-time) and brownout sheds alike.  The
+        total + per-reason pair updates under the registry lock so a
+        concurrent /statz scrape never reads a torn pair."""
+        with tel.get_registry().locked():
+            tel.counter("serve/shed_total").inc()
+            tel.counter(f"serve/shed_{reason}").inc()
         self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
         self.results[req.rid] = req
+        self.reqtrace.event(req, "shed", self.clock.now(), reason=reason)
 
     def submit_request(self, req: Request, now: float) -> str:
         tel.counter("serve/submissions_total").inc()
+        if req.trace_id is None:
+            req.trace_id = mint_trace_id()
+        # the trace's opening event; a supervisor/drain replay re-opens
+        # the SAME trace id with resubmit=True, linking both segments
+        # (the flag is explicit replay provenance — a fresh TCP request
+        # also arrives with a front-door-minted trace id)
+        self.reqtrace.event(req, "submit", now,
+                            prompt_len=req.prompt_len,
+                            max_new=int(req.max_new_tokens),
+                            priority=int(req.priority),
+                            **({"resubmit": True} if req.resubmit else {}))
         if self.brownout is not None:
             # Brownout first: at reject_low/reject_all the submission is
             # shed before it costs a queue entry; at degrade the output
@@ -206,6 +235,7 @@ class ServingEngine:
         if verdict.startswith("rejected"):
             tel.counter("serve/requests_rejected").inc()
             self.results[req.rid] = req
+            self.reqtrace.event(req, "rejected", now, verdict=verdict)
         elif verdict.startswith("shed"):
             pass                    # booked via the on_shed hook already
         return verdict
@@ -240,15 +270,35 @@ class ServingEngine:
         self.scheduler.release(req)
         self._clear_slot(slot)
         self.results[req.rid] = req
-        tel.counter("serve/requests_completed").inc()
         ttft = req.ttft_s()
-        if ttft is not None:
-            tel.histogram("serve/ttft_ms").observe(ttft * 1e3)
-            if self.brownout is not None:
-                self.brownout.observe_ttft(ttft * 1e3)
         tpot = req.tpot_s()
-        if tpot is not None:
-            tel.histogram("serve/tpot_ms").observe(tpot * 1e3)
+        # counter + latency histograms update as ONE group: a /statz
+        # scrape mid-completion must not see the count without its
+        # observation (or vice versa)
+        with tel.get_registry().locked():
+            tel.counter("serve/requests_completed").inc()
+            if ttft is not None:
+                tel.histogram("serve/ttft_ms").observe(ttft * 1e3)
+            if tpot is not None:
+                tel.histogram("serve/tpot_ms").observe(tpot * 1e3)
+        if ttft is not None and self.brownout is not None:
+            self.brownout.observe_ttft(ttft * 1e3)
+        if self.slo is not None:
+            if ttft is not None and self.slo.slo_ttft_ms is not None:
+                self.slo.record("ttft", ttft * 1e3 > self.slo.slo_ttft_ms,
+                                now)
+            if (tpot is not None and self.slo.slo_tpot_ms is not None
+                    and self.slo.has("tpot")):
+                self.slo.record("tpot", tpot * 1e3 > self.slo.slo_tpot_ms,
+                                now)
+            if req.deadline_ms is not None and self.slo.has("deadline"):
+                self.slo.record(
+                    "deadline",
+                    req.completion_s() > req.deadline_ms / 1e3, now)
+        self.reqtrace.event(req, "completed", now,
+                            n_tokens=req.n_generated(),
+                            ttft_ms=(None if ttft is None
+                                     else round(ttft * 1e3, 3)))
 
     def _scrub_blocks(self, blocks) -> None:
         """Zero a request's pool blocks (corruption eviction): bad rows
@@ -271,6 +321,8 @@ class ServingEngine:
         req.done_s = self.clock.now()
         self.results[req.rid] = req
         tel.counter(counter).inc()
+        self.reqtrace.event(req, status, req.done_s, where=where,
+                            n_tokens=req.n_generated())
 
     def cancel(self, rid: int, status: str = "cancelled") -> bool:
         """Client disconnect / caller cancel for a request anywhere in
@@ -300,6 +352,11 @@ class ServingEngine:
         req.tokens.append(int(token))
         if req.first_token_s is None:
             req.first_token_s = now
+            # before the done-check: a one-token request's first_token
+            # must precede its completed event in the timeline
+            self.reqtrace.event(req, "first_token", now,
+                                ttft_ms=round((now - req.arrival_s) * 1e3,
+                                              3))
         req.last_token_s = now
         done = (len(req.tokens) >= req.max_new_tokens
                 or (req.eos_id is not None and int(token) == req.eos_id))
@@ -314,6 +371,11 @@ class ServingEngine:
     def _prefill(self, slot: int, req: Request) -> None:
         import jax.numpy as jnp
 
+        self.reqtrace.event(req, "admitted", self.clock.now(), slot=slot,
+                            iteration=self.iterations,
+                            queue_wait_ms=round(
+                                (self.clock.now() - req.arrival_s) * 1e3,
+                                3))
         p_len = req.prompt_len
         p_pad = req.padded_prompt_len(self.block_size)
         nb_prompt = p_pad // self.block_size
@@ -325,7 +387,8 @@ class ServingEngine:
         seed = _request_seed(self.seed, req.rid)
         c0 = self.clock.now()
         t0 = time.perf_counter()
-        with tel.span("serve/prefill", tokens=p_pad):
+        with tel.span("serve/prefill", tokens=int(p_pad), rid=int(req.rid),
+                      t=round(c0, 6)):
             first, self.pool.k, self.pool.v = fn(
                 self.params, self.pool.k, self.pool.v,
                 jnp.asarray(prompt), jnp.int32(p_len),
@@ -341,6 +404,9 @@ class ServingEngine:
         self.scheduler.observe_prefill(p_pad, self.clock.now() - c0)
         tel.counter("serve/prefill_tokens_total").inc(p_pad)
         self.batch_log.append(("prefill", req.rid))
+        self.reqtrace.event(req, "prefill", self.clock.now(),
+                            tokens=p_pad,
+                            dur_ms=round((self.clock.now() - c0) * 1e3, 3))
 
         req.pos = p_len
         self._table[slot] = -1
@@ -357,7 +423,9 @@ class ServingEngine:
 
         c0 = self.clock.now()
         t0 = time.perf_counter()
-        with tel.span("serve/decode", batch=len(active)):
+        with tel.span("serve/decode", batch=len(active),
+                      rids=sorted(int(r.rid) for r in active),
+                      iteration=self.iterations, t=round(c0, 6)):
             nxt, ok, self.pool.k, self.pool.v = self._decode_fn(
                 self.params, self.pool.k, self.pool.v,
                 jnp.asarray(self._table), jnp.asarray(self._tok),
@@ -448,6 +516,8 @@ class ServingEngine:
                 self.iterations,
                 self.scheduler.oldest_queued_wait_s(self.clock.now()))
             tel.gauge("serve/brownout_level").set(level)
+        if self.slo is not None:
+            self.slo.update(self.clock.now(), self.iterations)
         self.iterations += 1
         if self.heartbeat is not None:
             self.heartbeat(self.iterations)
@@ -590,6 +660,8 @@ class ServingEngine:
                    1 for e in self.batch_log if e[0] == "decode")}
         if self.brownout is not None:
             out["brownout"] = self.brownout.state()
+        if self.slo is not None:
+            out["slo"] = self.slo.state()
         # Deadline accounting over ADMITTED-and-completed requests: a
         # violation is a completion later than (deadline + the SLO TTFT
         # budget) — the grace the SLO already tolerates at the front

@@ -97,10 +97,20 @@ def parse_request_line(line: bytes) -> dict:
     temperature = doc.get("temperature", 0.0)
     if not isinstance(temperature, (int, float)) or temperature < 0:
         raise ValueError("'temperature' must be a non-negative number")
+    # Distributed tracing: the trace id is minted HERE, at the network
+    # edge (a client may also carry its own through a retry), so the
+    # request's timeline starts where the operator's responsibility
+    # does.  Echoed on the terminal response line for correlation.
+    trace_id = doc.get("trace_id")
+    if trace_id is not None and (not isinstance(trace_id, str)
+                                 or not (1 <= len(trace_id) <= 64)):
+        raise ValueError("'trace_id' must be a short string")
+    from dtf_tpu.telemetry.reqtrace import mint_trace_id
     return {"prompt": np.asarray(prompt, np.int32),
             "max_new_tokens": max_new,
             "temperature": float(temperature),
-            "deadline_ms": deadline, "priority": priority}
+            "deadline_ms": deadline, "priority": priority,
+            "trace_id": trace_id or mint_trace_id()}
 
 
 class FrontendBridge:
@@ -181,7 +191,8 @@ class TCPFrontend:
             if done:
                 self.bridge.route(req.rid, {
                     "rid": req.rid, "status": req.status,
-                    "n_tokens": req.n_generated(), "terminal": True})
+                    "n_tokens": req.n_generated(),
+                    "trace_id": req.trace_id, "terminal": True})
 
         engine.on_token = on_token
 
@@ -286,7 +297,8 @@ class TCPFrontend:
                     "rid": req.rid, "status": (
                         f"shed_{req.shed_reason}" if req.status == "shed"
                         else req.status),
-                    "reason": req.shed_reason, "terminal": True})
+                    "reason": req.shed_reason,
+                    "trace_id": req.trace_id, "terminal": True})
 
     def run_loop(self, drain_timeout_s: float = 30.0,
                  idle_wait_s: float = 0.02) -> Optional[dict]:

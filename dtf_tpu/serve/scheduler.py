@@ -92,6 +92,15 @@ class Request:
     arrival_s: float = 0.0             # stamped at submit
     deadline_ms: Optional[float] = None  # completion budget from arrival
     priority: int = 0                  # higher = admitted sooner
+    #: Distributed-tracing id (telemetry/reqtrace.py): minted at the TCP
+    #: front end or at submit, carried through drain/replay so a
+    #: replayed request links to its pre-SIGTERM timeline.
+    trace_id: Optional[str] = None
+    #: True only on the drain/supervisor REPLAY of a previously-accepted
+    #: request (stamped on the reqtrace submit event).  Explicit, never
+    #: inferred from trace_id presence — a TCP client's fresh request
+    #: also carries a front-door-minted id.
+    resubmit: bool = False
 
     # runtime state (engine/scheduler owned)
     slot: Optional[int] = None
@@ -154,7 +163,14 @@ class Request:
                 "temperature": float(self.temperature),
                 "eos_id": None if self.eos_id is None else int(self.eos_id),
                 "deadline_ms": self.deadline_ms,
-                "priority": int(self.priority)}
+                "priority": int(self.priority),
+                # continuity: the replay engine re-submits under the SAME
+                # trace id, so --request <rid> shows one timeline across
+                # the SIGTERM boundary instead of a fresh unlinked one;
+                # a doc only exists because this request WAS accepted, so
+                # any submission built from it is by construction a replay
+                "trace_id": self.trace_id,
+                "resubmit": True}
 
 
 # ---------------------------------------------------------------------------

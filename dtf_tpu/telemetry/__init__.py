@@ -13,6 +13,19 @@ Three coordinated pieces, every layer reports into them:
   restart / stall / checkpoint / compile wall-clock, plus the shared
   MFU / tokens-per-sec formulas.
 
+The LIVE plane (DESIGN.md §6.4) rides on top of the same three pieces:
+
+* **Per-request tracing** (:mod:`.reqtrace`) — trace ids minted at the
+  serving front door and propagated through every lifecycle decision,
+  written into the ordinary span files and a bounded in-memory flight
+  recorder;
+* **Admin endpoint** (:mod:`.live`) — ``/statz`` (consistent registry
+  snapshot), ``/healthz``, ``/tracez``, ``/slo`` over stdlib HTTP,
+  mounted by ``--admin_port``;
+* **SLO burn-rate monitor** (:mod:`.slo`) — windowed error-budget
+  accounting with fast+slow burn alerts (the operator's early warning,
+  CI-gated to fire before brownout ``reject_all``).
+
 ``python -m dtf_tpu.telemetry.report <logdir>`` merges all of it (plus
 metrics.csv, health.json, and any XLA trace summary) into one run
 post-mortem.  Instrument and span names are registered in
@@ -44,6 +57,8 @@ __all__ = [
     "get_tracer", "get_tracker", "histogram", "instant", "names",
     "reset", "span", "write_telemetry_json",
 ]
+# live-plane modules are imported lazily by their consumers (reqtrace /
+# live / slo are stdlib-only but not needed at telemetry import time)
 
 
 def write_telemetry_json(logdir: str, extra: Optional[dict] = None) -> str:
@@ -67,3 +82,5 @@ def reset() -> None:
     get_registry().reset()
     get_tracker().reset()
     configure(None)
+    from dtf_tpu.telemetry import live as _live
+    _live.stop_admin()

@@ -33,6 +33,7 @@ sync points and the benchmark driver alike.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
@@ -48,17 +49,25 @@ CATEGORIES = ("productive", "compile", "data", "checkpoint", "rollback",
 
 
 class GoodputTracker:
+    """Thread-safe (one reentrant lock): the trainer/engine thread feeds
+    the buckets while the live ``/statz`` endpoint snapshots them from
+    an admin handler thread — a scrape must see one consistent cut of
+    the books, never a mid-update mix."""
+
     def __init__(self):
+        self._lock = threading.RLock()
         self.reset()
 
     def reset(self) -> None:
-        self.buckets: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
-        # Lazy clock: wall-time starts at the FIRST accounted event (the
-        # trainer's mark_up), not at module import — the books describe
-        # the training run, not the Python process around it.
-        self._t0: Optional[float] = None
-        self._base_wall = 0.0          # carried over from a previous process
-        self._down_since: Optional[float] = None
+        with self._lock:
+            self.buckets: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+            # Lazy clock: wall-time starts at the FIRST accounted event
+            # (the trainer's mark_up), not at module import — the books
+            # describe the training run, not the Python process around
+            # it.
+            self._t0: Optional[float] = None
+            self._base_wall = 0.0      # carried over from a previous process
+            self._down_since: Optional[float] = None
 
     def _start_clock(self) -> None:
         if self._t0 is None:
@@ -70,8 +79,9 @@ class GoodputTracker:
         if category not in self.buckets:
             raise ValueError(f"unknown goodput category {category!r}; "
                              f"one of {CATEGORIES}")
-        self._start_clock()
-        self.buckets[category] += max(float(seconds), 0.0)
+        with self._lock:
+            self._start_clock()
+            self.buckets[category] += max(float(seconds), 0.0)
 
     @contextlib.contextmanager
     def measure(self, category: str) -> Iterator[None]:
@@ -85,17 +95,19 @@ class GoodputTracker:
         """Supervisor: an attempt just crashed / was preempted; downtime
         starts now.  Idempotent (the first mark wins — the failure point,
         not the last log line)."""
-        self._start_clock()
-        if self._down_since is None:
-            self._down_since = time.perf_counter()
+        with self._lock:
+            self._start_clock()
+            if self._down_since is None:
+                self._down_since = time.perf_counter()
 
     def mark_up(self) -> None:
         """Trainer construction: if a down window is open, close it into
         the restart bucket."""
-        self._start_clock()
-        if self._down_since is not None:
-            self.add("restart", time.perf_counter() - self._down_since)
-            self._down_since = None
+        with self._lock:
+            self._start_clock()
+            if self._down_since is not None:
+                self.add("restart", time.perf_counter() - self._down_since)
+                self._down_since = None
 
     def load_previous(self, telemetry_json: dict) -> None:
         """Resume the books from a previous process's ``telemetry.json``
@@ -103,41 +115,49 @@ class GoodputTracker:
         buckets and account the dead time since its last write as restart
         downtime."""
         prev = telemetry_json.get("goodput", {})
-        for c in CATEGORIES:
-            self.buckets[c] += float(prev.get(f"{c}_s", 0.0))
-        self._base_wall = float(prev.get("wall_s", 0.0))
-        written = telemetry_json.get("written_unix")
-        if written is not None:
-            down = time.time() - float(written)
-            if 0 < down < 7 * 24 * 3600:    # a stale file is not downtime
-                self.add("restart", down)
-                self._base_wall += down
+        with self._lock:
+            for c in CATEGORIES:
+                self.buckets[c] += float(prev.get(f"{c}_s", 0.0))
+            self._base_wall = float(prev.get("wall_s", 0.0))
+            written = telemetry_json.get("written_unix")
+            if written is not None:
+                down = time.time() - float(written)
+                if 0 < down < 7 * 24 * 3600:  # a stale file isn't downtime
+                    self.add("restart", down)
+                    self._base_wall += down
 
     # -- reading ------------------------------------------------------------
 
     def wall_s(self) -> float:
-        if self._t0 is None:
-            return self._base_wall
-        return self._base_wall + (time.perf_counter() - self._t0)
+        with self._lock:
+            if self._t0 is None:
+                return self._base_wall
+            return self._base_wall + (time.perf_counter() - self._t0)
 
     def accounted_s(self) -> float:
-        return sum(self.buckets.values())
+        with self._lock:
+            return sum(self.buckets.values())
 
     def goodput_fraction(self) -> float:
         """Productive share of wall-clock (0 when nothing ran)."""
-        wall = self.wall_s()
-        return self.buckets["productive"] / wall if wall > 0 else 0.0
+        with self._lock:
+            wall = self.wall_s()
+            return self.buckets["productive"] / wall if wall > 0 else 0.0
 
     def snapshot(self) -> dict:
         """The ``goodput`` section of telemetry.json; also mirrors every
         bucket into the registry (``goodput/<cat>_s``) so the metric
-        stream and the JSON cannot drift."""
-        out = {f"{c}_s": round(self.buckets[c], 6) for c in CATEGORIES}
-        out["wall_s"] = round(self.wall_s(), 6)
-        out["accounted_s"] = round(self.accounted_s(), 6)
-        out["productive_fraction"] = round(self.goodput_fraction(), 6)
+        stream and the JSON cannot drift.  The lock is held across the
+        whole read so a concurrent ``/statz`` scrape sees buckets,
+        accounted_s and productive_fraction from ONE instant."""
+        with self._lock:
+            out = {f"{c}_s": round(self.buckets[c], 6) for c in CATEGORIES}
+            out["wall_s"] = round(self.wall_s(), 6)
+            out["accounted_s"] = round(self.accounted_s(), 6)
+            out["productive_fraction"] = round(self.goodput_fraction(), 6)
+            buckets = dict(self.buckets)
         for c in CATEGORIES:
-            _registry.gauge(f"goodput/{c}_s").set(self.buckets[c])
+            _registry.gauge(f"goodput/{c}_s").set(buckets[c])
         _registry.gauge("goodput/productive_fraction").set(
             out["productive_fraction"])
         return out

@@ -1,0 +1,257 @@
+"""Live introspection endpoint: a stdlib admin HTTP server for running
+jobs.
+
+The telemetry spine was strictly post-hoc — ``telemetry.json`` and the
+span files are written at sync points and judged after exit.  A serving
+fleet (or a week-long training run) is operated LIVE: an operator needs
+to see the metric registry, the health of the loop, the last-N request
+traces, and the SLO burn state *while the process runs* — and most
+especially while it is wedged, since a wedged process never reaches its
+next sync-point flush.
+
+Endpoints (all JSON, GET only):
+
+* ``/statz``  — one CONSISTENT snapshot of the metric registry (the
+  registry lock is held across the whole read — no torn counter pairs)
+  plus the goodput books;
+* ``/healthz`` — liveness: the :class:`LivenessProbe` the driving loop
+  beats every iteration/step, merged with any extra source (e.g. the
+  ``resilience/health.py`` coordinator's published ``health.json``);
+  HTTP 200 when live, 503 when the beat is stale — curl-able by a k8s
+  probe as-is;
+* ``/tracez`` — the request-trace flight recorder
+  (:class:`~dtf_tpu.telemetry.reqtrace.TraceRing`): last-N completed
+  request timelines, even when the process dies before any file flush;
+* ``/slo``    — the :class:`~dtf_tpu.telemetry.slo.BurnRateMonitor`
+  state (budgets, burn rates, alert history).
+
+Threading model — the same discipline as ``serve/frontend.py``: handler
+threads NEVER touch the engine or trainer; every endpoint reads a
+thread-safe structure (locked registry snapshot, ring snapshot, monitor
+state, probe timestamps).  The server binds 127.0.0.1 by default and
+runs on daemon threads; :func:`start_admin` is the idempotent
+process-wide entry the CLIs use for ``--admin_port``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from dtf_tpu.telemetry import goodput as _goodput
+from dtf_tpu.telemetry import registry as _registry
+
+
+class LivenessProbe:
+    """The loop's heartbeat into ``/healthz``: the driving thread calls
+    :meth:`beat` once per iteration/step; the endpoint judges liveness
+    by beat AGE on this process's monotonic clock (same observed-change
+    discipline as resilience/health.py — a wall-clock step cannot fake
+    or hide a wedge)."""
+
+    def __init__(self, stale_after_s: float = 60.0):
+        self.stale_after_s = float(stale_after_s)
+        self._lock = threading.Lock()
+        self._count: Optional[int] = None
+        self._last: Optional[float] = None
+
+    def beat(self, count: int) -> None:
+        with self._lock:
+            self._count = int(count)
+            self._last = time.monotonic()
+
+    def status(self) -> dict:
+        with self._lock:
+            count, last = self._count, self._last
+        if last is None:
+            # never beaten: the loop hasn't started — alive-but-booting
+            return {"ok": True, "phase": "booting", "beats": None,
+                    "age_s": None}
+        age = time.monotonic() - last
+        return {"ok": age <= self.stale_after_s, "phase": "running",
+                "beats": count, "age_s": round(age, 3),
+                "stale_after_s": self.stale_after_s}
+
+
+def health_file_fn(health_dir: str) -> Callable[[], Optional[dict]]:
+    """Extra-health source reading the ``resilience/health.py``
+    coordinator's published ``health.json`` under ``health_dir`` (the
+    file transport's snapshot) — wires multi-host liveness into
+    ``/healthz`` without touching the monitor thread."""
+    path = os.path.join(health_dir, "health.json")
+
+    def read() -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    return read
+
+
+class AdminServer:
+    """See module docstring.  ``port=0`` binds an ephemeral port (tests);
+    the bound port is ``self.port`` after :meth:`start`."""
+
+    def __init__(self, port: int, *, host: str = "127.0.0.1",
+                 probe: Optional[LivenessProbe] = None,
+                 trace_ring=None, slo=None,
+                 health_fn: Optional[Callable[[], Optional[dict]]] = None):
+        self.host = host
+        self._requested_port = int(port)
+        self.probe = probe or LivenessProbe()
+        self.trace_ring = trace_ring
+        self.slo = slo
+        self.health_fn = health_fn
+        self._server = None
+        self._thread = None
+
+    # sources can be rebound between supervisor attempts (a fresh engine
+    # per attempt, one server per process)
+    def bind(self, *, probe=None, trace_ring=None, slo=None,
+             health_fn=None) -> "AdminServer":
+        if probe is not None:
+            self.probe = probe
+        if trace_ring is not None:
+            self.trace_ring = trace_ring
+        if slo is not None:
+            self.slo = slo
+        if health_fn is not None:
+            self.health_fn = health_fn
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    # -- endpoint payloads (each reads only thread-safe state) --------------
+
+    def _statz(self) -> tuple:
+        # goodput first: snapshot() mirrors the buckets into registry
+        # gauges, and the registry snapshot after it is ONE locked cut
+        good = _goodput.get_tracker().snapshot()
+        return 200, {"metrics": _registry.get_registry().snapshot(),
+                     "goodput": good,
+                     "written_unix": time.time()}
+
+    def _healthz(self) -> tuple:
+        doc = self.probe.status()
+        if self.health_fn is not None:
+            extra = self.health_fn()
+            if extra is not None:
+                doc["cluster"] = extra
+        return (200 if doc.get("ok") else 503), doc
+
+    def _tracez(self, query: Dict[str, str]) -> tuple:
+        if self.trace_ring is None:
+            return 200, {"traces": [], "note": "no request tracing armed"}
+        n = None
+        if query.get("n", "").isdigit():
+            n = int(query["n"])
+        traces = self.trace_ring.snapshot(n)
+        return 200, {"capacity": self.trace_ring.capacity,
+                     "count": len(traces), "traces": traces}
+
+    def _slo(self) -> tuple:
+        if self.slo is None:
+            return 200, {"slo": None, "note": "no SLO monitor armed"}
+        return 200, self.slo.state()
+
+    # -- server -------------------------------------------------------------
+
+    def start(self) -> "AdminServer":
+        if self._server is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        admin = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):     # quiet by design
+                pass
+
+            def do_GET(self):
+                from dtf_tpu import telemetry as tel
+                from urllib.parse import parse_qsl, urlparse
+                tel.counter("live/requests_total").inc()
+                url = urlparse(self.path)
+                query = dict(parse_qsl(url.query))
+                try:
+                    if url.path in ("/statz", "/statz/"):
+                        code, doc = admin._statz()
+                    elif url.path in ("/healthz", "/healthz/"):
+                        code, doc = admin._healthz()
+                    elif url.path in ("/tracez", "/tracez/"):
+                        code, doc = admin._tracez(query)
+                    elif url.path in ("/slo", "/slo/"):
+                        code, doc = admin._slo()
+                    elif url.path == "/":
+                        code, doc = 200, {"endpoints": [
+                            "/statz", "/healthz", "/tracez", "/slo"]}
+                    else:
+                        code, doc = 404, {"error": f"no such endpoint "
+                                                   f"{url.path!r}"}
+                except Exception as exc:   # an endpoint must never crash
+                    code, doc = 500, {"error": f"{type(exc).__name__}: "
+                                               f"{exc}"}
+                if code != 200:
+                    tel.counter("live/errors_total").inc()
+                body = json.dumps(doc, indent=1, sort_keys=True,
+                                  default=str).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((self.host, self._requested_port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True, name="dtf-admin")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None
+
+
+# -- process-wide singleton (the --admin_port entry) ------------------------
+
+_ADMIN: Optional[AdminServer] = None
+
+
+def start_admin(port: int, **sources) -> AdminServer:
+    """Idempotent per process: the first call starts the server, later
+    calls (a supervisor's next attempt constructing a fresh engine)
+    rebind the data sources onto the SAME server — one admin window per
+    process for its whole life."""
+    global _ADMIN
+    if _ADMIN is None:
+        _ADMIN = AdminServer(port, **sources).start()
+    else:
+        _ADMIN.bind(**sources)
+    return _ADMIN
+
+
+def get_admin() -> Optional[AdminServer]:
+    return _ADMIN
+
+
+def stop_admin() -> None:
+    global _ADMIN
+    if _ADMIN is not None:
+        _ADMIN.close()
+        _ADMIN = None

@@ -106,6 +106,16 @@ METRICS = (
                                   # a graceful drain (each replays)
     "serve/conn_total",           # TCP front end: connections accepted
     "serve/conn_errors_total",    # malformed requests + timeouts + drops
+    # SLO burn-rate monitor (telemetry/slo.py): windowed error-budget
+    # burn per objective (ttft/tpot/deadline) at the fast and slow
+    # lookback windows, plus edge-triggered alert counters — the
+    # operator's early warning, surfaced live on /slo and in the report.
+    "serve/slo_burn_*",           # gauges: slo_burn_<objective>_<speed>
+    "serve/slo_alert_*",          # counters: slo_alert_<speed>_total and
+                                  # slo_alert_<objective>_<speed>
+    # live introspection endpoint (telemetry/live.py)
+    "live/requests_total",        # admin HTTP requests served
+    "live/errors_total",          # admin HTTP 4xx/5xx responses
 )
 # spans (host-side tracer)
 SPANS = (
@@ -126,6 +136,11 @@ SPANS = (
     "serve/prefill",
     "serve/decode",
     "trainer/init",
+    # per-request distributed tracing (telemetry/reqtrace.py): one
+    # lifecycle event stream per request, keyed by trace_id — submit /
+    # shed / rejected / admitted / prefill / first_token / completed /
+    # cancelled / failed / drained / lifetime
+    "reqtrace/*",
     # instants
     "chaos/*",                    # chaos/<fault kind> firing marks
     "health/*",                   # peer_stale / abort / poison marks
@@ -142,6 +157,21 @@ def validate(name: str) -> str:
         raise ValueError(
             f"telemetry name {name!r} violates the naming scheme: "
             f"snake_case segments joined by '/' (see telemetry/names.py)")
+    return name
+
+
+def require_declared(name: str) -> str:
+    """Runtime REGISTRATION guard (the reverse of the source lint): an
+    instrument created at runtime whose name is not declared here —
+    e.g. assembled from variables the AST lint collapsed to a pattern
+    that matches nothing — is rejected at creation, not discovered as a
+    dashboard hole at post-mortem time.  Returns the name."""
+    validate(name)
+    if not is_declared(name):
+        raise ValueError(
+            f"telemetry instrument {name!r} is not declared in "
+            f"dtf_tpu/telemetry/names.py — declare it (or a '*' pattern "
+            f"covering it) before registering")
     return name
 
 

@@ -12,19 +12,28 @@ of the update history — sorted keys, plain Python floats/ints, no
 timestamps — so tests can golden it and two processes applying the same
 updates produce identical JSON.
 
-Thread-safe (one lock per registry; instruments share it).  Not
-cross-process: each process owns its registry, and only the coordinator
-serializes (same rule as MetricLogger).
+Thread-safe (one reentrant lock per registry; instruments share it).
+Not cross-process: each process owns its registry, and only the
+coordinator serializes (same rule as MetricLogger).
+
+Snapshot consistency (the live plane's contract): :meth:`MetricRegistry.
+snapshot` holds the registry lock across EVERY instrument read, and
+:meth:`MetricRegistry.locked` lets a writer update a *group* of
+instruments atomically (e.g. ``serve/shed_total`` plus its per-reason
+counter) — so a concurrent ``/statz`` scrape can never observe a torn
+pair.  The lock is reentrant precisely so instrument updates nest inside
+``locked()``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
-from dtf_tpu.telemetry.names import validate
+from dtf_tpu.telemetry.names import require_declared, validate
 
 
 class Counter:
@@ -102,12 +111,22 @@ class Histogram:
 
 
 class MetricRegistry:
-    def __init__(self):
-        self._lock = threading.Lock()
+    """``strict=True`` (the process-wide registry's mode) additionally
+    requires every registered name to be DECLARED in telemetry/names.py
+    — the runtime half of the naming lint: a name assembled at runtime
+    that no declaration covers fails at creation, not at dashboard
+    time.  Scratch registries (tests, tools) default to shape-only."""
+
+    def __init__(self, strict: bool = False):
+        self._lock = threading.RLock()
+        self.strict = strict
         self._instruments: Dict[str, object] = {}
 
     def _get(self, name: str, cls):
-        validate(name)
+        if self.strict:
+            require_declared(name)
+        else:
+            validate(name)
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
@@ -131,11 +150,24 @@ class MetricRegistry:
         with self._lock:
             return sorted(self._instruments)
 
-    def snapshot(self) -> dict:
-        """Deterministic: sorted by name, value types only."""
+    @contextlib.contextmanager
+    def locked(self) -> Iterator[None]:
+        """Atomic multi-instrument update: hold the registry lock over a
+        GROUP of updates so a concurrent :meth:`snapshot` (the ``/statz``
+        scrape) sees either none or all of them.  Reentrant — the
+        individual ``inc``/``set``/``observe`` calls inside re-acquire
+        the same lock."""
         with self._lock:
-            items = sorted(self._instruments.items())
-        return {name: inst.snapshot() for name, inst in items}
+            yield
+
+    def snapshot(self) -> dict:
+        """Deterministic: sorted by name, value types only.  The lock is
+        held across EVERY instrument read — one consistent cut of the
+        registry, never a mix of before/after a concurrent ``locked()``
+        update group."""
+        with self._lock:
+            return {name: inst.snapshot()
+                    for name, inst in sorted(self._instruments.items())}
 
     def reset(self) -> None:
         with self._lock:
@@ -169,8 +201,10 @@ class MetricRegistry:
 
 
 # -- the process-wide registry ----------------------------------------------
+# Strict: every instrument the process registers must be declared in
+# names.py (the report CLI and dashboards key on those strings).
 
-_REGISTRY = MetricRegistry()
+_REGISTRY = MetricRegistry(strict=True)
 
 
 def get_registry() -> MetricRegistry:

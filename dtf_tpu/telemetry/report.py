@@ -61,16 +61,24 @@ def dedupe_latest_attempt(rows) -> List[Tuple[int, int, str, float]]:
 
 def summarize_spans(paths: List[str]) -> Tuple[List[dict], List[dict]]:
     """(per-name aggregate rows sorted by total time, instant events)."""
+    return summarize_span_records(
+        [rec for path in paths for rec in read_spans(path)])
+
+
+def summarize_span_records(records: List[dict]
+                           ) -> Tuple[List[dict], List[dict]]:
+    """:func:`summarize_spans` over already-parsed records — the report
+    parses every span file exactly once and shares the stream with the
+    request-trace reader."""
     agg = defaultdict(lambda: [0, 0.0])     # name -> [count, total_us]
     instants = []
-    for path in paths:
-        for rec in read_spans(path):
-            if rec.get("ph") == "X":
-                a = agg[rec["name"]]
-                a[0] += 1
-                a[1] += rec.get("dur", 0.0)
-            elif rec.get("ph") == "i":
-                instants.append(rec)
+    for rec in records:
+        if rec.get("ph") == "X":
+            a = agg[rec["name"]]
+            a[0] += 1
+            a[1] += rec.get("dur", 0.0)
+        elif rec.get("ph") == "i":
+            instants.append(rec)
     rows = [{"name": n, "count": c, "total_s": t / 1e6,
              "mean_ms": t / 1e3 / c if c else 0.0}
             for n, (c, t) in agg.items()]
@@ -116,12 +124,22 @@ def build_report(logdir: str, profile_dir: Optional[str] = None,
 
     span_files = find_span_files(logdir)
     if span_files:
-        rows, instants = summarize_spans(span_files)
+        from dtf_tpu.telemetry import reqtrace
+        records = [rec for p in span_files for rec in read_spans(p)]
+        rows, instants = summarize_span_records(records)
         out["span_files"] = [os.path.basename(p) for p in span_files]
         out["spans"] = rows[:top]
         out["instants"] = [
             {"name": r["name"], "ts": r.get("ts"), "pid": r.get("pid"),
-             "args": r.get("args", {})} for r in instants]
+             "args": r.get("args", {})} for r in instants
+            # request lifecycle events have their own section/gate; the
+            # shared instant timeline would drown in them
+            if not r["name"].startswith("reqtrace/")]
+        events = reqtrace.events_from_records(records)
+        if events:
+            traces = reqtrace.group_traces(events)
+            comp = reqtrace.completeness(traces)
+            out["request_traces"] = {"total": len(traces), **comp}
 
     hpath = os.path.join(logdir, "health.json")
     if os.path.exists(hpath):
@@ -157,6 +175,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_final_cost: Optional[float] = None,
                 min_goodput_qps: Optional[float] = None,
                 max_ttft_p99_ms: Optional[float] = None,
+                min_trace_complete_frac: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -181,7 +200,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       goodput-QPS floor (completed requests that met the SLO TTFT
       budget per second of makespan) and p99 TTFT ceiling — the
       scenario matrix's serve cell gates on these, so serving
-      robustness is CI-judged exactly like training.
+      robustness is CI-judged exactly like training;
+    * ``min_trace_complete_frac`` — observability gate: of requests
+      that COMPLETED, the fraction whose per-request trace reconstructs
+      the full admission->prefill->first_token->completion chain from
+      the span files (telemetry/reqtrace.py; drain/replay folded in by
+      trace-id continuity).  No reqtrace events on disk = not measured
+      = FAIL, same absence rule as every other gate.
     """
     lines: List[str] = []
     ok = True
@@ -232,6 +257,10 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = serving.get("ttft_ms_p99")
         gate("max_ttft_p99_ms", None if v is None else float(v),
              max_ttft_p99_ms, at_most=True)
+    if min_trace_complete_frac is not None:
+        v = report.get("request_traces", {}).get("complete_frac")
+        gate("min_trace_complete_frac", None if v is None else float(v),
+             min_trace_complete_frac, at_most=False)
     return ok, lines
 
 
@@ -363,8 +392,32 @@ def render(report: dict, top: int = 10) -> str:
                     f"({bo.get('level_name')}), p99 ewma "
                     f"{bo.get('p99_ttft_ewma_ms'):g} ms, "
                     f"{bo.get('transitions')} transition(s)")
+            slo = serving.get("slo")
+            if slo:
+                for oname, o in sorted(
+                        slo.get("objectives", {}).items()):
+                    bad = o.get("bad_frac")
+                    lines.append(
+                        f"  {'slo/' + oname:<28} target {o.get('target')}"
+                        f"  bad_frac "
+                        + ("n/a" if bad is None else f"{bad:.4f}")
+                        + f"  alerts fast={o.get('alerts_fast')} "
+                          f"slow={o.get('alerts_slow')}")
         for n in sorted(srv):
             lines.append(f"  {n:<28} {srv[n]:12.5g}")
+    rt = report.get("request_traces")
+    if rt:
+        frac = rt.get("complete_frac")
+        lines.append("Request traces (telemetry/reqtrace.py)")
+        lines.append(f"  {'traces':<28} {rt.get('total', 0):12d}")
+        lines.append(f"  {'completed':<28} {rt.get('completed', 0):12d}")
+        lines.append(f"  {'chain_complete':<28} {rt.get('complete', 0):12d}")
+        lines.append(f"  {'complete_frac':<28} "
+                     + ("         n/a" if frac is None else f"{frac:12.4f}"))
+        for inc in rt.get("incomplete", [])[:5]:
+            lines.append(f"  incomplete rid={inc.get('rid')} "
+                         f"trace={inc.get('trace_id')}: "
+                         f"{', '.join(inc.get('gaps', []))}")
     if "steps" in report:
         s = report["steps"]
         lines.append(f"Steps: {s['first']}..{s['last']}  "
@@ -444,10 +497,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "'serving' section)")
     p.add_argument("--max_ttft_p99_ms", type=float, default=None,
                    help="serving gate: p99 TTFT ceiling in ms")
+    p.add_argument("--min_trace_complete_frac", type=float, default=None,
+                   help="observability gate: floor on the fraction of "
+                        "completed requests with a gap-free "
+                        "admission->completion trace chain")
+    p.add_argument("--request", type=int, default=None, metavar="RID",
+                   help="print ONE request's causally-ordered timeline "
+                        "(reqtrace events + the engine iterations that "
+                        "touched it) instead of the full report")
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.logdir):
         print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
         return 2
+    if ns.request is not None:
+        from dtf_tpu.telemetry import reqtrace
+        events = reqtrace.request_timeline(ns.logdir, ns.request)
+        print(f"== request {ns.request} timeline: "
+              f"{os.path.abspath(ns.logdir)} ==")
+        for line in reqtrace.render_timeline(events):
+            print(line)
+        return 0 if events else 1
     report = build_report(ns.logdir, profile_dir=ns.profile_dir, top=ns.top)
     if ns.export_trace:
         from dtf_tpu.telemetry.spans import export_chrome_trace
@@ -466,7 +535,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "min_tokens_per_s": ns.min_tokens_per_s,
                   "max_final_cost": ns.max_final_cost,
                   "min_goodput_qps": ns.min_goodput_qps,
-                  "max_ttft_p99_ms": ns.max_ttft_p99_ms}
+                  "max_ttft_p99_ms": ns.max_ttft_p99_ms,
+                  "min_trace_complete_frac": ns.min_trace_complete_frac}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -1,0 +1,308 @@
+"""Per-request distributed tracing: one causally-ordered timeline per
+serving request.
+
+The span tracer (:mod:`.spans`) answers "where did the PROCESS's
+wall-clock go"; this module answers the per-request question a serving
+operator actually asks — *what happened to request 17431* — by stamping
+every lifecycle transition of a request with one **trace id**:
+
+* minted once, at the TCP front end (:func:`mint_trace_id` in
+  ``serve/frontend.py``) or at ``ServingEngine.submit``;
+* propagated through admission, shed/brownout decisions, prefill, first
+  token, per-iteration decode, and completion / eviction / drain;
+* carried ACROSS a graceful drain: ``drain.jsonl`` replay docs include
+  it, so a supervisor-replayed request links to its pre-SIGTERM events
+  and ``telemetry.report --request <rid>`` shows one continuous story.
+
+Events ride the EXISTING span-file format (``reqtrace/<phase>`` instant
+records in ``spans.p<k>.jsonl``, args carrying ``trace_id``/``rid``/
+``t`` = the engine-clock instant), so the Perfetto export interleaves
+request timelines with the engine's ``serve/prefill``/``serve/decode``
+iteration spans for free; each request additionally closes with one
+``reqtrace/lifetime`` "X" span on its own lane (``tid`` derived from the
+rid) so a trace viewer shows requests as parallel tracks.
+
+Causal ordering uses the ENGINE clock (``t``), not the wall ``ts``: the
+engine may run on the deterministic VirtualClock, and even on the wall
+clock a monotonic per-request ordering must not depend on NTP steps.
+
+The **flight recorder** (:class:`TraceRing`) keeps the last-N completed
+request traces in memory for the live ``/tracez`` endpoint — it survives
+exactly the case the files don't: a process dying before a sync-point
+flush still served its recent history to the scrape that noticed it
+dying.
+
+Jax-free, stdlib-only: importable from the front end before any backend
+exists.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from dtf_tpu.telemetry import spans as _spans
+
+#: Lifecycle phases, in causal order.  ``submit`` opens a segment (a
+#: replay opens a second segment under the SAME trace id); the chain a
+#: COMPLETED request must show in its final segment:
+CHAIN = ("submit", "admitted", "prefill", "first_token", "completed")
+#: Terminal phases (a trace lands in the ring when one of these fires).
+TERMINAL = ("completed", "rejected", "shed", "cancelled", "failed",
+            "drained")
+
+
+def mint_trace_id() -> str:
+    """16-hex-char trace id.  Random, not derived: two engines replaying
+    the same rid (an A/B's two arms) must not collide in a shared
+    logdir; continuity across drain/replay comes from *carrying* the id
+    in the replay doc, never from re-derivation."""
+    return os.urandom(8).hex()
+
+
+def _lane(rid: int) -> int:
+    """Stable per-request Perfetto lane, clear of thread-id lanes."""
+    return 0x40000 + (int(rid) & 0xFFFF)
+
+
+class TraceRing:
+    """Bounded flight recorder of the last-N *terminal* request traces
+    (``/tracez``).  Insertion order == terminal order; the oldest
+    completed trace is evicted first.  Thread-safe: the engine thread
+    appends, admin handler threads snapshot."""
+
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._live: Dict[str, dict] = {}            # trace_id -> doc
+        self._done: "OrderedDict[str, dict]" = OrderedDict()
+
+    def event(self, trace_id: str, rid: int, phase: str,
+              t: float, **attrs) -> None:
+        ev = {"phase": phase, "t": round(float(t), 6), **attrs}
+        with self._lock:
+            doc = self._live.get(trace_id)
+            if doc is None:
+                # a replay under the same trace id RE-OPENS its
+                # terminal doc: the ring keeps one continuous story
+                doc = self._done.pop(trace_id, None)
+            if doc is None:
+                doc = {"trace_id": trace_id, "rid": int(rid), "events": []}
+            self._live[trace_id] = doc
+            doc.pop("status", None)
+            doc["events"].append(ev)
+            if phase in TERMINAL:
+                doc["status"] = phase
+                self._live.pop(trace_id, None)
+                # a replayed trace re-terminates: move it to the back
+                self._done.pop(trace_id, None)
+                self._done[trace_id] = doc
+                while len(self._done) > self.capacity:
+                    self._done.popitem(last=False)
+
+    def snapshot(self, n: Optional[int] = None) -> List[dict]:
+        """Terminal traces, oldest first (``n`` keeps the newest n;
+        0 is genuinely empty — a count probe, not a full dump)."""
+        with self._lock:
+            docs = [dict(d, events=list(d["events"]))
+                    for d in self._done.values()]
+        if n is None:
+            return docs
+        n = int(n)
+        return docs[-n:] if n > 0 else []
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._done)
+
+
+class RequestTracer:
+    """The engine-side emitter: every lifecycle event goes to BOTH the
+    process span file (post-hoc plane) and the flight-recorder ring
+    (live plane).  One instance per engine; all calls from the engine
+    thread."""
+
+    def __init__(self, ring_capacity: int = 64):
+        self.ring = TraceRing(ring_capacity)
+        self._wall0: Dict[str, float] = {}   # trace_id -> first wall ts(us)
+
+    def event(self, req, phase: str, t: float, **attrs) -> None:
+        """``req`` is a serve Request (needs ``.trace_id``/``.rid``);
+        ``t`` is the engine-clock instant."""
+        import time
+        trace_id = req.trace_id
+        rid = int(req.rid)
+        self.ring.event(trace_id, rid, phase, t, **attrs)
+        tracer = _spans.get_tracer()
+        now_us = time.time() * 1e6
+        self._wall0.setdefault(trace_id, now_us)
+        tracer.emit_instant(
+            f"reqtrace/{phase}",
+            {"trace_id": trace_id, "rid": rid, "t": round(float(t), 6),
+             **attrs},
+            ts_us=now_us, tid=_lane(rid))
+        if phase in TERMINAL:
+            wall0 = self._wall0.pop(trace_id, now_us)
+            tracer.emit_complete(
+                "reqtrace/lifetime", wall0, now_us - wall0,
+                {"trace_id": trace_id, "rid": rid, "status": phase},
+                tid=_lane(rid))
+            tracer.flush()       # terminal events are what post-mortems need
+
+
+# ---------------------------------------------------------------------------
+# Readers (report CLI, completeness gate)
+# ---------------------------------------------------------------------------
+
+
+def events_from_records(records) -> List[dict]:
+    """``reqtrace/*`` instants out of already-parsed span records (in
+    read order), as flat event dicts.  Each event carries ``seq`` — its
+    position in the chronological record stream — which is the CAUSAL
+    order key: span files are appended in emit order and
+    ``find_span_files`` walks rotated generations oldest-first, so read
+    order is emit order without depending on wall-clock stamps (an NTP
+    step between two events must not reorder a timeline)."""
+    out = []
+    for seq, rec in enumerate(records):
+        name = rec.get("name", "")
+        if rec.get("ph") != "i" or not name.startswith("reqtrace/"):
+            continue
+        args = rec.get("args", {})
+        if "trace_id" not in args:
+            continue
+        out.append({"phase": name[len("reqtrace/"):],
+                    "trace_id": args["trace_id"],
+                    "rid": args.get("rid"),
+                    "t": args.get("t", 0.0),
+                    "ts": rec.get("ts"), "pid": rec.get("pid"),
+                    "seq": seq,
+                    **{k: v for k, v in args.items()
+                       if k not in ("trace_id", "rid", "t")}})
+    return out
+
+
+def read_all_records(logdir: str) -> List[dict]:
+    """Every span record under ``logdir``, one chronological stream
+    (rotated generations first, active tail last) — parse ONCE and feed
+    both :func:`events_from_records` and any span summarizer."""
+    return [rec for path in _spans.find_span_files(logdir)
+            for rec in _spans.read_spans(path)]
+
+
+def load_request_events(logdir: str) -> List[dict]:
+    """Every ``reqtrace/*`` instant from every span file (rotated
+    generations included), as flat event dicts."""
+    return events_from_records(read_all_records(logdir))
+
+
+def group_traces(events: List[dict]) -> Dict[str, List[dict]]:
+    """trace_id -> events, causally ordered by ``seq`` (file read
+    order == emit order; see :func:`events_from_records`).  Across a
+    drain/replay boundary both segments append to the same per-process
+    span file, so the replay's events read later — one trace id reads
+    as one ordered story even though the engine clock restarts per
+    process and the wall clock may step."""
+    by_id: Dict[str, List[dict]] = {}
+    for ev in events:
+        by_id.setdefault(ev["trace_id"], []).append(ev)
+    for evs in by_id.values():
+        evs.sort(key=lambda e: e.get("seq", 0))
+    return by_id
+
+
+def chain_gaps(events: List[dict]) -> List[str]:
+    """Missing lifecycle phases for one trace's FINAL segment (after its
+    last ``submit``).  Empty == gap-free.  Only completed traces are
+    held to the full chain; a shed/rejected trace is complete with just
+    its submit + verdict, and a drained segment is complete by being
+    re-opened (the replay segment is the one judged)."""
+    if not events:
+        return ["no events"]
+    last_submit = max((i for i, e in enumerate(events)
+                       if e["phase"] == "submit"), default=0)
+    seg = [e["phase"] for e in events[last_submit:]]
+    status = next((p for p in reversed(seg) if p in TERMINAL), None)
+    if status is None:
+        return ["no terminal event"]
+    if status != "completed":
+        # verdict-only chains: submit -> terminal is the whole story
+        return [] if "submit" in seg else ["missing submit"]
+    return [f"missing {p}" for p in CHAIN if p not in seg]
+
+
+def completeness(traces: Dict[str, List[dict]]) -> dict:
+    """The scenario gate's quantity: of traces that COMPLETED, what
+    fraction reconstructs the full admission->prefill->first_token->
+    completion chain (drain/replay folded in by trace-id continuity)."""
+    completed, complete, incomplete = 0, 0, []
+    for tid, evs in sorted(traces.items()):
+        if not any(e["phase"] == "completed" for e in evs):
+            continue
+        completed += 1
+        gaps = chain_gaps(evs)
+        if gaps:
+            incomplete.append({"trace_id": tid,
+                               "rid": evs[0].get("rid"), "gaps": gaps})
+        else:
+            complete += 1
+    return {"completed": completed, "complete": complete,
+            "complete_frac": (complete / completed) if completed else None,
+            "incomplete": incomplete[:16]}
+
+
+def request_timeline(logdir: str, rid: int,
+                     records: Optional[List[dict]] = None) -> List[dict]:
+    """Every event of every trace carrying ``rid``, plus the engine
+    iteration spans (``serve/prefill``/``serve/decode``) that touched
+    it — the ``report --request`` view's data.  ONE parse pass: pass
+    pre-parsed ``records`` (from :func:`read_all_records`) to reuse a
+    report's; ordering is read order (seq), same rule as
+    :func:`group_traces`."""
+    if records is None:
+        records = read_all_records(logdir)
+    # lifecycle instants via the ONE reqtrace parser (seq indexes into
+    # `records`, the same space the span extraction below enumerates)
+    events = [e for e in events_from_records(records)
+              if e.get("rid") == rid]
+    for seq, rec in enumerate(records):
+        if rec.get("ph") != "X":
+            continue
+        args = rec.get("args", {})
+        if rec.get("name") == "serve/decode" and rid in (
+                args.get("rids") or []):
+            events.append({"phase": "engine_decode",
+                           "trace_id": None, "rid": rid,
+                           "t": args.get("t", 0.0), "ts": rec.get("ts"),
+                           "seq": seq, "batch": args.get("batch"),
+                           "iteration": args.get("iteration")})
+        elif (rec.get("name") == "serve/prefill"
+              and args.get("rid") == rid):
+            events.append({"phase": "engine_prefill",
+                           "trace_id": None, "rid": rid,
+                           "t": args.get("t", 0.0), "ts": rec.get("ts"),
+                           "seq": seq, "tokens": args.get("tokens")})
+    events.sort(key=lambda e: e.get("seq", 0))
+    return events
+
+
+def render_timeline(events: List[dict]) -> List[str]:
+    """Human-readable lines for one request's timeline."""
+    if not events:
+        return ["(no trace events for this request)"]
+    lines = []
+    tids = sorted({e["trace_id"] for e in events if e.get("trace_id")})
+    lines.append(f"trace id(s): {', '.join(tids) or '(none)'}")
+    for e in events:
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(e.items())
+            if k not in ("phase", "trace_id", "rid", "t", "ts", "pid",
+                         "seq")
+            and v is not None)
+        lines.append(f"  t={e.get('t', 0.0):10.4f}s  "
+                     f"{e['phase']:<16}" + (f" {detail}" if detail else ""))
+    return lines

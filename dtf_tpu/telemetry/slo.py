@@ -1,0 +1,261 @@
+"""SLO burn-rate monitoring: windowed error-budget accounting with
+Google-SRE-style fast+slow burn alerts.
+
+An SLO like "99% of requests get first token within the TTFT budget"
+grants an **error budget** (1% of requests may miss).  The *burn rate*
+over a lookback window is::
+
+    burn = (bad events / total events in window) / (1 - target)
+
+burn 1.0 = spending the budget exactly at the sustainable rate; burn 14.4
+= the classic "page now" fast-burn threshold (a 30-day budget gone in ~2
+days).  Two windows per objective — a short **fast** window that reacts
+in seconds and a long **slow** window that filters blips — each with its
+own threshold, so a single outlier cannot page but a sustained
+regression pages early.
+
+This is the operator's early warning: under the pinned ``slow_decode``
+spike the FAST alert fires after a handful of over-budget completions,
+strictly before the brownout controller walks its dwell-hysteresis
+ladder to ``reject_all`` — alert-leads-control, gated in CI by
+``bench.serve_load --chaos --check``.
+
+Objectives are fed by the serving engine (TTFT / TPOT / deadline
+violations, on the engine's own wall-or-virtual clock so CI runs are
+deterministic) and read by three consumers: the ``serve/slo_*``
+instrument family, the live ``/slo`` endpoint, and the report CLI's
+Serving section.
+
+Jax-free; thread-safe (the engine thread records, admin handler threads
+snapshot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One objective: attainment ``target`` (0..1, exclusive) and the
+    two lookback windows with their burn thresholds.  ``min_events``
+    guards both alerts — a burn computed from one sample is noise."""
+
+    name: str
+    target: float
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+    min_events: int = 4
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got "
+                             f"{self.target} for {self.name!r}")
+        if self.fast_window_s >= self.slow_window_s:
+            raise ValueError(
+                f"{self.name!r}: fast window ({self.fast_window_s}s) must "
+                f"be shorter than slow window ({self.slow_window_s}s)")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+
+class _Objective:
+    """Per-window rolling (bad, total) counts with amortized-O(1)
+    updates: two deques of (t, bad) — one per window — each trimmed
+    from the front as its horizon advances, counts adjusted on
+    append/expire.  ``update`` runs in the engine's per-iteration hot
+    loop, so burn evaluation must not rescan the retained events."""
+
+    __slots__ = ("spec", "slow", "fast", "counts", "bad_total", "total",
+                 "alerts", "firing", "first_alert")
+
+    def __init__(self, spec: SLOSpec):
+        self.spec = spec
+        self.slow: Deque[Tuple[float, bool]] = deque()   # (t, bad)
+        self.fast: Deque[Tuple[float, bool]] = deque()
+        # {"fast"/"slow": [bad_in_window, total_in_window]}
+        self.counts = {"fast": [0, 0], "slow": [0, 0]}
+        self.bad_total = 0
+        self.total = 0
+        self.alerts = {"fast": 0, "slow": 0}
+        self.firing = {"fast": False, "slow": False}
+        self.first_alert: Dict[str, Tuple[float, int]] = {}
+
+    def record(self, bad: bool, t: float) -> None:
+        ev = (float(t), bool(bad))
+        for speed, q in (("fast", self.fast), ("slow", self.slow)):
+            q.append(ev)
+            c = self.counts[speed]
+            c[0] += int(bad)
+            c[1] += 1
+        self.total += 1
+        self.bad_total += int(bad)
+
+    def _trim(self, now: float) -> None:
+        for speed, q, window in (("fast", self.fast,
+                                  self.spec.fast_window_s),
+                                 ("slow", self.slow,
+                                  self.spec.slow_window_s)):
+            horizon = now - window
+            c = self.counts[speed]
+            while q and q[0][0] < horizon:
+                _, bad = q.popleft()
+                c[0] -= int(bad)
+                c[1] -= 1
+
+    def burns(self, now: float) -> Dict[str, Tuple[float, int]]:
+        """{"fast"/"slow": (burn rate, events in window)} from the
+        rolling counts (caller trims first).  Burn is 0 until
+        min_events samples exist in the window — never alert off
+        noise."""
+        out = {}
+        for speed in ("fast", "slow"):
+            bad, total = self.counts[speed]
+            if total < self.spec.min_events:
+                out[speed] = (0.0, total)
+            else:
+                out[speed] = ((bad / total) / self.spec.budget, total)
+        return out
+
+
+class BurnRateMonitor:
+    """The monitor the engine feeds and the live plane reads.
+
+    * :meth:`record` — one good/bad event per objective, stamped with
+      the engine clock;
+    * :meth:`update` — once per engine iteration: recompute both
+      windows' burn per objective, edge-trigger alerts into the
+      ``serve/slo_alert_*`` counters and the ``serve/slo_burn_*``
+      gauges, remember the FIRST alert instant (the alert-leads-control
+      gate's timestamp);
+    * :meth:`state` — the ``/slo`` endpoint / report payload.
+    """
+
+    def __init__(self, objectives: List[SLOSpec]):
+        if not objectives:
+            raise ValueError("BurnRateMonitor needs >= 1 objective")
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {names}")
+        self._lock = threading.Lock()
+        self._objs = {o.name: _Objective(o) for o in objectives}
+
+    @classmethod
+    def for_serving(cls, slo_ttft_ms: float,
+                    slo_tpot_ms: Optional[float] = None, *,
+                    ttft_target: float = 0.99,
+                    tpot_target: float = 0.99,
+                    deadline_target: float = 0.999,
+                    **spec_overrides) -> "BurnRateMonitor":
+        """The serving trio: TTFT attainment, TPOT attainment (only when
+        a TPOT budget exists), and deadline violations (budgeted much
+        tighter — a blown deadline is a broken promise, not a slow
+        one).  The engine stores the ms budgets for its own good/bad
+        classification."""
+        objs = [SLOSpec("ttft", ttft_target, **spec_overrides)]
+        if slo_tpot_ms is not None:
+            objs.append(SLOSpec("tpot", tpot_target, **spec_overrides))
+        objs.append(SLOSpec("deadline", deadline_target, **spec_overrides))
+        mon = cls(objs)
+        mon.slo_ttft_ms = float(slo_ttft_ms)
+        mon.slo_tpot_ms = (None if slo_tpot_ms is None
+                           else float(slo_tpot_ms))
+        return mon
+
+    # engine-facing budgets (set by for_serving; None when hand-built)
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+
+    def has(self, name: str) -> bool:
+        return name in self._objs
+
+    def record(self, name: str, bad: bool, t: float) -> None:
+        with self._lock:
+            obj = self._objs.get(name)
+            if obj is None:
+                raise ValueError(f"unknown SLO objective {name!r}; one of "
+                                 f"{sorted(self._objs)}")
+            obj.record(bad, t)
+
+    def update(self, now: float, iteration: int) -> Dict[str, dict]:
+        """One evaluation pass; returns {objective: {fast/slow burn,
+        firing flags}} and feeds the instrument family."""
+        from dtf_tpu import telemetry as tel
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for name, obj in self._objs.items():
+                obj._trim(now)
+                spec = obj.spec
+                res = {}
+                burns = obj.burns(now)
+                for speed, thresh in (("fast", spec.fast_burn),
+                                      ("slow", spec.slow_burn)):
+                    burn, n = burns[speed]
+                    firing = burn >= thresh
+                    if firing and not obj.firing[speed]:
+                        # edge-triggered: one alert per excursion
+                        obj.alerts[speed] += 1
+                        obj.first_alert.setdefault(
+                            speed, (float(now), int(iteration)))
+                        tel.counter(f"serve/slo_alert_{speed}_total").inc()
+                        tel.counter(
+                            f"serve/slo_alert_{name}_{speed}").inc()
+                        tel.instant(f"event/slo_alert_{name}_{speed}",
+                                    burn=round(burn, 3),
+                                    iteration=int(iteration))
+                    obj.firing[speed] = firing
+                    tel.gauge(f"serve/slo_burn_{name}_{speed}").set(burn)
+                    res[f"{speed}_burn"] = round(burn, 4)
+                    res[f"{speed}_window_events"] = n
+                    res[f"{speed}_firing"] = firing
+                out[name] = res
+        return out
+
+    def first_alert(self, name: str, speed: str = "fast"
+                    ) -> Optional[Tuple[float, int]]:
+        """(engine-clock t, iteration) of the objective's first alert,
+        or None — the alert-leads-control gate compares this against the
+        brownout controller's reject_all transition."""
+        with self._lock:
+            return self._objs[name].first_alert.get(speed)
+
+    def state(self) -> dict:
+        """The ``/slo`` payload / report section: per-objective budgets,
+        burn alert counts, lifetime bad fractions, first-alert marks."""
+        with self._lock:
+            objectives = {}
+            for name, obj in self._objs.items():
+                spec = obj.spec
+                objectives[name] = {
+                    "target": spec.target,
+                    "budget": round(spec.budget, 6),
+                    "fast_window_s": spec.fast_window_s,
+                    "slow_window_s": spec.slow_window_s,
+                    "fast_burn_threshold": spec.fast_burn,
+                    "slow_burn_threshold": spec.slow_burn,
+                    "events_total": obj.total,
+                    "bad_total": obj.bad_total,
+                    "bad_frac": (round(obj.bad_total / obj.total, 6)
+                                 if obj.total else None),
+                    "alerts_fast": obj.alerts["fast"],
+                    "alerts_slow": obj.alerts["slow"],
+                    "firing_fast": obj.firing["fast"],
+                    "firing_slow": obj.firing["slow"],
+                    "first_alert": {
+                        speed: {"t": t, "iteration": it}
+                        for speed, (t, it) in
+                        sorted(obj.first_alert.items())},
+                }
+            doc = {"objectives": objectives}
+            if self.slo_ttft_ms is not None:
+                doc["slo_ttft_ms"] = self.slo_ttft_ms
+            if self.slo_tpot_ms is not None:
+                doc["slo_tpot_ms"] = self.slo_tpot_ms
+            return doc

@@ -40,13 +40,46 @@ from dtf_tpu.telemetry.names import validate
 
 _FLUSH_EVERY = 64          # buffered records between file flushes
 
+#: Size-based rotation defaults: the active ``spans.p<k>.jsonl`` rolls
+#: to ``spans.p<k>.NNN.jsonl`` once it crosses ROTATE_MAX_BYTES, and only
+#: the newest ROTATE_KEEP rotated files survive — a week-long serving run
+#: cannot fill the disk with span history, and the flight recorder
+#: (``/tracez``) covers the live tail anyway.
+ROTATE_MAX_BYTES = 64 << 20
+ROTATE_KEEP = 8
+
+
+def _rotated_path(path: str, seq: int) -> str:
+    """``spans.p0.jsonl`` + seq 3 -> ``spans.p0.003.jsonl``."""
+    base, ext = os.path.splitext(path)
+    return f"{base}.{seq:03d}{ext}"
+
+
+def _rotated_seqs(path: str) -> List[int]:
+    """Existing rotation sequence numbers for an active span path."""
+    import glob as _glob
+    base, ext = os.path.splitext(path)
+    out = []
+    for p in _glob.glob(f"{base}.*{ext}"):
+        mid = p[len(base) + 1:-len(ext)] if ext else p[len(base) + 1:]
+        if mid.isdigit():
+            out.append(int(mid))
+    return sorted(out)
+
 
 class Tracer:
-    """Span recorder bound to one JSONL file (or disabled when path=None)."""
+    """Span recorder bound to one JSONL file (or disabled when path=None).
 
-    def __init__(self, path: Optional[str] = None, process: int = 0):
+    ``max_bytes``/``keep`` arm size-based rotation (None = unbounded, the
+    scratch-Tracer default; :func:`configure` arms the module defaults
+    for the process-wide tracer so long runs are bounded by default)."""
+
+    def __init__(self, path: Optional[str] = None, process: int = 0,
+                 max_bytes: Optional[int] = None, keep: int = ROTATE_KEEP):
         self.path = path
         self.process = process
+        self.max_bytes = max_bytes
+        self.keep = keep
         self._f = None
         self._lock = threading.Lock()
         self._pending = 0
@@ -54,6 +87,16 @@ class Tracer:
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, "a", buffering=1 << 16)
+            seqs = _rotated_seqs(path)
+            self._rot_seq = (seqs[-1] + 1) if seqs else 0
+            # size tracked incrementally: f.tell() on a buffered text
+            # file FLUSHES first, which would defeat _FLUSH_EVERY
+            # batching on every emit (records are ASCII JSON, so char
+            # count == byte count)
+            try:
+                self._size = os.path.getsize(path)
+            except OSError:
+                self._size = 0
 
     @property
     def enabled(self) -> bool:
@@ -66,14 +109,35 @@ class Tracer:
         return stack
 
     def _emit(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._f.write(line)
+            self._size += len(line)
             self._pending += 1
             if self._pending >= _FLUSH_EVERY:
                 self._f.flush()
                 self._pending = 0
+            if self.max_bytes and self._size >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Roll the active file to ``spans.p<k>.NNN.jsonl`` and prune
+        rotations older than keep-last-M.  Caller holds the lock."""
+        self._f.flush()
+        self._f.close()
+        os.replace(self.path, _rotated_path(self.path, self._rot_seq))
+        self._rot_seq += 1
+        for seq in _rotated_seqs(self.path):
+            if seq <= self._rot_seq - 1 - self.keep:
+                try:
+                    os.remove(_rotated_path(self.path, seq))
+                except OSError:
+                    pass
+        self._f = open(self.path, "a", buffering=1 << 16)
+        self._pending = 0
+        self._size = 0
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[None]:
@@ -104,6 +168,40 @@ class Tracer:
                         "pid": self.process,
                         "tid": threading.get_ident() & 0xFFFF,
                         "args": args})
+
+    def emit_instant(self, name: str, args: Optional[Dict[str, Any]] = None,
+                     *, ts_us: Optional[float] = None,
+                     tid: Optional[int] = None, eager: bool = False) -> None:
+        """Raw instant record with explicit args/timestamp/lane — the
+        request tracer's high-rate path (NOT eagerly flushed by default,
+        unlike :meth:`instant`: request lifecycle events are frequent and
+        the flight-recorder ring covers the live tail)."""
+        if self._f is None:
+            return
+        validate(name)
+        self._emit({"name": name, "ph": "i",
+                    "ts": time.time() * 1e6 if ts_us is None else ts_us,
+                    "s": "p", "pid": self.process,
+                    "tid": (threading.get_ident() & 0xFFFF
+                            if tid is None else tid),
+                    "args": dict(args or {})})
+        if eager:
+            self.flush()
+
+    def emit_complete(self, name: str, ts_us: float, dur_us: float,
+                      args: Optional[Dict[str, Any]] = None,
+                      tid: Optional[int] = None) -> None:
+        """Raw Chrome-trace "X" (complete) record with explicit window —
+        for spans whose start was observed earlier than the emit (a
+        request's lifetime, closed at its terminal event)."""
+        if self._f is None:
+            return
+        validate(name)
+        self._emit({"name": name, "ph": "X", "ts": ts_us,
+                    "dur": max(dur_us, 0.0), "pid": self.process,
+                    "tid": (threading.get_ident() & 0xFFFF
+                            if tid is None else tid),
+                    "args": dict(args or {})})
 
     def instant(self, name: str, **attrs: Any) -> None:
         """Point event (chaos fault fired, peer died, ...); flushed
@@ -137,16 +235,24 @@ _NULL = Tracer(None)
 _TRACER = _NULL
 
 
-def configure(logdir: Optional[str], process: int = 0) -> Tracer:
+def configure(logdir: Optional[str], process: int = 0,
+              max_bytes: Optional[int] = None,
+              keep: Optional[int] = None) -> Tracer:
     """Install the process-wide tracer writing to
     ``<logdir>/spans.p<process>.jsonl`` (telemetry CONVENTION: per-process
     files so multi-host runs on a shared logdir never interleave writes).
+    Rotation is armed by default (module defaults; override per call) so
+    a long run's span history is bounded on disk.
     ``logdir=None`` uninstalls (back to the no-op tracer)."""
     global _TRACER
     if _TRACER is not _NULL:
         _TRACER.close()
     _TRACER = (Tracer(os.path.join(logdir, f"spans.p{process}.jsonl"),
-                      process=process) if logdir else _NULL)
+                      process=process,
+                      max_bytes=(ROTATE_MAX_BYTES if max_bytes is None
+                                 else max_bytes),
+                      keep=ROTATE_KEEP if keep is None else keep)
+               if logdir else _NULL)
     return _TRACER
 
 
@@ -182,8 +288,24 @@ def read_spans(path: str) -> List[dict]:
 
 
 def find_span_files(logdir: str) -> List[str]:
+    """Every span file under ``logdir`` — rotated generations
+    (``spans.p<k>.NNN.jsonl``) AND the active tail — ordered oldest-first
+    per process so readers see one chronological stream."""
     import glob
-    return sorted(glob.glob(os.path.join(logdir, "spans.p*.jsonl")))
+    import re
+    pat = re.compile(r"spans\.p(\d+)(?:\.(\d+))?\.jsonl$")
+
+    def key(path: str):
+        m = pat.search(os.path.basename(path))
+        if not m:
+            return (1 << 30, 1 << 30, path)
+        proc = int(m.group(1))
+        # rotated generations sort before the active (unnumbered) file
+        seq = int(m.group(2)) if m.group(2) is not None else 1 << 30
+        return (proc, seq, path)
+
+    return sorted(glob.glob(os.path.join(logdir, "spans.p*.jsonl")),
+                  key=key)
 
 
 def export_chrome_trace(logdir: str, out_path: str) -> int:

@@ -644,6 +644,23 @@ class Trainer:
         tel.configure(self.cfg.logdir
                       if self.cfg.telemetry and self.cfg.logdir else None,
                       jax.process_index())
+        # Live introspection window (telemetry/live.py): one admin
+        # server per PROCESS life — a supervisor's next attempt rebinds
+        # its probe onto the same server, so the operator's curl never
+        # drops across restarts.  Coordinator only: simulated multi-host
+        # rigs share one machine, and N processes cannot share one port.
+        self._admin_probe = None
+        if self.cfg.admin_port is not None and jax.process_index() == 0:
+            from dtf_tpu.telemetry.live import LivenessProbe, start_admin
+            # generous staleness: a training "beat" is one step, and a
+            # legitimate first step may spend minutes in compile
+            self._admin_probe = LivenessProbe(stale_after_s=600.0)
+            _admin = start_admin(self.cfg.admin_port,
+                                 probe=self._admin_probe)
+            import logging as _logging
+            _logging.getLogger("dtf_tpu").info(
+                "admin endpoint on http://127.0.0.1:%s "
+                "(/statz /healthz /tracez /slo)", _admin.port)
         if (self.cfg.resume and self.cfg.logdir
                 and self.cluster.is_coordinator
                 and tracker.accounted_s() == 0):
@@ -1385,7 +1402,9 @@ class Trainer:
                     # inflate goodput by whole compile seconds.
                     _pre_seen = self._compile_seen
                     _t_step = time.perf_counter()
-                    with tel.span("train/step"):
+                    # step-scoped span: --request-style drill-down and
+                    # the Perfetto view can land on an exact step
+                    with tel.span("train/step", step=self._host_step):
                         self.state, metrics = self._dispatch_step(batch,
                                                                   step_rng)
                     _dt_step = time.perf_counter() - _t_step
@@ -1398,6 +1417,8 @@ class Trainer:
                     self.last_metrics = metrics
                     count += 1
                     self._host_step += 1
+                    if self._admin_probe is not None:
+                        self._admin_probe.beat(self._host_step)
                     if self._watchdog is not None:
                         self._watchdog.tick()
                     if self._profiler is not None:

@@ -1,10 +1,18 @@
 #!/usr/bin/env python
 """Telemetry name lint (run by the full-suite telemetry lane and
-tests/test_telemetry.py): every metric/span name literal in the package
-must be snake_case/slash scoped AND declared in
-dtf_tpu/telemetry/names.py — the report CLI and dashboards key on those
-strings, and an undeclared name is a dashboard hole nobody notices until
-the post-mortem needs it.
+tests/test_telemetry.py), in BOTH directions:
+
+* source -> table: every metric/span name literal in the package must be
+  snake_case/slash scoped AND declared in dtf_tpu/telemetry/names.py —
+  the report CLI and dashboards key on those strings, and an undeclared
+  name is a dashboard hole nobody notices until the post-mortem needs
+  it;
+* runtime -> table: the process-wide registry must be STRICT — an
+  instrument registered at runtime (e.g. a name assembled from variables
+  that the AST lint could only see as a pattern) whose name no
+  declaration covers must be REJECTED at creation.  This check arms the
+  guard itself: it fails if the process registry would accept an
+  undeclared instrument.
 
 Usage: python scripts/check_telemetry_names.py
 Exit 0 when clean; prints one line per violation otherwise.
@@ -18,18 +26,51 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from dtf_tpu.telemetry.names import check_source_names  # noqa: E402
+from dtf_tpu.telemetry.registry import get_registry  # noqa: E402
+
+
+def check_runtime_guard() -> list:
+    """The reverse lint: the live registry must reject an undeclared
+    instrument at registration time (and still accept declared names,
+    exact and pattern-covered)."""
+    problems = []
+    reg = get_registry()
+    if not getattr(reg, "strict", False):
+        problems.append(
+            "process registry is not strict: runtime-registered "
+            "instruments are not checked against names.py")
+        return problems
+    probe = "lint_probe/definitely_not_declared"
+    try:
+        reg.counter(probe)
+    except ValueError:
+        pass
+    else:
+        problems.append(
+            f"process registry ACCEPTED undeclared instrument {probe!r} "
+            f"— the runtime guard is not enforcing names.py")
+    for name in ("serve/shed_deadline_expired",    # pattern serve/shed_*
+                 "checkpoint/saves_total"):        # exact declaration
+        try:
+            reg.counter(name)
+        except ValueError as exc:
+            problems.append(f"declared name {name!r} rejected at "
+                            f"runtime: {exc}")
+    return problems
 
 
 def main() -> int:
     paths = sorted(glob.glob(os.path.join(ROOT, "dtf_tpu", "**", "*.py"),
                              recursive=True))
     problems = check_source_names(paths)
+    problems += check_runtime_guard()
     for p in problems:
         print(p)
     if problems:
         print(f"{len(problems)} telemetry naming violation(s)")
         return 1
-    print(f"telemetry names OK ({len(paths)} files scanned)")
+    print(f"telemetry names OK ({len(paths)} files scanned + runtime "
+          f"registration guard armed)")
     return 0
 
 

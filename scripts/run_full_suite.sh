@@ -367,6 +367,97 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: TCP front-end tests (rc=$rc)"; tail -10 "$scdir2/tcp.log"; }
   rm -rf "$scdir2"
 fi
+# Live-introspection lane (DESIGN.md §6.4, ISSUE 11): a chaos'd
+# wall-clock serve session with --admin_port, scraped WHILE it runs
+# (/statz consistent snapshot, /healthz liveness, /tracez flight
+# recorder, /slo burn state); afterwards the on-disk request traces
+# must reconstruct gap-free chains (report --min_trace_complete_frac
+# 0.99 + the --request view), and the pinned-spike A/B must show the
+# fast-burn SLO alert firing strictly BEFORE brownout reject_all
+# (serve_load --chaos --check, gate alert_leads_control).  Skip with
+# NO_LIVE_LANE=1.
+if [ "${NO_LIVE_LANE:-0}" != "1" ]; then
+  echo "=== live-introspection lane (admin scrape + request traces + alert-leads-control) ==="
+  lidir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python - "$lidir" <<'PYEOF'
+import json, os, socket, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+logdir = os.path.join(d, "run")
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dtf_tpu.serve", "--preset", "tiny",
+     "--demo", "24", "--qps", "3", "--clock", "wall",
+     "--chaos", "slow_decode@5:40ms:60", "--brownout",
+     "--admin_port", str(port), "--logdir", logdir],
+    stdout=open(os.path.join(d, "serve.log"), "w"),
+    stderr=subprocess.STDOUT,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+def get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return json.loads(r.read())
+
+try:
+    statz = None
+    deadline = time.time() + 180
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            statz = get("/statz"); break
+        except OSError:
+            time.sleep(0.3)
+    assert statz is not None, "admin endpoint never came up"
+    assert "metrics" in statz and "goodput" in statz
+    health = get("/healthz")
+    assert health["ok"], health
+    slo = get("/slo")
+    assert "objectives" in slo and "ttft" in slo["objectives"], slo
+    # live scrape catches completed traces in the flight recorder
+    # while the engine is still serving
+    tracez = {"count": 0}
+    while time.time() < deadline and proc.poll() is None:
+        tracez = get("/tracez")
+        if tracez["count"] > 0:
+            break
+        time.sleep(0.3)
+    assert tracez["count"] > 0, "flight recorder stayed empty"
+    ev = tracez["traces"][0]["events"]
+    assert ev[0]["phase"] == "submit", ev
+finally:
+    # never leak the server (and never let a wait timeout mask the
+    # scrape assertion that got us here)
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rc = -1
+assert rc == 0, f"serve session exited {rc}"
+print(f"live scrape OK: statz {len(statz['metrics'])} instruments, "
+      f"tracez {tracez['count']} trace(s) mid-run")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: live admin scrape (rc=$rc)"; tail -10 "$lidir/serve.log" 2>/dev/null; }
+  python -m dtf_tpu.telemetry.report "$lidir/run" --check \
+      --min_trace_complete_frac 0.99 > "$lidir/report.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: request-trace gate (rc=$rc)"; tail -8 "$lidir/report.log"; }
+  python -m dtf_tpu.telemetry.report "$lidir/run" --request 0 \
+      > "$lidir/request.log" 2>&1 \
+    && grep -q "completed\|shed\|drained" "$lidir/request.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: --request view"; tail -5 "$lidir/request.log"; }
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.serve_load --preset tiny \
+      --clock virtual --mode continuous --chaos 'slow_decode@30:60ms' \
+      --deadline_ms 2500 --priorities 0,0,1 --output_lens 2,8,16 \
+      --qps 10 --requests 60 --check > "$lidir/ab.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: alert-leads-control A/B (rc=$rc)"; tail -8 "$lidir/ab.log"; }
+  grep -q "gate alert_leads_control: OK" "$lidir/ab.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: alert_leads_control gate line missing"; }
+  rm -rf "$lidir"
+fi
+
 # Scenario lane (DESIGN.md §8): the 2-cell mini-matrix through the real
 # cell runner with --check — one chaos-off GPT baseline cell (the
 # control row) and the host_down MNIST elastic cell (SIGKILL mid-run ->

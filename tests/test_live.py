@@ -1,0 +1,548 @@
+"""Live introspection plane (ISSUE 11): per-request tracing
+(telemetry/reqtrace.py), admin endpoint (telemetry/live.py), SLO
+burn-rate monitor (telemetry/slo.py), registry snapshot consistency,
+and span-file rotation.
+
+The ISSUE-level pins live here:
+
+* **/statz consistency** — a snapshot taken while writer threads update
+  counter PAIRS under ``registry.locked()`` never observes a torn pair;
+* **/tracez ring eviction order** — oldest terminal trace evicted
+  first, a replayed trace re-terminates at the back;
+* **trace completeness** — every completed request of a chaos'd
+  closed-loop run reconstructs a gap-free admission->prefill->
+  first_token->completion chain from the span files, INCLUDING across a
+  drain + replay (trace-id continuity);
+* **alert-leads-control** — under the pinned slow_decode spike the SLO
+  monitor's fast-burn alert fires strictly before the brownout
+  controller escalates to reject_all.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import dtf_tpu.telemetry as tel
+from dtf_tpu.telemetry import reqtrace
+from dtf_tpu.telemetry.live import AdminServer, LivenessProbe
+from dtf_tpu.telemetry.registry import MetricRegistry
+from dtf_tpu.telemetry.reqtrace import TraceRing
+from dtf_tpu.telemetry.slo import BurnRateMonitor, SLOSpec
+from dtf_tpu.telemetry.spans import Tracer, find_span_files, read_spans
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tel.reset()
+    yield
+    tel.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry: consistent snapshots, strict registration
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryConsistency:
+    def test_snapshot_never_tears_a_locked_pair(self):
+        """Writers increment two counters as one locked group; every
+        concurrent snapshot must see them EQUAL — the /statz contract."""
+        reg = MetricRegistry()
+        a = reg.counter("serve/shed_total")
+        b = reg.counter("serve/shed_deadline_expired")
+        stop = threading.Event()
+        torn = []
+
+        def writer():
+            while not stop.is_set():
+                with reg.locked():
+                    a.inc()
+                    b.inc()
+
+        def reader():
+            while not stop.is_set():
+                snap = reg.snapshot()
+                va = snap["serve/shed_total"]["value"]
+                vb = snap["serve/shed_deadline_expired"]["value"]
+                if va != vb:
+                    torn.append((va, vb))
+
+        threads = ([threading.Thread(target=writer) for _ in range(2)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert a.value == b.value > 0
+        assert not torn, f"torn snapshots observed: {torn[:5]}"
+
+    def test_strict_registry_rejects_undeclared(self):
+        with pytest.raises(ValueError, match="not declared"):
+            tel.counter("bogus/never_declared")
+        # exact and pattern-covered names still register
+        tel.counter("checkpoint/saves_total").inc()
+        tel.counter("serve/shed_some_new_reason").inc()
+        tel.gauge("serve/slo_burn_ttft_fast").set(1.5)
+
+    def test_scratch_registry_stays_shape_only(self):
+        reg = MetricRegistry()
+        reg.counter("anything/goes_here").inc()       # undeclared: fine
+        with pytest.raises(ValueError):
+            reg.counter("Not Snake Case")             # shape still holds
+
+    def test_locked_is_reentrant(self):
+        reg = MetricRegistry()
+        with reg.locked():
+            with reg.locked():
+                reg.counter("a/b").inc()
+        assert reg.snapshot()["a/b"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Span-file rotation
+# ---------------------------------------------------------------------------
+
+
+class TestSpanRotation:
+    def test_rotate_and_keep_last(self, tmp_path):
+        path = str(tmp_path / "spans.p0.jsonl")
+        tr = Tracer(path, process=0, max_bytes=1500, keep=2)
+        for i in range(300):
+            tr.instant("event/tick", i=i)
+        tr.close()
+        files = find_span_files(str(tmp_path))
+        names = [f.split("/")[-1] for f in files]
+        # active file last, rotated generations before it, only keep=2
+        assert names[-1] == "spans.p0.jsonl"
+        rotated = names[:-1]
+        assert 1 <= len(rotated) <= 2
+        assert all(n.startswith("spans.p0.") and n.endswith(".jsonl")
+                   for n in rotated)
+        # the newest records survive in the retained set
+        recs = [r for f in files for r in read_spans(f)]
+        assert recs[-1]["args"]["i"] == 299
+        # rotated files are in generation order (reader sees one stream)
+        seqs = [int(n.split(".")[2]) for n in rotated]
+        assert seqs == sorted(seqs)
+
+    def test_rotation_resumes_numbering(self, tmp_path):
+        path = str(tmp_path / "spans.p0.jsonl")
+        for _round in range(2):
+            tr = Tracer(path, process=0, max_bytes=800, keep=10)
+            for i in range(100):
+                tr.instant("event/tick", i=i)
+            tr.close()
+        seqs = sorted(int(f.split(".")[-2])
+                      for f in find_span_files(str(tmp_path))
+                      if f.split("/")[-1].count(".") == 3)
+        assert seqs == sorted(set(seqs)), "rotation seq collided"
+
+    def test_unrotated_default_unchanged(self, tmp_path):
+        tr = Tracer(str(tmp_path / "spans.p0.jsonl"))
+        for i in range(100):
+            tr.instant("event/tick", i=i)
+        tr.close()
+        assert len(find_span_files(str(tmp_path))) == 1
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder ring
+# ---------------------------------------------------------------------------
+
+
+class TestTraceRing:
+    def _finish(self, ring, tid, rid, status="completed"):
+        ring.event(tid, rid, "submit", 0.0)
+        ring.event(tid, rid, status, 1.0)
+
+    def test_eviction_order_is_terminal_order(self):
+        ring = TraceRing(capacity=3)
+        for rid in range(5):
+            self._finish(ring, f"t{rid}", rid)
+        snap = ring.snapshot()
+        assert [d["rid"] for d in snap] == [2, 3, 4]   # oldest evicted
+        assert len(ring) == 3
+
+    def test_replay_reterminates_at_the_back(self):
+        ring = TraceRing(capacity=2)
+        self._finish(ring, "ta", 0, status="drained")
+        self._finish(ring, "tb", 1)
+        # replay of ta: same trace id, second terminal -> back of ring
+        ring.event("ta", 0, "submit", 2.0, resubmit=True)
+        ring.event("ta", 0, "completed", 3.0)
+        snap = ring.snapshot()
+        assert [d["trace_id"] for d in snap] == ["tb", "ta"]
+        # the replayed doc kept BOTH segments' events
+        assert [e["phase"] for e in snap[1]["events"]] == [
+            "submit", "drained", "submit", "completed"]
+
+    def test_snapshot_n_keeps_newest(self):
+        ring = TraceRing(capacity=8)
+        for rid in range(5):
+            self._finish(ring, f"t{rid}", rid)
+        assert [d["rid"] for d in ring.snapshot(2)] == [3, 4]
+        assert ring.snapshot(0) == []     # count probe, not a full dump
+
+    def test_live_traces_not_exposed(self):
+        ring = TraceRing(capacity=2)
+        ring.event("tx", 7, "submit", 0.0)
+        assert ring.snapshot() == []                  # not terminal yet
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_burn_is_bad_fraction_over_budget(self):
+        mon = BurnRateMonitor([SLOSpec("ttft", 0.99, fast_window_s=10,
+                                       slow_window_s=100, min_events=4)])
+        for i in range(8):
+            mon.record("ttft", bad=(i < 2), t=1.0 + i * 0.1)
+        out = mon.update(2.0, iteration=0)
+        # 2 bad / 8 events = 0.25 bad frac; budget 0.01 -> burn 25
+        assert out["ttft"]["fast_burn"] == pytest.approx(25.0)
+        assert out["ttft"]["slow_burn"] == pytest.approx(25.0)
+
+    def test_min_events_guards_noise(self):
+        mon = BurnRateMonitor([SLOSpec("ttft", 0.99, fast_window_s=10,
+                                       slow_window_s=100, min_events=4)])
+        for i in range(3):
+            mon.record("ttft", bad=True, t=float(i))
+        out = mon.update(3.0, iteration=0)
+        assert out["ttft"]["fast_burn"] == 0.0        # 3 < min_events
+        assert not out["ttft"]["fast_firing"]
+
+    def test_window_trims_old_events(self):
+        mon = BurnRateMonitor([SLOSpec("ttft", 0.9, fast_window_s=5,
+                                       slow_window_s=50, min_events=1)])
+        for i in range(10):
+            mon.record("ttft", bad=True, t=float(i))   # t in [0, 9]
+        # at t=100 every event is outside even the slow window
+        out = mon.update(100.0, iteration=0)
+        assert out["ttft"]["fast_window_events"] == 0
+        assert out["ttft"]["fast_burn"] == 0.0
+
+    def test_alert_edge_triggered_and_first_alert_pinned(self):
+        mon = BurnRateMonitor([SLOSpec("ttft", 0.99, fast_window_s=10,
+                                       slow_window_s=100, min_events=2,
+                                       fast_burn=14.4)])
+        for i in range(4):
+            mon.record("ttft", bad=True, t=1.0 + 0.1 * i)
+        mon.update(2.0, iteration=5)                  # fires (edge)
+        mon.update(2.1, iteration=6)                  # still firing: no re-count
+        st = mon.state()["objectives"]["ttft"]
+        assert st["alerts_fast"] == 1
+        assert st["firing_fast"]
+        assert mon.first_alert("ttft") == (2.0, 5)
+        assert tel.counter("serve/slo_alert_fast_total").value == 1
+        # recovery then relapse: a second excursion counts again
+        for i in range(50):
+            mon.record("ttft", bad=False, t=3.0 + 0.01 * i)
+        mon.update(4.0, iteration=20)
+        assert not mon.state()["objectives"]["ttft"]["firing_fast"]
+        for i in range(60):
+            mon.record("ttft", bad=True, t=4.1 + 0.01 * i)
+        mon.update(5.0, iteration=30)
+        assert mon.state()["objectives"]["ttft"]["alerts_fast"] == 2
+        assert mon.first_alert("ttft") == (2.0, 5)    # FIRST stays first
+
+    def test_for_serving_shapes(self):
+        mon = BurnRateMonitor.for_serving(400.0, slo_tpot_ms=50.0)
+        assert mon.has("ttft") and mon.has("tpot") and mon.has("deadline")
+        assert mon.slo_ttft_ms == 400.0
+        st = mon.state()
+        assert st["slo_ttft_ms"] == 400.0
+        assert st["objectives"]["deadline"]["target"] == 0.999
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="target"):
+            SLOSpec("x", 1.5)
+        with pytest.raises(ValueError, match="shorter"):
+            SLOSpec("x", 0.99, fast_window_s=100, slow_window_s=10)
+        with pytest.raises(ValueError, match="objective"):
+            BurnRateMonitor([])
+
+
+# ---------------------------------------------------------------------------
+# Admin endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestAdminServer:
+    def test_endpoints_and_payloads(self):
+        ring = TraceRing(4)
+        ring.event("tt", 1, "submit", 0.0)
+        ring.event("tt", 1, "completed", 0.5)
+        mon = BurnRateMonitor.for_serving(400.0)
+        probe = LivenessProbe(stale_after_s=60.0)
+        srv = AdminServer(0, probe=probe, trace_ring=ring, slo=mon).start()
+        try:
+            probe.beat(12)
+            tel.counter("serve/requests_completed").inc(3)
+            code, statz = _get(srv.port, "/statz")
+            assert code == 200
+            assert statz["metrics"]["serve/requests_completed"][
+                "value"] == 3
+            assert "goodput" in statz
+            code, health = _get(srv.port, "/healthz")
+            assert code == 200 and health["ok"] and health["beats"] == 12
+            code, tracez = _get(srv.port, "/tracez")
+            assert code == 200 and tracez["count"] == 1
+            assert tracez["traces"][0]["trace_id"] == "tt"
+            code, slo = _get(srv.port, "/slo")
+            assert code == 200 and "objectives" in slo
+            code, idx = _get(srv.port, "/")
+            assert code == 200 and "/statz" in idx["endpoints"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/nope")
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+
+    def test_healthz_flips_on_stale_beat(self):
+        probe = LivenessProbe(stale_after_s=0.05)
+        srv = AdminServer(0, probe=probe).start()
+        try:
+            # never beaten: booting is OK (the loop may still be in init)
+            code, doc = _get(srv.port, "/healthz")
+            assert code == 200 and doc["phase"] == "booting"
+            probe.beat(1)
+            import time
+            time.sleep(0.2)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/healthz")
+            assert ei.value.code == 503
+        finally:
+            srv.close()
+
+    def test_statz_scrape_is_consistent_under_writers(self):
+        """The /statz half of the torn-pair pin: HTTP scrapes race real
+        writer threads updating a locked pair."""
+        srv = AdminServer(0).start()
+        stop = threading.Event()
+
+        def writer():
+            reg = tel.get_registry()
+            a = reg.counter("serve/shed_total")
+            b = reg.counter("serve/shed_deadline_expired")
+            while not stop.is_set():
+                with reg.locked():
+                    a.inc()
+                    b.inc()
+
+        w = threading.Thread(target=writer)
+        w.start()
+        try:
+            for _ in range(20):
+                _, doc = _get(srv.port, "/statz")
+                m = doc["metrics"]
+                if "serve/shed_total" not in m:
+                    continue
+                assert (m["serve/shed_total"]["value"]
+                        == m["serve/shed_deadline_expired"]["value"])
+        finally:
+            stop.set()
+            w.join()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: trace completeness, drain/replay continuity,
+# alert-leads-control (jax; shares the serve marker)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _mk_trace(n, *, qps=40.0, seed=3, deadline_ms=None, vocab=128):
+    rng = np.random.default_rng(seed)
+    trace, t = [], 0.0
+    for rid in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        kw = {"rid": rid,
+              "prompt": rng.integers(0, vocab, (int(rng.choice([3, 5, 8])),)
+                                     ).astype(np.int32),
+              "max_new_tokens": int(rng.choice([2, 4, 6]))}
+        if deadline_ms is not None:
+            kw["deadline_ms"] = deadline_ms
+        trace.append((t, kw))
+    return trace
+
+
+@pytest.mark.serve
+class TestReqTraceEngine:
+    def _engine(self, tiny_model, **kw):
+        from dtf_tpu.serve import ServingEngine, VirtualClock
+        model, params = tiny_model
+        kw.setdefault("clock", VirtualClock())
+        kw.setdefault("num_slots", 3)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("blocks_per_slot", 8)
+        return ServingEngine(model, params, **kw)
+
+    def test_chaosd_run_traces_are_complete(self, tiny_model, tmp_path):
+        """Every completed request of a chaos'd closed-loop run leaves a
+        gap-free admission->completion chain in the span files; the
+        evicted/cancelled victims leave attributed terminal events."""
+        from dtf_tpu.resilience.chaos import FaultPlan
+        logdir = str(tmp_path)
+        tel.configure(logdir)
+        chaos = FaultPlan.parse(
+            "slow_decode@6:30ms,client_drop@4,kv_poison@8",
+            process_index=0)
+        eng = self._engine(tiny_model, chaos=chaos)
+        eng.run(_mk_trace(16))
+        tel.get_tracer().flush()
+        traces = reqtrace.group_traces(
+            reqtrace.load_request_events(logdir))
+        comp = reqtrace.completeness(traces)
+        done = sum(1 for r in eng.results.values()
+                   if r.status == "completed")
+        assert comp["completed"] == done > 0
+        assert comp["complete_frac"] == 1.0, comp["incomplete"]
+        # chaos victims are attributed, not vanished
+        statuses = {t[-1]["phase"] for t in
+                    ([evs for evs in traces.values()])}
+        by_status = {}
+        for evs in traces.values():
+            term = [e for e in evs if e["phase"] in reqtrace.TERMINAL]
+            assert term, "trace with no terminal event"
+            by_status[term[-1]["phase"]] = by_status.get(
+                term[-1]["phase"], 0) + 1
+        assert by_status.get("cancelled", 0) >= 1     # client_drop victim
+        assert by_status.get("failed", 0) >= 1        # kv_poison victim
+        # and the flight recorder holds the same terminal set
+        assert len(eng.reqtrace.ring) == len(traces)
+
+    def test_trace_continuity_across_drain_and_replay(self, tiny_model,
+                                                      tmp_path):
+        """drain.jsonl replay docs carry the original trace id: the
+        replay engine's timeline joins the pre-drain one into ONE
+        complete per-request story (ISSUE 11 satellite)."""
+        logdir = str(tmp_path)
+        tel.configure(logdir)
+        eng = self._engine(tiny_model)
+        real_step = eng.step
+
+        def draining_step():
+            if eng.iterations == 3:
+                eng.request_drain()
+            return real_step()
+
+        eng.step = draining_step
+        eng.run(_mk_trace(10), drain_timeout_s=0.0)
+        assert eng.drained and eng.drain_docs, "drain produced no docs"
+        for doc in eng.drain_docs:
+            assert doc["trace_id"], "replay doc lost the trace id"
+        drained_ids = {d["rid"]: d["trace_id"] for d in eng.drain_docs}
+
+        # fresh engine = the supervisor's replay attempt
+        eng2 = self._engine(tiny_model)
+        for doc in eng.drain_docs:
+            assert doc["resubmit"] is True    # replay provenance is explicit
+            eng2.submit(np.asarray(doc["prompt"], np.int32),
+                        doc["max_new_tokens"],
+                        temperature=doc["temperature"],
+                        eos_id=doc["eos_id"],
+                        deadline_ms=doc["deadline_ms"],
+                        priority=doc["priority"], rid=doc["rid"],
+                        trace_id=doc["trace_id"],
+                        resubmit=doc["resubmit"])
+        eng2.run([])
+        tel.get_tracer().flush()
+        traces = reqtrace.group_traces(
+            reqtrace.load_request_events(logdir))
+        for rid, tid in drained_ids.items():
+            evs = traces[tid]
+            phases = [e["phase"] for e in evs]
+            # two segments under ONE id: drained then replayed-to-done
+            assert phases.count("submit") == 2
+            assert "drained" in phases
+            assert phases[-1] == "completed" or "completed" in phases
+            assert not reqtrace.chain_gaps(evs), (rid, phases)
+            # the replay segment is marked
+            resub = [e for e in evs if e.get("resubmit")]
+            assert len(resub) == 1
+        comp = reqtrace.completeness(traces)
+        assert comp["complete_frac"] == 1.0
+
+    def test_alert_leads_control_under_pinned_spike(self, tiny_model):
+        """The tentpole's same-trace CI claim, pinned as a unit test:
+        fast-burn fires strictly before brownout reject_all."""
+        from dtf_tpu.resilience.chaos import FaultPlan
+        from dtf_tpu.serve import BrownoutController
+        mon = BurnRateMonitor.for_serving(120.0)
+        eng = self._engine(
+            tiny_model,
+            brownout=BrownoutController(120.0),
+            chaos=FaultPlan.parse("slow_decode@8:40ms", process_index=0),
+            slo=mon, max_queue=256)
+        eng.run(_mk_trace(40, qps=30.0, deadline_ms=4000.0))
+        ra = eng.brownout.first_transition_to(3)
+        alert = mon.first_alert("ttft")
+        assert ra is not None, "pinned spike never reached reject_all"
+        assert alert is not None, "fast-burn alert never fired"
+        assert alert[1] < ra, (alert, ra)
+        # and summary() carries both marks for the bench gate
+        s = eng.summary(slo_ttft_ms=120.0)
+        assert s["brownout"]["reject_all_iteration"] == ra
+        assert (s["slo"]["objectives"]["ttft"]["first_alert"]["fast"]
+                ["iteration"] == alert[1])
+
+    def test_report_request_view_and_trace_gate(self, tiny_model,
+                                                tmp_path, capsys):
+        from dtf_tpu.telemetry import report as rep
+        logdir = str(tmp_path)
+        tel.configure(logdir)
+        eng = self._engine(tiny_model)
+        eng.run(_mk_trace(6))
+        eng.write_telemetry(logdir, slo_ttft_ms=400.0)
+        tel.get_tracer().flush()
+        report = rep.build_report(logdir)
+        rt = report["request_traces"]
+        assert rt["complete_frac"] == 1.0
+        ok, lines = rep.check_gates(report, min_trace_complete_frac=0.99)
+        assert ok, lines
+        # a stricter-than-perfect bound fails (falsifiability)
+        ok, lines = rep.check_gates(report, min_trace_complete_frac=1.01)
+        assert not ok
+        # the --request CLI view renders a timeline for a real rid
+        rid = next(r.rid for r in eng.results.values()
+                   if r.status == "completed")
+        rc = rep.main([logdir, "--request", str(rid)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "first_token" in out and "completed" in out
+        assert "engine_decode" in out     # iteration spans interleaved
+        # rendered report shows the section
+        text = rep.render(report)
+        assert "Request traces" in text and "complete_frac" in text
+
+    def test_trace_gate_fails_without_events(self, tiny_model, tmp_path):
+        """Absence is not a pass: a logdir with no reqtrace events fails
+        the armed gate (same rule as every other gate)."""
+        from dtf_tpu.telemetry import report as rep
+        report = rep.build_report(str(tmp_path))
+        ok, lines = rep.check_gates(report, min_trace_complete_frac=0.99)
+        assert not ok
+        assert any("not measured" in ln for ln in lines)
