@@ -376,5 +376,39 @@ def main(_init=init_backend, _preflight=preflight_probe) -> int:
     return 0 if _emit_once(line, emit_state) else 1
 
 
+def main_check_ledger(argv) -> int:
+    """``python bench.py --check-ledger [--ledger PATH] [--tol PCT]``:
+    the perf-regression gate over LEDGER.jsonl (scripts/bench_ledger.py
+    writes it from the BENCH_r*/MULTICHIP_r* round files).  The newest
+    green run per rig must hold >= (1 - tol) x the best prior green run
+    on that rig; a trailing error streak (the stalled r03-r05
+    ``tpu_unavailable`` trajectory) prints loud.  No benchmark runs —
+    this judges the committed history, so CI can arm it without a TPU."""
+    import argparse
+    p = argparse.ArgumentParser(prog="python bench.py --check-ledger")
+    p.add_argument("--check-ledger", action="store_true", required=True)
+    p.add_argument("--ledger", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "LEDGER.jsonl"))
+    p.add_argument("--tol", type=float, default=float(
+        os.environ.get("DTF_LEDGER_TOL_PCT", "10")))
+    ns = p.parse_args(argv)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from bench_ledger import check_ledger, read_ledger
+    try:
+        rows = read_ledger(ns.ledger)
+    except (OSError, ValueError) as exc:
+        print(f"ledger check: FAIL — cannot read {ns.ledger}: {exc}")
+        return 1
+    ok, lines = check_ledger(rows, tol_pct=ns.tol)
+    for line in lines:
+        print(line)
+    print(f"ledger check: {'OK' if ok else 'FAIL'} "
+          f"({len(rows)} row(s), tol {ns.tol:g}%)")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
+    if "--check-ledger" in sys.argv[1:]:
+        sys.exit(main_check_ledger(sys.argv[1:]))
     sys.exit(main())

@@ -278,6 +278,14 @@ class TrainConfig:
     # ephemeral port).  None disables.  Long training runs get the same
     # live window as the serving CLI's --admin_port.
     admin_port: Optional[int] = None
+    # Fleet observability plane (telemetry/fleet.py): a SHARED directory
+    # (GCS/NFS) or "tcp://host:port" every host can reach.  Arms
+    # fleet/sync barrier marks at the logging-sync and checkpoint
+    # boundaries, per-host book publication, and (on the coordinator)
+    # the live skew/blame attribution + /fleetz rollup + fleet.json.
+    # The multi-process test rigs configure the plane explicitly with
+    # their out-of-band identity instead (telemetry.fleet.configure).
+    fleet_dir: Optional[str] = None
     # Attempt tag for metrics.csv rows (telemetry/report de-duplicates
     # overlapping step ranges by latest attempt).  0 = automatic: any
     # resumed run — in-process supervisor restart or --resume relaunch —

@@ -72,11 +72,17 @@ _POISON_FILE = "poison.json"
 _SNAPSHOT_FILE = "health.json"
 
 
-def _atomic_write(path: str, data: str) -> None:
+def atomic_write(path: str, data: str) -> None:
+    """Write-then-rename so no reader ever observes a torn document —
+    the invariant every mesh-published snapshot (heartbeats, health.json,
+    the fleet plane's per-host docs) leans on."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(data)
     os.replace(tmp, path)
+
+
+_atomic_write = atomic_write          # internal spelling, kept for callers
 
 
 # ---------------------------------------------------------------------------

@@ -149,11 +149,21 @@ def main(spec_json: str, task: int, nproc: int, shared: str,
             fit_kwargs=fit_kwargs)
     else:
         from dtf_tpu.resilience.health import HealthMonitor, make_transport
+        from dtf_tpu.telemetry import fleet
 
         plan = (FaultPlan.parse(chaos, process_index=task) if chaos
                 else None)
         monitor = None
         if nproc > 1:
+            # Fleet plane (ISSUE 12): explicit identity, same pattern as
+            # the health mesh below — every host's span stream lands in
+            # the JUDGED logdir (host 0's) under its fleet index, so the
+            # cell's max_skew_ms / min_fleet_goodput gates read real
+            # cross-host attribution.  Relaunch rounds run nproc==1 and
+            # skip it; round-0's fleet.json and fleet/sync spans persist
+            # for the post-hoc judgement.
+            fleet.configure(os.path.join(shared, "fleet"), task, nproc,
+                            spans_dir=os.path.join(shared, "logs"))
             # 0.5s x 8 = a 4s miss budget (vs the mp rig's 1s): matrix
             # cells run back-to-back on a loaded CI box where a GC or
             # compile pause past 1s makes BOTH hosts poison each other

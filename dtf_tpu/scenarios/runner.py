@@ -180,4 +180,11 @@ def _measured(report: dict) -> dict:
         "deadline_violations": serving.get("deadline_violations"),
         "trace_complete_frac": report.get("request_traces", {})
         .get("complete_frac"),
+        # fleet plane (absent for single-host cells)
+        "fleet_skew_ms_p50": report.get("fleet", {})
+        .get("attribution", {}).get("skew_ms_p50"),
+        "fleet_barriers": report.get("fleet", {})
+        .get("attribution", {}).get("barriers"),
+        "fleet_goodput": report.get("fleet", {})
+        .get("rollup", {}).get("goodput", {}).get("productive_fraction"),
     }

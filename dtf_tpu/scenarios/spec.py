@@ -69,6 +69,15 @@ class Gate:
     #: files (0 = not armed; serve cells arm it so recovery is not just
     #: achieved but attributable).
     min_trace_complete_frac: float = 0.0
+    #: Fleet gates (ISSUE 12, telemetry/fleet.py; 0 = not armed) — the
+    #: multi-host cells arm them so pod-scale runs are judged on
+    #: ATTRIBUTABLE skew, not just survival: ceiling on the median
+    #: per-barrier arrival skew, floor on the fleet's joint productive
+    #: fraction (coordinator rollup), ceiling on any one host's share
+    #: of last-arrivals.
+    max_skew_ms: float = 0.0
+    min_fleet_goodput: float = 0.0
+    max_blame_frac: float = 0.0
 
     def thresholds(self) -> dict:
         """Kwargs for :func:`dtf_tpu.telemetry.report.check_gates` — the
@@ -90,6 +99,12 @@ class Gate:
             out["max_ttft_p99_ms"] = self.max_ttft_p99_ms
         if self.min_trace_complete_frac > 0:
             out["min_trace_complete_frac"] = self.min_trace_complete_frac
+        if self.max_skew_ms > 0:
+            out["max_skew_ms"] = self.max_skew_ms
+        if self.min_fleet_goodput > 0:
+            out["min_fleet_goodput"] = self.min_fleet_goodput
+        if self.max_blame_frac > 0:
+            out["max_blame_frac"] = self.max_blame_frac
         return out
 
 
@@ -289,8 +304,16 @@ def default_matrix() -> List[ScenarioSpec]:
             chaos=("slow_host@0:0:250ms,slow_host@0:1:100ms,"
                    "host_down@12:1"),
             timeout_s=600.0,
+            # Fleet gates (ISSUE 12): round 0's two hosts feed the fleet
+            # plane (skew from the 150 ms/step pacing differential —
+            # measured p50 ~0.8-2.5 s across box loads — and the joint
+            # goodput rollup); a 2-host cell that leaves no attributable
+            # skew books is a failing cell.  max_skew_ms sits far above
+            # the measured band because box-load variance inflates it,
+            # but absence or a pathological (>15 s) skew still fails.
             gate=Gate(max_final_cost=0.9, min_goodput=0.006,
-                      min_examples_per_s=50.0, max_rollbacks=0)),
+                      min_examples_per_s=50.0, max_rollbacks=0,
+                      max_skew_ms=15000.0, min_fleet_goodput=0.002)),
         ScenarioSpec(
             # THE serving cell (ISSUE 10): a closed-loop Poisson load
             # run with completion deadlines and mixed priority classes

@@ -84,3 +84,5 @@ def reset() -> None:
     configure(None)
     from dtf_tpu.telemetry import live as _live
     _live.stop_admin()
+    from dtf_tpu.telemetry import fleet as _fleet
+    _fleet.reset()

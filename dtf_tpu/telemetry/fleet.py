@@ -1,0 +1,1005 @@
+"""Fleet observability plane: cross-host trace merge, sync-point skew
+attribution, coordinator rollup (DESIGN.md §6.5).
+
+The telemetry spine (spans/registry/goodput) and the live plane stop at
+the process boundary: per-host files, per-host registries, a per-host
+``/statz``.  Every pod-scale question is a FLEET question — "which host
+gated this step", "what did its lateness cost", "is the fleet's goodput
+acceptable" (the MLPerf-pods and pjit/TPUv4 papers both attribute
+pod-scale step time to per-host skew at collective boundaries).  This
+module is that layer, in three coordinated pieces:
+
+**1. Cross-host trace merge with clock alignment.**  Every host emits a
+``fleet/sync`` span per fleet-wide barrier (the trainer's logging-sync
+allgather and checkpoint boundaries; barrier id = ``<kind>_<step>``):
+``ts`` is the host's barrier ARRIVAL on its own wall clock, ``dur`` the
+time it waited inside the barrier, so ``ts + dur`` is the barrier
+RELEASE.  A real collective releases every host at (nearly) the same
+true instant — the last arrival frees everyone — so release-stamp deltas
+between hosts are pure clock offset plus network jitter, and the median
+over many barriers (:func:`estimate_offsets`) recovers each host's
+offset without any clock protocol.  ``report --export-trace`` re-bases
+every host's span stream by its offset (``spans.export_chrome_trace``'s
+``offsets_s``) and emits one Perfetto track-group per host, so a fleet
+step reads as a single picture.
+
+**2. Sync-point skew attribution.**  At every barrier the per-host
+arrival deltas are ranked: the LAST arrival is the host that gated the
+fleet, its margin over the second-latest is the wall-clock it cost
+everyone, and the spread is the barrier's skew.  Booked live as
+``fleet/skew_ms`` / ``fleet/blame_p*`` / ``fleet/lateness_s_p*`` and
+judged post-hoc by :func:`attribute`, which also fits each host's
+arrival DRIFT (ms of lateness per step — a persistent straggler's
+injected delay reads straight off the slope).  In a real multi-host job
+the arrival stamps ride the SAME allgather that already powers
+``flag_stragglers`` (no new collectives); without cross-process
+collectives (the CPU-sim rig) the file mesh below carries them.
+
+**3. Coordinator fleet rollup.**  Each host publishes its registry
+snapshot + goodput books into a fleet mesh (``--fleet_dir``: a shared
+directory, or ``tcp://host:port`` — the same dual transport as
+``resilience/health.py``); the coordinator folds them into ONE
+consistent fleet cut (per-host docs are written atomically, aggregates
+computed from one read pass), served live at ``/fleetz`` on the admin
+endpoint and written to ``<logdir>/fleet.json`` for ``report --fleet``,
+whose gates (``--max_skew_ms``, ``--min_fleet_goodput``,
+``--max_blame_frac``) ride the ordinary ``check_gates``.
+
+Jax-free, stdlib + numpy only: importable from the report CLI and unit
+tests without a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dtf_tpu.resilience.health import atomic_write
+from dtf_tpu.telemetry import spans as _spans
+
+#: Rollup file name (written into the fleet logdir by the coordinator).
+FLEET_FILE = "fleet.json"
+#: Live skew samples kept for the /fleetz distribution (bounded).
+_SKEW_KEEP = 1024
+#: Sync events kept per host by the TCP mesh server (bounded).
+_TCP_SYNC_KEEP = 1024
+#: Live-plane bounds: booked-barrier ids remembered for dedup, pending
+#: (incomplete) barriers held for a lagging host, and release-delta
+#: samples per host feeding the live clock-offset estimate.  All sized
+#: far above any real window so a week-long run stays O(1) per sync
+#: point without ever forgetting a barrier it could still book.
+_BOOKED_KEEP = 4096
+_PENDING_KEEP = 1024
+_DELTA_KEEP = 64
+
+
+def barrier_id(kind: str, step: int) -> str:
+    """``("log", 40) -> "log_00000040"`` — zero-padded so lexical order
+    within a kind is step order."""
+    return f"{kind}_{int(step):08d}"
+
+
+def split_unix(t: float) -> "tuple[float, float]":
+    """Epoch seconds as a float32-survivable (hi, lo) pair.
+
+    The trainer rides arrival stamps on the straggler allgather, but
+    jax's default x64-off config canonicalizes any f64 payload to f32
+    on the multi-process device_put path — and f32 spacing at epoch
+    ~1.7e9 s is 128-256 s, which would quantize every host's stamp to
+    the same value and fabricate the blame.  The classic double-single
+    split survives: hi carries the f32-rounded seconds (identical
+    rounding on every host is irrelevant — each host rounds its OWN
+    stamp), lo the f64 remainder (|lo| <= 256 s, so its f32 resolution
+    is ~15 µs); :func:`merge_unix` reconstructs to microsecond-level
+    precision.  Pinned by a round-trip test at current epoch."""
+    hi = float(np.float32(t))
+    lo = float(np.float32(t - hi))
+    return hi, lo
+
+
+def merge_unix(hi: float, lo: float) -> float:
+    """Reconstruct :func:`split_unix`'s pair (after an f32 wire)."""
+    return float(np.float64(hi) + np.float64(lo))
+
+
+# ---------------------------------------------------------------------------
+# Pure attribution math (shared by the live plane and report --fleet)
+# ---------------------------------------------------------------------------
+
+
+def sync_events(records: List[dict]) -> List[dict]:
+    """``fleet/sync`` span records out of an already-parsed span stream,
+    as flat events: {pid, barrier, kind, step, arrive_s, wait_s}."""
+    out = []
+    for rec in records:
+        if rec.get("name") != "fleet/sync" or rec.get("ph") != "X":
+            continue
+        args = rec.get("args", {})
+        if "barrier" not in args:
+            continue
+        out.append({
+            "pid": int(args.get("host", rec.get("pid", 0))),
+            "barrier": args["barrier"],
+            "kind": args.get("kind", ""),
+            "step": int(args.get("step", 0)),
+            "arrive_s": float(rec.get("ts", 0.0)) / 1e6,
+            "wait_s": float(rec.get("dur", 0.0)) / 1e6,
+        })
+    return out
+
+
+def estimate_offsets(events: List[dict],
+                     reference: Optional[int] = None) -> Dict[int, float]:
+    """Per-host clock offsets (seconds, relative to ``reference`` — the
+    lowest pid by default) from shared barrier RELEASE stamps.
+
+    Only release-bearing events (``wait_s > 0``, i.e. the host measurably
+    waited inside a real barrier) feed the estimate: a collective's
+    release is simultaneous across hosts in true time, so
+    ``release_i - release_ref`` per shared barrier is that host's clock
+    offset plus jitter, and the median over barriers suppresses the
+    jitter.  Arrival stamps must NOT be used — arrivals differ by real
+    skew (that is the signal :func:`attribute` measures), and folding
+    them into the offset would cancel a persistent straggler's lateness.
+    A host with no release-bearing events shares no estimable clock edge
+    and gets offset 0.0 (correct on a single machine, flagged in the
+    report by ``offset_estimated=False``)."""
+    pids = sorted({e["pid"] for e in events})
+    if not pids:
+        return {}
+    releases: Dict[str, Dict[int, float]] = {}
+    for e in events:
+        if e["wait_s"] > 0:
+            releases.setdefault(e["barrier"], {})[e["pid"]] = (
+                e["arrive_s"] + e["wait_s"])
+    ref = pids[0] if reference is None else reference
+    offsets = {ref: 0.0}
+    for p in pids:
+        if p == ref:
+            continue
+        deltas = [rel[p] - rel[ref] for rel in releases.values()
+                  if p in rel and ref in rel]
+        offsets[p] = float(np.median(deltas)) if deltas else 0.0
+    return offsets
+
+
+def _rank_arrivals(arrivals: Dict[int, float]):
+    """``(last_pid, skew_s, margin_s)`` for one barrier's corrected
+    arrivals: the LAST host gated the fleet; its margin over the
+    second-latest is the wall-clock its lateness cost every other host
+    (the fleet critical-path contribution)."""
+    srt = sorted(arrivals.items(), key=lambda kv: (kv[1], kv[0]))
+    last_pid, last_t = srt[-1]
+    return last_pid, last_t - srt[0][1], last_t - srt[-2][1]
+
+
+def attribute(events: List[dict],
+              offsets: Optional[Dict[int, float]] = None) -> Optional[dict]:
+    """Post-hoc sync-point skew attribution over ``fleet/sync`` events.
+
+    Arrivals are corrected by ``offsets`` (see :func:`estimate_offsets`)
+    before ranking, so cross-host clock offset never masquerades as — or
+    masks — real skew.  Returns None when no barrier saw >= 2 hosts.
+
+    Per host, besides blame counts and accumulated cost ("lateness"),
+    the DRIFT is fitted: each host's arrival lateness relative to the
+    earliest arrival of the same barrier, regressed against the step —
+    a persistent per-step straggler shows its injected delay as the
+    slope (ms/step), which is the measurement the sharding planner's
+    A/B and the chaos tests key on.
+
+    Cost accounting distinguishes the two barrier shapes.  A RESYNCING
+    barrier (some host measurably waited inside it — a real collective)
+    realigns the fleet, so the last host's margin over the second-latest
+    is wall-clock paid afresh every window and sums directly.  An
+    OBSERVATIONAL barrier (file-mesh marks, nobody waits) carries the
+    straggler's ACCUMULATED lag, so only the INCREMENT of its relative
+    lateness since the previous barrier is new cost — summing raw
+    margins there would count the same lag once per barrier."""
+    offsets = offsets or {}
+    by_barrier: Dict[str, Dict[int, dict]] = {}
+    meta: Dict[str, tuple] = {}
+    for e in events:
+        by_barrier.setdefault(e["barrier"], {}).setdefault(e["pid"], e)
+        meta[e["barrier"]] = (e["step"], e["kind"])
+    pids = sorted({e["pid"] for e in events})
+    rows: List[dict] = []
+    blame: Dict[int, int] = {p: 0 for p in pids}
+    lateness: Dict[int, float] = {p: 0.0 for p in pids}
+    rel_by_pid: Dict[int, List[tuple]] = {p: [] for p in pids}
+    prev_rel: Dict[int, float] = {}
+    t_min, t_max = float("inf"), float("-inf")
+    for b in sorted(by_barrier, key=lambda b: (meta[b], b)):
+        evs = by_barrier[b]
+        if len(evs) < 2:
+            continue
+        arr = {p: ev["arrive_s"] - offsets.get(p, 0.0)
+               for p, ev in evs.items()}
+        last, skew, margin = _rank_arrivals(arr)
+        resync = any(ev.get("wait_s", 0.0) > 0 for ev in evs.values())
+        first_t = min(arr.values())
+        cost = (margin if resync
+                else max(arr[last] - first_t - prev_rel.get(last, 0.0),
+                         0.0))
+        blame[last] += 1
+        lateness[last] += cost
+        for p, t in arr.items():
+            rel_by_pid[p].append((meta[b][0], t - first_t))
+            prev_rel[p] = 0.0 if resync else t - first_t
+        rows.append({"barrier": b, "step": meta[b][0], "kind": meta[b][1],
+                     "hosts": len(arr), "last": last, "resync": resync,
+                     "skew_ms": skew * 1e3, "margin_ms": margin * 1e3,
+                     "cost_ms": cost * 1e3})
+        t_min = min(t_min, first_t)
+        t_max = max(t_max, max(arr.values()))
+    if not rows:
+        return None
+    n = len(rows)
+    skews = sorted(r["skew_ms"] for r in rows)
+    window = t_max - t_min
+    per_host = {}
+    for p in pids:
+        pts = rel_by_pid[p]
+        drift = None
+        steps = sorted({s for s, _ in pts})
+        if len(steps) >= 2:
+            xs = np.asarray([s for s, _ in pts], np.float64)
+            ys = np.asarray([r for _, r in pts], np.float64)
+            drift = float(np.polyfit(xs, ys, 1)[0]) * 1e3
+        per_host[p] = {
+            "last_arrivals": blame[p],
+            "blame_frac": round(blame[p] / n, 6),
+            "lateness_s": round(lateness[p], 6),
+            "cost_pct": (round(lateness[p] / window * 100.0, 4)
+                         if window > 0 else None),
+            "drift_ms_per_step": (None if drift is None
+                                  else round(drift, 4)),
+        }
+    return {
+        "barriers": n,
+        "hosts": pids,
+        "skew_ms_p50": round(skews[n // 2], 4),
+        "skew_ms_mean": round(sum(skews) / n, 4),
+        "skew_ms_max": round(skews[-1], 4),
+        "window_s": round(window, 6) if window > 0 else 0.0,
+        "per_host": {str(p): d for p, d in per_host.items()},
+        "recent_barriers": rows[-16:],
+    }
+
+
+def fleet_report(records: Optional[List[dict]] = None,
+                 rollup_doc: Optional[dict] = None) -> Optional[dict]:
+    """The report CLI's ``fleet`` section: span-based, offset-corrected
+    attribution (the post-hoc truth) plus the coordinator rollup's fleet
+    goodput cut.  None when neither source has fleet data.
+
+    When the span streams are NOT co-located (node-local logdirs, or
+    the tcp:// mesh — only the judged logdir's own spans are visible),
+    the coordinator's LIVE attribution persisted in ``fleet.json``
+    stands in, so the skew/blame gates still judge real measurements
+    instead of failing on absence; ``attribution_source`` names which
+    fed the section."""
+    out: dict = {}
+    if records:
+        events = sync_events(records)
+        if events:
+            offsets = estimate_offsets(events)
+            release_bearing = {e["pid"] for e in events if e["wait_s"] > 0}
+            out["hosts"] = sorted({e["pid"] for e in events})
+            out["offsets_s"] = {str(p): round(o, 6)
+                                for p, o in sorted(offsets.items())}
+            out["offset_estimated"] = {
+                str(p): p in release_bearing or p == min(offsets, default=0)
+                for p in sorted(offsets)}
+            att = attribute(events, offsets)
+            if att:
+                out["attribution"] = att
+                out["attribution_source"] = "spans"
+    if rollup_doc:
+        out["rollup"] = {
+            "nproc": rollup_doc.get("nproc"),
+            "written_unix": rollup_doc.get("written_unix"),
+            "hosts_reporting": sorted(rollup_doc.get("hosts", {})),
+            "goodput": rollup_doc.get("goodput"),
+        }
+        live = rollup_doc.get("attribution") or {}
+        if "attribution" not in out and live.get("barriers"):
+            blame = {p: int(c) for p, c in (live.get("blame") or {}).items()}
+            lateness = live.get("lateness_s") or {}
+            n = live["barriers"]
+            hosts = sorted(set(blame) | set(lateness), key=str)
+            out["attribution"] = {
+                "barriers": n,
+                "hosts": hosts,
+                "skew_ms_p50": live.get("skew_ms_p50"),
+                "skew_ms_mean": None,
+                "skew_ms_max": live.get("skew_ms_max"),
+                "window_s": None,
+                "per_host": {
+                    str(p): {
+                        "last_arrivals": blame.get(str(p), blame.get(p, 0)),
+                        "blame_frac": round(
+                            blame.get(str(p), blame.get(p, 0)) / n, 6),
+                        "lateness_s": lateness.get(
+                            str(p), lateness.get(p, 0.0)),
+                        "cost_pct": None,
+                        "drift_ms_per_step": None,
+                    } for p in hosts},
+            }
+            out["attribution_source"] = "rollup_live"
+    return out or None
+
+
+# ---------------------------------------------------------------------------
+# Fleet mesh transports (same dual shape as resilience/health.py)
+# ---------------------------------------------------------------------------
+
+
+class FileFleetMesh:
+    """Shared-directory transport: per-host sync streams as append-only
+    JSONL (single writer per file; readers drop a torn tail), per-host
+    book snapshots as atomically-replaced JSON docs (a reader can never
+    observe a torn per-host snapshot), plus ready-markers for the
+    startup rendezvous the 2-process rig uses."""
+
+    observes_peers = True
+
+    def __init__(self, directory: str, process: int):
+        self.directory = directory
+        self.process = process
+        os.makedirs(directory, exist_ok=True)
+        self._sync_path = os.path.join(directory,
+                                       f"fleet_sync_p{process}.jsonl")
+        # drain cursors: path -> byte offset.  The coordinator drains
+        # the sync streams at every sync point of a potentially
+        # week-long run; re-parsing whole files every poll would be
+        # O(run length) per poll, and RETAINING every parsed event
+        # would grow without bound — drain_syncs() parses only bytes
+        # past the cursor and hands the events to the caller (the
+        # plane's bounded pending-barrier ledger) without keeping them.
+        self._cursors: Dict[str, int] = {}
+
+    def append_sync(self, event: dict) -> None:
+        with open(self._sync_path, "a") as f:
+            f.write(json.dumps(event, separators=(",", ":")) + "\n")
+
+    def publish_host(self, doc: dict) -> None:
+        atomic_write(os.path.join(self.directory,
+                                  f"host_{self.process}.json"),
+                     json.dumps(doc, sort_keys=True))
+
+    def mark_ready(self) -> None:
+        atomic_write(os.path.join(self.directory,
+                                  f"ready_{self.process}"), "1")
+
+    def ready_count(self) -> int:
+        return sum(1 for name in os.listdir(self.directory)
+                   if name.startswith("ready_"))
+
+    def _sync_files(self):
+        for name in sorted(os.listdir(self.directory)):
+            if not (name.startswith("fleet_sync_p")
+                    and name.endswith(".jsonl")):
+                continue
+            try:
+                pid = int(name[len("fleet_sync_p"):-len(".jsonl")])
+            except ValueError:
+                continue
+            yield pid, os.path.join(self.directory, name)
+
+    def drain_syncs(self) -> Dict[int, List[dict]]:
+        """NEW sync events per host since the last drain — nothing is
+        retained here.  Only COMPLETE lines are consumed: a partial
+        tail (a writer mid-append) stays for the next poll, and one a
+        dead writer left behind is dropped forever — the same torn-tail
+        rule as the span readers."""
+        out: Dict[int, List[dict]] = {}
+        for pid, path in self._sync_files():
+            offset = self._cursors.get(path, 0)
+            try:
+                if os.path.getsize(path) <= offset:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except OSError:
+                continue
+            end = chunk.rfind(b"\n") + 1
+            events = []
+            for line in chunk[:end].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+            self._cursors[path] = offset + end
+            if events:
+                out[pid] = events
+        return out
+
+    def read_syncs(self) -> Dict[int, List[dict]]:
+        """FULL per-host sync streams (a fresh whole-file parse — the
+        debug/test view; the coordinator's hot path is
+        :meth:`drain_syncs`)."""
+        out: Dict[int, List[dict]] = {}
+        for pid, path in self._sync_files():
+            events = []
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            events.append(json.loads(line))
+                        except ValueError:
+                            continue       # torn tail from a hard kill
+            except OSError:
+                continue
+            out[pid] = events
+        return out
+
+    def read_hosts(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for name in sorted(os.listdir(self.directory)):
+            if not (name.startswith("host_") and name.endswith(".json")):
+                continue
+            try:
+                pid = int(name[len("host_"):-len(".json")])
+                with open(os.path.join(self.directory, name)) as f:
+                    out[pid] = json.load(f)
+            except (OSError, ValueError):
+                continue          # mid-replace or foreign file: skip
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class TcpFleetServer:
+    """Coordinator-side fleet sink for meshes with no shared filesystem
+    (same line-protocol shape as health's TcpHeartbeatServer):
+
+        sync <proc> <json>     ->  "ok"
+        host <proc> <json>     ->  "ok"
+        ready <proc>           ->  "ok <count>"
+        snapshot               ->  one JSON line {hosts, syncs-per-host}
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)
+        self.address = self._sock.getsockname()
+        self._lock = threading.Lock()
+        self._syncs: Dict[int, deque] = {}
+        self._fresh: deque = deque(maxlen=_TCP_SYNC_KEEP * 4)
+        self._hosts: Dict[int, dict] = {}
+        self._ready: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dtf_tpu-fleet-server")
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with conn:
+                    conn.settimeout(2.0)
+                    line = conn.makefile("r").readline().strip()
+                    try:
+                        reply = self._handle(line)
+                    except Exception as exc:
+                        # Same rule as the beat sink: a malformed request
+                        # must never kill the serve thread.
+                        reply = f"err {type(exc).__name__}"
+                    conn.sendall((reply + "\n").encode())
+            except OSError:
+                continue
+
+    def _handle(self, line: str) -> str:
+        parts = line.split(" ", 2)
+        with self._lock:
+            if parts[0] == "sync" and len(parts) == 3:
+                pid = int(parts[1])
+                event = json.loads(parts[2])
+                self._syncs.setdefault(
+                    pid, deque(maxlen=_TCP_SYNC_KEEP)).append(event)
+                self._fresh.append((pid, event))
+                return "ok"
+            if parts[0] == "host" and len(parts) == 3:
+                self._hosts[int(parts[1])] = json.loads(parts[2])
+                return "ok"
+            if parts[0] == "ready" and len(parts) >= 2:
+                self._ready.add(int(parts[1]))
+                return f"ok {len(self._ready)}"
+            if parts[0] == "snapshot":
+                return json.dumps({
+                    "hosts": {str(k): v for k, v in self._hosts.items()},
+                    "syncs": {str(k): list(v)
+                              for k, v in self._syncs.items()}})
+            return "err unknown command"
+
+    # -- coordinator-local accessors ----------------------------------------
+
+    def drain_syncs(self) -> Dict[int, List[dict]]:
+        """NEW sync events since the last drain (bounded buffer — a
+        coordinator that never drains cannot grow without bound)."""
+        with self._lock:
+            fresh = list(self._fresh)
+            self._fresh.clear()
+        out: Dict[int, List[dict]] = {}
+        for pid, event in fresh:
+            out.setdefault(pid, []).append(event)
+        return out
+
+    def read_syncs(self) -> Dict[int, List[dict]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._syncs.items()}
+
+    def read_hosts(self) -> Dict[int, dict]:
+        with self._lock:
+            return dict(self._hosts)
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return len(self._ready)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+class TcpFleetMesh:
+    """Client/coordinator facade over :class:`TcpFleetServer` — the
+    coordinator hosts the sink in-process (full observer); other hosts
+    push their sync events and book snapshots over TCP.  Sends are
+    best-effort: fleet observability must never wedge training on a
+    coordinator hiccup."""
+
+    def __init__(self, address: str, process: int, is_coordinator: bool):
+        host, _, port = address.partition(":")
+        self.process = process
+        self._server: Optional[TcpFleetServer] = None
+        if is_coordinator:
+            self._server = TcpFleetServer(host or "127.0.0.1", int(port))
+            self._addr = self._server.address
+        else:
+            self._addr = (host or "127.0.0.1", int(port))
+        self.observes_peers = is_coordinator
+        self._ready_seen = 0
+
+    def _request(self, line: str) -> Optional[str]:
+        try:
+            with socket.create_connection(self._addr, timeout=2.0) as conn:
+                conn.sendall((line + "\n").encode())
+                return conn.makefile("r").readline().strip()
+        except OSError:
+            return None
+
+    def append_sync(self, event: dict) -> None:
+        if self._server is not None:
+            self._server._handle(
+                f"sync {self.process} "
+                + json.dumps(event, separators=(',', ':')))
+        else:
+            self._request(f"sync {self.process} "
+                          + json.dumps(event, separators=(',', ':')))
+
+    def drain_syncs(self) -> Dict[int, List[dict]]:
+        return self._server.drain_syncs() if self._server else {}
+
+    def publish_host(self, doc: dict) -> None:
+        payload = json.dumps(doc, sort_keys=True)
+        if self._server is not None:
+            self._server._handle(f"host {self.process} {payload}")
+        else:
+            self._request(f"host {self.process} {payload}")
+
+    def mark_ready(self) -> None:
+        if self._server is not None:
+            self._server._handle(f"ready {self.process}")
+        else:
+            reply = self._request(f"ready {self.process}")
+            if reply and reply.startswith("ok "):
+                self._ready_seen = int(reply.split()[1])
+
+    def ready_count(self) -> int:
+        if self._server is not None:
+            return self._server.ready_count()
+        # a client learns the count from its own (re-sent) ready line
+        self.mark_ready()
+        return self._ready_seen
+
+    def read_syncs(self) -> Dict[int, List[dict]]:
+        return self._server.read_syncs() if self._server else {}
+
+    def read_hosts(self) -> Dict[int, dict]:
+        return self._server.read_hosts() if self._server else {}
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+
+def make_fleet_mesh(fleet_dir: str, process: int, is_coordinator: bool):
+    """``tcp://host:port`` selects the socket transport (no shared FS);
+    anything else is a shared rendezvous directory — the same rule as
+    :func:`dtf_tpu.resilience.health.make_transport`."""
+    if fleet_dir.startswith("tcp://"):
+        return TcpFleetMesh(fleet_dir[len("tcp://"):], process,
+                            is_coordinator)
+    return FileFleetMesh(fleet_dir, process)
+
+
+# ---------------------------------------------------------------------------
+# The per-process plane
+# ---------------------------------------------------------------------------
+
+
+class FleetPlane:
+    """One process's handle on the fleet plane (see module docstring).
+
+    Every host: :meth:`note_sync` at each fleet barrier (emits the
+    ``fleet/sync`` span and ships the arrival into the mesh),
+    :meth:`publish_books` at telemetry sync points.  The coordinator
+    additionally ingests completed barriers from the mesh into the live
+    ``fleet/*`` instruments and serves/writes the rollup
+    (:meth:`fleetz` / :meth:`write_rollup`).
+
+    Thread-safety: the lock covers the live attribution state, so a
+    concurrent ``/fleetz`` scrape reads one consistent cut of the skew
+    books; per-host docs are atomic at the mesh layer."""
+
+    def __init__(self, mesh, process: int, nproc: int,
+                 spans_dir: Optional[str] = None):
+        self.mesh = mesh
+        self.process = int(process)
+        self.nproc = int(nproc)
+        self.spans_dir = spans_dir
+        self.is_coordinator = self.process == 0
+        self._lock = threading.RLock()
+        # dedup ledger, bounded: a deque evicts the oldest remembered
+        # barrier id once _BOOKED_KEEP are held (barriers arrive in
+        # step order; a duplicate older than thousands of barriers
+        # cannot occur)
+        self._booked: set = set()
+        self._booked_order: deque = deque()
+        # incomplete barriers awaiting a lagging host's arrival:
+        # barrier -> {"arr": {pid: (t, w)}, "step": int, "kind": str}
+        self._pending: Dict[str, dict] = {}
+        # live clock-offset estimate vs THIS coordinator, from release
+        # stamps (t + w where w > 0) of shared barriers — the same
+        # math as estimate_offsets, kept as a bounded running median so
+        # the live blame ranking is offset-corrected too (a peer's NTP
+        # drift must not masquerade as lateness on /fleetz).  Until a
+        # release-bearing barrier has been seen for a peer its offset
+        # is 0 — exact on a single machine, converging within a few
+        # barriers on a real fleet; the post-hoc attribute() pass
+        # remains the precise source.
+        self._release_deltas: Dict[int, deque] = {}
+        self._offsets: Dict[int, float] = {}
+        self._barriers = 0
+        self._skews_ms: deque = deque(maxlen=_SKEW_KEEP)
+        self._blame: Dict[int, int] = {}
+        self._lateness: Dict[int, float] = {}
+        self._prev_rel: Dict[int, float] = {}
+        self._rev = 0
+
+    # -- feeding (every host) -----------------------------------------------
+
+    def note_sync(self, kind: str, step: int, *,
+                  arrival_unix: Optional[float] = None,
+                  wait_s: float = 0.0) -> None:
+        """This host reached fleet barrier ``<kind>_<step>``: emit the
+        ``fleet/sync`` span (arrival = ``ts``, in-barrier wait = ``dur``)
+        and ship the arrival into the mesh.  The coordinator then sweeps
+        the mesh for newly-completed barriers."""
+        t = time.time() if arrival_unix is None else float(arrival_unix)
+        b = barrier_id(kind, step)
+        _spans.get_tracer().emit_complete(
+            "fleet/sync", t * 1e6, wait_s * 1e6,
+            {"barrier": b, "kind": kind, "step": int(step),
+             "host": self.process})
+        try:
+            self.mesh.append_sync({"barrier": b, "kind": kind,
+                                   "step": int(step), "p": self.process,
+                                   "t": t, "w": wait_s})
+        except OSError:
+            pass              # observability must never kill the job
+        if self.is_coordinator:
+            self._ingest_mesh()
+
+    def note_barrier(self, kind: str, step: int,
+                     arrivals: Dict[int, float]) -> None:
+        """Direct booking from an in-band exchange: the trainer's
+        straggler allgather already moves one float per host per sync
+        point, and riding the arrival stamp on it costs no new
+        collective — every host sees the whole fleet's arrivals the
+        instant the barrier releases.  A collective RESYNCS the fleet,
+        so the last host's margin is fresh cost (see
+        :func:`attribute`)."""
+        self._book(barrier_id(kind, step), arrivals, resync=True)
+
+    def rendezvous(self, timeout_s: float = 120.0,
+                   poll_s: float = 0.05) -> bool:
+        """Startup alignment for the attribution rig: mark this host
+        ready and wait (bounded) until every host has — so compile-time
+        skew between hosts doesn't pollute the first barriers' blame.
+        Observational only; a production fleet's real collectives align
+        it anyway."""
+        try:
+            self.mesh.mark_ready()
+        except OSError:
+            return False
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if self.mesh.ready_count() >= self.nproc:
+                    return True
+            except OSError:
+                pass
+            time.sleep(poll_s)
+        return False
+
+    def publish_books(self) -> None:
+        """Publish THIS host's registry snapshot + goodput books into the
+        mesh (atomically: a rollup can never read a torn per-host cut).
+        ``rev``/``rev_echo`` bracket the doc so consistency is checkable
+        from the outside."""
+        from dtf_tpu.telemetry import goodput as _goodput
+        from dtf_tpu.telemetry import registry as _registry
+        with self._lock:
+            self._rev += 1
+            rev = self._rev
+        doc = {"process": self.process, "nproc": self.nproc,
+               "rev": rev, "written_unix": time.time(),
+               "goodput": _goodput.get_tracker().snapshot(),
+               "metrics": _registry.get_registry().snapshot(),
+               "rev_echo": rev}
+        try:
+            self.mesh.publish_host(doc)
+        except OSError:
+            pass
+
+    # -- coordinator --------------------------------------------------------
+
+    def _ingest_mesh(self) -> None:
+        """Drain NEW mesh events into the bounded pending-barrier
+        ledger, fold release stamps into the live clock-offset
+        estimate, and book each barrier every host has reached exactly
+        once.  Work per sync point is O(new events + pending), not
+        O(run length)."""
+        try:
+            drained = self.mesh.drain_syncs()
+        except OSError:
+            return
+        with self._lock:
+            for pid, events in drained.items():
+                for e in events:
+                    try:
+                        b = e["barrier"]
+                        p = int(e.get("p", pid))
+                        t = float(e["t"])
+                        w = float(e.get("w", 0.0))
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                    # NOTE: a barrier already booked in-band (the
+                    # allgather ride) still accumulates here — its
+                    # release stamps must reach the offset fold below;
+                    # _book itself dedups.
+                    doc = self._pending.setdefault(
+                        b, {"arr": {}, "step": int(e.get("step", 0)),
+                            "kind": e.get("kind", "")})
+                    doc["arr"].setdefault(p, (t, w))
+            ready = [b for b, doc in self._pending.items()
+                     if len(doc["arr"]) >= self.nproc]
+            ready.sort(key=lambda b: (self._pending[b]["step"],
+                                      self._pending[b]["kind"], b))
+            docs = [(b, self._pending.pop(b)) for b in ready]
+            for _, doc in docs:
+                self._fold_offsets_locked(doc["arr"])
+            # prune: a dead host's incomplete barriers must not pile up
+            if len(self._pending) > _PENDING_KEEP:
+                for b in sorted(
+                        self._pending,
+                        key=lambda b: (self._pending[b]["step"],
+                                       self._pending[b]["kind"], b)
+                )[:len(self._pending) - _PENDING_KEEP]:
+                    del self._pending[b]
+        # book in step order so the incremental (no-resync) cost math
+        # sees barriers in the order the fleet passed them
+        for b, doc in docs:
+            self._book(b, {p: t for p, (t, w) in doc["arr"].items()},
+                       resync=any(w > 0 for _, w in doc["arr"].values()))
+
+    def _fold_offsets_locked(self, arr: Dict[int, tuple]) -> None:
+        """Fold one completed barrier's release stamps (t + w, w > 0)
+        into the per-peer running clock-offset medians — the live twin
+        of :func:`estimate_offsets`, referenced to THIS coordinator.
+        Each barrier contributes each peer pair exactly once (folded
+        only at booking time)."""
+        ref = arr.get(self.process)
+        if ref is None or ref[1] <= 0:
+            return
+        ref_release = ref[0] + ref[1]
+        for p, (t, w) in arr.items():
+            if p == self.process or w <= 0:
+                continue
+            dq = self._release_deltas.setdefault(
+                p, deque(maxlen=_DELTA_KEEP))
+            dq.append((t + w) - ref_release)
+            self._offsets[p] = float(np.median(dq))
+
+    def _book(self, b: str, arrivals: Dict[int, float],
+              resync: bool) -> None:
+        if len(arrivals) < 2:
+            return
+        with self._lock:
+            if b in self._booked:
+                return
+            self._booked.add(b)
+            self._booked_order.append(b)
+            while len(self._booked_order) > _BOOKED_KEEP:
+                self._booked.discard(self._booked_order.popleft())
+            # rank offset-CORRECTED arrivals: a peer's clock offset
+            # (already estimated from release stamps) must not read as
+            # lateness — the /fleetz verdict and the post-hoc
+            # attribute() apply the same rule
+            arrivals = {p: t - self._offsets.get(p, 0.0)
+                        for p, t in arrivals.items()}
+            last, skew, margin = _rank_arrivals(arrivals)
+            first_t = min(arrivals.values())
+            # resync barriers pay the margin fresh each window; purely
+            # observational marks carry accumulated lag, so only the
+            # increment since the last barrier is new cost (same rule
+            # as attribute())
+            cost = (margin if resync
+                    else max(arrivals[last] - first_t
+                             - self._prev_rel.get(last, 0.0), 0.0))
+            for p, t in arrivals.items():
+                self._prev_rel[p] = 0.0 if resync else t - first_t
+            self._barriers += 1
+            self._skews_ms.append(skew * 1e3)
+            self._blame[last] = self._blame.get(last, 0) + 1
+            self._lateness[last] = self._lateness.get(last, 0.0) + cost
+        from dtf_tpu.telemetry import registry as _registry
+        reg = _registry.get_registry()
+        with reg.locked():
+            reg.counter("fleet/barriers_total").inc()
+            reg.histogram("fleet/skew_ms").observe(skew * 1e3)
+            reg.counter(f"fleet/blame_p{last}").inc()
+            reg.gauge(f"fleet/lateness_s_p{last}").add(cost)
+            reg.gauge("fleet/hosts").set(len(arrivals))
+
+    def fleetz(self) -> dict:
+        """ONE consistent fleet cut for ``/fleetz`` / ``fleet.json``:
+        live skew books under the plane lock, per-host docs read
+        atomically from the mesh, fleet goodput aggregated from exactly
+        the docs in this payload (sum of productive over sum of wall —
+        the fleet's joint fraction — plus the weakest host's own)."""
+        try:
+            hosts = self.mesh.read_hosts()
+        except OSError:
+            hosts = {}
+        with self._lock:
+            skews = sorted(self._skews_ms)
+            n = len(skews)
+            att = {
+                "barriers": self._barriers,
+                "skew_ms_p50": round(skews[n // 2], 4) if n else None,
+                "skew_ms_max": round(skews[-1], 4) if n else None,
+                "blame": {str(p): c
+                          for p, c in sorted(self._blame.items())},
+                "lateness_s": {str(p): round(s, 6)
+                               for p, s in sorted(self._lateness.items())},
+                # live clock-offset estimate vs this coordinator (0 =
+                # none measured yet; arrivals are ranked corrected)
+                "offsets_s": {str(p): round(o, 6) for p, o
+                              in sorted(self._offsets.items())},
+            }
+        prod = wall = 0.0
+        per_host = {}
+        for p, doc in sorted(hosts.items()):
+            g = doc.get("goodput", {}) if isinstance(doc, dict) else {}
+            prod += float(g.get("productive_s", 0.0))
+            wall += float(g.get("wall_s", 0.0))
+            per_host[str(p)] = g.get("productive_fraction")
+        fractions = [f for f in per_host.values() if f is not None]
+        return {
+            "written_unix": time.time(),
+            "coordinator": self.process,
+            "nproc": self.nproc,
+            "hosts_reporting": sorted(hosts),
+            "attribution": att,
+            "goodput": {
+                "productive_s_total": round(prod, 6),
+                "wall_s_total": round(wall, 6),
+                "productive_fraction": (round(prod / wall, 6)
+                                        if wall > 0 else None),
+                "per_host_fraction": per_host,
+                "min_host_fraction": (min(fractions)
+                                      if fractions else None),
+            },
+            "hosts": {str(p): doc for p, doc in sorted(hosts.items())},
+        }
+
+    def write_rollup(self) -> Optional[str]:
+        """Coordinator: fold the current fleet cut into
+        ``<spans_dir>/fleet.json`` (atomic) — the artifact ``report
+        --fleet`` judges."""
+        if not self.is_coordinator:
+            return None
+        out_dir = self.spans_dir or getattr(self.mesh, "directory", None)
+        if not out_dir:
+            return None
+        path = os.path.join(out_dir, FLEET_FILE)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            atomic_write(path, json.dumps(self.fleetz(), sort_keys=True))
+        except OSError:
+            return None
+        return path
+
+    def close(self) -> None:
+        try:
+            self.mesh.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Process-wide plane (the --fleet_dir entry)
+# ---------------------------------------------------------------------------
+
+_PLANE: Optional[FleetPlane] = None
+
+
+def configure(fleet_dir: Optional[str], process: int = 0, nproc: int = 1,
+              spans_dir: Optional[str] = None) -> Optional[FleetPlane]:
+    """Install the process-wide fleet plane (``fleet_dir`` = shared
+    directory or ``tcp://host:port``; ``spans_dir`` = the SHARED logdir
+    every host's span stream and the coordinator's ``fleet.json`` land
+    in).  ``fleet_dir=None`` uninstalls.  The multi-process rigs call
+    this BEFORE constructing the Trainer with their explicit identity
+    (the same pattern as their explicit HealthMonitor); the trainer
+    falls back to jax's process identity when only ``--fleet_dir`` is
+    set."""
+    global _PLANE
+    if _PLANE is not None:
+        _PLANE.close()
+        _PLANE = None
+    if fleet_dir:
+        _PLANE = FleetPlane(
+            make_fleet_mesh(fleet_dir, process, process == 0),
+            process, nproc, spans_dir=spans_dir)
+    return _PLANE
+
+
+def get_plane() -> Optional[FleetPlane]:
+    return _PLANE
+
+
+def reset() -> None:
+    configure(None)

@@ -23,7 +23,12 @@ Endpoints (all JSON, GET only):
   (:class:`~dtf_tpu.telemetry.reqtrace.TraceRing`): last-N completed
   request timelines, even when the process dies before any file flush;
 * ``/slo``    — the :class:`~dtf_tpu.telemetry.slo.BurnRateMonitor`
-  state (budgets, burn rates, alert history).
+  state (budgets, burn rates, alert history);
+* ``/fleetz`` — the fleet plane's coordinator rollup
+  (:meth:`~dtf_tpu.telemetry.fleet.FleetPlane.fleetz`): per-host books,
+  sync-point skew/blame attribution, fleet goodput — one consistent
+  fleet cut (per-host docs are atomic, the skew books read under the
+  plane lock).
 
 Threading model — the same discipline as ``serve/frontend.py``: handler
 threads NEVER touch the engine or trainer; every endpoint reads a
@@ -100,20 +105,22 @@ class AdminServer:
     def __init__(self, port: int, *, host: str = "127.0.0.1",
                  probe: Optional[LivenessProbe] = None,
                  trace_ring=None, slo=None,
-                 health_fn: Optional[Callable[[], Optional[dict]]] = None):
+                 health_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 fleet_fn: Optional[Callable[[], dict]] = None):
         self.host = host
         self._requested_port = int(port)
         self.probe = probe or LivenessProbe()
         self.trace_ring = trace_ring
         self.slo = slo
         self.health_fn = health_fn
+        self.fleet_fn = fleet_fn
         self._server = None
         self._thread = None
 
     # sources can be rebound between supervisor attempts (a fresh engine
     # per attempt, one server per process)
     def bind(self, *, probe=None, trace_ring=None, slo=None,
-             health_fn=None) -> "AdminServer":
+             health_fn=None, fleet_fn=None) -> "AdminServer":
         if probe is not None:
             self.probe = probe
         if trace_ring is not None:
@@ -122,6 +129,8 @@ class AdminServer:
             self.slo = slo
         if health_fn is not None:
             self.health_fn = health_fn
+        if fleet_fn is not None:
+            self.fleet_fn = fleet_fn
         return self
 
     @property
@@ -161,6 +170,14 @@ class AdminServer:
             return 200, {"slo": None, "note": "no SLO monitor armed"}
         return 200, self.slo.state()
 
+    def _fleetz(self) -> tuple:
+        # fleet_fn is FleetPlane.fleetz: the skew books are read under
+        # the plane lock and per-host docs are atomic at the mesh layer,
+        # so this is one consistent fleet cut, never a torn mix.
+        if self.fleet_fn is None:
+            return 200, {"fleet": None, "note": "no fleet plane armed"}
+        return 200, self.fleet_fn()
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> "AdminServer":
@@ -189,9 +206,12 @@ class AdminServer:
                         code, doc = admin._tracez(query)
                     elif url.path in ("/slo", "/slo/"):
                         code, doc = admin._slo()
+                    elif url.path in ("/fleetz", "/fleetz/"):
+                        code, doc = admin._fleetz()
                     elif url.path == "/":
                         code, doc = 200, {"endpoints": [
-                            "/statz", "/healthz", "/tracez", "/slo"]}
+                            "/statz", "/healthz", "/tracez", "/slo",
+                            "/fleetz"]}
                     else:
                         code, doc = 404, {"error": f"no such endpoint "
                                                    f"{url.path!r}"}

@@ -116,6 +116,16 @@ METRICS = (
     # live introspection endpoint (telemetry/live.py)
     "live/requests_total",        # admin HTTP requests served
     "live/errors_total",          # admin HTTP 4xx/5xx responses
+    # fleet plane (telemetry/fleet.py): sync-point skew attribution,
+    # booked by the coordinator as fleet barriers complete.  blame_p<k>
+    # counts the barriers host k arrived LAST at (it gated the fleet);
+    # lateness_s_p<k> accumulates its margin over the second-latest
+    # arrival (the wall-clock its lateness cost every other host).
+    "fleet/barriers_total",
+    "fleet/skew_ms",              # per-barrier arrival spread (histogram)
+    "fleet/blame_p*",             # last-arrival counters per host
+    "fleet/lateness_s_p*",        # accumulated critical-path margin
+    "fleet/hosts",                # hosts seen at the latest barrier
 )
 # spans (host-side tracer)
 SPANS = (
@@ -141,6 +151,11 @@ SPANS = (
     # shed / rejected / admitted / prefill / first_token / completed /
     # cancelled / failed / drained / lifetime
     "reqtrace/*",
+    # fleet barrier marks (telemetry/fleet.py): one complete-span per
+    # host per fleet-wide barrier; ts = local arrival, dur = in-barrier
+    # wait, so ts+dur is the release edge the clock-offset estimator
+    # aligns hosts on
+    "fleet/sync",
     # instants
     "chaos/*",                    # chaos/<fault kind> firing marks
     "health/*",                   # peer_stale / abort / poison marks

@@ -13,7 +13,10 @@ timeline, per-host step-time overlay, top spans, top XLA ops.
 the goodput components sum to measured wall-clock within ``--tol``
 percent (default 10) — the acceptance contract for the telemetry lane.
 ``--export-trace`` additionally writes the merged Chrome-trace JSON for
-Perfetto.
+Perfetto; on a fleet logdir (telemetry/fleet.py) every host's stream is
+re-based onto the reference clock first.  ``--fleet`` requires the
+fleet section, and ``--max_skew_ms`` / ``--min_fleet_goodput`` /
+``--max_blame_frac`` gate the cross-host skew attribution.
 """
 
 from __future__ import annotations
@@ -123,6 +126,7 @@ def build_report(logdir: str, profile_dir: Optional[str] = None,
             for k, v in sorted(hosts.items())}
 
     span_files = find_span_files(logdir)
+    records: List[dict] = []
     if span_files:
         from dtf_tpu.telemetry import reqtrace
         records = [rec for p in span_files for rec in read_spans(p)]
@@ -140,6 +144,24 @@ def build_report(logdir: str, profile_dir: Optional[str] = None,
             traces = reqtrace.group_traces(events)
             comp = reqtrace.completeness(traces)
             out["request_traces"] = {"total": len(traces), **comp}
+
+    # Fleet plane (telemetry/fleet.py): span-based, offset-corrected
+    # skew attribution + the coordinator's rollup cut.  Shares the one
+    # parsed record stream with the span summary above.
+    fleet_rollup = None
+    fpath = os.path.join(logdir, "fleet.json")
+    if os.path.exists(fpath):
+        try:
+            with open(fpath) as f:
+                fleet_rollup = json.load(f)
+        except ValueError:
+            pass
+    if span_files or fleet_rollup:
+        from dtf_tpu.telemetry import fleet as _fleet
+        section = _fleet.fleet_report(records=records,
+                                      rollup_doc=fleet_rollup)
+        if section:
+            out["fleet"] = section
 
     hpath = os.path.join(logdir, "health.json")
     if os.path.exists(hpath):
@@ -176,6 +198,9 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 min_goodput_qps: Optional[float] = None,
                 max_ttft_p99_ms: Optional[float] = None,
                 min_trace_complete_frac: Optional[float] = None,
+                max_skew_ms: Optional[float] = None,
+                min_fleet_goodput: Optional[float] = None,
+                max_blame_frac: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -206,7 +231,15 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       the full admission->prefill->first_token->completion chain from
       the span files (telemetry/reqtrace.py; drain/replay folded in by
       trace-id continuity).  No reqtrace events on disk = not measured
-      = FAIL, same absence rule as every other gate.
+      = FAIL, same absence rule as every other gate;
+    * ``max_skew_ms`` / ``min_fleet_goodput`` / ``max_blame_frac`` — the
+      FLEET gates (telemetry/fleet.py; report section ``fleet``):
+      ceiling on the median per-barrier arrival skew (offset-corrected),
+      floor on the fleet's joint productive fraction (sum of productive
+      over sum of wall across every reporting host, from the
+      coordinator rollup), and ceiling on any single host's share of
+      last-arrivals (a fleet where one host eats the blame budget is a
+      straggler diagnosis, not noise).
     """
     lines: List[str] = []
     ok = True
@@ -261,6 +294,23 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = report.get("request_traces", {}).get("complete_frac")
         gate("min_trace_complete_frac", None if v is None else float(v),
              min_trace_complete_frac, at_most=False)
+    fleet = report.get("fleet", {})
+    att = fleet.get("attribution", {})
+    if max_skew_ms is not None:
+        v = att.get("skew_ms_p50")
+        gate("max_skew_ms", None if v is None else float(v),
+             max_skew_ms, at_most=True)
+    if min_fleet_goodput is not None:
+        v = fleet.get("rollup", {}).get("goodput", {}) \
+            .get("productive_fraction")
+        gate("min_fleet_goodput", None if v is None else float(v),
+             min_fleet_goodput, at_most=False)
+    if max_blame_frac is not None:
+        shares = [h.get("blame_frac")
+                  for h in att.get("per_host", {}).values()
+                  if h.get("blame_frac") is not None]
+        gate("max_blame_frac", max(shares) if shares else None,
+             max_blame_frac, at_most=True)
     return ok, lines
 
 
@@ -418,6 +468,54 @@ def render(report: dict, top: int = 10) -> str:
             lines.append(f"  incomplete rid={inc.get('rid')} "
                          f"trace={inc.get('trace_id')}: "
                          f"{', '.join(inc.get('gaps', []))}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("Fleet (telemetry/fleet.py)")
+        att = fleet.get("attribution")
+        offs = fleet.get("offsets_s", {})
+        if offs:
+            est = fleet.get("offset_estimated", {})
+            detail = " ".join(
+                f"p{p}={float(o) * 1e3:+.3f}ms"
+                + ("" if est.get(str(p), est.get(p, True)) else "(assumed)")
+                for p, o in sorted(offs.items(), key=lambda kv: str(kv[0])))
+            lines.append(f"  {'clock offsets':<28} {detail}")
+        if att:
+            src = fleet.get("attribution_source")
+            lines.append(f"  {'barriers':<28} {att['barriers']:12d}"
+                         f"   hosts {att.get('hosts')}"
+                         + (f"   (source: {src})" if src else ""))
+
+            def _ms(v):
+                return "       n/a" if v is None else f"{v:10.3f}"
+
+            lines.append(f"  {'skew_ms p50/mean/max':<28} "
+                         f"{_ms(att.get('skew_ms_p50'))} /"
+                         f"{_ms(att.get('skew_ms_mean'))} /"
+                         f"{_ms(att.get('skew_ms_max'))}")
+            for p, h in sorted(att.get("per_host", {}).items(),
+                               key=lambda kv: -kv[1]["blame_frac"]):
+                drift = h.get("drift_ms_per_step")
+                cost = h.get("cost_pct")
+                lines.append(
+                    f"  p{p}: last-arrival {h['last_arrivals']:>4}x "
+                    f"({h['blame_frac'] * 100:5.1f}%)  "
+                    f"cost {h['lateness_s']:8.3f}s"
+                    + (f" ({cost:.2f}% of fleet window)"
+                       if cost is not None else "")
+                    + (f"  drift {drift:+.2f} ms/step"
+                       if drift is not None else ""))
+        roll = fleet.get("rollup")
+        if roll:
+            g = roll.get("goodput") or {}
+            frac = g.get("productive_fraction")
+            lines.append(
+                f"  rollup: {len(roll.get('hosts_reporting', []))} host(s) "
+                f"reporting, fleet goodput "
+                + ("n/a" if frac is None else f"{float(frac) * 100:.1f}%")
+                + (f" (weakest host "
+                   f"{float(g['min_host_fraction']) * 100:.1f}%)"
+                   if g.get("min_host_fraction") is not None else ""))
     if "steps" in report:
         s = report["steps"]
         lines.append(f"Steps: {s['first']}..{s['last']}  "
@@ -501,26 +599,58 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="observability gate: floor on the fraction of "
                         "completed requests with a gap-free "
                         "admission->completion trace chain")
+    p.add_argument("--fleet", action="store_true",
+                   help="require the fleet section (telemetry/fleet.py): "
+                        "fail when the logdir holds no fleet/sync spans "
+                        "and no fleet.json rollup; --export-trace then "
+                        "re-bases every host onto one clock")
+    p.add_argument("--max_skew_ms", type=float, default=None,
+                   help="fleet gate: ceiling on the median per-barrier "
+                        "arrival skew (offset-corrected)")
+    p.add_argument("--min_fleet_goodput", type=float, default=None,
+                   help="fleet gate: floor on the fleet's joint "
+                        "productive fraction (coordinator rollup)")
+    p.add_argument("--max_blame_frac", type=float, default=None,
+                   help="fleet gate: ceiling on any single host's share "
+                        "of last-arrivals (0..1)")
     p.add_argument("--request", type=int, default=None, metavar="RID",
                    help="print ONE request's causally-ordered timeline "
                         "(reqtrace events + the engine iterations that "
                         "touched it) instead of the full report")
+    p.add_argument("--pid", type=int, default=None,
+                   help="with --request: restrict the timeline to one "
+                        "host's span stream (rids are per-engine, so a "
+                        "merged fleet stream can carry the same rid on "
+                        "several hosts)")
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.logdir):
         print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
         return 2
     if ns.request is not None:
         from dtf_tpu.telemetry import reqtrace
-        events = reqtrace.request_timeline(ns.logdir, ns.request)
+        events = reqtrace.request_timeline(ns.logdir, ns.request,
+                                           pid=ns.pid)
         print(f"== request {ns.request} timeline: "
               f"{os.path.abspath(ns.logdir)} ==")
         for line in reqtrace.render_timeline(events):
             print(line)
         return 0 if events else 1
     report = build_report(ns.logdir, profile_dir=ns.profile_dir, top=ns.top)
+    if ns.fleet and not report.get("fleet"):
+        print("error: --fleet requested but the logdir holds no "
+              "fleet/sync spans and no fleet.json rollup "
+              "(is this a fleet run's shared logdir?)", file=sys.stderr)
+        return 1
     if ns.export_trace:
         from dtf_tpu.telemetry.spans import export_chrome_trace
-        n = export_chrome_trace(ns.logdir, ns.export_trace)
+        offsets = None
+        if report.get("fleet", {}).get("offsets_s"):
+            # fleet run: re-base every host's stream onto the reference
+            # clock so the exported trace is ONE timeline
+            offsets = {int(p): float(o) for p, o in
+                       report["fleet"]["offsets_s"].items()}
+        n = export_chrome_trace(ns.logdir, ns.export_trace,
+                                offsets_s=offsets)
         report["exported_trace_events"] = n
     if ns.json:
         print(json.dumps(report, indent=1, sort_keys=True, default=str))
@@ -536,7 +666,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_final_cost": ns.max_final_cost,
                   "min_goodput_qps": ns.min_goodput_qps,
                   "max_ttft_p99_ms": ns.max_ttft_p99_ms,
-                  "min_trace_complete_frac": ns.min_trace_complete_frac}
+                  "min_trace_complete_frac": ns.min_trace_complete_frac,
+                  "max_skew_ms": ns.max_skew_ms,
+                  "min_fleet_goodput": ns.min_fleet_goodput,
+                  "max_blame_frac": ns.max_blame_frac}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -256,13 +256,21 @@ def completeness(traces: Dict[str, List[dict]]) -> dict:
 
 
 def request_timeline(logdir: str, rid: int,
-                     records: Optional[List[dict]] = None) -> List[dict]:
+                     records: Optional[List[dict]] = None,
+                     pid: Optional[int] = None) -> List[dict]:
     """Every event of every trace carrying ``rid``, plus the engine
     iteration spans (``serve/prefill``/``serve/decode``) that touched
     it — the ``report --request`` view's data.  ONE parse pass: pass
     pre-parsed ``records`` (from :func:`read_all_records`) to reuse a
-    report's; ordering is read order (seq), same rule as
-    :func:`group_traces`."""
+    report's.
+
+    Fleet streams: rids are minted per ENGINE, so a merged multi-host
+    logdir can carry the same rid on several hosts — those are
+    *different requests*.  Ordering is therefore (pid, seq): within one
+    host, read order is emit order (the causal rule of
+    :func:`group_traces`); across hosts each segment renders contiguous
+    with its pid, never interleaved by wall-clock.  Pass ``pid`` to
+    restrict the view to one host's stream."""
     if records is None:
         records = read_all_records(logdir)
     # lifecycle instants via the ONE reqtrace parser (seq indexes into
@@ -278,6 +286,7 @@ def request_timeline(logdir: str, rid: int,
             events.append({"phase": "engine_decode",
                            "trace_id": None, "rid": rid,
                            "t": args.get("t", 0.0), "ts": rec.get("ts"),
+                           "pid": rec.get("pid"),
                            "seq": seq, "batch": args.get("batch"),
                            "iteration": args.get("iteration")})
         elif (rec.get("name") == "serve/prefill"
@@ -285,24 +294,37 @@ def request_timeline(logdir: str, rid: int,
             events.append({"phase": "engine_prefill",
                            "trace_id": None, "rid": rid,
                            "t": args.get("t", 0.0), "ts": rec.get("ts"),
+                           "pid": rec.get("pid"),
                            "seq": seq, "tokens": args.get("tokens")})
-    events.sort(key=lambda e: e.get("seq", 0))
+    if pid is not None:
+        events = [e for e in events if e.get("pid") == pid]
+    events.sort(key=lambda e: (e.get("pid") or 0, e.get("seq", 0)))
     return events
 
 
 def render_timeline(events: List[dict]) -> List[str]:
-    """Human-readable lines for one request's timeline."""
+    """Human-readable lines for one request's timeline.  When the merged
+    stream carries the rid on more than one host (per-engine rid spaces),
+    every line is prefixed with its host so the segments read as the
+    distinct requests they are."""
     if not events:
         return ["(no trace events for this request)"]
     lines = []
     tids = sorted({e["trace_id"] for e in events if e.get("trace_id")})
     lines.append(f"trace id(s): {', '.join(tids) or '(none)'}")
+    pids = {e.get("pid") for e in events}
+    multi_host = len(pids) > 1
+    if multi_host:
+        lines.append(f"hosts: {sorted(p for p in pids if p is not None)} "
+                     f"(rids are per-engine — same rid on different "
+                     f"hosts is a different request; --pid narrows)")
     for e in events:
         detail = " ".join(
             f"{k}={v}" for k, v in sorted(e.items())
             if k not in ("phase", "trace_id", "rid", "t", "ts", "pid",
                          "seq")
             and v is not None)
-        lines.append(f"  t={e.get('t', 0.0):10.4f}s  "
+        host = f"p{e.get('pid', 0)}  " if multi_host else ""
+        lines.append(f"  {host}t={e.get('t', 0.0):10.4f}s  "
                      f"{e['phase']:<16}" + (f" {detail}" if detail else ""))
     return lines

@@ -308,17 +308,35 @@ def find_span_files(logdir: str) -> List[str]:
                   key=key)
 
 
-def export_chrome_trace(logdir: str, out_path: str) -> int:
+def export_chrome_trace(logdir: str, out_path: str,
+                        offsets_s: Optional[Dict[int, float]] = None
+                        ) -> int:
     """Merge every ``spans.p*.jsonl`` under ``logdir`` into one Chrome-
     trace JSON (load in Perfetto / chrome://tracing; overlays with the
     XLA profiler's trace since both use epoch-µs timestamps).  Returns
-    the number of events written."""
+    the number of events written.
+
+    ``offsets_s`` (the fleet plane's estimated per-host clock offsets,
+    :func:`dtf_tpu.telemetry.fleet.estimate_offsets`) re-bases each
+    host's timestamps onto the reference host's clock before export, so
+    a multi-host run reads as ONE timeline — each host stays its own
+    named, sort-ordered Perfetto track-group."""
+    offsets_s = offsets_s or {}
     events: List[dict] = []
     for path in find_span_files(logdir):
         events.extend(read_spans(path))
-    for k in {e.get("pid", 0) for e in events}:
+    for e in events:
+        off = offsets_s.get(e.get("pid", 0))
+        if off and "ts" in e:
+            e["ts"] = e["ts"] - off * 1e6
+    for k in sorted({e.get("pid", 0) for e in events}):
+        off = offsets_s.get(k, 0.0)
+        label = (f"dtf_tpu host p{k}" if not off
+                 else f"dtf_tpu host p{k} (clock {off * 1e3:+.3f} ms)")
         events.append({"ph": "M", "pid": k, "name": "process_name",
-                       "args": {"name": f"dtf_tpu host p{k}"}})
+                       "args": {"name": label}})
+        events.append({"ph": "M", "pid": k, "name": "process_sort_index",
+                       "args": {"sort_index": k}})
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)

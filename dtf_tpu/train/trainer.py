@@ -638,12 +638,31 @@ class Trainer:
         tracker = tel.get_tracker()
         tracker.mark_up()
         _t_init = time.perf_counter()
+        # Fleet plane (telemetry/fleet.py): --fleet_dir arms it with
+        # jax's process identity; a plane the caller configured FIRST
+        # (the mp rigs, whose hosts are independent jax processes that
+        # all read process_index 0) wins, exactly like their explicit
+        # HealthMonitor.
+        from dtf_tpu.telemetry import fleet as _fleet
+        if self.cfg.fleet_dir and _fleet.get_plane() is None:
+            _fleet.configure(self.cfg.fleet_dir, jax.process_index(),
+                             jax.process_count(),
+                             spans_dir=self.cfg.logdir)
+        self._fleet = _fleet.get_plane()
         # Disabled telemetry must UNINSTALL any tracer a previous run in
         # this process configured, or this run's spans would pollute the
-        # earlier run's span file.
-        tel.configure(self.cfg.logdir
-                      if self.cfg.telemetry and self.cfg.logdir else None,
-                      jax.process_index())
+        # earlier run's span file.  Under a fleet plane the span stream
+        # goes to the SHARED fleet logdir under the plane's host index —
+        # cross-host trace merge needs one collection point and real
+        # per-host file names (per-process files never interleave).
+        _span_dir = (self.cfg.logdir
+                     if self.cfg.telemetry and self.cfg.logdir else None)
+        _span_proc = jax.process_index()
+        if self._fleet is not None:
+            _span_proc = self._fleet.process
+            if self._fleet.spans_dir and _span_dir:
+                _span_dir = self._fleet.spans_dir
+        tel.configure(_span_dir, _span_proc)
         # Live introspection window (telemetry/live.py): one admin
         # server per PROCESS life — a supervisor's next attempt rebinds
         # its probe onto the same server, so the operator's curl never
@@ -656,7 +675,10 @@ class Trainer:
             # legitimate first step may spend minutes in compile
             self._admin_probe = LivenessProbe(stale_after_s=600.0)
             _admin = start_admin(self.cfg.admin_port,
-                                 probe=self._admin_probe)
+                                 probe=self._admin_probe,
+                                 fleet_fn=(self._fleet.fleetz
+                                           if self._fleet is not None
+                                           else None))
             import logging as _logging
             _logging.getLogger("dtf_tpu").info(
                 "admin endpoint on http://127.0.0.1:%s "
@@ -1432,6 +1454,11 @@ class Trainer:
                             what=f"step {self._host_step} metrics")
                     if (self.ckpt is not None and self.cfg.checkpoint_every > 0
                             and self._host_step % self.cfg.checkpoint_every == 0):
+                        if self._fleet is not None:
+                            # checkpoint boundaries hit the same step on
+                            # every host — a natural fleet-wide barrier
+                            # mark (telemetry/fleet.py)
+                            self._fleet.note_sync("ckpt", self._host_step)
                         with self._suspended_watchdog(), \
                                 tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state)
@@ -1499,17 +1526,58 @@ class Trainer:
                             # are flagged to metrics and the published
                             # health snapshot.  The allgather waits on the
                             # slowest host, so it books as stall time.
-                            with tracker.measure("stall"):
-                                per_host = np.asarray(
-                                    multihost_utils.process_allgather(
-                                        np.asarray([avg_ms], np.float32))
-                                ).reshape(-1)
+                            # With a fleet plane armed, each host's
+                            # barrier-arrival stamp RIDES this same
+                            # allgather as a split (hi, lo) f32 pair —
+                            # epoch seconds overflow f32's mantissa, and
+                            # jax's x64-off canonicalization downcasts
+                            # any f64 payload on the multi-process path,
+                            # so fleet.split_unix/merge_unix carry the
+                            # precision instead (µs-level after the f32
+                            # wire).  Skew attribution thus adds no new
+                            # collective; the span's dur is the
+                            # in-barrier wait, i.e. the release edge the
+                            # clock-offset estimator aligns hosts on.
+                            if self._fleet is not None:
+                                from dtf_tpu.telemetry.fleet import (
+                                    merge_unix, split_unix)
+                                _arrive = time.time()
+                                _hi, _lo = split_unix(_arrive)
+                                with tracker.measure("stall"):
+                                    gathered = np.asarray(
+                                        multihost_utils.process_allgather(
+                                            np.asarray(
+                                                [avg_ms, _hi, _lo],
+                                                np.float32))
+                                    ).reshape(-1, 3)
+                                self._fleet.note_sync(
+                                    "log", step, arrival_unix=_arrive,
+                                    wait_s=max(time.time() - _arrive, 0.0))
+                                self._fleet.note_barrier(
+                                    "log", step,
+                                    {i: merge_unix(row[1], row[2])
+                                     for i, row in enumerate(gathered)})
+                                per_host = gathered[:, 0]
+                            else:
+                                with tracker.measure("stall"):
+                                    per_host = np.asarray(
+                                        multihost_utils.process_allgather(
+                                            np.asarray([avg_ms],
+                                                       np.float32))
+                                    ).reshape(-1)
                             flagged = flag_stragglers(
                                 per_host, cfg.straggler_factor)
                             self.logger.stragglers(step, per_host, flagged)
                             if health is not None:
                                 health.note_stragglers(step, per_host,
                                                        flagged)
+                        elif self._fleet is not None:
+                            # No straggler allgather to ride: the barrier
+                            # mark travels through the fleet mesh (file
+                            # or TCP) instead — the CPU-sim rig's path,
+                            # whose jaxlib has no cross-process
+                            # collectives.
+                            self._fleet.note_sync("log", step)
                         # Telemetry sync point: steps/throughput/MFU
                         # gauges, then the registry->disk snapshot and the
                         # forced flush that keeps the crash-safety
@@ -1570,6 +1638,14 @@ class Trainer:
                                 tel.write_telemetry_json(self.cfg.logdir)
                             except OSError:   # kill the training loop
                                 pass
+                        if self._fleet is not None:
+                            # Every host ships its books into the fleet
+                            # mesh; the coordinator folds them (plus the
+                            # live skew attribution) into fleet.json —
+                            # the /fleetz payload, persisted.
+                            self._fleet.publish_books()
+                            if self._fleet.is_coordinator:
+                                self._fleet.write_rollup()
                 if preempted or hit_cap:
                     break
                 if splits.test is not None:
@@ -1688,6 +1764,13 @@ class Trainer:
                 tel.write_telemetry_json(self.cfg.logdir)
             except OSError:
                 pass
+        if self._fleet is not None:
+            # Final fleet cut: the last barriers and the completed books
+            # must be in fleet.json before the process exits.
+            self._fleet.publish_books()
+            if self._fleet.is_coordinator:
+                self._fleet.write_rollup()
+            tel.get_tracer().flush()
         return {"test_accuracy": ev["accuracy"], "final_cost": last_cost,
                 "steps": int(self.state["step"]), "total_s": timer.total_s(),
                 "preempted": preempted,

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Perf-regression ledger: fold the loose ``BENCH_r*.json`` /
+``MULTICHIP_r*.json`` round files into one machine-readable
+``LEDGER.jsonl`` — one row per run with rig, commit, TFLOP/s, MFU
+(roofline fraction) and, for failed rounds, the error + stage.
+
+The round files alone hide the trajectory: r01-r02 held ~193 TFLOP/s at
+~98% of roofline, then r03-r05 all died on ``tpu_unavailable`` relay
+hangs — five loose JSON files in the repo root, invisible unless you
+open each.  The ledger makes that one ``jq``-able stream, and
+``python bench.py --check-ledger`` turns it into a CI gate: the newest
+green run on each rig must not regress against the best prior green run
+on the same rig (``DTF_LEDGER_TOL_PCT``, default 10), and a trailing
+error streak prints loud instead of rotting silently.
+
+Usage:
+    python scripts/bench_ledger.py [--repo DIR] [--out LEDGER.jsonl]
+    python bench.py --check-ledger [--ledger LEDGER.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+
+def _added_commit(repo: str, filename: str) -> "str | None":
+    """The commit that first added ``filename`` (the round files carry no
+    commit of their own) — best-effort: None outside a git checkout."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "--diff-filter=A", "--format=%h", "-n", "1",
+             "--", filename],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        return out.stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _classify_legacy_tail(tail: str) -> "tuple[str, str]":
+    """Rounds recorded before the structured failure line (r03: a raw
+    traceback, parsed=null) still classify: the relay's signature error
+    strings are stable."""
+    low = (tail or "").lower()
+    if "unavailable" in low and ("tpu" in low or "backend" in low):
+        return "tpu_unavailable", "legacy_traceback"
+    return "benchmark_error", "legacy_traceback"
+
+
+def bench_row(path: str, repo: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    run = os.path.splitext(os.path.basename(path))[0]
+    row = {
+        "run": run,
+        "kind": "bench",
+        "n": doc.get("n"),
+        "commit": _added_commit(repo, os.path.basename(path)),
+        "rig": None,
+        "tflops_per_chip": None,
+        "mfu": None,               # roofline fraction, 0..1
+        "vs_baseline": None,
+        "ok": False,
+        "error": None,
+        "stage": None,
+    }
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("error"):
+        detail = parsed.get("detail") or {}
+        row.update(error=parsed["error"], stage=detail.get("stage"),
+                   rig=detail.get("device"))
+    elif isinstance(parsed, dict) and parsed.get("value") is not None:
+        detail = parsed.get("detail") or {}
+        row.update(
+            ok=doc.get("rc", 1) == 0,
+            rig=detail.get("device"),
+            tflops_per_chip=float(parsed["value"]),
+            mfu=detail.get("roofline_fraction"),
+            vs_baseline=parsed.get("vs_baseline"))
+    else:
+        err, stage = _classify_legacy_tail(doc.get("tail", ""))
+        row.update(error=err, stage=stage)
+    return row
+
+
+def multichip_row(path: str, repo: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    run = os.path.splitext(os.path.basename(path))[0]
+    ok = bool(doc.get("ok")) and not doc.get("skipped")
+    row = {
+        "run": run,
+        "kind": "multichip",
+        "n": doc.get("n", _run_index(run)),
+        "commit": _added_commit(repo, os.path.basename(path)),
+        "rig": (f"{doc.get('n_devices')}dev"
+                if doc.get("n_devices") else None),
+        "tflops_per_chip": None,
+        "mfu": None,
+        "vs_baseline": None,
+        "ok": ok,
+        "error": None if ok else "multichip_failed",
+        "stage": None if ok else ("skipped" if doc.get("skipped")
+                                  else "dryrun"),
+    }
+    return row
+
+
+def _run_index(run: str) -> "int | None":
+    m = re.search(r"_r(\d+)$", run)
+    return int(m.group(1)) if m else None
+
+
+def build_ledger(repo: str) -> "list[dict]":
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        rows.append(bench_row(path, repo))
+    for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
+        rows.append(multichip_row(path, repo))
+    # one stream, ordered (kind, round) so the per-rig trajectory reads
+    # top to bottom
+    rows.sort(key=lambda r: (r["kind"], r["n"] if r["n"] is not None
+                             else _run_index(r["run"]) or 0))
+    return rows
+
+
+def write_ledger(rows: "list[dict]", out_path: str) -> None:
+    with open(out_path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+
+
+def read_ledger(path: str) -> "list[dict]":
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
+                 ) -> "tuple[bool, list[str]]":
+    """The regression gate ``bench.py --check-ledger`` runs.
+
+    Per rig (bench rows only — multichip rows are pass/fail dryruns):
+    the NEWEST green run must hold at least ``(1 - tol) x`` the best of
+    the EARLIER green runs on that rig.  A trailing streak of error rows
+    (the stalled r03-r05 shape) prints loud as a warning — an outage is
+    visible, not a perf regression.  Returns (ok, verdict lines)."""
+    lines: "list[str]" = []
+    ok = True
+    bench = sorted((r for r in rows if r.get("kind") == "bench"),
+                   key=lambda r: r.get("n") or 0)
+    by_rig: "dict[str, list[dict]]" = {}
+    for r in bench:
+        if r.get("ok") and r.get("tflops_per_chip") and r.get("rig"):
+            by_rig.setdefault(r["rig"], []).append(r)
+    if not by_rig:
+        lines.append("ledger: no green bench rows — nothing to compare")
+    for rig, greens in sorted(by_rig.items()):
+        latest = greens[-1]
+        prior = greens[:-1]
+        if not prior:
+            lines.append(
+                f"ledger[{rig}]: OK — first green run "
+                f"{latest['run']} at {latest['tflops_per_chip']:g} "
+                f"TFLOP/s (no prior to compare)")
+            continue
+        best = max(prior, key=lambda r: r["tflops_per_chip"])
+        floor = best["tflops_per_chip"] * (1.0 - tol_pct / 100.0)
+        passed = latest["tflops_per_chip"] >= floor
+        ok = ok and passed
+        lines.append(
+            f"ledger[{rig}]: {'OK' if passed else 'REGRESSION'} — "
+            f"{latest['run']} {latest['tflops_per_chip']:g} TFLOP/s vs "
+            f"best prior green {best['run']} "
+            f"{best['tflops_per_chip']:g} (floor {floor:g}, "
+            f"tol {tol_pct:g}%)")
+    # trailing error streak: the stalled-trajectory alarm
+    streak = []
+    for r in reversed(bench):
+        if r.get("error"):
+            streak.append(r)
+        else:
+            break
+    if streak:
+        streak.reverse()
+        reasons = {f"{r.get('error')}@{r.get('stage')}" for r in streak}
+        lines.append(
+            f"ledger WARNING: last {len(streak)} bench run(s) errored "
+            f"({', '.join(sorted(reasons))}) — "
+            f"{streak[0]['run']}..{streak[-1]['run']}; the perf "
+            f"trajectory is STALLED, fresh numbers needed")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/bench_ledger.py",
+        description="Fold BENCH_r*/MULTICHIP_r* rounds into LEDGER.jsonl")
+    p.add_argument("--repo", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p.add_argument("--out", default=None,
+                   help="output path (default <repo>/LEDGER.jsonl)")
+    p.add_argument("--check", action="store_true",
+                   help="also run the regression gate on the fresh rows")
+    ns = p.parse_args(argv)
+    rows = build_ledger(ns.repo)
+    out = ns.out or os.path.join(ns.repo, "LEDGER.jsonl")
+    write_ledger(rows, out)
+    print(f"wrote {len(rows)} row(s) to {out}")
+    if ns.check:
+        ok, lines = check_ledger(rows)
+        for line in lines:
+            print(line)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,17 +40,24 @@ def check_runtime_guard() -> list:
             "process registry is not strict: runtime-registered "
             "instruments are not checked against names.py")
         return problems
-    probe = "lint_probe/definitely_not_declared"
-    try:
-        reg.counter(probe)
-    except ValueError:
-        pass
-    else:
-        problems.append(
-            f"process registry ACCEPTED undeclared instrument {probe!r} "
-            f"— the runtime guard is not enforcing names.py")
+    for probe in ("lint_probe/definitely_not_declared",
+                  # the fleet/* family is declared as exact names plus
+                  # the per-host '*' patterns — a near-miss outside them
+                  # must still be rejected
+                  "fleet/definitely_not_declared"):
+        try:
+            reg.counter(probe)
+        except ValueError:
+            pass
+        else:
+            problems.append(
+                f"process registry ACCEPTED undeclared instrument "
+                f"{probe!r} — the runtime guard is not enforcing "
+                f"names.py")
     for name in ("serve/shed_deadline_expired",    # pattern serve/shed_*
-                 "checkpoint/saves_total"):        # exact declaration
+                 "checkpoint/saves_total",         # exact declaration
+                 "fleet/blame_p3",                 # pattern fleet/blame_p*
+                 "fleet/barriers_total"):          # exact (fleet family)
         try:
             reg.counter(name)
         except ValueError as exc:

@@ -33,18 +33,35 @@ if [ "${NO_TELEMETRY_LANE:-0}" != "1" ]; then
   python scripts/check_telemetry_names.py \
     || { FAILS=$((FAILS + 1)); echo "FAILED: telemetry name lint"; }
   tdir=$(mktemp -d)
+  # Environment-sized flake fix (ISSUE 12): on zero-egress rigs the
+  # MNIST fallback set is 12800 examples = 25 steps at batch 512, while
+  # real-MNIST rigs get 117 — the old cost gate was calibrated on the
+  # latter and failed AT SEED on the former (final cost 2.42).  Write a
+  # fixture dataset SIZED BY STEPS (60 steps/epoch, deterministic IDX
+  # bytes; separable enough that the 2-epoch budget descends WELL below
+  # chance) and train on it everywhere, so the lane's trajectory — and
+  # the gate pinned from it — is rig-independent.
+  python - "$tdir/data" <<'PYEOF'
+import sys
+from dtf_tpu.data.fixtures import write_mnist_idx
+write_mnist_idx(sys.argv[1], n_train=512 * 60, n_test=1024, seed=1,
+                noise=0.15, label_noise=0.02, spread=0.5)
+PYEOF
   JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
-      --epochs 1 --batch_size 512 --init fan_in --log_frequency 5 \
+      --epochs 2 --batch_size 512 --init fan_in --log_frequency 5 \
+      --learning_rate 0.3 --data_dir "$tdir/data" \
       --logdir "$tdir" --checkpoint_every 5 --max_restarts 2 \
       --chaos "nan_grad@4,stall@7:1s,sigterm@11" > "$tdir/run.log" 2>&1
   rc=$?
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: telemetry lane run (rc=$rc)"; tail -5 "$tdir/run.log"; }
   # --max_rollbacks/--max_final_cost arm the same check_gates the
   # scenario matrix gates with (one gate implementation, DESIGN.md §8);
-  # the run above restarts once but never rolls back, and MNIST at
-  # these settings lands well under cost 1.0.
+  # the run above restarts once but never rolls back.  The 120-step
+  # fixture trajectory lands at 1.3978 — the 1.6 pin keeps ~14%
+  # headroom while sitting far below random-chance cross-entropy
+  # (ln 10 ~= 2.303), so a run that learns NOTHING still fails.
   python -m dtf_tpu.telemetry.report "$tdir" --check \
-      --max_rollbacks 0 --max_final_cost 1.0 | tee "$tdir/report.log"
+      --max_rollbacks 0 --max_final_cost 1.6 | tee "$tdir/report.log"
   rc=${PIPESTATUS[0]}       # the report's exit status, not tee's
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --check (rc=$rc)"; }
   grep -q "gate max_final_cost: OK" "$tdir/report.log" \
@@ -458,6 +475,116 @@ PYEOF
   rm -rf "$lidir"
 fi
 
+# Fleet lane (DESIGN.md §6.5, ISSUE 12): a 2-host chaos'd run through
+# the fleet plane — host 1 carries an injected 40 ms/step straggler,
+# every host's span stream lands in the shared logdir, /fleetz is
+# scraped MID-run for a consistent fleet cut, and afterwards
+# report --fleet must attribute the blame to the injected host, pass
+# the skew/goodput gates, and FAIL an absurd threshold (falsifiability,
+# same pattern as the scenario runner).  The perf-regression ledger
+# gate rides here too.  Skip with NO_FLEET_LANE=1.
+if [ "${NO_FLEET_LANE:-0}" != "1" ]; then
+  echo "=== fleet lane (2-host straggler + /fleetz scrape + report --fleet gates + ledger) ==="
+  fdir=$(mktemp -d)
+  JAX_PLATFORMS=cpu python - "$fdir" <<'PYEOF'
+import json, os, socket, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+env = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+       "PYTHONPATH": os.pathsep.join(
+           [os.getcwd()] + [p for p in os.environ.get(
+               "PYTHONPATH", "").split(os.pathsep) if p])}
+driver = os.path.abspath(os.path.join("tests", "_mp_fleet.py"))
+procs = [subprocess.Popen(
+    [sys.executable, driver, str(task), "2", d, "40", "2",
+     "slow_host@0:1:40ms", str(port) if task == 0 else ""],
+    stdout=open(os.path.join(d, f"host{task}.log"), "w"),
+    stderr=subprocess.STDOUT, env=env) for task in range(2)]
+
+def get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return json.loads(r.read())
+
+scraped = None
+try:
+    deadline = time.time() + 240
+    while time.time() < deadline and procs[0].poll() is None:
+        try:
+            doc = get("/fleetz")
+        except OSError:
+            time.sleep(0.3); continue
+        att = doc.get("attribution") or {}
+        if att.get("barriers", 0) >= 2 and len(
+                doc.get("hosts_reporting", [])) == 2:
+            scraped = doc
+            break
+        time.sleep(0.3)
+finally:
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=240))
+        except subprocess.TimeoutExpired:
+            p.kill(); p.wait(); rcs.append(-1)
+assert rcs == [0, 0], f"fleet hosts exited {rcs}"
+assert scraped is not None, "/fleetz never served a 2-host cut mid-run"
+# one consistent cut: the goodput aggregate must be computed from
+# exactly the per-host docs in this payload
+g = scraped["goodput"]
+hosts = scraped["hosts"]
+prod = sum(h["goodput"]["productive_s"] for h in hosts.values())
+assert abs(prod - g["productive_s_total"]) < 1e-6, (prod, g)
+for k, h in hosts.items():
+    assert h["rev"] == h["rev_echo"], f"torn host doc {k}: {h['rev']} != {h['rev_echo']}"
+print(f"fleet scrape OK: {scraped['attribution']['barriers']} barrier(s), "
+      f"hosts {sorted(hosts)}, fleet goodput {g['productive_fraction']}")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: fleet 2-host run / scrape (rc=$rc)"; tail -8 "$fdir"/host*.log 2>/dev/null; }
+  python -m dtf_tpu.telemetry.report "$fdir/logs" --fleet \
+      --max_skew_ms 5000 --min_fleet_goodput 0.0005 \
+      --export-trace "$fdir/fleet_trace.json" | tee "$fdir/report.log"
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --fleet gates (rc=$rc)"; }
+  grep -q "Fleet (telemetry/fleet.py)" "$fdir/report.log" \
+    && grep -q "gate max_skew_ms: OK" "$fdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: fleet report section/gates missing"; }
+  python - "$fdir" <<'PYEOF'
+import json, sys
+d = sys.argv[1]
+from dtf_tpu.telemetry.report import build_report
+rep = build_report(d + "/logs")
+att = rep["fleet"]["attribution"]
+blamed = max(att["per_host"].items(), key=lambda kv: kv[1]["blame_frac"])
+assert blamed[0] == "1" and blamed[1]["blame_frac"] >= 0.8, att["per_host"]
+drift = att["per_host"]["1"]["drift_ms_per_step"]
+assert 15.0 <= drift <= 90.0, f"drift {drift} vs injected 40 ms/step"
+trace = json.load(open(d + "/fleet_trace.json"))
+pids = {e.get("pid") for e in trace["traceEvents"]}
+assert {0, 1} <= pids, pids
+print(f"fleet attribution OK: blame p1 {blamed[1]['blame_frac']:.0%}, "
+      f"drift {drift:.1f} ms/step (injected 40), "
+      f"{len(trace['traceEvents'])} merged trace events")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: fleet attribution assertions (rc=$rc)"; }
+  # falsifiability: an absurd threshold must FAIL the same report
+  python -m dtf_tpu.telemetry.report "$fdir/logs" \
+      --max_skew_ms 0.001 --max_blame_frac 0.01 > /dev/null 2>&1
+  [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: absurd fleet thresholds did not fail"; }
+  rm -rf "$fdir"
+fi
+# Perf-regression ledger gate: needs no TPU, no multi-process run, no
+# fleet plane — it must run even on rigs that skip the fleet lane.
+# Skip with NO_LEDGER_GATE=1.
+if [ "${NO_LEDGER_GATE:-0}" != "1" ]; then
+  echo "=== ledger gate (bench.py --check-ledger) ==="
+  python bench.py --check-ledger \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: bench.py --check-ledger"; }
+fi
 # Scenario lane (DESIGN.md §8): the 2-cell mini-matrix through the real
 # cell runner with --check — one chaos-off GPT baseline cell (the
 # control row) and the host_down MNIST elastic cell (SIGKILL mid-run ->

@@ -1,0 +1,106 @@
+"""Fleet-plane worker (spawned by tests/test_fleet.py and the full-suite
+fleet lane).
+
+One "host" of an N-host job with the FLEET plane armed for real: the
+plane is configured with this host's explicit identity BEFORE the
+Trainer is built (the same out-of-band pattern as tests/_mp_health.py —
+these hosts are independent single-process jax instances, so
+``jax.process_index()`` cannot name them), every host's span stream
+lands in the SHARED logdir under its fleet index, barrier arrivals
+travel the ``--fleet_dir`` file mesh, and host 0 serves ``/fleetz`` when
+an admin port is passed and writes the ``fleet.json`` rollup the
+post-hoc ``report --fleet`` judges.
+
+Hosts rendezvous through the mesh before training so compile-time skew
+between children doesn't pollute the first barriers' blame — the
+attribution tests pin WHERE the blame lands, and it must land on the
+chaos-injected straggler, not on whichever child compiled slower.
+
+Usage:
+    _mp_fleet.py <task> <nproc> <shared_dir> <max_steps> <devices>
+                 [chaos] [admin_port]
+
+Host 0 prints ``MP_FLEET_DONE steps=<n> final_cost=<loss>``.
+"""
+
+import os
+import sys
+
+
+def tiny_splits(n=2048, seed=0):
+    """Deterministic, learnable 10-class data — identical on every host
+    (the _mp_health.py recipe)."""
+    import numpy as np
+
+    from dtf_tpu.data.datasets import Dataset, DataSplits
+
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    protos = rng.normal(0, 1, (10, 784)).astype(np.float32)
+    x = (protos[y] + rng.normal(0, 2.0, (n, 784))).astype(np.float32)
+    return DataSplits(train=Dataset(x, np.eye(10, dtype=np.float32)[y],
+                                    seed=1), test=None)
+
+
+def main(task: int, nproc: int, shared: str, max_steps: int,
+         devices: int, chaos: str = "", admin_port: str = "") -> int:
+    from dtf_tpu import optim
+    from dtf_tpu.cluster import bootstrap
+    from dtf_tpu.config import ClusterConfig, TrainConfig
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.resilience.chaos import FaultPlan
+    from dtf_tpu.telemetry import fleet
+    from dtf_tpu.train.trainer import Trainer
+
+    cluster = bootstrap(ClusterConfig(simulated_devices=devices,
+                                      mesh="data=-1"))
+    # Host 0 owns the SHARED logdir (telemetry.json / metrics.csv /
+    # checkpoints / fleet.json); other hosts keep their own books in a
+    # scratch logdir — but every host's SPAN stream goes to the shared
+    # logdir under its fleet index (the plane's spans_dir), which is what
+    # makes the cross-host trace merge possible.
+    logdir = (os.path.join(shared, "logs") if task == 0
+              else os.path.join(shared, f"logs_task{task}"))
+    plane = fleet.configure(os.path.join(shared, "fleet"), task, nproc,
+                            spans_dir=os.path.join(shared, "logs"))
+    cfg = TrainConfig(
+        batch_size=64, learning_rate=0.05, epochs=100,
+        log_frequency=2, seed=1, logdir=logdir,
+        checkpoint_every=5, prefetch=0,
+        admin_port=(int(admin_port) if admin_port and task == 0
+                    else None))
+    plan = FaultPlan.parse(chaos, process_index=task) if chaos else None
+    trainer = Trainer(cluster, MnistMLP(init_scale="fan_in"),
+                      optim.sgd(0.05), cfg, chaos=plan)
+    # Warm the step compile BEFORE the rendezvous (step_fn donates its
+    # first argument, so warm a throwaway copy), then align every host's
+    # loop entry through the mesh: compile-time skew must not be the
+    # thing the skew attribution measures.
+    import jax
+    import numpy as np
+
+    from dtf_tpu.train.trainer import put_global_batch
+
+    dummy = put_global_batch(
+        cluster.mesh, (np.zeros((cfg.batch_size, 784), np.float32),
+                       np.zeros((cfg.batch_size, 10), np.float32)))
+    throwaway = jax.tree_util.tree_map(lambda x: x + 0, trainer.state)
+    jax.block_until_ready(
+        trainer.step_fn(throwaway, dummy, jax.random.key(0)))
+    plane.rendezvous(120.0)
+    try:
+        result = trainer.fit(tiny_splits(), max_steps=max_steps)
+    finally:
+        if trainer.ckpt is not None:
+            trainer.ckpt.close()
+    if task == 0:
+        print(f"MP_FLEET_DONE steps={result['steps']} "
+              f"final_cost={result['final_cost']:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                  int(sys.argv[4]), int(sys.argv[5]),
+                  sys.argv[6] if len(sys.argv) > 6 else "",
+                  sys.argv[7] if len(sys.argv) > 7 else ""))

@@ -130,6 +130,7 @@ _SLOW_TESTS = (
     "tests/test_ulysses_attention.py::TestUlyssesAttention"
     "::test_matches_full_attention",
     "tests/test_ulysses_attention.py::TestUlyssesInModels",
+    "tests/test_fleet.py::TestFleetTwoProcess",  # spawns 2 real hosts
 )
 
 

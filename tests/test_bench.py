@@ -540,3 +540,139 @@ class TestGradSyncAB:
         assert (out["strategies"]["zero1_overlap"]["comm_bytes_per_step"]
                 > out["strategies"]["zero1"]["comm_bytes_per_step"])
         assert 0.8 < out["opt_state_drop_ratio"] < 0.95   # ~7/8
+
+
+class TestBenchLedger:
+    """Perf-regression ledger (scripts/bench_ledger.py + bench.py
+    --check-ledger, ISSUE 12): the loose BENCH_r*/MULTICHIP_r* round
+    files fold into LEDGER.jsonl, and the gate fails loud on a
+    regression vs the best prior green run on the same rig."""
+
+    def _ledger_mod(self):
+        import importlib
+        import os
+        import sys
+        scripts = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        return importlib.import_module("bench_ledger")
+
+    def _rows(self, *vals, rig="TPU v5 lite", errors=()):
+        rows = []
+        for i, v in enumerate(vals, start=1):
+            rows.append({"run": f"BENCH_r{i:02d}", "kind": "bench",
+                         "n": i, "commit": None, "rig": rig,
+                         "tflops_per_chip": v, "mfu": None,
+                         "vs_baseline": None, "ok": v is not None,
+                         "error": None if v is not None else "boom",
+                         "stage": None if v is not None else "sweep"})
+        for i, err in enumerate(errors, start=len(vals) + 1):
+            rows.append({"run": f"BENCH_r{i:02d}", "kind": "bench",
+                         "n": i, "commit": None, "rig": None,
+                         "tflops_per_chip": None, "mfu": None,
+                         "vs_baseline": None, "ok": False,
+                         "error": err, "stage": "preflight"})
+        return rows
+
+    def test_committed_ledger_is_green(self):
+        """The acceptance pin: bench.py --check-ledger runs green
+        against the COMMITTED LEDGER.jsonl (r01->r02 within tolerance;
+        the stalled r03-r05 tpu_unavailable streak prints as a warning,
+        not a failure)."""
+        import os
+        bl = self._ledger_mod()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rows = bl.read_ledger(os.path.join(repo, "LEDGER.jsonl"))
+        assert any(r["ok"] and r["tflops_per_chip"] for r in rows)
+        ok, lines = bl.check_ledger(rows)
+        assert ok, lines
+        assert any("STALLED" in ln for ln in lines), lines
+
+    def test_committed_ledger_matches_round_files(self):
+        """LEDGER.jsonl is generated, committed state — it must agree
+        with rebuilding from the BENCH_r*/MULTICHIP_r* files (commits
+        excluded: git metadata is environment-dependent)."""
+        import json
+        import os
+        bl = self._ledger_mod()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        fresh = bl.build_ledger(repo)
+        committed = bl.read_ledger(os.path.join(repo, "LEDGER.jsonl"))
+
+        def strip(rows):
+            return [{k: v for k, v in r.items() if k != "commit"}
+                    for r in rows]
+
+        assert strip(fresh) == strip(committed)
+
+    def test_synthetic_regression_fails(self):
+        bl = self._ledger_mod()
+        ok, lines = bl.check_ledger(self._rows(193.0, 192.0, 120.0))
+        assert not ok
+        assert any("REGRESSION" in ln for ln in lines)
+
+    def test_within_tolerance_passes(self):
+        bl = self._ledger_mod()
+        ok, lines = bl.check_ledger(self._rows(193.0, 185.0))
+        assert ok, lines
+
+    def test_first_green_has_no_comparison(self):
+        bl = self._ledger_mod()
+        ok, lines = bl.check_ledger(self._rows(193.0))
+        assert ok
+        assert any("no prior to compare" in ln for ln in lines)
+
+    def test_error_rows_do_not_regress_and_streak_warns(self):
+        """Error rounds never count as the 'latest green' — the newest
+        GREEN run is judged, and a trailing error streak warns."""
+        bl = self._ledger_mod()
+        rows = self._rows(193.0, 192.0,
+                          errors=("tpu_unavailable", "tpu_unavailable"))
+        ok, lines = bl.check_ledger(rows)
+        assert ok, lines
+        assert any("last 2 bench run(s) errored" in ln for ln in lines)
+
+    def test_rigs_compared_independently(self):
+        """A slower rig's green run must not read as a regression of a
+        faster rig's history."""
+        bl = self._ledger_mod()
+        rows = self._rows(193.0, 192.0) + self._rows(20.0, rig="cpu")
+        # re-number the cpu row after the tpu rows
+        rows[-1]["n"] = 3
+        rows[-1]["run"] = "BENCH_r03"
+        ok, lines = bl.check_ledger(rows)
+        assert ok, lines
+
+    def test_check_ledger_cli_green_and_regression(self, tmp_path):
+        """python bench.py --check-ledger end to end: green on the
+        committed ledger, exit 1 when a synthetic regression row is
+        appended (the falsifiability half)."""
+        import json
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        bench = os.path.join(repo, "bench.py")
+        r = subprocess.run([sys.executable, bench, "--check-ledger"],
+                           capture_output=True, text=True, timeout=60,
+                           cwd=repo)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "ledger check: OK" in r.stdout
+        rows = [json.loads(ln) for ln in
+                open(os.path.join(repo, "LEDGER.jsonl"))]
+        rows.append({"run": "BENCH_r99", "kind": "bench", "n": 99,
+                     "commit": None, "rig": "TPU v5 lite",
+                     "tflops_per_chip": 100.0, "mfu": 0.5,
+                     "vs_baseline": 0.56, "ok": True, "error": None,
+                     "stage": None})
+        bad = tmp_path / "LEDGER.jsonl"
+        with open(bad, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        r = subprocess.run([sys.executable, bench, "--check-ledger",
+                            "--ledger", str(bad)],
+                           capture_output=True, text=True, timeout=60,
+                           cwd=repo)
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout

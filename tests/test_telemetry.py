@@ -64,10 +64,14 @@ class TestSpans:
         n = export_chrome_trace(str(tmp_path), out)
         doc = json.load(open(out))
         events = doc["traceEvents"]
-        assert n == len(events) == 4        # 2 spans + 2 process_name metas
+        # 2 spans + per-host process_name AND process_sort_index metas
+        # (one named, sort-ordered Perfetto track-group per host — the
+        # fleet plane's merged-trace contract)
+        assert n == len(events) == 6
         assert {e["pid"] for e in events} == {0, 1}
-        assert any(e.get("ph") == "M" and e["name"] == "process_name"
-                   for e in events)
+        for meta in ("process_name", "process_sort_index"):
+            assert sum(1 for e in events
+                       if e.get("ph") == "M" and e["name"] == meta) == 2
 
     def test_disabled_tracer_is_noop(self, tmp_path):
         tr = Tracer(None)
