@@ -18,6 +18,23 @@ One command per BASELINE.md decode row::
     python -m dtf_tpu.bench.decode_ladder --preset gpt2_small \
         --mode fused --beam 4                # beam through the kernel
 
+Serving-engine rungs (ISSUE 14) ride the SAME linfit methodology so
+the unfused/fused/paged/speculative numbers are directly comparable::
+
+    python -m dtf_tpu.bench.decode_ladder --preset tiny --mode paged \
+        --streams 3                          # narrowed paged data path
+    python -m dtf_tpu.bench.decode_ladder --preset tiny --mode paged \
+        --no_narrow --pool_blocks 200        # baseline whole-pool arm
+    python -m dtf_tpu.bench.decode_ladder --preset tiny --mode spec \
+        --spec_k 4 --trace_vocab 12          # speculative decoding
+
+``--json`` writes a ladder doc ``scripts/bench_ledger.py`` folds into
+LEDGER.jsonl as a ``decode`` rig row (gated by
+``python bench.py --check-ledger``); the decode-fast full-suite lane
+A/Bs the paged arm against the baseline on tight AND oversized pools —
+marginal ms/token must drop, and must be pool-size invariant only for
+the narrowed arm.
+
 The reference has no decode path at all (TF1 parameter-server MNIST
 demo); these rows are framework-beyond-parity serving numbers.
 """
@@ -25,6 +42,127 @@ demo); these rows are framework-beyond-parity serving numbers.
 from __future__ import annotations
 
 import argparse
+import json
+
+
+def _finish_fit(out: dict, fit, streams: int) -> dict:
+    """Shared fit -> report fields: the no-signal check and the
+    tokens/s conversions (one definition for the generate-path and
+    engine-path rungs)."""
+    per_token_s = fit.per_iter_s
+    out["ladder"] = [[k, round(t * 1e3, 2)] for k, t in fit.points]
+    out["per_token_us"] = per_token_s * 1e6
+    out["fit_overhead_ms"] = fit.overhead_s * 1e3
+    times = [t for _, t in fit.points]
+    if times[-1] <= times[0] or per_token_s <= 1e-9:
+        out["tok_s_per_stream"] = out["tok_s_aggregate"] = None
+        out["warning"] = ("non-positive slope — ladder is "
+                          "noise-dominated; lengthen --ladder or raise "
+                          "--reps")
+    else:
+        out["tok_s_per_stream"] = 1.0 / per_token_s
+        out["tok_s_aggregate"] = streams / per_token_s
+    return out
+
+
+def run_engine(preset: str = "tiny", mode: str = "paged",
+               streams: int = 3, ladder=(8, 16, 32), reps: int = 2,
+               prompt_len: int = 8, seed: int = 0, block_size: int = 4,
+               pool_blocks=None, narrow: bool = True, spec_k: int = 4,
+               trace_vocab=None) -> dict:
+    """Serving-engine ladder rung: drive a fresh ``ServingEngine`` on
+    the wall clock for each (ladder point, rep) — ``streams`` requests,
+    each generating ``max_new`` tokens — and linfit wall time against
+    ``max_new``.  The marginal slope is the engine's whole per-token
+    cost (dispatch, gather/scatter, host bookkeeping), which is exactly
+    the quantity the narrowed data path and speculation attack.
+
+    ``mode="paged"`` runs the plain decode path (``--no_narrow`` is the
+    whole-pool/full-window baseline arm); ``mode="spec"`` arms the
+    n-gram drafter.  ``pool_blocks`` oversizes the pool to probe
+    pool-size (in)variance.
+    """
+    import jax
+    import numpy as np
+
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    from dtf_tpu.serve import ServingEngine, WallClock, blocks_for
+    from dtf_tpu.utils.timing import time_linfit
+
+    ladder = tuple(sorted(set(ladder)))
+    if len(ladder) < 2:
+        raise ValueError(f"ladder needs >=2 distinct lengths, got {ladder}")
+    max_new = max(ladder)
+    window = prompt_len + max_new + block_size
+    cfg = GPTConfig.from_preset(preset, max_len=max(window, 64))
+    model = GPT(cfg)
+    params = model.init(jax.random.key(seed))
+    blocks_per_slot = blocks_for(window, block_size)
+    tight = 1 + streams * blocks_per_slot
+    num_blocks = pool_blocks or tight
+    if num_blocks < tight:
+        raise ValueError(f"--pool_blocks {num_blocks} < tight pool "
+                         f"{tight} for {streams} stream(s)")
+    rng = np.random.default_rng(seed + 1)
+    vocab = min(cfg.vocab_size, trace_vocab) if trace_vocab \
+        else cfg.vocab_size
+    base_prompts = rng.integers(0, vocab, (streams, prompt_len))
+    counter = [0]
+    last_engine = [None]
+    # ONE pool shared across every timed engine: per-call zeros/concat
+    # churn for an oversized pool is tens of MB and would otherwise
+    # dominate the fit's noise floor (stale finite rows are harmless —
+    # prefill rewrites each block before an unmasked read)
+    from dtf_tpu.serve import KVPool
+    shared_pool = KVPool.create(cfg, num_blocks, block_size)
+
+    def closure_of(n_new):
+        def call():
+            counter[0] += 1
+            eng = ServingEngine(
+                model, params, num_slots=streams, block_size=block_size,
+                blocks_per_slot=blocks_per_slot, num_blocks=num_blocks,
+                clock=WallClock(), seed=seed,
+                narrow_decode=narrow, pool=shared_pool,
+                spec_k=(spec_k if mode == "spec" else 0))
+            prompts = (base_prompts + counter[0]) % vocab
+            trace = [(0.0, dict(rid=i,
+                                prompt=prompts[i].astype(np.int32),
+                                max_new_tokens=n_new))
+                     for i in range(streams)]
+            eng.run(trace)
+            last_engine[0] = eng
+            return eng
+        return call
+
+    fit = time_linfit(closure_of, ladder, reps=reps)
+    # The rig id carries the FULL arm geometry: ledger rounds gate
+    # newest-green vs best-prior PER RIG, and a baseline (--no_narrow)
+    # or oversized-pool arm is deliberately slower — aliased onto the
+    # narrowed rig it would read as a spurious regression.
+    rig = f"decode_{preset}_{mode}_s{streams}_bs{block_size}"
+    if mode == "spec":
+        rig += f"_k{spec_k}"
+    if not narrow:
+        rig += "_nonarrow"
+    if pool_blocks:
+        rig += f"_pool{num_blocks}"
+    out = {
+        "preset": preset, "mode": mode, "streams": streams,
+        "block_size": block_size, "pool_blocks": num_blocks,
+        "tight_pool_blocks": tight, "narrow": bool(narrow),
+        "spec_k": spec_k if mode == "spec" else 0,
+        "prompt_len": prompt_len,
+        "rig": rig,
+        "device": str(jax.devices()[0]),
+    }
+    eng = last_engine[0]
+    if mode == "spec" and eng is not None:
+        out["spec_proposed"] = eng.spec_proposed
+        out["spec_accepted"] = eng.spec_accepted
+        out["spec_acceptance"] = (eng.spec_accepted / eng.spec_proposed
+                                  if eng.spec_proposed else None)
+    return _finish_fit(out, fit, streams)
 
 
 def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
@@ -78,37 +216,34 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         return call
 
     fit = time_linfit(closure_of, ladder, reps=reps)
-    per_token_s = fit.per_iter_s
+    rig = (f"decode_{preset}_{mode}_s{streams}"
+           + ("_int8" if int8 else "") + ("_kvint8" if kv_int8 else "")
+           + (f"_beam{beam}" if beam else ""))
     out = {
         "preset": preset, "mode": mode, "streams": streams,
         "int8": int8, "kv_int8": kv_int8, "beam": beam,
-        "ladder": [[k, round(t * 1e3, 2)] for k, t in fit.points],
-        "per_token_us": per_token_s * 1e6,
-        "fit_overhead_ms": fit.overhead_s * 1e3,
+        "rig": rig,
         "device": str(jax.devices()[0]),
     }
     # time_linfit clamps the slope to >= 1e-12, so "no signal" must be
-    # detected directly: the longest chain must actually take longer
-    # than the shortest (ladder passed in increasing order), and the
-    # per-token time must be physically plausible (>1 ns).
-    times = [t for _, t in fit.points]
-    if times[-1] <= times[0] or per_token_s <= 1e-9:
-        out["tok_s_per_stream"] = out["tok_s_aggregate"] = None
-        out["warning"] = ("non-positive slope — ladder is "
-                          "noise-dominated; lengthen --ladder or raise "
-                          "--reps")
-    else:
-        out["tok_s_per_stream"] = 1.0 / per_token_s
-        out["tok_s_aggregate"] = streams / per_token_s
-    return out
+    # detected directly (_finish_fit): the longest chain must actually
+    # take longer than the shortest, and the per-token time must be
+    # physically plausible (>1 ns).
+    return _finish_fit(out, fit, streams)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--preset", default="gpt2_small",
                         choices=["gpt2_small", "llama", "tiny"])
-    parser.add_argument("--mode", choices=["fused", "unfused"],
-                        default="fused")
+    parser.add_argument("--mode",
+                        choices=["fused", "unfused", "paged", "spec"],
+                        default="fused",
+                        help="fused/unfused = GPT.generate kernels; "
+                             "paged = the serving engine's narrowed "
+                             "block-indexed data path (--no_narrow = "
+                             "whole-pool baseline arm); spec = "
+                             "speculative decoding through the engine")
     parser.add_argument("--streams", type=int, default=1)
     parser.add_argument("--int8", action="store_true")
     parser.add_argument("--kv_int8", action="store_true",
@@ -127,6 +262,24 @@ def main(argv=None) -> int:
     parser.add_argument("--prompt_len", type=int, default=8,
                         help="prompt length (long-context rows: a long "
                              "prompt makes the cache long from step one)")
+    parser.add_argument("--block_size", type=int, default=4,
+                        help="paged/spec: KV block size")
+    parser.add_argument("--pool_blocks", type=int, default=None,
+                        help="paged/spec: total pool blocks (oversize "
+                             "to probe pool-size invariance; default "
+                             "tight = 1 + streams x window)")
+    parser.add_argument("--no_narrow", action="store_true",
+                        help="paged/spec: full-window whole-pool "
+                             "baseline geometry (the A/B foil)")
+    parser.add_argument("--spec_k", type=int, default=4,
+                        help="spec: drafts per iteration")
+    parser.add_argument("--trace_vocab", type=int, default=None,
+                        help="paged/spec: cap the prompt token alphabet "
+                             "(small alphabets give the n-gram drafter "
+                             "material)")
+    parser.add_argument("--json", default=None,
+                        help="write the ladder doc here (bench_ledger "
+                             "folds it as a decode rig row)")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend (reliable even when "
                              "a TPU plugin is registered)")
@@ -135,22 +288,49 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
     ladder = tuple(int(k) for k in ns.ladder.split(","))
-    r = run(ns.preset, ns.mode, ns.streams, ns.int8, ns.beam, ladder,
-            ns.reps, prompt_len=ns.prompt_len, kv_int8=ns.kv_int8,
-            cache_chunk=ns.cache_chunk)
-    beam_tag = f" beam={r['beam']}" if r["beam"] else ""
-    int8_tag = (" int8" if r["int8"] else "") + (
-        " kv-int8" if r.get("kv_int8") else "")
-    print(f"{r['preset']} {r['mode']}{int8_tag}{beam_tag} "
-          f"x{r['streams']} streams on {r['device']}")
+    if ns.mode in ("paged", "spec"):
+        # fail loud, not silently-fp: the engine rungs don't take the
+        # generate-path quantization/beam knobs (yet — ROADMAP lists
+        # int8 verify composition as the open item)
+        for flag, val in (("--int8", ns.int8), ("--kv_int8", ns.kv_int8),
+                          ("--beam", ns.beam),
+                          ("--cache_chunk", ns.cache_chunk)):
+            if val:
+                parser.error(f"{flag} applies to the fused/unfused "
+                             f"generate-path modes, not --mode {ns.mode}")
+        r = run_engine(ns.preset, ns.mode, ns.streams, ladder, ns.reps,
+                       prompt_len=ns.prompt_len, block_size=ns.block_size,
+                       pool_blocks=ns.pool_blocks,
+                       narrow=not ns.no_narrow, spec_k=ns.spec_k,
+                       trace_vocab=ns.trace_vocab)
+        tag = (" narrow" if r["narrow"] else " baseline") + (
+            f" k={r['spec_k']}" if r["mode"] == "spec" else "")
+        print(f"{r['preset']} {r['mode']}{tag} x{r['streams']} streams "
+              f"pool={r['pool_blocks']} blocks on {r['device']}")
+    else:
+        r = run(ns.preset, ns.mode, ns.streams, ns.int8, ns.beam, ladder,
+                ns.reps, prompt_len=ns.prompt_len, kv_int8=ns.kv_int8,
+                cache_chunk=ns.cache_chunk)
+        beam_tag = f" beam={r['beam']}" if r["beam"] else ""
+        int8_tag = (" int8" if r["int8"] else "") + (
+            " kv-int8" if r.get("kv_int8") else "")
+        print(f"{r['preset']} {r['mode']}{int8_tag}{beam_tag} "
+              f"x{r['streams']} streams on {r['device']}")
     print(f"ladder (max_new_tokens, best ms): {r['ladder']}")
+    if ns.json:
+        with open(ns.json, "w") as f:
+            json.dump(r, f, indent=1, sort_keys=True)
+        print(f"wrote {ns.json}")
     if r.get("warning"):
         print(f"NO RESULT: {r['warning']}")
         return 1
+    acc = r.get("spec_acceptance")
+    acc_tag = f", acceptance {acc:.2f}" if acc is not None else ""
     print(f"per-token {r['per_token_us']:.1f} us  ->  "
           f"{r['tok_s_per_stream']:.1f} tok/s/stream, "
           f"{r['tok_s_aggregate']:.1f} tok/s aggregate "
-          f"(fixed overhead {r['fit_overhead_ms']:.0f} ms absorbed)")
+          f"(fixed overhead {r['fit_overhead_ms']:.0f} ms absorbed"
+          f"{acc_tag})")
     return 0
 
 

@@ -86,27 +86,35 @@ def poisson_trace(*, seed: int, n_requests: int, qps: float,
     return trace
 
 
-def run_point(model, params, *, mode: str, qps: float, ns) -> Dict:
+def _trace_vocab(model, ns) -> int:
+    cap = getattr(ns, "trace_vocab", None)
+    return min(model.cfg.vocab_size, cap) if cap else model.cfg.vocab_size
+
+
+def run_point(model, params, *, mode: str, qps: float, ns,
+              spec_k: int = 0) -> Dict:
     """One sweep point: fresh engine + fresh clock, the seeded trace for
-    this QPS, closed-loop to drain.  Returns the engine summary plus the
-    offered rate."""
+    this QPS, closed-loop to drain.  Returns ``(summary, engine)`` —
+    the summary carries the offered rate; the engine lets A/B callers
+    (``spec_ab``) read per-rid token streams for identity gates."""
     from dtf_tpu.serve import ServingEngine, VirtualClock, WallClock
 
     clock = VirtualClock() if ns.clock == "virtual" else WallClock()
     engine = ServingEngine(
         model, params, num_slots=ns.slots, block_size=ns.block_size,
         num_blocks=ns.pool_blocks, mode=mode, seed=ns.seed, clock=clock,
-        max_queue=ns.max_queue, top_k=ns.top_k, top_p=ns.top_p)
+        max_queue=ns.max_queue, top_k=ns.top_k, top_p=ns.top_p,
+        spec_k=spec_k)
     trace = poisson_trace(
         seed=ns.seed, n_requests=ns.requests,
         qps=qps, prompt_lens=ns.prompt_lens_list,
         output_lens=ns.output_lens_list,
-        vocab_size=model.cfg.vocab_size, temperature=ns.temperature)
+        vocab_size=_trace_vocab(model, ns), temperature=ns.temperature)
     engine.run(trace)
     out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
     out["offered_qps"] = qps
     out["requests_offered"] = ns.requests
-    return out
+    return out, engine
 
 
 def sustained_goodput(points: List[Dict], budget_ms: float) -> Dict:
@@ -220,6 +228,111 @@ def chaos_gates(on: Dict, off: Dict) -> Tuple[bool, List[str]]:
     return ok, lines
 
 
+def spec_gates(on: Dict, off: Dict, identical: Dict,
+               max_tpot_p99_ms: Optional[float]) -> Tuple[bool, List[str]]:
+    """The speculative-decoding acceptance gates (ISSUE 14):
+
+    * **token identity** — every commonly-completed request's token
+      stream is bitwise identical with and without speculation on the
+      same trace (the verify step emits the model's own choices; the
+      PR 9 token-identity contract survives); completion-set
+      differences (a scheduling effect of the arms' different clock
+      trajectories) are surfaced in the detail, not conflated with
+      divergence;
+    * **p99 TPOT strictly drops** at the fixed offered rate — the
+      speculative win is a latency claim, measured end to end;
+    * **drafts accepted** — acceptance > 0, so the win is attributable
+      to speculation, not noise;
+    * optional absolute ceiling ``--max_tpot_p99_ms``, enforced through
+      the ONE :func:`telemetry.report.check_gates` path so the same
+      threshold is CI-armable anywhere a telemetry.json lands.
+    """
+    from dtf_tpu.telemetry.report import check_gates
+
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, passed, detail):
+        nonlocal ok
+        ok = ok and passed
+        lines.append(f"gate {name}: {'OK' if passed else 'FAIL'} — "
+                     f"{detail}")
+
+    gate("spec_token_identity", identical["ok"],
+         (f"{identical['common']} common completed stream(s) bitwise "
+          f"identical" if identical["ok"]
+          else f"{len(identical['diverged'])} common stream(s) "
+               f"DIVERGED: rids {identical['diverged'][:8]}")
+         + (f"; completion sets differ (only-spec "
+            f"{identical['only_on']}, only-baseline "
+            f"{identical['only_off']})"
+            if identical["only_on"] or identical["only_off"] else ""))
+    t_on = on.get("tpot_ms_p99")
+    t_off = off.get("tpot_ms_p99")
+    gate("spec_tpot_p99_drops",
+         t_on is not None and t_off is not None and t_on < t_off,
+         f"p99 TPOT {t_on} ms with spec_k={on.get('spec_k')} vs "
+         f"{t_off} ms without (same trace, qps {on.get('offered_qps')})")
+    acc = on.get("spec_acceptance")
+    gate("spec_drafts_accepted", bool(on.get("spec_accepted", 0) > 0),
+         f"{on.get('spec_accepted', 0)}/{on.get('spec_proposed', 0)} "
+         f"drafts accepted"
+         + (f" (rate {acc:.3f})" if acc is not None else ""))
+    if max_tpot_p99_ms:
+        g_ok, g_lines = check_gates(
+            {"telemetry": {"serving": on}},
+            max_tpot_p99_ms=max_tpot_p99_ms)
+        ok = ok and g_ok
+        lines.extend(g_lines)
+    return ok, lines
+
+
+def spec_ab(model, params, ns) -> Dict:
+    """Same-trace speculative-decoding on/off A/B at the fixed offered
+    rate (the FIRST --qps point): identical trace, identical engine
+    geometry, the only difference is ``spec_k``."""
+    qps = ns.qps_list[0]
+    on, eng_on = run_point(model, params, mode="continuous", qps=qps,
+                           ns=ns, spec_k=ns.spec_k)
+    off, eng_off = run_point(model, params, mode="continuous", qps=qps,
+                             ns=ns, spec_k=0)
+    tokens = []
+    for eng in (eng_on, eng_off):
+        tokens.append({r.rid: list(r.tokens or [])
+                       for r in eng.results.values()
+                       if r.status == "completed"})
+    # Identity is judged per request over the INTERSECTION of completed
+    # sets: the arms' clocks advance differently, so near a shed/
+    # deadline boundary one arm may complete a request the other
+    # dropped — a scheduling difference, not a token-identity
+    # violation.  Set differences are surfaced in the gate detail.
+    common = sorted(set(tokens[0]) & set(tokens[1]))
+    diverged = [rid for rid in common if tokens[0][rid] != tokens[1][rid]]
+    identical = {
+        "ok": not diverged, "common": len(common), "diverged": diverged,
+        "only_on": len(set(tokens[0]) - set(tokens[1])),
+        "only_off": len(set(tokens[1]) - set(tokens[0])),
+    }
+    ok, lines = spec_gates(on, off, identical, ns.max_tpot_p99_ms or None)
+    if ns.logdir:
+        import os
+        os.makedirs(ns.logdir, exist_ok=True)
+        eng_on.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
+    for arm, s in (("spec", on), ("no_spec", off)):
+        acc = s.get("spec_acceptance")
+        print(f"  [{arm:>8}] completed {s.get('completed', 0):3d}  "
+              f"tpot p50/p99 {s.get('tpot_ms_p50', float('nan')):6.2f}"
+              f"/{s.get('tpot_ms_p99', float('nan')):6.2f} ms  "
+              f"ttft p99 {s.get('ttft_ms_p99', float('nan')):7.1f} ms"
+              + (f"  acceptance {acc:.2f}" if acc is not None else ""),
+              flush=True)
+    return {"spec_k": ns.spec_k, "offered_qps": qps, "clock": ns.clock,
+            "spec": on, "no_spec": off,
+            "token_identity": identical["ok"],
+            "token_identity_detail": identical,
+            "gates": lines, "ok": ok}
+
+
 def chaos_ab(model, params, ns) -> Dict:
     """Same-trace controller-on/off A/B under the injected spike."""
     on = run_chaos_point(model, params, controller=True, ns=ns)
@@ -242,7 +355,8 @@ def sweep(model, params, ns) -> Dict:
     points: List[Dict] = []
     for mode in modes:
         for qps in ns.qps_list:
-            pt = run_point(model, params, mode=mode, qps=qps, ns=ns)
+            pt, _ = run_point(model, params, mode=mode, qps=qps, ns=ns,
+                              spec_k=getattr(ns, "spec_k", 0))
             points.append(pt)
             print(f"  [{mode:>10}] offered {qps:6.1f} qps -> "
                   f"ttft p50/p99 {pt.get('ttft_ms_p50', float('nan')):7.1f}"
@@ -325,6 +439,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                    default="virtual",
                    help="virtual = deterministic cost-model time (CI); "
                         "wall = measure the real engine")
+    p.add_argument("--spec_k", type=int, default=0,
+                   help="speculative decoding: drafts per iteration "
+                        "(applies to every continuous-mode point)")
+    p.add_argument("--spec_ab", action="store_true",
+                   help="same-trace spec-on/off A/B at the FIRST --qps "
+                        "point (fixed-rate mode); --check gates token "
+                        "identity + strict p99 TPOT improvement + "
+                        "acceptance > 0")
+    p.add_argument("--trace_vocab", type=int, default=None,
+                   help="cap the trace's prompt token alphabet (small "
+                        "alphabets give the n-gram drafter material)")
+    p.add_argument("--max_tpot_p99_ms", type=float, default=0.0,
+                   help="absolute p99 TPOT ceiling, enforced through "
+                        "telemetry.report.check_gates (0 = off)")
+    p.add_argument("--logdir", default=None,
+                   help="write the (spec arm's) engine telemetry.json "
+                        "here for report --check")
     p.add_argument("--json", default=None,
                    help="write the full sweep result here")
     p.add_argument("--check", action="store_true",
@@ -343,9 +474,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.chaos and ns.mode != "continuous":
         p.error("--chaos is the overload/brownout gate; it runs the "
                 "continuous engine (--mode continuous)")
-    if ns.check and not ns.chaos and ns.mode != "both":
-        p.error("--check needs --mode both (it asserts the A/B ratio) "
-                "or --chaos (the overload gates)")
+    if ns.spec_ab and ns.spec_k < 1:
+        p.error("--spec_ab needs --spec_k >= 1 (the speculative arm)")
+    if ns.spec_ab and ns.chaos:
+        p.error("--spec_ab and --chaos are separate A/Bs; run them "
+                "as separate invocations")
+    if ns.check and not ns.chaos and not ns.spec_ab and ns.mode != "both":
+        p.error("--check needs --mode both (it asserts the A/B ratio), "
+                "--chaos (the overload gates), or --spec_ab (the "
+                "speculative-decoding gates)")
 
     import jax
 
@@ -357,7 +494,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"serve_load: preset={ns.preset} slots={ns.slots} "
           f"block_size={ns.block_size} clock={ns.clock} "
           f"slo_ttft_ms={ns.slo_ttft_ms}"
-          + (f" chaos={ns.chaos}" if ns.chaos else ""), flush=True)
+          + (f" chaos={ns.chaos}" if ns.chaos else "")
+          + (f" spec_k={ns.spec_k}" if ns.spec_k else ""), flush=True)
+    if ns.spec_ab:
+        result = spec_ab(model, params, ns)
+        for line in result["gates"]:
+            print(line, flush=True)
+        if ns.json:
+            with open(ns.json, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {ns.json}")
+        if ns.check:
+            if not result["ok"]:
+                print("CHECK FAILED: speculative-decoding gates "
+                      "(see above)", file=sys.stderr)
+                return 1
+            print("CHECK OK")
+        return 0
     if ns.chaos:
         result = chaos_ab(model, params, ns)
         for line in result["gates"]:

@@ -99,6 +99,26 @@ def sample_token_batched(keys: jax.Array, logits: jax.Array, *,
                      drawn.astype(jnp.int32))
 
 
+def sample_token_window(keys: jax.Array, logits: jax.Array, *,
+                        temperature: jax.Array, top_k: int = 0,
+                        top_p: float = 1.0) -> jax.Array:
+    """Per-(row, position) sampling for the speculative verify step:
+    ``logits`` (B, S, V) with keys (B, S) — each window position draws
+    with its own stream key (the request key folded with the token
+    count that position would have in sequential decode) and its row's
+    temperature, so the emitted token at every position is EXACTLY the
+    one :func:`sample_token_batched` would draw in the sequential
+    engine.  Implemented as the batched sampler over the flattened
+    (B·S, V) view — same per-row math, pinned by the spec-decode
+    token-identity tests."""
+    b, s, _ = logits.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    flat = sample_token_batched(
+        keys.reshape(b * s), logits.reshape(b * s, logits.shape[-1]),
+        temperature=jnp.repeat(temperature, s), top_k=top_k, top_p=top_p)
+    return flat.reshape(b, s)
+
+
 def filter_logits(logits: jax.Array, *, top_k: int = 0,
                   top_p: float = 1.0) -> jax.Array:
     """``top_p_filter(top_k_filter(x, k), p)`` with ONE descending sort.

@@ -425,6 +425,157 @@ def _decode_kernel_chunked(*refs, keys, num_layers, num_heads, kv_heads,
         x_out[...] = x.astype(out_dtype)
 
 
+def _segment_matrices(num_heads, head_dim, dtype):
+    """The constant 0/1 lane-segment matmul pair (reduce / broadcast per
+    head) shared by the fused decode kernel and the paged-attention
+    kernel — Mosaic does not lower lane-splitting reshapes, so per-head
+    reductions ride these instead."""
+    hn = num_heads * head_dim
+    lane = lambda shape, dim: jax.lax.broadcasted_iota(jnp.int32, shape,
+                                                       dim)
+    segm = (lane((hn, num_heads), 0) // head_dim
+            == lane((hn, num_heads), 1)).astype(dtype)
+    return segm, segm.T
+
+
+def _gqa_expand_matrix(num_heads, kv_heads, head_dim, dtype):
+    """(KVH·Dh, H·Dh) constant matmul that replicates each kv head's
+    lanes across its query group (the GQA lane expand)."""
+    g = num_heads // kv_heads
+    kn, hn = kv_heads * head_dim, num_heads * head_dim
+    lane = lambda shape, dim: jax.lax.broadcasted_iota(jnp.int32, shape,
+                                                       dim)
+    i, j = lane((kn, hn), 0), lane((kn, hn), 1)
+    return (i == (j // (g * head_dim)) * head_dim
+            + j % head_dim).astype(dtype)
+
+
+def _paged_attn_kernel(table_ref, pos_ref, q_ref, ks_ref, vs_ref,
+                       kc_ref, vc_ref, segm_ref, segb_ref, *rest,
+                       num_heads, kv_heads, head_dim, block_size):
+    """Block-indexed paged attention, one decode token per slot.
+
+    Grid (slots, blocks_per_slot): the slot's block table (scalar
+    prefetch) drives each grid step's cache-block DMA — the gather IS
+    the index_map, no whole-pool materialization.  Online softmax state
+    (running max / denominator / accumulator) lives in VMEM scratch and
+    is seeded at block 0 with the current token's self term, exactly
+    the fused decode kernel's join."""
+    has_g = kv_heads != num_heads
+    if has_g:
+        expm_ref, out_ref = rest[0], rest[1]
+        m_s, den_s, acc_s = rest[2:]
+    else:
+        out_ref = rest[0]
+        m_s, den_s, acc_s = rest[1:]
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    f32 = jnp.float32
+    mmc = lambda a, bb: jax.lax.dot_general(
+        a, bb, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+    segm = segm_ref[...].astype(f32)
+    segb = segb_ref[...].astype(f32)
+    expand = ((lambda a: a) if not has_g
+              else (lambda a: mmc(a, expm_ref[...].astype(f32))))
+    q = q_ref[...].astype(f32)                      # (1, H·Dh)
+    scale = head_dim ** -0.5
+
+    @pl.when(i == 0)
+    def _seed():
+        k_s = expand(ks_ref[...].astype(f32))       # (1, H·Dh)
+        s_self = mmc(k_s * q, segm) * scale         # (1, H)
+        m_s[...] = s_self
+        den_s[...] = jnp.ones_like(s_self)          # p_self = exp(0)
+        acc_s[...] = expand(vs_ref[...].astype(f32))
+
+    kc = expand(kc_ref[0].astype(f32))              # (bs, H·Dh)
+    vc = expand(vc_ref[0].astype(f32))
+    q_rep = jnp.broadcast_to(q, (block_size, q.shape[1]))
+    s = mmc(kc * q_rep, segm) * scale               # (bs, H)
+    gpos = (i * block_size
+            + jax.lax.broadcasted_iota(jnp.int32, (block_size, 1), 0))
+    s = jnp.where(gpos < pos_ref[b], s, NEG_BIG)    # strictly-older rows
+    m_old = m_s[...]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=0, keepdims=True))
+    alpha = jnp.exp(m_old - m_new)                  # (1, H)
+    p = jnp.exp(s - m_new)                          # (bs, H)
+    den_s[...] = den_s[...] * alpha + jnp.sum(p, axis=0, keepdims=True)
+    pv = mmc(p, segb) * vc                          # (bs, H·Dh)
+    acc_s[...] = (acc_s[...] * mmc(alpha, segb)
+                  + jnp.sum(pv, axis=0, keepdims=True))
+    m_s[...] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        out_ref[...] = acc_s[...] * mmc(1.0 / den_s[...], segb)
+
+
+def paged_attention(q, k_self, v_self, pool_k, pool_v, table, pos, *,
+                    num_heads: int, kv_heads: int, interpret=None):
+    """Paged attention over a block pool: the TPU-build replacement for
+    the serving decode step's ``pool[table]`` XLA gather.
+
+    q: (B, H·Dh) this token's queries; k_self/v_self: (B, KVH·Dh) its
+    k/v (folded online, never written to the pool here); pool_k/pool_v:
+    (num_blocks, block_size, KVH·Dh) ONE layer's hot pool; table:
+    (B, nb) int32 physical block ids (callers clamp -1 to the trash
+    block); pos: (B,) int32 — cache rows strictly below ``pos[b]`` are
+    visible, the self term joins at the softmax.
+
+    Per grid step the kernel DMAs exactly one (block_size, KVH·Dh)
+    cache block chosen by the scalar-prefetched table — per-token cost
+    is O(nb · block_size) regardless of pool size, which is the whole
+    point.  Attention itself is the fused decode kernel's lane-segment
+    arithmetic with an online softmax across block steps.  Returns the
+    fp32 (B, H·Dh) context rows.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, hn = q.shape
+    nb = table.shape[1]
+    _, bs, kn = pool_k.shape
+    hd = hn // num_heads
+    f32 = jnp.float32
+    segm, segb = _segment_matrices(num_heads, hd, f32)
+    grid_invariant = lambda blk: pl.BlockSpec(
+        blk, lambda bb, ii, tr, pr: (0,) * len(blk))
+    row = lambda width: pl.BlockSpec((1, width),
+                                     lambda bb, ii, tr, pr: (bb, 0))
+    in_specs = [
+        row(hn),                                    # q
+        row(kn),                                    # k_self
+        row(kn),                                    # v_self
+        pl.BlockSpec((1, bs, kn),
+                     lambda bb, ii, tr, pr: (tr[bb, ii], 0, 0)),
+        pl.BlockSpec((1, bs, kn),
+                     lambda bb, ii, tr, pr: (tr[bb, ii], 0, 0)),
+        grid_invariant((hn, num_heads)),            # segm
+        grid_invariant((num_heads, hn)),            # segb
+    ]
+    args = [q, k_self, v_self, pool_k, pool_v, segm, segb]
+    if kv_heads != num_heads:
+        in_specs.append(grid_invariant((kn, hn)))
+        args.append(_gqa_expand_matrix(num_heads, kv_heads, hd, f32))
+    kernel = functools.partial(
+        _paged_attn_kernel, num_heads=num_heads, kv_heads=kv_heads,
+        head_dim=hd, block_size=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, hn), lambda bb, ii, tr, pr: (bb, 0)),
+        scratch_shapes=[pltpu.VMEM((1, num_heads), f32),
+                        pltpu.VMEM((1, num_heads), f32),
+                        pltpu.VMEM((1, hn), f32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hn), f32),
+        interpret=interpret,
+    )(jnp.asarray(table, jnp.int32), jnp.asarray(pos, jnp.int32), *args)
+
+
 def fused_decode_step(pack, cache_k, cache_v, x, pos, cfg, *,
                       cache_k_scale=None, cache_v_scale=None,
                       rope_cos=None, rope_sin=None, cache_chunk=None,

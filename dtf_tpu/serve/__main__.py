@@ -140,7 +140,9 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
         num_blocks=ns.pool_blocks, mode=ns.mode, top_k=ns.top_k,
         top_p=ns.top_p, eos_id=ns.eos_id, seed=ns.seed, clock=clock,
         max_queue=ns.max_queue, aging_s=ns.aging_s, on_token=printer,
-        heartbeat=heartbeat, brownout=brownout, chaos=chaos, slo=slo)
+        heartbeat=heartbeat, brownout=brownout, chaos=chaos, slo=slo,
+        spec_k=ns.spec_k, coalesce_prefill=not ns.no_prefill_coalesce,
+        narrow_decode=not ns.no_narrow)
     if ns.admin_port is not None:
         # one admin window per process; a supervisor's next attempt
         # rebinds the fresh engine's ring + monitor onto the same server
@@ -371,6 +373,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="serving fault plan, e.g. "
                         "'slow_decode@40:80ms:60,client_drop@20,"
                         "kv_poison@30' (iteration-keyed)")
+    p.add_argument("--spec_k", type=int, default=0,
+                   help="speculative decoding: up to this many "
+                        "self-drafted (n-gram prompt-lookup) tokens "
+                        "verified per iteration; greedy tokens stay "
+                        "bitwise identical to spec_k=0 (0 = off)")
+    p.add_argument("--no_prefill_coalesce", action="store_true",
+                   help="disable batched multi-request prefill (the "
+                        "determinism A/B's solo baseline)")
+    p.add_argument("--no_narrow", action="store_true",
+                   help="disable the narrowed decode data path (full "
+                        "window / whole pool per step — the ladder's "
+                        "baseline geometry)")
     p.add_argument("--clock", choices=["wall", "virtual"], default="wall")
     p.add_argument("--stream", action="store_true",
                    help="print each token as it is emitted")

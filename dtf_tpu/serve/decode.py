@@ -22,6 +22,27 @@ token-for-token (greedy and sampled, single-device and TP mesh).
 
 Functions are built once per (model, static shape) and cached, so every
 engine over the same model/geometry shares one compiled step.
+
+The FAST data path (ISSUE 14) adds three step shapes on top:
+
+* **narrowed decode** — the engine passes a table sliced to the live
+  context's block extent (``blocks_per_slot`` here is the TABLE WIDTH,
+  not the admission window) and a pool whose hot prefix covers only the
+  allocator's high-water mark, so the per-step gather/scatter cost
+  scales with context actually used, not pool size;
+* **batched prefill** (:func:`build_prefill_batched_fn`) — R same-bucket
+  admissions run as ONE forward with per-row lengths, one compile per
+  (rows, prompt bucket) geometry;
+* **multi-token verify** (:func:`build_verify_fn`) — the speculative
+  decoder's target step: S = k+1 tokens per slot through the paged
+  cache in one pass (batched-prefill math at decode time), emitting the
+  model's own next-token choice at every window position so the host
+  can accept the longest matching draft prefix.
+
+On TPU builds the per-layer gather is a block-indexed DMA inside
+``ops.decode_kernel.paged_attention`` (lane-segment attention, online
+softmax across block grid steps); the XLA gather stays the CPU-sim
+path and the parity oracle.
 """
 
 from __future__ import annotations
@@ -71,11 +92,17 @@ def _sample_keys(seeds, counts):
         lambda s, c: jax.random.fold_in(jax.random.key(s), c))(seeds, counts)
 
 
-def _block_decode_paged(block, lp, x_t, pk, pv, table, pos, visible_bias):
+def _block_decode_paged(block, lp, x_t, pk, pv, table, pos, visible_bias,
+                        kernel=False):
     """One decoder block, one token per slot, against gathered pool
     blocks.  The attention body is a line-for-line mirror of
     ``GPTBlock.decode_step`` (grouped cache, fp32 softmax stats, cache
-    dtype end-to-end); only the cache materialization differs."""
+    dtype end-to-end); only the cache materialization differs.
+
+    ``kernel=True`` swaps the XLA gather+softmax for the block-indexed
+    Pallas paged-attention kernel (ops/decode_kernel.py): the same math
+    with the gather as a per-block DMA — the TPU-build path, run in
+    interpret mode by the CPU parity tests."""
     cfg = block.cfg
     p = lp["attn"]
     b = x_t.shape[0]
@@ -90,14 +117,24 @@ def _block_decode_paged(block, lp, x_t, pk, pv, table, pos, visible_bias):
     t_cache = nbs * bs
     kvh = k_t.shape[2]
     hd = k_t.shape[3]
+    h_all = q.shape[2]
     safe = jnp.maximum(table, 0)                # -1 -> trash block
+    if kernel:
+        from dtf_tpu.ops.decode_kernel import paged_attention
+        out = paged_attention(
+            q.reshape(b, h_all * hd), k_t.reshape(b, kvh * hd),
+            v_t.reshape(b, kvh * hd), pk, pv, safe, pos,
+            num_heads=h_all, kv_heads=kvh)
+        out = out.reshape(b, 1, h_all, hd).astype(x_t.dtype)
+        x_t = x_t + block.attn.out_proj(p, out)
+        y = block._mlp_residual(lp, x_t)
+        return y, k_t[:, 0].reshape(b, -1), v_t[:, 0].reshape(b, -1)
     ck = pk[safe].reshape(b, t_cache, kvh, hd)  # logical-order gather
     cv = pv[safe].reshape(b, t_cache, kvh, hd)
     rows = jnp.arange(b)
     ck = ck.at[rows, pos].set(k_t[:, 0].astype(ck.dtype))
     cv = cv.at[rows, pos].set(v_t[:, 0].astype(cv.dtype))
 
-    h_all = q.shape[2]
     g = h_all // kvh
     qg = q.reshape(b, kvh, g, hd).astype(ck.dtype)
     scale = hd ** -0.5
@@ -113,7 +150,8 @@ def _block_decode_paged(block, lp, x_t, pk, pv, table, pos, visible_bias):
     return y, k_t[:, 0].reshape(b, -1), v_t[:, 0].reshape(b, -1)
 
 
-def _paged_logits(model, params, pool_k, pool_v, table, tok, pos):
+def _paged_logits(model, params, pool_k, pool_v, table, tok, pos,
+                  kernel=False):
     """tok/pos (B,) -> (logits (B, V), new pools).  The layer walk is the
     same unrolled scan as ``GPT._decode_logits`` (decode is latency-
     bound; unrolling lets XLA overlap weight streaming across layers)."""
@@ -131,7 +169,8 @@ def _paged_logits(model, params, pool_k, pool_v, table, tok, pos):
     def layer_scan(carry_x, inputs):
         lp, pk, pv = inputs
         y, k_row, v_row = _block_decode_paged(
-            model.block, lp, carry_x, pk, pv, table, pos, visible_bias)
+            model.block, lp, carry_x, pk, pv, table, pos, visible_bias,
+            kernel=kernel)
         return y, (k_row, v_row)
 
     x, (k_new, v_new) = lax.scan(
@@ -150,7 +189,8 @@ def _paged_logits(model, params, pool_k, pool_v, table, tok, pos):
 
 
 def build_decode_fn(model, *, num_slots: int, blocks_per_slot: int,
-                    block_size: int, top_k: int = 0, top_p: float = 1.0):
+                    block_size: int, top_k: int = 0, top_p: float = 1.0,
+                    kernel: bool = False):
     """The engine's one compiled decode iteration.
 
     ``fn(params, pool_k, pool_v, table (B,nbs) i32, tok (B,) i32,
@@ -168,18 +208,23 @@ def build_decode_fn(model, *, num_slots: int, blocks_per_slot: int,
 
     Static shape per (slots, window): ONE compile covers every batch
     composition — that is what makes continuous batching free of
-    recompiles.  Pools are donated (the update is in-place where the
-    backend allows).
+    recompiles.  ``blocks_per_slot`` is the TABLE WIDTH of this step —
+    the narrowed engine passes the live-context bucket here, the
+    baseline passes the full admission window.  ``kernel=True`` routes
+    attention through the Pallas paged-attention kernel.  Pools are
+    donated (the update is in-place where the backend allows).
     """
     from dtf_tpu.nn.sampling import sample_token_batched
 
-    statics = (num_slots, blocks_per_slot, block_size, top_k, float(top_p))
+    statics = (num_slots, blocks_per_slot, block_size, top_k, float(top_p),
+               bool(kernel))
 
     def build():
         def step(params, pool_k, pool_v, table, tok, pos, temps, seeds,
                  counts):
             logits, pool_k, pool_v = _paged_logits(
-                model, params, pool_k, pool_v, table, tok, pos)
+                model, params, pool_k, pool_v, table, tok, pos,
+                kernel=kernel)
             ok = jnp.all(jnp.isfinite(logits), axis=-1)
             keys = _sample_keys(seeds, counts)
             nxt = sample_token_batched(keys, logits, temperature=temps,
@@ -243,3 +288,193 @@ def build_prefill_fn(model, *, padded_len: int, num_blocks_req: int,
         return jax.jit(prefill, donate_argnums=_donate_pools())
 
     return _cached(model, "prefill", statics, build)
+
+
+def build_prefill_batched_fn(model, *, padded_len: int,
+                             num_blocks_req: int, n_rows: int,
+                             top_k: int = 0, top_p: float = 1.0):
+    """R same-bucket prefills as ONE batched forward — the multi-request
+    generalization of :func:`build_prefill_fn` (whose per-row math it
+    mirrors exactly: rows are independent through the whole network, so
+    a request's first token is bitwise the same whether it prefilled
+    solo or coalesced — pinned by tests).
+
+    ``fn(params, pool_k, pool_v, prompts (R, P_pad) i32, p_lens (R,)
+    i32, blocks (R, nb) i32, temps (R,) f32, seeds (R,) u32)
+    -> (first_toks (R,) i32, pool_k, pool_v)``
+
+    Compiled per (rows bucket, padded prompt length).  Padding rows
+    (the engine rounds R up to a power of two) carry ``blocks`` rows of
+    all-zeros — their k/v lands in the trash block and their sampled
+    token is discarded.
+    """
+    from dtf_tpu.nn.sampling import sample_token_batched
+
+    statics = (padded_len, num_blocks_req, n_rows, top_k, float(top_p))
+
+    def build():
+        def prefill(params, pool_k, pool_v, prompts, p_lens, blocks,
+                    temps, seeds):
+            x = model._embed(params, prompts, jnp.arange(padded_len))
+
+            def prefill_layer(cx, lp):
+                y, k, v = model.block.prefill(lp, cx)
+                return y, (k, v)
+
+            x, (ks, vs) = lax.scan(prefill_layer, x, params["layers"])
+            # per-row logits at the LAST REAL prompt position
+            x_last = jnp.take_along_axis(
+                x, (p_lens - 1)[:, None, None], axis=1)
+            x_last = model.ln_f.apply(params["ln_f"], x_last)
+            logits = model.tok.attend(params["tok"], x_last)[:, 0, :]
+
+            # (L, R, P_pad, KVH, Dh) -> (L, R, nb, bs, KVH*Dh) -> blocks
+            l = ks.shape[0]
+            bs = pool_k.shape[2]
+            chunk = lambda a: a.reshape(l, n_rows, num_blocks_req, bs, -1)
+            pool_k = pool_k.at[:, blocks].set(
+                chunk(ks).astype(pool_k.dtype))
+            pool_v = pool_v.at[:, blocks].set(
+                chunk(vs).astype(pool_v.dtype))
+
+            keys = _sample_keys(seeds, jnp.zeros((n_rows,), jnp.int32))
+            first = sample_token_batched(keys, logits, temperature=temps,
+                                         top_k=top_k, top_p=top_p)
+            return first, pool_k, pool_v
+
+        return jax.jit(prefill, donate_argnums=_donate_pools())
+
+    return _cached(model, "prefill_batched", statics, build)
+
+
+def _paged_window_logits(model, params, pool_k, pool_v, table, toks,
+                         pos0):
+    """S tokens per slot against the paged cache in ONE forward pass —
+    the speculative verify core.  ``toks`` (B, S): the last emitted
+    token followed by the drafts; ``pos0`` (B,): its sequence position.
+    Returns (logits (B, S, V), new pools' k/v rows (L, B, S, KVH·Dh)).
+
+    The attention math is the window generalization of
+    :func:`_paged_logits`'s per-block mirror: the window's own k/v rows
+    fold into the gathered view at positions ``pos0 + s`` and the
+    visibility mask is per (query position, cache row) — query ``s``
+    sees rows ``<= pos0 + s``, so rejected-draft rows left stale in the
+    pool by an earlier verify step sit strictly above every later
+    query's horizon until overwritten."""
+    cfg = model.cfg
+    bs = pool_k.shape[2]
+    nbs = table.shape[1]
+    b, s_w = toks.shape
+    t_cache = nbs * bs
+    posw = pos0[:, None] + jnp.arange(s_w)[None, :]          # (B, S)
+    # clamped only for OOB-safe embedding of invalid (past-n_in) rows;
+    # valid rows always sit inside the admission window
+    pos_emb = jnp.minimum(posw, cfg.max_len - 1)
+    x = model._embed(params, toks, pos_emb)                  # (B, S, D)
+    visible_bias = jnp.where(
+        jnp.arange(t_cache)[None, None, None, None, :]
+        <= posw[:, None, None, :, None], 0.0, NEG_BIG)       # (B,1,1,S,T)
+    safe = jnp.maximum(table, 0)
+    rows = jnp.arange(b)[:, None]
+
+    def layer_scan(carry_x, inputs):
+        lp, pk, pv = inputs
+        block = model.block
+        p = lp["attn"]
+        h = block.ln1.apply(lp["ln1"], carry_x)
+        q, k_t, v_t = block.attn.qkv(p, h)     # (B,S,H,Dh)/(B,S,KVH,Dh)
+        if cfg.rope:
+            from dtf_tpu.nn.rope import apply_rope
+            q = apply_rope(q, pos_emb)
+            k_t = apply_rope(k_t, pos_emb)
+        kvh = k_t.shape[2]
+        hd = k_t.shape[3]
+        ck = pk[safe].reshape(b, t_cache, kvh, hd)
+        cv = pv[safe].reshape(b, t_cache, kvh, hd)
+        # fold the whole window in; rows past a slot's n_in are masked
+        # out of every valid query by the position horizon above
+        ck = ck.at[rows, posw].set(k_t.astype(ck.dtype), mode="drop")
+        cv = cv.at[rows, posw].set(v_t.astype(cv.dtype), mode="drop")
+        h_all = q.shape[2]
+        g = h_all // kvh
+        qg = q.reshape(b, s_w, kvh, g, hd).astype(ck.dtype)
+        scale = hd ** -0.5
+        s = jnp.einsum("bskgd,btkd->bkgst", qg, ck,
+                       preferred_element_type=jnp.float32) * scale
+        s = s + visible_bias                   # (B, KVH, G, S, T)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bkgst,btkd->bskgd", w.astype(cv.dtype), cv,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(b, s_w, h_all, hd).astype(carry_x.dtype)
+        y = carry_x + block.attn.out_proj(p, out)
+        y = block._mlp_residual(lp, y)
+        return y, (k_t.reshape(b, s_w, -1), v_t.reshape(b, s_w, -1))
+
+    x, (k_new, v_new) = lax.scan(
+        layer_scan, x, (params["layers"], pool_k, pool_v), unroll=True)
+    x = model.ln_f.apply(params["ln_f"], x)
+    logits = model.tok.attend(params["tok"], x)              # (B, S, V)
+    return logits, k_new, v_new
+
+
+def build_verify_fn(model, *, num_slots: int, blocks_per_slot: int,
+                    block_size: int, width: int, top_k: int = 0,
+                    top_p: float = 1.0):
+    """The speculative decoder's target step: S = ``width`` tokens per
+    slot (current token + k drafts) verified in one paged pass.
+
+    ``fn(params, pool_k, pool_v, table (B,nb) i32, toks (B,S) i32,
+    pos0 (B,) i32, n_in (B,) i32, temps (B,) f32, seeds (B,) u32,
+    counts (B,) i32) -> (out_toks (B,S) i32, ok (B,) bool, pool_k,
+    pool_v)``
+
+    ``out_toks[b, s]`` is the model's OWN next-token choice after
+    window position ``s`` — greedy argmax or the request's (seed, rid,
+    count+s)-keyed draw, exactly the token the sequential decode step
+    would emit given the same prefix.  The host accepts drafts while
+    ``toks[b, s+1] == out_toks[b, s]`` and emits the bonus token at the
+    first mismatch, so the emitted stream is bitwise the sequential
+    one.  K/V rows are written for positions ``pos0 .. pos0+n_in-1``
+    (rows past ``n_in`` scatter to the trash block); rejected-draft
+    rows go stale above the next query horizon and are overwritten
+    before they can become visible.
+    """
+    from dtf_tpu.nn.sampling import sample_token_window
+
+    statics = (num_slots, blocks_per_slot, block_size, width, top_k,
+               float(top_p))
+
+    def build():
+        def verify(params, pool_k, pool_v, table, toks, pos0, n_in,
+                   temps, seeds, counts):
+            b, s_w = toks.shape
+            bs = pool_k.shape[2]
+            nbs = table.shape[1]
+            logits, k_new, v_new = _paged_window_logits(
+                model, params, pool_k, pool_v, table, toks, pos0)
+            valid = jnp.arange(s_w)[None, :] < n_in[:, None]  # (B, S)
+            ok = jnp.all(jnp.isfinite(logits) | ~valid[:, :, None],
+                         axis=(1, 2))
+            # per-(row, position) keys: position s draws at stream
+            # count counts+s — the count the sequential step would use
+            keys = jax.vmap(lambda sd, c: jax.vmap(
+                lambda cc: jax.random.fold_in(jax.random.key(sd), cc))(
+                    c + jnp.arange(s_w, dtype=jnp.int32)))(seeds, counts)
+            out_toks = sample_token_window(
+                keys, logits, temperature=temps, top_k=top_k, top_p=top_p)
+            # scatter the window's k/v rows: valid rows to their table
+            # blocks, the rest to the trash block
+            posw = pos0[:, None] + jnp.arange(s_w)[None, :]
+            blk_idx = jnp.clip(posw // bs, 0, nbs - 1)
+            blk = jnp.take_along_axis(table, blk_idx, axis=1)
+            blk = jnp.where(valid, jnp.maximum(blk, 0), 0)
+            off = posw % bs
+            pool_k = pool_k.at[:, blk, off].set(
+                k_new.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, blk, off].set(
+                v_new.astype(pool_v.dtype))
+            return out_toks, ok, pool_k, pool_v
+
+        return jax.jit(verify, donate_argnums=_donate_pools())
+
+    return _cached(model, "verify", statics, build)

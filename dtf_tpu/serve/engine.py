@@ -60,6 +60,27 @@ def _request_seed(engine_seed: int, rid: int) -> int:
     return (int(engine_seed) * 2654435761 + int(rid) * 40503) % (1 << 32)
 
 
+#: Speculative drafting backoff: a request's draft credit caps here and
+#: a credit-exhausted request retries one draft round every this many
+#: verify iterations (loops form late in greedy streams — never
+#: retrying would miss them; retrying every round would let one
+#: undraftable stream tax the whole batch's p99 TPOT).
+SPEC_CREDIT_MAX = 8
+SPEC_RETRY_EVERY = 8
+
+
+def _pow2_bucket(n: int, cap: int) -> int:
+    """Smallest power of two >= n, clamped to cap (>= 1).  The geometry
+    bucketing that bounds compile count: narrowed decode table widths,
+    hot pool prefixes, and batched-prefill row counts all quantize
+    through this, so a serving process warms O(log) executables per
+    shape family instead of one per live-context length."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return max(1, min(b, cap))
+
+
 class ServingEngine:
     """See module docstring.  ``model`` is a :class:`dtf_tpu.models.gpt.
     GPT` (params may be sharded under a mesh — GSPMD inserts the
@@ -77,7 +98,12 @@ class ServingEngine:
                  on_token: Optional[Callable] = None,
                  heartbeat: Optional[Callable[[int], None]] = None,
                  brownout=None, chaos=None, slo=None,
-                 trace_ring_capacity: int = 64):
+                 trace_ring_capacity: int = 64,
+                 coalesce_prefill: bool = True,
+                 narrow_decode: bool = True,
+                 spec_k: int = 0,
+                 decode_kernel: Optional[bool] = None,
+                 pool: Optional[KVPool] = None):
         t_init = time.perf_counter()
         # Close any open supervisor down-window into the restart bucket
         # (run_supervised marks down at the crash; construction of the
@@ -98,7 +124,21 @@ class ServingEngine:
             # no-sharing default: every slot can hold a full window;
             # size it down to see paging's pool-sharing win
             num_blocks = 1 + num_slots * self.blocks_per_slot
-        self.pool = KVPool.create(cfg, num_blocks, block_size)
+        if pool is not None:
+            # externally-owned pool (the decode ladder reuses ONE pool
+            # across its timed engine constructions so the per-call
+            # zeros/concat churn stays out of the marginal fit); stale
+            # finite rows are harmless — prefill rewrites every block
+            # before an unmasked read
+            if (pool.num_blocks != num_blocks
+                    or pool.block_size != block_size):
+                raise ValueError(
+                    f"external pool geometry ({pool.num_blocks} blocks "
+                    f"x {pool.block_size}) != engine "
+                    f"({num_blocks} x {block_size})")
+            self.pool = pool
+        else:
+            self.pool = KVPool.create(cfg, num_blocks, block_size)
         self.clock = clock or WallClock()
         self.scheduler = Scheduler(
             num_slots=num_slots, allocator=BlockAllocator(num_blocks),
@@ -140,11 +180,44 @@ class ServingEngine:
         self._seeds = np.zeros((num_slots,), np.uint32)
         self._counts = np.zeros((num_slots,), np.int32)
 
-        self._decode_fn = dec.build_decode_fn(
-            model, num_slots=num_slots,
-            blocks_per_slot=self.blocks_per_slot, block_size=block_size,
-            top_k=top_k, top_p=top_p)
+        #: Coalesce same-bucket admissions into one batched prefill call
+        #: (serve/decode.py build_prefill_batched_fn).  Off = the solo
+        #: per-request path — the determinism A/B's baseline arm.
+        self.coalesce_prefill = bool(coalesce_prefill)
+        #: Narrowed decode data path: table width bucketed to the live
+        #: context's block extent and the pool's hot prefix bucketed to
+        #: the allocator high-water mark, so per-token cost scales with
+        #: context used, not pool size.  Off = full-window whole-pool
+        #: geometry — the ladder's baseline arm.
+        self.narrow_decode = bool(narrow_decode)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        #: Speculative decoding: the n-gram self-drafter (serve/spec.py)
+        #: proposes up to spec_k tokens per slot per iteration and the
+        #: verify step emits the model's own choices, so the greedy
+        #: token stream is bitwise the sequential one (tested).
+        self.spec_k = int(spec_k)
+        #: Pallas paged-attention kernel for the decode gather (TPU
+        #: builds; None = auto: TPU backend AND Mosaic-legal geometry —
+        #: 8-aligned block rows, 128-aligned head lanes; explicit True
+        #: forces it, e.g. interpret-mode parity tests).  The XLA
+        #: gather remains the CPU-sim path and the parity oracle.
+        import jax as _jax
+        kvh = cfg.num_kv_heads or cfg.num_heads
+        lanes_ok = (block_size % 8 == 0
+                    and (kvh * (cfg.dim // cfg.num_heads)) % 128 == 0
+                    and cfg.dim % 128 == 0)
+        self.decode_kernel = (bool(decode_kernel)
+                              if decode_kernel is not None
+                              else _jax.default_backend() == "tpu"
+                              and lanes_ok)
         self._compiled: set = set()
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.prefill_calls = 0
+        if not self.narrow_decode:
+            # baseline geometry: whole pool stays hot for process life
+            self.pool.ensure_hot(self.pool.num_blocks)
 
         self._next_rid = 0
         self.results: Dict[int, Request] = {}
@@ -368,14 +441,38 @@ class ServingEngine:
         self._emit(req, token, done)
         return done
 
-    def _prefill(self, slot: int, req: Request) -> None:
-        import jax.numpy as jnp
-
+    def _mark_admitted(self, slot: int, req: Request) -> None:
         self.reqtrace.event(req, "admitted", self.clock.now(), slot=slot,
                             iteration=self.iterations,
                             queue_wait_ms=round(
                                 (self.clock.now() - req.arrival_s) * 1e3,
                                 3))
+
+    def _post_prefill(self, slot: int, req: Request, first: int,
+                      seed: int, p_pad: int, c0: float) -> None:
+        """Per-request bookkeeping shared by the solo and batched
+        prefill paths: the batch-log entry (mode-independent — the
+        coalescing determinism pin compares it across paths), slot-side
+        state, and the first token's emission.  Clock charges and the
+        rate-estimator feed happen at CALL level before this runs."""
+        tel.counter("serve/prefill_tokens_total").inc(p_pad)
+        self.batch_log.append(("prefill", req.rid))
+        self.reqtrace.event(req, "prefill", self.clock.now(),
+                            tokens=p_pad,
+                            dur_ms=round((self.clock.now() - c0) * 1e3, 3))
+        req.pos = req.prompt_len
+        self._table[slot] = -1
+        self._table[slot, :len(req.blocks)] = req.blocks
+        self._tok[slot] = first
+        self._pos[slot] = req.prompt_len
+        self._temps[slot] = req.temperature
+        self._seeds[slot] = seed
+        self._counts[slot] = 1
+        self._token_out(req, first, self.clock.now())
+
+    def _prefill(self, slot: int, req: Request) -> None:
+        import jax.numpy as jnp
+
         p_len = req.prompt_len
         p_pad = req.padded_prompt_len(self.block_size)
         nb_prompt = p_pad // self.block_size
@@ -396,44 +493,141 @@ class ServingEngine:
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray([seed], jnp.uint32))
             first = int(first)
-        self._book(("prefill", p_pad), time.perf_counter() - t0)
+        self._book(("prefill", p_pad, self.pool.hot_blocks),
+                   time.perf_counter() - t0)
+        self.prefill_calls += 1
+        tel.histogram("serve/prefill_batch_size").observe(1)
         self.clock.charge("prefill", tokens=p_pad)
         # Feed the deadline estimator from the SAME clock latencies a
         # client experiences (wall or virtual), so feasibility math and
         # measured TTFT cannot disagree about what "slow" means.
         self.scheduler.observe_prefill(p_pad, self.clock.now() - c0)
-        tel.counter("serve/prefill_tokens_total").inc(p_pad)
-        self.batch_log.append(("prefill", req.rid))
-        self.reqtrace.event(req, "prefill", self.clock.now(),
-                            tokens=p_pad,
-                            dur_ms=round((self.clock.now() - c0) * 1e3, 3))
+        self._post_prefill(slot, req, first, seed, p_pad, c0)
 
-        req.pos = p_len
-        self._table[slot] = -1
-        self._table[slot, :len(req.blocks)] = req.blocks
-        self._tok[slot] = first
-        self._pos[slot] = p_len
-        self._temps[slot] = req.temperature
-        self._seeds[slot] = seed
-        self._counts[slot] = 1
-        self._token_out(req, first, self.clock.now())
+    def _prefill_batch(self, group: List[Tuple[int, Request]]) -> None:
+        """R same-bucket admissions through ONE batched prefill call
+        (rows rounded up to a power of two; padding rows write the
+        trash block and their sampled token is discarded)."""
+        import jax.numpy as jnp
+
+        p_pad = group[0][1].padded_prompt_len(self.block_size)
+        nb_prompt = p_pad // self.block_size
+        r = len(group)
+        r_pad = _pow2_bucket(r, max(self.num_slots, r))
+        fn = dec.build_prefill_batched_fn(
+            self.model, padded_len=p_pad, num_blocks_req=nb_prompt,
+            n_rows=r_pad, top_k=self.top_k, top_p=self.top_p)
+        prompts = np.zeros((r_pad, p_pad), np.int32)
+        p_lens = np.ones((r_pad,), np.int32)
+        blocks = np.zeros((r_pad, nb_prompt), np.int32)    # pad -> trash
+        temps = np.zeros((r_pad,), np.float32)
+        seeds = np.zeros((r_pad,), np.uint32)
+        for i, (_, req) in enumerate(group):
+            prompts[i, :req.prompt_len] = req.prompt
+            p_lens[i] = req.prompt_len
+            blocks[i] = req.blocks[:nb_prompt]
+            temps[i] = req.temperature
+            seeds[i] = _request_seed(self.seed, req.rid)
+        c0 = self.clock.now()
+        t0 = time.perf_counter()
+        with tel.span("serve/prefill", tokens=int(p_pad) * r,
+                      rids=sorted(int(req.rid) for _, req in group),
+                      t=round(c0, 6)):
+            firsts, self.pool.k, self.pool.v = fn(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(prompts), jnp.asarray(p_lens),
+                jnp.asarray(blocks), jnp.asarray(temps),
+                jnp.asarray(seeds))
+            firsts = np.asarray(firsts)
+        self._book(("prefill_batch", p_pad, r_pad, self.pool.hot_blocks),
+                   time.perf_counter() - t0)
+        self.prefill_calls += 1
+        tel.histogram("serve/prefill_batch_size").observe(r)
+        # one virtual charge per member — the cost-model trajectory (and
+        # so every scheduling decision and the batch log) is identical
+        # to the solo path's; the batched win is measured on the wall
+        # clock and in dispatch/compile counts, not by rigging the
+        # policy clock
+        for _ in group:
+            self.clock.charge("prefill", tokens=p_pad)
+        self.scheduler.observe_prefill(p_pad * r, self.clock.now() - c0)
+        for i, (slot, req) in enumerate(group):
+            self._post_prefill(slot, req, int(firsts[i]),
+                               int(seeds[i]), p_pad, c0)
+
+    def _prefill_admitted(self,
+                          admitted: List[Tuple[int, Request]]) -> None:
+        """Dispatch this iteration's admissions to prefill: coalesce
+        same-bucket runs into batched calls (admission order is
+        preserved — the scheduler's decisions, the batch log, and every
+        request's tokens are identical to the solo path, pinned by the
+        determinism A/B), or run each solo when coalescing is off."""
+        for slot, req in admitted:
+            self._mark_admitted(slot, req)
+        i = 0
+        while i < len(admitted):
+            if not self.coalesce_prefill:
+                self._prefill(*admitted[i])
+                i += 1
+                continue
+            p_pad = admitted[i][1].padded_prompt_len(self.block_size)
+            j = i + 1
+            while (j < len(admitted)
+                   and admitted[j][1].padded_prompt_len(self.block_size)
+                   == p_pad):
+                j += 1
+            group = admitted[i:j]
+            if len(group) == 1:
+                self._prefill(*group[0])
+            else:
+                self._prefill_batch(group)
+            i = j
+
+    # -- narrowed geometry --------------------------------------------------
+
+    def _nb_bucket(self, active: List[Request], extra: int) -> int:
+        """Narrowed decode table width: blocks covering the batch's
+        deepest live context plus the rows this step will write
+        (``extra`` = 1 for plain decode, the window width for verify),
+        bucketed to a power of two so compile count stays O(log)."""
+        if not self.narrow_decode:
+            return self.blocks_per_slot
+        need_rows = max(int(self._pos[r.slot]) + extra for r in active)
+        nb = blocks_for(need_rows, self.block_size)
+        return _pow2_bucket(nb, self.blocks_per_slot)
+
+    def _ensure_hot_prefix(self) -> None:
+        """Bucket the pool's hot prefix to the allocator's high-water
+        mark — the other half of "cost scales with context used": the
+        functional scatter's copy is of the hot arrays only."""
+        if not self.narrow_decode:
+            return
+        h = _pow2_bucket(self.scheduler.allocator.highest_used() + 1,
+                         self.pool.num_blocks)
+        self.pool.ensure_hot(h)
 
     def _decode(self, active: List[Request]) -> None:
         import jax.numpy as jnp
 
+        nb = self._nb_bucket(active, 1)
+        fn = dec.build_decode_fn(
+            self.model, num_slots=self.num_slots, blocks_per_slot=nb,
+            block_size=self.block_size, top_k=self.top_k,
+            top_p=self.top_p, kernel=self.decode_kernel)
         c0 = self.clock.now()
         t0 = time.perf_counter()
         with tel.span("serve/decode", batch=len(active),
                       rids=sorted(int(r.rid) for r in active),
                       iteration=self.iterations, t=round(c0, 6)):
-            nxt, ok, self.pool.k, self.pool.v = self._decode_fn(
+            nxt, ok, self.pool.k, self.pool.v = fn(
                 self.params, self.pool.k, self.pool.v,
-                jnp.asarray(self._table), jnp.asarray(self._tok),
+                jnp.asarray(self._table[:, :nb]), jnp.asarray(self._tok),
                 jnp.asarray(self._pos), jnp.asarray(self._temps),
                 jnp.asarray(self._seeds), jnp.asarray(self._counts))
             nxt = np.asarray(nxt)
             ok = np.asarray(ok)
-        self._book(("decode",), time.perf_counter() - t0)
+        self._book(("decode", nb, self.pool.hot_blocks),
+                   time.perf_counter() - t0)
         self.clock.charge("decode", batch=len(active))
         self.scheduler.observe_decode(self.clock.now() - c0)
         now = self.clock.now()
@@ -462,6 +656,141 @@ class ServingEngine:
             self._counts[slot] += 1
             self._tok[slot] = tok
             self._token_out(req, tok, now)
+
+    # -- speculative decoding -----------------------------------------------
+
+    def _spec_decode(self, active: List[Request]) -> None:
+        """One speculative iteration: the n-gram self-drafter proposes
+        up to ``spec_k`` tokens per slot, the verify step runs the whole
+        window through the paged cache in one pass, and the host emits
+        the longest prefix of drafts the model itself would have chosen
+        plus the bonus token at the first mismatch — so every emitted
+        token is the model's own choice and the greedy stream is
+        bitwise the sequential engine's (pinned).  Slots with nothing
+        to draft (budget exhausted, no n-gram match) ride the same
+        window with a 1-token ``n_in``; if NO slot drafted, the plain
+        decode step runs instead (cheaper geometry)."""
+        import jax.numpy as jnp
+
+        from dtf_tpu.serve.spec import propose_drafts
+
+        s_w = self.spec_k + 1
+        toks = np.zeros((self.num_slots, s_w), np.int32)
+        n_in = np.ones((self.num_slots,), np.int32)
+        proposed = 0
+        for req in active:
+            slot = req.slot
+            toks[slot, 0] = self._tok[slot]
+            budget = req.max_new_tokens - len(req.tokens) - 1
+            d = min(self.spec_k, max(budget, 0))
+            # adaptive backoff: a request whose drafts keep getting
+            # rejected stops paying the verify premium (rides the
+            # window with n_in=1) until the periodic retry — p99 TPOT
+            # must never be hostage to an undraftable stream.  The
+            # retry itself probes with a SINGLE draft (one extra verify
+            # lane); a hit restores credit and the next round drafts
+            # the full k again.
+            if req.spec_credit <= 0:
+                req.spec_idle += 1
+                if req.spec_idle >= SPEC_RETRY_EVERY:
+                    d = min(d, 1)
+                else:
+                    d = 0
+            if d > 0:
+                drafts = propose_drafts(
+                    np.concatenate([req.prompt,
+                                    np.asarray(req.tokens, np.int32)]), d)
+                if drafts:
+                    toks[slot, 1:1 + len(drafts)] = drafts
+                    n_in[slot] = 1 + len(drafts)
+                    proposed += len(drafts)
+                else:
+                    # an attempted-but-empty draft round consumes credit
+                    # too: without this, an undraftable (high-entropy)
+                    # stream would re-scan its whole context EVERY
+                    # iteration forever — the exact per-iteration host
+                    # tax the backoff exists to bound
+                    req.spec_idle = 0
+                    req.spec_credit -= 1
+        if proposed == 0:
+            return self._decode(active)
+        nb = self._nb_bucket(active, s_w)
+        fn = dec.build_verify_fn(
+            self.model, num_slots=self.num_slots, blocks_per_slot=nb,
+            block_size=self.block_size, width=s_w, top_k=self.top_k,
+            top_p=self.top_p)
+        c0 = self.clock.now()
+        t0 = time.perf_counter()
+        with tel.span("serve/decode", batch=len(active),
+                      rids=sorted(int(r.rid) for r in active),
+                      iteration=self.iterations, spec=int(proposed),
+                      t=round(c0, 6)):
+            out_toks, ok, self.pool.k, self.pool.v = fn(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(self._table[:, :nb]), jnp.asarray(toks),
+                jnp.asarray(self._pos), jnp.asarray(n_in),
+                jnp.asarray(self._temps), jnp.asarray(self._seeds),
+                jnp.asarray(self._counts))
+            out_toks = np.asarray(out_toks)
+            ok = np.asarray(ok)
+        self._book(("verify", nb, s_w, self.pool.hot_blocks),
+                   time.perf_counter() - t0)
+        self.clock.charge("verify", batch=len(active),
+                          tokens=int(proposed))
+        now = self.clock.now()
+        emitted = 0
+        accepted = 0
+        self.batch_log.append(
+            ("decode", tuple(sorted(r.rid for r in active))))
+        for req in active:
+            slot = req.slot
+            if not bool(ok[slot]):
+                self._scrub_blocks(req.blocks)
+                self._evict(req, "failed", "serve/kv_evictions_total")
+                self._emit(req, -1, True)
+                continue
+            # accept drafts while they equal the model's own choice
+            a = 0
+            while (a + 1 < int(n_in[slot])
+                   and toks[slot, a + 1] == out_toks[slot, a]):
+                a += 1
+            row_emitted = 0
+            for i in range(a + 1):
+                tok = int(out_toks[slot, i])
+                req.pos += 1
+                self._pos[slot] += 1
+                self._counts[slot] += 1
+                self._tok[slot] = tok
+                row_emitted += 1
+                if self._token_out(req, tok, now):
+                    break
+            emitted += row_emitted
+            # drafts that became emitted tokens (EOS can cut the tail)
+            accepted += row_emitted - 1
+            if int(n_in[slot]) > 1:
+                req.spec_idle = 0
+                if a > 0:
+                    req.spec_credit = min(
+                        max(req.spec_credit, 0) + a, SPEC_CREDIT_MAX)
+                else:
+                    req.spec_credit -= 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        with tel.get_registry().locked():
+            tel.counter("serve/spec_proposed_total").inc(proposed)
+            tel.counter("serve/spec_accepted_total").inc(accepted)
+        tel.counter("serve/decode_iterations_total").inc()
+        tel.counter("serve/tokens_generated_total").inc(emitted)
+        # the EWMA learns seconds per EMITTED token per slot, so the
+        # deadline feasibility math tracks the speculative rate.  A
+        # zero-emission iteration (every slot evicted for non-finite
+        # logits) is NOT a rate observation — dividing by an epsilon
+        # token count would inflate the EWMA ~1e9x and shed every
+        # queued request as infeasible for the next ~70 iterations.
+        if emitted > 0:
+            self.scheduler.observe_decode(
+                self.clock.now() - c0,
+                tokens_per_slot=emitted / len(active))
 
     def _oldest_active(self) -> Optional[Request]:
         act = self.scheduler.active()
@@ -506,11 +835,16 @@ class ServingEngine:
         if self.chaos is not None:
             self._serve_chaos()
         admitted = self.scheduler.admit(self.clock.now())
-        for slot, req in admitted:
-            self._prefill(slot, req)
+        if admitted:
+            self._ensure_hot_prefix()
+            self._prefill_admitted(admitted)
         active = self.scheduler.active()
         if active:
-            self._decode(active)
+            self._ensure_hot_prefix()
+            if self.spec_k > 0:
+                self._spec_decode(active)
+            else:
+                self._decode(active)
         if self.brownout is not None:
             level = self.brownout.update(
                 self.iterations,
@@ -656,8 +990,16 @@ class ServingEngine:
                "kv_blocks_total": self.pool.num_blocks - 1,
                "kv_blocks_peak": self._blocks_peak,
                "kv_block_size": self.block_size,
+               "prefill_calls": self.prefill_calls,
                "decode_iterations": sum(
                    1 for e in self.batch_log if e[0] == "decode")}
+        if self.spec_k > 0:
+            out["spec_k"] = self.spec_k
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_acceptance"] = (
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else None)
         if self.brownout is not None:
             out["brownout"] = self.brownout.state()
         if self.slo is not None:

@@ -33,9 +33,20 @@ into logical order before attention (``pool[table]``), which
 materializes a transient contiguous view — correct everywhere, and
 exactly what the parity test leans on (the gather of a permuted table
 is bit-identical to the contiguous layout).  On real TPU hardware the
-gather would instead be a block-indexed DMA inside a paged decode
-kernel (a future ops/ kernel); the *pool residency* — the HBM claim —
-is what paging buys at either maturity level.
+gather is instead a block-indexed DMA inside the paged decode kernel
+(``ops.decode_kernel.paged_attention``); the *pool residency* — the
+HBM claim — is what paging buys at either maturity level.
+
+Cost model note (the narrowed data path): the jitted step consumes the
+pool FUNCTIONALLY — on backends without donation (the CPU sim) every
+step's scatter copies the whole pool, so per-token cost scales with
+POOL SIZE, not with context used.  The allocator hands out lowest ids
+first, so live blocks concentrate in a low prefix; :meth:`KVPool.
+ensure_hot` keeps exactly that prefix (bucketed) as the working "hot"
+arrays the step touches, parking the tail in cold storage that only
+moves on bucket transitions.  Per-token cost then scales with the
+pool's *high-water mark*, and the decode ladder's oversized-pool
+invariance gate pins it.
 """
 
 from __future__ import annotations
@@ -73,6 +84,11 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # sorted free list; pop from the front = lowest id first
         self._free: List[int] = list(range(1, num_blocks))
+        # allocated ids, maintained incrementally: highest_used() must
+        # be O(live blocks), never O(pool) — an O(pool) scan per engine
+        # iteration would reintroduce exactly the pool-size cost term
+        # the narrowed data path exists to remove (measured)
+        self._used: set = set()
 
     @property
     def free_blocks(self) -> int:
@@ -98,6 +114,7 @@ class BlockAllocator:
                 f"asked for {n} KV blocks, {len(self._free)} free "
                 f"(pool {self.num_blocks - 1} usable)")
         out, self._free = self._free[:n], self._free[n:]
+        self._used.update(out)
         return out
 
     def free(self, blocks: List[int]) -> None:
@@ -110,6 +127,16 @@ class BlockAllocator:
                 raise ValueError(f"double free of block {b}")
         # keep the free list sorted so allocation order stays canonical
         self._free = sorted(self._free + list(blocks))
+        self._used.difference_update(blocks)
+
+    def highest_used(self) -> int:
+        """Largest physical block id currently allocated (0 = none; the
+        trash block is always id 0).  Lowest-id-first allocation keeps
+        live blocks in a low prefix, so ``highest_used() + 1`` is the
+        pool prefix the decode step actually needs resident — the
+        narrowed data path's hot-prefix bound.  O(live blocks) by
+        construction (called every engine iteration)."""
+        return max(self._used, default=0)
 
 
 def blocks_for(tokens: int, block_size: int) -> int:
@@ -121,16 +148,26 @@ def blocks_for(tokens: int, block_size: int) -> int:
 class KVPool:
     """The device-resident block pool for one model.
 
-    ``k``/``v``: ``(num_layers, num_blocks, block_size, KVH·Dh)`` in the
-    model dtype.  Functional updates (jax arrays are immutable): the
-    scatter helpers return NEW pool arrays; the engine threads them
-    through its jitted step exactly like the contiguous cache threads
-    through ``lax.scan`` in ``GPT.generate``.
+    ``k``/``v``: ``(num_layers, hot_blocks, block_size, KVH·Dh)`` in the
+    model dtype — the HOT prefix of the pool, the only arrays the jitted
+    steps touch.  ``cold_k``/``cold_v`` hold the tail blocks
+    (``num_blocks - hot_blocks``) that no live request reaches; they
+    move between hot and cold only at :meth:`ensure_hot` bucket
+    transitions, never per step.  A pool created with
+    ``ensure_hot(num_blocks)`` (the default) is the classic whole-pool
+    layout — the ladder's baseline arm.
+
+    Functional updates (jax arrays are immutable): the scatter helpers
+    return NEW pool arrays; the engine threads them through its jitted
+    step exactly like the contiguous cache threads through ``lax.scan``
+    in ``GPT.generate``.
     """
 
-    k: "object"            # jax array
+    k: "object"            # jax array (hot prefix)
     v: "object"
     block_size: int
+    cold_k: "object" = None    # jax array (tail), zero-width when all hot
+    cold_v: "object" = None
 
     @classmethod
     def create(cls, cfg, num_blocks: int, block_size: int,
@@ -142,13 +179,52 @@ class KVPool:
         kvh = cfg.num_kv_heads or cfg.num_heads
         hd = cfg.dim // cfg.num_heads
         shape = (cfg.num_layers, num_blocks, block_size, kvh * hd)
+        cold = (cfg.num_layers, 0, block_size, kvh * hd)
         dt = dtype or cfg.dtype
         return cls(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
-                   block_size=block_size)
+                   block_size=block_size,
+                   cold_k=jnp.zeros(cold, dt), cold_v=jnp.zeros(cold, dt))
 
     @property
     def num_blocks(self) -> int:
+        return self.k.shape[1] + self.cold_k.shape[1]
+
+    @property
+    def hot_blocks(self) -> int:
         return self.k.shape[1]
+
+    def ensure_hot(self, h: int) -> None:
+        """Resize the hot prefix to exactly ``h`` blocks (ids ``0..h-1``).
+
+        O(pool) concatenates, but only on bucket transitions — the
+        engine buckets ``h`` to powers of two of the allocator's
+        high-water mark, so steady-state iterations never move a byte.
+        Shrinking parks stale-but-finite freed blocks in cold storage;
+        they are rewritten by prefill before any unmasked read when
+        reallocated (trash block 0 is always hot)."""
+        import jax.numpy as jnp
+
+        if not (1 <= h <= self.num_blocks):
+            raise ValueError(
+                f"hot prefix {h} outside [1, {self.num_blocks}]")
+        cur = self.hot_blocks
+        if h == cur:
+            return
+        if h > cur:
+            take = h - cur
+            self.k = jnp.concatenate([self.k, self.cold_k[:, :take]],
+                                     axis=1)
+            self.v = jnp.concatenate([self.v, self.cold_v[:, :take]],
+                                     axis=1)
+            self.cold_k = self.cold_k[:, take:]
+            self.cold_v = self.cold_v[:, take:]
+        else:
+            self.cold_k = jnp.concatenate([self.k[:, h:], self.cold_k],
+                                          axis=1)
+            self.cold_v = jnp.concatenate([self.v[:, h:], self.cold_v],
+                                          axis=1)
+            self.k = self.k[:, :h]
+            self.v = self.v[:, :h]
 
     def bytes_per_block(self) -> int:
         """HBM bytes one block pins across both pool arrays."""

@@ -114,6 +114,14 @@ class Request:
     status: str = "queued"
     shed_reason: Optional[str] = None  # set when status == "shed"
     degraded: bool = False             # brownout clamped max_new_tokens
+    # speculative-decoding adaptive state (engine-owned): drafting
+    # credit — decremented on zero-acceptance verify rounds, restored
+    # by accepted drafts; at 0 the request rides verify windows for
+    # free (n_in=1) until the periodic retry.  Purely a cost policy:
+    # token identity never depends on whether a row drafted (the
+    # verify step emits the model's own choices either way).
+    spec_credit: int = 2
+    spec_idle: int = 0                 # iterations since last draft try
 
     @property
     def prompt_len(self) -> int:
@@ -205,22 +213,33 @@ class VirtualClock:
     static goodput ratio against it.
 
     Cost model (milliseconds): ``prefill = prefill_base + prefill_per_token
-    * tokens``; ``decode = decode_base + decode_per_seq * batch`` — the
-    shape of real decode cost (a fixed dispatch floor plus a per-stream
-    term), with defaults in the measured range of the CPU-sim tiny
-    preset.  Calibrate per chip if the absolute numbers matter; the
-    POLICY comparison only needs the shape.
+    * tokens``; ``decode = decode_base + decode_per_seq * batch``;
+    ``verify = decode + verify_per_token * drafted_tokens`` — the shape
+    of real decode cost (a fixed dispatch floor plus a per-stream term;
+    a speculative verify pays the SAME dispatch floor once for its whole
+    window plus a small per-extra-token compute term, which is exactly
+    why acceptance buys TPOT), with defaults in the measured range of
+    the CPU-sim tiny preset.  Calibrate per chip if the absolute
+    numbers matter; the POLICY comparison only needs the shape.
+    Batched prefill deliberately charges per member (see the engine) so
+    policy A/Bs are prefill-dispatch-mode independent.
     """
 
     def __init__(self, *, decode_base_ms: float = 8.0,
                  decode_per_seq_ms: float = 0.5,
                  prefill_base_ms: float = 2.0,
-                 prefill_per_token_ms: float = 0.2):
+                 prefill_per_token_ms: float = 0.2,
+                 # an extra verify-window token is prefill-like work (one
+                 # more row in an already-dispatched batched matmul), so
+                 # it prices BELOW the prefill per-token rate — it shares
+                 # the decode dispatch it rides on
+                 verify_per_token_ms: float = 0.1):
         self._t = 0.0
         self.decode_base_ms = decode_base_ms
         self.decode_per_seq_ms = decode_per_seq_ms
         self.prefill_base_ms = prefill_base_ms
         self.prefill_per_token_ms = prefill_per_token_ms
+        self.verify_per_token_ms = verify_per_token_ms
 
     def now(self) -> float:
         return self._t
@@ -230,6 +249,10 @@ class VirtualClock:
             ms = self.prefill_base_ms + self.prefill_per_token_ms * tokens
         elif kind == "decode":
             ms = self.decode_base_ms + self.decode_per_seq_ms * batch
+        elif kind == "verify":
+            # one decode dispatch + the window's extra (drafted) tokens
+            ms = (self.decode_base_ms + self.decode_per_seq_ms * batch
+                  + self.verify_per_token_ms * tokens)
         else:
             raise ValueError(f"unknown charge kind {kind!r}")
         self._t += ms / 1e3
@@ -322,13 +345,21 @@ class Scheduler:
             per if self.prefill_s_per_token == 0.0
             else a * per + (1 - a) * self.prefill_s_per_token)
 
-    def observe_decode(self, seconds: float) -> None:
-        if seconds <= 0:
+    def observe_decode(self, seconds: float,
+                       tokens_per_slot: float = 1.0) -> None:
+        """Feed one decode (or speculative verify) iteration's measured
+        cost.  ``tokens_per_slot`` is the mean tokens EMITTED per active
+        slot this iteration (1 for plain decode; >1 when speculation
+        accepted drafts) — the EWMA tracks seconds per emitted token,
+        so deadline feasibility learns the speculative rate instead of
+        overestimating by the acceptance factor."""
+        if seconds <= 0 or tokens_per_slot <= 0:
             return
+        per = seconds / tokens_per_slot
         a = self._ewma_alpha
         self.decode_iter_s = (
-            seconds if self.decode_iter_s == 0.0
-            else a * seconds + (1 - a) * self.decode_iter_s)
+            per if self.decode_iter_s == 0.0
+            else a * per + (1 - a) * self.decode_iter_s)
 
     def estimate_completion_s(self, req: Request) -> float:
         """Best-effort time from "admitted now" to the request's LAST

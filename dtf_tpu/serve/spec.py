@@ -1,0 +1,67 @@
+"""Self-drafting speculation: n-gram prompt-lookup draft proposals.
+
+The cheapest possible draft model — the request's OWN context.  Decode
+streams are heavily self-similar (system prompts, quoted spans, the
+repetition loops small greedy models fall into), so the most recent
+earlier occurrence of the current suffix n-gram is a strong predictor
+of what comes next ("prompt lookup decoding").  The drafter proposes
+the ``k`` tokens that followed that occurrence; the target model
+verifies the whole window in ONE paged step
+(``serve/decode.py build_verify_fn``) and the engine emits the longest
+prefix of drafts the model itself would have chosen, plus the bonus
+token at the first mismatch.
+
+Correctness does not depend on the drafter AT ALL: every emitted token
+is the verify step's own (greedy or (seed, rid, count)-keyed) choice,
+so a terrible drafter costs only wasted verify lanes, never a wrong
+token — the spec-decode token-identity pin in tests/test_serve.py is a
+pin on the verify step, and this module only moves the acceptance rate.
+
+Deliberately jax-free (numpy over the host-side token lists) so drafts
+cost microseconds against the multi-millisecond step they amortize.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+#: Longest suffix n-gram tried first; shorter suffixes are fallbacks.
+#: 3..1 is the standard prompt-lookup ladder — longer matches are rarer
+#: but much more predictive.
+MAX_NGRAM = 3
+
+
+def propose_drafts(context: Sequence[int], k: int,
+                   max_ngram: int = MAX_NGRAM) -> List[int]:
+    """Up to ``k`` draft tokens for ``context`` (prompt + generated so
+    far, most recent last), or ``[]`` when no suffix n-gram of length
+    ``max_ngram..1`` recurs earlier in the context.
+
+    Matching prefers the longest suffix, and within a suffix length the
+    MOST RECENT earlier occurrence (recency beats frequency for decode
+    streams).  Deterministic: same context, same drafts — the
+    speculative engine's batch log stays a pure function of the trace.
+    """
+    if k <= 0:
+        return []
+    ctx = np.asarray(context, dtype=np.int64).reshape(-1)
+    n = ctx.shape[0]
+    for g in range(min(max_ngram, n - 1), 0, -1):
+        suffix = ctx[n - g:]
+        # one vectorized sliding-window compare per suffix length (the
+        # drafter runs per slot per engine iteration — a Python
+        # per-position scan here would cost milliseconds on long
+        # contexts, rivaling the device step it amortizes): candidate
+        # start positions are windows [i, i+g) strictly before the
+        # suffix itself, most recent wins
+        windows = np.lib.stride_tricks.sliding_window_view(
+            ctx[:n - 1], g)
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        if hits.size:
+            i = int(hits[-1])
+            cont = ctx[i + g:i + g + k]
+            if cont.size:
+                return [int(t) for t in cont]
+    return []

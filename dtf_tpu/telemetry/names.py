@@ -89,6 +89,14 @@ METRICS = (
     "serve/kv_blocks_peak",
     "serve/ttft_ms",              # per-request time-to-first-token
     "serve/tpot_ms",              # per-request time-per-output-token
+    # fast decode data path (ISSUE 14): batched multi-request prefill +
+    # speculative decoding.  prefill_batch_size is a histogram of
+    # requests per prefill dispatch (mean > 1 = coalescing is paying);
+    # acceptance = spec_accepted_total / spec_proposed_total, surfaced
+    # in summary() and the report's Serving section.
+    "serve/prefill_batch_size",
+    "serve/spec_proposed_total",
+    "serve/spec_accepted_total",
     # overload control / resilience (PR 10): sheds happen BEFORE prefill
     # (deadline feasibility or brownout level), evictions tear out
     # in-flight requests (client disconnect / detected KV corruption),

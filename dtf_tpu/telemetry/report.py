@@ -197,6 +197,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_final_cost: Optional[float] = None,
                 min_goodput_qps: Optional[float] = None,
                 max_ttft_p99_ms: Optional[float] = None,
+                max_tpot_p99_ms: Optional[float] = None,
                 min_trace_complete_frac: Optional[float] = None,
                 max_skew_ms: Optional[float] = None,
                 min_fleet_goodput: Optional[float] = None,
@@ -220,12 +221,14 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       (``throughput/*`` gauges);
     * ``max_final_cost`` — convergence: the metrics.csv final cost
       (latest attempt) must be at or under the pinned target;
-    * ``min_goodput_qps`` / ``max_ttft_p99_ms`` — the SERVING gates
-      (telemetry.json's ``serving`` section, written by the engine):
-      goodput-QPS floor (completed requests that met the SLO TTFT
-      budget per second of makespan) and p99 TTFT ceiling — the
-      scenario matrix's serve cell gates on these, so serving
-      robustness is CI-judged exactly like training;
+    * ``min_goodput_qps`` / ``max_ttft_p99_ms`` / ``max_tpot_p99_ms``
+      — the SERVING gates (telemetry.json's ``serving`` section,
+      written by the engine): goodput-QPS floor (completed requests
+      that met the SLO TTFT budget per second of makespan), p99 TTFT
+      ceiling, and p99 TPOT ceiling (the streaming-cadence gate the
+      speculative-decoding lane arms) — the scenario matrix's serve
+      cell gates on these, so serving robustness is CI-judged exactly
+      like training;
     * ``min_trace_complete_frac`` — observability gate: of requests
       that COMPLETED, the fraction whose per-request trace reconstructs
       the full admission->prefill->first_token->completion chain from
@@ -290,6 +293,10 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = serving.get("ttft_ms_p99")
         gate("max_ttft_p99_ms", None if v is None else float(v),
              max_ttft_p99_ms, at_most=True)
+    if max_tpot_p99_ms is not None:
+        v = serving.get("tpot_ms_p99")
+        gate("max_tpot_p99_ms", None if v is None else float(v),
+             max_tpot_p99_ms, at_most=True)
     if min_trace_complete_frac is not None:
         v = report.get("request_traces", {}).get("complete_frac")
         gate("min_trace_complete_frac", None if v is None else float(v),
@@ -423,9 +430,11 @@ def render(report: dict, top: int = 10) -> str:
                      "goodput_qps", "slo_ttft_ms", "slo_attainment",
                      "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
                      "tpot_ms_p99", "makespan_s", "tokens_out",
+                     "prefill_calls", "spec_k", "spec_proposed",
+                     "spec_accepted", "spec_acceptance",
                      "kv_blocks_peak", "kv_blocks_total")
             for k in order:
-                if k in serving:
+                if k in serving and serving[k] is not None:
                     v = serving[k]
                     lines.append(f"  {k:<28} "
                                  + (f"{v:>12}" if isinstance(v, str)
@@ -595,6 +604,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "'serving' section)")
     p.add_argument("--max_ttft_p99_ms", type=float, default=None,
                    help="serving gate: p99 TTFT ceiling in ms")
+    p.add_argument("--max_tpot_p99_ms", type=float, default=None,
+                   help="serving gate: p99 TPOT ceiling in ms (the "
+                        "streaming-cadence gate the spec-decode lane "
+                        "arms)")
     p.add_argument("--min_trace_complete_frac", type=float, default=None,
                    help="observability gate: floor on the fraction of "
                         "completed requests with a gap-free "
@@ -666,6 +679,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_final_cost": ns.max_final_cost,
                   "min_goodput_qps": ns.min_goodput_qps,
                   "max_ttft_p99_ms": ns.max_ttft_p99_ms,
+                  "max_tpot_p99_ms": ns.max_tpot_p99_ms,
                   "min_trace_complete_frac": ns.min_trace_complete_frac,
                   "max_skew_ms": ns.max_skew_ms,
                   "min_fleet_goodput": ns.min_fleet_goodput,

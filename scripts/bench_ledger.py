@@ -1,8 +1,10 @@
 #!/usr/bin/env python
 """Perf-regression ledger: fold the loose ``BENCH_r*.json`` /
-``MULTICHIP_r*.json`` round files into one machine-readable
-``LEDGER.jsonl`` — one row per run with rig, commit, TFLOP/s, MFU
-(roofline fraction) and, for failed rounds, the error + stage.
+``MULTICHIP_r*.json`` / ``DECODE_r*.json`` round files into one
+machine-readable ``LEDGER.jsonl`` — one row per run with rig, commit,
+the rig's headline metric (TFLOP/s for matmul rounds, aggregate
+tokens/s for decode-ladder rounds), MFU (roofline fraction) and, for
+failed rounds, the error + stage.
 
 The round files alone hide the trajectory: r01-r02 held ~193 TFLOP/s at
 ~98% of roofline, then r03-r05 all died on ``tpu_unavailable`` relay
@@ -111,6 +113,36 @@ def multichip_row(path: str, repo: str) -> dict:
     return row
 
 
+def decode_row(path: str, repo: str) -> dict:
+    """DECODE_r*.json: one ``bench.decode_ladder --json`` doc (plus an
+    ``n`` round index).  Headline metric = aggregate tokens/s over the
+    ladder's marginal fit; a doc carrying the fit's no-signal warning
+    (or no tok_s at all) folds as an errored round, not a silent gap."""
+    with open(path) as f:
+        doc = json.load(f)
+    run = os.path.splitext(os.path.basename(path))[0]
+    tok_s = doc.get("tok_s_aggregate")
+    ok = tok_s is not None and not doc.get("warning")
+    return {
+        "run": run,
+        "kind": "decode",
+        "n": doc.get("n", _run_index(run)),
+        "commit": _added_commit(repo, os.path.basename(path)),
+        # rig = the ladder doc's full arm geometry (preset/mode/streams/
+        # block_size/narrow/pool...) so deliberately-different arms (a
+        # --no_narrow baseline, an oversized pool) never alias onto one
+        # regression history
+        "rig": doc.get("rig") or (
+            f"decode_{doc.get('preset')}_{doc.get('mode')}"),
+        "tok_s_aggregate": float(tok_s) if ok else None,
+        "per_token_us": doc.get("per_token_us"),
+        "spec_acceptance": doc.get("spec_acceptance"),
+        "ok": ok,
+        "error": None if ok else (doc.get("warning") or "no_tok_s"),
+        "stage": None if ok else "ladder_fit",
+    }
+
+
 def _run_index(run: str) -> "int | None":
     m = re.search(r"_r(\d+)$", run)
     return int(m.group(1)) if m else None
@@ -122,6 +154,8 @@ def build_ledger(repo: str) -> "list[dict]":
         rows.append(bench_row(path, repo))
     for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
         rows.append(multichip_row(path, repo))
+    for path in sorted(glob.glob(os.path.join(repo, "DECODE_r*.json"))):
+        rows.append(decode_row(path, repo))
     # one stream, ordered (kind, round) so the per-rig trajectory reads
     # top to bottom
     rows.sort(key=lambda r: (r["kind"], r["n"] if r["n"] is not None
@@ -145,24 +179,18 @@ def read_ledger(path: str) -> "list[dict]":
     return rows
 
 
-def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
-                 ) -> "tuple[bool, list[str]]":
-    """The regression gate ``bench.py --check-ledger`` runs.
-
-    Per rig (bench rows only — multichip rows are pass/fail dryruns):
-    the NEWEST green run must hold at least ``(1 - tol) x`` the best of
-    the EARLIER green runs on that rig.  A trailing streak of error rows
-    (the stalled r03-r05 shape) prints loud as a warning — an outage is
-    visible, not a perf regression.  Returns (ok, verdict lines)."""
-    lines: "list[str]" = []
+def _gate_kind(rows: "list[dict]", kind: str, field: str, unit: str,
+               tol_pct: float, lines: "list[str]") -> bool:
+    """One kind's newest-green-vs-best-prior gate, per rig.  Returns
+    ok; appends verdict lines."""
     ok = True
-    bench = sorted((r for r in rows if r.get("kind") == "bench"),
-                   key=lambda r: r.get("n") or 0)
+    kind_rows = sorted((r for r in rows if r.get("kind") == kind),
+                       key=lambda r: r.get("n") or 0)
     by_rig: "dict[str, list[dict]]" = {}
-    for r in bench:
-        if r.get("ok") and r.get("tflops_per_chip") and r.get("rig"):
+    for r in kind_rows:
+        if r.get("ok") and r.get(field) and r.get("rig"):
             by_rig.setdefault(r["rig"], []).append(r)
-    if not by_rig:
+    if not by_rig and kind == "bench":
         lines.append("ledger: no green bench rows — nothing to compare")
     for rig, greens in sorted(by_rig.items()):
         latest = greens[-1]
@@ -170,22 +198,22 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
         if not prior:
             lines.append(
                 f"ledger[{rig}]: OK — first green run "
-                f"{latest['run']} at {latest['tflops_per_chip']:g} "
-                f"TFLOP/s (no prior to compare)")
+                f"{latest['run']} at {latest[field]:g} "
+                f"{unit} (no prior to compare)")
             continue
-        best = max(prior, key=lambda r: r["tflops_per_chip"])
-        floor = best["tflops_per_chip"] * (1.0 - tol_pct / 100.0)
-        passed = latest["tflops_per_chip"] >= floor
+        best = max(prior, key=lambda r: r[field])
+        floor = best[field] * (1.0 - tol_pct / 100.0)
+        passed = latest[field] >= floor
         ok = ok and passed
         lines.append(
             f"ledger[{rig}]: {'OK' if passed else 'REGRESSION'} — "
-            f"{latest['run']} {latest['tflops_per_chip']:g} TFLOP/s vs "
+            f"{latest['run']} {latest[field]:g} {unit} vs "
             f"best prior green {best['run']} "
-            f"{best['tflops_per_chip']:g} (floor {floor:g}, "
+            f"{best[field]:g} (floor {floor:g}, "
             f"tol {tol_pct:g}%)")
     # trailing error streak: the stalled-trajectory alarm
     streak = []
-    for r in reversed(bench):
+    for r in reversed(kind_rows):
         if r.get("error"):
             streak.append(r)
         else:
@@ -194,10 +222,28 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
         streak.reverse()
         reasons = {f"{r.get('error')}@{r.get('stage')}" for r in streak}
         lines.append(
-            f"ledger WARNING: last {len(streak)} bench run(s) errored "
+            f"ledger WARNING: last {len(streak)} {kind} run(s) errored "
             f"({', '.join(sorted(reasons))}) — "
             f"{streak[0]['run']}..{streak[-1]['run']}; the perf "
             f"trajectory is STALLED, fresh numbers needed")
+    return ok
+
+
+def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
+                 ) -> "tuple[bool, list[str]]":
+    """The regression gate ``bench.py --check-ledger`` runs.
+
+    Per rig and kind (bench rows gate TFLOP/s, decode rows gate
+    aggregate tokens/s; multichip rows are pass/fail dryruns): the
+    NEWEST green run must hold at least ``(1 - tol) x`` the best of
+    the EARLIER green runs on that rig.  A trailing streak of error rows
+    (the stalled r03-r05 shape) prints loud as a warning — an outage is
+    visible, not a perf regression.  Returns (ok, verdict lines)."""
+    lines: "list[str]" = []
+    ok = _gate_kind(rows, "bench", "tflops_per_chip", "TFLOP/s",
+                    tol_pct, lines)
+    ok = _gate_kind(rows, "decode", "tok_s_aggregate", "tok/s",
+                    tol_pct, lines) and ok
     return ok, lines
 
 

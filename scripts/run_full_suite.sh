@@ -384,6 +384,76 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: TCP front-end tests (rc=$rc)"; tail -10 "$scdir2/tcp.log"; }
   rm -rf "$scdir2"
 fi
+# Decode-fast lane (DESIGN.md §7.5, ISSUE 14): the decode data path at
+# the hardware floor.  (1) paged-vs-baseline ladder A/B on tight AND
+# oversized pools: the narrowed path's marginal ms/token must be
+# pool-size invariant and strictly beat the whole-pool baseline on the
+# oversized pool, while the baseline must demonstrably degrade (the
+# falsifiability half of the invariance claim); (2) same-trace
+# spec-decode serve_load A/B at fixed QPS: p99 TPOT strictly drops,
+# zero token-identity diffs, acceptance > 0, absolute TPOT ceiling via
+# the shared check_gates path; (3) a spec-decode serve session whose
+# report --check stays green with the new spec/prefill instruments.
+# Skip with NO_DECODE_FAST_LANE=1.
+if [ "${NO_DECODE_FAST_LANE:-0}" != "1" ]; then
+  echo "=== decode-fast lane (paged ladder A/B + spec-decode TPOT gate) ==="
+  dfdir=$(mktemp -d)
+  for arm in paged_tight:"":"" paged_over:"--pool_blocks 4096":"" \
+             base_tight:"":"--no_narrow" base_over:"--pool_blocks 4096":"--no_narrow"; do
+    name="${arm%%:*}"; rest="${arm#*:}"
+    pool="${rest%%:*}"; narrow="${rest#*:}"
+    JAX_PLATFORMS=cpu python -m dtf_tpu.bench.decode_ladder \
+        --preset tiny --mode paged --streams 3 --ladder 8,24,48 \
+        --reps 4 --block_size 16 $pool $narrow \
+        --json "$dfdir/$name.json" > "$dfdir/$name.log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: decode ladder arm $name (rc=$rc)"; tail -4 "$dfdir/$name.log"; }
+  done
+  python - "$dfdir" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+arm = {n: json.load(open(os.path.join(d, n + ".json")))
+       for n in ("paged_tight", "paged_over", "base_tight", "base_over")}
+us = {n: a["per_token_us"] for n, a in arm.items()}
+# the baseline's marginal cost must grow with pool size (the disease)
+assert us["base_over"] >= 1.5 * us["base_tight"], \
+    f"baseline did not degrade with pool size: {us}"
+# the narrowed path must be pool-size invariant (the cure) ...
+drift = abs(us["paged_over"] - us["paged_tight"]) / us["paged_tight"]
+assert drift <= 0.5, f"paged marginal drifted {drift:.2f} with pool size: {us}"
+# ... and strictly cheaper than the baseline where it matters
+assert us["paged_over"] < 0.6 * us["base_over"], \
+    f"paged did not beat baseline on the oversized pool: {us}"
+print(f"decode ladder OK: paged {us['paged_tight']:.0f}->"
+      f"{us['paged_over']:.0f} us/tok (drift {drift:.2f}) vs baseline "
+      f"{us['base_tight']:.0f}->{us['base_over']:.0f} us/tok")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: decode ladder A/B assertions (rc=$rc)"; }
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.serve_load --preset tiny \
+      --clock virtual --mode continuous --qps 10 --requests 32 --seed 5 \
+      --prompt_lens 4,8,16 --output_lens 16,32,48 \
+      --spec_ab --spec_k 4 --max_tpot_p99_ms 11.5 \
+      --check --json "$dfdir/spec_ab.json" > "$dfdir/spec.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: spec-decode serve_load A/B (rc=$rc)"; tail -8 "$dfdir/spec.log"; }
+  grep -q "CHECK OK" "$dfdir/spec.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: spec-decode CHECK OK line missing"; }
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 12 \
+      --qps 20 --clock virtual --seed 3 --spec_k 4 \
+      --logdir "$dfdir/specrun" > "$dfdir/specrun.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: spec-decode serve session (rc=$rc)"; tail -6 "$dfdir/specrun.log"; }
+  python -m dtf_tpu.telemetry.report "$dfdir/specrun" --check \
+      > "$dfdir/report.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: spec-run report --check (rc=$rc)"; tail -5 "$dfdir/report.log"; }
+  grep -q "serve/spec_proposed_total" "$dfdir/report.log" \
+    && grep -q "serve/prefill_batch_size" "$dfdir/report.log" \
+    && grep -q "spec_acceptance" "$dfdir/report.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: report missing spec/prefill instruments"; }
+  rm -rf "$dfdir"
+fi
 # Live-introspection lane (DESIGN.md §6.4, ISSUE 11): a chaos'd
 # wall-clock serve session with --admin_port, scraped WHILE it runs
 # (/statz consistent snapshot, /healthz liveness, /tracez flight

@@ -1,0 +1,631 @@
+"""The fast decode data path (ISSUE 14): batched multi-request
+prefill, block-indexed narrowed paged decode, speculative decoding.
+
+The three ISSUE-level pins:
+
+* **coalescing determinism** — the same trace produces the same batch
+  log and bitwise-identical tokens whether prefill ran solo or
+  coalesced (and coalescing demonstrably cuts prefill dispatches);
+* **narrowed-geometry parity** — narrowed decode (live-context table
+  buckets + hot pool prefix) emits tokens identical to the full-window
+  whole-pool baseline AND to the contiguous ``GPT.generate`` oracle,
+  greedy and sampled, with the compiled-geometry count pinned;
+* **speculative token identity** — the spec engine's greedy stream is
+  bitwise the sequential engine's on the same trace (the verify step
+  emits the model's own choices; drafts only move the acceptance
+  rate), while acceptance > 0 proves speculation actually engaged.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.serve import (BlockAllocator, KVPool, ServingEngine,
+                           VirtualClock, blocks_for)
+from dtf_tpu.serve import decode as dec
+from dtf_tpu.serve.engine import _pow2_bucket
+from dtf_tpu.serve.spec import propose_drafts
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _mk_engine(model, params, **kw):
+    kw.setdefault("clock", VirtualClock())
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 8)
+    kw.setdefault("num_blocks", 1 + 3 * 8)
+    return ServingEngine(model, params, **kw)
+
+
+def _mk_trace(rng, n, *, qps=50.0, p_lens=(3, 5, 8, 12),
+              o_lens=(3, 6, 10), temperature=0.0, vocab=128):
+    trace, t = [], 0.0
+    for rid in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        p = int(rng.choice(p_lens))
+        trace.append((t, {
+            "rid": rid,
+            "prompt": rng.integers(0, vocab, (p,)).astype(np.int32),
+            "max_new_tokens": int(rng.choice(o_lens)),
+            "temperature": temperature,
+        }))
+    return trace
+
+
+def _completed_tokens(results):
+    return {r.rid: list(r.tokens) for r in results.values()
+            if r.status == "completed"}
+
+
+# ---------------------------------------------------------------------------
+# buckets / allocator / pool plumbing (no jax compilation)
+# ---------------------------------------------------------------------------
+
+
+class TestBuckets:
+    def test_pow2_bucket(self):
+        assert [_pow2_bucket(n, 64) for n in (1, 2, 3, 5, 8, 9)] == \
+            [1, 2, 4, 8, 8, 16]
+        assert _pow2_bucket(100, 48) == 48          # cap clamps
+        assert _pow2_bucket(0, 8) == 1              # floor at 1
+
+    def test_highest_used_tracks_incrementally(self):
+        a = BlockAllocator(64)
+        assert a.highest_used() == 0
+        got = a.allocate(3)                         # [1, 2, 3]
+        assert a.highest_used() == 3
+        more = a.allocate(2)                        # [4, 5]
+        assert a.highest_used() == 5
+        a.free(more)
+        assert a.highest_used() == 3
+        a.free(got)
+        assert a.highest_used() == 0
+        # fragmented reuse: high-water follows the max live id exactly
+        a.allocate(1)
+        b2 = a.allocate(4)
+        a.free(b2[:3])
+        assert a.highest_used() == b2[3]
+
+
+class TestKVPoolHot:
+    def _cfg(self):
+        from dtf_tpu.models.gpt import GPTConfig
+        return GPTConfig.tiny()
+
+    def test_ensure_hot_roundtrip_preserves_rows(self):
+        pool = KVPool.create(self._cfg(), 16, 4)
+        assert pool.hot_blocks == 16 and pool.num_blocks == 16
+        marked = pool.k.at[:, 9].set(7.0)
+        pool.k = marked
+        pool.ensure_hot(4)
+        assert pool.hot_blocks == 4
+        assert pool.num_blocks == 16                # nothing lost
+        pool.ensure_hot(16)
+        assert pool.hot_blocks == 16
+        # block 9's rows came back from cold storage intact
+        np.testing.assert_array_equal(np.asarray(pool.k[:, 9]),
+                                      np.asarray(marked[:, 9]))
+
+    def test_ensure_hot_bounds(self):
+        pool = KVPool.create(self._cfg(), 8, 4)
+        with pytest.raises(ValueError, match="hot prefix"):
+            pool.ensure_hot(0)
+        with pytest.raises(ValueError, match="hot prefix"):
+            pool.ensure_hot(9)
+
+    def test_external_pool_geometry_validated(self, tiny_model):
+        model, params = tiny_model
+        pool = KVPool.create(self._cfg(), 16, 4)
+        with pytest.raises(ValueError, match="pool geometry"):
+            ServingEngine(model, params, num_slots=2, block_size=4,
+                          blocks_per_slot=4, num_blocks=32, pool=pool)
+
+
+# ---------------------------------------------------------------------------
+# batched prefill coalescing (ISSUE pin)
+# ---------------------------------------------------------------------------
+
+
+class TestPrefillCoalescing:
+    def _burst(self, n=3, p_len=5, max_new=5, temperature=0.0):
+        return [(0.0, dict(rid=i,
+                           prompt=np.arange(i, i + p_len,
+                                            dtype=np.int32) % 128,
+                           max_new_tokens=max_new,
+                           temperature=temperature)) for i in range(n)]
+
+    @pytest.mark.parametrize("temperature", [0.0, 1.0])
+    def test_solo_vs_coalesced_bitwise(self, tiny_model, temperature):
+        """THE determinism pin: same trace => same batch log and
+        bitwise-identical tokens whether prefill ran solo or coalesced
+        — and the coalesced engine dispatched ONE prefill call for the
+        same-bucket burst the solo engine dispatched three for."""
+        model, params = tiny_model
+        trace = self._burst(temperature=temperature)
+
+        def run(coalesce):
+            eng = _mk_engine(model, params, seed=42,
+                             coalesce_prefill=coalesce)
+            res = eng.run([(t, dict(kw)) for t, kw in trace])
+            return eng, _completed_tokens(res)
+
+        e_co, t_co = run(True)
+        e_solo, t_solo = run(False)
+        assert t_co == t_solo and len(t_co) == 3
+        assert e_co.batch_log == e_solo.batch_log
+        assert e_co.prefill_calls == 1
+        assert e_solo.prefill_calls == 3
+
+    def test_mixed_buckets_group_by_padded_len(self, tiny_model):
+        """Admissions of different prompt buckets in one iteration run
+        as separate calls, in admission order (the scheduler's
+        decisions are untouched by dispatch grouping)."""
+        model, params = tiny_model
+        trace = [(0.0, dict(rid=0, prompt=np.arange(3, dtype=np.int32),
+                            max_new_tokens=3)),
+                 (0.0, dict(rid=1, prompt=np.arange(3, dtype=np.int32),
+                            max_new_tokens=3)),
+                 (0.0, dict(rid=2, prompt=np.arange(7, dtype=np.int32),
+                            max_new_tokens=3))]
+        eng = _mk_engine(model, params, prefill_token_budget=64)
+        eng.run(trace)
+        # rid 0+1 share the 4-row bucket (one call), rid 2 pads to 8
+        assert eng.prefill_calls == 2
+        prefills = [e[1] for e in eng.batch_log if e[0] == "prefill"]
+        assert prefills == [0, 1, 2]
+
+    def test_batch_size_histogram_observed(self, tiny_model):
+        import dtf_tpu.telemetry as tel
+        model, params = tiny_model
+        tel.reset()
+        eng = _mk_engine(model, params)
+        eng.run(self._burst())
+        h = tel.histogram("serve/prefill_batch_size")
+        assert h.count == 1 and h.total == 3
+        assert eng.summary()["prefill_calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# narrowed decode geometry (ISSUE pin)
+# ---------------------------------------------------------------------------
+
+
+class TestNarrowedDecode:
+    def test_narrow_matches_baseline_and_generate(self, tiny_model):
+        """Narrowed geometry (table buckets + hot prefix) vs the
+        full-window whole-pool baseline vs the contiguous
+        ``GPT.generate`` oracle: one token stream, three data paths."""
+        model, params = tiny_model
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+                   for n in (5, 8, 3, 12)]
+        new = [10, 6, 12, 7]
+        refs = []
+        for p, n in zip(prompts, new):
+            out = model.generate(params, jnp.asarray(p)[None], n,
+                                 temperature=0.0)
+            refs.append(np.asarray(out)[0, len(p):].tolist())
+        trace = [(0.01 * i, dict(rid=i, prompt=p, max_new_tokens=n))
+                 for i, (p, n) in enumerate(zip(prompts, new))]
+        for narrow in (True, False):
+            eng = _mk_engine(model, params, num_blocks=1 + 3 * 6,
+                             blocks_per_slot=6, narrow_decode=narrow)
+            res = eng.run(list(trace))
+            for i in range(4):
+                assert res[i].tokens == refs[i], \
+                    f"narrow={narrow} request {i} diverged"
+
+    @pytest.mark.parametrize("temperature", [0.0, 1.0])
+    def test_oversized_pool_token_identity(self, tiny_model, temperature):
+        """An 8x oversized pool must not change a single token, and the
+        narrowed engine must never heat more than the live prefix."""
+        model, params = tiny_model
+        trace = _mk_trace(np.random.default_rng(9), 6,
+                          temperature=temperature)
+
+        def run(num_blocks, narrow=True):
+            eng = _mk_engine(model, params, seed=11,
+                             num_blocks=num_blocks, narrow_decode=narrow)
+            res = eng.run([(t, dict(kw)) for t, kw in trace])
+            return eng, _completed_tokens(res)
+
+        e_tight, t_tight = run(1 + 3 * 8)
+        e_over, t_over = run(200)
+        assert t_tight == t_over and len(t_over) == 6
+        assert e_over.pool.hot_blocks < 200
+        assert e_over.pool.num_blocks == 200
+
+    def test_geometry_bucket_count_pinned(self, tiny_model):
+        """Recompile discipline: geometries are power-of-two buckets,
+        so a whole trace compiles O(log) decode shapes — and a second
+        engine over the same model adds ZERO new compiled steps."""
+        model, params = tiny_model
+        trace = _mk_trace(np.random.default_rng(21), 8)
+
+        def run():
+            eng = _mk_engine(model, params, seed=5)
+            eng.run([(t, dict(kw)) for t, kw in trace])
+            return eng
+
+        run()
+        cache_after_first = set(model._serve_fn_cache)
+        eng = run()
+        assert set(model._serve_fn_cache) == cache_after_first
+        decode_geoms = {k for k in eng._compiled if k[0] == "decode"}
+        # window is 8 blocks -> at most 1,2,4,8 table buckets
+        assert 1 <= len(decode_geoms) <= 4
+        for key in decode_geoms:
+            nb = key[1]
+            assert nb == _pow2_bucket(nb, 8)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE pin)
+# ---------------------------------------------------------------------------
+
+
+class TestDrafter:
+    def test_longest_most_recent_match_wins(self):
+        #          0  1  2  3  4  5  6  7
+        ctx = [5, 6, 7, 9, 5, 6, 7, 9]  # suffix (6,7,9) seen at 1..3
+        assert propose_drafts(ctx + [5, 6, 7], 2) == [9, 5]
+        # most RECENT occurrence preferred: continuation after the
+        # second (5,6) run is (7,9), same here, but pin recency with an
+        # asymmetric context
+        ctx2 = [1, 2, 3, 9, 9, 1, 2, 4]
+        assert propose_drafts(ctx2 + [1, 2], 1) == [4]
+
+    def test_no_match_returns_empty(self):
+        assert propose_drafts([1, 2, 3, 4], 3) == []
+        assert propose_drafts([7], 3) == []
+        assert propose_drafts([1, 2, 1, 2], 0) == []
+
+    def test_k_clamps_to_available_continuation(self):
+        ctx = [3, 4, 5, 3, 4]
+        assert propose_drafts(ctx, 4) == [5, 3, 4]
+
+
+class TestSpeculative:
+    def test_greedy_token_identity_vs_sequential(self, tiny_model):
+        """THE spec pin: same trace, spec_k=4 vs spec_k=0 — bitwise
+        identical completed token streams, same completion statuses,
+        and drafts were actually proposed AND accepted (the win is
+        attributable, not vacuous)."""
+        model, params = tiny_model
+        trace = _mk_trace(np.random.default_rng(7), 8, qps=30.0,
+                          o_lens=(6, 10, 16))
+
+        def run(k):
+            eng = _mk_engine(model, params, seed=1, spec_k=k)
+            res = eng.run([(t, dict(kw)) for t, kw in trace])
+            stat = {r.rid: r.status for r in res.values()}
+            return eng, _completed_tokens(res), stat
+
+        e_spec, t_spec, s_spec = run(4)
+        e_base, t_base, s_base = run(0)
+        assert t_spec == t_base and s_spec == s_base
+        assert e_spec.spec_proposed > 0
+        assert e_spec.spec_accepted > 0
+        assert e_spec.spec_accepted <= e_spec.spec_proposed
+        # fewer decode dispatches for the same tokens is the point
+        assert e_spec.iterations <= e_base.iterations
+
+    def test_sampled_token_identity_vs_sequential(self, tiny_model):
+        """Sampled streams hold too: the verify step draws position s
+        with the request's (seed, rid, count+s) key — exactly the
+        sequential stream's draw."""
+        model, params = tiny_model
+        trace = _mk_trace(np.random.default_rng(13), 6, temperature=1.0)
+
+        def run(k):
+            eng = _mk_engine(model, params, seed=2, spec_k=k)
+            return _completed_tokens(eng.run(
+                [(t, dict(kw)) for t, kw in trace]))
+
+        assert run(4) == run(0)
+
+    def test_eos_mid_window_stops_exactly(self, tiny_model):
+        """EOS accepted mid-verify-window must finish the request at
+        the EOS token, exactly like the sequential engine."""
+        model, params = tiny_model
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, 128, (6,)).astype(np.int32)
+        ref = np.asarray(model.generate(
+            params, jnp.asarray(prompt)[None], 10,
+            temperature=0.0))[0, 6:].tolist()
+        eos = ref[2]
+        eng = _mk_engine(model, params, spec_k=4)
+        res = eng.run([(0.0, dict(rid=0, prompt=prompt,
+                                  max_new_tokens=10, eos_id=eos))])
+        assert res[0].tokens == ref[:3]
+        assert eng.scheduler.allocator.used_blocks == 0
+
+    def test_summary_and_instruments(self, tiny_model):
+        import dtf_tpu.telemetry as tel
+        model, params = tiny_model
+        tel.reset()
+        eng = _mk_engine(model, params, spec_k=3)
+        eng.run(_mk_trace(np.random.default_rng(31), 5, o_lens=(8, 12)))
+        s = eng.summary()
+        assert s["spec_k"] == 3
+        assert s["spec_proposed"] == eng.spec_proposed > 0
+        assert s["spec_accepted"] == eng.spec_accepted
+        assert s["spec_acceptance"] == pytest.approx(
+            eng.spec_accepted / eng.spec_proposed)
+        assert tel.counter("serve/spec_proposed_total").value == \
+            eng.spec_proposed
+        assert tel.counter("serve/spec_accepted_total").value == \
+            eng.spec_accepted
+
+    def test_verify_fn_single_token_matches_decode_fn(self, tiny_model):
+        """Fn-level: a verify window with n_in=1 is the plain decode
+        step — same next token, same health flag."""
+        model, params = tiny_model
+        from dtf_tpu.serve.paged_kv import KVPool
+        pool = KVPool.create(model.cfg, 9, 4)
+        rng = np.random.default_rng(0)
+        pk = jnp.asarray(rng.normal(size=pool.k.shape).astype(np.float32))
+        pv = jnp.asarray(rng.normal(size=pool.v.shape).astype(np.float32))
+        table = jnp.asarray(np.array([[3, 1, -1, -1], [2, 5, 7, -1]],
+                                     np.int32))
+        tok = np.array([5, 9], np.int32)
+        pos = jnp.asarray(np.array([6, 9], np.int32))
+        temps = jnp.asarray(np.zeros(2, np.float32))
+        seeds = jnp.asarray(np.array([1, 2], np.uint32))
+        counts = jnp.asarray(np.array([3, 4], np.int32))
+        fd = dec.build_decode_fn(model, num_slots=2, blocks_per_slot=4,
+                                 block_size=4)
+        fv = dec.build_verify_fn(model, num_slots=2, blocks_per_slot=4,
+                                 block_size=4, width=3)
+        nxt, ok, _, _ = fd(params, pk, pv, table, jnp.asarray(tok), pos,
+                           temps, seeds, counts)
+        toks_w = np.zeros((2, 3), np.int32)
+        toks_w[:, 0] = tok
+        out, okv, _, _ = fv(params, pk, pv, table, jnp.asarray(toks_w),
+                            pos, jnp.asarray(np.ones(2, np.int32)),
+                            temps, seeds, counts)
+        np.testing.assert_array_equal(np.asarray(nxt),
+                                      np.asarray(out)[:, 0])
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(okv))
+
+    def test_scheduler_learns_tokens_per_slot(self):
+        from dtf_tpu.serve.scheduler import Scheduler
+        s = Scheduler(num_slots=2, allocator=BlockAllocator(16),
+                      block_size=4, blocks_per_slot=4)
+        s.observe_decode(0.010)
+        assert s.decode_iter_s == pytest.approx(0.010)
+        # a verify that emitted 2 tokens/slot halves the per-token rate
+        s2 = Scheduler(num_slots=2, allocator=BlockAllocator(16),
+                      block_size=4, blocks_per_slot=4)
+        s2.observe_decode(0.010, tokens_per_slot=2.0)
+        assert s2.decode_iter_s == pytest.approx(0.005)
+
+    def test_verify_charge_kind(self):
+        clock = VirtualClock()
+        clock.charge("verify", batch=3, tokens=8)
+        expect = (8.0 + 0.5 * 3 + clock.verify_per_token_ms * 8) / 1e3
+        assert clock.now() == pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# paged-attention Pallas kernel (interpret-mode parity)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedAttentionKernel:
+    @pytest.mark.parametrize("llama", [False, True])
+    def test_kernel_decode_matches_xla_gather(self, llama):
+        """The TPU-build data path: build_decode_fn(kernel=True) runs
+        the block-indexed Pallas kernel (interpret mode on CPU) and
+        must emit the same greedy tokens as the XLA gather oracle on a
+        fragmented table — including GQA + RoPE wiring."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        cfg = (GPTConfig.tiny(num_kv_heads=2, rope=True) if llama
+               else GPTConfig.tiny())
+        model = GPT(cfg)
+        params = model.init(jax.random.key(1))
+        from dtf_tpu.serve.paged_kv import KVPool
+        pool = KVPool.create(cfg, 9, 4)
+        rng = np.random.default_rng(2)
+        pk = jnp.asarray(rng.normal(size=pool.k.shape).astype(np.float32))
+        pv = jnp.asarray(rng.normal(size=pool.v.shape).astype(np.float32))
+        table = jnp.asarray(np.array([[3, 1, -1, -1], [2, 5, 7, -1]],
+                                     np.int32))
+        args = (params, pk, pv, table,
+                jnp.asarray(np.array([5, 9], np.int32)),
+                jnp.asarray(np.array([6, 9], np.int32)),
+                jnp.asarray(np.zeros(2, np.float32)),
+                jnp.asarray(np.array([1, 2], np.uint32)),
+                jnp.asarray(np.array([3, 4], np.int32)))
+        fx = dec.build_decode_fn(model, num_slots=2, blocks_per_slot=4,
+                                 block_size=4)
+        fk = dec.build_decode_fn(model, num_slots=2, blocks_per_slot=4,
+                                 block_size=4, kernel=True)
+        nx, okx, kx, vx = fx(*args)
+        nk, okk, kk, vk = fk(*args)
+        np.testing.assert_array_equal(np.asarray(nx), np.asarray(nk))
+        np.testing.assert_array_equal(np.asarray(okx), np.asarray(okk))
+        np.testing.assert_allclose(np.asarray(kx), np.asarray(kk),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_kernel_standalone_matches_reference(self):
+        """paged_attention against a dense numpy softmax reference on a
+        known table/pos layout."""
+        from dtf_tpu.ops.decode_kernel import paged_attention
+        rng = np.random.default_rng(0)
+        b, nh, kvh, hd, bs, nb, npool = 2, 4, 4, 8, 4, 3, 8
+        hn, kn = nh * hd, kvh * hd
+        q = rng.normal(size=(b, hn)).astype(np.float32)
+        ks = rng.normal(size=(b, kn)).astype(np.float32)
+        vs = rng.normal(size=(b, kn)).astype(np.float32)
+        pool_k = rng.normal(size=(npool, bs, kn)).astype(np.float32)
+        pool_v = rng.normal(size=(npool, bs, kn)).astype(np.float32)
+        table = np.array([[2, 4, 0], [1, 3, 5]], np.int32)
+        pos = np.array([5, 9], np.int32)
+        out = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(ks), jnp.asarray(vs),
+            jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(pos),
+            num_heads=nh, kv_heads=kvh))
+        for bi in range(b):
+            kc = pool_k[table[bi]].reshape(-1, kvh, hd)
+            vc = pool_v[table[bi]].reshape(-1, kvh, hd)
+            kc = np.concatenate([kc[:pos[bi]],
+                                 ks[bi].reshape(1, kvh, hd)])
+            vc = np.concatenate([vc[:pos[bi]],
+                                 vs[bi].reshape(1, kvh, hd)])
+            qh = q[bi].reshape(nh, hd)
+            for h in range(nh):
+                s = kc[:, h] @ qh[h] * hd ** -0.5
+                w = np.exp(s - s.max())
+                w /= w.sum()
+                ref = w @ vc[:, h]
+                np.testing.assert_allclose(
+                    out[bi].reshape(nh, hd)[h], ref, rtol=1e-5,
+                    atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bench satellites: ladder engine mode, spec A/B, ledger decode rows,
+# check_gates max_tpot_p99_ms
+# ---------------------------------------------------------------------------
+
+
+class TestLadderEngineModes:
+    def test_paged_mode_reports_fit(self):
+        from dtf_tpu.bench.decode_ladder import run_engine
+        r = run_engine("tiny", "paged", streams=2, ladder=(3, 6),
+                       reps=1, prompt_len=4, block_size=4)
+        assert r["rig"] == "decode_tiny_paged_s2_bs4"
+        assert r["narrow"] is True and r["spec_k"] == 0
+        assert len(r["ladder"]) == 2
+        assert "per_token_us" in r
+
+    def test_spec_mode_reports_acceptance(self):
+        from dtf_tpu.bench.decode_ladder import run_engine
+        r = run_engine("tiny", "spec", streams=2, ladder=(4, 8),
+                       reps=1, prompt_len=4, block_size=4, spec_k=3)
+        assert r["rig"] == "decode_tiny_spec_s2_bs4_k3"
+        assert r["spec_k"] == 3
+        assert r["spec_proposed"] >= 0
+        assert "spec_acceptance" in r
+
+    def test_oversized_pool_must_cover_tight(self):
+        from dtf_tpu.bench.decode_ladder import run_engine
+        with pytest.raises(ValueError, match="pool_blocks"):
+            run_engine("tiny", "paged", streams=2, ladder=(3, 6),
+                       reps=1, prompt_len=4, block_size=4, pool_blocks=3)
+
+
+class TestSpecLoadAB:
+    def test_spec_ab_gates_green_on_pinned_trace(self, tiny_model):
+        """The CI gate in-process: the pinned decode-fast-lane trace
+        must pass token identity + strict p99 TPOT improvement +
+        acceptance, and fail an absurd absolute ceiling
+        (falsifiability)."""
+        import argparse
+        from dtf_tpu.bench.serve_load import spec_ab
+        model, params = tiny_model
+
+        def ns_for(ceiling):
+            return argparse.Namespace(
+                qps_list=[10.0], requests=32, seed=5,
+                prompt_lens_list=[4, 8, 16],
+                output_lens_list=[16, 32, 48], temperature=0.0,
+                top_k=0, top_p=1.0, slots=4, block_size=16,
+                pool_blocks=None, max_queue=256, slo_ttft_ms=400.0,
+                clock="virtual", spec_k=4, trace_vocab=None,
+                max_tpot_p99_ms=ceiling, logdir=None)
+
+        r = spec_ab(model, params, ns_for(0.0))
+        assert r["ok"], r["gates"]
+        assert r["token_identity"]
+        assert r["spec"]["tpot_ms_p99"] < r["no_spec"]["tpot_ms_p99"]
+        r_absurd = spec_ab(model, params, ns_for(0.001))
+        assert not r_absurd["ok"]
+        assert any("max_tpot_p99_ms" in ln and "FAIL" in ln
+                   for ln in r_absurd["gates"])
+
+
+class TestLedgerDecodeRows:
+    def _ledger_mod(self):
+        import importlib
+        import os
+        import sys
+        scripts = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        return importlib.import_module("bench_ledger")
+
+    def _decode_rows(self, *vals, rig="decode_tiny_paged"):
+        rows = []
+        for i, v in enumerate(vals, start=1):
+            rows.append({"run": f"DECODE_r{i:02d}", "kind": "decode",
+                         "n": i, "commit": None, "rig": rig,
+                         "tok_s_aggregate": v, "per_token_us": None,
+                         "spec_acceptance": None, "ok": v is not None,
+                         "error": None if v is not None else "no_tok_s",
+                         "stage": None if v is not None else "ladder_fit"})
+        return rows
+
+    def test_decode_round_file_folds(self, tmp_path):
+        bl = self._ledger_mod()
+        doc = {"rig": "decode_tiny_paged", "preset": "tiny",
+               "mode": "paged", "tok_s_aggregate": 3500.0,
+               "per_token_us": 857.0}
+        p = tmp_path / "DECODE_r01.json"
+        p.write_text(json.dumps(doc))
+        row = bl.decode_row(str(p), str(tmp_path))
+        assert row["kind"] == "decode" and row["n"] == 1
+        assert row["ok"] and row["tok_s_aggregate"] == 3500.0
+        # a no-signal ladder folds as an errored round, not a gap
+        doc["warning"] = "non-positive slope"
+        p2 = tmp_path / "DECODE_r02.json"
+        p2.write_text(json.dumps(doc))
+        row2 = bl.decode_row(str(p2), str(tmp_path))
+        assert not row2["ok"] and row2["error"]
+
+    def test_decode_gate_green_and_regression(self):
+        bl = self._ledger_mod()
+        ok, lines = bl.check_ledger(self._decode_rows(3500.0, 3400.0))
+        assert ok, lines
+        ok, lines = bl.check_ledger(self._decode_rows(3500.0, 2000.0))
+        assert not ok
+        assert any("REGRESSION" in ln and "decode_tiny_paged" in ln
+                   for ln in lines)
+
+    def test_committed_decode_round_is_green(self):
+        import os
+        bl = self._ledger_mod()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rows = bl.read_ledger(os.path.join(repo, "LEDGER.jsonl"))
+        dec_rows = [r for r in rows if r["kind"] == "decode"]
+        assert dec_rows, "no committed decode rows in LEDGER.jsonl"
+        assert all(r["ok"] for r in dec_rows)
+
+
+class TestCheckGatesTpot:
+    def test_tpot_ceiling_green_fail_absent(self):
+        from dtf_tpu.telemetry.report import check_gates
+        rep = {"telemetry": {"serving": {"tpot_ms_p99": 9.5}}}
+        ok, lines = check_gates(rep, max_tpot_p99_ms=10.0)
+        assert ok, lines
+        ok, _ = check_gates(rep, max_tpot_p99_ms=9.0)
+        assert not ok
+        # absence of evidence fails the gate, it does not pass it
+        ok, lines = check_gates({"telemetry": {"serving": {}}},
+                                max_tpot_p99_ms=10.0)
+        assert not ok
+        assert any("not measured" in ln for ln in lines)
