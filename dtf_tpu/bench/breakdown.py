@@ -41,8 +41,15 @@ from dtf_tpu.utils.timing import time_linfit
 LADDER = (2, 8, 24)
 
 
-def _chain(fn, n, x0):
-    """n dependent applications of fn inside one jit (no CSE/hoist)."""
+def _chain(fn, n, x0, tag="?"):
+    """n dependent applications of fn inside one jit (no CSE/hoist).
+    The jit is wrapped by the cost observatory so each ladder point's
+    compile lands as a bench/breakdown CostCard (geometry = the row's
+    op tag + chain length + operand shape — the tag is what keeps two
+    different ops over the same operand from folding into one card);
+    capture happens at the compile the first call pays anyway, so the
+    timed region is unchanged."""
+    from dtf_tpu.telemetry import costobs
 
     @jax.jit
     def run(x):
@@ -50,11 +57,15 @@ def _chain(fn, n, x0):
             return fn(c), None
         out, _ = lax.scan(body, x, None, length=n)
         return out
-    return lambda: run(x0)
+
+    inst = costobs.instrument(
+        run, "bench/breakdown",
+        (tag, n, tuple(jnp.shape(x0)), str(getattr(x0, "dtype", "?"))))
+    return lambda: inst(x0)
 
 
-def _time(fn, x0, reps=4):
-    fit = time_linfit(lambda n: _chain(fn, n, x0), LADDER, reps=reps)
+def _time(fn, x0, reps=4, tag="?"):
+    fit = time_linfit(lambda n: _chain(fn, n, x0, tag), LADDER, reps=reps)
     return fit.per_iter_s
 
 
@@ -107,13 +118,14 @@ def _attn_rows(rows, b, t, h, hd, bq, bk, causal, tag):
     fa = functools.partial(flash_attention, causal=causal,
                            block_q=rbq, block_k=rbk)
     full_tag = f"{tag} bq{rbq} bk{rbk}"
-    s = _time(lambda x: fa(x, q, q).astype(jnp.bfloat16), q)
+    s = _time(lambda x: fa(x, q, q).astype(jnp.bfloat16), q,
+              tag=f"fwd {full_tag}")
     rows.append(Row(f"fwd {full_tag}", s, flops=flops))
 
     def fa_grad(x):
         g = jax.grad(lambda y: jnp.sum(fa(y, q, q) * 1e-6))(x)
         return g.astype(jnp.bfloat16)
-    s = _time(fa_grad, q)
+    s = _time(fa_grad, q, tag=f"fwd+bwd {full_tag}")
     rows.append(Row(f"fwd+bwd {full_tag}", s, flops=3.5 * flops))
     return flops
 
@@ -140,7 +152,7 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
         def mm(x, w=w, k_=k_):
             y = jnp.dot(x, w, preferred_element_type=jnp.float32)
             return y[:, :k_].astype(jnp.bfloat16)
-        s = _time(mm, mk(2, (m, k_)))
+        s = _time(mm, mk(2, (m, k_)), tag=name)
         rows.append(Row(name, s, flops=2.0 * m * k_ * n))
     # fc2 shrinks (BT,F)->(BT,D), so it cannot chain alone; time the
     # full matmul-only MLP pair (fc1 -> gelu -> fc2), the shape that a
@@ -150,16 +162,17 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
         u = jax.nn.gelu(jnp.dot(x, w1, preferred_element_type=jnp.float32))
         return jnp.dot(u.astype(jnp.bfloat16), w2,
                        preferred_element_type=jnp.float32).astype(jnp.bfloat16)
-    s = _time(mlp, mk(14, (bt, d)))
+    s = _time(mlp, mk(14, (bt, d)), tag="mlp pair fc1+gelu+fc2")
     rows.append(Row("mlp pair fc1+gelu+fc2", s, flops=4.0 * bt * d * f))
 
     # --- elementwise / normalization ---------------------------------
     from dtf_tpu.nn.layers import LayerNorm
     ln = LayerNorm(d)
     lnp = ln.init(jax.random.key(3))
-    s = _time(lambda x: ln.apply(lnp, x), mk(4, (b, t, d)))
+    s = _time(lambda x: ln.apply(lnp, x), mk(4, (b, t, d)),
+              tag="layernorm")
     rows.append(Row("layernorm (B,T,D)", s, bytes_moved=2.0 * bt * d * 2))
-    s = _time(lambda x: jax.nn.gelu(x), mk(5, (b, t, f)))
+    s = _time(lambda x: jax.nn.gelu(x), mk(5, (b, t, f)), tag="gelu")
     rows.append(Row("gelu (B,T,F)", s, bytes_moved=2.0 * bt * f * 2))
 
     # --- attention (shared accounting: _attn_rows) --------------------
@@ -177,14 +190,15 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
     # 6·p_layer·(per-token) convention: params ≈ 12 D² per layer
     p_layer = sum(x.size for x in jax.tree_util.tree_leaves(bp))
     blk_fwd_flops = 2.0 * p_layer * bt + attn_flops
-    s = _time(lambda x: block.apply(bp, x), mk(8, (b, t, d)))
+    s = _time(lambda x: block.apply(bp, x), mk(8, (b, t, d)),
+              tag="block fwd")
     rows.append(Row("block fwd", s, flops=blk_fwd_flops))
 
     def blk_grad(x):
         g = jax.grad(lambda y: jnp.sum(block.apply(bp, y)
                                        .astype(jnp.float32)) * 1e-6)(x)
         return g.astype(jnp.bfloat16)
-    s = _time(blk_grad, mk(9, (b, t, d)))
+    s = _time(blk_grad, mk(9, (b, t, d)), tag="block fwd+bwd x-grad")
     # grad wrt x alone never computes the dW matmuls: dx costs ~1x the
     # forward matmul FLOPs, so the executed total is ~2x fwd, not 3x.
     rows.append(Row("block fwd+bwd (x-grad only)", s,
@@ -204,7 +218,7 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
                                   .astype(jnp.float32)) * 1e-6,
             argnums=(0, 1))(bp, x)
         return _fold_w_grads(gp, gx)
-    s = _time(blk_grad_w, mk(10, (b, t, d)))
+    s = _time(blk_grad_w, mk(10, (b, t, d)), tag="block fwd+bwd x+w")
     rows.append(Row("block fwd+bwd (x+w grads)", s,
                     flops=3.0 * blk_fwd_flops))
 
@@ -213,7 +227,7 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
         gx = jax.grad(lambda y: jnp.sum(fn(y).astype(jnp.float32))
                       * 1e-6)(x)
         return gx.astype(jnp.bfloat16)
-    s = _time(blk_grad_remat, mk(11, (b, t, d)))
+    s = _time(blk_grad_remat, mk(11, (b, t, d)), tag="block remat")
     # x-grad only (see above) + one full recompute: ~3x fwd executed.
     rows.append(Row("block fwd+bwd x-grad, full remat", s,
                     flops=3.0 * blk_fwd_flops))
@@ -235,7 +249,8 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
                       dtype=jnp.bfloat16, vocab_size=1024,
                       fused_block=True)
     block_f = GPTBlock(cfg_f)
-    s = _time(lambda x: block_f.apply(bp, x), mk(8, (b, t, d)))
+    s = _time(lambda x: block_f.apply(bp, x), mk(8, (b, t, d)),
+              tag="block fwd fused")
     rows.append(Row("block fwd (fused kernels)", s, flops=blk_fwd_flops))
 
     def blk_f_grad_w(x):
@@ -244,7 +259,8 @@ def breakdown(family: str = "bert", batch: Optional[int] = None,
                                   .astype(jnp.float32)) * 1e-6,
             argnums=(0, 1))(bp, x)
         return _fold_w_grads(gp, gx)
-    s = _time(blk_f_grad_w, mk(10, (b, t, d)))
+    s = _time(blk_f_grad_w, mk(10, (b, t, d)),
+              tag="block fwd+bwd x+w fused")
     rows.append(Row("block fwd+bwd x+w grads (fused kernels)", s,
                     flops=3.0 * blk_fwd_flops))
 

@@ -45,6 +45,23 @@ import argparse
 import json
 
 
+def _hbm_sampler(obs):
+    """Per-invocation live-HBM watermark: ``sample()`` at ladder-point
+    boundaries (OUTSIDE the timed closures) and once after the fit; the
+    max of the samples is this run's ``peak_hbm_bytes`` ledger column —
+    per-run by construction, so a previous arm in the same process
+    cannot leak into this row.  ONE definition for both ladder entry
+    points so the column's meaning cannot drift between them."""
+    seen = [0.0]
+
+    def sample():
+        live = obs.update_live_memory()
+        if live:
+            seen[0] = max(seen[0], live)
+
+    return seen, sample
+
+
 def _finish_fit(out: dict, fit, streams: int) -> dict:
     """Shared fit -> report fields: the no-signal check and the
     tokens/s conversions (one definition for the generate-path and
@@ -87,6 +104,7 @@ def run_engine(preset: str = "tiny", mode: str = "paged",
 
     from dtf_tpu.models.gpt import GPT, GPTConfig
     from dtf_tpu.serve import ServingEngine, WallClock, blocks_for
+    from dtf_tpu.telemetry import costobs
     from dtf_tpu.utils.timing import time_linfit
 
     ladder = tuple(sorted(set(ladder)))
@@ -116,7 +134,12 @@ def run_engine(preset: str = "tiny", mode: str = "paged",
     from dtf_tpu.serve import KVPool
     shared_pool = KVPool.create(cfg, num_blocks, block_size)
 
+    obs = costobs.get_observatory()
+    hbm_seen, sample_hbm = _hbm_sampler(obs)
+
     def closure_of(n_new):
+        sample_hbm()
+
         def call():
             counter[0] += 1
             eng = ServingEngine(
@@ -135,7 +158,13 @@ def run_engine(preset: str = "tiny", mode: str = "paged",
             return eng
         return call
 
+    compiles0 = obs.total_compiles()
     fit = time_linfit(closure_of, ladder, reps=reps)
+    # Ledger columns: the run's compile count (the engine's serve/*
+    # builds are observatory-instrumented, delta'd against this
+    # invocation's start) and the sampled live-HBM watermark above.
+    sample_hbm()
+    n_compiles = obs.total_compiles() - compiles0
     # The rig id carries the FULL arm geometry: ledger rounds gate
     # newest-green vs best-prior PER RIG, and a baseline (--no_narrow)
     # or oversized-pool arm is deliberately slower — aliased onto the
@@ -155,6 +184,8 @@ def run_engine(preset: str = "tiny", mode: str = "paged",
         "prompt_len": prompt_len,
         "rig": rig,
         "device": str(jax.devices()[0]),
+        "n_compiles": n_compiles,
+        "peak_hbm_bytes": hbm_seen[0] or None,
     }
     eng = last_engine[0]
     if mode == "spec" and eng is not None:
@@ -173,6 +204,7 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
     import jax.numpy as jnp
 
     from dtf_tpu.models.gpt import GPT, GPTConfig
+    from dtf_tpu.telemetry import costobs
     from dtf_tpu.utils.timing import time_linfit
 
     fused = mode == "fused"
@@ -193,20 +225,26 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         jax.random.key(seed + 1), (streams, prompt_len), 0, cfg.vocab_size)
 
     def gen_fn(k):
+        geometry = (preset, mode, streams, int8, kv_int8, beam, k)
         if beam > 0:
-            return jax.jit(lambda p, pr: model.beam_search(
+            jfn = jax.jit(lambda p, pr: model.beam_search(
                 p, pr, k, beam_size=beam, int8_weights=int8,
                 fused=fused, kv_int8=kv_int8, cache_chunk=cache_chunk)[0])
-        return jax.jit(lambda p, pr: model.generate(
-            p, pr, k, temperature=0.0, int8_weights=int8, fused=fused,
-            kv_int8=kv_int8, cache_chunk=cache_chunk))
+        else:
+            jfn = jax.jit(lambda p, pr: model.generate(
+                p, pr, k, temperature=0.0, int8_weights=int8, fused=fused,
+                kv_int8=kv_int8, cache_chunk=cache_chunk))
+        return costobs.instrument(jfn, "bench/decode_ladder", geometry)
 
     # Perturb the prompt each call: the relay memoizes bitwise-identical
     # executions.  A deterministic token shift keeps runs reproducible
     # while making every execution distinct.
     counter = [0]
+    obs = costobs.get_observatory()
+    hbm_seen, sample_hbm = _hbm_sampler(obs)
 
     def closure_of(k):
+        sample_hbm()
         g = gen_fn(k)
 
         def call():
@@ -215,7 +253,9 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
             return g(params, pr)
         return call
 
+    compiles0 = obs.total_compiles()
     fit = time_linfit(closure_of, ladder, reps=reps)
+    sample_hbm()
     rig = (f"decode_{preset}_{mode}_s{streams}"
            + ("_int8" if int8 else "") + ("_kvint8" if kv_int8 else "")
            + (f"_beam{beam}" if beam else ""))
@@ -224,6 +264,8 @@ def run(preset: str = "gpt2_small", mode: str = "fused", streams: int = 1,
         "int8": int8, "kv_int8": kv_int8, "beam": beam,
         "rig": rig,
         "device": str(jax.devices()[0]),
+        "n_compiles": obs.total_compiles() - compiles0,
+        "peak_hbm_bytes": hbm_seen[0] or None,
     }
     # time_linfit clamps the slope to >= 1e-12, so "no signal" must be
     # detected directly (_finish_fit): the longest chain must actually

@@ -156,7 +156,7 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
                        else None))
         if fresh:
             print(f"admin endpoint on http://127.0.0.1:{admin.port} "
-                  f"(/statz /healthz /tracez /slo)", flush=True)
+                  f"(/statz /healthz /tracez /slo /memz)", flush=True)
     return engine
 
 
@@ -406,7 +406,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "past it are checkpointed, not finished)")
     p.add_argument("--admin_port", type=int, default=None,
                    help="mount the live introspection endpoint on "
-                        "127.0.0.1:PORT (/statz /healthz /tracez /slo; "
+                        "127.0.0.1:PORT (/statz /healthz /tracez /slo /memz; "
                         "0 = ephemeral port, printed at startup)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="run the TCP front end instead of a trace "

@@ -53,6 +53,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dtf_tpu.telemetry import costobs
+
 NEG_BIG = -1e30
 
 
@@ -66,12 +68,20 @@ def _cached(model, tag, statics, build):
     """Per-(model, static geometry) compiled-step cache, stored ON the
     model object so its lifetime is exactly the model's — no global
     registry pinning dead models (and their executables) for the
-    process lifetime, no id-recycling hazards."""
+    process lifetime, no id-recycling hazards.
+
+    Every entry is wrapped in the cost observatory's AOT-capturing
+    shim (telemetry/costobs.py): the first call per input signature
+    pays ``lower().compile()`` — exactly the compile jit would have
+    paid — and its ``cost_analysis()``/``memory_analysis()`` lands as a
+    CostCard keyed by the SAME (tag, statics) geometry this cache keys
+    executables by.  One card per compiled geometry, captured at
+    compile time, zero hot-path cost."""
     cache: Dict[tuple, object] = model.__dict__.setdefault(
         "_serve_fn_cache", {})
     key = (tag, statics)
     if key not in cache:
-        cache[key] = build()
+        cache[key] = costobs.instrument(build(), f"serve/{tag}", statics)
     return cache[key]
 
 

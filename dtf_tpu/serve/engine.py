@@ -224,6 +224,7 @@ class ServingEngine:
         self.iterations = 0
         self.batch_log: List[Tuple] = []    # scheduling trace (tests pin)
         self._blocks_peak = 0
+        self._pool_frac_peak = 0.0
         self.shed_reasons: Dict[str, int] = {}
         self._drain_requested = False       # set (signal-safely) by SIGTERM
         self.drained = False
@@ -855,10 +856,26 @@ class ServingEngine:
         self.iterations += 1
         if self.heartbeat is not None:
             self.heartbeat(self.iterations)
-        used = self.scheduler.allocator.used_blocks
+        # KV-pool observability (serve/paged_kv.py pool_observation):
+        # pool pressure is visible BEFORE admission starts rejecting —
+        # in-use/frac/hot-prefix plus the HBM bytes the live blocks pin.
+        # Pure host arithmetic (no device sync); the group updates under
+        # the registry lock so a /statz or /memz scrape never reads the
+        # in-use count without its matching fraction.
+        from dtf_tpu.serve.paged_kv import pool_observation
+        obs = pool_observation(self.scheduler.allocator, self.pool)
+        used = obs["blocks_in_use"]
         self._blocks_peak = max(self._blocks_peak, used)
-        tel.gauge("serve/kv_blocks_used").set(used)
-        tel.gauge("serve/kv_blocks_peak").set(self._blocks_peak)
+        self._pool_frac_peak = max(self._pool_frac_peak, obs["pool_frac"])
+        with tel.get_registry().locked():
+            tel.gauge("serve/kv_blocks_peak").set(self._blocks_peak)
+            # (renamed from serve/kv_blocks_used — ISSUE 15's KV
+            # observability family is the canonical spelling)
+            tel.gauge("serve/kv_blocks_in_use").set(used)
+            tel.gauge("serve/kv_pool_frac").set(obs["pool_frac"])
+            tel.gauge("serve/kv_hot_prefix_blocks").set(
+                obs["hot_prefix_blocks"])
+            tel.gauge("hbm/kv_pool_bytes").set(obs["bytes_in_use"])
         tel.gauge("serve/queue_depth").set(len(self.scheduler.queue))
         tel.gauge("serve/active_requests").set(self.scheduler.num_active())
         tracker = tel.get_tracker()
@@ -989,6 +1006,9 @@ class ServingEngine:
                "slots": self.num_slots,
                "kv_blocks_total": self.pool.num_blocks - 1,
                "kv_blocks_peak": self._blocks_peak,
+               "kv_blocks_in_use": self.scheduler.allocator.used_blocks,
+               "kv_pool_frac_peak": round(self._pool_frac_peak, 6),
+               "kv_hot_prefix_blocks": self.pool.hot_blocks,
                "kv_block_size": self.block_size,
                "prefill_calls": self.prefill_calls,
                "decode_iterations": sum(

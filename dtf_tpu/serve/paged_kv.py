@@ -233,6 +233,22 @@ class KVPool:
         return 2 * l * bs * w * per
 
 
+def pool_observation(allocator: BlockAllocator, pool: "KVPool") -> dict:
+    """One consistent read of the pool's pressure for the KV
+    observability gauges (``serve/kv_blocks_in_use`` /
+    ``serve/kv_pool_frac`` / ``serve/kv_hot_prefix_blocks`` and the
+    ``hbm/kv_pool_bytes`` claim): blocks in use, the fraction of the
+    usable pool they pin, the hot-prefix width the jitted steps touch,
+    and the HBM bytes the live blocks claim — pure host arithmetic off
+    the allocator and the pool shapes, no device sync."""
+    used = allocator.used_blocks
+    usable = max(allocator.num_blocks - 1, 1)   # block 0 is the trash block
+    return {"blocks_in_use": used,
+            "pool_frac": used / usable,
+            "hot_prefix_blocks": pool.hot_blocks,
+            "bytes_in_use": used * pool.bytes_per_block()}
+
+
 def dense_table(block_tables: List[Optional[List[int]]],
                 blocks_per_slot: int) -> np.ndarray:
     """Host block tables (``None`` = empty slot) -> the dense

@@ -64,10 +64,22 @@ __all__ = [
 def write_telemetry_json(logdir: str, extra: Optional[dict] = None) -> str:
     """Serialize the registry snapshot + goodput books to
     ``<logdir>/telemetry.json`` (atomic replace).  Cheap enough for every
-    logging sync point, so even a SIGKILL'd host leaves a recent file."""
+    logging sync point, so even a SIGKILL'd host leaves a recent file.
+
+    This IS the cost observatory's sync point too (telemetry/costobs.py):
+    the live-HBM gauges update here — never on the hot path — and any
+    captured CostCards persist as ``<logdir>/costcards.jsonl`` plus a
+    ``cost`` summary section in the JSON (what ``report --explain`` and
+    the ``--max_hbm_frac`` / ``--max_compiles`` gates read)."""
     path = os.path.join(logdir, TELEMETRY_FILE)
+    from dtf_tpu.telemetry import costobs as _costobs
+    obs = _costobs.get_observatory()
+    obs.update_live_memory()
     doc = {"goodput": get_tracker().snapshot(),
            "written_unix": time.time()}
+    if obs.total_compiles() or obs.live_peak_bytes() is not None:
+        doc["cost"] = obs.summary()
+        obs.write_jsonl(logdir)
     if extra:
         doc.update(extra)
     get_registry().write_json(path, extra=doc)
@@ -86,3 +98,5 @@ def reset() -> None:
     _live.stop_admin()
     from dtf_tpu.telemetry import fleet as _fleet
     _fleet.reset()
+    from dtf_tpu.telemetry import costobs as _costobs
+    _costobs.get_observatory().reset()

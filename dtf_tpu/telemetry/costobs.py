@@ -1,0 +1,720 @@
+"""Device cost observatory: XLA cost/memory attribution per compile.
+
+The telemetry spine stops at the host boundary: spans, goodput books and
+the fleet rollup say *when* a step was slow, and the perf ledger says
+*that* a rig regressed — nothing says *why*.  This module closes the
+loop at the only place XLA will tell us: **compile time**.  Every
+``.lower().compile()`` site the repo has (the trainer's AOT warmup, the
+serving engine's cached prefill/decode/verify builds, the bench
+drivers) captures ``compiled.cost_analysis()`` +
+``compiled.memory_analysis()`` into a per-geometry :class:`CostCard`
+and books the ``cost/*`` + ``hbm/*`` instrument family — so a run's
+FLOP/byte/HBM accounting is on disk (``<logdir>/costcards.jsonl``),
+live (the ``/memz`` admin endpoint), and diffable
+(``telemetry.report --explain <a> <b>``).
+
+Honesty rules, pinned by tests/test_costobs.py:
+
+* a backend that reports nothing (or partial dicts) yields a
+  well-formed card with ``None`` fields — never a fake zero a gate
+  could pass on;
+* capture happens at compile time only, and the live-memory gauges
+  update at existing sync points (``write_telemetry_json``) — the hot
+  path pays nothing and no collective is added;
+* classification (compute- vs memory-bound) is against a per-chip
+  roofline table (``utils/profiling.chip_roofline``); the CPU sim gets
+  a pinned synthetic entry so tests are deterministic.
+
+Pure stdlib at import time (jax is imported lazily inside the capture
+helpers), same rule as the rest of the telemetry spine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from dtf_tpu.telemetry import registry as _registry
+
+#: On-disk card stream under a run's logdir (one JSON object per line,
+#: rewritten atomically at every sync point — cards are cumulative).
+COSTCARDS_FILE = "costcards.jsonl"
+
+
+def _deep_tuple(v):
+    """Lists/tuples -> nested tuples (hashable, JSON-round-trip-stable
+    geometry keys); everything else passes through."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_deep_tuple(x) for x in v)
+    return v
+
+
+# -- the card ----------------------------------------------------------------
+
+@dataclasses.dataclass
+class CostCard:
+    """One compiled executable's cost/memory accounting, keyed by
+    ``(site, geometry)`` — the same static-geometry key the compile
+    caches use, so "one card per executable the process warmed" holds
+    by construction.  A recompile of the same geometry (e.g. the paged
+    pool's hot prefix crossing a bucket) folds into the card:
+    ``n_compiles`` increments, the latest per-compile numbers replace
+    the headline fields, and the ``*_total`` accumulators sum every
+    capture whose backend reported a value (``None`` = never reported,
+    distinct from a measured zero)."""
+
+    site: str                  # "train/step", "serve/decode", "bench/matmul"
+    geometry: Tuple            # static shape key (slots, window, bucket, ...)
+    flops: Optional[float] = None           # latest compile
+    bytes_accessed: Optional[float] = None  # latest compile
+    flops_total: Optional[float] = None     # summed over captures
+    bytes_total: Optional[float] = None
+    peak_hbm_bytes: Optional[float] = None  # max over captures (see below)
+    argument_bytes: Optional[float] = None
+    output_bytes: Optional[float] = None
+    temp_bytes: Optional[float] = None
+    generated_code_bytes: Optional[float] = None
+    oi: Optional[float] = None              # operational intensity, flops/byte
+    bound: str = "unknown"                  # "compute" | "memory" | "unknown"
+    n_compiles: int = 0
+    seq: int = 0                            # capture order (stable sort key)
+
+    def key(self) -> Tuple[str, Tuple]:
+        return (self.site, _deep_tuple(self.geometry))
+
+    def to_doc(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["geometry"] = list(self.geometry)
+        return d
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "CostCard":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in doc.items() if k in known}
+        # recursive list->tuple: JSON turns NESTED geometry tuples (e.g.
+        # bench/breakdown's operand-shape element) into lists, and the
+        # key must round-trip hashable AND equal to the in-process key —
+        # explain pairs A/B cards by it
+        kw["geometry"] = _deep_tuple(kw.get("geometry") or ())
+        return cls(**kw)
+
+
+def _fnum(v) -> Optional[float]:
+    """A usable float or None: non-numeric, NaN and negative sentinels
+    (XLA reports -1 for "unknown") all degrade to None — absence, never
+    a fake value a gate could pass on."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if f != f or f < 0:
+        return None
+    return f
+
+
+def _cost_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` with every backend quirk absorbed:
+    None, a raise, a list-of-dicts (one per computation — first wins),
+    or a plain dict all normalize to a (possibly empty) dict."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else {}
+
+
+def _mem_fields(compiled) -> dict:
+    """``compiled.memory_analysis()`` -> the four device-side byte
+    fields (None where the backend reports nothing).  ``peak_hbm_bytes``
+    is arguments + outputs + temps − aliased: XLA exposes no single
+    "peak" number, and that sum is the executable's device-memory claim
+    while it runs (generated code is reported separately)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    out = {"argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None,
+           "peak_hbm_bytes": None}
+    if ma is None:
+        return out
+    out["argument_bytes"] = _fnum(getattr(ma, "argument_size_in_bytes", None))
+    out["output_bytes"] = _fnum(getattr(ma, "output_size_in_bytes", None))
+    out["temp_bytes"] = _fnum(getattr(ma, "temp_size_in_bytes", None))
+    out["generated_code_bytes"] = _fnum(
+        getattr(ma, "generated_code_size_in_bytes", None))
+    parts = [out["argument_bytes"], out["output_bytes"], out["temp_bytes"]]
+    if any(p is not None for p in parts):
+        alias = _fnum(getattr(ma, "alias_size_in_bytes", None)) or 0.0
+        out["peak_hbm_bytes"] = max(
+            sum(p for p in parts if p is not None) - alias, 0.0)
+    return out
+
+
+def classify(flops: Optional[float], bytes_accessed: Optional[float],
+             roofline) -> Tuple[Optional[float], str]:
+    """``(operational intensity, bound)`` against a
+    :class:`~dtf_tpu.utils.profiling.ChipRoofline`.  Any missing input
+    (no flops, no bytes, unknown chip) is "unknown" — a gate must see
+    absence, not a guessed verdict."""
+    if not flops or not bytes_accessed:
+        return None, "unknown"
+    oi = flops / bytes_accessed
+    if roofline is None:
+        return oi, "unknown"
+    return oi, ("compute" if oi >= roofline.ridge_flops_per_byte
+                else "memory")
+
+
+# -- the observatory ---------------------------------------------------------
+
+class CostObservatory:
+    """Process-wide card store + the ``hbm/*`` live-memory plane.
+
+    Thread-safe (one lock over the card dict; instrument updates group
+    under the registry lock, same ``/statz`` discipline) — the admin
+    ``/memz`` handler reads while the engine/trainer thread records.
+    Lock order is observatory -> registry everywhere, so the two can
+    never deadlock.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cards: Dict[Tuple[str, Tuple], CostCard] = {}
+        self._seq = 0
+        self._compiles = 0
+        self._live_peak: Optional[float] = None
+        self._roofline = None
+        self._roofline_tried = False
+
+    # -- roofline (lazy: jax must not load at telemetry import time) --------
+
+    def _resolve_roofline(self):
+        if not self._roofline_tried:
+            self._roofline_tried = True
+            try:
+                import jax
+
+                from dtf_tpu.utils.profiling import chip_roofline
+                self._roofline = chip_roofline(jax.devices()[0])
+            except Exception:
+                self._roofline = None
+        return self._roofline
+
+    # -- capture ------------------------------------------------------------
+
+    def observe(self, site: str, geometry, compiled) -> CostCard:
+        """Capture one compile.  Called at compile time only (the AOT
+        warmup, a jit-wrapper's per-signature lower+compile) — never on
+        the hot path."""
+        ca = _cost_dict(compiled)
+        mem = _mem_fields(compiled)
+        flops = _fnum(ca.get("flops"))
+        bytes_accessed = _fnum(ca.get("bytes accessed"))
+        oi, bound = classify(flops, bytes_accessed,
+                             self._resolve_roofline())
+        with self._lock:
+            geometry = _deep_tuple(geometry)
+            key = (site, geometry)
+            card = self._cards.get(key)
+            if card is None:
+                card = CostCard(site=site, geometry=geometry,
+                                seq=self._seq)
+                self._seq += 1
+                self._cards[key] = card
+            card.n_compiles += 1
+            self._compiles += 1
+            card.flops = flops
+            card.bytes_accessed = bytes_accessed
+            if flops is not None:
+                card.flops_total = (card.flops_total or 0.0) + flops
+            if bytes_accessed is not None:
+                card.bytes_total = (card.bytes_total or 0.0) + bytes_accessed
+            for f in ("argument_bytes", "output_bytes", "temp_bytes",
+                      "generated_code_bytes"):
+                if mem[f] is not None:
+                    setattr(card, f, mem[f])
+            if mem["peak_hbm_bytes"] is not None:
+                card.peak_hbm_bytes = max(card.peak_hbm_bytes or 0.0,
+                                          mem["peak_hbm_bytes"])
+            card.oi, card.bound = oi, bound
+            n_cards = len(self._cards)
+            peak_card = max((c.peak_hbm_bytes for c in self._cards.values()
+                             if c.peak_hbm_bytes is not None), default=None)
+            # instruments update INSIDE the observatory lock (nested
+            # obs -> registry, the established order): a /memz scrape —
+            # cards under the obs lock, instruments under the registry
+            # lock — can then never see a card whose cost/cards or
+            # cost/compiles_total hasn't landed yet
+            with _registry.get_registry().locked():
+                _registry.counter("cost/compiles_total").inc()
+                _registry.gauge("cost/cards").set(n_cards)
+                if flops is not None:
+                    _registry.gauge("cost/flops_total").add(flops)
+                if bytes_accessed is not None:
+                    _registry.gauge("cost/bytes_total").add(bytes_accessed)
+                if peak_card is not None:
+                    _registry.gauge("hbm/peak_card_bytes").set(peak_card)
+        return card
+
+    # -- live device memory (sync points only) ------------------------------
+
+    def update_live_memory(self) -> Optional[float]:
+        """High-water gauge over ``jax.live_arrays()`` — the measured
+        device-memory claim, booked at existing sync points (every
+        ``write_telemetry_json``).  Returns the current live bytes, or
+        None when jax is absent/uninitialized (a jax-free tool writing
+        telemetry must not crash)."""
+        try:
+            import jax
+            live = float(sum(getattr(a, "nbytes", 0)
+                             for a in jax.live_arrays()))
+        except Exception:
+            return None
+        with self._lock:
+            self._live_peak = max(self._live_peak or 0.0, live)
+            peak = self._live_peak
+        rl = self._resolve_roofline()
+        # hbm/frac denominator is the PROCESS's capacity: live_arrays()
+        # sums every local device's shards, so a single-chip capacity
+        # would overstate the fraction n_devices-fold on a pod slice
+        try:
+            n_dev = max(len(jax.local_devices()), 1)
+        except Exception:
+            n_dev = 1
+        with _registry.get_registry().locked():
+            _registry.gauge("hbm/live_bytes").set(live)
+            _registry.gauge("hbm/live_bytes_peak").set(peak)
+            if rl is not None and rl.hbm_capacity_bytes:
+                _registry.gauge("hbm/frac").set(
+                    peak / (rl.hbm_capacity_bytes * n_dev))
+        return live
+
+    # -- reading ------------------------------------------------------------
+
+    def cards(self) -> List[CostCard]:
+        with self._lock:
+            return sorted(self._cards.values(), key=lambda c: c.seq)
+
+    def total_compiles(self) -> int:
+        with self._lock:
+            return self._compiles
+
+    def live_peak_bytes(self) -> Optional[float]:
+        with self._lock:
+            return self._live_peak
+
+    def summary(self) -> dict:
+        """Deterministic aggregate for telemetry.json's ``cost`` section
+        (sorted keys, value types only — the report renders it and the
+        ``--max_hbm_frac`` arithmetic reads it post-hoc)."""
+        rl = self._resolve_roofline()
+        with self._lock:
+            sites: Dict[str, dict] = {}
+            for c in sorted(self._cards.values(), key=lambda c: c.seq):
+                s = sites.setdefault(c.site, {
+                    "cards": 0, "compiles": 0, "flops_total": None,
+                    "bytes_total": None, "peak_hbm_bytes": None,
+                    "compute_bound": 0, "memory_bound": 0})
+                s["cards"] += 1
+                s["compiles"] += c.n_compiles
+                if c.flops_total is not None:
+                    s["flops_total"] = ((s["flops_total"] or 0.0)
+                                        + c.flops_total)
+                if c.bytes_total is not None:
+                    s["bytes_total"] = ((s["bytes_total"] or 0.0)
+                                        + c.bytes_total)
+                if c.peak_hbm_bytes is not None:
+                    s["peak_hbm_bytes"] = max(s["peak_hbm_bytes"] or 0.0,
+                                              c.peak_hbm_bytes)
+                if c.bound in ("compute", "memory"):
+                    s[c.bound + "_bound"] += 1
+            out = {"cards": len(self._cards), "compiles": self._compiles,
+                   "live_bytes_peak": self._live_peak,
+                   "sites": {k: sites[k] for k in sorted(sites)}}
+        if rl is not None:
+            out["roofline"] = {
+                "kind": rl.kind, "peak_flops": rl.peak_flops,
+                "hbm_bytes_per_s": rl.hbm_bytes_per_s,
+                "hbm_capacity_bytes": rl.hbm_capacity_bytes,
+                "ridge_flops_per_byte": rl.ridge_flops_per_byte,
+                "synthetic": rl.synthetic}
+        else:
+            out["roofline"] = None
+        return out
+
+    def memz(self) -> dict:
+        """The ``/memz`` payload: one consistent cut — the observatory
+        lock is held across the cards read, the registry snapshot AND
+        the summary (observe() updates its instruments nested inside
+        the same lock), so a scrape can never see a card without its
+        ``cost/*`` bookings or vice versa (same torn-pair discipline
+        as ``/statz``)."""
+        with self._lock:
+            cards = [c.to_doc()
+                     for c in sorted(self._cards.values(),
+                                     key=lambda c: c.seq)]
+            metrics = _registry.get_registry().snapshot()
+            summary = self.summary()
+        fam = {n: m for n, m in metrics.items()
+               if n.startswith(("hbm/", "cost/", "serve/kv_"))}
+        return {"cards": cards, "metrics": fam, "summary": summary}
+
+    # -- persistence --------------------------------------------------------
+
+    def write_jsonl(self, logdir: str) -> Optional[str]:
+        """Atomic rewrite of ``<logdir>/costcards.jsonl`` (cards are
+        cumulative; the whole stream is rewritten each sync point, so a
+        SIGKILL leaves a recent consistent file).  No-op when no card
+        was ever captured."""
+        cards = self.cards()
+        if not cards:
+            return None
+        path = os.path.join(logdir, COSTCARDS_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for c in cards:
+                f.write(json.dumps(c.to_doc(), sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cards.clear()
+            self._seq = 0
+            self._compiles = 0
+            self._live_peak = None
+            self._roofline = None
+            self._roofline_tried = False
+
+
+_OBSERVATORY = CostObservatory()
+
+
+def get_observatory() -> CostObservatory:
+    return _OBSERVATORY
+
+
+def observe(site: str, geometry, compiled) -> CostCard:
+    return _OBSERVATORY.observe(site, geometry, compiled)
+
+
+def read_costcards(logdir: str) -> List[CostCard]:
+    """Cards back off a run's ``costcards.jsonl`` (torn tail lines from
+    a hard kill are skipped, same rule as every other reader)."""
+    path = os.path.join(logdir, COSTCARDS_FILE)
+    out: List[CostCard] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(CostCard.from_doc(json.loads(line)))
+            except (ValueError, TypeError):
+                continue
+    return out
+
+
+# -- the jit wrapper (the serving/bench compile sites) -----------------------
+
+class InstrumentedJit:
+    """AOT-capturing wrapper around a jitted callable: per input
+    signature it runs ``jfn.lower(*args).compile()`` ONCE, captures the
+    CostCard, and dispatches every later call straight to the compiled
+    executable — the identical program jit would have built (the parity
+    tests that pin token-bitwise behavior run through this wrapper).
+
+    Hot-path contract: the steady state pays ONE identity check and a
+    try-frame, nothing else — the last-used Compiled is called
+    directly, and ITS OWN C-level argument validation (shape/dtype/
+    sharding, run before execution or donation — the same pre-execution
+    contract the trainer's AOT dispatch leans on) doubles as the cache
+    probe.  Only a mismatch (a new shape bucket, a resharded input)
+    raises TypeError/ValueError and falls into the slow path, which
+    computes the full pytree signature, compiles+captures if new, and
+    promotes the entry.  Shape buckets in the engine are sticky, so the
+    exception path is O(distinct geometries) per process, not per step.
+
+    Failure is always graceful and PER SIGNATURE: a lowering quirk (or
+    a first-call input rejection) routes that signature to the plain
+    jit path while other geometries keep capturing — so
+    ``cost/compiles_total`` never silently undercounts a run with real
+    geometry churn just because one shape misbehaved.  Fallback
+    signatures pay the sig-keyed slow path per call (they are the
+    rare, already-broken case); an execution failure propagates.
+    """
+
+    def __init__(self, jfn, site: str, geometry):
+        self._jfn = jfn
+        self.site = site
+        self.geometry = _deep_tuple(geometry)
+        self._by_sig: Dict[Tuple, Any] = {}
+        self._last: Any = None         # last-used entry (fast path)
+
+    @staticmethod
+    def _sig(args) -> Tuple:
+        # (aval, sharding) per leaf: a Compiled pins its input
+        # shardings, so the same shapes on a different mesh (e.g. the
+        # TP-sharded params of a later engine over the same model) must
+        # map to a fresh compile, exactly as jit's own cache would.
+        # avals and sharding objects are hashable.
+        import jax
+        import numpy as np
+        out = []
+        for x in jax.tree_util.tree_leaves(args):
+            aval = getattr(x, "aval", None)
+            if aval is not None:
+                out.append((aval, getattr(x, "sharding", None)))
+            else:
+                out.append((tuple(np.shape(x)),
+                            str(getattr(x, "dtype", type(x).__name__))))
+        return tuple(out)
+
+    def __call__(self, *args):
+        entry = self._last             # only ever a Compiled, never jfn
+        if entry is not None:
+            try:
+                # the Compiled's own pre-execution argument check IS
+                # the cache probe: zero extra hot-path work
+                return entry(*args)
+            except (TypeError, ValueError):
+                pass                   # new geometry: re-route below
+        sig = self._sig(args)
+        entry = self._by_sig.get(sig)
+        if entry is None:
+            try:
+                entry = self._jfn.lower(*args).compile()
+                observe(self.site, self.geometry, entry)
+            except Exception:
+                entry = self._jfn      # capture must never break serving
+            self._by_sig[sig] = entry
+        if entry is self._jfn:
+            return self._jfn(*args)
+        try:
+            out = entry(*args)
+        except (TypeError, ValueError):
+            # first-call input rejection (raised before execution or
+            # donation): jit fallback for THIS signature only
+            self._by_sig[sig] = self._jfn
+            return self._jfn(*args)
+        self._last = entry
+        return out
+
+
+def instrument(jfn, site: str, geometry) -> InstrumentedJit:
+    """Wrap a jitted callable so every compile it pays is captured as a
+    CostCard under ``(site, geometry)``."""
+    return InstrumentedJit(jfn, site, geometry)
+
+
+# -- the explainer (report --explain A B) ------------------------------------
+
+def _card_totals(card: CostCard) -> dict:
+    return {"bytes": card.bytes_total, "flops": card.flops_total,
+            "compiles": card.n_compiles,
+            "peak_hbm_bytes": card.peak_hbm_bytes, "bound": card.bound}
+
+
+def _rel(b: Optional[float], a: Optional[float]) -> Optional[float]:
+    """Relative growth, or None when undefined — including a zero base
+    (no Infinity: the ``--json`` document must stay RFC-parseable by
+    non-Python consumers, and the absolute deltas carry the signal)."""
+    if a is None or b is None or a == 0:
+        return None
+    return (b - a) / a
+
+
+def _growth_verdict(bf: Optional[float], ff: Optional[float]) -> str:
+    """bytes-growth-fraction, flops-growth-fraction -> a one-word cause."""
+    if bf is None and ff is None:
+        return "unmeasured"
+    bf = bf if bf is not None else 0.0
+    ff = ff if ff is not None else 0.0
+    if bf > 2 * max(ff, 0.0) + 0.05:
+        return "memory-bound growth"
+    if ff > 2 * max(bf, 0.0) + 0.05:
+        return "compute-bound growth"
+    if max(bf, ff) > 0.05:
+        return "proportional growth"
+    if min(bf, ff) < -0.05:
+        return "shrink"
+    return "flat"
+
+
+def diff_cards(cards_a: List[CostCard],
+               cards_b: List[CostCard]) -> List[dict]:
+    """Card-by-card diff, RANKED by share of byte growth (run A's total
+    bytes is the normalizer, so "which executable grew the run" reads
+    directly off the order).  A geometry present only in B — the usual
+    shape of a widened decode bucket — counts its full cost as growth.
+    Ties (no bytes on either side) fall back to flops growth, then to
+    compile-count growth."""
+    ix_a = {c.key(): c for c in cards_a}
+    ix_b = {c.key(): c for c in cards_b}
+    total_bytes_a = sum(c.bytes_total or 0.0 for c in cards_a) or 1.0
+    total_flops_a = sum(c.flops_total or 0.0 for c in cards_a) or 1.0
+    rows = []
+    for key in sorted(set(ix_a) | set(ix_b), key=str):
+        a, b = ix_a.get(key), ix_b.get(key)
+        ta = _card_totals(a) if a else {"bytes": None, "flops": None,
+                                        "compiles": 0,
+                                        "peak_hbm_bytes": None,
+                                        "bound": "unknown"}
+        tb = _card_totals(b) if b else {"bytes": None, "flops": None,
+                                        "compiles": 0,
+                                        "peak_hbm_bytes": None,
+                                        "bound": "unknown"}
+        d_bytes = (tb["bytes"] or 0.0) - (ta["bytes"] or 0.0)
+        d_flops = (tb["flops"] or 0.0) - (ta["flops"] or 0.0)
+        score = (abs(d_bytes) / total_bytes_a
+                 + 0.1 * abs(d_flops) / total_flops_a
+                 + 1e-6 * abs(tb["compiles"] - ta["compiles"]))
+        rows.append({
+            "site": key[0], "geometry": list(key[1]),
+            "in_a": a is not None, "in_b": b is not None,
+            "bytes_a": ta["bytes"], "bytes_b": tb["bytes"],
+            "flops_a": ta["flops"], "flops_b": tb["flops"],
+            "compiles_a": ta["compiles"], "compiles_b": tb["compiles"],
+            "peak_hbm_a": ta["peak_hbm_bytes"],
+            "peak_hbm_b": tb["peak_hbm_bytes"],
+            "bytes_frac": _rel(tb["bytes"], ta["bytes"]),
+            "flops_frac": _rel(tb["flops"], ta["flops"]),
+            "bound": tb["bound"] if b else ta["bound"],
+            "bytes_delta": d_bytes, "flops_delta": d_flops,
+            "score": score})
+    rows.sort(key=lambda r: (-r["score"], r["site"], str(r["geometry"])))
+    return rows
+
+
+def diff_sites(cards_a: List[CostCard],
+               cards_b: List[CostCard]) -> List[dict]:
+    """Per-site rollup of :func:`diff_cards` — the headline attribution
+    ("decode: bytes +112%, flops flat -> memory-bound growth; compiles
+    3 -> 9"), ranked the same way."""
+    def fold(cards):
+        agg: Dict[str, dict] = {}
+        for c in cards:
+            s = agg.setdefault(c.site, {"bytes": None, "flops": None,
+                                        "compiles": 0})
+            s["compiles"] += c.n_compiles
+            if c.bytes_total is not None:
+                s["bytes"] = (s["bytes"] or 0.0) + c.bytes_total
+            if c.flops_total is not None:
+                s["flops"] = (s["flops"] or 0.0) + c.flops_total
+        return agg
+
+    agg_a, agg_b = fold(cards_a), fold(cards_b)
+    total_bytes_a = sum(c.bytes_total or 0.0 for c in cards_a) or 1.0
+    total_flops_a = sum(c.flops_total or 0.0 for c in cards_a) or 1.0
+    rows = []
+    for site in sorted(set(agg_a) | set(agg_b)):
+        a = agg_a.get(site, {"bytes": None, "flops": None, "compiles": 0})
+        b = agg_b.get(site, {"bytes": None, "flops": None, "compiles": 0})
+        bf, ff = _rel(b["bytes"], a["bytes"]), _rel(b["flops"], a["flops"])
+        d_bytes = (b["bytes"] or 0.0) - (a["bytes"] or 0.0)
+        d_flops = (b["flops"] or 0.0) - (a["flops"] or 0.0)
+        # same weights as diff_cards: bytes growth leads, flops growth
+        # keeps a compute-bound regression (flat bytes, doubled flops)
+        # from ranking at ~zero, compile churn breaks ties
+        rows.append({
+            "site": site, "bytes_a": a["bytes"], "bytes_b": b["bytes"],
+            "flops_a": a["flops"], "flops_b": b["flops"],
+            "compiles_a": a["compiles"], "compiles_b": b["compiles"],
+            "bytes_frac": bf, "flops_frac": ff,
+            "verdict": _growth_verdict(bf, ff),
+            "score": abs(d_bytes) / total_bytes_a
+            + 0.1 * abs(d_flops) / total_flops_a
+            + 1e-6 * abs(b["compiles"] - a["compiles"])})
+    rows.sort(key=lambda r: (-r["score"], r["site"]))
+    return rows
+
+
+def _load_telemetry(logdir: str) -> dict:
+    path = os.path.join(logdir, "telemetry.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def explain(logdir_a: str, logdir_b: str) -> dict:
+    """The ``report --explain`` payload: phase-by-phase (goodput bucket
+    deltas off each run's telemetry.json) and card-by-card (ranked site
+    + geometry attribution off each run's costcards.jsonl).  Raises
+    FileNotFoundError when either side has no cards — an explain
+    against a run that never captured is a configuration error, not an
+    empty diff."""
+    cards_a = read_costcards(logdir_a)
+    cards_b = read_costcards(logdir_b)
+    for name, cards in (("A", cards_a), ("B", cards_b)):
+        if not cards:
+            raise FileNotFoundError(
+                f"run {name} has no {COSTCARDS_FILE} — was it produced "
+                f"by a costobs-instrumented run?")
+    tel_a, tel_b = _load_telemetry(logdir_a), _load_telemetry(logdir_b)
+    phases = {}
+    ga = tel_a.get("goodput") or {}
+    gb = tel_b.get("goodput") or {}
+    for k in sorted(set(ga) | set(gb)):
+        if not k.endswith("_s") and k != "productive_fraction":
+            continue
+        va, vb = ga.get(k), gb.get(k)
+        if va is None and vb is None:
+            continue
+        phases[k] = {"a": va, "b": vb,
+                     "delta": (vb or 0.0) - (va or 0.0)}
+    ranked = diff_sites(cards_a, cards_b)
+    return {"logdir_a": os.path.abspath(logdir_a),
+            "logdir_b": os.path.abspath(logdir_b),
+            "phases": phases,
+            "ranked": ranked,
+            "cards": diff_cards(cards_a, cards_b)}
+
+
+def _fmt(v: Optional[float]) -> str:
+    return "n/a" if v is None else f"{v:.4g}"
+
+
+def _fmt_frac(v: Optional[float]) -> str:
+    return "n/a" if v is None else f"{v:+.0%}"
+
+
+def render_explain(doc: dict, top: int = 10) -> List[str]:
+    """Human-readable explain lines (the ``--json`` twin is the raw
+    dict).  The first ranked line IS the attribution — the lane greps
+    it."""
+    lines = [f"== cost explain: {doc['logdir_a']} -> {doc['logdir_b']} =="]
+    if doc["phases"]:
+        lines.append("Phase deltas (goodput seconds, B - A)")
+        for k, p in sorted(doc["phases"].items(),
+                           key=lambda kv: -abs(kv[1]["delta"])):
+            if abs(p["delta"]) < 1e-9:
+                continue
+            lines.append(f"  {k:<24} {_fmt(p['a']):>10} -> "
+                         f"{_fmt(p['b']):>10}  ({p['delta']:+.3f})")
+    lines.append("Ranked attribution (share of byte growth, largest first)")
+    for i, r in enumerate(doc["ranked"][:top], start=1):
+        lines.append(
+            f"  {i}. {r['site']}: bytes {_fmt_frac(r['bytes_frac'])} "
+            f"({_fmt(r['bytes_a'])} -> {_fmt(r['bytes_b'])}), "
+            f"flops {_fmt_frac(r['flops_frac'])} -> {r['verdict']}; "
+            f"compiles {r['compiles_a']} -> {r['compiles_b']}")
+        for c in [c for c in doc["cards"] if c["site"] == r["site"]][:3]:
+            tag = ("NEW in B" if not c["in_a"]
+                   else "gone in B" if not c["in_b"]
+                   else f"bytes {_fmt_frac(c['bytes_frac'])}")
+            lines.append(
+                f"       geometry {tuple(c['geometry'])}: {tag}, "
+                f"bytes {_fmt(c['bytes_a'])} -> {_fmt(c['bytes_b'])}, "
+                f"compiles {c['compiles_a']} -> {c['compiles_b']} "
+                f"[{c['bound']}]")
+    return lines

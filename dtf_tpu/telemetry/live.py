@@ -28,7 +28,13 @@ Endpoints (all JSON, GET only):
   (:meth:`~dtf_tpu.telemetry.fleet.FleetPlane.fleetz`): per-host books,
   sync-point skew/blame attribution, fleet goodput — one consistent
   fleet cut (per-host docs are atomic, the skew books read under the
-  plane lock).
+  plane lock);
+* ``/memz``   — the device cost observatory
+  (:meth:`~dtf_tpu.telemetry.costobs.CostObservatory.memz`): every
+  captured CostCard (per-compile FLOP/byte/HBM attribution) plus the
+  ``hbm/*`` + ``cost/*`` + KV-pool instruments as one consistent cut
+  (cards under the observatory lock, instruments from one locked
+  registry snapshot — same torn-pair discipline as ``/statz``).
 
 Threading model — the same discipline as ``serve/frontend.py``: handler
 threads NEVER touch the engine or trainer; every endpoint reads a
@@ -178,6 +184,14 @@ class AdminServer:
             return 200, {"fleet": None, "note": "no fleet plane armed"}
         return 200, self.fleet_fn()
 
+    def _memz(self) -> tuple:
+        # the process-wide observatory is always present (cards may be
+        # empty before the first compile — that IS the honest payload);
+        # memz() reads cards under the observatory lock and instruments
+        # from one locked registry snapshot.
+        from dtf_tpu.telemetry import costobs
+        return 200, costobs.get_observatory().memz()
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> "AdminServer":
@@ -208,10 +222,12 @@ class AdminServer:
                         code, doc = admin._slo()
                     elif url.path in ("/fleetz", "/fleetz/"):
                         code, doc = admin._fleetz()
+                    elif url.path in ("/memz", "/memz/"):
+                        code, doc = admin._memz()
                     elif url.path == "/":
                         code, doc = 200, {"endpoints": [
                             "/statz", "/healthz", "/tracez", "/slo",
-                            "/fleetz"]}
+                            "/fleetz", "/memz"]}
                     else:
                         code, doc = 404, {"error": f"no such endpoint "
                                                    f"{url.path!r}"}

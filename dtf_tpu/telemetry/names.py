@@ -85,7 +85,6 @@ METRICS = (
     "serve/active_requests",
     "serve/slots",
     "serve/kv_blocks_total",
-    "serve/kv_blocks_used",
     "serve/kv_blocks_peak",
     "serve/ttft_ms",              # per-request time-to-first-token
     "serve/tpot_ms",              # per-request time-per-output-token
@@ -124,6 +123,25 @@ METRICS = (
     # live introspection endpoint (telemetry/live.py)
     "live/requests_total",        # admin HTTP requests served
     "live/errors_total",          # admin HTTP 4xx/5xx responses
+    # device cost observatory (telemetry/costobs.py): per-compile XLA
+    # cost/memory attribution.  cost/* book at COMPILE time only;
+    # hbm/* gauges update at existing sync points (write_telemetry_json)
+    # and from the engine's per-iteration KV arithmetic — zero hot-path
+    # device work, zero new collectives.
+    "cost/compiles_total",        # compiles captured as CostCards
+    "cost/cards",                 # distinct (site, geometry) cards
+    "cost/flops_total",           # summed cost_analysis flops (known only)
+    "cost/bytes_total",           # summed cost_analysis bytes accessed
+    "hbm/live_bytes",             # sum of jax.live_arrays() bytes
+    "hbm/live_bytes_peak",        # high-water of the above
+    "hbm/frac",                   # live peak / chip HBM capacity (roofline)
+    "hbm/peak_card_bytes",        # max per-executable HBM claim over cards
+    "hbm/kv_pool_bytes",          # paged-KV blocks-in-use x block bytes
+    # KV-pool observability (serve/paged_kv.py pool via engine.step):
+    # pool pressure visible BEFORE admission starts rejecting
+    "serve/kv_blocks_in_use",
+    "serve/kv_pool_frac",
+    "serve/kv_hot_prefix_blocks",
     # fleet plane (telemetry/fleet.py): sync-point skew attribution,
     # booked by the coordinator as fleet barriers complete.  blame_p<k>
     # counts the barriers host k arrived LAST at (it gated the fleet);

@@ -2,6 +2,10 @@
 
     python -m dtf_tpu.telemetry.report <logdir> [--top N] [--json]
         [--profile_dir DIR] [--export-trace OUT.json] [--check [--tol PCT]]
+    python -m dtf_tpu.telemetry.report --explain <logdir_a> <logdir_b>
+        # step-time regression explainer: phase-by-phase + card-by-card
+        # diff of two runs' cost observatories (telemetry/costobs.py),
+        # ranked attribution of byte/flop growth per compile site
 
 Merges ``telemetry.json`` (goodput books + instrument snapshot),
 ``metrics.csv`` (attempt-deduplicated), ``spans.p*.jsonl``,
@@ -202,6 +206,8 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_skew_ms: Optional[float] = None,
                 min_fleet_goodput: Optional[float] = None,
                 max_blame_frac: Optional[float] = None,
+                max_hbm_frac: Optional[float] = None,
+                max_compiles: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -242,7 +248,16 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       over sum of wall across every reporting host, from the
       coordinator rollup), and ceiling on any single host's share of
       last-arrivals (a fleet where one host eats the blame budget is a
-      straggler diagnosis, not noise).
+      straggler diagnosis, not noise);
+    * ``max_hbm_frac`` / ``max_compiles`` — the DEVICE COST gates
+      (telemetry/costobs.py): ceiling on the run's live-HBM high-water
+      as a fraction of chip capacity (``hbm/frac``, measured off
+      ``jax.live_arrays()`` against the roofline table's capacity —
+      the CPU sim's pinned synthetic 4 GiB keeps it deterministic),
+      and ceiling on captured compiles (``cost/compiles_total`` — a
+      geometry churn that recompiles every iteration is a perf bug the
+      wall clock alone misattributes).  A run that never captured (no
+      observatory wired) FAILS both: absence is falsifiable.
     """
     lines: List[str] = []
     ok = True
@@ -318,6 +333,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                   if h.get("blame_frac") is not None]
         gate("max_blame_frac", max(shares) if shares else None,
              max_blame_frac, at_most=True)
+    if max_hbm_frac is not None:
+        gate("max_hbm_frac", _metric_value(report, "hbm/frac"),
+             max_hbm_frac, at_most=True)
+    if max_compiles is not None:
+        gate("max_compiles",
+             _metric_value(report, "cost/compiles_total"),
+             float(max_compiles), at_most=True)
     return ok, lines
 
 
@@ -432,7 +454,9 @@ def render(report: dict, top: int = 10) -> str:
                      "tpot_ms_p99", "makespan_s", "tokens_out",
                      "prefill_calls", "spec_k", "spec_proposed",
                      "spec_accepted", "spec_acceptance",
-                     "kv_blocks_peak", "kv_blocks_total")
+                     "kv_blocks_peak", "kv_blocks_total",
+                     "kv_blocks_in_use", "kv_pool_frac_peak",
+                     "kv_hot_prefix_blocks")
             for k in order:
                 if k in serving and serving[k] is not None:
                     v = serving[k]
@@ -464,6 +488,35 @@ def render(report: dict, top: int = 10) -> str:
                           f"slow={o.get('alerts_slow')}")
         for n in sorted(srv):
             lines.append(f"  {n:<28} {srv[n]:12.5g}")
+    # Device cost plane (telemetry/costobs.py): the per-site compile
+    # FLOP/byte/HBM rollup plus the roofline the cards were classified
+    # against.  None values print as n/a — a backend that reported
+    # nothing must read as "not measured", never as zero.
+    cost = tel.get("cost")
+    if cost:
+        lines.append("Device cost (telemetry/costobs.py)")
+        rl = cost.get("roofline")
+        if rl:
+            lines.append(
+                f"  {'roofline':<28} {rl.get('kind')}"
+                f"  ridge {rl.get('ridge_flops_per_byte'):.3g} flops/B"
+                f"  capacity {rl.get('hbm_capacity_bytes'):.3g} B"
+                + ("  (synthetic)" if rl.get("synthetic") else ""))
+        _na = lambda v, fmt="{:.4g}": ("n/a" if v is None
+                                       else fmt.format(v))
+        lines.append(f"  {'cards / compiles':<28} "
+                     f"{cost.get('cards', 0)} / {cost.get('compiles', 0)}")
+        if cost.get("live_bytes_peak") is not None:
+            lines.append(f"  {'live_bytes_peak':<28} "
+                         f"{_na(cost['live_bytes_peak'])}")
+        for site, s in sorted((cost.get("sites") or {}).items()):
+            lines.append(
+                f"  {site:<28} cards {s['cards']:>3}  compiles "
+                f"{s['compiles']:>4}  flops {_na(s['flops_total']):>9}  "
+                f"bytes {_na(s['bytes_total']):>9}  peak_hbm "
+                f"{_na(s['peak_hbm_bytes']):>9}  "
+                f"(compute {s['compute_bound']}/memory "
+                f"{s['memory_bound']})")
     rt = report.get("request_traces")
     if rt:
         frac = rt.get("complete_frac")
@@ -572,6 +625,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m dtf_tpu.telemetry.report",
         description="Merge a run's telemetry into one post-mortem.")
     p.add_argument("logdir")
+    p.add_argument("logdir_b", nargs="?", default=None,
+                   help="second logdir (the B run) for --explain")
+    p.add_argument("--explain", action="store_true",
+                   help="step-time regression explainer: diff TWO runs "
+                        "phase-by-phase (goodput buckets) and card-by-"
+                        "card (costcards.jsonl) and print a ranked "
+                        "attribution — which site/geometry grew, in "
+                        "bytes or flops, and whether the growth is "
+                        "memory- or compute-bound")
     p.add_argument("--top", type=int, default=10)
     p.add_argument("--json", action="store_true",
                    help="emit the merged report as JSON instead of text")
@@ -626,6 +688,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max_blame_frac", type=float, default=None,
                    help="fleet gate: ceiling on any single host's share "
                         "of last-arrivals (0..1)")
+    p.add_argument("--max_hbm_frac", type=float, default=None,
+                   help="device-cost gate: ceiling on the live-HBM "
+                        "high-water as a fraction of chip capacity "
+                        "(hbm/frac; not measured = FAIL)")
+    p.add_argument("--max_compiles", type=float, default=None,
+                   help="device-cost gate: ceiling on captured compiles "
+                        "(cost/compiles_total; not measured = FAIL)")
     p.add_argument("--request", type=int, default=None, metavar="RID",
                    help="print ONE request's causally-ordered timeline "
                         "(reqtrace events + the engine iterations that "
@@ -638,6 +707,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     ns = p.parse_args(argv)
     if not os.path.isdir(ns.logdir):
         print(f"error: {ns.logdir} is not a directory", file=sys.stderr)
+        return 2
+    if ns.explain:
+        from dtf_tpu.telemetry import costobs
+        if ns.logdir_b is None or not os.path.isdir(ns.logdir_b):
+            print("error: --explain takes TWO logdirs "
+                  "(report --explain <logdir_a> <logdir_b>)",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = costobs.explain(ns.logdir, ns.logdir_b)
+        except FileNotFoundError as exc:
+            # absence is loud: an explain against a run that never
+            # captured cards is a configuration error, not an empty diff
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        if ns.json:
+            print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        else:
+            for line in costobs.render_explain(doc, top=ns.top):
+                print(line)
+        return 0
+    if ns.logdir_b is not None:
+        print("error: a second logdir only makes sense with --explain",
+              file=sys.stderr)
         return 2
     if ns.request is not None:
         from dtf_tpu.telemetry import reqtrace
@@ -683,7 +776,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "min_trace_complete_frac": ns.min_trace_complete_frac,
                   "max_skew_ms": ns.max_skew_ms,
                   "min_fleet_goodput": ns.min_fleet_goodput,
-                  "max_blame_frac": ns.max_blame_frac}
+                  "max_blame_frac": ns.max_blame_frac,
+                  "max_hbm_frac": ns.max_hbm_frac,
+                  "max_compiles": ns.max_compiles}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -682,7 +682,7 @@ class Trainer:
             import logging as _logging
             _logging.getLogger("dtf_tpu").info(
                 "admin endpoint on http://127.0.0.1:%s "
-                "(/statz /healthz /tracez /slo)", _admin.port)
+                "(/statz /healthz /tracez /slo /memz)", _admin.port)
         if (self.cfg.resume and self.cfg.logdir
                 and self.cluster.is_coordinator
                 and tracker.accounted_s() == 0):
@@ -1175,6 +1175,13 @@ class Trainer:
         self._compiled_batch_sig = self._batch_signature(batch_sds)
         self._compile_seen = True      # the loop's first step is productive
         tel.gauge("compile/aot_s").set(time.perf_counter() - _t0)
+        # Cost observatory (telemetry/costobs.py): the warmup holds the
+        # one Compiled object the training hot loop will run — capture
+        # its cost/memory analysis as the run's train/step CostCard
+        # here, at compile time, so the hot path never pays a read.
+        from dtf_tpu.telemetry import costobs
+        costobs.observe("train/step", ("aot", global_bs),
+                        self._compiled_step)
 
     def _dispatch_step(self, batch, step_rng):
         """One train-step dispatch: the AOT-compiled executable when its

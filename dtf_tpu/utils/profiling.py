@@ -16,10 +16,71 @@ computes bitwise-identical results each step.  ``fingerprint()`` +
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 from typing import Any, Iterator, Optional
 
 import jax
 import numpy as np
+
+
+# -- per-chip roofline table (telemetry/costobs.py classification) -----------
+
+@dataclasses.dataclass(frozen=True)
+class ChipRoofline:
+    """Peak compute, HBM bandwidth and HBM capacity for one chip kind —
+    the denominator set of the cost observatory: operational intensity
+    above ``ridge_flops_per_byte`` is compute-bound, below is
+    memory-bound, and ``hbm_capacity_bytes`` turns a peak-bytes gauge
+    into the ``hbm/frac`` fraction the ``--max_hbm_frac`` gate reads.
+    ``synthetic=True`` marks the pinned CPU-sim entry: the NUMBERS are
+    arbitrary-but-fixed so classification and the capacity fraction are
+    deterministic in tests, not a claim about the host."""
+
+    kind: str
+    peak_flops: float            # dense-matmul peak, FLOP/s per chip
+    hbm_bytes_per_s: float       # HBM bandwidth per chip
+    hbm_capacity_bytes: float    # HBM per chip
+    synthetic: bool = False
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        return self.peak_flops / self.hbm_bytes_per_s
+
+
+# Public figures (bf16 peak mirrors bench/matmul._PEAK_BF16; bandwidth/
+# capacity: v4 1.2 TB/s / 32 GB, v5e 0.82 TB/s / 16 GB, v5p 2.765 TB/s /
+# 95 GB, v6e 1.64 TB/s / 32 GB).
+_ROOFLINES = {
+    "v4": (275e12, 1.2e12, 32e9),
+    "v5 lite": (197e12, 0.82e12, 16e9),
+    "v5e": (197e12, 0.82e12, 16e9),
+    "v5p": (459e12, 2.765e12, 95e9),
+    "v6 lite": (918e12, 1.64e12, 32e9),
+    "v6e": (918e12, 1.64e12, 32e9),
+}
+
+#: The pinned synthetic CPU-sim entry: ridge = 2.0 flops/byte, capacity
+#: 4 GiB.  Fixed forever so test classifications and hbm/frac readings
+#: are deterministic across rigs.
+CPU_SIM_ROOFLINE = ChipRoofline("cpu_sim", 1.0e11, 5.0e10,
+                                4.0 * 1024 ** 3, synthetic=True)
+
+
+def chip_roofline(device: Optional[jax.Device] = None
+                  ) -> Optional[ChipRoofline]:
+    """Roofline entry for ``device`` (default: the first local device).
+    TPU kinds match by substring against the public table; the CPU
+    backend gets :data:`CPU_SIM_ROOFLINE`; an unknown accelerator
+    returns None — classification then reports "unknown" rather than
+    guessing."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, (peak, bw, cap) in _ROOFLINES.items():
+        if key in kind:
+            return ChipRoofline(kind or key, peak, bw, cap)
+    if getattr(device, "platform", "") == "cpu" or kind == "cpu":
+        return CPU_SIM_ROOFLINE
+    return None
 
 
 @contextlib.contextmanager

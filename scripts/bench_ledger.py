@@ -44,6 +44,25 @@ def _added_commit(repo: str, filename: str) -> "str | None":
         return None
 
 
+#: Optional device-cost columns (telemetry/costobs.py, ISSUE 15):
+#: present only when the round's doc carried them — rows written before
+#: the cost observatory existed fold WITHOUT these keys, so the
+#: committed LEDGER.jsonl is byte-stable and old rows keep parsing
+#: (readers use .get; the round-trip test pins both directions).
+#: peak_hbm_bytes semantics per kind (the gate compares within one rig,
+#: and rigs never mix kinds, so the two readings never cross-diagnose):
+#: bench rows carry the max per-executable compile-time HBM claim
+#: (CostCard.peak_hbm_bytes); decode rows carry the invocation's live
+#: device-bytes watermark sampled at ladder-point boundaries.
+COST_COLUMNS = ("peak_hbm_bytes", "n_compiles")
+
+
+def _fold_cost_columns(row: dict, doc: dict) -> None:
+    for col in COST_COLUMNS:
+        if doc.get(col) is not None and row.get(col) is None:
+            row[col] = doc[col]
+
+
 def _classify_legacy_tail(tail: str) -> "tuple[str, str]":
     """Rounds recorded before the structured failure line (r03: a raw
     traceback, parsed=null) still classify: the relay's signature error
@@ -84,9 +103,11 @@ def bench_row(path: str, repo: str) -> dict:
             tflops_per_chip=float(parsed["value"]),
             mfu=detail.get("roofline_fraction"),
             vs_baseline=parsed.get("vs_baseline"))
+        _fold_cost_columns(row, detail)
     else:
         err, stage = _classify_legacy_tail(doc.get("tail", ""))
         row.update(error=err, stage=stage)
+    _fold_cost_columns(row, doc)
     return row
 
 
@@ -110,6 +131,7 @@ def multichip_row(path: str, repo: str) -> dict:
         "stage": None if ok else ("skipped" if doc.get("skipped")
                                   else "dryrun"),
     }
+    _fold_cost_columns(row, doc)
     return row
 
 
@@ -123,7 +145,7 @@ def decode_row(path: str, repo: str) -> dict:
     run = os.path.splitext(os.path.basename(path))[0]
     tok_s = doc.get("tok_s_aggregate")
     ok = tok_s is not None and not doc.get("warning")
-    return {
+    row = {
         "run": run,
         "kind": "decode",
         "n": doc.get("n", _run_index(run)),
@@ -141,6 +163,8 @@ def decode_row(path: str, repo: str) -> dict:
         "error": None if ok else (doc.get("warning") or "no_tok_s"),
         "stage": None if ok else "ladder_fit",
     }
+    _fold_cost_columns(row, doc)
+    return row
 
 
 def _run_index(run: str) -> "int | None":
@@ -211,6 +235,26 @@ def _gate_kind(rows: "list[dict]", kind: str, field: str, unit: str,
             f"best prior green {best['run']} "
             f"{best[field]:g} (floor {floor:g}, "
             f"tol {tol_pct:g}%)")
+        if not passed:
+            # Name the regressed QUANTITY, not just the rig: the
+            # headline delta always, plus the optional device-cost
+            # columns (peak HBM, compile count) when both rounds
+            # carried them — a compile-count or HBM jump alongside a
+            # throughput drop is the diagnosis, not a coincidence.
+            drop = (latest[field] - best[field]) / best[field]
+            quant = [f"{field} {best[field]:g} -> {latest[field]:g} "
+                     f"({drop:+.1%})"]
+            for col, label in (("peak_hbm_bytes", "peak_hbm"),
+                               ("n_compiles", "compiles")):
+                # None-checks, not truthiness: a measured ZERO (e.g. 0
+                # compiles, everything cache-served) is exactly the
+                # reading whose jump is the diagnosis
+                a, b = best.get(col), latest.get(col)
+                if a is not None and b is not None:
+                    pct = f" ({(b - a) / a:+.0%})" if a else ""
+                    quant.append(f"{label} {a:g} -> {b:g}{pct}")
+            lines.append(f"ledger[{rig}]:   regressed quantity: "
+                         + "; ".join(quant))
     # trailing error streak: the stalled-trajectory alarm
     streak = []
     for r in reversed(kind_rows):

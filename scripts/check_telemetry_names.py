@@ -44,7 +44,14 @@ def check_runtime_guard() -> list:
                   # the fleet/* family is declared as exact names plus
                   # the per-host '*' patterns — a near-miss outside them
                   # must still be rejected
-                  "fleet/definitely_not_declared"):
+                  "fleet/definitely_not_declared",
+                  # the cost/hbm families (telemetry/costobs.py) are
+                  # exact-name declarations, no wildcards — a typo'd
+                  # scope must fail at registration, not ship a run's
+                  # worth of unplotted gauges
+                  "cost/definitely_not_declared",
+                  "hbm/definitely_not_declared",
+                  "serve/kv_definitely_not_declared"):
         try:
             reg.counter(probe)
         except ValueError:
@@ -57,9 +64,21 @@ def check_runtime_guard() -> list:
     for name in ("serve/shed_deadline_expired",    # pattern serve/shed_*
                  "checkpoint/saves_total",         # exact declaration
                  "fleet/blame_p3",                 # pattern fleet/blame_p*
-                 "fleet/barriers_total"):          # exact (fleet family)
+                 "fleet/barriers_total",           # exact (fleet family)
+                 "cost/compiles_total"):           # exact (cost family)
         try:
             reg.counter(name)
+        except ValueError as exc:
+            problems.append(f"declared name {name!r} rejected at "
+                            f"runtime: {exc}")
+    # gauge-typed declarations probe through gauge() — the live process
+    # may already hold them as gauges, and a counter() probe would trip
+    # the type guard instead of exercising the naming guard
+    for name in ("hbm/live_bytes",                 # exact (hbm family)
+                 "cost/cards",                     # exact (cost family)
+                 "serve/kv_pool_frac"):            # exact (kv gauges)
+        try:
+            reg.gauge(name)
         except ValueError as exc:
             problems.append(f"declared name {name!r} rejected at "
                             f"runtime: {exc}")

@@ -647,6 +647,139 @@ PYEOF
   [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: absurd fleet thresholds did not fail"; }
   rm -rf "$fdir"
 fi
+# Cost-observatory lane (DESIGN.md §6.6, ISSUE 15): (1) a train run and
+# a serve run must both emit CostCards (train/step from the AOT warmup;
+# serve/prefill+decode from the engine's builders — card count >= the
+# distinct compiled geometries, i.e. every card compiled at least once);
+# (2) /memz scraped MID-run serves the cards + hbm/cost instrument cut;
+# (3) an injected A/B where arm B doubles decode context — the
+# step-time regression explainer must rank serve/decode's bytes growth
+# FIRST; (4) the --max_hbm_frac gate is falsifiable: green at a sane
+# threshold, exit 1 at an absurd one, on the SAME logdir.  Skip with
+# NO_COSTOBS_LANE=1.
+if [ "${NO_COSTOBS_LANE:-0}" != "1" ]; then
+  echo "=== cost-observatory lane (cards + /memz scrape + explain A/B + hbm gates) ==="
+  codir=$(mktemp -d)
+  # (1a) train: AOT warmup -> train/step card, hbm gauges at sync points
+  JAX_PLATFORMS=cpu python -m dtf_tpu.workloads.mnist \
+      --epochs 1 --batch_size 512 --init fan_in --log_frequency 20 \
+      --logdir "$codir/train" > "$codir/train.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: costobs train run (rc=$rc)"; tail -5 "$codir/train.log"; }
+  # (1b) serve arm A, and (2) arm B with doubled decode context scraped
+  # mid-run on /memz
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 12 \
+      --qps 20 --clock virtual --seed 7 --block_size 4 \
+      --prompt_lens 4,8 --output_lens 4,8,8 \
+      --logdir "$codir/a" > "$codir/a.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: costobs serve arm A (rc=$rc)"; tail -5 "$codir/a.log"; }
+  JAX_PLATFORMS=cpu python - "$codir" <<'PYEOF'
+import json, os, socket, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dtf_tpu.serve", "--preset", "tiny",
+     "--demo", "12", "--qps", "20", "--clock", "wall", "--seed", "7",
+     "--block_size", "4", "--prompt_lens", "4,8",
+     "--output_lens", "16,32,32",      # arm B: decode context doubled+
+     "--admin_port", str(port), "--logdir", os.path.join(d, "b")],
+    stdout=open(os.path.join(d, "b.log"), "w"), stderr=subprocess.STDOUT,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+memz = None
+try:
+    deadline = time.time() + 180
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/memz", timeout=5) as r:
+                doc = json.loads(r.read())
+        except OSError:
+            time.sleep(0.2); continue
+        sites = {c["site"] for c in doc.get("cards", [])}
+        # wait for a decode card AND the end-of-iteration KV gauges —
+        # the first scrape can land mid-compile, before the engine's
+        # first iteration ever reached its gauge block
+        if "serve/decode" in sites and "hbm/kv_pool_bytes" in doc["metrics"]:
+            memz = doc
+            break
+        time.sleep(0.2)
+finally:
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill(); proc.wait(); rc = -1
+assert rc == 0, f"serve arm B exited {rc}"
+assert memz is not None, "/memz never served a decode card mid-run"
+assert "cost/compiles_total" in memz["metrics"], memz["metrics"].keys()
+assert "hbm/kv_pool_bytes" in memz["metrics"], "kv pool bytes missing"
+cards = memz["cards"]
+assert all(c["n_compiles"] >= 1 for c in cards)
+print(f"memz scrape OK: {len(cards)} card(s) mid-run, sites "
+      f"{sorted({c['site'] for c in cards})}")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: costobs /memz scrape (rc=$rc)"; tail -8 "$codir/b.log" 2>/dev/null; }
+  # (1c) every compile site emitted cards; count >= distinct geometries
+  python - "$codir" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+def cards(run):
+    path = os.path.join(d, run, "costcards.jsonl")
+    assert os.path.exists(path), f"{run}: no costcards.jsonl"
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+train = cards("train")
+assert any(c["site"] == "train/step" for c in train), train
+a, b = cards("a"), cards("b")
+for name, cs in (("a", a), ("b", b)):
+    sites = {c["site"] for c in cs}
+    assert "serve/decode" in sites, (name, sites)
+    assert sites & {"serve/prefill", "serve/prefill_batched"}, (name, sites)
+    # one card per distinct geometry (no duplicates in the stream) and
+    # every geometry actually compiled at least once
+    geoms = {(c["site"], str(c["geometry"])) for c in cs}
+    assert len(cs) == len(geoms), (name, len(cs), len(geoms))
+    assert all(c["n_compiles"] >= 1 for c in cs)
+tele = json.load(open(os.path.join(d, "b", "telemetry.json")))
+assert tele["cost"]["compiles"] >= len(b)
+assert tele["metrics"]["hbm/frac"]["value"] > 0
+print(f"cards OK: train {len(train)}, serve A {len(a)}, serve B {len(b)} "
+      f"(B compiles {tele['cost']['compiles']})")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: costobs card assertions (rc=$rc)"; }
+  # (3) the explainer must rank arm B's decode bytes-growth first
+  python -m dtf_tpu.telemetry.report --explain "$codir/a" "$codir/b" \
+      --json > "$codir/explain.json" 2>"$codir/explain.err"
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --explain (rc=$rc)"; tail -3 "$codir/explain.err"; }
+  python - "$codir/explain.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+top = doc["ranked"][0]
+assert top["site"] == "serve/decode", [r["site"] for r in doc["ranked"]]
+assert top["bytes_b"] and top["bytes_a"] and top["bytes_b"] > top["bytes_a"], top
+assert "growth" in top["verdict"], top
+print(f"explain OK: ranked #1 {top['site']} bytes "
+      f"{top['bytes_a']:.3g} -> {top['bytes_b']:.3g} ({top['verdict']})")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: explain ranking (rc=$rc)"; }
+  # (4) falsifiability: sane thresholds green, absurd threshold exits 1,
+  # same logdir
+  python -m dtf_tpu.telemetry.report "$codir/b" \
+      --max_hbm_frac 0.9 --max_compiles 500 > "$codir/gates.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: sane hbm gates (rc=$rc)"; tail -5 "$codir/gates.log"; }
+  grep -q "gate max_hbm_frac: OK" "$codir/gates.log" \
+    && grep -q "gate max_compiles: OK" "$codir/gates.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: hbm gate lines missing"; }
+  python -m dtf_tpu.telemetry.report "$codir/b" \
+      --max_hbm_frac 0.0000001 > /dev/null 2>&1
+  [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: absurd max_hbm_frac did not fail"; }
+  rm -rf "$codir"
+fi
 # Perf-regression ledger gate: needs no TPU, no multi-process run, no
 # fleet plane — it must run even on rigs that skip the fleet lane.
 # Skip with NO_LEDGER_GATE=1.

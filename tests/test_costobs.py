@@ -1,0 +1,626 @@
+"""Device cost observatory (dtf_tpu/telemetry/costobs.py, ISSUE 15).
+
+The honesty pins live here:
+
+* **backend degradation** — ``cost_analysis()`` / ``memory_analysis()``
+  returning None, raising, or reporting partial dicts must yield a
+  well-formed CostCard with ``None`` fields, never a crash and never a
+  fake zero a gate could pass on;
+* **deterministic classification** — the CPU sim classifies against
+  the pinned synthetic roofline entry, so compute-vs-memory verdicts
+  are rig-independent;
+* **explain ranking** — an A/B where one site's bytes grow must rank
+  that site first, and the ``--max_hbm_frac`` / ``--max_compiles``
+  gates are falsifiable (absence = FAIL, absurd threshold = FAIL).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dtf_tpu.telemetry as tel
+from dtf_tpu.telemetry import costobs
+from dtf_tpu.telemetry.costobs import (CostCard, classify, diff_sites,
+                                       read_costcards)
+from dtf_tpu.utils.profiling import CPU_SIM_ROOFLINE, chip_roofline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tel.reset()
+    yield
+    tel.reset()
+
+
+# ---------------------------------------------------------------------------
+# fakes: every backend degradation shape in one place
+# ---------------------------------------------------------------------------
+
+
+class _Mem:
+    def __init__(self, arg=None, out=None, temp=None, code=None,
+                 alias=None):
+        if arg is not None:
+            self.argument_size_in_bytes = arg
+        if out is not None:
+            self.output_size_in_bytes = out
+        if temp is not None:
+            self.temp_size_in_bytes = temp
+        if code is not None:
+            self.generated_code_size_in_bytes = code
+        if alias is not None:
+            self.alias_size_in_bytes = alias
+
+
+class _Compiled:
+    def __init__(self, cost="raise", mem="raise"):
+        self._cost = cost
+        self._mem = mem
+
+    def cost_analysis(self):
+        if self._cost == "raise":
+            raise NotImplementedError("backend reports nothing")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._mem == "raise":
+            raise NotImplementedError("backend reports nothing")
+        return self._mem
+
+
+# ---------------------------------------------------------------------------
+# capture honesty
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureDegradation:
+    def test_everything_raises_yields_null_card(self):
+        card = costobs.observe("train/step", ("g",), _Compiled())
+        assert card.flops is None and card.bytes_accessed is None
+        assert card.peak_hbm_bytes is None
+        assert card.flops_total is None and card.bytes_total is None
+        assert card.bound == "unknown"
+        assert card.n_compiles == 1
+
+    def test_none_analysis(self):
+        card = costobs.observe("train/step", ("g",),
+                               _Compiled(cost=None, mem=None))
+        assert card.flops is None and card.peak_hbm_bytes is None
+
+    def test_partial_dict_keeps_missing_none(self):
+        card = costobs.observe("train/step", ("g",),
+                               _Compiled(cost={"flops": 10.0}, mem=None))
+        assert card.flops == 10.0
+        assert card.bytes_accessed is None      # absent, NOT zero
+        assert card.bound == "unknown"          # can't classify w/o bytes
+
+    def test_negative_sentinel_degrades_to_none(self):
+        # XLA reports -1 for "unknown" — a gate must see absence
+        card = costobs.observe(
+            "train/step", ("g",),
+            _Compiled(cost={"flops": -1.0, "bytes accessed": -1.0}))
+        assert card.flops is None and card.bytes_accessed is None
+
+    def test_list_of_dicts_form(self):
+        # older jax returns [dict]; first computation wins
+        card = costobs.observe(
+            "train/step", ("g",),
+            _Compiled(cost=[{"flops": 8.0, "bytes accessed": 2.0}]))
+        assert card.flops == 8.0 and card.bytes_accessed == 2.0
+        assert card.oi == 4.0
+
+    def test_memory_fields_and_peak(self):
+        card = costobs.observe(
+            "train/step", ("g",),
+            _Compiled(cost=None,
+                      mem=_Mem(arg=100.0, out=50.0, temp=25.0, code=7.0,
+                               alias=25.0)))
+        assert card.argument_bytes == 100.0
+        assert card.output_bytes == 50.0
+        assert card.temp_bytes == 25.0
+        assert card.generated_code_bytes == 7.0
+        # arguments + outputs + temps - aliased
+        assert card.peak_hbm_bytes == 150.0
+
+    def test_doc_roundtrip_preserves_none(self):
+        card = costobs.observe("serve/decode", (3, 8), _Compiled())
+        back = CostCard.from_doc(json.loads(json.dumps(card.to_doc())))
+        assert back.key() == card.key()
+        assert back.flops is None and back.flops_total is None
+
+
+class TestClassification:
+    def test_cpu_roofline_is_pinned(self):
+        rl = chip_roofline(jax.devices()[0])
+        assert rl == CPU_SIM_ROOFLINE
+        assert rl.synthetic
+        assert rl.ridge_flops_per_byte == pytest.approx(2.0)
+
+    def test_compute_vs_memory_vs_unknown(self):
+        rl = CPU_SIM_ROOFLINE
+        assert classify(40.0, 10.0, rl) == (4.0, "compute")
+        assert classify(10.0, 10.0, rl) == (1.0, "memory")
+        assert classify(None, 10.0, rl) == (None, "unknown")
+        assert classify(10.0, None, rl) == (None, "unknown")
+        assert classify(10.0, 5.0, None) == (2.0, "unknown")
+
+    def test_real_compile_classifies_on_cpu_sim(self):
+        # a real CPU-backend Compiled: analysis present, classification
+        # deterministic against the pinned synthetic entry
+        f = jax.jit(lambda a, b: a @ b)
+        a = jnp.ones((64, 64), jnp.float32)
+        compiled = f.lower(a, a).compile()
+        card = costobs.observe("bench/matmul", (64,), compiled)
+        assert card.flops and card.bytes_accessed
+        assert card.bound in ("compute", "memory")  # never unknown here
+        assert card.peak_hbm_bytes and card.peak_hbm_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# observatory bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class TestObservatory:
+    def test_recompile_folds_into_card(self):
+        obs = costobs.get_observatory()
+        c = _Compiled(cost={"flops": 5.0, "bytes accessed": 10.0})
+        costobs.observe("serve/decode", (3, 8), c)
+        card = costobs.observe("serve/decode", (3, 8), c)
+        assert card.n_compiles == 2
+        assert card.flops_total == 10.0 and card.bytes_total == 20.0
+        assert len(obs.cards()) == 1
+        assert obs.total_compiles() == 2
+
+    def test_instruments_book_as_group(self):
+        costobs.observe("serve/decode", (3, 8),
+                        _Compiled(cost={"flops": 5.0,
+                                        "bytes accessed": 10.0}))
+        snap = tel.get_registry().snapshot()
+        assert snap["cost/compiles_total"]["value"] == 1
+        assert snap["cost/cards"]["value"] == 1
+        assert snap["cost/flops_total"]["value"] == 5.0
+        assert snap["cost/bytes_total"]["value"] == 10.0
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        costobs.observe("serve/decode", (3, 8),
+                        _Compiled(cost={"flops": 5.0,
+                                        "bytes accessed": 10.0}))
+        costobs.observe("serve/prefill", (16,), _Compiled())
+        path = costobs.get_observatory().write_jsonl(str(tmp_path))
+        assert os.path.basename(path) == costobs.COSTCARDS_FILE
+        cards = read_costcards(str(tmp_path))
+        assert [c.site for c in cards] == ["serve/decode", "serve/prefill"]
+        assert cards[1].flops is None
+
+    def test_update_live_memory_sets_hbm_gauges(self):
+        keep = jnp.ones((128, 128), jnp.float32)   # noqa: F841 (pinned live)
+        live = costobs.get_observatory().update_live_memory()
+        assert live and live >= keep.nbytes
+        snap = tel.get_registry().snapshot()
+        assert snap["hbm/live_bytes"]["value"] == live
+        assert snap["hbm/live_bytes_peak"]["value"] >= live
+        frac = snap["hbm/frac"]["value"]
+        # denominator is the PROCESS capacity: chip capacity x local
+        # devices (live_arrays sums every local device's shards)
+        assert frac == pytest.approx(
+            snap["hbm/live_bytes_peak"]["value"]
+            / (CPU_SIM_ROOFLINE.hbm_capacity_bytes
+               * len(jax.local_devices())))
+
+    def test_memz_is_one_families_cut(self):
+        costobs.observe("serve/decode", (3, 8),
+                        _Compiled(cost={"flops": 5.0,
+                                        "bytes accessed": 10.0}))
+        tel.counter("serve/requests_completed").inc()   # outside families
+        doc = costobs.get_observatory().memz()
+        assert doc["cards"][0]["site"] == "serve/decode"
+        assert "cost/compiles_total" in doc["metrics"]
+        assert "serve/requests_completed" not in doc["metrics"]
+        assert doc["summary"]["sites"]["serve/decode"]["compiles"] == 1
+
+    def test_summary_is_deterministic(self):
+        c = _Compiled(cost={"flops": 5.0, "bytes accessed": 10.0})
+        costobs.observe("b", (1,), c)
+        costobs.observe("a", (1,), c)
+        s = costobs.get_observatory().summary()
+        assert list(s["sites"]) == ["a", "b"]
+        assert json.dumps(s, sort_keys=True)   # JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# the jit wrapper (the serving/bench compile sites run through this)
+# ---------------------------------------------------------------------------
+
+
+class TestInstrumentedJit:
+    def test_captures_once_per_signature(self):
+        jfn = jax.jit(lambda x: x * 2.0)
+        inst = costobs.instrument(jfn, "bench/matmul", ("t",))
+        x = jnp.arange(4.0)
+        np.testing.assert_array_equal(np.asarray(inst(x)),
+                                      np.asarray(jfn(x)))
+        inst(x)                       # same signature: no new compile
+        assert costobs.get_observatory().total_compiles() == 1
+        inst(jnp.arange(8.0))         # new shape: one more compile
+        card = costobs.get_observatory().cards()[0]
+        assert card.n_compiles == 2
+        assert card.site == "bench/matmul"
+        # ping back to the first shape: the fast-path entry mismatches,
+        # the slow path must hit the per-signature cache — NOT recompile
+        inst(x)
+        assert costobs.get_observatory().total_compiles() == 2
+
+    def test_nested_geometry_roundtrips_hashable(self, tmp_path):
+        """bench/breakdown geometries nest a shape tuple; JSON turns it
+        into a list — from_doc must rebuild the SAME hashable key or
+        explain's A/B pairing breaks (diff_cards indexes by key)."""
+        from dtf_tpu.telemetry.costobs import diff_cards
+        c = _Compiled(cost={"flops": 4.0, "bytes accessed": 2.0})
+        costobs.observe("bench/breakdown", ("gelu", 2, (8, 8), "f32"), c)
+        costobs.get_observatory().write_jsonl(str(tmp_path))
+        back = read_costcards(str(tmp_path))
+        assert back[0].key() == costobs.get_observatory().cards()[0].key()
+        rows = diff_cards(back, back)      # must not raise unhashable
+        # inner tuples stay tuples in-process (JSON listifies on write)
+        assert rows[0]["geometry"] == ["gelu", 2, (8, 8), "f32"]
+
+    def test_lowering_failure_falls_back_to_jit(self):
+        calls = []
+
+        class _Weird:
+            def lower(self, *a):
+                raise RuntimeError("lowering quirk")
+
+            def __call__(self, x):
+                calls.append(1)
+                return x
+
+        inst = costobs.instrument(_Weird(), "bench/matmul", ("t",))
+        assert float(inst(jnp.float32(3.0))) == 3.0
+        assert calls == [1]
+        assert costobs.get_observatory().total_compiles() == 0
+
+    def test_serve_builders_emit_cards_and_stay_token_identical(self):
+        """The decode.py builders run through the wrapper: same tokens
+        as ever (the wrapper executes the identical lowered program),
+        one card per compiled geometry."""
+        from dtf_tpu.models.gpt import GPT, GPTConfig
+        from dtf_tpu.serve import ServingEngine, VirtualClock
+
+        model = GPT(GPTConfig.tiny())
+        params = model.init(jax.random.key(0))
+        rng = np.random.default_rng(3)
+        trace = [(0.02 * i, {"rid": i,
+                             "prompt": rng.integers(0, 64, (5,))
+                             .astype(np.int32),
+                             "max_new_tokens": 4})
+                 for i in range(3)]
+        eng = ServingEngine(model, params, num_slots=3, block_size=4,
+                            blocks_per_slot=8, clock=VirtualClock(),
+                            seed=0)
+        results = eng.run(list(trace))
+        assert all(r.status == "completed" for r in results.values())
+        cards = costobs.get_observatory().cards()
+        sites = {c.site for c in cards}
+        assert "serve/prefill" in sites or "serve/prefill_batched" in sites
+        assert "serve/decode" in sites
+        # one card per compiled geometry, every one actually compiled
+        assert all(c.n_compiles >= 1 for c in cards)
+        # KV gauges (satellite): registered from the engine iteration
+        snap = tel.get_registry().snapshot()
+        assert "serve/kv_blocks_in_use" in snap
+        assert 0.0 <= snap["serve/kv_pool_frac"]["value"] <= 1.0
+        assert snap["serve/kv_hot_prefix_blocks"]["value"] >= 1
+        assert "hbm/kv_pool_bytes" in snap
+        summ = eng.summary()
+        assert summ["kv_blocks_in_use"] == 0          # all released
+        assert summ["kv_pool_frac_peak"] > 0
+        assert summ["kv_hot_prefix_blocks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# trainer AOT warmup capture
+# ---------------------------------------------------------------------------
+
+
+class _ProbeDataset:
+    num_examples = 64
+
+    def examples(self, lo, hi):
+        rng = np.random.default_rng(0)
+        n = hi - lo
+        return (rng.random((n, 784)).astype(np.float32),
+                np.eye(10, dtype=np.float32)[np.arange(n) % 10])
+
+
+class TestTrainerAotCard:
+    def test_aot_warmup_records_train_step_card(self, mesh8, tmp_path):
+        from dtf_tpu import optim
+        from dtf_tpu.cluster import Cluster, ClusterConfig
+        from dtf_tpu.config import TrainConfig
+        from dtf_tpu.models.mlp import MnistMLP
+        from dtf_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(batch_size=64, learning_rate=0.05, epochs=1,
+                          seed=1, logdir=str(tmp_path))
+        trainer = Trainer(Cluster(config=ClusterConfig(), mesh=mesh8),
+                          MnistMLP(init_scale="fan_in"), optim.sgd(0.05),
+                          cfg)
+        trainer._aot_warmup(_ProbeDataset(), 64)
+        assert trainer._compiled_step is not None
+        cards = [c for c in costobs.get_observatory().cards()
+                 if c.site == "train/step"]
+        assert len(cards) == 1
+        assert cards[0].geometry == ("aot", 64)
+        # the CPU backend reports analysis: real numbers, classified
+        assert cards[0].flops and cards[0].flops > 0
+        assert cards[0].bound in ("compute", "memory")
+
+
+# ---------------------------------------------------------------------------
+# telemetry.json + gates + /memz endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestSyncPointAndGates:
+    def _run_and_write(self, tmp_path):
+        import time
+        inst = costobs.instrument(jax.jit(lambda x: x @ x),
+                                  "bench/matmul", (32,))
+        # keep the result alive: hbm/live_bytes measures live_arrays()
+        self._keep = inst(jnp.ones((32, 32), jnp.float32))
+        # the implied --check wants goodput ~ wall: start the tracker
+        # clock, then one measured block that IS ~all of the wall time
+        tel.get_tracker().add("other", 0.0)
+        with tel.get_tracker().measure("productive"):
+            time.sleep(0.3)
+        tel.write_telemetry_json(str(tmp_path))
+        return str(tmp_path)
+
+    def test_telemetry_json_carries_cost_section_and_cards(self, tmp_path):
+        logdir = self._run_and_write(tmp_path)
+        doc = json.load(open(os.path.join(logdir, "telemetry.json")))
+        assert doc["cost"]["compiles"] == 1
+        assert doc["cost"]["roofline"]["synthetic"] is True
+        assert "bench/matmul" in doc["cost"]["sites"]
+        assert os.path.exists(os.path.join(logdir,
+                                           costobs.COSTCARDS_FILE))
+        assert doc["metrics"]["hbm/frac"]["value"] > 0
+
+    def test_gates_pass_sane_fail_absurd_fail_absent(self, tmp_path):
+        from dtf_tpu.telemetry.report import build_report, check_gates
+        logdir = self._run_and_write(tmp_path)
+        report = build_report(logdir)
+        ok, lines = check_gates(report, max_hbm_frac=0.9,
+                                max_compiles=100)
+        assert ok, lines
+        ok, lines = check_gates(report, max_hbm_frac=1e-9)
+        assert not ok
+        ok, lines = check_gates(report, max_compiles=0)
+        assert not ok
+        # absence is a failure, not a pass
+        os.makedirs(str(tmp_path / "nothing_here_"), exist_ok=True)
+        empty = build_report(str(tmp_path / "nothing_here_"))
+        ok, lines = check_gates(empty, max_hbm_frac=0.9)
+        assert not ok and any("not measured" in ln for ln in lines)
+
+    def test_report_cli_gate_exit_codes(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report as report_cli
+        logdir = self._run_and_write(tmp_path)
+        assert report_cli.main([logdir, "--max_hbm_frac", "0.9",
+                                "--max_compiles", "100"]) == 0
+        assert report_cli.main([logdir, "--max_hbm_frac",
+                                "0.000000001"]) == 1
+        capsys.readouterr()
+
+    def test_memz_endpoint_serves_consistent_cut(self, tmp_path):
+        import urllib.request
+
+        from dtf_tpu.telemetry.live import AdminServer
+        self._run_and_write(tmp_path)
+        admin = AdminServer(0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin.port}/memz",
+                    timeout=5) as r:
+                doc = json.loads(r.read())
+            assert doc["cards"][0]["site"] == "bench/matmul"
+            assert "cost/compiles_total" in doc["metrics"]
+            assert doc["summary"]["compiles"] == 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin.port}/", timeout=5) as r:
+                root = json.loads(r.read())
+            assert "/memz" in root["endpoints"]
+        finally:
+            admin.close()
+
+
+# ---------------------------------------------------------------------------
+# the explainer
+# ---------------------------------------------------------------------------
+
+
+def _write_run(tmp_path, name, cards, goodput=None):
+    d = tmp_path / name
+    d.mkdir()
+    with open(d / costobs.COSTCARDS_FILE, "w") as f:
+        for c in cards:
+            f.write(json.dumps(c.to_doc(), sort_keys=True) + "\n")
+    with open(d / "telemetry.json", "w") as f:
+        json.dump({"goodput": goodput or {}, "metrics": {}}, f)
+    return str(d)
+
+
+def _card(site, geometry, bytes_t, flops_t, compiles=1):
+    return CostCard(site=site, geometry=geometry,
+                    bytes_total=bytes_t, flops_total=flops_t,
+                    bytes_accessed=bytes_t, flops=flops_t,
+                    n_compiles=compiles)
+
+
+class TestExplain:
+    def test_bytes_growth_ranks_first(self, tmp_path):
+        a = _write_run(tmp_path, "a", [
+            _card("serve/decode", (3, 4), 100.0, 100.0),
+            _card("serve/prefill", (16,), 50.0, 60.0)],
+            goodput={"productive_s": 1.0, "wall_s": 2.0})
+        # B: decode context doubled — the wider bucket is a NEW geometry
+        # whose bytes dominate the growth; prefill unchanged
+        b = _write_run(tmp_path, "b", [
+            _card("serve/decode", (3, 4), 100.0, 100.0),
+            _card("serve/decode", (3, 8), 220.0, 105.0, compiles=2),
+            _card("serve/prefill", (16,), 50.0, 60.0)],
+            goodput={"productive_s": 2.0, "wall_s": 3.0})
+        doc = costobs.explain(a, b)
+        assert doc["ranked"][0]["site"] == "serve/decode"
+        assert doc["ranked"][0]["verdict"] == "memory-bound growth"
+        assert doc["ranked"][0]["compiles_b"] == 3
+        # the flat site ranks below
+        sites = [r["site"] for r in doc["ranked"]]
+        assert sites.index("serve/decode") < sites.index("serve/prefill")
+        # the new geometry shows as the top card, flagged NEW
+        top_card = doc["cards"][0]
+        assert top_card["site"] == "serve/decode"
+        assert top_card["geometry"] == [3, 8] and not top_card["in_a"]
+        lines = costobs.render_explain(doc)
+        assert any("serve/decode" in ln and "memory-bound" in ln
+                   for ln in lines)
+        # phase deltas ride along
+        assert doc["phases"]["productive_s"]["delta"] == pytest.approx(1.0)
+
+    def test_site_rollup_verdicts(self):
+        a = [_card("s", (1,), 100.0, 100.0)]
+        flopsy = [_card("s", (1,), 102.0, 300.0)]
+        assert diff_sites(a, flopsy)[0]["verdict"] == "compute-bound growth"
+        flat = [_card("s", (1,), 101.0, 101.0)]
+        assert diff_sites(a, flat)[0]["verdict"] == "flat"
+
+    def test_compute_bound_regression_ranks_first(self):
+        """Flat bytes + doubled flops must still outrank byte jitter —
+        the ranking carries a flops term, not bytes alone."""
+        a = [_card("decode", (1,), 100.0, 100.0),
+             _card("prefill", (2,), 100.0, 100.0)]
+        b = [_card("decode", (1,), 100.0, 300.0),     # flops tripled
+             _card("prefill", (2,), 101.0, 100.0)]    # byte jitter
+        ranked = diff_sites(a, b)
+        assert ranked[0]["site"] == "decode"
+        assert ranked[0]["verdict"] == "compute-bound growth"
+
+    def test_json_doc_has_no_infinity(self, tmp_path):
+        """A measured-zero base must not leak RFC-invalid Infinity into
+        the --json document (zero-base ratios degrade to None)."""
+        a = _write_run(tmp_path, "za", [_card("s", (1,), 0.0, 1.0)])
+        b = _write_run(tmp_path, "zb", [_card("s", (1,), 50.0, 1.0)])
+        doc = costobs.explain(a, b)
+        text = json.dumps(doc)
+        assert "Infinity" not in text
+        assert doc["ranked"][0]["bytes_frac"] is None
+
+    def test_missing_cards_is_loud(self, tmp_path):
+        a = _write_run(tmp_path, "a", [_card("s", (1,), 1.0, 1.0)])
+        empty = tmp_path / "b"
+        empty.mkdir()
+        with pytest.raises(FileNotFoundError):
+            costobs.explain(a, str(empty))
+
+    def test_explain_cli(self, tmp_path, capsys):
+        from dtf_tpu.telemetry import report as report_cli
+        a = _write_run(tmp_path, "a", [_card("serve/decode", (3, 4),
+                                             100.0, 100.0)])
+        b = _write_run(tmp_path, "b", [_card("serve/decode", (3, 4),
+                                             300.0, 110.0)])
+        assert report_cli.main(["--explain", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "Ranked attribution" in out and "serve/decode" in out
+        # missing cards -> exit 1 (absence loud)
+        empty = tmp_path / "c"
+        empty.mkdir()
+        assert report_cli.main(["--explain", a, str(empty)]) == 1
+        # a second logdir without --explain is a usage error
+        assert report_cli.main([a, b]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# ledger fold (satellite: optional columns, old rows untouched)
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerCostColumns:
+    def _mod(self):
+        import importlib
+        import sys
+        scripts = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        return importlib.import_module("bench_ledger")
+
+    def test_decode_row_folds_new_columns_only_when_present(self, tmp_path):
+        bl = self._mod()
+        new = {"tok_s_aggregate": 100.0, "rig": "decode_tiny_paged",
+               "per_token_us": 10.0, "peak_hbm_bytes": 1.5e8,
+               "n_compiles": 7}
+        old = {"tok_s_aggregate": 100.0, "rig": "decode_tiny_paged",
+               "per_token_us": 10.0}
+        pn, po = tmp_path / "DECODE_r01.json", tmp_path / "DECODE_r02.json"
+        json.dump(new, open(pn, "w"))
+        json.dump(old, open(po, "w"))
+        rn = bl.decode_row(str(pn), str(tmp_path))
+        ro = bl.decode_row(str(po), str(tmp_path))
+        assert rn["peak_hbm_bytes"] == 1.5e8 and rn["n_compiles"] == 7
+        # pre-observatory docs fold WITHOUT the keys — committed
+        # LEDGER.jsonl rows stay byte-stable
+        assert "peak_hbm_bytes" not in ro and "n_compiles" not in ro
+
+    def test_regression_names_the_quantity(self):
+        bl = self._mod()
+
+        def row(n, toks, hbm, compiles):
+            return {"run": f"DECODE_r{n:02d}", "kind": "decode", "n": n,
+                    "rig": "decode_tiny_paged", "ok": True, "error": None,
+                    "tok_s_aggregate": toks, "peak_hbm_bytes": hbm,
+                    "n_compiles": compiles}
+
+        ok, lines = bl.check_ledger([row(1, 200.0, 1e8, 6),
+                                     row(2, 100.0, 3e8, 18)])
+        assert not ok
+        named = [ln for ln in lines if "regressed quantity" in ln]
+        assert named, lines
+        assert "tok_s_aggregate" in named[0]
+        assert "peak_hbm" in named[0] and "compiles" in named[0]
+
+    def test_zero_valued_columns_still_diagnose(self):
+        """A measured ZERO (0 compiles — everything cache-served) is
+        exactly the reading whose jump is the diagnosis; truthiness
+        must not drop it from the regressed-quantity line."""
+        bl = self._mod()
+
+        def row(n, toks, compiles):
+            return {"run": f"DECODE_r{n:02d}", "kind": "decode", "n": n,
+                    "rig": "r", "ok": True, "error": None,
+                    "tok_s_aggregate": toks, "n_compiles": compiles}
+
+        ok, lines = bl.check_ledger([row(1, 200.0, 0), row(2, 100.0, 40)])
+        assert not ok
+        named = [ln for ln in lines if "regressed quantity" in ln]
+        assert named and "compiles 0 -> 40" in named[0], named
+
+    def test_old_rows_without_columns_still_gate(self):
+        bl = self._mod()
+        rows = [{"run": "DECODE_r01", "kind": "decode", "n": 1,
+                 "rig": "r", "ok": True, "error": None,
+                 "tok_s_aggregate": 200.0},
+                {"run": "DECODE_r02", "kind": "decode", "n": 2,
+                 "rig": "r", "ok": True, "error": None,
+                 "tok_s_aggregate": 100.0}]
+        ok, lines = bl.check_ledger(rows)
+        assert not ok
+        named = [ln for ln in lines if "regressed quantity" in ln]
+        assert named and "tok_s_aggregate" in named[0]
+        assert "peak_hbm" not in named[0]      # columns absent: not faked
