@@ -46,6 +46,13 @@ import numpy as np
 #: static baseline's goodput QPS at the same p99 TTFT budget.
 AB_MIN_RATIO = 1.5
 
+#: Fleet bar (ISSUE 16): an N-replica fleet must deliver at least this
+#: multiple of the single-replica goodput QPS on the SAME trace at the
+#: pinned operating point (offered load past single-replica capacity,
+#: so the single arm's queue waits blow the TTFT SLO while the fleet's
+#: N-way concurrency holds it).
+FLEET_AB_MIN_RATIO = 1.6
+
 
 def poisson_trace(*, seed: int, n_requests: int, qps: float,
                   prompt_lens: List[int], output_lens: List[int],
@@ -333,6 +340,179 @@ def spec_ab(model, params, ns) -> Dict:
             "gates": lines, "ok": ok}
 
 
+def fleet_gates(fleet: Dict, single: Dict, identical: Dict,
+                totals: Dict, chaos_armed: bool) -> Tuple[bool, List[str]]:
+    """The fleet A/B acceptance gates (ISSUE 16):
+
+    * **zero lost** — every offered request reached a terminal in the
+      fleet arm (a killed replica's accepted work fails over, it does
+      not vanish);
+    * **token identity** — every fleet completion is bitwise identical
+      to the uninterrupted single-engine reference (failover replay and
+      hedging may move a request between replicas, never change its
+      tokens);
+    * **goodput ratio** (chaos arms) — fleet goodput QPS >=
+      {FLEET_AB_MIN_RATIO}x single-replica UNDER THE SAME FAULT.  Both
+      arms eat the identical ``replica_down`` plan on the identical
+      trace; the single arm's only replica IS the target, so its
+      goodput collapses to the pre-kill completions while the fleet
+      fails over and keeps serving — the survival margin is the
+      product's value, measured, not a parallel-speedup claim (on a
+      1-core rig N in-process replicas share one driver thread and
+      cannot beat one replica on raw throughput);
+    * **fleet completes all** + **failover exercised** (chaos arms) —
+      the fleet arm completes every offered request even though the
+      plan killed a replica, and at least one in-flight request was
+      replayed on a survivor.
+    """
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, passed, detail):
+        nonlocal ok
+        ok = ok and passed
+        lines.append(f"  gate {name:<22} "
+                     f"{'PASS' if passed else 'FAIL'}  {detail}")
+
+    lost = fleet.get("lost", 0)
+    gate("fleet_zero_lost", lost == 0,
+         f"{lost} lost of {fleet.get('offered', 0)} offered "
+         f"(statuses {fleet.get('statuses')})")
+    gate("fleet_token_identity", identical["ok"],
+         f"{identical['compared']} compared, "
+         f"diverged {identical['diverged'][:4]}, "
+         f"missing_ref {identical['missing_ref'][:4]}")
+    if chaos_armed:
+        done, offered = fleet.get("completed", 0), fleet.get("offered", 0)
+        gate("fleet_completes_all", done == offered,
+             f"{done}/{offered} completed through the fault "
+             f"(statuses {fleet.get('statuses')})")
+        fg = fleet.get("goodput_qps", 0.0)
+        sg = single.get("goodput_qps", 0.0)
+        ratio = None if sg <= 0 else fg / sg
+        gate("fleet_goodput_ab",
+             (fg > 0 if ratio is None else ratio >= FLEET_AB_MIN_RATIO),
+             f"fleet {fg:.2f} qps vs single {sg:.2f} qps under the "
+             f"same fault (ratio "
+             + ("inf" if ratio is None else f"{ratio:.2f}")
+             + f", bar {FLEET_AB_MIN_RATIO})")
+        gate("fleet_failover",
+             totals.get("failovers", 0) >= 1
+             and totals.get("replayed", 0) >= 1,
+             f"failovers {totals.get('failovers', 0)}, replayed "
+             f"{totals.get('replayed', 0)} (chaos arm must exercise "
+             f"the replay path)")
+    return ok, lines
+
+
+def fleet_ab(model, params, ns) -> Dict:
+    """Same-trace fleet-vs-single A/B over real sockets (--replicas N).
+
+    Three arms, one seeded trace at the FIRST --qps point:
+
+    * **reference** — one uninterrupted engine on the virtual clock:
+      the token ground truth (temperature 0, so tokens depend only on
+      the prompt — rid assignment order cannot perturb them);
+    * **single** — a 1-replica fleet (same acceptor, same sockets, same
+      measurement path — the honest baseline);
+    * **fleet** — N replicas.
+
+    With ``--chaos replica_down@S:P``, BOTH measured arms eat the same
+    plan (the single arm's target clamps to its only replica): the A/B
+    is survival under the identical fault, which is the fleet's actual
+    value on any rig — not a parallel-speedup claim.
+
+    Chaos arms AFTER the warmup barrage, so ``@S`` counts measured
+    dispatches.  Both measured arms warm every replica's compile cache
+    first (n_replicas x slots tiny requests) — on the wall clock a
+    first-step XLA compile would otherwise dominate every TTFT."""
+    from dtf_tpu.serve import ServingEngine, VirtualClock
+    from dtf_tpu.serve.fleet import (FleetConfig, build_local_fleet,
+                                     client_summary, drive_trace)
+
+    qps = ns.qps_list[0]
+    trace = poisson_trace(
+        seed=ns.seed, n_requests=ns.requests, qps=qps,
+        prompt_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
+        vocab_size=_trace_vocab(model, ns), temperature=0.0,
+        priorities=ns.priorities_list)
+    ekw = dict(num_slots=ns.slots, block_size=ns.block_size,
+               num_blocks=ns.pool_blocks, max_queue=ns.max_queue)
+
+    ref_eng = ServingEngine(model, params, seed=ns.seed,
+                            clock=VirtualClock(), **ekw)
+    ref_eng.run(trace)
+    ref = {rid: list(r.tokens or [])
+           for rid, r in ref_eng.results.items()
+           if r.status == "completed"}
+
+    def run_arm(n: int, chaos_spec: Optional[str]):
+        cfg = FleetConfig(stream_timeout_s=10.0, beat_stale_s=3.0,
+                          monitor_interval_s=0.1, connect_timeout_s=2.0)
+        acc = build_local_fleet(model, params, n, seed=ns.seed,
+                                config=cfg, engine_kwargs=ekw).start()
+        try:
+            warm = [(0.0, {"prompt": np.arange(1, 4, dtype=np.int32),
+                           "max_new_tokens": 2, "temperature": 0.0})
+                    for _ in range(n * ns.slots)]
+            drive_trace(acc.address, warm, request_timeout_s=120.0)
+            if chaos_spec:
+                from dtf_tpu.resilience.chaos import (_FLEET_KINDS,
+                                                      FaultPlan)
+                plan = FaultPlan.parse(chaos_spec, process_index=0)
+                for f in plan.faults:
+                    # the single arm has one failure domain: a fleet
+                    # fault aimed at replica P >= n hits replica 0 (the
+                    # same fault, the only possible target)
+                    if f.kind in _FLEET_KINDS and (f.process or 0) >= n:
+                        f.process = 0
+                acc.arm_chaos(plan)
+            res = drive_trace(acc.address, trace, request_timeout_s=120.0)
+            summ = client_summary(res, slo_ttft_ms=ns.slo_ttft_ms)
+            return res, summ, acc.totals()
+        finally:
+            acc.shutdown()
+
+    fleet_res, fleet_sum, fleet_tot = run_arm(ns.replicas, ns.chaos)
+    single_res, single_sum, single_tot = run_arm(1, ns.chaos)
+
+    # Identity of every fleet COMPLETION vs the reference (poisson_trace
+    # rids are the trace indices, so res[i] pairs with ref[i]).
+    diverged, missing_ref, compared = [], [], 0
+    for i, rec in sorted(fleet_res.items()):
+        if rec["status"] != "completed":
+            continue
+        if i not in ref:
+            missing_ref.append(i)
+            continue
+        compared += 1
+        if rec["tokens"] != ref[i]:
+            diverged.append(i)
+    identical = {"ok": not diverged and not missing_ref,
+                 "compared": compared, "diverged": diverged,
+                 "missing_ref": missing_ref}
+
+    ok, lines = fleet_gates(fleet_sum, single_sum, identical, fleet_tot,
+                            chaos_armed=bool(ns.chaos))
+    for arm, s in (("fleet", fleet_sum), ("single", single_sum)):
+        print(f"  [{arm:>7}] completed {s.get('completed', 0):3d}/"
+              f"{s.get('offered', 0):3d}  lost {s.get('lost', 0):2d}  "
+              f"ttft p50/p99 {s.get('ttft_ms_p50', float('nan')):7.1f}/"
+              f"{s.get('ttft_ms_p99', float('nan')):7.1f} ms  "
+              f"goodput {s.get('goodput_qps', 0.0):6.2f} qps", flush=True)
+    print(f"  [  fleet] failovers {fleet_tot.get('failovers', 0)}  "
+          f"replayed {fleet_tot.get('replayed', 0)}  "
+          f"hedged {fleet_tot.get('hedged', 0)}", flush=True)
+    return {"replicas": ns.replicas, "offered_qps": qps,
+            "chaos": ns.chaos, "slo_ttft_ms": ns.slo_ttft_ms,
+            "fleet": fleet_sum, "single": single_sum,
+            "fleet_totals": fleet_tot, "single_totals": single_tot,
+            "token_identity": identical["ok"],
+            "token_identity_detail": identical,
+            "min_ratio": FLEET_AB_MIN_RATIO,
+            "gates": lines, "ok": ok}
+
+
 def chaos_ab(model, params, ns) -> Dict:
     """Same-trace controller-on/off A/B under the injected spike."""
     on = run_chaos_point(model, params, controller=True, ns=ns)
@@ -447,6 +627,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "point (fixed-rate mode); --check gates token "
                         "identity + strict p99 TPOT improvement + "
                         "acceptance > 0")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="fleet A/B (serve/fleet.py): N replicas vs a "
+                        "single replica on the SAME trace over real "
+                        "sockets at the FIRST --qps point; --chaos "
+                        "takes the fleet kinds (replica_down@S:P, "
+                        "replica_wedge@S:DURms, conn_flake@S:P, keyed "
+                        "on measured dispatch sequence); --check gates "
+                        "zero lost + token identity vs an uninterrupted "
+                        f"reference + goodput >= {FLEET_AB_MIN_RATIO}x "
+                        "single-replica")
     p.add_argument("--trace_vocab", type=int, default=None,
                    help="cap the trace's prompt token alphabet (small "
                         "alphabets give the n-gram drafter material)")
@@ -471,7 +661,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ns.prompt_lens_list = [int(x) for x in ns.prompt_lens.split(",")]
     ns.output_lens_list = [int(x) for x in ns.output_lens.split(",")]
     ns.priorities_list = [int(x) for x in ns.priorities.split(",")]
-    if ns.chaos and ns.mode != "continuous":
+    if ns.replicas is not None:
+        if ns.replicas < 2:
+            p.error("--replicas needs N >= 2 (the single arm is built "
+                    "in as the baseline)")
+        if ns.spec_ab:
+            p.error("--replicas and --spec_ab are separate A/Bs; run "
+                    "them as separate invocations")
+        if ns.temperature != 0.0:
+            p.error("--replicas gates token identity across replicas; "
+                    "that needs greedy decoding (--temperature 0)")
+        if ns.clock != "wall":
+            # fleet arms serve real sockets; force the wall clock the
+            # same way --listen does
+            ns.clock = "wall"
+    if ns.chaos and ns.replicas is None and ns.mode != "continuous":
         p.error("--chaos is the overload/brownout gate; it runs the "
                 "continuous engine (--mode continuous)")
     if ns.spec_ab and ns.spec_k < 1:
@@ -479,10 +683,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.spec_ab and ns.chaos:
         p.error("--spec_ab and --chaos are separate A/Bs; run them "
                 "as separate invocations")
-    if ns.check and not ns.chaos and not ns.spec_ab and ns.mode != "both":
+    if (ns.check and not ns.chaos and not ns.spec_ab
+            and ns.replicas is None and ns.mode != "both"):
         p.error("--check needs --mode both (it asserts the A/B ratio), "
-                "--chaos (the overload gates), or --spec_ab (the "
-                "speculative-decoding gates)")
+                "--chaos (the overload gates), --spec_ab (the "
+                "speculative-decoding gates), or --replicas (the "
+                "fleet gates)")
 
     import jax
 
@@ -496,6 +702,21 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"slo_ttft_ms={ns.slo_ttft_ms}"
           + (f" chaos={ns.chaos}" if ns.chaos else "")
           + (f" spec_k={ns.spec_k}" if ns.spec_k else ""), flush=True)
+    if ns.replicas is not None:
+        result = fleet_ab(model, params, ns)
+        for line in result["gates"]:
+            print(line, flush=True)
+        if ns.json:
+            with open(ns.json, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {ns.json}")
+        if ns.check:
+            if not result["ok"]:
+                print("CHECK FAILED: fleet gates (see above)",
+                      file=sys.stderr)
+                return 1
+            print("CHECK OK")
+        return 0
     if ns.spec_ab:
         result = spec_ab(model, params, ns)
         for line in result["gates"]:

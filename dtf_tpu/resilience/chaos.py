@@ -54,6 +54,26 @@ run.  Spec grammar (comma-separated)::
                          corruption); the engine must detect the
                          non-finite logits, evict ONLY the victim, and
                          keep serving the rest
+    replica_down@S[:P]   serving fleet: at acceptor dispatch sequence S
+                         replica P (default 0) dies ABRUPTLY — SIGKILL
+                         semantics: open connections sever, beats stop,
+                         no drain, no goodbye.  The acceptor must detach
+                         it and replay its accepted-but-unfinished
+                         requests token-identically on a survivor.
+                         One-shot only: a dead replica cannot die twice.
+    replica_wedge@S:DURms[:P]  serving fleet: replica P (default 0)
+                         stops draining its frontend mailbox for DUR —
+                         the process is alive (its sockets still accept)
+                         but the engine never steps and beats go stale;
+                         detection must come from missed beats or the
+                         response-stream timeout, not a clean conn
+                         error.  '@every:K:DUR[:P]' = recurring GC-pause
+                         flavor.
+    conn_flake@S:P       serving fleet: at dispatch sequence S the
+                         acceptor<->replica-P sockets are severed
+                         mid-flight (transient network flake); in-flight
+                         legs must retry/fail over and the replica stays
+                         in rotation.  '@every:K:P' = flaky link.
     KIND@every:N[...]    repeating variant: fire at steps N, 2N, 3N, ...
                          instead of once (nan_grad/loader_error/stall
                          only), e.g. 'stall@every:50:1s'
@@ -61,7 +81,12 @@ run.  Spec grammar (comma-separated)::
 
 Serving kinds (``slow_decode``/``client_drop``/``kv_poison``) are keyed
 on the ENGINE ITERATION, not the optimizer step — the serving engine
-calls their ``maybe_*`` hooks from its iteration loop.
+calls their ``maybe_*`` hooks from its iteration loop.  Fleet kinds
+(``replica_down``/``replica_wedge``/``conn_flake``) are keyed on the
+ACCEPTOR'S DISPATCH SEQUENCE (accepted-request count) and their ``:P``
+names the TARGET REPLICA, not a host to fire on — the acceptor process
+owns the plan and performs the side effect on replica P, so the
+host-match filter does not apply to them.
 
 One-shot faults fire once; ``@every`` faults fire on every multiple of
 their period.  A plan is shared state: an in-process supervisor must pass
@@ -83,7 +108,7 @@ import re
 import signal
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -91,7 +116,12 @@ log = logging.getLogger("dtf_tpu")
 
 _KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "preempt",
           "ckpt_stall", "corrupt_ckpt", "host_down", "slow_host",
-          "partition", "slow_decode", "client_drop", "kv_poison")
+          "partition", "slow_decode", "client_drop", "kv_poison",
+          "replica_down", "replica_wedge", "conn_flake")
+# Fleet kinds: ``process`` is the TARGET replica index (the acceptor
+# fires the side effect FOR it), not a host filter — _take must not
+# compare it against this process's own index.
+_FLEET_KINDS = ("replica_down", "replica_wedge", "conn_flake")
 # Kinds whose semantics survive refiring (a host_down process is gone;
 # corruption of the same step proves nothing twice).  preempt refires
 # safely BECAUSE each firing ends in a clean checkpoint + supervisor
@@ -100,8 +130,13 @@ _KINDS = ("nan_grad", "loader_error", "stall", "sigterm", "preempt",
 # slow_decode is a recurring latency hiccup, a periodic client_drop is
 # flappy clients — both meaningful on every firing; kv_poison stays
 # one-shot (corrupting the same pool twice proves nothing twice).
+# Fleet: a periodic replica_wedge is a recurring GC pause and a periodic
+# conn_flake is a flaky link — both survive refiring; replica_down is
+# one-shot for the same reason host_down is (a dead replica is gone, and
+# refiring would silently no-op against an already-detached target).
 _PERIODIC_OK = ("nan_grad", "loader_error", "stall", "preempt",
-                "ckpt_stall", "slow_decode", "client_drop")
+                "ckpt_stall", "slow_decode", "client_drop",
+                "replica_wedge", "conn_flake")
 
 _DUR_RE = re.compile(r"^([0-9]+(?:\.[0-9]+)?)(ms|s)?$")
 
@@ -157,6 +192,14 @@ class Fault:
             extra = f":{self.duration_s * 1e3:g}ms"
             if self.count is not None:
                 extra += f":{self.count}"
+        elif self.kind == "replica_down" and self.process is not None:
+            extra = f":{self.process}"
+        elif self.kind == "replica_wedge":
+            extra = f":{self.duration_s * 1e3:g}ms"
+            if self.process is not None:
+                extra += f":{self.process}"
+        elif self.kind == "conn_flake":
+            extra = f":{self.process}"
         return f"{self.kind}@{at}{extra}"
 
 
@@ -283,6 +326,36 @@ class FaultPlan:
                     raise ValueError(f"partition takes an optional process, "
                                      f"e.g. 'partition@30:1'; got {entry!r}")
                 process = int(args[0]) if args and args[0] else None
+            elif kind == "replica_down":
+                if len(args) > 1 or (args and args[0]
+                                     and not args[0].isdigit()):
+                    raise ValueError(
+                        f"replica_down takes an optional target replica, "
+                        f"e.g. 'replica_down@12:1'; got {entry!r}")
+                process = int(args[0]) if args and args[0] else None
+            elif kind == "replica_wedge":
+                if not args or not args[0]:
+                    raise ValueError(
+                        f"replica_wedge needs a wedge duration, e.g. "
+                        f"'replica_wedge@12:800ms' or "
+                        f"'replica_wedge@12:800ms:1' (target replica 1); "
+                        f"got {entry!r}")
+                duration_s = _parse_duration(args[0], "ms", entry)
+                if len(args) == 2:
+                    if not args[1].isdigit():
+                        raise ValueError(
+                            f"replica_wedge target must be a replica "
+                            f"index; got {entry!r}")
+                    process = int(args[1])
+                elif len(args) > 2:
+                    raise ValueError(f"replica_wedge takes "
+                                     f"duration[:replica]; got {entry!r}")
+            elif kind == "conn_flake":
+                if len(args) != 1 or not args[0].isdigit():
+                    raise ValueError(
+                        f"conn_flake needs the target replica, e.g. "
+                        f"'conn_flake@8:1'; got {entry!r}")
+                process = int(args[0])
             elif args and args[0]:
                 raise ValueError(f"{kind} takes no extra arguments; "
                                  f"got {entry!r}")
@@ -319,7 +392,8 @@ class FaultPlan:
         for f in self.faults:
             if f.kind != kind:
                 continue
-            if f.process is not None and self._pid() != f.process:
+            if (f.process is not None and f.kind not in _FLEET_KINDS
+                    and self._pid() != f.process):
                 continue
             if f.period is not None:
                 if (step is not None and step > 0 and step % f.period == 0
@@ -463,6 +537,29 @@ class FaultPlan:
         NaN-scribbles its oldest active request's pool blocks and must
         then detect + evict exactly that victim."""
         return self._take("kv_poison", iteration) is not None
+
+    # -- fleet hooks (the ACCEPTOR calls these per accepted request) --------
+
+    def maybe_replica_down(self, seq: int) -> Optional[int]:
+        """``replica_down@S[:P]``: at dispatch sequence S, returns the
+        replica index to kill ABRUPTLY (SIGKILL semantics: sever its
+        sockets, stop its stepping, no drain).  None = no fire."""
+        f = self._take("replica_down", seq)
+        return None if f is None else (f.process or 0)
+
+    def maybe_replica_wedge(self, seq: int) -> Optional[Tuple[int, float]]:
+        """``replica_wedge@S:DURms[:P]``: returns ``(replica, seconds)``
+        — the target stops draining its mailbox (and stepping, so beats
+        go stale) for that long.  None = no fire."""
+        f = self._take("replica_wedge", seq)
+        return None if f is None else ((f.process or 0), f.duration_s)
+
+    def maybe_conn_flake(self, seq: int) -> Optional[int]:
+        """``conn_flake@S:P``: returns the replica whose acceptor-side
+        sockets must be severed mid-flight (the replica itself stays
+        healthy).  None = no fire."""
+        f = self._take("conn_flake", seq)
+        return None if f is None else (f.process or 0)
 
     def maybe_corrupt_after_save(self, step: int, ckpt) -> None:
         """corrupt_ckpt@S: wait for the step-S save to land, then scribble

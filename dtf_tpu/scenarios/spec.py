@@ -350,6 +350,31 @@ def default_matrix() -> List[ScenarioSpec]:
                       min_goodput_qps=3.5, max_ttft_p99_ms=1200.0,
                       min_trace_complete_frac=0.99)),
         ScenarioSpec(
+            # fleet failure-domain cell (ISSUE 16): a 3-replica serving
+            # fleet behind the acceptor, replica 1 SIGKILL'd (in-process
+            # kill) at measured dispatch 8 — mid-trace, with streams in
+            # flight — and the triple gate is judged ACROSS the
+            # failover: goodput-QPS floor, p99-TTFT ceiling (wall
+            # clock: the fleet needs live sockets + stream timeouts, so
+            # both sit loose vs. measured), and >= 99% gap-free
+            # admission->completion trace chains — a failed-over
+            # request's chain spans BOTH replicas stitched by trace_id,
+            # with the survivor's submit span marked resubmit=true.
+            # Offered qps sits AT the rig's fleet service rate (~6/s) —
+            # the overload regime is serve_overload_brownout's job;
+            # this cell isolates the failover cost.  measured (1-core
+            # rig, 2 runs): 36/36 completed, 0 lost, 1-4 failovers all
+            # replayed token-identically, goodput 2.9-3.3 qps, ttft
+            # p99 3.9-4.8 s, trace_complete_frac 1.0, books 0.04-0.05.
+            name="serve_fleet_replica_down", workload="serve",
+            devices=1, chaos="replica_down@8:1", max_restarts=0,
+            timeout_s=600.0,
+            extra=(("qps", 6.0), ("replicas", 3), ("requests", 36),
+                   ("slo_ttft_ms", 2000.0), ("slots", 2)),
+            gate=Gate(max_final_cost=None, min_goodput=0.003,
+                      min_goodput_qps=1.8, max_ttft_p99_ms=9000.0,
+                      min_trace_complete_frac=0.99)),
+        ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike
             # to prove the guard composes with the sharded update.

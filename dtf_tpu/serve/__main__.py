@@ -91,16 +91,25 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
         priorities=[int(x) for x in ns.priorities.split(",")])
 
 
-def _write_drain_file(engine, logdir: str) -> Optional[str]:
+def _write_drain_file(engine, logdir: str,
+                      replica_index: Optional[int] = None) -> Optional[str]:
     """Checkpoint a drain's unfinished requests as a --requests-
     compatible JSONL replay file (arrival 0: they are due NOW).  An
     attempt that finished WITHOUT leaving unfinished work removes any
     previous attempt's file instead — after a successful supervisor
     replay, a stale drain.jsonl would tell the operator to re-serve
-    requests that already completed."""
+    requests that already completed.
+
+    Fleet replicas namespace their checkpoint (``drain.r<k>.jsonl``):
+    rids are per-engine, so two standalone replicas' drain files can
+    collide — the per-replica name keeps the namespaces apart and
+    ``serve.fleet.merge_drain_docs`` refuses a colliding merge (an
+    acceptor-run fleet never collides: rids are fleet-minted)."""
     if not logdir:
         return None
-    path = os.path.join(logdir, "drain.jsonl")
+    name = ("drain.jsonl" if replica_index is None
+            else f"drain.r{replica_index}.jsonl")
+    path = os.path.join(logdir, name)
     if not engine.drained or not engine.drain_docs:
         if os.path.exists(path):
             os.remove(path)
@@ -268,7 +277,7 @@ def serve_session(ns, model, params, trace,
                 os.makedirs(ns.logdir, exist_ok=True)
                 engine.write_telemetry(ns.logdir,
                                        slo_ttft_ms=ns.slo_ttft_ms)
-                _write_drain_file(engine, ns.logdir)
+                _write_drain_file(engine, ns.logdir, ns.replica_index)
         return engine
 
     def drained_needs_restart(engine) -> bool:
@@ -296,7 +305,15 @@ def serve_listen(ns, model, params,
     if ns.chaos:
         from dtf_tpu.resilience.chaos import FaultPlan
         chaos = FaultPlan.parse(ns.chaos, process_index=0)
-    engine = _make_engine(ns, model, params, WallClock(), None, None,
+    heartbeat = None
+    if ns.health_dir:
+        # A fleet replica beats under ITS index so the acceptor's
+        # missed-beat detector can tell replicas apart.
+        from dtf_tpu.resilience.health import FileHeartbeatTransport
+        transport = FileHeartbeatTransport(ns.health_dir,
+                                           ns.replica_index or 0)
+        heartbeat = transport.beat
+    engine = _make_engine(ns, model, params, WallClock(), None, heartbeat,
                           chaos)
     if drain_target is not None:
         drain_target["engine"] = engine
@@ -314,7 +331,7 @@ def serve_listen(ns, model, params,
     if ns.logdir:
         os.makedirs(ns.logdir, exist_ok=True)
         engine.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
-        path = _write_drain_file(engine, ns.logdir)
+        path = _write_drain_file(engine, ns.logdir, ns.replica_index)
         if path:
             print(f"drained: {len(engine.drain_docs)} unfinished "
                   f"request(s) checkpointed to {path} "
@@ -322,6 +339,98 @@ def serve_listen(ns, model, params,
     print(json.dumps(engine.summary(slo_ttft_ms=ns.slo_ttft_ms),
                      indent=1, sort_keys=True))
     return 0 if (drain is None or not drain.get("timed_out")) else 1
+
+
+def _fleet_config(ns):
+    from dtf_tpu.serve.fleet import FleetConfig
+    return FleetConfig(hedge_priority=ns.hedge_priority,
+                       hedge_delay_ms=ns.hedge_delay_ms,
+                       stream_timeout_s=ns.stream_timeout_s,
+                       beat_stale_s=ns.beat_stale_s,
+                       drain_timeout_s=ns.drain_timeout_s)
+
+
+def _run_acceptor(ns, acc, banner: str) -> int:
+    """Shared fleet-acceptor lifecycle: start, serve until SIGTERM or
+    SIGINT, shut down, write the acceptor-side telemetry."""
+    import threading
+
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+        signal.signal(signal.SIGINT, lambda s, f: stop.set())
+    except ValueError:               # not the main thread (tests)
+        pass
+    acc.start()
+    if ns.admin_port is not None:
+        from dtf_tpu.telemetry.live import start_admin
+        admin = start_admin(ns.admin_port, fleet_fn=acc.rollup)
+        print(f"admin endpoint on http://127.0.0.1:{admin.port} "
+              f"(/statz /healthz /tracez /slo /fleetz /memz)", flush=True)
+    print(banner, flush=True)
+    stop.wait()
+    acc.shutdown()
+    if ns.logdir:
+        acc.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
+    print(json.dumps(acc.summary(slo_ttft_ms=ns.slo_ttft_ms),
+                     indent=1, sort_keys=True))
+    return 0
+
+
+def serve_fleet(ns, model, params) -> int:
+    """--replicas N: the in-process fleet quickstart — N engine replicas
+    (one seed, one driver thread) behind one acceptor socket."""
+    from dtf_tpu.serve.fleet import build_local_fleet
+    from dtf_tpu.serve.frontend import parse_listen
+
+    chaos = None
+    if ns.chaos:
+        from dtf_tpu.resilience.chaos import FaultPlan
+        chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    host, port = (parse_listen(ns.listen) if ns.listen
+                  else ("127.0.0.1", 0))
+    acc = build_local_fleet(
+        model, params, ns.replicas, seed=ns.seed, host=host, port=port,
+        config=_fleet_config(ns), chaos=chaos, logdir=ns.logdir,
+        health_dir=ns.health_dir, conn_timeout_s=ns.conn_timeout_s,
+        brownout=ns.brownout, slo_ttft_ms=ns.slo_ttft_ms,
+        degrade_max_new=ns.degrade_max_new,
+        engine_kwargs=dict(
+            num_slots=ns.slots, block_size=ns.block_size,
+            num_blocks=ns.pool_blocks, max_queue=ns.max_queue,
+            aging_s=ns.aging_s, eos_id=ns.eos_id, spec_k=ns.spec_k))
+    return _run_acceptor(
+        ns, acc,
+        f"fleet serving on tcp://{acc.address[0]}:{acc.address[1]} "
+        f"(replicas={ns.replicas}, preset={ns.preset}, "
+        f"seed={ns.seed})")
+
+
+def serve_acceptor(ns) -> int:
+    """--connect: acceptor over already-running --listen replicas.  No
+    model, no jax — this process is a pure routing/failover proxy, so
+    it boots in milliseconds and can be restarted freely."""
+    from dtf_tpu.serve.fleet import connect_remote_fleet
+    from dtf_tpu.serve.frontend import parse_listen
+
+    chaos = None
+    if ns.chaos:
+        from dtf_tpu.resilience.chaos import FaultPlan
+        chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    addrs = []
+    for part in ns.connect.split(","):
+        host, _, port = part.strip().rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    bind_host, bind_port = (parse_listen(ns.listen) if ns.listen
+                            else ("127.0.0.1", 0))
+    acc = connect_remote_fleet(
+        addrs, host=bind_host, port=bind_port, config=_fleet_config(ns),
+        chaos=chaos, logdir=ns.logdir, health_dir=ns.health_dir,
+        seed=ns.seed)
+    return _run_acceptor(
+        ns, acc,
+        f"fleet acceptor on tcp://{acc.address[0]}:{acc.address[1]} "
+        f"(replicas={len(addrs)})")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -410,7 +519,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "0 = ephemeral port, printed at startup)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="run the TCP front end instead of a trace "
-                        "(':8100' binds 127.0.0.1:8100; wall clock)")
+                        "(':8100' binds 127.0.0.1:8100; wall clock); "
+                        "with --replicas/--connect this is the fleet "
+                        "acceptor's bind address")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="fleet quickstart: N in-process engine replicas "
+                        "(one seed, one driver thread) behind one "
+                        "acceptor socket (serve/fleet.py)")
+    p.add_argument("--connect", default=None, metavar="H:P,H:P,...",
+                   help="fleet acceptor over already-running --listen "
+                        "replica processes (no model in this process; "
+                        "replicas must share --seed and, for missed-"
+                        "beat detection, --health_dir)")
+    p.add_argument("--replica_index", type=int, default=None, metavar="K",
+                   help="this --listen process is fleet replica K: "
+                        "heartbeats publish as hb_K and the drain "
+                        "checkpoint namespaces to drain.rK.jsonl")
+    p.add_argument("--hedge_priority", type=int, default=1,
+                   help="fleet: priority classes >= this get hedged "
+                        "dispatch (a duplicate leg on a second replica "
+                        "after the hedge delay)")
+    p.add_argument("--hedge_delay_ms", type=float, default=None,
+                   help="fleet: fixed hedge delay (default: p99 of "
+                        "observed TTFT, floored at 50ms)")
+    p.add_argument("--stream_timeout_s", type=float, default=30.0,
+                   help="fleet: per-event replica-stream wait before a "
+                        "leg is declared wedged and failed over")
+    p.add_argument("--beat_stale_s", type=float, default=10.0,
+                   help="fleet: detach a replica whose heartbeat count "
+                        "has not advanced for this long")
     p.add_argument("--conn_timeout_s", type=float, default=30.0,
                    help="TCP per-connection idle/read timeout")
     p.add_argument("--tokens_out", default=None,
@@ -422,8 +559,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if ns.listen and ns.clock == "virtual":
+    if (ns.listen or ns.replicas or ns.connect) and ns.clock == "virtual":
         p.error("--listen serves real clients; it needs --clock wall")
+    if ns.replicas is not None and ns.connect:
+        p.error("--replicas builds local replicas; --connect attaches "
+                "to remote ones — pick one")
+    if ns.replicas is not None and ns.replicas < 1:
+        p.error("--replicas must be >= 1")
     if ns.logdir:
         # span tracer (rotation-bounded): request lifecycle events and
         # the engine's prefill/decode iteration spans land here, the
@@ -449,6 +591,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError:               # not the main thread (tests)
         pass
 
+    if ns.connect:
+        # pure proxy: never initialise jax or build a model
+        return serve_acceptor(ns)
+
     import jax
 
     from dtf_tpu.models.gpt import GPT, GPTConfig
@@ -456,6 +602,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = GPTConfig.from_preset(ns.preset)
     model = GPT(cfg)
     params = model.init(jax.random.key(ns.seed))
+    if ns.replicas is not None:
+        return serve_fleet(ns, model, params)
     if ns.listen:
         return serve_listen(ns, model, params, drain_target)
     trace = build_trace(ns, cfg.vocab_size)

@@ -277,6 +277,18 @@ class ServingEngine:
 
     def submit_request(self, req: Request, now: float) -> str:
         tel.counter("serve/submissions_total").inc()
+        # ONE live request per rid: a fleet acceptor's failover/hedge
+        # replay may resubmit a rid whose earlier copy is still live on
+        # this engine (the leg's cancel raced the resubmit through the
+        # mailbox).  The stale copy is torn out FIRST — otherwise its
+        # mid-stream tokens would cross-wire into the new submission's
+        # per-rid stream and the acceptor's replay-prefix verification
+        # would (correctly) fail the request.
+        for old in list(self.scheduler.queue) + self.scheduler.active():
+            if old.rid == req.rid and old is not req:
+                self._evict(old, "cancelled", "serve/cancelled_total")
+                self._emit(old, -1, True)
+                break
         if req.trace_id is None:
             req.trace_id = mint_trace_id()
         # the trace's opening event; a supervisor/drain replay re-opens

@@ -1,0 +1,1132 @@
+"""Fault-tolerant serving fleet: one acceptor, N engine replicas.
+
+The single-engine TCP front end (serve/frontend.py) made the engine a
+server; this module makes it a FLEET — the ROADMAP's "one front end, N
+engine replicas" tier, built failure-first.  One acceptor fans client
+requests out to N :class:`~dtf_tpu.serve.engine.ServingEngine` replicas
+over the existing line-JSON TCP framing, and a replica is an EXPENDABLE
+unit: the fleet serves through its death without losing one accepted
+request.
+
+Robustness layers (DESIGN.md §7.6):
+
+* **Replica failure domains** — every replica beats per engine
+  iteration (``resilience/health.py`` file transport and/or in-memory),
+  and the acceptor detaches a replica on missed beats OR a
+  response-stream timeout OR severed sockets.  Its accepted-but-
+  unfinished requests are replayed on a survivor with the SAME
+  fleet-minted rid, ``resubmit`` marked and the original ``trace_id``
+  carried — replay is token-identical because per-request rng streams
+  are (seed, rid)-keyed and every replica runs the same seed, and the
+  acceptor skips (and VERIFIES) the tokens it already forwarded, so the
+  client's stream is bitwise the uninterrupted one.
+* **Routing as a control loop** — admission scores replicas on a
+  composite of queue depth, brownout level, KV-pool pressure, SLO
+  fast-burn (the ``{"stats": true}`` snapshot each replica's engine
+  thread refreshes) and the acceptor's own in-flight count; transient
+  connect errors retry with backoff; latency-critical priority classes
+  get HEDGED dispatch — a duplicate leg on a second replica after a
+  p99-derived delay, first stream wins, loser cancelled through the
+  engine's real cancel path so its KV blocks free that iteration.
+* **Fleet-level graceful degradation** — per-replica drain for rolling
+  restarts (in-flight legs fail over on the ``drained`` terminal, the
+  remainder checkpoints to ``drain.r<k>.jsonl``); when ALL live
+  replicas are browned out, the acceptor itself sheds low-priority work
+  (two-tier accounting: ``fleet/shed_acceptor_total`` vs
+  ``fleet/shed_replica_total``); the rollup rides ``/fleetz``.
+* **Replica-grade chaos** — ``replica_down@S[:P]`` /
+  ``replica_wedge@S:DURms[:P]`` / ``conn_flake@S:P``
+  (resilience/chaos.py), keyed on the acceptor's dispatch sequence.
+
+Threading model: acceptor handler threads proxy requests and NEVER
+touch an engine.  Local (in-process) replicas are all driven by ONE
+round-robin driver thread calling each frontend's ``run_once`` — one
+thread, because concurrently-booked goodput categories from N engine
+threads would overcount wall-clock and fail the books gate on an honest
+run.  Remote replicas are ``python -m dtf_tpu.serve --listen
+--replica_index k`` processes reached by address; the acceptor carries
+no model at all in that mode.
+
+rid discipline (the latent collision this module fixes): rids are
+per-engine, so two replicas' drain files merged naively can collide.
+The acceptor mints FLEET-UNIQUE rids and maps them on the wire — a
+client's own ``rid`` is echoed back to it, the fleet rid is what
+replicas (and their ``drain.r<k>.jsonl`` namespaces) see.
+:func:`merge_drain_docs` is the offline replay path's loud guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import os
+import queue
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dtf_tpu import telemetry as tel
+from dtf_tpu.serve.frontend import MAX_LINE_BYTES, parse_request_line
+
+log = logging.getLogger("dtf_tpu")
+
+#: Brownout ordinal at which a replica counts as degraded for the
+#: acceptor-level brownout (serve/brownout.py LEVELS index of
+#: "reject_low").
+_DEGRADED_LEVEL = 2
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Acceptor policy knobs.  Defaults suit a production-ish wall-clock
+    deployment; tests and the bench pin tighter timeouts."""
+    #: priority >= this may hedge (duplicate dispatch after the delay)
+    hedge_priority: int = 1
+    #: fixed hedge delay; None = p99 of observed TTFT (floored below)
+    hedge_delay_ms: Optional[float] = None
+    hedge_min_delay_ms: float = 50.0
+    #: per-event wait on a replica's response stream before the leg is
+    #: declared wedged and failed over
+    stream_timeout_s: float = 30.0
+    connect_timeout_s: float = 2.0
+    connect_retries: int = 2
+    connect_backoff_s: float = 0.05
+    #: a replica whose beat count has not advanced for this long is
+    #: detached (observed-change discipline, same as resilience/health)
+    beat_stale_s: float = 10.0
+    monitor_interval_s: float = 0.25
+    #: legs one request may burn before it fails loudly
+    max_failovers: int = 3
+    #: acceptor brownout sheds priority <= this when ALL replicas degrade
+    shed_priority_max: int = 0
+    #: grace window for a per-replica drain
+    drain_timeout_s: float = 30.0
+
+
+class Replica:
+    """One failure domain.  LOCAL replicas own an in-process engine +
+    frontend (driven by the fleet's single driver thread); REMOTE
+    replicas are an address only."""
+
+    def __init__(self, index: int, address: Tuple[str, int], *,
+                 frontend=None, engine=None, logdir: Optional[str] = None):
+        self.index = index
+        self.address = tuple(address)
+        self.frontend = frontend
+        self.engine = engine
+        self.logdir = logdir
+        self.state = "up"                  # up | draining | down
+        self.down_reason: Optional[str] = None
+        self.killed = False                # driver stops stepping it
+        self.stats: dict = {}
+        self.inflight = 0                  # acceptor-side live legs
+        self.dispatched = 0
+        self.failed_legs = 0
+        self.leg_socks: set = set()
+        self.beat_count: Optional[int] = None
+        self.beat_changed = time.monotonic()
+        self.beat_at_detach: Optional[int] = None
+
+    @property
+    def local(self) -> bool:
+        return self.frontend is not None
+
+    def note_beat(self, count: int) -> None:
+        """In-memory heartbeat sink for local replicas (the engine's
+        per-iteration callback); remote beats arrive via the health-dir
+        file transport instead."""
+        if count != self.beat_count:
+            self.beat_count = count
+            self.beat_changed = time.monotonic()
+
+
+class _LegError(OSError):
+    """A dispatch leg could not be established."""
+
+
+def merge_drain_docs(doc_sets: Sequence[Sequence[dict]]) -> List[dict]:
+    """Merge per-replica drain namespaces (``drain.r<k>.jsonl``) into
+    one replay set, FAILING LOUDLY on rid collisions.  Two standalone
+    engines both mint rids from 0, so their drain files can collide —
+    silently merging them would replay one request's rng stream under
+    another's id and quietly break token identity.  An acceptor-run
+    fleet never collides (rids are fleet-minted), so a collision here
+    means the operator merged files from engines that were never behind
+    one acceptor — exactly the mistake to refuse."""
+    merged: Dict[int, dict] = {}
+    for docs in doc_sets:
+        for doc in docs:
+            rid = int(doc["rid"])
+            if rid in merged:
+                raise ValueError(
+                    f"rid collision merging drain docs: rid {rid} appears "
+                    f"in more than one replica's namespace — these engines "
+                    f"minted rids independently (not behind one acceptor); "
+                    f"replay each drain.r<k>.jsonl separately, or re-serve "
+                    f"through the fleet acceptor which mints fleet-unique "
+                    f"rids")
+            merged[rid] = doc
+    return [merged[rid] for rid in sorted(merged)]
+
+
+def read_drain_files(logdir: str) -> List[dict]:
+    """Collect every ``drain.r<k>.jsonl`` under ``logdir`` through the
+    collision guard — the cold-restart replay set."""
+    sets = []
+    for name in sorted(os.listdir(logdir) if os.path.isdir(logdir) else []):
+        if name.startswith("drain.r") and name.endswith(".jsonl"):
+            with open(os.path.join(logdir, name)) as f:
+                sets.append([json.loads(ln) for ln in f if ln.strip()])
+    return merge_drain_docs(sets)
+
+
+class FleetAcceptor:
+    """See module docstring.  Construct with replicas, :meth:`start`,
+    point clients at :attr:`address`, :meth:`shutdown` when done."""
+
+    def __init__(self, replicas: List[Replica], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 config: Optional[FleetConfig] = None,
+                 chaos=None, logdir: Optional[str] = None,
+                 health_dir: Optional[str] = None,
+                 seed: int = 0):
+        self.replicas = list(replicas)
+        self.cfg = config or FleetConfig()
+        self.chaos = chaos
+        self.logdir = logdir
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._flights: List[dict] = []
+        self._inflight_count = 0
+        self._ttft_ms: List[float] = []
+        self._totals = {"accepted": 0, "completed": 0, "failovers": 0,
+                        "replayed": 0, "hedged": 0, "hedge_wins": 0,
+                        "hedge_cancelled": 0, "shed_acceptor": 0,
+                        "shed_replica": 0, "lost_legs": 0}
+        self._hb = None
+        if health_dir:
+            from dtf_tpu.resilience.health import FileHeartbeatTransport
+            # index -1: the acceptor reads every hb_<k>, it never beats
+            self._hb = FileHeartbeatTransport(health_dir, -1)
+        # goodput booking: local replicas' engines book through the
+        # driver thread; a pure-proxy acceptor (all replicas remote)
+        # books its own wall in the monitor so report --check's books
+        # gate holds on the acceptor logdir too
+        self._book_wall = not any(r.local for r in self.replicas)
+        tel.gauge("fleet/replicas").set(len(self.replicas))
+        tel.gauge("fleet/replicas_up").set(len(self.replicas))
+
+        acceptor = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                tel.counter("serve/conn_total").inc()
+                try:
+                    while not acceptor._stop.is_set():
+                        line = self.rfile.readline(MAX_LINE_BYTES + 1)
+                        if not line or len(line) > MAX_LINE_BYTES:
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ctl = acceptor._maybe_control(line)
+                        if ctl is not None:
+                            self._send(ctl)
+                            continue
+                        try:
+                            raw = json.loads(line.decode("utf-8"))
+                            parsed = parse_request_line(line)
+                        except (ValueError, UnicodeDecodeError) as exc:
+                            tel.counter("serve/conn_errors_total").inc()
+                            self._send({"error": str(exc)})
+                            return
+                        if not acceptor._proxy(self._send, raw, parsed):
+                            return
+                except (TimeoutError, OSError):
+                    tel.counter("serve/conn_errors_total").inc()
+
+            def _send(self, doc: dict) -> None:
+                self.wfile.write(
+                    (json.dumps(doc, sort_keys=True) + "\n").encode())
+                self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server((host, port), Handler)
+        self.address = self.server.server_address
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FleetAcceptor":
+        for r in self.replicas:
+            if r.local:
+                r.frontend._server_thread.start()
+        self._threads = [
+            threading.Thread(target=self.server.serve_forever,
+                             kwargs={"poll_interval": 0.05},
+                             daemon=True, name="dtf-fleet-acceptor"),
+            threading.Thread(target=self._monitor, daemon=True,
+                             name="dtf-fleet-monitor"),
+        ]
+        if any(r.local for r in self.replicas):
+            self._threads.append(
+                threading.Thread(target=self._drive, daemon=True,
+                                 name="dtf-fleet-driver"))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def shutdown(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self.server.shutdown()
+        self.server.server_close()
+        for r in self.replicas:
+            if r.local and not r.killed:
+                try:
+                    r.frontend.shutdown()
+                except Exception:
+                    pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- the single-thread local driver -------------------------------------
+
+    def _drive(self) -> None:
+        """Round-robin every live local replica from ONE thread (the
+        goodput-books invariant; see module docstring)."""
+        while not self._stop.is_set():
+            progress = False
+            for r in self.replicas:
+                if not r.local or r.killed:
+                    continue
+                eng = r.engine
+                try:
+                    if eng._drain_requested and not eng.drained:
+                        r.frontend._drain_mailbox()
+                        eng.drain(self.cfg.drain_timeout_s)
+                        self._finish_drain(r)
+                        progress = True
+                        continue
+                    progress = r.frontend.run_once() or progress
+                except Exception:
+                    log.exception("[fleet] replica %d crashed in step",
+                                  r.index)
+                    self._kill_replica(r, reason="crashed")
+            if not progress:
+                t0 = time.perf_counter()
+                self._stop.wait(0.004)
+                tel.get_tracker().add("stall", time.perf_counter() - t0)
+
+    def _finish_drain(self, r: Replica) -> None:
+        """A drained replica leaves rotation; its unfinished requests
+        checkpoint to the per-replica namespace AND fail over live (the
+        ``drained`` terminals its legs just received)."""
+        if self.logdir and r.engine.drain_docs:
+            os.makedirs(self.logdir, exist_ok=True)
+            path = os.path.join(self.logdir, f"drain.r{r.index}.jsonl")
+            with open(path, "w") as f:
+                for doc in r.engine.drain_docs:
+                    f.write(json.dumps({**doc, "arrival_s": 0.0},
+                                       sort_keys=True) + "\n")
+        r.killed = True
+        try:
+            r.frontend.shutdown()       # abort_all -> "drained" terminals
+        except Exception:
+            pass
+        self._mark_down(r, "drained")
+        tel.counter("fleet/drains_total").inc()
+
+    # -- replica state ------------------------------------------------------
+
+    def _up_replicas(self, exclude=()) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state == "up" and r.index not in exclude]
+
+    def _mark_down(self, r: Replica, reason: str) -> None:
+        with self._lock:
+            if r.state == "down":
+                return
+            r.state = "down"
+            r.down_reason = reason
+            r.beat_at_detach = r.beat_count
+        tel.counter("fleet/detached_total").inc()
+        tel.gauge("fleet/replicas_up").set(len(self._up_replicas()))
+        log.warning("[fleet] replica %d detached (%s)", r.index, reason)
+
+    def _rejoin(self, r: Replica) -> None:
+        with self._lock:
+            r.state = "up"
+            r.down_reason = None
+        tel.counter("fleet/rejoined_total").inc()
+        tel.gauge("fleet/replicas_up").set(len(self._up_replicas()))
+        log.warning("[fleet] replica %d rejoined (beats resumed)", r.index)
+
+    def _kill_replica(self, r: Replica, reason: str = "killed") -> None:
+        """replica_down semantics: abrupt, no drain, no goodbye."""
+        r.killed = True
+        if r.local:
+            try:
+                r.frontend.kill()
+            except Exception:
+                pass
+        self._sever_legs(r)
+        self._mark_down(r, reason)
+
+    def _wedge_replica(self, r: Replica, duration_s: float) -> None:
+        tel.counter("fleet/replica_wedged_total").inc()
+        if r.local:
+            r.frontend.wedge_until = time.monotonic() + duration_s
+            return
+        try:
+            self._control_roundtrip(r, {"wedge_ms": duration_s * 1e3})
+        except OSError:
+            log.warning("[fleet] replica %d unreachable for wedge",
+                        r.index)
+
+    def _flake_replica(self, r: Replica) -> None:
+        tel.counter("fleet/conn_flakes_total").inc()
+        self._sever_legs(r)
+
+    def _sever_legs(self, r: Replica) -> None:
+        with self._lock:
+            socks = list(r.leg_socks)
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def drain_replica(self, index: int) -> None:
+        """Rolling restart, step 1: freeze replica ``index``'s front
+        door.  In-flight legs fail over on their ``drained`` terminals;
+        the remainder checkpoints to ``drain.r<k>.jsonl``.  Remote
+        replicas are drained by the operator (SIGTERM to the process) —
+        the acceptor reacts identically either way."""
+        r = self.replicas[index]
+        if not r.local:
+            raise ValueError(
+                f"replica {index} is remote; send SIGTERM to its process "
+                f"instead (the acceptor fails over on its drained "
+                f"terminals either way)")
+        with self._lock:
+            if r.state == "up":
+                r.state = "draining"
+        r.engine.request_drain()
+
+    # -- monitor: stats polling, beat staleness, wall booking ---------------
+
+    def _monitor(self) -> None:
+        last = time.perf_counter()
+        while not self._stop.is_set():
+            for r in self.replicas:
+                if r.state == "down" or not r.local and r.killed:
+                    continue
+                if r.local:
+                    # stats snapshot is a plain attribute the replica's
+                    # engine thread refreshes — no socket needed
+                    r.stats = dict(r.frontend.stats)
+                else:
+                    try:
+                        doc = self._control_roundtrip(r, {"stats": True})
+                        r.stats = doc.get("stats", {}) or {}
+                    except OSError:
+                        r.failed_legs += 1
+            self._check_beats()
+            now = time.perf_counter()
+            if self._book_wall:
+                cat = "productive" if self._inflight_count else "stall"
+                tel.get_tracker().add(cat, now - last)
+            last = now
+            self._stop.wait(self.cfg.monitor_interval_s)
+
+    def _check_beats(self) -> None:
+        """Missed-beat detachment + beat-resumption rejoin, observed-
+        change discipline: only an ADVANCING count proves liveness."""
+        file_beats: Dict[int, int] = {}
+        if self._hb is not None:
+            try:
+                file_beats = self._hb.read_beats()
+            except OSError:
+                pass
+        now = time.monotonic()
+        for r in self.replicas:
+            count = file_beats.get(r.index, r.beat_count)
+            if count is not None and count != r.beat_count:
+                r.beat_count = count
+                r.beat_changed = now
+            if r.killed:
+                continue
+            stale = (now - r.beat_changed) > self.cfg.beat_stale_s
+            if r.state == "up" and stale and r.beat_count is not None:
+                self._mark_down(r, "stale_beats")
+            elif (r.state == "down"
+                  and r.down_reason in ("stale_beats", "unreachable")
+                  and r.beat_count is not None
+                  and r.beat_count != r.beat_at_detach):
+                self._rejoin(r)
+
+    # -- routing ------------------------------------------------------------
+
+    def _score(self, r: Replica) -> float:
+        s = r.stats or {}
+        return (float(s.get("queue_depth", 0))
+                + 2.0 * float(s.get("active", 0))
+                + 25.0 * float(s.get("brownout_level", 0))
+                + 10.0 * float(s.get("kv_pool_frac", 0.0))
+                + 15.0 * float(s.get("slo_fast_firing", 0))
+                + 2.0 * r.inflight)
+
+    def _route(self, exclude=()) -> Optional[Replica]:
+        cands = self._up_replicas(exclude)
+        if not cands:
+            cands = self._up_replicas()
+        if not cands:
+            return None
+        return min(cands, key=self._score)
+
+    def _fleet_degraded(self) -> bool:
+        up = self._up_replicas()
+        return bool(up) and all(
+            int((r.stats or {}).get("brownout_level", 0)) >= _DEGRADED_LEVEL
+            for r in up)
+
+    def _hedge_delay_s(self) -> float:
+        if self.cfg.hedge_delay_ms is not None:
+            return self.cfg.hedge_delay_ms / 1e3
+        with self._lock:
+            samples = list(self._ttft_ms)
+        if len(samples) >= 8:
+            return max(self.cfg.hedge_min_delay_ms,
+                       float(np.percentile(samples, 99))) / 1e3
+        return self.cfg.hedge_min_delay_ms / 1e3
+
+    # -- control lines to replicas / from clients ---------------------------
+
+    def _control_roundtrip(self, r: Replica, doc: dict,
+                           timeout: Optional[float] = None) -> dict:
+        with socket.create_connection(
+                r.address, timeout=timeout or self.cfg.connect_timeout_s
+        ) as s:
+            s.settimeout(timeout or self.cfg.connect_timeout_s)
+            s.sendall((json.dumps(doc) + "\n").encode())
+            line = s.makefile("rb").readline(MAX_LINE_BYTES)
+        if not line:
+            raise OSError("empty control reply")
+        return json.loads(line)
+
+    def _maybe_control(self, line: bytes) -> Optional[dict]:
+        try:
+            doc = json.loads(line.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None
+        if isinstance(doc, dict) and "stats" in doc and "prompt" not in doc:
+            return {"ok": True, "fleet": self.rollup()}
+        return None
+
+    # -- the proxy path (handler threads) -----------------------------------
+
+    def _admit(self, raw: dict, parsed: dict):
+        """Mint the fleet rid, fire dispatch-sequence chaos, apply the
+        acceptor-level brownout.  Returns (flight, shed_terminal)."""
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._seq += 1
+            seq = self._seq
+        if self.chaos is not None:
+            down = self.chaos.maybe_replica_down(seq)
+            if down is not None and down < len(self.replicas):
+                self._kill_replica(self.replicas[down], reason="chaos_kill")
+            wedge = self.chaos.maybe_replica_wedge(seq)
+            if wedge is not None and wedge[0] < len(self.replicas):
+                self._wedge_replica(self.replicas[wedge[0]], wedge[1])
+            flake = self.chaos.maybe_conn_flake(seq)
+            if flake is not None and flake < len(self.replicas):
+                self._flake_replica(self.replicas[flake])
+        client_rid = raw.get("rid")
+        out_rid = client_rid if client_rid is not None else rid
+        fl = {"rid": rid, "out_rid": out_rid,
+              "trace_id": parsed["trace_id"],
+              "priority": parsed.get("priority", 0),
+              "t_accept": time.monotonic(), "t_first": None,
+              "t_done": None, "status": None, "n_tokens": 0,
+              "failovers": 0, "hedged": False}
+        if not self._up_replicas():
+            return fl, {"rid": out_rid, "status": "shed_fleet_no_replicas",
+                        "reason": "no live replicas",
+                        "trace_id": fl["trace_id"]}
+        if (self._fleet_degraded()
+                and fl["priority"] <= self.cfg.shed_priority_max):
+            return fl, {"rid": out_rid, "status": "shed_fleet_brownout",
+                        "reason": "all replicas degraded",
+                        "trace_id": fl["trace_id"]}
+        return fl, None
+
+    def _proxy(self, send, raw: dict, parsed: dict) -> bool:
+        """Serve one client request end-to-end: route, stream, fail
+        over, hedge.  Returns False when the client connection should
+        close."""
+        cfg = self.cfg
+        fl, shed = self._admit(raw, parsed)
+        if shed is not None:
+            with self._lock:
+                self._totals["shed_acceptor"] += 1
+                fl["status"] = shed["status"]
+                fl["t_done"] = time.monotonic()
+                self._flights.append(fl)
+            tel.counter("fleet/shed_acceptor_total").inc()
+            try:
+                send(shed)
+            except OSError:
+                return False
+            return True
+        with self._lock:
+            self._totals["accepted"] += 1
+            self._inflight_count += 1
+            self._flights.append(fl)
+        tel.counter("fleet/accepted_total").inc()
+        try:
+            return self._stream(send, raw, fl)
+        finally:
+            with self._lock:
+                self._inflight_count -= 1
+                if fl["t_done"] is None:
+                    fl["t_done"] = time.monotonic()
+
+    def _wire_doc(self, raw: dict, fl: dict, *, resubmit: bool) -> bytes:
+        doc = {k: v for k, v in raw.items()
+               if k not in ("rid", "resubmit", "trace_id")}
+        doc["rid"] = fl["rid"]
+        doc["trace_id"] = fl["trace_id"]
+        if resubmit:
+            doc["resubmit"] = True
+        return (json.dumps(doc) + "\n").encode("utf-8")
+
+    def _open_leg(self, r: Replica, payload: bytes) -> socket.socket:
+        last: Optional[OSError] = None
+        for attempt in range(self.cfg.connect_retries + 1):
+            try:
+                s = socket.create_connection(
+                    r.address, timeout=self.cfg.connect_timeout_s)
+                s.settimeout(self.cfg.stream_timeout_s)
+                s.sendall(payload)
+                return s
+            except OSError as exc:
+                last = exc
+                tel.counter("fleet/conn_retries_total").inc()
+                time.sleep(self.cfg.connect_backoff_s * (2 ** attempt))
+        # connect budget exhausted: the replica is unreachable — a
+        # SIGKILLed process refuses connections long before its beats
+        # read stale
+        self._mark_down(r, "unreachable")
+        raise _LegError(str(last))
+
+    def _stream(self, send, raw: dict, fl: dict) -> bool:
+        cfg = self.cfg
+        out_q: "queue.Queue" = queue.Queue()
+        legs: Dict[int, dict] = {}
+        leg_ids = itertools.count()
+        tried: set = set()
+        forwarded: List[int] = []
+        winner: Optional[int] = None
+
+        def reader(leg_id: int, sock: socket.socket) -> None:
+            try:
+                for line in sock.makefile("rb"):
+                    try:
+                        out_q.put((leg_id, json.loads(line)))
+                    except ValueError:
+                        break              # garbled stream = failed leg
+            except OSError:
+                pass
+            out_q.put((leg_id, None))
+
+        def launch(r: Replica, *, resubmit: bool, skip: int,
+                   hedge: bool = False) -> None:
+            sock = self._open_leg(r, self._wire_doc(raw, fl,
+                                                    resubmit=resubmit))
+            leg_id = next(leg_ids)
+            leg = {"replica": r, "sock": sock, "skip": skip,
+                   "skipped": 0, "hedge": hedge}
+            legs[leg_id] = leg
+            tried.add(r.index)
+            with self._lock:
+                r.leg_socks.add(sock)
+                r.inflight += 1
+                r.dispatched += 1
+            threading.Thread(target=reader, args=(leg_id, sock),
+                             daemon=True).start()
+
+        def close_leg(leg_id: int) -> None:
+            leg = legs.pop(leg_id, None)
+            if leg is None:
+                return
+            r = leg["replica"]
+            with self._lock:
+                r.leg_socks.discard(leg["sock"])
+                r.inflight = max(0, r.inflight - 1)
+            try:
+                leg["sock"].close()
+            except OSError:
+                pass
+
+        def cancel_leg(leg_id: int) -> None:
+            leg = legs.get(leg_id)
+            if leg is None:
+                return
+            r = leg["replica"]
+            close_leg(leg_id)
+            # the loser's handler is mid-stream, so the cancel rides a
+            # fresh control connection; the engine's cancel path frees
+            # the loser's KV blocks that iteration
+            try:
+                self._control_roundtrip(r, {"cancel": fl["rid"]})
+            except OSError:
+                pass
+
+        def fail_over(from_leg: Optional[int]) -> bool:
+            nonlocal winner
+            if from_leg is not None:
+                legs[from_leg]["replica"].failed_legs += 1
+                close_leg(from_leg)
+            winner = None
+            while fl["failovers"] < cfg.max_failovers:
+                fl["failovers"] += 1
+                with self._lock:
+                    self._totals["failovers"] += 1
+                tel.counter("fleet/failovers_total").inc()
+                nxt = self._route(exclude=tried)
+                if nxt is None:
+                    return False
+                try:
+                    launch(nxt, resubmit=True, skip=len(forwarded))
+                except _LegError:
+                    continue
+                with self._lock:
+                    self._totals["replayed"] += 1
+                tel.counter("fleet/replayed_total").inc()
+                return True
+            return False
+
+        def finish(status: str, doc: Optional[dict] = None) -> bool:
+            fl["status"] = status
+            fl["t_done"] = time.monotonic()
+            fl["n_tokens"] = len(forwarded)
+            for leg_id in list(legs):
+                cancel_leg(leg_id)
+            if status == "completed":
+                with self._lock:
+                    self._totals["completed"] += 1
+                    if fl["t_first"] is not None:
+                        self._ttft_ms.append(
+                            (fl["t_first"] - fl["t_accept"]) * 1e3)
+                tel.counter("fleet/completed_total").inc()
+            elif status.startswith("shed_") or status.startswith("rejected"):
+                with self._lock:
+                    self._totals["shed_replica"] += 1
+                tel.counter("fleet/shed_replica_total").inc()
+            out = doc or {"rid": fl["out_rid"], "status": status,
+                          "n_tokens": len(forwarded),
+                          "trace_id": fl["trace_id"]}
+            try:
+                send(out)
+            except OSError:
+                return False
+            return True
+
+        primary = self._route()
+        if primary is None:
+            return finish("shed_fleet_no_replicas")
+        try:
+            launch(primary, resubmit=bool(raw.get("resubmit")), skip=0)
+        except _LegError:
+            if not fail_over(None):
+                return finish("failed_failover_exhausted")
+        hedge_at: Optional[float] = None
+        if (fl["priority"] >= cfg.hedge_priority
+                and len(self._up_replicas()) > 1):
+            hedge_at = time.monotonic() + self._hedge_delay_s()
+
+        while True:
+            tmo = 0.25
+            if hedge_at is not None:
+                tmo = min(tmo, max(0.002, hedge_at - time.monotonic()))
+            try:
+                leg_id, ev = out_q.get(timeout=tmo)
+            except queue.Empty:
+                if (hedge_at is not None and winner is None
+                        and not forwarded
+                        and time.monotonic() >= hedge_at):
+                    hedge_at = None
+                    nxt = self._route(exclude=tried)
+                    if nxt is not None:
+                        try:
+                            launch(nxt, resubmit=False, skip=0, hedge=True)
+                            fl["hedged"] = True
+                            with self._lock:
+                                self._totals["hedged"] += 1
+                            tel.counter("fleet/hedged_total").inc()
+                        except _LegError:
+                            pass
+                if not legs:
+                    # every leg is gone and nothing replaced them
+                    if not fail_over(None):
+                        return finish("failed_failover_exhausted")
+                continue
+            if leg_id not in legs:
+                continue                   # cancelled loser's straggler
+            if ev is None or "error" in ev:
+                # leg died: conn severed / stream timeout / replica error
+                with self._lock:
+                    self._totals["lost_legs"] += 1
+                if winner is None or winner == leg_id:
+                    if not fail_over(leg_id):
+                        return finish("failed_failover_exhausted")
+                else:
+                    close_leg(leg_id)
+                continue
+            if "status" in ev and ev["status"] in ("drained",
+                                                   "server_shutdown"):
+                # graceful exit under us: replay on a survivor
+                if winner is None or winner == leg_id:
+                    if not fail_over(leg_id):
+                        return finish("failed_failover_exhausted")
+                else:
+                    close_leg(leg_id)
+                continue
+            if winner is None:
+                winner = leg_id
+                if legs[leg_id]["hedge"]:
+                    with self._lock:
+                        self._totals["hedge_wins"] += 1
+                    tel.counter("fleet/hedge_wins_total").inc()
+                for other in [k for k in legs if k != winner]:
+                    with self._lock:
+                        self._totals["hedge_cancelled"] += 1
+                    tel.counter("fleet/hedge_cancelled_total").inc()
+                    cancel_leg(other)
+            if leg_id != winner:
+                continue
+            if "token" in ev:
+                leg = legs[leg_id]
+                if leg["skipped"] < leg["skip"]:
+                    # replayed prefix: MUST match what the client already
+                    # has — token identity across the failover is the
+                    # contract, and a mismatch is a correctness bug to
+                    # fail loudly, not paper over
+                    if ev["token"] != forwarded[leg["skipped"]]:
+                        tel.counter("fleet/replay_mismatch_total").inc()
+                        log.error(
+                            "[fleet] replay divergence rid=%d pos=%d: "
+                            "%r != %r", fl["rid"], leg["skipped"],
+                            ev["token"], forwarded[leg["skipped"]])
+                        return finish("failed_replay_mismatch")
+                    leg["skipped"] += 1
+                    continue
+                if fl["t_first"] is None:
+                    fl["t_first"] = time.monotonic()
+                forwarded.append(ev["token"])
+                try:
+                    send({"rid": fl["out_rid"], "token": ev["token"],
+                          "done": bool(ev.get("done"))})
+                except OSError:
+                    # client went away: cancel every leg so no replica
+                    # pins KV for a vanished reader
+                    for lid in list(legs):
+                        cancel_leg(lid)
+                    fl["status"] = "client_gone"
+                    return False
+                continue
+            if "status" in ev:
+                st = ev["status"]
+                close_leg(leg_id)
+                return finish(st, {"rid": fl["out_rid"], "status": st,
+                                   "n_tokens": len(forwarded),
+                                   "trace_id": fl["trace_id"]})
+
+    # -- rollup / summary ---------------------------------------------------
+
+    def totals(self) -> dict:
+        with self._lock:
+            return dict(self._totals)
+
+    def arm_chaos(self, plan) -> None:
+        """Arm (or swap) a fault plan mid-run, restarting the dispatch
+        sequence the ``@S`` step keys count — so a bench can warm the
+        fleet first and still write specs against MEASURED dispatches."""
+        with self._lock:
+            self.chaos = plan
+            self._seq = 0
+
+    def rollup(self) -> dict:
+        """The ``/fleetz`` payload: one consistent cut of per-replica
+        state + acceptor totals (everything under the acceptor lock)."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = {
+                str(r.index): {
+                    "state": r.state,
+                    "down_reason": r.down_reason,
+                    "address": list(r.address),
+                    "local": r.local,
+                    "inflight": r.inflight,
+                    "dispatched": r.dispatched,
+                    "failed_legs": r.failed_legs,
+                    "beat_count": r.beat_count,
+                    "beat_age_s": round(now - r.beat_changed, 3),
+                    "stats": r.stats,
+                } for r in self.replicas}
+            totals = dict(self._totals)
+        return {"fleet": "serving", "replicas": replicas,
+                "up": len(self._up_replicas()),
+                "size": len(self.replicas),
+                "totals": totals, "written_unix": time.time()}
+
+    def summary(self, slo_ttft_ms: Optional[float] = None) -> dict:
+        """Acceptor-side serving summary — same gate keys the engine's
+        summary feeds (``goodput_qps`` / ``ttft_ms_p99`` / ...), measured
+        where the client sees them: at the fleet's front door."""
+        with self._lock:
+            flights = [dict(f) for f in self._flights]
+            totals = dict(self._totals)
+        done = [f for f in flights if f["t_done"] is not None]
+        completed = [f for f in done if f["status"] == "completed"]
+        ttfts = [(f["t_first"] - f["t_accept"]) * 1e3 for f in completed
+                 if f["t_first"] is not None]
+        out = {
+            "mode": "fleet",
+            "replicas": len(self.replicas),
+            "replicas_up": len(self._up_replicas()),
+            "accepted": totals["accepted"],
+            "completed": len(completed),
+            "shed": totals["shed_acceptor"] + totals["shed_replica"],
+            "shed_acceptor": totals["shed_acceptor"],
+            "shed_replica": totals["shed_replica"],
+            "failed": sum(1 for f in done
+                          if (f["status"] or "").startswith("failed")),
+            "failovers": totals["failovers"],
+            "replays": totals["replayed"],
+            "hedged": totals["hedged"],
+            "hedge_wins": totals["hedge_wins"],
+            "hedge_cancelled": totals["hedge_cancelled"],
+            "tokens_out": sum(f["n_tokens"] for f in completed),
+        }
+        if ttfts:
+            out["ttft_ms_p50"] = float(np.percentile(ttfts, 50))
+            out["ttft_ms_p99"] = float(np.percentile(ttfts, 99))
+        if done:
+            span = (max(f["t_done"] for f in done)
+                    - min(f["t_accept"] for f in done))
+            out["makespan_s"] = round(max(span, 1e-9), 6)
+            out["completed_qps"] = round(len(completed) / max(span, 1e-9),
+                                         4)
+        if slo_ttft_ms is not None:
+            good = [f for f in completed
+                    if f["t_first"] is not None
+                    and (f["t_first"] - f["t_accept"]) * 1e3 <= slo_ttft_ms]
+            out["slo_ttft_ms"] = slo_ttft_ms
+            out["slo_attainment"] = (round(len(good) / len(completed), 4)
+                                     if completed else None)
+            if done:
+                out["goodput_qps"] = round(
+                    len(good) / max(max(f["t_done"] for f in done)
+                                    - min(f["t_accept"] for f in done),
+                                    1e-9), 4)
+        return out
+
+    def write_telemetry(self, logdir: str,
+                        slo_ttft_ms: Optional[float] = None,
+                        extra: Optional[dict] = None) -> str:
+        os.makedirs(logdir, exist_ok=True)
+        serving = self.summary(slo_ttft_ms)
+        serving["fleet"] = self.rollup()
+        if extra:
+            serving.update(extra)
+        return tel.write_telemetry_json(logdir, extra={"serving": serving})
+
+
+# -- local fleet construction ----------------------------------------------
+
+def build_local_fleet(model, params, n_replicas: int, *,
+                      seed: int = 0, host: str = "127.0.0.1", port: int = 0,
+                      config: Optional[FleetConfig] = None,
+                      chaos=None, logdir: Optional[str] = None,
+                      health_dir: Optional[str] = None,
+                      conn_timeout_s: float = 30.0,
+                      brownout: bool = False,
+                      slo_ttft_ms: float = 500.0,
+                      degrade_max_new: int = 8,
+                      engine_kwargs: Optional[dict] = None) -> FleetAcceptor:
+    """N in-process replicas (one engine + TCP frontend each, ALL on the
+    same seed — the token-identity precondition) behind one acceptor.
+    The caller must :meth:`FleetAcceptor.start` it."""
+    from dtf_tpu.serve import WallClock
+    from dtf_tpu.serve.engine import ServingEngine
+    from dtf_tpu.serve.frontend import TCPFrontend
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
+
+    kw = dict(engine_kwargs or {})
+    replicas: List[Replica] = []
+    for k in range(n_replicas):
+        beats = None
+        if health_dir:
+            from dtf_tpu.resilience.health import FileHeartbeatTransport
+            transport = FileHeartbeatTransport(health_dir, k)
+            beats = transport.beat
+        # brownout controller + SLO burn monitor are PER-REPLICA state
+        # (hysteresis and burn windows must not be shared)
+        bo = None
+        if brownout:
+            from dtf_tpu.serve import BrownoutController
+            bo = BrownoutController(slo_ttft_ms,
+                                    degrade_max_new=degrade_max_new)
+        engine = ServingEngine(model, params, seed=seed, clock=WallClock(),
+                               brownout=bo,
+                               slo=BurnRateMonitor.for_serving(slo_ttft_ms),
+                               **kw)
+        replica = Replica(k, ("127.0.0.1", 0))
+        inner = beats
+
+        def heartbeat(count, _r=replica, _inner=inner):
+            _r.note_beat(count)
+            if _inner is not None:
+                _inner(count)
+
+        engine.heartbeat = heartbeat
+        frontend = TCPFrontend(engine, "127.0.0.1", 0,
+                               conn_timeout_s=conn_timeout_s)
+        replica.frontend = frontend
+        replica.engine = engine
+        replica.address = tuple(frontend.address)
+        replicas.append(replica)
+    return FleetAcceptor(replicas, host=host, port=port, config=config,
+                         chaos=chaos, logdir=logdir, health_dir=health_dir,
+                         seed=seed)
+
+
+def connect_remote_fleet(addresses: Sequence[Tuple[str, int]], *,
+                         host: str = "127.0.0.1", port: int = 0,
+                         config: Optional[FleetConfig] = None,
+                         chaos=None, logdir: Optional[str] = None,
+                         health_dir: Optional[str] = None,
+                         seed: int = 0) -> FleetAcceptor:
+    """Acceptor over already-running ``python -m dtf_tpu.serve --listen
+    --replica_index k`` processes.  The acceptor carries no model; all
+    replicas must share one ``--seed`` (token identity) and, for
+    missed-beat detection, one ``--health_dir``."""
+    replicas = [Replica(k, addr) for k, addr in enumerate(addresses)]
+    return FleetAcceptor(replicas, host=host, port=port, config=config,
+                         chaos=chaos, logdir=logdir, health_dir=health_dir,
+                         seed=seed)
+
+
+# -- trace-driving client (bench / scenario / CI lane) ----------------------
+
+def drive_trace(address: Tuple[str, int], trace, *,
+                request_timeout_s: float = 120.0,
+                time_scale: float = 1.0) -> Dict[int, dict]:
+    """Replay a ``poisson_trace``-shaped trace against a fleet (or
+    single-replica) front door over real sockets, one connection per
+    request, pacing arrivals on the wall clock.  Returns per-trace-index
+    records with the client-side latency marks — the fleet summary's
+    ground truth is measured HERE, where the user sits."""
+    results: Dict[int, dict] = {}
+    threads: List[threading.Thread] = []
+
+    def one(i: int, kw: dict) -> None:
+        rec: dict = {"status": None, "tokens": [], "t_send": None,
+                     "t_first": None, "t_done": None, "trace_id": None}
+        results[i] = rec
+        doc = {"prompt": [int(x) for x in kw["prompt"]],
+               "max_new_tokens": int(kw["max_new_tokens"]),
+               "temperature": float(kw.get("temperature", 0.0)),
+               "trace_id": kw.get("trace_id") or f"drv-{i:05d}"}
+        if kw.get("deadline_ms") is not None:
+            doc["deadline_ms"] = float(kw["deadline_ms"])
+        if kw.get("priority") is not None:
+            doc["priority"] = int(kw.get("priority", 0))
+        rec["trace_id"] = doc["trace_id"]
+        try:
+            with socket.create_connection(address, timeout=10.0) as s:
+                s.settimeout(request_timeout_s)
+                rec["t_send"] = time.monotonic()
+                s.sendall((json.dumps(doc) + "\n").encode())
+                for line in s.makefile("rb"):
+                    ev = json.loads(line)
+                    if "error" in ev:
+                        rec["status"] = f"error:{ev['error']}"
+                        break
+                    if "token" in ev:
+                        if rec["t_first"] is None:
+                            rec["t_first"] = time.monotonic()
+                        rec["tokens"].append(int(ev["token"]))
+                    if "status" in ev:
+                        rec["status"] = ev["status"]
+                        rec["t_done"] = time.monotonic()
+                        break
+        except (OSError, ValueError) as exc:
+            if rec["status"] is None:
+                rec["status"] = f"conn_error:{type(exc).__name__}"
+
+    t0 = time.monotonic()
+    for i, (t_arr, kw) in enumerate(trace):
+        delay = t0 + t_arr * time_scale - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one, args=(i, kw), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=request_timeout_s + 15.0)
+    return results
+
+
+def client_summary(results: Dict[int, dict], *,
+                   slo_ttft_ms: float) -> dict:
+    """Client-side serving summary over :func:`drive_trace` records —
+    the A/B's measurement arm (both arms measured identically)."""
+    done = [r for r in results.values() if r["t_done"] is not None]
+    completed = [r for r in done if r["status"] == "completed"]
+    lost = [i for i, r in results.items() if r["t_done"] is None]
+    ttfts = [(r["t_first"] - r["t_send"]) * 1e3 for r in completed
+             if r["t_first"] is not None and r["t_send"] is not None]
+    out = {"offered": len(results), "completed": len(completed),
+           "lost": len(lost), "lost_indices": lost[:8],
+           "statuses": {}, "slo_ttft_ms": slo_ttft_ms,
+           "tokens_out": sum(len(r["tokens"]) for r in completed)}
+    for r in results.values():
+        st = r["status"] or "no_terminal"
+        out["statuses"][st] = out["statuses"].get(st, 0) + 1
+    if ttfts:
+        out["ttft_ms_p50"] = float(np.percentile(ttfts, 50))
+        out["ttft_ms_p99"] = float(np.percentile(ttfts, 99))
+    if done:
+        sends = [r["t_send"] for r in done if r["t_send"] is not None]
+        span = max(r["t_done"] for r in done) - min(sends)
+        out["makespan_s"] = round(max(span, 1e-9), 6)
+        good = sum(1 for r in completed
+                   if r["t_first"] is not None and r["t_send"] is not None
+                   and (r["t_first"] - r["t_send"]) * 1e3 <= slo_ttft_ms)
+        out["goodput_qps"] = round(good / max(span, 1e-9), 4)
+        out["completed_qps"] = round(len(completed) / max(span, 1e-9), 4)
+    else:
+        out["goodput_qps"] = 0.0
+    return out

@@ -106,11 +106,27 @@ def parse_request_line(line: bytes) -> dict:
                                  or not (1 <= len(trace_id) <= 64)):
         raise ValueError("'trace_id' must be a short string")
     from dtf_tpu.telemetry.reqtrace import mint_trace_id
-    return {"prompt": np.asarray(prompt, np.int32),
-            "max_new_tokens": max_new,
-            "temperature": float(temperature),
-            "deadline_ms": deadline, "priority": priority,
-            "trace_id": trace_id or mint_trace_id()}
+    out = {"prompt": np.asarray(prompt, np.int32),
+           "max_new_tokens": max_new,
+           "temperature": float(temperature),
+           "deadline_ms": deadline, "priority": priority,
+           "trace_id": trace_id or mint_trace_id()}
+    # Fleet wire: the acceptor (serve/fleet.py) mints fleet-unique rids
+    # and carries them to the replica so a failover replay on a survivor
+    # reuses the SAME (seed, rid)-keyed rng stream — token identity
+    # across the failure domain.  ``resubmit`` marks the replay segment
+    # in the request's reqtrace chain.  Plain clients send neither.
+    rid = doc.get("rid")
+    if rid is not None:
+        if not isinstance(rid, int) or isinstance(rid, bool) or rid < 0:
+            raise ValueError("'rid' must be a non-negative int")
+        out["rid"] = rid
+    resubmit = doc.get("resubmit", False)
+    if not isinstance(resubmit, bool):
+        raise ValueError("'resubmit' must be a bool")
+    if resubmit:
+        out["resubmit"] = True
+    return out
 
 
 class FrontendBridge:
@@ -177,6 +193,20 @@ class TCPFrontend:
         self.request_timeout_s = request_timeout_s
         self._shutdown = False
         self._drain_status: Optional[dict] = None
+        # Fleet control surface: a wedge deadline (chaos replica_wedge —
+        # the engine loop stops draining the mailbox and stepping until
+        # it passes, so beats go stale exactly like a GC-paused process)
+        # and a routing-stats snapshot the engine thread refreshes and
+        # handler threads serve on {"stats": true} without ever touching
+        # the engine (atomic reference swap, same mailbox discipline).
+        self.wedge_until: float = 0.0
+        self.stats: dict = {"queue_depth": 0, "active": 0,
+                            "iterations": 0, "brownout_level": 0,
+                            "kv_pool_frac": 0.0, "slo_fast_firing": 0,
+                            "draining": False, "completed": 0}
+        self._stats_at = 0.0
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
         # Engine streaming -> bridge routing.  Chain any pre-existing
         # on_token (e.g. --stream printing) rather than replacing it.
@@ -204,6 +234,7 @@ class TCPFrontend:
             def handle(self):
                 tel.counter("serve/conn_total").inc()
                 self.connection.settimeout(conn_timeout_s)
+                frontend._track_conn(self.connection, True)
                 try:
                     while not frontend._shutdown:
                         line = self.rfile.readline(MAX_LINE_BYTES + 1)
@@ -214,6 +245,10 @@ class TCPFrontend:
                         if len(line) > MAX_LINE_BYTES:
                             self._error("request line too large")
                             return
+                        ctl = frontend._maybe_control(line.strip())
+                        if ctl is not None:
+                            self._send(ctl)
+                            continue
                         try:
                             kwargs = parse_request_line(line.strip())
                         except ValueError as exc:
@@ -226,6 +261,8 @@ class TCPFrontend:
                     # (any in-flight request was already handled by
                     # _stream_one's own error path)
                     tel.counter("serve/conn_errors_total").inc()
+                finally:
+                    frontend._track_conn(self.connection, False)
 
             def _send(self, doc: dict) -> None:
                 self.wfile.write((json.dumps(doc, sort_keys=True) + "\n")
@@ -275,7 +312,108 @@ class TCPFrontend:
             target=self.server.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True, name="dtf-serve-acceptor")
 
+    # -- control messages (handler threads; never touch the engine) ---------
+
+    def _track_conn(self, conn, add: bool) -> None:
+        with self._conns_lock:
+            (self._conns.add if add else self._conns.discard)(conn)
+
+    def _maybe_control(self, line: bytes) -> Optional[dict]:
+        """A control line — ``{"cancel": rid}`` / ``{"stats": true}`` /
+        ``{"wedge_ms": D}`` — gets a one-line reply; returns None for
+        anything else (falls through to request parsing)."""
+        try:
+            doc = json.loads(line.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None
+        if not isinstance(doc, dict):
+            return None
+        if "cancel" in doc:
+            rid = doc["cancel"]
+            if not isinstance(rid, int) or isinstance(rid, bool) or rid < 0:
+                return {"error": "'cancel' must be a non-negative rid"}
+            self.bridge.cancel(rid)
+            return {"ok": True, "cancel": rid}
+        if "stats" in doc:
+            return {"ok": True, "stats": self.stats}
+        if "wedge_ms" in doc:
+            dur = doc["wedge_ms"]
+            if not isinstance(dur, (int, float)) or dur <= 0:
+                return {"error": "'wedge_ms' must be a positive number"}
+            self.wedge_until = time.monotonic() + float(dur) / 1e3
+            return {"ok": True, "wedge_ms": float(dur)}
+        return None
+
     # -- engine loop --------------------------------------------------------
+
+    def _build_stats(self) -> dict:
+        """The routing snapshot (engine thread only): what the fleet
+        acceptor's admission control loop weighs — queue depth, brownout
+        state, KV-pool pressure, SLO fast-burn — read from the engine at
+        an iteration boundary, never from a handler."""
+        eng = self.engine
+        alloc = eng.scheduler.allocator
+        usable = max(alloc.num_blocks - 1, 1)
+        fast_firing = 0
+        if eng.slo is not None:
+            try:
+                objs = eng.slo.state().get("objectives", {})
+                fast_firing = sum(1 for o in objs.values()
+                                  if o.get("firing_fast"))
+            except Exception:
+                pass
+        return {"queue_depth": len(eng.scheduler.queue),
+                "active": len(eng.scheduler.active()),
+                "iterations": eng.iterations,
+                "brownout_level": (eng.brownout.level if eng.brownout
+                                   else 0),
+                "kv_pool_frac": round(alloc.used_blocks / usable, 4),
+                "slo_fast_firing": fast_firing,
+                "draining": bool(eng._drain_requested or eng.drained),
+                "completed": sum(1 for r in eng.results.values()
+                                 if r.status == "completed")}
+
+    def run_once(self) -> bool:
+        """One engine-loop slice: honor a wedge, drain the mailbox,
+        refresh the routing snapshot, step if there is work.  Returns
+        True when the engine made progress (False = idle or wedged).
+        The single-frontend :meth:`run_loop` and the fleet's one-thread
+        round-robin driver (serve/fleet.py) both build on this — the
+        fleet driver MUST interleave replicas from one thread, or their
+        concurrently-booked goodput categories overcount wall-clock and
+        the books gate fails on an honest run."""
+        now = time.monotonic()
+        if now < self.wedge_until:
+            return False       # wedged: mailbox backs up, beats stop
+        self._drain_mailbox()
+        if now - self._stats_at > 0.02:
+            self.stats = self._build_stats()
+            self._stats_at = now
+        if self.engine.scheduler.has_work():
+            self.engine.step()
+            return True
+        return False
+
+    def kill(self) -> None:
+        """Abrupt death for fleet chaos (``replica_down``): sever every
+        open connection and stop accepting — no drain, no
+        ``abort_all`` goodbyes.  A SIGKILLed process sends nothing; its
+        peers must notice from the severed sockets and stale beats."""
+        import socket as _socket
+        self._shutdown = True
+        self.server.shutdown()
+        self.server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _drain_mailbox(self) -> None:
         while True:
@@ -311,10 +449,7 @@ class TCPFrontend:
                     self._drain_mailbox()      # last-chance submissions
                     self._drain_status = self.engine.drain(drain_timeout_s)
                     break
-                self._drain_mailbox()
-                if self.engine.scheduler.has_work():
-                    self.engine.step()
-                else:
+                if not self.run_once():
                     # book the idle wait as stall, same as engine.run's
                     # between-arrivals waits — otherwise a mostly-idle
                     # server's goodput books don't sum to wall-clock

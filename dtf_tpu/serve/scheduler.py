@@ -77,12 +77,21 @@ MODES = ("continuous", "static")
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
     """One generation request.  ``temperature=0`` is greedy; sampling
     draws come from a per-request stream seeded by (engine seed, rid),
     so a request's tokens are independent of the batch composition it
-    rode (continuous vs static modes emit identical tokens — tested)."""
+    rode (continuous vs static modes emit identical tokens — tested).
+
+    ``eq=False``: a request is identified by OBJECT, not by field value.
+    Two live Request objects may share a rid (a fleet acceptor's
+    failover/hedge resubmits the same rid while the original copy is
+    still queued on the old replica), and field equality on such a pair
+    walks into ``prompt`` — a numpy array whose ``==`` is elementwise,
+    so ``queue.remove``/``in`` membership raised "truth value of an
+    array is ambiguous" and crashed the engine driver.  cancel() must
+    tear out THE object it was handed, never an equal-valued twin."""
 
     rid: int
     prompt: np.ndarray                 # (P,) int32 token ids

@@ -152,6 +152,29 @@ METRICS = (
     "fleet/blame_p*",             # last-arrival counters per host
     "fleet/lateness_s_p*",        # accumulated critical-path margin
     "fleet/hosts",                # hosts seen at the latest barrier
+    # serving fleet (serve/fleet.py): the acceptor's view of its replica
+    # failure domains.  Two-tier shed accounting is deliberate — an
+    # acceptor-level shed (fleet brownout / no replicas) is an operator
+    # page, a replica-level shed is that replica's own admission policy
+    # doing its job.
+    "fleet/replicas",             # gauge: fleet size
+    "fleet/replicas_up",          # gauge: replicas in rotation
+    "fleet/accepted_total",       # requests past acceptor admission
+    "fleet/completed_total",      # terminal=completed at the front door
+    "fleet/detached_total",       # replicas marked down (any reason)
+    "fleet/rejoined_total",       # beat-resumption rejoins (wedge healed)
+    "fleet/failovers_total",      # leg deaths that triggered re-dispatch
+    "fleet/replayed_total",       # resubmit legs launched on survivors
+    "fleet/replay_mismatch_total",  # replayed prefix diverged (bug!)
+    "fleet/hedged_total",         # duplicate legs launched past the delay
+    "fleet/hedge_wins_total",     # hedge leg beat the primary
+    "fleet/hedge_cancelled_total",  # losing legs cancelled (KV freed)
+    "fleet/conn_retries_total",   # transient connect errors retried
+    "fleet/conn_flakes_total",    # chaos-severed acceptor<->replica socks
+    "fleet/replica_wedged_total",  # chaos wedges injected
+    "fleet/shed_acceptor_total",  # tier 1: fleet brownout / no replicas
+    "fleet/shed_replica_total",   # tier 2: replica admission shed/reject
+    "fleet/drains_total",         # rolling-restart drains completed
 )
 # spans (host-side tracer)
 SPANS = (

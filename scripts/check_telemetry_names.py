@@ -65,6 +65,12 @@ def check_runtime_guard() -> list:
                  "checkpoint/saves_total",         # exact declaration
                  "fleet/blame_p3",                 # pattern fleet/blame_p*
                  "fleet/barriers_total",           # exact (fleet family)
+                 # the serving-fleet family (ISSUE 16): exact names only
+                 # — the fleet/definitely_not_declared probe above is
+                 # this family's rejection direction
+                 "fleet/failovers_total",
+                 "fleet/shed_acceptor_total",
+                 "fleet/replay_mismatch_total",
                  "cost/compiles_total"):           # exact (cost family)
         try:
             reg.counter(name)
@@ -76,6 +82,7 @@ def check_runtime_guard() -> list:
     # the type guard instead of exercising the naming guard
     for name in ("hbm/live_bytes",                 # exact (hbm family)
                  "cost/cards",                     # exact (cost family)
+                 "fleet/replicas_up",              # exact (serving fleet)
                  "serve/kv_pool_frac"):            # exact (kv gauges)
         try:
             reg.gauge(name)

@@ -384,6 +384,128 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: TCP front-end tests (rc=$rc)"; tail -10 "$scdir2/tcp.log"; }
   rm -rf "$scdir2"
 fi
+# Serve-fleet lane (DESIGN.md §7.6, ISSUE 16): the replica failure
+# domain gate against REAL processes on the wall clock — three --listen
+# replica processes (one preset+seed, so one weight tensor) behind a
+# --connect acceptor with --admin_port, a SIGKILL of replica 1 while
+# /fleetz shows it provably holding in-flight legs, and the client-side
+# verdict: zero lost requests, every token stream bitwise identical to
+# an uninterrupted in-process reference, the failover booked in the
+# /fleetz rollup (up drops to 2/3), and the acceptor's report --check
+# green.  Skip with NO_SERVE_FLEET_LANE=1.
+if [ "${NO_SERVE_FLEET_LANE:-0}" != "1" ]; then
+  echo "=== serve-fleet lane (3-replica SIGKILL failover + token identity) ==="
+  sfdir=$(mktemp -d)
+  mkdir -p "$sfdir/hb"
+  rpids=()
+  for k in 0 1 2; do
+    JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --listen :0 \
+        --replica_index "$k" --seed 11 --health_dir "$sfdir/hb" \
+        --logdir "$sfdir/r$k" > "$sfdir/r$k.log" 2>&1 &
+    rpids[$k]=$!
+  done
+  ports=()
+  for k in 0 1 2; do
+    for _ in $(seq 1 240); do
+      grep -q "serving on tcp://" "$sfdir/r$k.log" 2>/dev/null && break
+      sleep 0.5
+    done
+    ports[$k]=$(sed -n 's#.*serving on tcp://[^:]*:\([0-9]*\).*#\1#p' "$sfdir/r$k.log" | head -1)
+    [ -n "${ports[$k]:-}" ] \
+      || { FAILS=$((FAILS + 1)); echo "FAILED: fleet replica $k never came up"; tail -5 "$sfdir/r$k.log"; }
+  done
+  if [ -n "${ports[0]:-}" ] && [ -n "${ports[1]:-}" ] && [ -n "${ports[2]:-}" ]; then
+    JAX_PLATFORMS=cpu python -m dtf_tpu.serve \
+        --connect "127.0.0.1:${ports[0]},127.0.0.1:${ports[1]},127.0.0.1:${ports[2]}" \
+        --listen :0 --admin_port 0 --seed 11 --health_dir "$sfdir/hb" \
+        --logdir "$sfdir/fleet" > "$sfdir/acc.log" 2>&1 &
+    apid=$!
+    for _ in $(seq 1 60); do
+      grep -q "fleet acceptor on tcp://" "$sfdir/acc.log" 2>/dev/null && break
+      sleep 0.5
+    done
+    fport=$(sed -n 's#.*fleet acceptor on tcp://[^:]*:\([0-9]*\).*#\1#p' "$sfdir/acc.log" | head -1)
+    aport=$(sed -n 's#.*admin endpoint on http://127.0.0.1:\([0-9]*\).*#\1#p' "$sfdir/acc.log" | head -1)
+    if [ -z "$fport" ] || [ -z "$aport" ]; then
+      FAILS=$((FAILS + 1)); echo "FAILED: fleet acceptor never came up"; tail -5 "$sfdir/acc.log"
+    else
+      JAX_PLATFORMS=cpu python - "$fport" "$aport" "${rpids[1]}" <<'PYEOF'
+import json, os, signal, sys, threading, time, urllib.request
+
+import jax
+from dtf_tpu.bench.serve_load import poisson_trace
+from dtf_tpu.models.gpt import GPT, GPTConfig
+from dtf_tpu.serve import ServingEngine, VirtualClock
+from dtf_tpu.serve.fleet import client_summary, drive_trace
+
+fport, aport, victim = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+cfg = GPTConfig.from_preset("tiny")
+model = GPT(cfg)
+params = model.init(jax.random.key(11))
+trace = poisson_trace(seed=11, n_requests=24, qps=6.0,
+                      prompt_lens=[4, 8], output_lens=[16],
+                      vocab_size=cfg.vocab_size, temperature=0.0)
+# the uninterrupted reference: one in-process engine on the virtual
+# clock (greedy tokens are clock-, batching- and replica-independent)
+eng = ServingEngine(model, params, seed=11, clock=VirtualClock())
+eng.run(trace)
+ref = {kw["rid"]: eng.results[kw["rid"]].tokens for _, kw in trace}
+assert all(ref.values()), "reference run rejected a request"
+
+fleetz = f"http://127.0.0.1:{aport}/fleetz"
+
+def kill_when_inflight():
+    # SIGKILL replica 1 the moment /fleetz shows it holding live legs —
+    # the failover is then provable, not a race against an idle replica
+    deadline = time.monotonic() + 25.0
+    while time.monotonic() < deadline:
+        try:
+            roll = json.load(urllib.request.urlopen(fleetz, timeout=5))
+            r1 = roll["replicas"]["1"]
+            if r1["state"] == "up" and r1["inflight"] >= 1:
+                break
+        except OSError:
+            pass
+        time.sleep(0.1)
+    os.kill(victim, signal.SIGKILL)
+
+killer = threading.Thread(target=kill_when_inflight, daemon=True)
+killer.start()
+res = drive_trace(("127.0.0.1", fport), trace, request_timeout_s=120.0)
+killer.join(timeout=30.0)
+cs = client_summary(res, slo_ttft_ms=2000.0)
+assert cs["lost"] == 0, f"lost requests across the SIGKILL: {cs}"
+assert cs["completed"] == len(trace), f"not all completed: {cs}"
+diffs = [i for i in range(len(trace))
+         if list(res[i]["tokens"]) != list(ref[i])]
+assert not diffs, f"token divergence vs reference at indices {diffs[:8]}"
+roll = json.load(urllib.request.urlopen(fleetz, timeout=5))
+assert roll["up"] == 2, f"expected 2/3 replicas up, got {roll['up']}"
+assert roll["totals"]["failovers"] >= 1, roll["totals"]
+print(f"serve-fleet OK: {cs['completed']}/{len(trace)} completed, 0 lost "
+      f"across SIGKILL of replica 1; {roll['totals']['failovers']} "
+      f"failover(s), {roll['totals']['replayed']} replayed, "
+      f"up={roll['up']}/{roll['size']}; tokens identical to "
+      f"uninterrupted reference")
+PYEOF
+      rc=$?
+      [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve-fleet drive (rc=$rc)"; tail -8 "$sfdir/acc.log"; }
+    fi
+    kill -TERM "$apid" 2>/dev/null
+    wait "$apid"
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: fleet acceptor shutdown (rc=$rc)"; tail -8 "$sfdir/acc.log"; }
+    python -m dtf_tpu.telemetry.report "$sfdir/fleet" --check \
+        > "$sfdir/report.log" 2>&1
+    rc=$?
+    [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: fleet report --check (rc=$rc)"; tail -5 "$sfdir/report.log"; }
+  fi
+  # replica 1 died by SIGKILL above (rc 137 is the lane working); 0 and
+  # 2 drain gracefully
+  kill -TERM "${rpids[0]}" "${rpids[2]}" 2>/dev/null
+  wait "${rpids[0]}" "${rpids[2]}" 2>/dev/null
+  rm -rf "$sfdir"
+fi
 # Decode-fast lane (DESIGN.md §7.5, ISSUE 14): the decode data path at
 # the hardware floor.  (1) paged-vs-baseline ladder A/B on tight AND
 # oversized pools: the narrowed path's marginal ms/token must be

@@ -70,7 +70,13 @@ class TestFaultPlanParse:
                     # positive, kv_poison is one-shot, no extra args
                     "slow_decode@5", "slow_decode@5:10ms:0",
                     "slow_decode@every:3:10ms:5", "kv_poison@every:3",
-                    "client_drop@3:1", "kv_poison@3:4"):
+                    "client_drop@3:1", "kv_poison@3:4",
+                    # fleet kinds: replica_down is one-shot (a dead
+                    # replica cannot die twice), wedge needs a duration,
+                    # conn_flake needs its target replica
+                    "replica_down@every:4", "replica_down@3:x",
+                    "replica_wedge@5", "replica_wedge@5:80ms:1:2",
+                    "conn_flake@3", "conn_flake@3:1:2"):
             with pytest.raises(ValueError):
                 FaultPlan.parse(bad)
 
@@ -129,6 +135,11 @@ class TestFaultPlanParse:
         # slowdowns, client drops, KV corruption
         "slow_decode@30:60ms,client_drop@10,kv_poison@20",
         "slow_decode@10:80ms:40,client_drop@every:4",
+        # the fleet kinds (ISSUE 16): abrupt replica death, wedges
+        # (one-shot GC pause + recurring flavor), flaky links
+        "replica_down@8:1,replica_wedge@5:250ms:2,conn_flake@3:0",
+        "replica_down@8,replica_wedge@every:4:100ms:1,"
+        "conn_flake@every:6:2",
     ])
     def test_spec_round_trips(self, spec):
         """str(parse(spec)) == spec, and re-parsing the printed form is a
@@ -220,6 +231,27 @@ class TestFaultPlanParse:
         assert kills == []                             # not this host
         here.maybe_step_faults(5)
         assert kills == [signal.SIGKILL]               # abrupt, no goodbye
+
+    def test_fleet_fault_hooks_and_process_filter_exemption(self):
+        """Fleet kinds are keyed on the ACCEPTOR's dispatch sequence and
+        their ``:P`` names the TARGET replica, not a host to fire on —
+        the acceptor owns the plan, so the host-match filter must NOT
+        apply (process_index=7 here matches none of the targets)."""
+        plan = FaultPlan.parse(
+            "replica_down@3:1,replica_wedge@5:80ms,conn_flake@2:1",
+            process_index=7)
+        assert plan.maybe_replica_down(2) is None
+        assert plan.maybe_conn_flake(2) == 1
+        assert plan.maybe_replica_down(3) == 1
+        assert plan.maybe_replica_down(3) is None      # one-shot
+        replica, dur = plan.maybe_replica_wedge(5)
+        assert replica == 0 and dur == pytest.approx(0.08)
+        assert plan.pending() == []
+
+    def test_periodic_fleet_faults_refire(self):
+        plan = FaultPlan.parse("conn_flake@every:3:0", process_index=0)
+        hits = [plan.maybe_conn_flake(s) for s in range(1, 8)]
+        assert hits == [None, None, 0, None, None, 0, None]
 
     def test_slow_host_delay_is_persistent(self):
         sleeps = []

@@ -159,6 +159,24 @@ class TestScheduler:
         assert s.submit(_req(2), 0.0) == "queued"
         assert s.submit(_req(3), 0.0) == "rejected_queue_full"
 
+    def test_cancel_with_duplicate_rid_in_queue_is_identity_based(self):
+        """Two LIVE Request objects may share a rid (a fleet acceptor's
+        failover/hedge resubmits a rid while the original copy still
+        sits queued on the old replica).  cancel() must tear out the
+        OBJECT it was handed — field equality on such a pair walks into
+        the numpy prompt and raised "truth value of an array is
+        ambiguous", crashing the engine driver (found by a fleet chaos
+        drive; Request is eq=False now)."""
+        s = _sched()
+        queued = _req(7)
+        twin = _req(7)                   # same rid, same-shape prompt
+        assert s.submit(queued, 0.0) == "queued"
+        assert queued != twin            # identity eq, not field eq
+        assert s.cancel(twin) == "gone"  # must not touch the queued copy
+        assert list(s.queue) == [queued]
+        assert s.cancel(queued, status="cancelled") == "queued"
+        assert not s.queue and queued.status == "cancelled"
+
     def test_worst_case_block_reservation(self):
         s = _sched()
         # prompt 5 pads to 8 rows (2 blocks); decode writes rows 5..7

@@ -1,0 +1,429 @@
+"""Fault-tolerant serving fleet (dtf_tpu/serve/fleet.py, ISSUE 16).
+
+Wall-clock socket tests against an in-process local fleet: routing +
+fleet-unique rid minting, replica-down failover with TOKEN-IDENTICAL
+replay (the client's stream is bitwise the uninterrupted single-engine
+reference), hedged dispatch (single winning stream, loser's KV blocks
+freed — the pool-leak pin), wedge detection via the stream timeout,
+conn-flake transience, rolling drain into the ``drain.r<k>.jsonl``
+namespace, acceptor-level brownout shedding (two-tier accounting), the
+drain-merge collision guard, and reqtrace continuity across a failover
+(one trace id spans both replicas, the replay submit marked
+``resubmit``).
+
+Every fleet here runs on the REAL wire (line-JSON TCP legs, one driver
+thread stepping all engines) — only the reference arm uses the virtual
+clock.  Temperature is pinned to 0 so token identity is a greedy-decode
+invariant, independent of rid assignment order.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dtf_tpu.resilience.chaos import FaultPlan
+from dtf_tpu.serve import ServingEngine, VirtualClock
+from dtf_tpu.serve.fleet import (FleetAcceptor, FleetConfig, Replica,
+                                 build_local_fleet, client_summary,
+                                 drive_trace, merge_drain_docs,
+                                 read_drain_files)
+
+pytestmark = pytest.mark.serve
+
+#: one engine shape for every arm — identity comparisons need it equal
+ENGINE_KW = dict(num_slots=2, block_size=4, blocks_per_slot=8,
+                 max_queue=64)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _cfg(**kw):
+    kw.setdefault("stream_timeout_s", 5.0)
+    kw.setdefault("connect_timeout_s", 2.0)
+    kw.setdefault("beat_stale_s", 10.0)
+    kw.setdefault("monitor_interval_s", 0.05)
+    return FleetConfig(**kw)
+
+
+def _fleet(model, params, n, **kw):
+    kw.setdefault("config", _cfg())
+    kw.setdefault("engine_kwargs", dict(ENGINE_KW))
+    return build_local_fleet(model, params, n, seed=0, **kw).start()
+
+
+def _trace(n, *, qps=100.0, max_new=8, p_len=4, vocab=128, seed=0,
+           **extra):
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for i in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        out.append((t, {"rid": i,
+                        "prompt": [int(x) for x in
+                                   rng.integers(0, vocab, (p_len,))],
+                        "max_new_tokens": max_new, "temperature": 0.0,
+                        **extra}))
+    return out
+
+
+def _reference_tokens(model, params, trace):
+    """The uninterrupted ground truth: ONE virtual-clock engine, same
+    seed and shape, same trace — trace index -> token list."""
+    eng = ServingEngine(model, params, seed=0, clock=VirtualClock(),
+                        **ENGINE_KW)
+    eng.run([(t, {**kw, "prompt": np.asarray(kw["prompt"], np.int32)})
+             for t, kw in trace])
+    return {rid: list(req.tokens) for rid, req in eng.results.items()}
+
+
+def _assert_identical(res, ref):
+    for i, rec in res.items():
+        assert rec["status"] == "completed", (i, rec["status"])
+        assert rec["tokens"] == ref[i], f"request {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# routing + fleet-unique rids
+# ---------------------------------------------------------------------------
+
+
+class TestFleetServes:
+    def test_routes_completes_token_identity_and_unique_rids(
+            self, tiny_model):
+        model, params = tiny_model
+        trace = _trace(6)
+        ref = _reference_tokens(model, params, trace)
+        acc = _fleet(model, params, 2)
+        try:
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+            _assert_identical(res, ref)
+            cs = client_summary(res, slo_ttft_ms=10_000.0)
+            assert cs["completed"] == 6 and cs["lost"] == 0
+            # the rid-collision fix: the acceptor mints fleet-unique
+            # rids, so the two engines' result namespaces are DISJOINT
+            r0 = set(acc.replicas[0].engine.results)
+            r1 = set(acc.replicas[1].engine.results)
+            assert not (r0 & r1)
+            assert len(r0 | r1) == 6
+            # acceptor control line: the /fleetz rollup over the wire
+            import socket as _socket
+            with _socket.create_connection(acc.address, timeout=5.0) as s:
+                s.sendall(b'{"stats": true}\n')
+                doc = json.loads(s.makefile("rb").readline())
+            assert doc["ok"] and len(doc["fleet"]["replicas"]) == 2
+            assert doc["fleet"]["totals"]["completed"] == 6
+        finally:
+            acc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# replica failure domains: kill, wedge, flake
+# ---------------------------------------------------------------------------
+
+
+class TestFailover:
+    def test_replica_down_replays_token_identically(self, tiny_model):
+        model, params = tiny_model
+        trace = _trace(8, qps=200.0, max_new=16)
+        ref = _reference_tokens(model, params, trace)
+        acc = _fleet(model, params, 2)
+        try:
+            acc.arm_chaos(FaultPlan.parse("replica_down@2:0",
+                                          process_index=0))
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+            _assert_identical(res, ref)          # bitwise, across the kill
+            t = acc.totals()
+            assert t["failovers"] >= 1 and t["replayed"] >= 1
+            assert acc.replicas[0].state == "down"
+            assert acc.replicas[0].down_reason == "chaos_kill"
+            assert client_summary(res, slo_ttft_ms=10_000.0)["lost"] == 0
+        finally:
+            acc.shutdown()
+
+    def test_wedged_replica_fails_over_via_stream_timeout(
+            self, tiny_model):
+        """A wedge is the nasty failure mode: the socket ACCEPTS but the
+        engine never steps — detection must come from the response-stream
+        timeout, not a clean connection error."""
+        model, params = tiny_model
+        trace = _trace(1, max_new=8)
+        ref = _reference_tokens(model, params, trace)
+        acc = _fleet(model, params, 2,
+                     config=_cfg(stream_timeout_s=1.5))
+        try:
+            acc.arm_chaos(FaultPlan.parse("replica_wedge@1:8000ms:0",
+                                          process_index=0))
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+            _assert_identical(res, ref)
+            assert acc.totals()["failovers"] >= 1
+        finally:
+            acc.shutdown()
+
+    def test_conn_flake_is_transient(self, tiny_model):
+        """A severed acceptor<->replica socket fails the leg over but the
+        replica STAYS in rotation — flake != death."""
+        model, params = tiny_model
+        # arrivals ~20ms apart with long streams: by dispatch 3 the
+        # first legs are established and mid-stream, so the severed
+        # socket provably interrupts live work (no admission race)
+        trace = _trace(6, qps=50.0, max_new=24)
+        ref = _reference_tokens(model, params, trace)
+        acc = _fleet(model, params, 2)
+        try:
+            acc.arm_chaos(FaultPlan.parse("conn_flake@3:0",
+                                          process_index=0))
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+            _assert_identical(res, ref)
+            assert acc.totals()["failovers"] >= 1
+            assert acc.replicas[0].state == "up"
+        finally:
+            acc.shutdown()
+
+    def test_failover_trace_continuity(self, tiny_model, tmp_path):
+        """ISSUE 16 observability pin: a failed-over request's reqtrace
+        chain spans BOTH replicas under ONE trace id — two submit
+        events, the replay's marked ``resubmit`` — and completeness over
+        the whole run stays 1.0 (failover does not cost attribution)."""
+        from dtf_tpu import telemetry as tel
+        from dtf_tpu.telemetry import reqtrace
+
+        tel.configure(str(tmp_path))
+        model, params = tiny_model
+        # spaced arrivals + long streams: by dispatch 3 the first
+        # request is mid-decode on replica 0 (its submit span already
+        # emitted THERE), so the kill provably splits a live trace
+        # across the two replicas
+        trace = _trace(6, qps=50.0, max_new=24)
+        acc = _fleet(model, params, 2)
+        try:
+            acc.arm_chaos(FaultPlan.parse("replica_down@3:0",
+                                          process_index=0))
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+        finally:
+            acc.shutdown()
+        assert acc.totals()["replayed"] >= 1
+        assert all(r["status"] == "completed" for r in res.values())
+        tel.get_tracer().flush()
+        traces = reqtrace.group_traces(
+            reqtrace.load_request_events(str(tmp_path)))
+        comp = reqtrace.completeness(traces)
+        assert comp["completed"] >= 6
+        assert comp["complete_frac"] == 1.0, comp["incomplete"]
+        replayed = [evs for evs in traces.values()
+                    if sum(e["phase"] == "submit" for e in evs) >= 2]
+        assert replayed, "no trace spans the failover"
+        assert any(e.get("resubmit") for evs in replayed for e in evs
+                   if e["phase"] == "submit")
+
+
+# ---------------------------------------------------------------------------
+# rid supersede: ONE live request per rid per engine
+# ---------------------------------------------------------------------------
+
+
+class TestRidSupersede:
+    def test_resubmitted_rid_supersedes_live_copy(self, tiny_model):
+        """A failover/hedge replay can resubmit a rid whose earlier copy
+        is still LIVE on the target engine — the leg's cancel races the
+        resubmit through the frontend mailbox.  The new submission must
+        tear the stale copy out first: two live requests under one rid
+        cross-wire their token streams into the bridge's per-rid queue
+        and the acceptor's replay-prefix verification (correctly) fails
+        the request (found by a fleet chaos drive)."""
+        model, params = tiny_model
+        prompt = np.arange(4, dtype=np.int32)
+        ref_eng = ServingEngine(model, params, seed=0,
+                                clock=VirtualClock(), **ENGINE_KW)
+        ref = ref_eng.submit(prompt, 8, rid=9)
+        for _ in range(100):
+            if ref.status == "completed":
+                break
+            ref_eng.step()
+        assert ref.status == "completed"
+
+        eng = ServingEngine(model, params, seed=0, clock=VirtualClock(),
+                            **ENGINE_KW)
+        old = eng.submit(prompt, 8, rid=9)
+        for _ in range(4):            # admit + prefill + a few decodes
+            eng.step()
+        assert old.status == "running" and len(old.tokens) >= 1
+        new = eng.submit(prompt, 8, rid=9, resubmit=True)
+        assert old.status == "cancelled"       # stale copy torn out
+        for _ in range(100):
+            if new.status == "completed":
+                break
+            eng.step()
+        assert new.status == "completed"
+        assert list(new.tokens) == list(ref.tokens)  # fresh full stream
+
+
+# ---------------------------------------------------------------------------
+# hedged dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestHedging:
+    def test_hedge_single_stream_and_no_pool_leak(self, tiny_model):
+        """The double-emit / leaked-KV pin: with hedging forced on every
+        request, the client still sees EXACTLY ONE stream per request
+        and, once quiesced, every allocator is back to its pre-run free
+        count — the cancelled loser's blocks came home."""
+        model, params = tiny_model
+        trace = _trace(4, qps=300.0, max_new=8, priority=1)
+        ref = _reference_tokens(model, params, trace)
+        acc = _fleet(model, params, 2,
+                     config=_cfg(hedge_priority=1, hedge_delay_ms=1.0))
+        free0 = [r.engine.scheduler.allocator.free_blocks
+                 for r in acc.replicas]
+        try:
+            res = drive_trace(acc.address, trace, request_timeout_s=60.0)
+            _assert_identical(res, ref)
+            for rec in res.values():
+                assert len(rec["tokens"]) == 8      # one stream, no dupes
+            t = acc.totals()
+            assert t["hedged"] >= 1
+            assert t["hedge_wins"] + t["hedge_cancelled"] >= 1
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                free = [r.engine.scheduler.allocator.free_blocks
+                        for r in acc.replicas]
+                if free == free0:
+                    break
+                time.sleep(0.02)
+            assert free == free0, f"leaked KV blocks: {free} != {free0}"
+        finally:
+            acc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rolling drain
+# ---------------------------------------------------------------------------
+
+
+class TestRollingDrain:
+    def test_drain_replica_namespaces_and_fails_over(self, tiny_model,
+                                                     tmp_path):
+        model, params = tiny_model
+        trace = _trace(12, qps=400.0, max_new=16)
+        acc = _fleet(model, params, 2, logdir=str(tmp_path))
+        try:
+            box = {}
+
+            def run():
+                box["res"] = drive_trace(acc.address, trace,
+                                         request_timeout_s=60.0)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            # wait for replica 0 to actually hold work, then drain it
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if (acc.replicas[0].inflight > 0
+                        or acc.replicas[0].engine.scheduler.has_work()):
+                    break
+                time.sleep(0.005)
+            acc.drain_replica(0)
+            th.join(timeout=60.0)
+            assert not th.is_alive()
+            res = box["res"]
+            assert all(r["status"] == "completed" for r in res.values())
+            assert client_summary(res,
+                                  slo_ttft_ms=10_000.0)["lost"] == 0
+            assert acc.replicas[0].state == "down"
+            assert acc.replicas[0].down_reason == "drained"
+            # the namespace fix: per-replica drain files, and the merged
+            # read is collision-checked
+            path = tmp_path / "drain.r0.jsonl"
+            if path.exists():                     # queued work remained
+                docs = read_drain_files(str(tmp_path))
+                assert all("rid" in d for d in docs)
+        finally:
+            acc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# acceptor-level brownout (two-tier shed)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetBrownout:
+    def _acceptor(self):
+        return FleetAcceptor([Replica(0, ("127.0.0.1", 1)),
+                              Replica(1, ("127.0.0.1", 2))])
+
+    def test_sheds_low_priority_when_all_replicas_degraded(self):
+        acc = self._acceptor()
+        try:
+            for r in acc.replicas:
+                r.stats = {"brownout_level": 2}
+            parsed = {"trace_id": "t-low", "priority": 0}
+            fl, shed = acc._admit({}, parsed)
+            assert shed is not None
+            assert shed["status"] == "shed_fleet_brownout"
+            # latency-critical traffic still admits (two-tier: the
+            # replicas' own brownout governs it from here)
+            fl, shed = acc._admit({}, {"trace_id": "t-hi", "priority": 1})
+            assert shed is None
+        finally:
+            acc.server.server_close()
+
+    def test_one_degraded_replica_does_not_brown_out_fleet(self):
+        acc = self._acceptor()
+        try:
+            acc.replicas[0].stats = {"brownout_level": 3}
+            acc.replicas[1].stats = {"brownout_level": 0}
+            fl, shed = acc._admit({}, {"trace_id": "t", "priority": 0})
+            assert shed is None
+        finally:
+            acc.server.server_close()
+
+    def test_sheds_everything_with_no_live_replicas(self):
+        acc = self._acceptor()
+        try:
+            for r in acc.replicas:
+                r.state = "down"
+            fl, shed = acc._admit({}, {"trace_id": "t", "priority": 5})
+            assert shed is not None
+            assert shed["status"] == "shed_fleet_no_replicas"
+        finally:
+            acc.server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# drain-merge collision guard
+# ---------------------------------------------------------------------------
+
+
+class TestMergeDrainDocs:
+    def test_disjoint_namespaces_merge_sorted(self):
+        out = merge_drain_docs([[{"rid": 3}, {"rid": 1}],
+                                [{"rid": 2}]])
+        assert [d["rid"] for d in out] == [1, 2, 3]
+
+    def test_collision_fails_loudly(self):
+        with pytest.raises(ValueError, match="rid collision"):
+            merge_drain_docs([[{"rid": 0}], [{"rid": 0}]])
+
+    def test_read_drain_files_roundtrip(self, tmp_path):
+        for k, rids in ((0, [0, 2]), (1, [1, 5])):
+            with open(tmp_path / f"drain.r{k}.jsonl", "w") as f:
+                for rid in rids:
+                    f.write(json.dumps({"rid": rid, "prompt": [1]}) + "\n")
+        docs = read_drain_files(str(tmp_path))
+        assert [d["rid"] for d in docs] == [0, 1, 2, 5]
+
+    def test_read_drain_files_collision(self, tmp_path):
+        for k in (0, 1):
+            with open(tmp_path / f"drain.r{k}.jsonl", "w") as f:
+                f.write(json.dumps({"rid": 7}) + "\n")
+        with pytest.raises(ValueError, match="rid collision"):
+            read_drain_files(str(tmp_path))
