@@ -54,11 +54,41 @@ AB_MIN_RATIO = 1.5
 FLEET_AB_MIN_RATIO = 1.6
 
 
+#: qps_profile shapes: multiplicative modulation of the base rate over
+#: the trace's expected constant-rate makespan ``span = n/qps``.  Every
+#: shape stays within [0.5, 1.5]x (never zero — arrivals always make
+#: progress) and every profile REUSES the same unit-rate exponential
+#: chain and the same per-request draws, so request CONTENTS are
+#: identical across profiles — only arrival instants move.
+QPS_PROFILES = ("constant", "ramp", "square", "sine")
+
+
+def _profile_rate(profile: str, qps: float, t: float,
+                  span: float) -> float:
+    """Instantaneous arrival rate at trace time ``t``."""
+    if profile == "constant" or span <= 0.0:
+        return qps
+    if profile == "ramp":
+        # 0.5x -> 1.5x linearly over the span, held at 1.5x past it
+        return qps * (0.5 + min(t / span, 1.0))
+    if profile == "square":
+        # oscillating load: 1.5x / 0.5x alternating, period span/4
+        level = int(t // (span / 8.0)) % 2
+        return qps * (1.5 if level == 0 else 0.5)
+    if profile == "sine":
+        # two full cycles over the span, 1.0x mean
+        import math
+        return qps * (1.0 + 0.5 * math.sin(4.0 * math.pi * t / span))
+    raise ValueError(f"unknown qps_profile {profile!r} "
+                     f"(choices: {QPS_PROFILES})")
+
+
 def poisson_trace(*, seed: int, n_requests: int, qps: float,
                   prompt_lens: List[int], output_lens: List[int],
                   vocab_size: int, temperature: float = 0.0,
                   deadline_ms: Optional[float] = None,
                   priorities: Optional[List[int]] = None,
+                  qps_profile: str = "constant",
                   ) -> List[Tuple[float, dict]]:
     """Seeded Poisson arrivals with lengths drawn uniformly from the
     mixed pools.  The arrival process is a UNIT-RATE exponential chain
@@ -70,12 +100,23 @@ def poisson_trace(*, seed: int, n_requests: int, qps: float,
     ``deadline_ms`` attaches one completion deadline to every request;
     ``priorities`` is a pool each request's priority class is drawn
     from (uniform, seeded — drawn LAST so traces with the default
-    single-class pool keep the exact token streams of older traces)."""
+    single-class pool keep the exact token streams of older traces).
+
+    ``qps_profile`` shapes the arrival RATE over time (inhomogeneous
+    Poisson, rate held constant across each inter-arrival gap): the rng
+    draw order is untouched, so every profile serves the exact same
+    request contents — an adversarial-load A/B moves only WHEN requests
+    land, never WHAT they are."""
+    if qps_profile not in QPS_PROFILES:
+        raise ValueError(f"unknown qps_profile {qps_profile!r} "
+                         f"(choices: {QPS_PROFILES})")
     rng = np.random.default_rng(seed)
     trace: List[Tuple[float, dict]] = []
+    span = n_requests / qps     # the profile's time base
     t = 0.0
     for rid in range(n_requests):
-        t += float(rng.exponential(1.0)) / qps
+        t += (float(rng.exponential(1.0))
+              / _profile_rate(qps_profile, qps, t, span))
         p = int(rng.choice(prompt_lens))
         kw = {
             "rid": rid,
@@ -116,7 +157,8 @@ def run_point(model, params, *, mode: str, qps: float, ns,
         seed=ns.seed, n_requests=ns.requests,
         qps=qps, prompt_lens=ns.prompt_lens_list,
         output_lens=ns.output_lens_list,
-        vocab_size=_trace_vocab(model, ns), temperature=ns.temperature)
+        vocab_size=_trace_vocab(model, ns), temperature=ns.temperature,
+        qps_profile=getattr(ns, "qps_profile", "constant"))
     engine.run(trace)
     out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
     out["offered_qps"] = qps
@@ -169,7 +211,8 @@ def run_chaos_point(model, params, *, controller: bool, ns) -> Dict:
         prompt_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
         vocab_size=model.cfg.vocab_size, temperature=ns.temperature,
         deadline_ms=ns.deadline_ms or None,
-        priorities=ns.priorities_list)
+        priorities=ns.priorities_list,
+        qps_profile=getattr(ns, "qps_profile", "constant"))
     engine.run(trace)
     out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
     out["controller"] = controller
@@ -530,6 +573,130 @@ def chaos_ab(model, params, ns) -> Dict:
             "gates": lines, "ok": ok}
 
 
+def run_knob_point(model, params, *, knobs: bool, ns) -> Tuple[Dict, object]:
+    """One adversarial-load run with or without the self-tuning knob
+    controller (dtf_tpu/control).  The two arms share EVERYTHING — the
+    seeded trace (same qps_profile shape), the fault plan, the brownout
+    config, the SLO monitor, the engine geometry — so the delta is
+    attributable to the knob controller alone.  Returns ``(summary,
+    engine)``; the summary's ``control`` section (knob positions,
+    decisions, rollbacks + reasons) is what :func:`knob_gates` judges."""
+    from dtf_tpu.serve import (BrownoutController, ServingEngine,
+                               VirtualClock, WallClock)
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
+
+    clock = VirtualClock() if ns.clock == "virtual" else WallClock()
+    chaos = None
+    if ns.chaos:
+        from dtf_tpu.resilience.chaos import FaultPlan
+        chaos = FaultPlan.parse(ns.chaos, process_index=0)
+    brownout = BrownoutController(ns.slo_ttft_ms,
+                                  degrade_max_new=ns.degrade_max_new)
+    slo = BurnRateMonitor.for_serving(ns.slo_ttft_ms)
+    engine = ServingEngine(
+        model, params, num_slots=ns.slots, block_size=ns.block_size,
+        num_blocks=ns.pool_blocks, mode="continuous", seed=ns.seed,
+        clock=clock, max_queue=ns.max_queue, top_k=ns.top_k,
+        top_p=ns.top_p, brownout=brownout, chaos=chaos, slo=slo,
+        spec_k=ns.spec_k)
+    if knobs:
+        from dtf_tpu.control import arm_controller
+        arm_controller(engine)
+    trace = poisson_trace(
+        seed=ns.seed, n_requests=ns.requests, qps=ns.qps_list[0],
+        prompt_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
+        vocab_size=_trace_vocab(model, ns), temperature=ns.temperature,
+        deadline_ms=ns.deadline_ms or None,
+        priorities=ns.priorities_list, qps_profile=ns.qps_profile)
+    engine.run(trace)
+    out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
+    out["knob_controller"] = knobs
+    out["offered_qps"] = ns.qps_list[0]
+    out["qps_profile"] = ns.qps_profile
+    out["chaos"] = ns.chaos
+    return out, engine
+
+
+def knob_gates(on: Dict, off: Dict,
+               max_rollbacks: Optional[int]) -> Tuple[bool, List[str]]:
+    """The self-tuning control-plane acceptance gates (ISSUE 17):
+
+    * **goodput strictly improves** — the knob-controller arm beats the
+      pinned-knob arm on the same trace under the same adversarial load
+      shape (the controller pays for itself or it does not ship);
+    * **latency no worse** — p99 TTFT and p99 TPOT do not regress
+      versus the pinned arm (a goodput win bought with a latency
+      blow-up is not a win);
+    * **knobs actually moved** — the controller made decisions AND at
+      least one audited knob set landed, so the delta is attributable
+      to knob motion, not noise;
+    * **every rollback explained** — each snap-back is booked with a
+      reason (``fast_burn`` / ``no_improvement``); an unexplained
+      rollback means an unaudited mutation path exists.  With
+      ``max_rollbacks`` armed the count is also bounded.
+    """
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, passed, detail):
+        nonlocal ok
+        ok = ok and passed
+        lines.append(f"gate {name}: {'OK' if passed else 'FAIL'} — "
+                     f"{detail}")
+
+    g_on = on.get("goodput_qps", 0.0)
+    g_off = off.get("goodput_qps", 0.0)
+    gate("knob_controller_improves_goodput", g_on > g_off,
+         f"goodput {g_on:.3f} qps with knob controller vs {g_off:.3f} "
+         f"pinned (same trace, same load shape)")
+    t_on, t_off = on.get("ttft_ms_p99"), off.get("ttft_ms_p99")
+    d_on, d_off = on.get("tpot_ms_p99"), off.get("tpot_ms_p99")
+    gate("knob_latency_no_worse",
+         (t_on is not None and t_off is not None and t_on <= t_off
+          and d_on is not None and d_off is not None and d_on <= d_off),
+         f"ttft p99 {t_on} vs {t_off} ms, tpot p99 {d_on} vs {d_off} ms "
+         f"(controller vs pinned)")
+    ctl = on.get("control") or {}
+    gate("knob_decisions_made",
+         ctl.get("decisions", 0) > 0 and ctl.get("sets", 0) > 0,
+         f"{ctl.get('decisions', 0)} decision(s), "
+         f"{ctl.get('sets', 0)} audited knob set(s), final knobs "
+         f"{ctl.get('knobs')}")
+    rb = ctl.get("rollbacks", 0)
+    explained = sum((ctl.get("rollback_reasons") or {}).values())
+    bounded = max_rollbacks is None or rb <= max_rollbacks
+    gate("knob_rollbacks_explained", rb == explained and bounded,
+         f"{rb} rollback(s), {explained} with reasons "
+         f"{ctl.get('rollback_reasons')}"
+         + (f", bound {max_rollbacks}" if max_rollbacks is not None
+            else ""))
+    return ok, lines
+
+
+def knob_ab(model, params, ns) -> Dict:
+    """Same-trace knob-controller on/off A/B under the adversarial load
+    shape (--qps_profile) and/or fault plan (--chaos)."""
+    on, eng_on = run_knob_point(model, params, knobs=True, ns=ns)
+    off, _ = run_knob_point(model, params, knobs=False, ns=ns)
+    ok, lines = knob_gates(on, off, ns.max_control_rollbacks)
+    if ns.logdir:
+        import os
+        os.makedirs(ns.logdir, exist_ok=True)
+        eng_on.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
+    for arm, s in (("knobs", on), ("pinned", off)):
+        ctl = s.get("control") or {}
+        print(f"  [{arm:>6}] completed {s.get('completed', 0):3d}  "
+              f"ttft p99 {s.get('ttft_ms_p99', float('nan')):8.1f} ms  "
+              f"tpot p99 {s.get('tpot_ms_p99', float('nan')):6.2f} ms  "
+              f"goodput {s.get('goodput_qps', 0.0):6.2f} qps"
+              + (f"  sets {ctl.get('sets', 0)} "
+                 f"rollbacks {ctl.get('rollbacks', 0)}"
+                 if ctl else ""), flush=True)
+    return {"qps_profile": ns.qps_profile, "chaos": ns.chaos,
+            "slo_ttft_ms": ns.slo_ttft_ms, "clock": ns.clock,
+            "knobs": on, "pinned": off, "gates": lines, "ok": ok}
+
+
 def sweep(model, params, ns) -> Dict:
     modes = (["continuous", "static"] if ns.mode == "both" else [ns.mode])
     points: List[Dict] = []
@@ -627,6 +794,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "point (fixed-rate mode); --check gates token "
                         "identity + strict p99 TPOT improvement + "
                         "acceptance > 0")
+    p.add_argument("--qps_profile", default="constant",
+                   choices=list(QPS_PROFILES),
+                   help="arrival-rate shape around the offered rate "
+                        "(same seeded request contents, only arrival "
+                        "times move): ramp 0.5x->1.5x, square "
+                        "oscillation, sine — the adversarial shapes "
+                        "the knob controller is judged under")
+    p.add_argument("--knob_ab", action="store_true",
+                   help="same-trace self-tuning knob-controller on/off "
+                        "A/B (dtf_tpu/control) at the FIRST --qps "
+                        "point under --qps_profile and/or --chaos; "
+                        "--check gates strict goodput improvement + "
+                        "latency no worse + audited knob motion + "
+                        "zero unexplained rollbacks")
+    p.add_argument("--max_control_rollbacks", type=int, default=None,
+                   help="with --knob_ab: also bound the controller "
+                        "arm's snap-back count (same threshold "
+                        "telemetry.report --max_control_rollbacks "
+                        "arms on a telemetry.json)")
     p.add_argument("--replicas", type=int, default=None, metavar="N",
                    help="fleet A/B (serve/fleet.py): N replicas vs a "
                         "single replica on the SAME trace over real "
@@ -675,7 +861,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             # fleet arms serve real sockets; force the wall clock the
             # same way --listen does
             ns.clock = "wall"
-    if ns.chaos and ns.replicas is None and ns.mode != "continuous":
+    if (ns.chaos and ns.replicas is None and not ns.knob_ab
+            and ns.mode != "continuous"):
         p.error("--chaos is the overload/brownout gate; it runs the "
                 "continuous engine (--mode continuous)")
     if ns.spec_ab and ns.spec_k < 1:
@@ -683,12 +870,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.spec_ab and ns.chaos:
         p.error("--spec_ab and --chaos are separate A/Bs; run them "
                 "as separate invocations")
-    if (ns.check and not ns.chaos and not ns.spec_ab
+    if ns.knob_ab and (ns.spec_ab or ns.replicas is not None):
+        p.error("--knob_ab is its own A/B; run --spec_ab/--replicas "
+                "as separate invocations")
+    if (ns.check and not ns.chaos and not ns.spec_ab and not ns.knob_ab
             and ns.replicas is None and ns.mode != "both"):
         p.error("--check needs --mode both (it asserts the A/B ratio), "
                 "--chaos (the overload gates), --spec_ab (the "
-                "speculative-decoding gates), or --replicas (the "
-                "fleet gates)")
+                "speculative-decoding gates), --knob_ab (the control-"
+                "plane gates), or --replicas (the fleet gates)")
 
     import jax
 
@@ -701,7 +891,9 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"block_size={ns.block_size} clock={ns.clock} "
           f"slo_ttft_ms={ns.slo_ttft_ms}"
           + (f" chaos={ns.chaos}" if ns.chaos else "")
-          + (f" spec_k={ns.spec_k}" if ns.spec_k else ""), flush=True)
+          + (f" spec_k={ns.spec_k}" if ns.spec_k else "")
+          + (f" qps_profile={ns.qps_profile}"
+             if ns.qps_profile != "constant" else ""), flush=True)
     if ns.replicas is not None:
         result = fleet_ab(model, params, ns)
         for line in result["gates"]:
@@ -729,6 +921,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not result["ok"]:
                 print("CHECK FAILED: speculative-decoding gates "
                       "(see above)", file=sys.stderr)
+                return 1
+            print("CHECK OK")
+        return 0
+    if ns.knob_ab:
+        result = knob_ab(model, params, ns)
+        for line in result["gates"]:
+            print(line, flush=True)
+        if ns.json:
+            with open(ns.json, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {ns.json}")
+        if ns.check:
+            if not result["ok"]:
+                print("CHECK FAILED: control-plane gates (see above)",
+                      file=sys.stderr)
                 return 1
             print("CHECK OK")
         return 0

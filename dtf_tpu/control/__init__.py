@@ -1,0 +1,13 @@
+"""Self-tuning control plane (DESIGN.md §9): the runtime knob registry,
+the SLO-driven knob controller with safety rails, and the standard
+serving-knob wiring.  Jax-free by construction — everything here is
+host-side bookkeeping on the engine-iteration cadence."""
+
+from dtf_tpu.control.controller import (KnobController,  # noqa: F401
+                                        default_policy)
+from dtf_tpu.control.knobs import Knob, KnobRegistry  # noqa: F401
+from dtf_tpu.control.wire import (arm_controller,  # noqa: F401
+                                  wire_serve_knobs)
+
+__all__ = ["Knob", "KnobRegistry", "KnobController", "default_policy",
+           "wire_serve_knobs", "arm_controller"]

@@ -1,0 +1,316 @@
+"""The SLO-driven knob controller: rule-based policies with hysteresis,
+wrapped in safety rails.
+
+Closes the observe->act loop PRs 9-14 left open: the brownout ladder,
+SLO burn alerts, KV-pool gauges and speculative-acceptance counters all
+existed, but a human still turned the dials.  :class:`KnobController`
+runs on the engine-iteration cadence (jax-free — one host-side method
+call per iteration, decisions every ``period`` iterations), reads ONE
+consistent cut of its inputs (knob registry snapshot + SLO burn state +
+brownout state + the ``serve/kv_*`` / queue gauges, the gauge reads
+under the metric-registry lock), and actuates through the
+:class:`~dtf_tpu.control.knobs.KnobRegistry`'s single audited path.
+
+The default policy is deliberately boring — small hysteretic rules, one
+quantum per decision:
+
+* raise ``spec_k`` while draft acceptance is high (or unprobed) and
+  there is latency pressure to spend it on; lower it when acceptance
+  collapses (the verify premium stops paying);
+* widen ``prefill_token_budget`` under queue pressure while the KV pool
+  has room; shrink it under pool pressure or fast burn;
+* cheapen brownout-degraded answers (``degrade_max_new``) while burn is
+  high and the ladder is engaged; restore when calm;
+* engage the brownout earlier (``brownout_enter_ratio`` down) under
+  sustained slow burn; relax back toward the default when quiet.
+
+Safety rails (the headline robustness property):
+
+* per-decision step sizes and per-knob cooldowns are enforced by the
+  registry, not trusted to the policy;
+* **fast-burn guard** — a NEW fast-burn alert (the monitor's
+  edge-triggered alert count advancing) while knobs are off their
+  pinned defaults snaps every knob back (``control/rollback_total`` +
+  a ``control/rollback`` instant).  Edge-triggered on purpose: an
+  alert that was already firing BEFORE any knob moved is background
+  load the policy should fight, not evidence against the knobs — only
+  an alert that arrives after a mutation indicts it;
+* **no-improvement guard** — each decision records the pre-decision
+  SLO bad-event fraction; if, ``improve_window`` iterations later, the
+  post-decision window's bad fraction got WORSE by more than
+  ``improve_margin``, the decision is judged harmful and everything
+  snaps back.  An injected always-worsening policy therefore rolls the
+  system back to its pinned operating point within one window (pinned
+  by tests/test_control.py — the falsifiability half of "self-tuning");
+* after any rollback the controller holds off (``hold_iters``) before
+  proposing again, so a persistently hostile environment degenerates to
+  the pinned-knob baseline instead of thrashing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from dtf_tpu import telemetry as tel
+from dtf_tpu.control.knobs import KnobRegistry
+
+#: A policy maps a signal dict to [(knob, delta, reason), ...].
+Policy = Callable[[dict, dict], List[Tuple[str, float, str]]]
+
+
+def default_policy(signals: dict, knobs: dict
+                   ) -> List[Tuple[str, float, str]]:
+    """The rule table above.  ``knobs`` is the registry snapshot's knob
+    map (value/default/quantum per name); rules propose at most one
+    quantum each — the registry's max_step clamp is the rail, this is
+    just the polite default."""
+    props: List[Tuple[str, float, str]] = []
+    fast = signals.get("fast_burn_max", 0.0)
+    slow = signals.get("slow_burn_max", 0.0)
+    kv = signals.get("kv_frac", 0.0)
+    queue = signals.get("queue_depth", 0.0)
+    level = signals.get("brownout_level", 0)
+    acc = signals.get("spec_acceptance")     # None until first proposals
+    pressure = queue > 0 or fast >= 0.5 or slow >= 0.5
+
+    k = knobs.get("spec_k")
+    if k is not None:
+        if (k["value"] < k["hi"] and pressure
+                and (acc is None or acc >= 0.5)):
+            props.append(("spec_k", +k["quantum"],
+                          "probe" if acc is None else "accept_high"))
+        elif k["value"] > 0 and acc is not None and acc < 0.2:
+            props.append(("spec_k", -k["quantum"], "accept_low"))
+
+    k = knobs.get("prefill_token_budget")
+    if k is not None:
+        if kv > 0.85 or fast >= 1.0:
+            if k["value"] > k["lo"]:
+                props.append(("prefill_token_budget", -k["quantum"],
+                              "kv_pressure" if kv > 0.85 else "fast_burn"))
+        elif queue > signals.get("slots", 4) and kv < 0.6 \
+                and k["value"] < k["hi"]:
+            props.append(("prefill_token_budget", +k["quantum"],
+                          "queue_pressure"))
+
+    k = knobs.get("degrade_max_new")
+    if k is not None:
+        if level >= 1 and slow >= 1.0 and k["value"] > k["lo"]:
+            props.append(("degrade_max_new", -k["quantum"],
+                          "brownout_cheapen"))
+        elif level == 0 and slow < 0.25 and k["value"] < k["default"]:
+            props.append(("degrade_max_new", +k["quantum"], "recover"))
+
+    k = knobs.get("brownout_enter_ratio")
+    if k is not None:
+        if slow >= 2.0 and k["value"] > k["lo"]:
+            props.append(("brownout_enter_ratio", -k["quantum"],
+                          "sustained_burn"))
+        elif slow < 0.25 and k["value"] < k["default"]:
+            props.append(("brownout_enter_ratio", +k["quantum"], "relax"))
+    return props
+
+
+class KnobController:
+    """See module docstring.  ``slo`` is a :class:`~dtf_tpu.telemetry.
+    slo.BurnRateMonitor` (required — the controller's objective IS the
+    SLO), ``brownout`` a :class:`~dtf_tpu.serve.brownout.
+    BrownoutController` or None, ``acceptance_fn`` an optional callable
+    returning cumulative ``(proposed, accepted)`` draft counts (the
+    engine's spec counters)."""
+
+    def __init__(self, registry: KnobRegistry, *, slo,
+                 brownout=None,
+                 acceptance_fn: Optional[Callable[[], Tuple[int, int]]]
+                 = None,
+                 policy: Policy = default_policy,
+                 period: int = 8, improve_window: int = 32,
+                 improve_margin: float = 0.10, min_window_events: int = 4,
+                 hold_iters: int = 64):
+        if slo is None:
+            raise ValueError("KnobController needs a BurnRateMonitor — "
+                             "its objective is the SLO")
+        self.registry = registry
+        self.slo = slo
+        self.brownout = brownout
+        self.acceptance_fn = acceptance_fn
+        self.policy = policy
+        self.period = int(period)
+        self.improve_window = int(improve_window)
+        self.improve_margin = float(improve_margin)
+        self.min_window_events = int(min_window_events)
+        self.hold_iters = int(hold_iters)
+
+        self._last_eval: Optional[int] = None
+        self._hold_until: Optional[int] = None
+        #: fast-alert count at the last decision (edge detector for
+        #: rail 1; None until the first sense)
+        self._alerts_seen: Optional[int] = None
+        #: open decision under the no-improvement guard:
+        #: {"iteration", "bad", "events", "bad_frac"} at decision time
+        self._pending: Optional[dict] = None
+        self.decisions = 0
+        self.rollbacks = 0
+        self.rollback_reasons: dict = {}
+        # rollback_total registers EAGERLY: "armed, zero rollbacks"
+        # (counter present at 0) must be distinguishable from
+        # "controller never ran" (counter absent) — the
+        # --max_control_rollbacks gate fails on absence by design
+        tel.counter("control/rollback_total")
+        tel.counter("control/decisions_total")
+        tel.counter("control/sets_total")
+
+    # -- sensing -------------------------------------------------------------
+
+    def _sense(self) -> dict:
+        """One consistent cut of the controller's inputs.  Gauge reads
+        group under the metric-registry lock (torn-pair discipline);
+        the SLO monitor and brownout controller snapshot under their own
+        locks — each source is internally consistent, which is the same
+        contract /statz gives scrapers."""
+        with tel.get_registry().locked():
+            # gauges read None until the engine's first step sets them
+            kv = tel.gauge("serve/kv_pool_frac").value or 0.0
+            queue = tel.gauge("serve/queue_depth").value or 0.0
+            slots = tel.gauge("serve/slots").value or 4.0
+        slo_state = self.slo.state()
+        bad = events = alerts_fast = 0
+        fast_max = slow_max = 0.0
+        firing_fast = False
+        for obj in slo_state["objectives"].values():
+            bad += obj["bad_total"]
+            events += obj["events_total"]
+            alerts_fast += obj["alerts_fast"]
+            firing_fast = firing_fast or obj["firing_fast"]
+        # burns from the live gauges the monitor's update() maintains
+        with tel.get_registry().locked():
+            for name in slo_state["objectives"]:
+                for speed in ("fast", "slow"):
+                    g = tel.gauge(
+                        f"serve/slo_burn_{name}_{speed}").value or 0.0
+                    if speed == "fast":
+                        fast_max = max(fast_max, g)
+                    else:
+                        slow_max = max(slow_max, g)
+        signals = {"kv_frac": kv, "queue_depth": queue, "slots": slots,
+                   "bad_total": bad, "events_total": events,
+                   "bad_frac": (bad / events if events else 0.0),
+                   "fast_burn_max": fast_max, "slow_burn_max": slow_max,
+                   "fast_firing": firing_fast,
+                   "alerts_fast": alerts_fast,
+                   "brownout_level": (self.brownout.level
+                                      if self.brownout is not None
+                                      else 0)}
+        if self.acceptance_fn is not None:
+            proposed, accepted = self.acceptance_fn()
+            signals["spec_acceptance"] = (accepted / proposed
+                                          if proposed else None)
+        else:
+            signals["spec_acceptance"] = None
+        return signals
+
+    # -- safety rails --------------------------------------------------------
+
+    def _rollback(self, reason: str, iteration: int) -> None:
+        moved = self.registry.reset_to_defaults(
+            iteration=iteration, reason=reason)
+        self.rollbacks += 1
+        self.rollback_reasons[reason] = \
+            self.rollback_reasons.get(reason, 0) + 1
+        tel.counter("control/rollback_total").inc()
+        tel.instant("control/rollback", iteration=int(iteration),
+                    reason=reason, knobs_restored=sorted(moved))
+        self._pending = None
+        self._hold_until = iteration + self.hold_iters
+
+    def _check_pending(self, signals: dict, iteration: int) -> bool:
+        """The no-improvement guard.  Returns True when it rolled
+        back."""
+        p = self._pending
+        if p is None or iteration - p["iteration"] < self.improve_window:
+            return False
+        d_events = signals["events_total"] - p["events"]
+        if d_events < self.min_window_events:
+            # not enough post-decision evidence yet; keep waiting
+            return False
+        d_bad = signals["bad_total"] - p["bad"]
+        frac_after = d_bad / d_events
+        if frac_after > p["bad_frac"] + self.improve_margin:
+            self._rollback("no_improvement", iteration)
+            return True
+        self._pending = None          # decision survived its window
+        return False
+
+    # -- the loop ------------------------------------------------------------
+
+    def decide(self, now: float, iteration: int) -> None:
+        """Called once per engine iteration (the engine's step tail);
+        evaluates every ``period`` iterations.  ``now`` rides the
+        engine's own clock, so the loop is deterministic under the
+        seeded VirtualClock."""
+        if (self._last_eval is not None
+                and iteration - self._last_eval < self.period):
+            return
+        self._last_eval = iteration
+        signals = self._sense()
+        self.decisions += 1
+        tel.counter("control/decisions_total").inc()
+        # rail 1: a NEW fast-burn alert (edge, not level — see module
+        # docstring) while knobs are off their pins
+        new_alert = (self._alerts_seen is not None
+                     and signals["alerts_fast"] > self._alerts_seen)
+        self._alerts_seen = signals["alerts_fast"]
+        if new_alert and not self.registry.at_defaults():
+            self._rollback("fast_burn", iteration)
+            return
+        # rail 2: the open decision's improvement window
+        if self._check_pending(signals, iteration):
+            return
+        if self._hold_until is not None \
+                and iteration < self._hold_until:
+            return                     # post-rollback hold-off
+        snap = self.registry.snapshot()
+        applied = False
+        for knob, delta, reason in self.policy(signals, snap["knobs"]):
+            if self.registry.nudge(knob, delta, iteration=iteration,
+                                   reason=reason) is not None:
+                applied = True
+        if applied and self._pending is None:
+            self._pending = {"iteration": iteration,
+                             "bad": signals["bad_total"],
+                             "events": signals["events_total"],
+                             "bad_frac": signals["bad_frac"]}
+
+    # -- reporting -----------------------------------------------------------
+
+    def state(self) -> dict:
+        """The ``/controlz`` payload: registry snapshot (knobs + audit
+        trail) plus the controller's own loop state."""
+        doc = self.registry.snapshot()
+        doc["controller"] = {
+            "period": self.period,
+            "improve_window": self.improve_window,
+            "decisions": self.decisions,
+            "rollbacks": self.rollbacks,
+            "rollback_reasons": dict(sorted(
+                self.rollback_reasons.items())),
+            "pending_decision": self._pending,
+            "hold_until": self._hold_until,
+        }
+        return doc
+
+    def summary(self) -> dict:
+        """Compact per-run aggregate for ``engine.summary()`` /
+        telemetry.json."""
+        snap = self.registry.snapshot()
+        return {"decisions": self.decisions,
+                "sets": sum(1 for e in snap["audit"]
+                            if not e["reason"].startswith("rollback:")),
+                "rollbacks": self.rollbacks,
+                "rollback_reasons": dict(sorted(
+                    self.rollback_reasons.items())),
+                "at_defaults": snap["at_defaults"],
+                "knobs": {name: k["value"]
+                          for name, k in snap["knobs"].items()},
+                "knob_defaults": {name: k["default"]
+                                  for name, k in snap["knobs"].items()}}

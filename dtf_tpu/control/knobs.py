@@ -1,0 +1,224 @@
+"""Runtime knob registry: every tunable, settable mid-run, through ONE
+audited path.
+
+A :class:`Knob` is a declared tunable — name, bounds, step quantum,
+pinned default, an apply-callback that pushes the value into the owning
+subsystem (engine attribute, scheduler field, brownout threshold) — and
+:class:`KnobRegistry` is the single mutation path: :meth:`~KnobRegistry.
+set` clamps to bounds, quantizes to the knob's quantum, bounds the
+per-decision step size, enforces the per-knob cooldown, applies the
+callback, and books the mutation (``control/sets_total`` + the per-knob
+``control/knob_*`` gauge + a ``control/set`` instant carrying
+knob/old/new/reason/actor) — all under one lock, so a concurrent
+``/controlz`` or ``/statz`` scrape never reads a knob value without its
+matching audit entry (the same torn-pair discipline the engine's shed
+booking uses).
+
+:meth:`~KnobRegistry.reset_to_defaults` is the safety-rail primitive:
+snap every knob back to its pinned default, bypassing cooldowns (a
+safety action must never be rate-limited by the policy it is undoing),
+idempotent (already-at-default knobs book nothing).
+
+Deliberately jax-free and engine-agnostic: apply callbacks are plain
+callables, so the registry works identically under the seeded
+VirtualClock (the scenario cells' determinism) and on a live server.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from dtf_tpu import telemetry as tel
+
+
+class Knob:
+    """One declared tunable.  ``quantum`` is the resolution every value
+    snaps to (anchored at ``lo``); ``max_step`` bounds how far a single
+    decision may move the value (safety rail: a runaway policy cannot
+    teleport a knob across its range); ``cooldown_iters`` is the minimum
+    engine-iteration gap between accepted mutations."""
+
+    __slots__ = ("name", "lo", "hi", "quantum", "max_step", "default",
+                 "apply", "cooldown_iters", "value", "last_set_iteration")
+
+    def __init__(self, name: str, *, lo: float, hi: float, quantum: float,
+                 default: float, apply: Callable[[float], None],
+                 max_step: Optional[float] = None,
+                 cooldown_iters: int = 0):
+        if not lo <= default <= hi:
+            raise ValueError(f"knob {name!r}: default {default} outside "
+                             f"bounds [{lo}, {hi}]")
+        if quantum <= 0:
+            raise ValueError(f"knob {name!r}: quantum must be > 0, got "
+                             f"{quantum}")
+        self.name = name
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.quantum = float(quantum)
+        self.max_step = (float(max_step) if max_step is not None
+                         else self.quantum)
+        self.default = float(default)
+        self.apply = apply
+        self.cooldown_iters = int(cooldown_iters)
+        self.value = float(default)
+        self.last_set_iteration: Optional[int] = None
+
+    def snap(self, v: float) -> float:
+        """Clamp to bounds and quantize (round to the nearest multiple
+        of ``quantum`` anchored at ``lo``)."""
+        v = min(max(float(v), self.lo), self.hi)
+        steps = round((v - self.lo) / self.quantum)
+        return min(max(self.lo + steps * self.quantum, self.lo), self.hi)
+
+
+#: Audit-trail capacity: bounded so a long-lived server's /controlz
+#: payload stays scrape-sized (every mutation ALSO lands in the span
+#: file as a control/set instant, which is the unbounded record).
+AUDIT_CAPACITY = 256
+
+
+class KnobRegistry:
+    """See module docstring.  Thread-safe: the engine thread sets, admin
+    handler threads snapshot."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._knobs: Dict[str, Knob] = {}
+        self.audit: Deque[dict] = deque(maxlen=AUDIT_CAPACITY)
+
+    def register(self, name: str, *, lo: float, hi: float, quantum: float,
+                 default: float, apply: Callable[[float], None],
+                 max_step: Optional[float] = None,
+                 cooldown_iters: int = 0) -> Knob:
+        """Declare a tunable.  The per-knob gauge registers eagerly so
+        the knob is visible in telemetry (at its default) from the
+        moment it exists, not from its first mutation."""
+        with self._lock:
+            if name in self._knobs:
+                raise ValueError(f"knob {name!r} already registered")
+            knob = Knob(name, lo=lo, hi=hi, quantum=quantum,
+                        default=default, apply=apply, max_step=max_step,
+                        cooldown_iters=cooldown_iters)
+            self._knobs[name] = knob
+            tel.gauge(f"control/knob_{name}").set(knob.value)
+            return knob
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._knobs
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._knobs)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._knobs[name].value
+
+    # -- the ONE mutation path ----------------------------------------------
+
+    def set(self, name: str, value: float, *, iteration: int,
+            reason: str, actor: str = "controller",
+            bypass_rails: bool = False) -> Optional[Tuple[float, float]]:
+        """Audited mutation.  Returns ``(old, new)`` when the value
+        actually changed, ``None`` when the proposal was refused
+        (cooldown) or collapsed to a no-op (already at the target after
+        clamp/quantize).  ``bypass_rails`` is for safety actions
+        (rollback): skips cooldown and the max_step clamp — undoing a
+        bad excursion must never be rate-limited by the rails that
+        failed to prevent it."""
+        with self._lock:
+            knob = self._knobs.get(name)
+            if knob is None:
+                raise ValueError(f"unknown knob {name!r}; one of "
+                                 f"{sorted(self._knobs)}")
+            if (not bypass_rails and knob.cooldown_iters > 0
+                    and knob.last_set_iteration is not None
+                    and iteration - knob.last_set_iteration
+                    < knob.cooldown_iters):
+                tel.counter("control/cooldown_skips_total").inc()
+                return None
+            target = knob.snap(value)
+            if not bypass_rails and abs(target - knob.value) \
+                    > knob.max_step + 1e-12:
+                step = knob.max_step if target > knob.value \
+                    else -knob.max_step
+                target = knob.snap(knob.value + step)
+                tel.counter("control/clamped_total").inc()
+            if target == knob.value:
+                return None
+            old = knob.value
+            knob.value = target
+            knob.last_set_iteration = int(iteration)
+            knob.apply(target)
+            entry = {"iteration": int(iteration), "knob": name,
+                     "old": old, "new": target, "reason": reason,
+                     "actor": actor}
+            self.audit.append(entry)
+            # gauge + counter + instant as ONE group under the registry
+            # lock: a concurrent /statz scrape must never see the new
+            # knob value without its booked mutation (or vice versa)
+            with tel.get_registry().locked():
+                tel.counter("control/sets_total").inc()
+                tel.gauge(f"control/knob_{name}").set(target)
+            tel.instant("control/set", **entry)
+            return old, target
+
+    def nudge(self, name: str, delta: float, *, iteration: int,
+              reason: str, actor: str = "controller"
+              ) -> Optional[Tuple[float, float]]:
+        """Relative mutation — the controller's native verb."""
+        with self._lock:
+            knob = self._knobs.get(name)
+            if knob is None:
+                raise ValueError(f"unknown knob {name!r}; one of "
+                                 f"{sorted(self._knobs)}")
+            return self.set(name, knob.value + delta,
+                            iteration=iteration, reason=reason,
+                            actor=actor)
+
+    def reset_to_defaults(self, *, iteration: int, reason: str,
+                          actor: str = "controller") -> List[str]:
+        """Snap every knob back to its pinned default (the safety-rail
+        snap-back).  Idempotent: knobs already at default book nothing;
+        returns the names that actually moved."""
+        moved = []
+        with self._lock:
+            for name, knob in sorted(self._knobs.items()):
+                if knob.value != knob.default:
+                    res = self.set(name, knob.default,
+                                   iteration=iteration,
+                                   reason=f"rollback:{reason}",
+                                   actor=actor, bypass_rails=True)
+                    if res is not None:
+                        moved.append(name)
+        return moved
+
+    def at_defaults(self) -> bool:
+        with self._lock:
+            return all(k.value == k.default
+                       for k in self._knobs.values())
+
+    # -- consistent reads ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One consistent cut: every knob's (value, default, bounds,
+        cooldown state) plus the bounded audit trail — taken under the
+        registry lock, so no ``set`` can tear a knob value from its
+        audit entry mid-scrape."""
+        with self._lock:
+            return {
+                "knobs": {
+                    name: {"value": k.value, "default": k.default,
+                           "lo": k.lo, "hi": k.hi,
+                           "quantum": k.quantum,
+                           "max_step": k.max_step,
+                           "cooldown_iters": k.cooldown_iters,
+                           "last_set_iteration": k.last_set_iteration}
+                    for name, k in sorted(self._knobs.items())},
+                "at_defaults": all(k.value == k.default
+                                   for k in self._knobs.values()),
+                "audit": list(self.audit),
+            }

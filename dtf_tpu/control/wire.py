@@ -1,0 +1,79 @@
+"""Standard serving-knob registrations: one place where the engine,
+scheduler, brownout controller and speculative drafter expose their
+tunables to the control plane.
+
+Bounds/quanta are deliberately conservative: each knob's pinned default
+is whatever the engine was CONSTRUCTED with (the operating point the
+operator chose), and the controller may walk at most one quantum per
+decision inside a range that every subsystem tolerates — e.g. the
+brownout ratio knobs' ranges are disjoint (exit <= 0.7 < 0.8 <= enter),
+so no sequence of audited mutations can violate the hysteresis
+invariant ``0 < exit < enter`` the BrownoutController's constructor
+enforces.
+"""
+
+from __future__ import annotations
+
+from dtf_tpu.control.knobs import KnobRegistry
+
+
+def wire_serve_knobs(registry: KnobRegistry, engine) -> KnobRegistry:
+    """Register the serving tunables on ``registry`` with
+    apply-callbacks into ``engine`` (a :class:`~dtf_tpu.serve.engine.
+    ServingEngine`).  Defaults pin to the engine's constructed values.
+    Returns the registry for chaining."""
+    sched = engine.scheduler
+    registry.register(
+        "spec_k", lo=0, hi=8, quantum=1, max_step=1,
+        default=engine.spec_k, cooldown_iters=16,
+        apply=lambda v: setattr(engine, "spec_k", int(v)))
+    registry.register(
+        "prefill_token_budget",
+        lo=max(engine.block_size, 16), hi=8192,
+        quantum=max(engine.block_size, 16),
+        max_step=2 * max(engine.block_size, 16),
+        default=sched.prefill_token_budget, cooldown_iters=16,
+        apply=lambda v: setattr(sched, "prefill_token_budget", int(v)))
+    registry.register(
+        "aging_s", lo=0.25, hi=8.0, quantum=0.25, max_step=0.5,
+        default=min(max(sched.aging_s, 0.25), 8.0), cooldown_iters=32,
+        apply=lambda v: setattr(sched, "aging_s", float(v)))
+    if engine.brownout is not None:
+        b = engine.brownout
+        registry.register(
+            "brownout_enter_ratio", lo=0.8, hi=2.0, quantum=0.05,
+            max_step=0.1,
+            default=min(max(b.enter_ratio, 0.8), 2.0),
+            cooldown_iters=32,
+            apply=lambda v: setattr(b, "enter_ratio", float(v)))
+        registry.register(
+            "brownout_exit_ratio", lo=0.2, hi=0.7, quantum=0.05,
+            max_step=0.1,
+            default=min(max(b.exit_ratio, 0.2), 0.7),
+            cooldown_iters=32,
+            apply=lambda v: setattr(b, "exit_ratio", float(v)))
+        registry.register(
+            "degrade_max_new", lo=2, hi=64, quantum=2, max_step=4,
+            default=min(max(b.degrade_max_new, 2), 64),
+            cooldown_iters=16,
+            apply=lambda v: setattr(b, "degrade_max_new", int(v)))
+    return registry
+
+
+def arm_controller(engine, *, policy=None, **controller_kwargs):
+    """Build the full control plane for a serving engine: registry +
+    standard knob wiring + :class:`~dtf_tpu.control.controller.
+    KnobController` reading the engine's own SLO monitor / brownout /
+    spec counters, attached so ``engine.step()`` drives the loop.
+    Returns the controller.  The engine must carry a BurnRateMonitor
+    (``slo=``) — the controller's objective is the SLO."""
+    from dtf_tpu.control.controller import KnobController, default_policy
+    registry = KnobRegistry()
+    wire_serve_knobs(registry, engine)
+    ctl = KnobController(
+        registry, slo=engine.slo, brownout=engine.brownout,
+        acceptance_fn=lambda: (engine.spec_proposed,
+                               engine.spec_accepted),
+        policy=policy or default_policy, **controller_kwargs)
+    engine.controller = ctl
+    return ctl

@@ -62,6 +62,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"path to a JSON list of cell specs")
     p.add_argument("--only", default=None,
                    help="comma-separated cell names to run (subset)")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="run each selected cell N times (flake hunt / "
+                        "determinism check); every repeat must pass")
     p.add_argument("--out", default=None,
                    help="results directory (per-cell JSON + summary); "
                         "default: a fresh temp dir, printed")
@@ -99,21 +102,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"[scenarios] matrix {ns.matrix!r}: {len(cells)} cell(s), "
           f"results under {out}", flush=True)
 
+    if ns.repeat < 1:
+        print(f"error: --repeat must be >= 1, got {ns.repeat}",
+              file=sys.stderr)
+        return 2
+
     results: List[CellResult] = []
+    total = len(cells) * ns.repeat
     for i, spec in enumerate(cells):
-        print(f"[scenarios] [{i + 1}/{len(cells)}] {spec.name} "
-              f"(workload={spec.workload}, hosts={spec.hosts}, "
-              f"chaos={spec.chaos or 'off'}) ...", flush=True)
-        res = run_cell(spec, workdir)
-        results.append(res)
-        with open(os.path.join(out, f"{spec.name}.json"), "w") as f:
-            json.dump(res.to_doc(), f, indent=1, sort_keys=True)
-        status = "PASS" if res.ok else "FAIL"
-        print(f"[scenarios]   -> {status} in {res.duration_s:.1f}s", flush=True)
-        if res.error:
-            print(f"[scenarios]   error: {res.error}", flush=True)
-        for line in res.gates:
-            print(f"[scenarios]   {line}", flush=True)
+        for rep in range(ns.repeat):
+            tag = f" (repeat {rep + 1}/{ns.repeat})" if ns.repeat > 1 else ""
+            print(f"[scenarios] [{i * ns.repeat + rep + 1}/{total}] "
+                  f"{spec.name}{tag} (workload={spec.workload}, "
+                  f"hosts={spec.hosts}, chaos={spec.chaos or 'off'}) ...",
+                  flush=True)
+            res = run_cell(spec, workdir)
+            results.append(res)
+            suffix = f".rep{rep}" if rep else ""
+            with open(os.path.join(out, f"{spec.name}{suffix}.json"),
+                      "w") as f:
+                json.dump(res.to_doc(), f, indent=1, sort_keys=True)
+            status = "PASS" if res.ok else "FAIL"
+            print(f"[scenarios]   -> {status} in {res.duration_s:.1f}s",
+                  flush=True)
+            if res.error:
+                print(f"[scenarios]   error: {res.error}", flush=True)
+            for line in res.gates:
+                print(f"[scenarios]   {line}", flush=True)
 
     table = summary_table(results)
     print(table)

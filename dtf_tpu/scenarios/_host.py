@@ -128,8 +128,21 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     deadlines + the brownout controller armed, telemetry (goodput books
     + the ``serving`` summary) written to the logdir the runner judges.
     Scale knobs ride ``spec.extra``: ``qps`` / ``requests`` /
-    ``slo_ttft_ms`` / ``deadline_ms`` / ``slots``.  Cells that carry a
-    ``replicas`` knob route to the fleet cell instead."""
+    ``slo_ttft_ms`` / ``deadline_ms`` / ``slots`` / ``qps_profile``
+    (arrival-rate shape, bench/serve_load.py) / ``trace_vocab`` (prompt
+    alphabet cap — small alphabets give the n-gram drafter material).
+    Cells that carry a ``replicas`` knob route to the fleet cell.
+
+    ``controller=1`` arms the self-tuning knob controller
+    (dtf_tpu/control) — and turns the cell into a SAME-TRACE A/B: a
+    pinned-knob baseline pass runs first (fresh engine, identical trace
+    and fault plan), then the controller pass, and the cell FAILS
+    unless the controller strictly beats the baseline on goodput QPS
+    with p99 TTFT / p99 TPOT / deadline violations no worse.  The
+    judged telemetry is the controller pass's; the baseline's numbers
+    ride the summary under ``control_ab`` so the margin is on disk.
+    Engine summaries are engine-local (per-run results), so the two
+    in-process passes cannot pollute each other's judged numbers."""
     import jax
 
     if "replicas" in spec.extra_dict:
@@ -149,6 +162,8 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     slo_ttft_ms = float(ex.get("slo_ttft_ms", 400.0))
     deadline_ms = float(ex.get("deadline_ms", 2500.0))
     slots = int(ex.get("slots", 4))
+    qps_profile = str(ex.get("qps_profile", "constant"))
+    controller = bool(ex.get("controller", 0))
 
     # span tracer into the judged logdir: the cell's
     # min_trace_complete_frac gate reads the per-request trace chains
@@ -158,25 +173,69 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     cfg = GPTConfig.tiny()
     model = GPT(cfg)
     params = model.init(jax.random.key(spec.seed))
-    plan = (FaultPlan.parse(chaos, process_index=0) if chaos else None)
-    engine = ServingEngine(
-        model, params, num_slots=slots, seed=spec.seed,
-        clock=VirtualClock(), max_queue=256,
-        brownout=BrownoutController(slo_ttft_ms), chaos=plan,
-        slo=BurnRateMonitor.for_serving(slo_ttft_ms))
+    vocab = int(ex.get("trace_vocab", cfg.vocab_size))
     trace = poisson_trace(
         seed=spec.seed, n_requests=n_requests, qps=qps,
         prompt_lens=[4, 8, 16], output_lens=[2, 8, 16],
-        vocab_size=cfg.vocab_size, deadline_ms=deadline_ms,
-        priorities=[0, 0, 1])
-    engine.run(trace)
-    engine.write_telemetry(logdir, slo_ttft_ms=slo_ttft_ms)
+        vocab_size=min(vocab, cfg.vocab_size), deadline_ms=deadline_ms,
+        priorities=[0, 0, 1], qps_profile=qps_profile)
+
+    def run_pass(arm_knobs: bool):
+        # fresh engine + clock + fault plan per pass (fired chaos
+        # latches are per-plan state) — the ONLY arm difference is the
+        # knob controller
+        plan = (FaultPlan.parse(chaos, process_index=0) if chaos
+                else None)
+        engine = ServingEngine(
+            model, params, num_slots=slots, seed=spec.seed,
+            clock=VirtualClock(), max_queue=256,
+            brownout=BrownoutController(slo_ttft_ms), chaos=plan,
+            slo=BurnRateMonitor.for_serving(slo_ttft_ms))
+        if arm_knobs:
+            from dtf_tpu.control import arm_controller
+            arm_controller(engine)
+        engine.run(trace)
+        return engine, engine.summary(slo_ttft_ms=slo_ttft_ms)
+
+    extra = None
+    if controller:
+        _, base = run_pass(False)
+    engine, s = run_pass(controller)
+    if controller:
+        # the strict-improvement contract, judged in-cell (the gate
+        # thresholds on disk are the controller arm's absolutes; the
+        # RELATIVE claim needs both arms' numbers)
+        deltas = {
+            "goodput_qps": (s.get("goodput_qps", 0.0),
+                            base.get("goodput_qps", 0.0)),
+            "ttft_ms_p99": (s.get("ttft_ms_p99"), base.get("ttft_ms_p99")),
+            "tpot_ms_p99": (s.get("tpot_ms_p99"), base.get("tpot_ms_p99")),
+            "deadline_violations": (s.get("deadline_violations", 0),
+                                    base.get("deadline_violations", 0)),
+        }
+        if not (deltas["goodput_qps"][0] > deltas["goodput_qps"][1]
+                and deltas["ttft_ms_p99"][0] <= deltas["ttft_ms_p99"][1]
+                and deltas["tpot_ms_p99"][0] <= deltas["tpot_ms_p99"][1]
+                and deltas["deadline_violations"][0]
+                <= deltas["deadline_violations"][1]):
+            print(f"SCENARIO_FAIL controller did not strictly beat the "
+                  f"pinned baseline: {deltas}", flush=True)
+            return 1
+        extra = {"control_ab": {
+            "baseline": {k: v[1] for k, v in deltas.items()},
+            "controller": {k: v[0] for k, v in deltas.items()}}}
+    engine.write_telemetry(logdir, slo_ttft_ms=slo_ttft_ms, extra=extra)
     tel.get_tracer().flush()
-    s = engine.summary(slo_ttft_ms=slo_ttft_ms)
-    print(f"SCENARIO_DONE completed={s['completed']} shed={s['shed']} "
-          f"goodput_qps={s.get('goodput_qps', 0.0):.3f} "
-          f"ttft_p99={s.get('ttft_ms_p99', 0.0):.1f}ms "
-          f"violations={s.get('deadline_violations', 0)}", flush=True)
+    line = (f"SCENARIO_DONE completed={s['completed']} shed={s['shed']} "
+            f"goodput_qps={s.get('goodput_qps', 0.0):.3f} "
+            f"ttft_p99={s.get('ttft_ms_p99', 0.0):.1f}ms "
+            f"violations={s.get('deadline_violations', 0)}")
+    if controller:
+        c = s.get("control") or {}
+        line += (f" baseline_goodput_qps={base.get('goodput_qps', 0.0):.3f}"
+                 f" knob_sets={c.get('sets', 0)}"
+                 f" rollbacks={c.get('rollbacks', 0)}")
+    print(line, flush=True)
     return 0
 
 

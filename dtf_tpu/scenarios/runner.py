@@ -180,6 +180,12 @@ def _measured(report: dict) -> dict:
         "deadline_violations": serving.get("deadline_violations"),
         "trace_complete_frac": report.get("request_traces", {})
         .get("complete_frac"),
+        # knob-controller cells (absent when no controller armed; note
+        # control/rollback_total deliberately has NO default — the gate
+        # distinguishes "never armed" from "armed, zero rollbacks")
+        "control_decisions": metric("control/decisions_total"),
+        "control_sets": metric("control/sets_total"),
+        "control_rollbacks": metric("control/rollback_total"),
         # fleet plane (absent for single-host cells)
         "fleet_skew_ms_p50": report.get("fleet", {})
         .get("attribution", {}).get("skew_ms_p50"),

@@ -63,6 +63,16 @@ class Gate:
     max_rollbacks: Optional[int] = None
     min_goodput_qps: float = 0.0
     max_ttft_p99_ms: float = 0.0
+    #: Streaming-cadence ceiling (0 = not armed): p99 time-per-output-
+    #: token — the controller cells arm it so a goodput win bought with
+    #: a decode-cadence blow-up still fails.
+    max_tpot_p99_ms: float = 0.0
+    #: Control-plane gate (ISSUE 17, dtf_tpu/control; None = not armed):
+    #: ceiling on the knob controller's snap-backs.  Armed on controller
+    #: cells it ALSO proves the controller ran at all — the counter
+    #: registers eagerly at arm time, so its absence from telemetry.json
+    #: fails the gate (never-armed != calm).
+    max_control_rollbacks: Optional[int] = None
     #: Observability gate (ISSUE 11): floor on the fraction of COMPLETED
     #: requests whose per-request trace reconstructs the full
     #: admission->prefill->first_token->completion chain from the span
@@ -97,6 +107,10 @@ class Gate:
             out["min_goodput_qps"] = self.min_goodput_qps
         if self.max_ttft_p99_ms > 0:
             out["max_ttft_p99_ms"] = self.max_ttft_p99_ms
+        if self.max_tpot_p99_ms > 0:
+            out["max_tpot_p99_ms"] = self.max_tpot_p99_ms
+        if self.max_control_rollbacks is not None:
+            out["max_control_rollbacks"] = self.max_control_rollbacks
         if self.min_trace_complete_frac > 0:
             out["min_trace_complete_frac"] = self.min_trace_complete_frac
         if self.max_skew_ms > 0:
@@ -374,6 +388,52 @@ def default_matrix() -> List[ScenarioSpec]:
             gate=Gate(max_final_cost=None, min_goodput=0.003,
                       min_goodput_qps=1.8, max_ttft_p99_ms=9000.0,
                       min_trace_complete_frac=0.99)),
+        ScenarioSpec(
+            # Self-tuning control plane, adversarial cell 1 (ISSUE 17):
+            # OSCILLATING load — a square-wave arrival rate (1.5x/0.5x
+            # the offered 36 qps, period span/4) that a pinned operating
+            # point cannot be right for on both halves.  controller=1
+            # makes the cell a same-trace A/B inside _host.py: the knob
+            # controller must STRICTLY beat the pinned-knob baseline on
+            # goodput QPS with p99 TTFT / p99 TPOT / deadline violations
+            # no worse, or the cell fails before any threshold is read.
+            # trace_vocab=12 gives the n-gram drafter material, so
+            # raising spec_k under burst pressure is a real lever.
+            # measured (virtual clock, deterministic): controller
+            # 35.42 qps / ttft p99 221 ms / tpot p99 12.9 ms vs baseline
+            # 34.74 qps / 232 ms / 13.1 ms — 7 audited knob sets, 0
+            # rollbacks.  Absolute gates sit well outside the measured
+            # point; max_control_rollbacks=1 tolerates one explained
+            # snap-back and (counter registered eagerly at arm time)
+            # fails if the controller never armed at all.
+            name="serve_oscillating_load_controller", workload="serve",
+            devices=1, chaos=None, max_restarts=0,
+            extra=(("controller", 1), ("deadline_ms", 2500.0),
+                   ("qps", 36.0), ("qps_profile", "square"),
+                   ("requests", 64), ("slo_ttft_ms", 400.0),
+                   ("trace_vocab", 12)),
+            gate=Gate(max_final_cost=None, min_goodput=0.002,
+                      min_goodput_qps=18.0, max_ttft_p99_ms=600.0,
+                      max_tpot_p99_ms=30.0, max_control_rollbacks=1)),
+        ScenarioSpec(
+            # Self-tuning control plane, adversarial cell 2 (ISSUE 17):
+            # SLOW-DRIFT decode degradation — a periodic slow_decode hit
+            # (every 6th iteration, +50 ms) that gradually poisons the
+            # decode cadence the pinned knobs were sized for.  Same
+            # in-cell strict A/B contract as the oscillating cell.
+            # measured (virtual clock, deterministic): controller
+            # 24.22 qps / ttft p99 419 ms / tpot p99 21.9 ms vs baseline
+            # 21.89 qps / 472 ms / 22.1 ms — 12 audited knob sets, 0
+            # rollbacks (the controller leans on spec_k + brownout
+            # cheapening to buy back the injected drag).
+            name="serve_slow_drift_controller", workload="serve",
+            devices=1, chaos="slow_decode@every:6:50ms", max_restarts=0,
+            extra=(("controller", 1), ("deadline_ms", 2500.0),
+                   ("qps", 28.0), ("requests", 64),
+                   ("slo_ttft_ms", 400.0), ("trace_vocab", 12)),
+            gate=Gate(max_final_cost=None, min_goodput=0.002,
+                      min_goodput_qps=12.0, max_ttft_p99_ms=1000.0,
+                      max_tpot_p99_ms=45.0, max_control_rollbacks=1)),
         ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike

@@ -88,7 +88,8 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
         output_lens=[int(x) for x in ns.output_lens.split(",")],
         vocab_size=vocab_size, temperature=ns.temperature,
         deadline_ms=ns.deadline_ms or None,
-        priorities=[int(x) for x in ns.priorities.split(",")])
+        priorities=[int(x) for x in ns.priorities.split(",")],
+        qps_profile=getattr(ns, "qps_profile", "constant"))
 
 
 def _write_drain_file(engine, logdir: str,
@@ -152,6 +153,12 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
         heartbeat=heartbeat, brownout=brownout, chaos=chaos, slo=slo,
         spec_k=ns.spec_k, coalesce_prefill=not ns.no_prefill_coalesce,
         narrow_decode=not ns.no_narrow)
+    ctl = None
+    if getattr(ns, "controller", False):
+        # self-tuning control plane (DESIGN.md §9): registry + standard
+        # serving knobs + SLO-driven controller on the engine cadence
+        from dtf_tpu.control import arm_controller
+        ctl = arm_controller(engine)
     if ns.admin_port is not None:
         # one admin window per process; a supervisor's next attempt
         # rebinds the fresh engine's ring + monitor onto the same server
@@ -162,10 +169,12 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
             ns.admin_port, probe=probe,
             trace_ring=engine.reqtrace.ring, slo=slo,
             health_fn=(health_file_fn(ns.health_dir) if ns.health_dir
-                       else None))
+                       else None),
+            control_fn=(ctl.state if ctl is not None else None))
         if fresh:
             print(f"admin endpoint on http://127.0.0.1:{admin.port} "
-                  f"(/statz /healthz /tracez /slo /memz)", flush=True)
+                  f"(/statz /healthz /tracez /slo /controlz /memz)",
+                  flush=True)
     return engine
 
 
@@ -460,6 +469,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "requests")
     p.add_argument("--qps", type=float, default=8.0,
                    help="demo arrival rate (Poisson)")
+    p.add_argument("--qps_profile", default="constant",
+                   choices=["constant", "ramp", "square", "sine"],
+                   help="demo arrival-rate shape around --qps (same "
+                        "seeded request CONTENTS for every profile — "
+                        "only arrival times move; bench/serve_load.py "
+                        "documents the shapes)")
     p.add_argument("--prompt_lens", default="4,8,16")
     p.add_argument("--output_lens", default="4,8,16")
     p.add_argument("--deadline_ms", type=float, default=0.0,
@@ -476,6 +491,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--brownout", action="store_true",
                    help="arm the overload controller against "
                         "--slo_ttft_ms (serve/brownout.py)")
+    p.add_argument("--controller", action="store_true",
+                   help="arm the self-tuning knob controller "
+                        "(dtf_tpu/control): SLO-driven runtime tuning "
+                        "of spec_k / prefill budget / brownout "
+                        "thresholds with audited, bounded steps and "
+                        "snap-back safety rails; inspect via /controlz")
     p.add_argument("--degrade_max_new", type=int, default=8,
                    help="brownout level-1 output-length ceiling")
     p.add_argument("--chaos", default=None,
@@ -515,8 +536,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "past it are checkpointed, not finished)")
     p.add_argument("--admin_port", type=int, default=None,
                    help="mount the live introspection endpoint on "
-                        "127.0.0.1:PORT (/statz /healthz /tracez /slo /memz; "
-                        "0 = ephemeral port, printed at startup)")
+                        "127.0.0.1:PORT (/statz /healthz /tracez /slo "
+                        "/controlz /memz; 0 = ephemeral port, printed "
+                        "at startup)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="run the TCP front end instead of a trace "
                         "(':8100' binds 127.0.0.1:8100; wall clock); "

@@ -158,6 +158,12 @@ class ServingEngine:
         #: None = not armed.  Passive: it observes completions and
         #: raises alerts, it never touches admission.
         self.slo = slo
+        #: Self-tuning control plane (dtf_tpu/control): attached by
+        #: control.arm_controller AFTER construction (its knob wiring
+        #: captures the constructed scheduler/brownout); None = pinned
+        #: knobs.  The step tail drives its decide() on the engine
+        #: clock, so the loop is deterministic under VirtualClock.
+        self.controller = None
         #: Per-request distributed tracing (telemetry/reqtrace.py):
         #: lifecycle events into the span file + the /tracez flight
         #: recorder.  Always on — events are cheap and the ring is
@@ -865,6 +871,10 @@ class ServingEngine:
             tel.gauge("serve/brownout_level").set(level)
         if self.slo is not None:
             self.slo.update(self.clock.now(), self.iterations)
+        if self.controller is not None:
+            # after brownout/slo updates: the controller's consistent
+            # cut reads THIS iteration's burn gauges and service level
+            self.controller.decide(self.clock.now(), self.iterations)
         self.iterations += 1
         if self.heartbeat is not None:
             self.heartbeat(self.iterations)
@@ -1036,6 +1046,8 @@ class ServingEngine:
             out["brownout"] = self.brownout.state()
         if self.slo is not None:
             out["slo"] = self.slo.state()
+        if self.controller is not None:
+            out["control"] = self.controller.summary()
         # Deadline accounting over ADMITTED-and-completed requests: a
         # violation is a completion later than (deadline + the SLO TTFT
         # budget) — the grace the SLO already tolerates at the front

@@ -29,6 +29,11 @@ Endpoints (all JSON, GET only):
   sync-point skew/blame attribution, fleet goodput — one consistent
   fleet cut (per-host docs are atomic, the skew books read under the
   plane lock);
+* ``/controlz`` — the self-tuning control plane
+  (:meth:`~dtf_tpu.control.controller.KnobController.state`): every
+  knob's value/default/bounds, the bounded mutation audit trail, and
+  the controller loop's decision/rollback state — one consistent cut
+  under the knob-registry lock;
 * ``/memz``   — the device cost observatory
   (:meth:`~dtf_tpu.telemetry.costobs.CostObservatory.memz`): every
   captured CostCard (per-compile FLOP/byte/HBM attribution) plus the
@@ -112,7 +117,8 @@ class AdminServer:
                  probe: Optional[LivenessProbe] = None,
                  trace_ring=None, slo=None,
                  health_fn: Optional[Callable[[], Optional[dict]]] = None,
-                 fleet_fn: Optional[Callable[[], dict]] = None):
+                 fleet_fn: Optional[Callable[[], dict]] = None,
+                 control_fn: Optional[Callable[[], dict]] = None):
         self.host = host
         self._requested_port = int(port)
         self.probe = probe or LivenessProbe()
@@ -120,13 +126,15 @@ class AdminServer:
         self.slo = slo
         self.health_fn = health_fn
         self.fleet_fn = fleet_fn
+        self.control_fn = control_fn
         self._server = None
         self._thread = None
 
     # sources can be rebound between supervisor attempts (a fresh engine
     # per attempt, one server per process)
     def bind(self, *, probe=None, trace_ring=None, slo=None,
-             health_fn=None, fleet_fn=None) -> "AdminServer":
+             health_fn=None, fleet_fn=None,
+             control_fn=None) -> "AdminServer":
         if probe is not None:
             self.probe = probe
         if trace_ring is not None:
@@ -137,6 +145,8 @@ class AdminServer:
             self.health_fn = health_fn
         if fleet_fn is not None:
             self.fleet_fn = fleet_fn
+        if control_fn is not None:
+            self.control_fn = control_fn
         return self
 
     @property
@@ -184,6 +194,15 @@ class AdminServer:
             return 200, {"fleet": None, "note": "no fleet plane armed"}
         return 200, self.fleet_fn()
 
+    def _controlz(self) -> tuple:
+        # control_fn is KnobController.state: the knob map + audit
+        # trail snapshot under the knob-registry lock — one consistent
+        # cut, same torn-pair discipline as /statz.
+        if self.control_fn is None:
+            return 200, {"control": None,
+                         "note": "no knob controller armed"}
+        return 200, self.control_fn()
+
     def _memz(self) -> tuple:
         # the process-wide observatory is always present (cards may be
         # empty before the first compile — that IS the honest payload);
@@ -222,12 +241,14 @@ class AdminServer:
                         code, doc = admin._slo()
                     elif url.path in ("/fleetz", "/fleetz/"):
                         code, doc = admin._fleetz()
+                    elif url.path in ("/controlz", "/controlz/"):
+                        code, doc = admin._controlz()
                     elif url.path in ("/memz", "/memz/"):
                         code, doc = admin._memz()
                     elif url.path == "/":
                         code, doc = 200, {"endpoints": [
                             "/statz", "/healthz", "/tracez", "/slo",
-                            "/fleetz", "/memz"]}
+                            "/fleetz", "/controlz", "/memz"]}
                     else:
                         code, doc = 404, {"error": f"no such endpoint "
                                                    f"{url.path!r}"}

@@ -175,6 +175,17 @@ METRICS = (
     "fleet/shed_acceptor_total",  # tier 1: fleet brownout / no replicas
     "fleet/shed_replica_total",   # tier 2: replica admission shed/reject
     "fleet/drains_total",         # rolling-restart drains completed
+    # self-tuning control plane (dtf_tpu/control): the runtime knob
+    # registry + SLO-driven controller.  Every knob mutation flows
+    # through ONE audited path (KnobRegistry.set), so these totals plus
+    # the control/set instants ARE the complete mutation history; the
+    # per-knob gauges mirror current values for /statz and /controlz.
+    "control/decisions_total",    # controller policy evaluations
+    "control/sets_total",         # accepted knob mutations
+    "control/clamped_total",      # proposals clamped by bounds/max_step
+    "control/cooldown_skips_total",  # proposals refused on cooldown
+    "control/rollback_total",     # safety-rail snap-backs to defaults
+    "control/knob_*",             # gauges: knob_<name> current value
 )
 # spans (host-side tracer)
 SPANS = (
@@ -209,6 +220,12 @@ SPANS = (
     "chaos/*",                    # chaos/<fault kind> firing marks
     "health/*",                   # peer_stale / abort / poison marks
     "event/*",
+    # control-plane audit trail (dtf_tpu/control): one instant per
+    # accepted knob mutation (knob/old/new/reason/actor) and one per
+    # safety-rail snap-back (reason + knobs restored) — report's
+    # "Control plane" section and /controlz render these verbatim
+    "control/set",
+    "control/rollback",
 )
 
 DECLARED: Tuple[str, ...] = tuple(sorted(set(METRICS) | set(SPANS)))

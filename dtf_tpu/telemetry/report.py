@@ -203,6 +203,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_ttft_p99_ms: Optional[float] = None,
                 max_tpot_p99_ms: Optional[float] = None,
                 min_trace_complete_frac: Optional[float] = None,
+                max_control_rollbacks: Optional[int] = None,
                 max_skew_ms: Optional[float] = None,
                 min_fleet_goodput: Optional[float] = None,
                 max_blame_frac: Optional[float] = None,
@@ -241,6 +242,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       the span files (telemetry/reqtrace.py; drain/replay folded in by
       trace-id continuity).  No reqtrace events on disk = not measured
       = FAIL, same absence rule as every other gate;
+    * ``max_control_rollbacks`` — ceiling on the self-tuning control
+      plane's snap-backs (``control/rollback_total``, dtf_tpu/control).
+      NO absent-counter default on purpose: the controller registers
+      the counter eagerly when armed, so an absent counter means the
+      run this gate was pinned for never armed its controller — a
+      config regression, not a calm run, and it FAILS.  (Contrast
+      ``max_rollbacks`` above, where absent legitimately means zero);
     * ``max_skew_ms`` / ``min_fleet_goodput`` / ``max_blame_frac`` — the
       FLEET gates (telemetry/fleet.py; report section ``fleet``):
       ceiling on the median per-barrier arrival skew (offset-corrected),
@@ -316,6 +324,11 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = report.get("request_traces", {}).get("complete_frac")
         gate("min_trace_complete_frac", None if v is None else float(v),
              min_trace_complete_frac, at_most=False)
+    if max_control_rollbacks is not None:
+        # no default: an absent counter = controller never armed = FAIL
+        gate("max_control_rollbacks",
+             _metric_value(report, "control/rollback_total"),
+             float(max_control_rollbacks), at_most=True)
     fleet = report.get("fleet", {})
     att = fleet.get("attribution", {})
     if max_skew_ms is not None:
@@ -486,6 +499,25 @@ def render(report: dict, top: int = 10) -> str:
                         + ("n/a" if bad is None else f"{bad:.4f}")
                         + f"  alerts fast={o.get('alerts_fast')} "
                           f"slow={o.get('alerts_slow')}")
+            # Control plane (dtf_tpu/control): final knob positions vs
+            # their pinned defaults + the loop's decision/rollback books
+            ctl = serving.get("control")
+            if ctl:
+                lines.append(
+                    f"  {'control':<28} {ctl.get('decisions', 0)} "
+                    f"decision(s), {ctl.get('sets', 0)} knob set(s), "
+                    f"{ctl.get('rollbacks', 0)} rollback(s)"
+                    + (f" {ctl.get('rollback_reasons')}"
+                       if ctl.get("rollback_reasons") else "")
+                    + ("" if ctl.get("at_defaults")
+                       else "  [knobs OFF defaults]"))
+                defaults = ctl.get("knob_defaults") or {}
+                for kname, v in sorted((ctl.get("knobs") or {}).items()):
+                    d = defaults.get(kname)
+                    mark = ("" if d is None or v == d
+                            else f"  (default {d:g})")
+                    lines.append(f"  {'control/' + kname:<28} "
+                                 f"{v:12.5g}{mark}")
         for n in sorted(srv):
             lines.append(f"  {n:<28} {srv[n]:12.5g}")
     # Device cost plane (telemetry/costobs.py): the per-site compile
@@ -674,6 +706,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="observability gate: floor on the fraction of "
                         "completed requests with a gap-free "
                         "admission->completion trace chain")
+    p.add_argument("--max_control_rollbacks", type=int, default=None,
+                   help="control-plane gate: ceiling on the self-tuning "
+                        "knob controller's snap-backs "
+                        "(control/rollback_total; the counter ABSENT = "
+                        "controller never armed = FAIL)")
     p.add_argument("--fleet", action="store_true",
                    help="require the fleet section (telemetry/fleet.py): "
                         "fail when the logdir holds no fleet/sync spans "
@@ -774,6 +811,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_ttft_p99_ms": ns.max_ttft_p99_ms,
                   "max_tpot_p99_ms": ns.max_tpot_p99_ms,
                   "min_trace_complete_frac": ns.min_trace_complete_frac,
+                  "max_control_rollbacks": ns.max_control_rollbacks,
                   "max_skew_ms": ns.max_skew_ms,
                   "min_fleet_goodput": ns.min_fleet_goodput,
                   "max_blame_frac": ns.max_blame_frac,

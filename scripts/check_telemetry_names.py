@@ -51,7 +51,11 @@ def check_runtime_guard() -> list:
                   # worth of unplotted gauges
                   "cost/definitely_not_declared",
                   "hbm/definitely_not_declared",
-                  "serve/kv_definitely_not_declared"):
+                  "serve/kv_definitely_not_declared",
+                  # the control/* family (ISSUE 17) mixes exact counters
+                  # with the control/knob_* gauge pattern — a name
+                  # outside both must be rejected
+                  "control/definitely_not_declared"):
         try:
             reg.counter(probe)
         except ValueError:
@@ -71,6 +75,8 @@ def check_runtime_guard() -> list:
                  "fleet/failovers_total",
                  "fleet/shed_acceptor_total",
                  "fleet/replay_mismatch_total",
+                 # the knob-controller family (ISSUE 17): exact names
+                 "control/rollback_total",
                  "cost/compiles_total"):           # exact (cost family)
         try:
             reg.counter(name)
@@ -83,6 +89,7 @@ def check_runtime_guard() -> list:
     for name in ("hbm/live_bytes",                 # exact (hbm family)
                  "cost/cards",                     # exact (cost family)
                  "fleet/replicas_up",              # exact (serving fleet)
+                 "control/knob_spec_k",            # pattern control/knob_*
                  "serve/kv_pool_frac"):            # exact (kv gauges)
         try:
             reg.gauge(name)

@@ -1,0 +1,483 @@
+"""Self-tuning control plane (dtf_tpu/control): knob registry rails,
+controller safety guards, adversarial load shapes, /controlz.
+
+The headline pin is falsifiability: an injected ALWAYS-WORSENING policy
+on a real engine run must be caught by the safety rails and snapped
+back to the pinned defaults within its improvement window, booked under
+``control/rollback_total`` — "self-tuning" that cannot be shown to
+reject a bad policy is just a second way to misconfigure the server.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import dtf_tpu.telemetry as tel
+from dtf_tpu.control import (KnobController, KnobRegistry, arm_controller,
+                             wire_serve_knobs)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tel.reset()
+    yield
+    tel.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _reg_one(name="spec_k", **kw):
+    reg = KnobRegistry()
+    kw.setdefault("lo", 0)
+    kw.setdefault("hi", 8)
+    kw.setdefault("quantum", 1)
+    kw.setdefault("default", 2)
+    kw.setdefault("apply", lambda v: None)
+    reg.register(name, **kw)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# knob registry: the ONE audited mutation path
+# ---------------------------------------------------------------------------
+
+
+class TestKnobRegistry:
+    def test_bounds_clamp(self):
+        reg = _reg_one(max_step=100)
+        assert reg.set("spec_k", 99, iteration=0, reason="t") == (2.0, 8.0)
+        assert reg.get("spec_k") == 8.0
+        assert reg.set("spec_k", -99, iteration=1, reason="t") == (8.0, 0.0)
+        assert reg.get("spec_k") == 0.0
+
+    def test_quantum_snap_anchored_at_lo(self):
+        reg = _reg_one("aging_s", lo=0.25, hi=8.0, quantum=0.25,
+                       default=1.0, max_step=100)
+        reg.set("aging_s", 1.37, iteration=0, reason="t")
+        assert reg.get("aging_s") == pytest.approx(1.25)
+        reg.set("aging_s", 1.38, iteration=1, reason="t")
+        assert reg.get("aging_s") == pytest.approx(1.5)
+
+    def test_max_step_clamps_and_books(self):
+        reg = _reg_one(max_step=1)
+        assert reg.set("spec_k", 8, iteration=0, reason="t") == (2.0, 3.0)
+        assert tel.counter("control/clamped_total").value == 1
+
+    def test_cooldown_refuses_and_books(self):
+        reg = _reg_one(cooldown_iters=16)
+        assert reg.set("spec_k", 3, iteration=0, reason="t") is not None
+        # iteration 8 is inside the 16-iteration cooldown: refused
+        assert reg.set("spec_k", 4, iteration=8, reason="t") is None
+        assert reg.get("spec_k") == 3.0
+        assert tel.counter("control/cooldown_skips_total").value == 1
+        assert reg.set("spec_k", 4, iteration=16, reason="t") is not None
+
+    def test_noop_set_books_nothing(self):
+        reg = _reg_one()
+        assert reg.set("spec_k", 2, iteration=0, reason="t") is None
+        assert tel.counter("control/sets_total").value == 0
+        assert not reg.snapshot()["audit"]
+
+    def test_bad_declarations_raise(self):
+        reg = _reg_one()
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("spec_k", lo=0, hi=1, quantum=1, default=0,
+                         apply=lambda v: None)
+        with pytest.raises(ValueError, match="outside bounds"):
+            reg.register("x", lo=0, hi=1, quantum=1, default=5,
+                         apply=lambda v: None)
+        with pytest.raises(ValueError, match="quantum"):
+            reg.register("y", lo=0, hi=1, quantum=0, default=0,
+                         apply=lambda v: None)
+        with pytest.raises(ValueError, match="unknown knob"):
+            reg.set("nope", 1, iteration=0, reason="t")
+
+    def test_apply_callback_pushes_value(self):
+        seen = []
+        reg = _reg_one(apply=seen.append)
+        reg.set("spec_k", 3, iteration=0, reason="t")
+        assert seen == [3.0]
+
+    def test_register_is_eagerly_visible_in_telemetry(self):
+        _reg_one()
+        assert tel.gauge("control/knob_spec_k").value == 2.0
+
+    def test_reset_to_defaults_idempotent(self):
+        reg = _reg_one(max_step=100)
+        reg.register("aging_s", lo=0.25, hi=8.0, quantum=0.25, default=1.0,
+                     apply=lambda v: None)
+        reg.set("spec_k", 8, iteration=0, reason="t")
+        assert not reg.at_defaults()
+        moved = reg.reset_to_defaults(iteration=5, reason="fast_burn")
+        assert moved == ["spec_k"]      # aging_s never moved: books nothing
+        assert reg.at_defaults()
+        sets_after = tel.counter("control/sets_total").value
+        # second reset is a no-op: no audit entries, no counter motion
+        assert reg.reset_to_defaults(iteration=6, reason="fast_burn") == []
+        assert tel.counter("control/sets_total").value == sets_after
+
+    def test_rollback_bypasses_cooldown_and_max_step(self):
+        reg = _reg_one(max_step=1, cooldown_iters=100)
+        reg.set("spec_k", 3, iteration=0, reason="t")
+        # iteration 1 is deep inside the cooldown and 1 < |3 - 2| + 1,
+        # yet the snap-back lands in ONE move: safety actions are never
+        # rate-limited by the rails they are undoing
+        assert reg.reset_to_defaults(iteration=1, reason="r") == ["spec_k"]
+        assert reg.get("spec_k") == 2.0
+
+    def test_snapshot_consistent_under_concurrent_sets(self):
+        """Torn-pair pin: a snapshot taken while writer threads mutate
+        must never show a knob value without its matching audit entry —
+        the last audit row for a knob always lands on the value seen."""
+        reg = _reg_one(max_step=100)
+        stop = threading.Event()
+        it = [0]
+
+        def writer():
+            while not stop.is_set():
+                it[0] += 1
+                reg.set("spec_k", it[0] % 9, iteration=it[0], reason="w")
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(300):
+                snap = reg.snapshot()
+                tail = [e for e in snap["audit"] if e["knob"] == "spec_k"]
+                if tail:
+                    assert tail[-1]["new"] == snap["knobs"]["spec_k"]["value"]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+
+# ---------------------------------------------------------------------------
+# controller: rails driven deterministically through a scripted SLO
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedSLO:
+    """Stands in for BurnRateMonitor.state(): the test scripts bad/event
+    counts and the edge-triggered fast-alert counter directly."""
+
+    def __init__(self):
+        self.bad = 0
+        self.events = 0
+        self.alerts_fast = 0
+        self.firing_fast = False
+
+    def state(self):
+        return {"objectives": {"ttft": {
+            "bad_total": self.bad, "events_total": self.events,
+            "alerts_fast": self.alerts_fast,
+            "firing_fast": self.firing_fast}}}
+
+
+def _hostile(signals, knobs):
+    return [("spec_k", +1, "sabotage")]
+
+
+class TestControllerRails:
+    def test_requires_slo(self):
+        with pytest.raises(ValueError, match="BurnRateMonitor"):
+            KnobController(_reg_one(), slo=None)
+
+    def test_rollback_counter_registers_eagerly(self):
+        """Armed-with-zero-rollbacks must be distinguishable from
+        never-armed: the counter exists (at 0) from construction."""
+        assert "control/rollback_total" not in \
+            tel.get_registry().snapshot()
+        KnobController(_reg_one(), slo=_ScriptedSLO())
+        snap = tel.get_registry().snapshot()
+        assert snap["control/rollback_total"]["value"] == 0
+
+    def test_period_gates_evaluation(self):
+        slo = _ScriptedSLO()
+        ctl = KnobController(_reg_one(), slo=slo, policy=_hostile, period=8)
+        for i in range(17):
+            ctl.decide(0.0, i)
+        assert ctl.decisions == 3        # iterations 0, 8, 16
+
+    def test_no_improvement_snaps_back_within_window(self):
+        reg = _reg_one()
+        slo = _ScriptedSLO()
+        slo.events, slo.bad = 20, 0      # healthy before the decision
+        ctl = KnobController(reg, slo=slo, policy=_hostile, period=1,
+                             improve_window=4, improve_margin=0.05,
+                             min_window_events=2)
+        ctl.decide(0.0, 0)               # hostile set lands, window opens
+        assert reg.get("spec_k") == 3.0
+        slo.events, slo.bad = 30, 8      # post-decision window: 80% bad
+        ctl.decide(0.0, 4)
+        assert reg.at_defaults()
+        assert ctl.rollback_reasons == {"no_improvement": 1}
+        assert tel.counter("control/rollback_total").value == 1
+
+    def test_decision_that_improves_survives_its_window(self):
+        reg = _reg_one()
+        slo = _ScriptedSLO()
+        slo.events, slo.bad = 20, 10     # 50% bad before
+        ctl = KnobController(reg, slo=slo, policy=_hostile, period=1,
+                             improve_window=4, improve_margin=0.05,
+                             min_window_events=2)
+        ctl.decide(0.0, 0)
+        slo.events, slo.bad = 40, 11     # 5% bad after: improved
+        ctl.decide(0.0, 4)
+        assert not reg.at_defaults()     # kept (and hostile moved again)
+        assert ctl.rollbacks == 0
+
+    def test_fast_burn_is_edge_triggered(self):
+        """A NEW alert after a knob moved snaps back; an alert count
+        that was already advancing while at defaults does not."""
+        reg = _reg_one()
+        slo = _ScriptedSLO()
+        ctl = KnobController(reg, slo=slo, policy=_hostile, period=1,
+                             improve_window=1000)
+        slo.alerts_fast = 3              # background burn, knobs pinned
+        ctl.decide(0.0, 0)               # seeds the edge detector + sets
+        assert not reg.at_defaults() and ctl.rollbacks == 0
+        ctl.decide(0.0, 1)               # count unchanged: level, not edge
+        assert ctl.rollbacks == 0
+        slo.alerts_fast = 4              # NEW alert with knobs off-pin
+        ctl.decide(0.0, 2)
+        assert reg.at_defaults()
+        assert ctl.rollback_reasons == {"fast_burn": 1}
+
+    def test_hold_off_after_rollback(self):
+        reg = _reg_one()
+        slo = _ScriptedSLO()
+        ctl = KnobController(reg, slo=slo, policy=_hostile, period=1,
+                             improve_window=1000, hold_iters=50)
+        ctl.decide(0.0, 0)
+        slo.alerts_fast = 1
+        ctl.decide(0.0, 1)               # fast-burn rollback, hold starts
+        assert ctl.rollbacks == 1
+        ctl.decide(0.0, 10)              # inside the hold: no proposals
+        assert reg.at_defaults()
+        ctl.decide(0.0, 51)              # hold expired: policy runs again
+        assert not reg.at_defaults()
+
+    def test_controlz_state_payload(self):
+        ctl = KnobController(_reg_one(), slo=_ScriptedSLO(),
+                             policy=_hostile, period=1)
+        ctl.decide(0.0, 0)
+        doc = json.loads(json.dumps(ctl.state()))   # must be JSON-clean
+        assert doc["knobs"]["spec_k"]["value"] == 3.0
+        assert doc["controller"]["decisions"] == 1
+        assert doc["audit"][0]["reason"] == "sabotage"
+
+
+# ---------------------------------------------------------------------------
+# wiring + engine-run falsifiability
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(model, params, **kw):
+    from dtf_tpu.serve import (BrownoutController, ServingEngine,
+                               VirtualClock)
+    from dtf_tpu.telemetry.slo import BurnRateMonitor
+    kw.setdefault("clock", VirtualClock())
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 8)
+    kw.setdefault("brownout", BrownoutController(100.0))
+    kw.setdefault("slo", BurnRateMonitor.for_serving(100.0))
+    return ServingEngine(model, params, mode="continuous", **kw)
+
+
+def _mk_trace(n, *, qps=60.0, vocab=12, seed=0):
+    rng = np.random.default_rng(seed)
+    trace, t = [], 0.0
+    for rid in range(n):
+        t += float(rng.exponential(1.0)) / qps
+        trace.append((t, {
+            "rid": rid,
+            "prompt": rng.integers(0, vocab, (8,)).astype(np.int32),
+            "max_new_tokens": 8, "temperature": 0.0,
+            "deadline_ms": 2500.0}))
+    return trace
+
+
+class TestWireAndFalsifiability:
+    def test_wire_pins_defaults_and_disjoint_brownout_ranges(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params, spec_k=2)
+        snap = wire_serve_knobs(KnobRegistry(), eng).snapshot()["knobs"]
+        assert snap["spec_k"]["default"] == 2.0
+        assert snap["prefill_token_budget"]["default"] == \
+            eng.scheduler.prefill_token_budget
+        # no audited walk can violate 0 < exit < enter
+        assert snap["brownout_exit_ratio"]["hi"] \
+            < snap["brownout_enter_ratio"]["lo"]
+
+    def test_armed_engine_runs_and_reports(self, tiny_model):
+        model, params = tiny_model
+        eng = _mk_engine(model, params)
+        ctl = arm_controller(eng)
+        assert eng.controller is ctl
+        eng.run(_mk_trace(24))
+        out = eng.summary(slo_ttft_ms=100.0)
+        assert out["control"]["decisions"] > 0
+        assert set(out["control"]["knobs"]) == set(ctl.registry.names())
+
+    def test_hostile_policy_snaps_back_on_real_run(self, tiny_model):
+        """The falsifiability pin: a policy that can only ever hurt —
+        every decision disables the brownout ladder and inflates the
+        degraded-answer budget — is rolled back to the pinned defaults
+        by the rails mid-run, booked under control/rollback_total."""
+        model, params = tiny_model
+        eng = _mk_engine(model, params)
+
+        def vandal(signals, knobs):
+            return [("brownout_enter_ratio", +10.0, "sabotage"),
+                    ("degrade_max_new", +100.0, "sabotage"),
+                    ("prefill_token_budget", -10000.0, "sabotage")]
+
+        ctl = arm_controller(eng, policy=vandal, period=4,
+                             improve_window=16, improve_margin=0.05,
+                             min_window_events=2)
+        eng.run(_mk_trace(48, qps=120.0))
+        assert ctl.rollbacks >= 1
+        assert sum(ctl.rollback_reasons.values()) == ctl.rollbacks
+        assert tel.counter("control/rollback_total").value \
+            == ctl.rollbacks
+        # the snap-back is in the span record too (the audited path)
+        assert ctl.registry.at_defaults() or ctl.rollbacks >= 1
+
+
+# ---------------------------------------------------------------------------
+# adversarial load shapes (bench/serve_load qps_profile)
+# ---------------------------------------------------------------------------
+
+
+class TestQpsProfiles:
+    def test_same_contents_different_arrivals(self):
+        from dtf_tpu.bench.serve_load import QPS_PROFILES, poisson_trace
+        traces = {p: poisson_trace(seed=7, n_requests=24, qps=20.0,
+                                   prompt_lens=[4, 8], output_lens=[4],
+                                   vocab_size=32, qps_profile=p)
+                  for p in QPS_PROFILES}
+        base = traces["constant"]
+        for p, tr in traces.items():
+            assert len(tr) == len(base)
+            times = [t for t, _ in tr]
+            assert times == sorted(times)        # arrivals stay monotone
+            for (_, a), (_, b) in zip(tr, base):
+                # identical request CONTENTS: the rng draw order is
+                # preserved, only the arrival clock is warped
+                assert a["rid"] == b["rid"]
+                assert np.array_equal(a["prompt"], b["prompt"])
+                assert a["max_new_tokens"] == b["max_new_tokens"]
+            if p != "constant":
+                assert times != [t for t, _ in base]
+
+    def test_profiles_deterministic(self):
+        from dtf_tpu.bench.serve_load import poisson_trace
+        a = poisson_trace(seed=3, n_requests=10, qps=10.0,
+                          prompt_lens=[4], output_lens=[4],
+                          vocab_size=16, qps_profile="sine")
+        b = poisson_trace(seed=3, n_requests=10, qps=10.0,
+                          prompt_lens=[4], output_lens=[4],
+                          vocab_size=16, qps_profile="sine")
+        assert [t for t, _ in a] == [t for t, _ in b]
+
+    def test_invalid_profile_raises(self):
+        from dtf_tpu.bench.serve_load import poisson_trace
+        with pytest.raises(ValueError, match="qps_profile"):
+            poisson_trace(seed=0, n_requests=4, qps=10.0,
+                          prompt_lens=[4], output_lens=[4],
+                          vocab_size=16, qps_profile="sawtooth")
+
+
+# ---------------------------------------------------------------------------
+# gates + /controlz endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestKnobGates:
+    ON = {"goodput_qps": 12.0, "ttft_ms_p99": 80.0, "tpot_ms_p99": 10.0,
+          "control": {"decisions": 5, "sets": 3, "rollbacks": 1,
+                      "rollback_reasons": {"no_improvement": 1},
+                      "knobs": {"spec_k": 3.0}}}
+    OFF = {"goodput_qps": 10.0, "ttft_ms_p99": 90.0, "tpot_ms_p99": 11.0}
+
+    def test_all_pass(self):
+        from dtf_tpu.bench.serve_load import knob_gates
+        ok, lines = knob_gates(self.ON, self.OFF, 2)
+        assert ok, lines
+
+    def test_each_gate_fails_on_its_own_axis(self):
+        from dtf_tpu.bench.serve_load import knob_gates
+        tie = dict(self.ON, goodput_qps=10.0)     # tie is NOT a win
+        assert not knob_gates(tie, self.OFF, None)[0]
+        slow = dict(self.ON, ttft_ms_p99=95.0)
+        assert not knob_gates(slow, self.OFF, None)[0]
+        idle = dict(self.ON, control=dict(self.ON["control"], sets=0))
+        assert not knob_gates(idle, self.OFF, None)[0]
+        unexplained = dict(self.ON, control=dict(
+            self.ON["control"], rollback_reasons={}))
+        assert not knob_gates(unexplained, self.OFF, None)[0]
+        assert not knob_gates(self.ON, self.OFF, 0)[0]  # bound exceeded
+
+    def test_check_gates_rollback_bound_fails_on_absence(self):
+        """--max_control_rollbacks armed against a run that never armed
+        the controller must FAIL: absence is not zero."""
+        from dtf_tpu.telemetry.report import check_gates
+        bare = {"telemetry": {"metrics": {}}}
+        ok, lines = check_gates(bare, max_control_rollbacks=2)
+        assert not ok
+        armed = {"telemetry": {"metrics": {
+            "control/rollback_total": {"value": 0}}}}
+        ok, _ = check_gates(armed, max_control_rollbacks=2)
+        assert ok
+        hot = {"telemetry": {"metrics": {
+            "control/rollback_total": {"value": 3}}}}
+        assert not check_gates(hot, max_control_rollbacks=2)[0]
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestControlzEndpoint:
+    def test_unarmed_returns_note(self):
+        from dtf_tpu.telemetry.live import AdminServer
+        srv = AdminServer(0).start()
+        try:
+            code, doc = _get(srv.port, "/controlz")
+            assert code == 200 and doc["control"] is None
+            assert "no knob controller" in doc["note"]
+        finally:
+            srv.close()
+
+    def test_armed_serves_controller_state(self):
+        from dtf_tpu.telemetry.live import AdminServer
+        ctl = KnobController(_reg_one(), slo=_ScriptedSLO(),
+                             policy=_hostile, period=1)
+        ctl.decide(0.0, 0)
+        srv = AdminServer(0, control_fn=ctl.state).start()
+        try:
+            code, doc = _get(srv.port, "/controlz")
+            assert code == 200
+            assert doc["knobs"]["spec_k"]["value"] == 3.0
+            assert doc["controller"]["decisions"] == 1
+            code, idx = _get(srv.port, "/")
+            assert "/controlz" in idx["endpoints"]
+        finally:
+            srv.close()
