@@ -33,6 +33,27 @@ def _fmt(v, width=9, digits=4) -> str:
     return str(v).rjust(width)
 
 
+def _triage(logdir: str) -> dict:
+    """Run the incident diagnoser over a failed repeat's logdir: the
+    top-ranked suspect across its incidents + the counts.  Never raises
+    (a triage that crashes must not mask the cell failure it explains)."""
+    try:
+        from dtf_tpu.telemetry import diagnose
+        doc = diagnose.diagnose_logdir(logdir)
+    except Exception as exc:
+        return {"error": str(exc)}
+    tops = [i["top"] for i in doc.get("incidents", []) if i.get("top")]
+    best = max(tops, key=lambda t: t["score"], default=None)
+    return {"anomalies": doc.get("anomalies", 0),
+            "attributed": doc.get("attributed", 0),
+            "attribution_frac": doc.get("attribution_frac"),
+            "top_suspect": ({"plane": best["plane"], "kind": best["kind"],
+                             "score": round(best["score"], 4)}
+                            if best else None),
+            "standing": [s.get("summary") for s in
+                         doc.get("standing", [])]}
+
+
 def summary_table(results: List[CellResult]) -> str:
     lines = [f"{'cell':<30} {'workload':<9} {'chaos':<7} "
              f"{'final':>9} {'goodput':>9} {'ex/s':>9} {'tok/s':>9} "
@@ -119,14 +140,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             res = run_cell(spec, workdir)
             results.append(res)
             suffix = f".rep{rep}" if rep else ""
+            doc = res.to_doc()
+            if not res.ok and res.logdir:
+                # failure triage (ISSUE 18): a failed repeat diagnoses
+                # itself — the incident correlator's top suspect and
+                # incident count land in the per-repeat JSON so a flake
+                # hunt reads WHY, not just which repeat
+                doc["triage"] = _triage(res.logdir)
             with open(os.path.join(out, f"{spec.name}{suffix}.json"),
                       "w") as f:
-                json.dump(res.to_doc(), f, indent=1, sort_keys=True)
+                json.dump(doc, f, indent=1, sort_keys=True)
             status = "PASS" if res.ok else "FAIL"
             print(f"[scenarios]   -> {status} in {res.duration_s:.1f}s",
                   flush=True)
             if res.error:
                 print(f"[scenarios]   error: {res.error}", flush=True)
+            if doc.get("triage"):
+                t = doc["triage"]
+                top = t.get("top_suspect")
+                print(f"[scenarios]   triage: {t.get('anomalies', 0)} "
+                      f"anomaly(ies), top suspect "
+                      + (f"[{top['plane']}] {top['kind']}" if top
+                         else "NONE"), flush=True)
             for line in res.gates:
                 print(f"[scenarios]   {line}", flush=True)
 

@@ -82,13 +82,23 @@ def _serve_fleet_cell(spec, logdir: str, chaos: str) -> int:
     try:
         # warm every replica through BOTH prompt-shape buckets (each
         # bucket jit-compiles its own prefill) before arming chaos, so
-        # the fault's dispatch sequence counts measured requests only
-        # and no compile lands inside a measured TTFT
+        # the fault's dispatch sequence counts measured requests only.
+        # Decode-length geometries are deliberately NOT warmed: a
+        # failover shifts the measured trace's long decodes onto the
+        # survivor cold, and the resulting compile-plus-replay TTFT
+        # spike is the fault's client-visible signature — exactly what
+        # the attribution gate judges the incident plane on
         warm = poisson_trace(seed=spec.seed + 1,
                              n_requests=2 * replicas * slots, qps=1000.0,
                              prompt_lens=[4, 8], output_lens=[2],
                              vocab_size=cfg.vocab_size, temperature=0.0)
         drive_trace(acc.address, warm, request_timeout_s=120.0)
+        # the warmup barrage's compile-dominated latencies would poison
+        # the anomaly detectors' baselines (a compile looks exactly like
+        # a fault); restart them so the measured trace builds its
+        # baseline from steady-state serving only
+        from dtf_tpu.telemetry import anomaly as _anomaly
+        _anomaly.get_monitor().reset_baselines()
         if chaos:
             acc.arm_chaos(FaultPlan.parse(chaos, process_index=0))
         trace = poisson_trace(

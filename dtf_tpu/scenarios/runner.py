@@ -193,4 +193,12 @@ def _measured(report: dict) -> dict:
         .get("attribution", {}).get("barriers"),
         "fleet_goodput": report.get("fleet", {})
         .get("rollup", {}).get("goodput", {}).get("productive_fraction"),
+        # incident plane (telemetry/anomaly.py + diagnose.py): how many
+        # anomalies fired, what fraction attributed, and which plane the
+        # top suspects blame (frac None = chaos fired, nothing detected)
+        "anomalies": report.get("incidents", {}).get("anomalies"),
+        "attribution_frac": report.get("incidents", {})
+        .get("attribution_frac"),
+        "incident_top_planes": report.get("incidents", {})
+        .get("top_plane_counts"),
     }

@@ -88,6 +88,15 @@ class Gate:
     max_skew_ms: float = 0.0
     min_fleet_goodput: float = 0.0
     max_blame_frac: float = 0.0
+    #: Incident gate (ISSUE 18, telemetry/anomaly.py + diagnose.py;
+    #: 0 = not armed) — chaos-bearing cells arm it so the incident
+    #: plane is judged END TO END: the injected fault must be DETECTED
+    #: (chaos fired with zero anomalies = frac None = not-measured =
+    #: FAIL) and the detected anomalies must rank the injected fault
+    #: kind TOP (a correlator that blames an innocent plane fails the
+    #: same floor).  Virtual-clock cells pin it high (determinism);
+    #: wall-clock cells sit looser for scheduler noise.
+    min_attribution_frac: float = 0.0
 
     def thresholds(self) -> dict:
         """Kwargs for :func:`dtf_tpu.telemetry.report.check_gates` — the
@@ -119,6 +128,8 @@ class Gate:
             out["min_fleet_goodput"] = self.min_fleet_goodput
         if self.max_blame_frac > 0:
             out["max_blame_frac"] = self.max_blame_frac
+        if self.min_attribution_frac > 0:
+            out["min_attribution_frac"] = self.min_attribution_frac
         return out
 
 
@@ -293,9 +304,19 @@ def default_matrix() -> List[ScenarioSpec]:
             name="seq2seq_straggler_ckpt_stall", workload="seq2seq",
             devices=2, steps=60, batch_size=32, learning_rate=1e-2,
             chaos="slow_host@5:0:40ms,ckpt_stall@every:10:250ms",
-            max_restarts=1,
+            max_restarts=1, checkpoint_every=2,
+            # Incident gate (ISSUE 18): each 250ms ckpt_stall onset is a
+            # checkpoint/save_ms discontinuity the anomaly plane must
+            # both DETECT and pin on the injected chaos/ckpt_stall mark.
+            # checkpoint_every=2 keeps stalled saves a 1-in-5 minority
+            # of the detector window (at the default cadence of 5 every
+            # SECOND save stalls, the window's MAD absorbs the stall
+            # level and no robust detector can call it a changepoint).
+            # Wall-clock run — the floor sits below 1.0 for scheduler
+            # noise in the save-time baseline.
             gate=Gate(max_final_cost=3.85, min_goodput=0.04,
-                      min_examples_per_s=25.0, max_rollbacks=0)),
+                      min_examples_per_s=25.0, max_rollbacks=0,
+                      min_attribution_frac=0.75)),
         ScenarioSpec(
             # THE elastic cell: 2 hosts, host 1 dies abruptly (SIGKILL)
             # mid-run; host 0 exits via the coordinated abort (71) and
@@ -360,9 +381,13 @@ def default_matrix() -> List[ScenarioSpec]:
             max_restarts=0,
             extra=(("deadline_ms", 2500.0), ("qps", 10.0),
                    ("requests", 60), ("slo_ttft_ms", 400.0)),
+            # Incident gate (ISSUE 18): the iteration-30 slow_decode
+            # onset is a TTFT/TPOT discontinuity; virtual clock makes
+            # detection + chaos-top attribution deterministic.
             gate=Gate(max_final_cost=None, min_goodput=0.004,
                       min_goodput_qps=3.5, max_ttft_p99_ms=1200.0,
-                      min_trace_complete_frac=0.99)),
+                      min_trace_complete_frac=0.99,
+                      min_attribution_frac=0.99)),
         ScenarioSpec(
             # fleet failure-domain cell (ISSUE 16): a 3-replica serving
             # fleet behind the acceptor, replica 1 SIGKILL'd (in-process
@@ -385,9 +410,15 @@ def default_matrix() -> List[ScenarioSpec]:
             timeout_s=600.0,
             extra=(("qps", 6.0), ("replicas", 3), ("requests", 36),
                    ("slo_ttft_ms", 2000.0), ("slots", 2)),
+            # Incident gate (ISSUE 18): the SIGKILL'd replica shows up
+            # as a TTFT/queue discontinuity on the survivors; the
+            # chaos/replica_down mark (with event/fleet_detach and
+            # event/fleet_failover right behind it) must rank TOP.
+            # Wall-clock fleet run — the floor sits loose.
             gate=Gate(max_final_cost=None, min_goodput=0.003,
                       min_goodput_qps=1.8, max_ttft_p99_ms=9000.0,
-                      min_trace_complete_frac=0.99)),
+                      min_trace_complete_frac=0.99,
+                      min_attribution_frac=0.75)),
         ScenarioSpec(
             # Self-tuning control plane, adversarial cell 1 (ISSUE 17):
             # OSCILLATING load — a square-wave arrival rate (1.5x/0.5x
@@ -431,9 +462,16 @@ def default_matrix() -> List[ScenarioSpec]:
             extra=(("controller", 1), ("deadline_ms", 2500.0),
                    ("qps", 28.0), ("requests", 64),
                    ("slo_ttft_ms", 400.0), ("trace_vocab", 12)),
+            # Incident gate (ISSUE 18): the periodic +50ms slow_decode
+            # hits are TPOT discontinuities; with the controller's own
+            # control/set instants in the evidence stream the chaos
+            # mark must STILL out-rank them (prior 1.0 vs 0.6) — the
+            # cell that proves attribution is not fooled by a busy
+            # control plane.  Virtual clock -> deterministic.
             gate=Gate(max_final_cost=None, min_goodput=0.002,
                       min_goodput_qps=12.0, max_ttft_p99_ms=1000.0,
-                      max_tpot_p99_ms=45.0, max_control_rollbacks=1)),
+                      max_tpot_p99_ms=45.0, max_control_rollbacks=1,
+                      min_attribution_frac=0.99)),
         ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike

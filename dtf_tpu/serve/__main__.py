@@ -170,10 +170,12 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
             trace_ring=engine.reqtrace.ring, slo=slo,
             health_fn=(health_file_fn(ns.health_dir) if ns.health_dir
                        else None),
-            control_fn=(ctl.state if ctl is not None else None))
+            control_fn=(ctl.state if ctl is not None else None),
+            logdir=getattr(ns, "logdir", None))
         if fresh:
             print(f"admin endpoint on http://127.0.0.1:{admin.port} "
-                  f"(/statz /healthz /tracez /slo /controlz /memz)",
+                  f"(/statz /healthz /tracez /slo /controlz /memz "
+                  f"/incidentz; GET / for the full index)",
                   flush=True)
     return engine
 
@@ -373,9 +375,11 @@ def _run_acceptor(ns, acc, banner: str) -> int:
     acc.start()
     if ns.admin_port is not None:
         from dtf_tpu.telemetry.live import start_admin
-        admin = start_admin(ns.admin_port, fleet_fn=acc.rollup)
+        admin = start_admin(ns.admin_port, fleet_fn=acc.rollup,
+                            logdir=ns.logdir or None)
         print(f"admin endpoint on http://127.0.0.1:{admin.port} "
-              f"(/statz /healthz /tracez /slo /fleetz /memz)", flush=True)
+              f"(/statz /healthz /tracez /slo /fleetz /memz /incidentz; "
+              f"GET / for the full index)", flush=True)
     print(banner, flush=True)
     stop.wait()
     acc.shutdown()
@@ -537,8 +541,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--admin_port", type=int, default=None,
                    help="mount the live introspection endpoint on "
                         "127.0.0.1:PORT (/statz /healthz /tracez /slo "
-                        "/controlz /memz; 0 = ephemeral port, printed "
-                        "at startup)")
+                        "/controlz /memz /incidentz; 0 = ephemeral "
+                        "port, printed at startup)")
     p.add_argument("--listen", default=None, metavar="HOST:PORT",
                    help="run the TCP front end instead of a trace "
                         "(':8100' binds 127.0.0.1:8100; wall clock); "

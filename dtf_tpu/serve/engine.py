@@ -169,6 +169,27 @@ class ServingEngine:
         #: recorder.  Always on — events are cheap and the ring is
         #: bounded.
         self.reqtrace = RequestTracer(trace_ring_capacity)
+        #: Incident plane (telemetry/anomaly.py): the process-wide
+        #: changepoint monitor, armed eagerly so even a zero-anomaly run
+        #: leaves 'armed, zero' books.  Fed from _finish (TTFT/TPOT) and
+        #: the step tail (queue depth) — values only, clock-agnostic.
+        from dtf_tpu.telemetry import anomaly as _anomaly
+        from dtf_tpu.telemetry import diagnose as _diagnose
+        self.anomaly = _anomaly.get_monitor().arm()
+        _diagnose.install()
+        #: Compile-stall exclusion for the latency feeds, WALL clock
+        #: only: a request whose service window overlaps a fresh XLA
+        #: compile measures the compile, not serving health — feeding
+        #: it would make every new-geometry compile a false anomaly
+        #: (the trainer applies the same rule to compile-bearing
+        #: steps).  A VirtualClock charges compiles zero virtual time,
+        #: so its latencies are never polluted and nothing is excluded.
+        self._compile_feed_guard = isinstance(self.clock, WallClock)
+        self._compiles_seen: Optional[int] = None
+        self._last_compile_clock_s: Optional[float] = None
+        #: Brownout level as of the previous step tail — the edge the
+        #: event/brownout_transition evidence instant fires on.
+        self._prev_brownout_level = 0 if brownout is not None else None
         self.mode = mode
         self.top_k = top_k
         self.top_p = top_p
@@ -375,6 +396,38 @@ class ServingEngine:
                 tel.histogram("serve/tpot_ms").observe(tpot * 1e3)
         if ttft is not None and self.brownout is not None:
             self.brownout.observe_ttft(ttft * 1e3)
+        # incident plane feeds: per-completion latency observations into
+        # the changepoint detectors (values only, no clock reads).  On a
+        # wall clock the TPOT feed excludes completions whose decode
+        # window [first_token, last_token] contained the most recent
+        # XLA compile — their streaming cadence measures the compile
+        # stall, not serving health, and every fresh decode-batch
+        # geometry would read as a fault.  TTFT feeds UNGUARDED on
+        # purpose: its compile pollution is the first-encounter prefill
+        # of each prompt bucket, which lands during detector cold-start
+        # (min_samples shields it), while a mid-run compile that blocks
+        # QUEUED requests is real head-of-line blocking the client
+        # waited through — e.g. a failover onto cold geometries — and
+        # the correlator, not the feed, is the layer that decides
+        # whether chaos or the compile owns that spike.
+        clean_tpot = True
+        if self._compile_feed_guard:
+            from dtf_tpu.telemetry import costobs as _costobs
+            c = _costobs.get_observatory().total_compiles()
+            if c != self._compiles_seen:
+                self._compiles_seen = c
+                self._last_compile_clock_s = now
+            stamp = self._last_compile_clock_s
+            if stamp is not None and req.first_token_s is not None:
+                end = (req.last_token_s
+                       if req.last_token_s is not None else now)
+                clean_tpot = not (req.first_token_s <= stamp <= end)
+        if ttft is not None:
+            self.anomaly.observe("serve/ttft_ms", ttft * 1e3,
+                                 tick=self.iterations)
+        if clean_tpot and tpot is not None:
+            self.anomaly.observe("serve/tpot_ms", tpot * 1e3,
+                                 tick=self.iterations)
         if self.slo is not None:
             if ttft is not None and self.slo.slo_ttft_ms is not None:
                 self.slo.record("ttft", ttft * 1e3 > self.slo.slo_ttft_ms,
@@ -869,6 +922,14 @@ class ServingEngine:
                 self.iterations,
                 self.scheduler.oldest_queued_wait_s(self.clock.now()))
             tel.gauge("serve/brownout_level").set(level)
+            if level != self._prev_brownout_level:
+                # evidence instant for the incident correlator: the
+                # brownout plane changed state (brownout.py itself
+                # stays telemetry-free; the engine owns the edge)
+                tel.instant("event/brownout_transition",
+                            old=self._prev_brownout_level, new=level,
+                            iteration=self.iterations)
+                self._prev_brownout_level = level
         if self.slo is not None:
             self.slo.update(self.clock.now(), self.iterations)
         if self.controller is not None:
@@ -900,6 +961,9 @@ class ServingEngine:
             tel.gauge("hbm/kv_pool_bytes").set(obs["bytes_in_use"])
         tel.gauge("serve/queue_depth").set(len(self.scheduler.queue))
         tel.gauge("serve/active_requests").set(self.scheduler.num_active())
+        self.anomaly.observe("serve/queue_depth",
+                             len(self.scheduler.queue),
+                             tick=self.iterations)
         tracker = tel.get_tracker()
         booked = ((tracker.buckets["productive"] - prod0)
                   + (tracker.buckets["compile"] - comp0))

@@ -365,6 +365,9 @@ class FleetAcceptor:
             r.beat_at_detach = r.beat_count
         tel.counter("fleet/detached_total").inc()
         tel.gauge("fleet/replicas_up").set(len(self._up_replicas()))
+        # evidence instant for the incident correlator: a membership
+        # change is a prime suspect for any latency anomaly that follows
+        tel.instant("event/fleet_detach", replica=r.index, reason=reason)
         log.warning("[fleet] replica %d detached (%s)", r.index, reason)
 
     def _rejoin(self, r: Replica) -> None:
@@ -450,6 +453,16 @@ class FleetAcceptor:
                     except OSError:
                         r.failed_legs += 1
             self._check_beats()
+            # incident plane: fleet membership into the changepoint
+            # detector.  A replica dropping out is a step the latency
+            # planes may never see (a warm survivor absorbs the load
+            # with no client-visible latency), so the monitor watches
+            # the up-count itself; the correlator then decides whether
+            # chaos or an innocent stale-beat detach owns the drop.
+            from dtf_tpu.telemetry import anomaly as _anomaly
+            _anomaly.observe("serve/fleet_up_replicas",
+                             float(sum(1 for rr in self.replicas
+                                       if rr.state == "up")))
             now = time.perf_counter()
             if self._book_wall:
                 cat = "productive" if self._inflight_count else "stall"
@@ -713,6 +726,8 @@ class FleetAcceptor:
                 with self._lock:
                     self._totals["failovers"] += 1
                 tel.counter("fleet/failovers_total").inc()
+                tel.instant("event/fleet_failover", rid=fl["rid"],
+                            attempt=fl["failovers"])
                 nxt = self._route(exclude=tried)
                 if nxt is None:
                     return False

@@ -77,6 +77,17 @@ def write_telemetry_json(logdir: str, extra: Optional[dict] = None) -> str:
     obs.update_live_memory()
     doc = {"goodput": get_tracker().snapshot(),
            "written_unix": time.time()}
+    # incident plane: the sync-point signals (goodput fraction, HBM
+    # roofline fraction) feed the changepoint detectors here — once per
+    # logging boundary, never on the hot path
+    from dtf_tpu.telemetry import anomaly as _anomaly
+    mon = _anomaly.get_monitor()
+    if doc["goodput"].get("wall_s"):
+        mon.observe("goodput/fraction",
+                    doc["goodput"].get("productive_fraction", 0.0))
+    _hbm = get_registry().snapshot().get("hbm/frac")
+    if _hbm is not None and _hbm.get("value") is not None:
+        mon.observe("hbm/frac", _hbm["value"])
     if obs.total_compiles() or obs.live_peak_bytes() is not None:
         doc["cost"] = obs.summary()
         obs.write_jsonl(logdir)
@@ -100,3 +111,7 @@ def reset() -> None:
     _fleet.reset()
     from dtf_tpu.telemetry import costobs as _costobs
     _costobs.get_observatory().reset()
+    from dtf_tpu.telemetry import anomaly as _anomaly
+    _anomaly.reset()
+    from dtf_tpu.telemetry import diagnose as _diagnose
+    _diagnose.reset()

@@ -1,0 +1,216 @@
+"""Online anomaly detection over the signals the run already books.
+
+The five evidence planes (spans/goodput, request traces, SLO burn,
+fleet skew, CostCards/HBM, control audit) record what happened; none of
+them says *something just changed*.  This module is that trigger: a
+rolling robust-statistics detector per signal — windowed median/MAD
+with a changepoint EDGE trigger — fed inline from ``engine.step()`` and
+the trainer's sync points, emitting one ``anomaly/<signal>`` instant
+per onset plus the eagerly-registered ``anomaly/detected_total``
+counter (absent counter = the plane never armed = a gate FAIL, never a
+silent zero — the torn-pair discipline).
+
+Detector math (DESIGN.md "Incident plane"):
+
+* maintain a bounded window of recent observations; never fire until
+  ``min_samples`` have been seen (cold start is silence, not noise);
+* robust z-score ``z = |x - median| / D`` with
+  ``D = max(1.4826 * MAD, rel_floor * |median|, abs_floor)`` — the MAD
+  term adapts to the signal's own spread, the two floors keep an
+  all-constant signal (MAD = 0) from dividing by zero or firing on
+  float noise;
+* EDGE trigger: a detector in the anomalous state does not re-fire; it
+  re-arms only after z falls below ``threshold / 2`` (hysteresis).  A
+  step function therefore fires exactly once; a recurring fault (every
+  Nth checkpoint stalled) fires once per onset.
+
+Everything is values-only arithmetic — no clock reads, no jax — so a
+VirtualClock run and a WallClock run fed the same observation sequence
+fire identically (tested), and the hot-path cost is one deque append
+plus a sort of a <=64-element window per observation.
+
+Detection and attribution are deliberately split: this module only
+*notices*; :mod:`dtf_tpu.telemetry.diagnose` explains, by correlating
+each fire against every plane's instant stream.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Deque, Dict, Optional
+
+# -- per-signal detector configuration ---------------------------------------
+# Conservative by design: a false anomaly poisons the attribution gate
+# far more than a missed one (every fire must find its cause).  Floors
+# are in the signal's own units.  A steadily RAMPING signal (overload
+# queue growth, TTFT creep) keeps z near 1 because the MAD grows with
+# the ramp — only discontinuities fire, which is exactly the changepoint
+# semantic the correlator needs.
+SIGNALS: Dict[str, dict] = {
+    "serve/ttft_ms":      dict(window=48, min_samples=16, threshold=8.0,
+                               rel_floor=0.25, abs_floor=5.0),
+    "serve/tpot_ms":      dict(window=48, min_samples=16, threshold=8.0,
+                               rel_floor=0.25, abs_floor=2.0),
+    "serve/queue_depth":  dict(window=64, min_samples=24, threshold=10.0,
+                               rel_floor=0.50, abs_floor=2.0),
+    "train/step_ms":      dict(window=32, min_samples=12, threshold=8.0,
+                               rel_floor=0.20, abs_floor=5.0),
+    "checkpoint/save_ms": dict(window=16, min_samples=3, threshold=4.0,
+                               rel_floor=0.50, abs_floor=15.0),
+    "goodput/fraction":   dict(window=16, min_samples=8, threshold=6.0,
+                               rel_floor=0.20, abs_floor=0.05),
+    "hbm/frac":           dict(window=16, min_samples=8, threshold=6.0,
+                               rel_floor=0.20, abs_floor=0.02),
+    "fleet/skew_ms":      dict(window=32, min_samples=12, threshold=8.0,
+                               rel_floor=0.50, abs_floor=5.0),
+    # serve-fleet membership: a count, not a latency.  One replica
+    # dropping out of a small fleet must fire (|Δ|=1 against abs_floor
+    # 0.25 gives z=4 even when the default rel_floor would swallow it),
+    # and a warm survivor can absorb the load with NO client-visible
+    # latency shift — membership is the only plane that sees the fault.
+    "serve/fleet_up_replicas": dict(window=48, min_samples=8,
+                                    threshold=4.0, rel_floor=0.05,
+                                    abs_floor=0.25),
+}
+DEFAULT_CONFIG = dict(window=48, min_samples=16, threshold=8.0,
+                      rel_floor=0.25, abs_floor=1e-9)
+
+_MAD_SCALE = 1.4826            # MAD -> sigma for a normal distribution
+
+
+def _median(xs) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class RollingDetector:
+    """One signal's changepoint detector (see module docstring)."""
+
+    def __init__(self, signal: str, window: int = 48, min_samples: int = 16,
+                 threshold: float = 8.0, rel_floor: float = 0.25,
+                 abs_floor: float = 1e-9):
+        self.signal = signal
+        self.min_samples = max(2, min_samples)
+        self.threshold = threshold
+        self.rel_floor = rel_floor
+        self.abs_floor = abs_floor
+        self.window: Deque[float] = collections.deque(maxlen=window)
+        self.in_anomaly = False
+        self.fired_total = 0
+        self._n_seen = 0
+
+    def score(self, value: float) -> Optional[dict]:
+        """Robust z of ``value`` against the current window, or None
+        while the window is still cold."""
+        if len(self.window) < self.min_samples:
+            return None
+        med = _median(self.window)
+        mad = _median([abs(x - med) for x in self.window])
+        denom = max(_MAD_SCALE * mad, self.rel_floor * abs(med),
+                    self.abs_floor)
+        return {"median": med, "mad": mad,
+                "z": abs(value - med) / denom}
+
+    def observe(self, value: float, tick=None) -> Optional[dict]:
+        """Feed one observation; returns a fire-doc on an anomaly ONSET
+        (edge), None otherwise.  ``tick`` is annotation only (step /
+        iteration number) — the math never reads a clock."""
+        value = float(value)
+        self._n_seen += 1
+        sc = self.score(value)
+        fired = None
+        if sc is not None:
+            z = sc["z"]
+            if z >= self.threshold and not self.in_anomaly:
+                self.in_anomaly = True
+                self.fired_total += 1
+                fired = {"signal": self.signal, "value": value,
+                         "median": sc["median"], "mad": sc["mad"],
+                         "z": z, "n": self._n_seen}
+                if tick is not None:
+                    fired["tick"] = tick
+            elif self.in_anomaly and z < self.threshold / 2.0:
+                self.in_anomaly = False
+        # the window always absorbs the observation — after a level
+        # shift the baseline migrates, z decays below the hysteresis
+        # exit, and the detector re-arms for the NEXT edge
+        self.window.append(value)
+        return fired
+
+
+class AnomalyMonitor:
+    """Process-wide detector bank: one :class:`RollingDetector` per
+    signal, lazily created from :data:`SIGNALS`.  On a fire it books the
+    ``anomaly/detected_total`` counter, emits the ``anomaly/<signal>``
+    instant (the post-hoc evidence), and hands the fire-doc to the live
+    correlator (:func:`dtf_tpu.telemetry.diagnose.record_anomaly`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._detectors: Dict[str, RollingDetector] = {}
+        self._armed = False
+
+    def arm(self) -> "AnomalyMonitor":
+        """Eagerly register the detection counter (absence from a run's
+        books must mean 'never armed', not zero).  Idempotent."""
+        if not self._armed:
+            from dtf_tpu.telemetry import counter
+            counter("anomaly/detected_total")
+            self._armed = True
+        return self
+
+    def _detector(self, signal: str) -> RollingDetector:
+        det = self._detectors.get(signal)
+        if det is None:
+            cfg = SIGNALS.get(signal, DEFAULT_CONFIG)
+            det = self._detectors[signal] = RollingDetector(signal, **cfg)
+        return det
+
+    def observe(self, signal: str, value, tick=None) -> Optional[dict]:
+        """Feed one observation of ``signal``; returns the fire-doc on
+        an onset (after booking + emitting it), else None."""
+        with self._lock:
+            fired = self._detector(signal).observe(value, tick=tick)
+        if fired is None:
+            return None
+        self.arm()
+        from dtf_tpu.telemetry import counter, instant
+        counter("anomaly/detected_total").inc()
+        # slash-scoped signal -> one flat anomaly/* segment, so every
+        # anomaly instant lints against the single declared pattern
+        slug = signal.replace("/", "_")
+        instant(f"anomaly/{slug}", **fired)
+        from dtf_tpu.telemetry import diagnose
+        diagnose.record_anomaly(f"anomaly/{slug}", fired)
+        return fired
+
+    def reset_baselines(self) -> None:
+        """Drop every detector's window/state (keeps the armed counter).
+        Used after a warmup phase whose traffic shape is deliberately
+        unlike steady state (the fleet cell's pre-chaos barrage)."""
+        with self._lock:
+            self._detectors.clear()
+
+
+# -- process-wide monitor ----------------------------------------------------
+
+_MONITOR = AnomalyMonitor()
+
+
+def get_monitor() -> AnomalyMonitor:
+    return _MONITOR
+
+
+def observe(signal: str, value, tick=None) -> Optional[dict]:
+    """Module-level convenience: feed the process-wide monitor."""
+    return _MONITOR.observe(signal, value, tick=tick)
+
+
+def reset() -> None:
+    """Forget all detector state AND the armed flag (telemetry.reset()
+    companion — a new run re-arms on first feed)."""
+    global _MONITOR
+    _MONITOR = AnomalyMonitor()

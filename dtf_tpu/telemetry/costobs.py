@@ -218,6 +218,7 @@ class CostObservatory:
         bytes_accessed = _fnum(ca.get("bytes accessed"))
         oi, bound = classify(flops, bytes_accessed,
                              self._resolve_roofline())
+        new_geometry = False
         with self._lock:
             geometry = _deep_tuple(geometry)
             key = (site, geometry)
@@ -227,6 +228,7 @@ class CostObservatory:
                                 seq=self._seq)
                 self._seq += 1
                 self._cards[key] = card
+                new_geometry = True
             card.n_compiles += 1
             self._compiles += 1
             card.flops = flops
@@ -260,6 +262,15 @@ class CostObservatory:
                     _registry.gauge("cost/bytes_total").add(bytes_accessed)
                 if peak_card is not None:
                     _registry.gauge("hbm/peak_card_bytes").set(peak_card)
+        if new_geometry:
+            # evidence instant for the incident correlator: a compile
+            # against a geometry this process has never seen is exactly
+            # the kind of event that explains a step-time spike.
+            # Emitted OUTSIDE the observatory lock (the tracer flushes
+            # to disk; lock order stays obs -> registry only).
+            from dtf_tpu.telemetry import spans as _spans
+            _spans.instant("event/compile_new_geometry", site=site,
+                           seq=card.seq)
         return card
 
     # -- live device memory (sync points only) ------------------------------

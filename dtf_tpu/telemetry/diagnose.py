@@ -1,0 +1,388 @@
+"""Cross-plane root-cause attribution: every anomaly explains itself.
+
+:mod:`dtf_tpu.telemetry.anomaly` notices that a signal changed; this
+module says WHY, by correlating each fire against the instant streams
+every other plane already emits — chaos fault marks, ``control/set`` /
+``control/rollback`` audit entries, brownout transitions, SLO
+first-alert marks, fleet detach/failover, new-geometry compile events,
+drain marks, supervisor restarts and health aborts.  One deterministic
+rule, two consumers:
+
+* LIVE — a tap on :func:`dtf_tpu.telemetry.spans.Tracer.instant` keeps
+  a bounded in-process event log; each anomaly fire is correlated
+  immediately and the resulting incident lands in a bounded ring served
+  by the ``/incidentz`` admin endpoint as one consistent cut;
+* POST-HOC — ``report --diagnose <logdir>`` re-runs the SAME
+  :func:`correlate` over the instants parsed back from the span files,
+  so the live and post-mortem verdicts cannot drift apart.
+
+Attribution rule (DESIGN.md "Incident plane"): a candidate suspect is
+any evidence instant with ``ts <= anomaly.ts`` (temporal PRECEDENCE —
+an effect never explains its cause) within the causality window
+(default 60 s of tracer wall-clock); its score is
+``prior(plane) * exp(-dt / tau)``.  On a VirtualClock run all the
+wall-clock gaps compress toward zero, so precedence + priors decide —
+which is what makes the scenario-matrix attribution gate deterministic.
+Anomaly instants themselves are never evidence (a symptom cannot
+explain a symptom), and SLO alerts carry the lowest prior for the same
+reason: they are detectors, not causes.
+
+Falsifiability is the contract: an anomaly with NO suspect is an exit-1
+failure of ``report --diagnose`` (silence is a failure, not a pass),
+and the scenario gate ``min_attribution_frac`` demands the injected
+fault kind be TOP-ranked — a correlator that blames an innocent plane
+demonstrably fails it (tested with an inverted-priors variant).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# -- plane priors -------------------------------------------------------------
+# Ordered matchers: first hit wins.  Priors encode how *causal* a plane
+# is when it precedes an anomaly — injected faults are ground truth
+# (1.0); fleet membership changes and control rollbacks are strong
+# causes; an SLO alert is another detector looking at the same symptom
+# (0.3, kept only so an otherwise-unexplained anomaly still shows its
+# context).  A matcher is (prefix | exact, plane, prior).
+PLANE_PRIORS: Tuple[Tuple[str, str, float], ...] = (
+    ("chaos/",                    "chaos",    1.00),
+    ("event/fleet_detach",        "fleet",    0.90),
+    ("event/fleet_failover",      "fleet",    0.90),
+    ("control/rollback",          "control",  0.80),
+    ("event/supervisor_restart",  "health",   0.70),
+    ("health/",                   "health",   0.70),
+    ("control/set",               "control",  0.60),
+    ("event/brownout_transition", "brownout", 0.50),
+    ("event/serve_drain",         "drain",    0.45),
+    ("event/compile_new_geometry", "compile", 0.40),
+    ("event/slo_alert_",          "slo",      0.30),
+)
+
+#: causality window: evidence older than this cannot explain an anomaly
+WINDOW_S = 60.0
+#: recency decay constant inside the window
+TAU_S = 20.0
+#: bounded live stores
+EVENT_LOG_MAX = 4096
+INCIDENT_RING_MAX = 256
+
+
+def classify(name: str) -> Optional[Tuple[str, float]]:
+    """(plane, prior) for an evidence instant name; None when the name
+    is not evidence (anomaly/* and reqtrace/* included)."""
+    if name.startswith("anomaly/"):
+        return None
+    for pat, plane, prior in PLANE_PRIORS:
+        if name == pat or (pat.endswith(("/", "_")) and
+                           name.startswith(pat)):
+            return plane, prior
+    return None
+
+
+def _kind(name: str) -> str:
+    """Suspect kind: the fault kind for chaos marks, else the full
+    instant name — what the gate compares against the injected plan."""
+    if name.startswith("chaos/"):
+        return name.split("/", 1)[1]
+    return name
+
+
+def correlate(anomaly_ts_us: float, events: Iterable[dict],
+              window_s: float = WINDOW_S, tau_s: float = TAU_S,
+              priors=None) -> List[dict]:
+    """Rank suspects for one anomaly at ``anomaly_ts_us`` against
+    ``events`` (dicts with ``name``/``ts``/``args``).  Deterministic:
+    score = prior * exp(-dt/tau); ties break by prior then recency.
+    ``priors`` overrides :data:`PLANE_PRIORS` (the falsifiability tests
+    invert them to prove the gate catches an innocent-blaming ranker).
+
+    One suspect per (plane, kind): the LATEST qualifying instant of that
+    kind carries the evidence; ``count`` says how many preceded."""
+    table = PLANE_PRIORS if priors is None else priors
+    best: Dict[Tuple[str, str], dict] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        hit = None
+        for pat, plane, prior in table:
+            if name == pat or (pat.endswith(("/", "_")) and
+                               name.startswith(pat)):
+                hit = (plane, prior)
+                break
+        if hit is None or name.startswith("anomaly/"):
+            continue
+        ts = float(ev.get("ts", 0.0))
+        dt_s = (anomaly_ts_us - ts) / 1e6
+        if dt_s < 0 or dt_s > window_s:
+            continue               # precedence + causality window
+        plane, prior = hit
+        score = prior * math.exp(-dt_s / tau_s)
+        key = (plane, _kind(name))
+        cur = best.get(key)
+        if cur is None or score > cur["score"]:
+            best[key] = {"plane": plane, "kind": key[1], "name": name,
+                         "ts_us": ts, "dt_s": round(dt_s, 6),
+                         "prior": prior, "score": score,
+                         "evidence": dict(ev.get("args") or {}),
+                         "count": (cur["count"] if cur else 0)}
+        best[key]["count"] += 1
+    return sorted(best.values(),
+                  key=lambda s: (-s["score"], -s["prior"], s["dt_s"]))
+
+
+# -- live plane ---------------------------------------------------------------
+
+class IncidentRing:
+    """Bounded FIFO of incidents with a consistent snapshot (the same
+    rev/rev_echo torn-read discipline is unnecessary here because the
+    whole cut is built under one lock)."""
+
+    def __init__(self, maxlen: int = INCIDENT_RING_MAX):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self._total = 0
+
+    def push(self, incident: dict) -> None:
+        with self._lock:
+            incident = dict(incident)
+            incident["seq"] = self._total
+            self._ring.append(incident)
+            self._total += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"total": self._total,
+                    "evicted": self._total - len(self._ring),
+                    "incidents": [dict(i) for i in self._ring]}
+
+
+class _LiveState:
+    """Process-wide tap + ring (reset() swaps the whole object)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.events: collections.deque = collections.deque(
+            maxlen=EVENT_LOG_MAX)
+        self.ring = IncidentRing()
+        self.tapped = False
+
+    def tap(self, name: str, ts_us: float, args: dict, process: int
+            ) -> None:
+        if classify(name) is None:
+            return
+        with self.lock:
+            self.events.append({"name": name, "ts": ts_us,
+                                "args": dict(args), "pid": process})
+
+
+_STATE = _LiveState()
+
+
+def _ensure_tap() -> None:
+    if not _STATE.tapped:
+        from dtf_tpu.telemetry import spans
+        spans.add_instant_tap(_STATE.tap)
+        _STATE.tapped = True
+
+
+def install() -> None:
+    """Arm the live evidence tap + the incident instruments (idempotent;
+    called by the anomaly monitor's consumers at startup so even a
+    zero-incident run leaves 'armed, zero' books, never silence)."""
+    _ensure_tap()
+    from dtf_tpu.telemetry import counter
+    counter("incident/recorded_total")
+    counter("incident/attributed_total")
+
+
+def record_anomaly(name: str, fired: dict) -> dict:
+    """Live path, called by the anomaly monitor on each fire: correlate
+    NOW against the tapped event log, book the incident counters, and
+    push the incident into the ring.  Returns the incident."""
+    install()
+    now_us = time.time() * 1e6
+    with _STATE.lock:
+        events = list(_STATE.events)
+    suspects = correlate(now_us, events)
+    incident = {"anomaly": dict(fired, name=name), "ts_us": now_us,
+                "suspects": suspects,
+                "top": suspects[0] if suspects else None}
+    from dtf_tpu.telemetry import counter
+    counter("incident/recorded_total").inc()
+    if suspects:
+        counter("incident/attributed_total").inc()
+    _STATE.ring.push(incident)
+    return incident
+
+
+def get_ring() -> IncidentRing:
+    return _STATE.ring
+
+
+def incidentz(logdir: Optional[str] = None) -> dict:
+    """The ``/incidentz`` payload: one consistent cut of the live ring
+    plus any standing incidents (bench-ledger stall) for ``logdir``."""
+    doc = _STATE.ring.snapshot()
+    doc["generated_unix"] = time.time()
+    standing = ledger_standing_incidents(logdir) if logdir else []
+    doc["standing"] = standing
+    return doc
+
+
+def reset() -> None:
+    """Forget the live event log + ring (telemetry.reset() companion)."""
+    global _STATE
+    old, _STATE = _STATE, _LiveState()
+    if old.tapped:
+        from dtf_tpu.telemetry import spans
+        spans.remove_instant_tap(old.tap)
+
+
+# -- post-hoc plane -----------------------------------------------------------
+
+def diagnose_records(records: Iterable[dict], window_s: float = WINDOW_S,
+                     priors=None) -> dict:
+    """Re-run the live rule over span records parsed from disk: every
+    ``anomaly/*`` instant is correlated against every evidence instant.
+    Returns the report's ``incidents`` section (see
+    :func:`attribution_summary` for the gate quantity)."""
+    instants = [r for r in records if r.get("ph") == "i"]
+    anomalies = [r for r in instants
+                 if str(r.get("name", "")).startswith("anomaly/")]
+    evidence = [r for r in instants
+                if classify(str(r.get("name", ""))) is not None]
+    incidents = []
+    for a in sorted(anomalies, key=lambda r: float(r.get("ts", 0.0))):
+        ts = float(a.get("ts", 0.0))
+        suspects = correlate(ts, evidence, window_s=window_s,
+                             priors=priors)
+        incidents.append({
+            "anomaly": {"name": a.get("name"), "ts_us": ts,
+                        **dict(a.get("args") or {})},
+            "ts_us": ts,
+            "suspects": suspects,
+            "top": suspects[0] if suspects else None,
+        })
+    return attribution_summary(incidents, evidence)
+
+
+def attribution_summary(incidents: List[dict], evidence: List[dict]
+                        ) -> dict:
+    """Fold incidents into the gate's quantities.
+
+    ``attribution_frac`` is the fraction the ``min_attribution_frac``
+    gate reads; its meaning is deliberately strict when chaos is in
+    play: with injected-fault evidence present, ONLY an incident whose
+    TOP suspect is the chaos plane counts as attributed (top-ranked
+    innocent = unattributed = gate-visible).  With chaos fired but ZERO
+    anomalies detected, the fraction is None — gated-but-unmeasured
+    fails, which is exactly the injected-but-undetected case.  Without
+    chaos evidence, attributed simply means 'has at least one suspect'
+    (the report --diagnose exit-1 rule)."""
+    chaos_fired = any(str(e.get("name", "")).startswith("chaos/")
+                      for e in evidence)
+    n = len(incidents)
+    if chaos_fired:
+        attributed = sum(1 for i in incidents
+                         if i["top"] and i["top"]["plane"] == "chaos")
+        frac = (attributed / n) if n else None
+    else:
+        attributed = sum(1 for i in incidents if i["suspects"])
+        frac = (attributed / n) if n else 1.0
+    planes = collections.Counter(
+        i["top"]["plane"] for i in incidents if i["top"])
+    return {"anomalies": n, "attributed": attributed,
+            "attribution_frac": frac, "chaos_fired": chaos_fired,
+            "unattributed": sum(1 for i in incidents
+                                if not i["suspects"]),
+            "top_plane_counts": dict(planes),
+            "incidents": incidents}
+
+
+def diagnose_logdir(logdir: str, window_s: float = WINDOW_S,
+                    priors=None) -> dict:
+    """Parse ``logdir``'s span files and diagnose them; also attaches
+    any standing incidents (bench-ledger stall) found near the logdir."""
+    from dtf_tpu.telemetry import spans
+    records: List[dict] = []
+    for path in spans.find_span_files(logdir):
+        records.extend(spans.read_spans(path))
+    doc = diagnose_records(records, window_s=window_s, priors=priors)
+    doc["standing"] = ledger_standing_incidents(logdir)
+    return doc
+
+
+# -- standing incidents (bench-ledger stall) ---------------------------------
+
+#: trailing error rows (same kind) before the trajectory counts as
+#: stalled — matches the r03-r05 shape bench.py --check-ledger warns on
+LEDGER_STALL_STREAK = 3
+
+
+def ledger_standing_incidents(logdir: Optional[str]) -> List[dict]:
+    """The bench-ledger STALLED streak as a standing incident: walk up
+    from ``logdir`` looking for ``LEDGER.jsonl``; a trailing streak of
+    >= LEDGER_STALL_STREAK error rows of one kind becomes one incident
+    with the preflight stage/reason as evidence.  Empty list when no
+    ledger is in scope (the common case) — never an error."""
+    path = _find_ledger(logdir)
+    if path is None:
+        return []
+    try:
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    except (OSError, ValueError):
+        return []
+    out = []
+    for kind in sorted({r.get("kind") for r in rows if r.get("kind")}):
+        kind_rows = sorted((r for r in rows if r.get("kind") == kind),
+                           key=lambda r: r.get("n") or 0)
+        streak = []
+        for r in reversed(kind_rows):
+            if r.get("error"):
+                streak.append(r)
+            else:
+                break
+        if len(streak) < LEDGER_STALL_STREAK:
+            continue
+        streak.reverse()
+        reasons = sorted({f"{r.get('error')}@{r.get('stage')}"
+                          for r in streak})
+        out.append({
+            "kind": "bench_ledger_stalled",
+            "plane": "bench",
+            "ledger": path,
+            "bench_kind": kind,
+            "streak": len(streak),
+            "runs": f"{streak[0].get('run')}..{streak[-1].get('run')}",
+            "reasons": reasons,
+            "summary": (f"last {len(streak)} {kind} run(s) errored "
+                        f"({', '.join(reasons)}) — perf trajectory "
+                        f"STALLED, fresh numbers needed"),
+        })
+    return out
+
+
+def _find_ledger(logdir: Optional[str]) -> Optional[str]:
+    if not logdir:
+        return None
+    d = os.path.abspath(logdir)
+    for _ in range(4):             # logdir, run dir, results dir, repo
+        cand = os.path.join(d, "LEDGER.jsonl")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None

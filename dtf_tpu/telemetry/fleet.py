@@ -892,6 +892,10 @@ class FleetPlane:
             reg.counter(f"fleet/blame_p{last}").inc()
             reg.gauge(f"fleet/lateness_s_p{last}").add(cost)
             reg.gauge("fleet/hosts").set(len(arrivals))
+        # incident plane: per-barrier skew into the changepoint detector
+        # — a straggler ONSET (not a steady straggler) fires here
+        from dtf_tpu.telemetry import anomaly as _anomaly
+        _anomaly.observe("fleet/skew_ms", skew * 1e3)
 
     def fleetz(self) -> dict:
         """ONE consistent fleet cut for ``/fleetz`` / ``fleet.json``:

@@ -118,7 +118,8 @@ class AdminServer:
                  trace_ring=None, slo=None,
                  health_fn: Optional[Callable[[], Optional[dict]]] = None,
                  fleet_fn: Optional[Callable[[], dict]] = None,
-                 control_fn: Optional[Callable[[], dict]] = None):
+                 control_fn: Optional[Callable[[], dict]] = None,
+                 logdir: Optional[str] = None):
         self.host = host
         self._requested_port = int(port)
         self.probe = probe or LivenessProbe()
@@ -127,6 +128,9 @@ class AdminServer:
         self.health_fn = health_fn
         self.fleet_fn = fleet_fn
         self.control_fn = control_fn
+        #: run logdir, when known — lets /incidentz fold in standing
+        #: incidents found near the run (bench-ledger stall)
+        self.logdir = logdir
         self._server = None
         self._thread = None
 
@@ -134,7 +138,7 @@ class AdminServer:
     # per attempt, one server per process)
     def bind(self, *, probe=None, trace_ring=None, slo=None,
              health_fn=None, fleet_fn=None,
-             control_fn=None) -> "AdminServer":
+             control_fn=None, logdir=None) -> "AdminServer":
         if probe is not None:
             self.probe = probe
         if trace_ring is not None:
@@ -147,6 +151,8 @@ class AdminServer:
             self.fleet_fn = fleet_fn
         if control_fn is not None:
             self.control_fn = control_fn
+        if logdir is not None:
+            self.logdir = logdir
         return self
 
     @property
@@ -211,6 +217,32 @@ class AdminServer:
         from dtf_tpu.telemetry import costobs
         return 200, costobs.get_observatory().memz()
 
+    def _incidentz(self) -> tuple:
+        # the process-wide incident ring (telemetry/diagnose.py): one
+        # consistent cut built under the ring lock — live incidents with
+        # their ranked suspects, plus any standing incidents (bench-
+        # ledger stall) in scope of this run's logdir.
+        from dtf_tpu.telemetry import diagnose
+        return 200, diagnose.incidentz(self.logdir)
+
+    def _endpoints(self) -> dict:
+        """The root index: EVERY endpoint — the always-mounted ones and
+        the conditionally-armed ones — with an armed/unarmed marker, so
+        an operator sees what exists, not just what answers today."""
+        return {
+            "/statz": "armed",
+            "/healthz": "armed",
+            "/tracez": ("armed" if self.trace_ring is not None
+                        else "unarmed"),
+            "/slo": "armed" if self.slo is not None else "unarmed",
+            "/fleetz": ("armed" if self.fleet_fn is not None
+                        else "unarmed"),
+            "/controlz": ("armed" if self.control_fn is not None
+                          else "unarmed"),
+            "/memz": "armed",
+            "/incidentz": "armed",
+        }
+
     # -- server -------------------------------------------------------------
 
     def start(self) -> "AdminServer":
@@ -245,13 +277,23 @@ class AdminServer:
                         code, doc = admin._controlz()
                     elif url.path in ("/memz", "/memz/"):
                         code, doc = admin._memz()
+                    elif url.path in ("/incidentz", "/incidentz/"):
+                        code, doc = admin._incidentz()
                     elif url.path == "/":
-                        code, doc = 200, {"endpoints": [
-                            "/statz", "/healthz", "/tracez", "/slo",
-                            "/fleetz", "/controlz", "/memz"]}
+                        code, doc = 200, {"endpoints": admin._endpoints()}
                     else:
-                        code, doc = 404, {"error": f"no such endpoint "
-                                                   f"{url.path!r}"}
+                        # 404-with-hint: name the nearest real endpoint —
+                        # a typo'd scrape should cost one glance, not a
+                        # source dive
+                        import difflib
+                        known = sorted(admin._endpoints())
+                        near = difflib.get_close_matches(
+                            url.path.rstrip("/"), known, n=1, cutoff=0.0)
+                        code, doc = 404, {
+                            "error": f"no such endpoint {url.path!r}",
+                            "hint": (f"did you mean {near[0]!r}?"
+                                     if near else None),
+                            "endpoints": known}
                 except Exception as exc:   # an endpoint must never crash
                     code, doc = 500, {"error": f"{type(exc).__name__}: "
                                                f"{exc}"}

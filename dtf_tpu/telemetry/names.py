@@ -186,6 +186,16 @@ METRICS = (
     "control/cooldown_skips_total",  # proposals refused on cooldown
     "control/rollback_total",     # safety-rail snap-backs to defaults
     "control/knob_*",             # gauges: knob_<name> current value
+    # incident plane (telemetry/anomaly.py + telemetry/diagnose.py):
+    # online changepoint detection over already-booked signals, plus
+    # the cross-plane root-cause correlator.  detected_total is
+    # registered EAGERLY when the monitor arms (absent = never armed =
+    # FAIL, the torn-pair discipline); recorded/attributed reconcile
+    # against it — every fire becomes an incident, and an incident
+    # without a suspect is report --diagnose's exit-1 condition.
+    "anomaly/detected_total",     # detector onsets (edge-triggered)
+    "incident/recorded_total",    # incidents pushed into the live ring
+    "incident/attributed_total",  # incidents with >= 1 ranked suspect
 )
 # spans (host-side tracer)
 SPANS = (
@@ -226,6 +236,12 @@ SPANS = (
     # "Control plane" section and /controlz render these verbatim
     "control/set",
     "control/rollback",
+    # incident plane: one instant per detector ONSET —
+    # anomaly/<signal_slug> (slashes in the signal name flatten to '_',
+    # e.g. anomaly/serve_ttft_ms) with value/median/mad/z args; these
+    # are the SYMPTOM marks the diagnose correlator explains, and are
+    # never themselves evidence
+    "anomaly/*",
 )
 
 DECLARED: Tuple[str, ...] = tuple(sorted(set(METRICS) | set(SPANS)))

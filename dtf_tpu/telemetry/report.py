@@ -167,6 +167,19 @@ def build_report(logdir: str, profile_dir: Optional[str] = None,
         if section:
             out["fleet"] = section
 
+    # Incident plane (telemetry/anomaly.py + telemetry/diagnose.py):
+    # every anomaly/* instant in the shared record stream is correlated
+    # against the other planes' evidence instants — the SAME rule the
+    # live /incidentz ring applies, re-run post-hoc so the two verdicts
+    # cannot drift.  Standing incidents (bench-ledger stall) attach even
+    # when the run itself left no spans.
+    from dtf_tpu.telemetry import diagnose as _diagnose
+    if records:
+        out["incidents"] = _diagnose.diagnose_records(records)
+    standing = _diagnose.ledger_standing_incidents(logdir)
+    if standing:
+        out.setdefault("incidents", {})["standing"] = standing
+
     hpath = os.path.join(logdir, "health.json")
     if os.path.exists(hpath):
         try:
@@ -209,6 +222,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_blame_frac: Optional[float] = None,
                 max_hbm_frac: Optional[float] = None,
                 max_compiles: Optional[float] = None,
+                min_attribution_frac: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -266,6 +280,17 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       geometry churn that recompiles every iteration is a perf bug the
       wall clock alone misattributes).  A run that never captured (no
       observatory wired) FAILS both: absence is falsifiable.
+    * ``min_attribution_frac`` — the INCIDENT gate (telemetry/anomaly.py
+      + telemetry/diagnose.py; report section ``incidents``): floor on
+      the fraction of detected anomalies that are correctly attributed.
+      With chaos evidence in the stream the bar is strict — only an
+      incident whose TOP-ranked suspect is the injected fault counts
+      (a correlator that blames an innocent plane fails).  Chaos fired
+      but ZERO anomalies detected leaves the fraction None =
+      not-measured = FAIL: injected-but-undetected is the detector's
+      falsifiability failure, not a calm run.  Without chaos, attributed
+      means 'has at least one suspect', and zero anomalies passes
+      vacuously (frac 1.0) — the chaos-off twin's contract.
     """
     lines: List[str] = []
     ok = True
@@ -353,6 +378,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         gate("max_compiles",
              _metric_value(report, "cost/compiles_total"),
              float(max_compiles), at_most=True)
+    if min_attribution_frac is not None:
+        # None here covers BOTH no-incidents-section (detector never
+        # armed) and chaos-fired-zero-anomalies (injected-but-
+        # undetected); the shared not-measured rule fails either way
+        v = report.get("incidents", {}).get("attribution_frac")
+        gate("min_attribution_frac", None if v is None else float(v),
+             min_attribution_frac, at_most=False)
     return ok, lines
 
 
@@ -562,6 +594,29 @@ def render(report: dict, top: int = 10) -> str:
             lines.append(f"  incomplete rid={inc.get('rid')} "
                          f"trace={inc.get('trace_id')}: "
                          f"{', '.join(inc.get('gaps', []))}")
+    inc = report.get("incidents")
+    if inc and (inc.get("anomalies") or inc.get("standing")
+                or inc.get("chaos_fired")):
+        lines.append("Incidents (telemetry/anomaly.py + diagnose.py)")
+        frac = inc.get("attribution_frac")
+        lines.append(
+            f"  {'anomalies':<28} {inc.get('anomalies', 0):12d}")
+        lines.append(
+            f"  {'attributed':<28} {inc.get('attributed', 0):12d}"
+            + (f"  (frac {frac:.4f})" if frac is not None else
+               "  (frac n/a — chaos fired, nothing detected)"))
+        planes = inc.get("top_plane_counts") or {}
+        if planes:
+            detail = " ".join(f"{k}={v}"
+                              for k, v in sorted(planes.items()))
+            lines.append(f"  {'top suspect planes':<28} {detail}")
+        if inc.get("unattributed"):
+            lines.append(f"  {'UNATTRIBUTED':<28} "
+                         f"{inc['unattributed']:12d}  "
+                         f"(--diagnose exits 1 on these)")
+        for st in inc.get("standing", []):
+            lines.append(f"  standing: {st.get('summary')}")
+        lines.append("  (full ranked suspects: report --diagnose)")
     fleet = report.get("fleet")
     if fleet:
         lines.append("Fleet (telemetry/fleet.py)")
@@ -652,6 +707,64 @@ def render(report: dict, top: int = 10) -> str:
     return "\n".join(lines)
 
 
+def render_diagnose(doc: dict, logdir: str) -> List[str]:
+    """Text for ``report --diagnose``: the attribution summary, any
+    standing incidents, then one merged timeline per anomaly — every
+    qualifying suspect at its offset before the fire, top-ranked marked.
+    The exit-1 rule (an anomaly with NO suspect) is the caller's."""
+    lines = [f"== incident diagnosis: {os.path.abspath(logdir)} =="]
+    frac = doc.get("attribution_frac")
+    lines.append(
+        f"anomalies {doc.get('anomalies', 0)}  "
+        f"attributed {doc.get('attributed', 0)}  "
+        + ("attribution_frac n/a (chaos fired, NOTHING detected — "
+           "injected-but-undetected)" if frac is None
+           else f"attribution_frac {frac:.4f}")
+        + f"  chaos_evidence={'yes' if doc.get('chaos_fired') else 'no'}")
+    planes = doc.get("top_plane_counts") or {}
+    if planes:
+        lines.append("top suspect planes: "
+                     + " ".join(f"{k}={v}"
+                                for k, v in sorted(planes.items())))
+    for st in doc.get("standing", []):
+        lines.append(f"STANDING [{st.get('plane')}] {st.get('kind')}: "
+                     f"{st.get('summary')}")
+    incidents = doc.get("incidents") or []
+    if not incidents:
+        lines.append("no anomalies detected"
+                     + (" — but chaos evidence is present: the detector "
+                        "MISSED the injected fault"
+                        if doc.get("chaos_fired") else
+                        " (and no chaos evidence: a calm run)"))
+        return lines
+    for i, incident in enumerate(incidents):
+        a = incident.get("anomaly") or {}
+        detail = " ".join(
+            f"{k}={a[k]:.4g}" if isinstance(a[k], float) else
+            f"{k}={a[k]}"
+            for k in ("value", "median", "z", "tick") if a.get(k)
+            is not None)
+        lines.append(f"incident #{i}  {a.get('name')}  {detail}")
+        suspects = incident.get("suspects") or []
+        if not suspects:
+            lines.append("  UNATTRIBUTED — no evidence instant precedes "
+                         "this anomaly inside the causality window")
+            continue
+        top = incident.get("top")
+        for s in sorted(suspects, key=lambda s: s["ts_us"]):
+            ev = s.get("evidence") or {}
+            evtxt = " ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+            lines.append(
+                f"  -{s['dt_s']:9.3f}s  [{s['plane']:<8}] "
+                f"{s['name']:<28} score {s['score']:.3f} "
+                f"(prior {s['prior']:g}, x{s['count']})"
+                + ("  << TOP" if top is not None
+                   and s["name"] == top["name"]
+                   and s["ts_us"] == top["ts_us"] else "")
+                + (f"  {evtxt}" if evtxt else ""))
+    return lines
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dtf_tpu.telemetry.report",
@@ -666,6 +779,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "attribution — which site/geometry grew, in "
                         "bytes or flops, and whether the growth is "
                         "memory- or compute-bound")
+    p.add_argument("--diagnose", action="store_true",
+                   help="incident post-mortem (telemetry/diagnose.py): "
+                        "correlate every anomaly/* instant against the "
+                        "other planes' evidence instants and print the "
+                        "ranked suspects + a merged timeline per "
+                        "anomaly, plus any standing incidents "
+                        "(bench-ledger stall).  Exits 1 when ANY "
+                        "anomaly has no suspect — silence is a "
+                        "failure, not a pass")
     p.add_argument("--top", type=int, default=10)
     p.add_argument("--json", action="store_true",
                    help="emit the merged report as JSON instead of text")
@@ -732,6 +854,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max_compiles", type=float, default=None,
                    help="device-cost gate: ceiling on captured compiles "
                         "(cost/compiles_total; not measured = FAIL)")
+    p.add_argument("--min_attribution_frac", type=float, default=None,
+                   help="incident gate: floor on the fraction of "
+                        "detected anomalies correctly attributed — with "
+                        "chaos evidence only a TOP-ranked chaos suspect "
+                        "counts; chaos fired with zero anomalies = not "
+                        "measured = FAIL (injected-but-undetected)")
     p.add_argument("--request", type=int, default=None, metavar="RID",
                    help="print ONE request's causally-ordered timeline "
                         "(reqtrace events + the engine iterations that "
@@ -769,6 +897,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: a second logdir only makes sense with --explain",
               file=sys.stderr)
         return 2
+    if ns.diagnose:
+        from dtf_tpu.telemetry import diagnose as _diagnose
+        doc = _diagnose.diagnose_logdir(ns.logdir)
+        if ns.json:
+            print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        else:
+            for line in render_diagnose(doc, ns.logdir):
+                print(line)
+        # the falsifiability exit rule: an anomaly nobody can explain is
+        # a correlator failure, and chaos-with-zero-anomalies (frac
+        # None) is a detector failure — both are exit 1
+        bad = (doc.get("unattributed", 0) > 0
+               or (doc.get("chaos_fired")
+                   and doc.get("attribution_frac") is None))
+        return 1 if bad else 0
     if ns.request is not None:
         from dtf_tpu.telemetry import reqtrace
         events = reqtrace.request_timeline(ns.logdir, ns.request,
@@ -816,7 +959,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "min_fleet_goodput": ns.min_fleet_goodput,
                   "max_blame_frac": ns.max_blame_frac,
                   "max_hbm_frac": ns.max_hbm_frac,
-                  "max_compiles": ns.max_compiles}
+                  "max_compiles": ns.max_compiles,
+                  "min_attribution_frac": ns.min_attribution_frac}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -206,14 +206,24 @@ class Tracer:
     def instant(self, name: str, **attrs: Any) -> None:
         """Point event (chaos fault fired, peer died, ...); flushed
         eagerly — instants mark exactly the moments a post-mortem needs,
-        and the process may be about to die."""
+        and the process may be about to die.  Instants also fan out to
+        any registered taps (telemetry/diagnose.py's live event log)
+        even when the tracer itself is disabled — live root-cause
+        correlation must not depend on a logdir being armed."""
+        validate(name)
+        ts_us = time.time() * 1e6
+        args = dict(attrs)
+        for tap in _INSTANT_TAPS:
+            try:
+                tap(name, ts_us, args, self.process)
+            except Exception:
+                pass               # a broken tap must never break the emit
         if self._f is None:
             return
-        validate(name)
-        self._emit({"name": name, "ph": "i", "ts": time.time() * 1e6,
+        self._emit({"name": name, "ph": "i", "ts": ts_us,
                     "s": "p", "pid": self.process,
                     "tid": threading.get_ident() & 0xFFFF,
-                    "args": dict(attrs)})
+                    "args": args})
         self.flush()
 
     def flush(self) -> None:
@@ -227,6 +237,27 @@ class Tracer:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+
+
+# -- instant taps -----------------------------------------------------------
+# Callables invoked on EVERY Tracer.instant emit: fn(name, ts_us, args,
+# process).  The incident plane (telemetry/diagnose.py) taps here so the
+# live correlator sees exactly the records the post-hoc reader parses
+# back from disk — one evidence stream, two consumers.
+
+_INSTANT_TAPS: List[Any] = []
+
+
+def add_instant_tap(fn) -> None:
+    if fn not in _INSTANT_TAPS:
+        _INSTANT_TAPS.append(fn)
+
+
+def remove_instant_tap(fn) -> None:
+    try:
+        _INSTANT_TAPS.remove(fn)
+    except ValueError:
+        pass
 
 
 # -- process-wide tracer ----------------------------------------------------

@@ -724,6 +724,13 @@ class Trainer:
         if isinstance(self._chaos, str):
             from dtf_tpu.resilience.chaos import FaultPlan
             self._chaos = FaultPlan.parse(self._chaos)
+        # Incident plane (telemetry/anomaly.py): armed eagerly — a run
+        # with zero anomalies books 'armed, zero', never silence.  Fed
+        # from the fit loop (step time, checkpoint-save duration).
+        from dtf_tpu.telemetry import anomaly as _anomaly
+        from dtf_tpu.telemetry import diagnose as _diagnose
+        self._anomaly = _anomaly.get_monitor().arm()
+        _diagnose.install()
         self._guarded = self.cfg.nonfinite_guard
         self._rollbacks = 0
         stateful = hasattr(self.model, "init_model_state")
@@ -1440,6 +1447,13 @@ class Trainer:
                     tracker.add("productive"
                                 if _pre_seen and self._compile_seen
                                 else "compile", _dt_step)
+                    # incident plane: per-step time into the changepoint
+                    # detector — compile-bearing steps excluded (a first
+                    # step 100x the steady state is not an incident)
+                    if _pre_seen and self._compile_seen:
+                        self._anomaly.observe("train/step_ms",
+                                              _dt_step * 1e3,
+                                              tick=self._host_step)
                     if not self._compile_seen:
                         self._compile_seen = True
                         tel.gauge("compile/first_step_s").set(_dt_step)
@@ -1466,6 +1480,7 @@ class Trainer:
                             # every host — a natural fleet-wide barrier
                             # mark (telemetry/fleet.py)
                             self._fleet.note_sync("ckpt", self._host_step)
+                        _t_ckpt = time.perf_counter()
                         with self._suspended_watchdog(), \
                                 tracker.measure("checkpoint"):
                             self.ckpt.save(self._host_step, self.state)
@@ -1478,6 +1493,13 @@ class Trainer:
                                     self._host_step)
                                 self._chaos.maybe_corrupt_after_save(
                                     self._host_step, self.ckpt)
+                        # incident plane: the measured window INCLUDES an
+                        # injected write stall — a stalled store is an
+                        # onset the correlator must explain
+                        self._anomaly.observe(
+                            "checkpoint/save_ms",
+                            (time.perf_counter() - _t_ckpt) * 1e3,
+                            tick=self._host_step)
                     # Preemption decision: single-process polls the local
                     # flag every step; multi-process agrees via allgather
                     # only at the logging sync boundaries (deterministic,

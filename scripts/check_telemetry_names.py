@@ -55,7 +55,12 @@ def check_runtime_guard() -> list:
                   # the control/* family (ISSUE 17) mixes exact counters
                   # with the control/knob_* gauge pattern — a name
                   # outside both must be rejected
-                  "control/definitely_not_declared"):
+                  "control/definitely_not_declared",
+                  # the incident plane (ISSUE 18) declares exact metric
+                  # names only (anomaly/* is a SPAN pattern for the
+                  # onset instants, but instruments outside the three
+                  # exact counters must fail at registration)
+                  "incident/definitely_not_declared"):
         try:
             reg.counter(probe)
         except ValueError:
@@ -77,6 +82,10 @@ def check_runtime_guard() -> list:
                  "fleet/replay_mismatch_total",
                  # the knob-controller family (ISSUE 17): exact names
                  "control/rollback_total",
+                 # the incident plane (ISSUE 18): exact counter names
+                 "anomaly/detected_total",
+                 "incident/recorded_total",
+                 "incident/attributed_total",
                  "cost/compiles_total"):           # exact (cost family)
         try:
             reg.counter(name)

@@ -1039,5 +1039,95 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: scenario lane assertions (rc=$rc)"; }
   rm -rf "$scdir"
 fi
+# Incident lane (DESIGN.md "Incident plane", ISSUE 18): (1) a chaos'd
+# WALL-CLOCK serve run with --admin_port, /incidentz scraped MID-run —
+# the live ring must already hold an incident whose top-ranked suspect
+# is the injected fault; (2) post-hoc `report --diagnose` over the same
+# logdir re-runs the correlator from the span files and must rank the
+# injected chaos kind TOP (exit 0: every anomaly explained); (3) the
+# --min_attribution_frac gate is green on the chaos run; (4) the
+# FALSIFIABILITY twin: the identical run with chaos OFF must report
+# zero incidents and still exit 0 (vacuous attribution — calm is a
+# pass, silence about a real fault is not).  Skip with
+# NO_INCIDENT_LANE=1.
+if [ "${NO_INCIDENT_LANE:-0}" != "1" ]; then
+  echo "=== incident lane (live /incidentz + report --diagnose + chaos-off twin) ==="
+  idir=$(mktemp -d)
+  # (1) chaos'd wall-clock serve, /incidentz scraped mid-run
+  JAX_PLATFORMS=cpu python - "$idir" <<'PYEOF'
+import json, os, socket, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dtf_tpu.serve", "--preset", "tiny",
+     "--demo", "60", "--qps", "20", "--clock", "wall", "--seed", "7",
+     "--chaos", "slow_decode@30:60ms",
+     "--admin_port", str(port), "--logdir", os.path.join(d, "chaos")],
+    stdout=open(os.path.join(d, "chaos.log"), "w"),
+    stderr=subprocess.STDOUT, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+cut = index = None
+try:
+    deadline = time.time() + 240
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/incidentz", timeout=5) as r:
+                doc = json.loads(r.read())
+            if index is None:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=5) as r:
+                    index = json.loads(r.read())
+        except OSError:
+            time.sleep(0.2); continue
+        if doc.get("total", 0) >= 1:
+            cut = doc
+            break
+        time.sleep(0.2)
+finally:
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill(); proc.wait(); rc = -1
+assert rc == 0, f"chaos'd serve exited {rc}"
+assert cut is not None, "/incidentz never showed an incident mid-run"
+top = cut["incidents"][0]["top"]
+assert top and top["plane"] == "chaos" and top["kind"] == "slow_decode", \
+    f"live top suspect {top} is not the injected fault"
+assert index["endpoints"]["/incidentz"] == "armed", index
+print(f"live scrape OK: {cut['total']} incident(s) mid-run, top suspect "
+      f"[{top['plane']}] {top['kind']} (score {top['score']:.3f})")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: incident live scrape (rc=$rc)"; tail -8 "$idir/chaos.log" 2>/dev/null; }
+  # (2) post-hoc diagnose: injected fault must be TOP-ranked, exit 0
+  python -m dtf_tpu.telemetry.report --diagnose "$idir/chaos" \
+      | tee "$idir/diagnose.log"
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --diagnose (rc=$rc)"; }
+  grep -q "chaos.*slow_decode.*<< TOP" "$idir/diagnose.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: injected fault not top-ranked in --diagnose"; }
+  # (3) the attribution gate is green on the chaos run (wall-clock floor)
+  python -m dtf_tpu.telemetry.report "$idir/chaos" \
+      --min_attribution_frac 0.75 > "$idir/gate.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: min_attribution_frac gate on chaos run (rc=$rc)"; tail -5 "$idir/gate.log"; }
+  grep -q "gate min_attribution_frac: OK" "$idir/gate.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: attribution gate line missing"; }
+  # (4) chaos-off twin: zero incidents, exit 0 (the falsifiability pin —
+  # a detector that fires on a calm run would poison every attribution)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 60 \
+      --qps 20 --clock wall --seed 7 \
+      --logdir "$idir/calm" > "$idir/calm.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: chaos-off twin run (rc=$rc)"; tail -5 "$idir/calm.log"; }
+  python -m dtf_tpu.telemetry.report --diagnose "$idir/calm" \
+      | tee "$idir/calm_diag.log"
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: twin --diagnose (rc=$rc)"; }
+  grep -q "anomalies 0 " "$idir/calm_diag.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: chaos-off twin detected anomalies"; }
+  rm -rf "$idir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

@@ -1,0 +1,405 @@
+"""Incident plane (telemetry/anomaly.py + telemetry/diagnose.py, ISSUE 18).
+
+The honesty pins live here:
+
+* **detector edges** — cold start is silence; a step function fires
+  exactly once (edge, not level) and re-arms after the baseline
+  migrates; an all-constant signal (MAD = 0) neither divides by zero
+  nor fires on float noise; a steady ramp is NOT an anomaly;
+* **clock independence** — the math is values-only, so the same
+  observation sequence fires identically whether wall time passes
+  between observations or not (VirtualClock/WallClock parity);
+* **attribution falsifiability** — temporal precedence excludes
+  post-anomaly evidence, the chaos plane out-ranks innocents, and an
+  inverted-priors correlator (deliberately blaming an innocent plane)
+  demonstrably FAILS the ``min_attribution_frac`` gate — as does
+  chaos-fired-with-nothing-detected (frac None = not measured);
+* **bounded live state** — the incident ring evicts oldest-first with
+  an honest evicted count;
+* **standing incidents** — a trailing bench-ledger error streak
+  surfaces as one incident; a recovered ledger does not.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import dtf_tpu.telemetry as tel
+from dtf_tpu.telemetry import anomaly, diagnose
+from dtf_tpu.telemetry.anomaly import AnomalyMonitor, RollingDetector
+from dtf_tpu.telemetry.diagnose import (IncidentRing, attribution_summary,
+                                        classify, correlate,
+                                        diagnose_logdir, diagnose_records,
+                                        ledger_standing_incidents)
+from dtf_tpu.telemetry.live import AdminServer
+from dtf_tpu.telemetry.report import check_gates
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    tel.reset()
+    yield
+    tel.reset()
+
+
+def _detector(**over):
+    cfg = dict(window=16, min_samples=4, threshold=6.0, rel_floor=0.25,
+               abs_floor=1.0)
+    cfg.update(over)
+    return RollingDetector("test/sig", **cfg)
+
+
+# ---------------------------------------------------------------------------
+# detector math edges
+
+
+class TestRollingDetector:
+    def test_cold_start_never_fires(self):
+        det = _detector(min_samples=8)
+        # wild values, but fewer than min_samples seen: always silence
+        for v in (1.0, 500.0, -300.0, 1e6, 0.0, 42.0, 7e5):
+            assert det.observe(v) is None
+        assert det.fired_total == 0
+
+    def test_step_function_fires_exactly_once(self):
+        det = _detector()
+        fires = [det.observe(10.0) for _ in range(10)]
+        fires += [det.observe(100.0) for _ in range(20)]
+        docs = [f for f in fires if f]
+        # the onset fires; the PERSISTING level does not re-fire
+        assert len(docs) == 1
+        assert docs[0]["value"] == 100.0 and docs[0]["z"] >= 6.0
+
+    def test_rearms_after_baseline_migrates(self):
+        det = _detector()
+        for _ in range(10):
+            det.observe(10.0)
+        first = [det.observe(100.0) for _ in range(20)]
+        # window is now all-100s: the detector re-armed, so a SECOND
+        # edge fires again — once
+        second = [det.observe(400.0) for _ in range(20)]
+        assert sum(1 for f in first if f) == 1
+        assert sum(1 for f in second if f) == 1
+        assert det.fired_total == 2
+
+    def test_constant_signal_mad_zero_no_fire(self):
+        det = _detector(abs_floor=1.0)
+        for _ in range(30):
+            assert det.observe(5.0) is None          # no div-by-zero
+        # float-noise wiggle under the abs_floor: still silence
+        for i in range(30):
+            assert det.observe(5.0 + 1e-9 * (i % 3)) is None
+        assert det.fired_total == 0
+
+    def test_steady_ramp_is_not_an_anomaly(self):
+        det = _detector()
+        fires = [det.observe(10.0 + 3.0 * i) for i in range(64)]
+        # MAD grows with the ramp, so z stays near 1: no changepoint
+        assert not any(fires)
+
+    def test_clock_parity_values_only(self):
+        """VirtualClock/WallClock parity: identical observation
+        sequences fire identically whether or not wall time elapses
+        between observations — the math never reads a clock."""
+        seq = [10.0] * 8 + [90.0] * 4 + [10.0] * 8 + [250.0] * 3
+        fast, slow = _detector(), _detector()
+        fired_fast = [i for i, v in enumerate(seq) if fast.observe(v)]
+        fired_slow = []
+        for i, v in enumerate(seq):
+            time.sleep(0.002)          # wall-clock gaps, virtual has none
+            if slow.observe(v):
+                fired_slow.append(i)
+        assert fired_fast == fired_slow and fired_fast
+
+
+class TestAnomalyMonitor:
+    def test_fire_books_counter_instant_and_incident(self, tmp_path):
+        tel.configure(str(tmp_path))
+        mon = anomaly.get_monitor().arm()
+        diagnose.install()
+        tel.instant("chaos/slow_decode", step=3)
+        for _ in range(8):
+            mon.observe("serve/ttft_ms", 20.0)
+        # signal config for ttft has min_samples=16: use the default-
+        # config signal instead for a short warmup
+        for _ in range(16):
+            mon.observe("custom/sig", 20.0)
+        fired = mon.observe("custom/sig", 2000.0)
+        assert fired and fired["signal"] == "custom/sig"
+        snap = tel.get_registry().snapshot()
+        assert snap["anomaly/detected_total"]["value"] == 1
+        assert snap["incident/recorded_total"]["value"] == 1
+        assert snap["incident/attributed_total"]["value"] == 1
+        inc = diagnose.get_ring().snapshot()["incidents"]
+        assert len(inc) == 1
+        assert inc[0]["top"]["kind"] == "slow_decode"
+        # the instant landed in the span file for the post-hoc path
+        tel.get_tracer().flush()
+        doc = diagnose_logdir(str(tmp_path))
+        assert doc["anomalies"] == 1 and doc["attribution_frac"] == 1.0
+
+    def test_armed_counter_is_eager_zero(self):
+        AnomalyMonitor().arm()
+        snap = tel.get_registry().snapshot()
+        assert snap["anomaly/detected_total"]["value"] == 0
+
+    def test_reset_baselines_forgets_windows(self):
+        mon = AnomalyMonitor()
+        for _ in range(20):
+            mon.observe("custom/sig", 10.0)
+        mon.reset_baselines()
+        # post-reset the window is cold again: a wild value is silence
+        assert mon.observe("custom/sig", 1e6) is None
+
+
+# ---------------------------------------------------------------------------
+# live ring
+
+
+class TestIncidentRing:
+    def test_eviction_order_and_counts(self):
+        ring = IncidentRing(maxlen=4)
+        for i in range(10):
+            ring.push({"anomaly": {"name": f"a{i}"}})
+        snap = ring.snapshot()
+        assert snap["total"] == 10 and snap["evicted"] == 6
+        # oldest evicted first: the survivors are the LAST four, in
+        # push order, with their original seq numbers
+        assert [i["seq"] for i in snap["incidents"]] == [6, 7, 8, 9]
+        assert [i["anomaly"]["name"] for i in snap["incidents"]] == \
+            ["a6", "a7", "a8", "a9"]
+
+
+# ---------------------------------------------------------------------------
+# correlator
+
+
+def _ev(name, ts_s, **args):
+    return {"name": name, "ts": ts_s * 1e6, "args": args}
+
+
+class TestCorrelate:
+    def test_precedence_excludes_post_anomaly_evidence(self):
+        events = [_ev("chaos/slow_decode", 100.0),
+                  _ev("chaos/kv_poison", 103.0)]   # AFTER the anomaly
+        sus = correlate(102.0 * 1e6, events)
+        assert [s["kind"] for s in sus] == ["slow_decode"]
+
+    def test_window_excludes_stale_evidence(self):
+        events = [_ev("chaos/slow_decode", 10.0)]
+        assert correlate(100.0 * 1e6, events, window_s=60.0) == []
+
+    def test_chaos_outranks_innocent_planes(self):
+        events = [_ev("chaos/slow_decode", 90.0),
+                  _ev("event/brownout_transition", 99.0, new=1),
+                  _ev("event/slo_alert_ttft_fast", 99.5)]
+        sus = correlate(100.0 * 1e6, events)
+        assert sus[0]["kind"] == "slow_decode"
+        # ...even though the innocents are MORE recent
+        assert sus[0]["dt_s"] > sus[1]["dt_s"]
+
+    def test_one_suspect_per_kind_latest_carries_evidence(self):
+        events = [_ev("control/set", 95.0, knob="spec_k", value=2),
+                  _ev("control/set", 99.0, knob="spec_k", value=4)]
+        sus = correlate(100.0 * 1e6, events)
+        assert len(sus) == 1
+        assert sus[0]["count"] == 2
+        assert sus[0]["evidence"]["value"] == 4
+
+    def test_anomaly_instants_are_never_evidence(self):
+        assert classify("anomaly/serve_ttft_ms") is None
+        events = [_ev("anomaly/serve_tpot_ms", 99.0)]
+        assert correlate(100.0 * 1e6, events) == []
+
+
+# ---------------------------------------------------------------------------
+# attribution semantics + the gate's falsifiability
+
+
+def _rec(name, ts_s, **args):
+    return {"ph": "i", "name": name, "ts": ts_s * 1e6, "args": args}
+
+
+class TestAttribution:
+    def test_chaos_top_counts_attributed(self):
+        recs = [_rec("chaos/slow_decode", 90.0),
+                _rec("anomaly/serve_ttft_ms", 95.0, z=12.0)]
+        doc = diagnose_records(recs)
+        assert doc["chaos_fired"] and doc["attribution_frac"] == 1.0
+        assert doc["top_plane_counts"] == {"chaos": 1}
+
+    def test_injected_but_undetected_is_not_measured(self):
+        recs = [_rec("chaos/slow_decode", 90.0)]   # zero anomalies
+        doc = diagnose_records(recs)
+        assert doc["chaos_fired"] and doc["attribution_frac"] is None
+        ok, lines = check_gates({"incidents": doc},
+                                min_attribution_frac=0.5)
+        assert not ok and "not measured" in lines[0]
+
+    def test_innocent_blaming_correlator_fails_the_gate(self):
+        """The falsifiability pin: invert the priors so the SLO plane
+        out-ranks chaos — the anomaly is still 'attributed' to SOME
+        plane, but the gate demands the injected fault be TOP."""
+        recs = [_rec("chaos/slow_decode", 94.0),
+                _rec("event/slo_alert_ttft_fast", 94.5),
+                _rec("anomaly/serve_ttft_ms", 95.0, z=12.0)]
+        honest = diagnose_records(recs)
+        assert honest["attribution_frac"] == 1.0
+        assert check_gates({"incidents": honest},
+                           min_attribution_frac=0.99)[0]
+        inverted = tuple((pat, plane, 1.1 - prior) for pat, plane, prior
+                         in diagnose.PLANE_PRIORS)
+        liar = diagnose_records(recs, priors=inverted)
+        assert liar["incidents"][0]["top"]["plane"] == "slo"
+        assert liar["attribution_frac"] == 0.0
+        ok, lines = check_gates({"incidents": liar},
+                                min_attribution_frac=0.99)
+        assert not ok and "FAIL" in lines[0]
+
+    def test_no_chaos_any_suspect_counts(self):
+        recs = [_rec("event/brownout_transition", 94.0, new=1),
+                _rec("anomaly/serve_ttft_ms", 95.0, z=9.0)]
+        doc = diagnose_records(recs)
+        assert not doc["chaos_fired"]
+        assert doc["attribution_frac"] == 1.0 and doc["unattributed"] == 0
+
+    def test_chaos_off_twin_zero_anomalies_vacuous_pass(self):
+        doc = diagnose_records([_rec("event/brownout_transition", 94.0)])
+        assert doc["anomalies"] == 0
+        assert doc["attribution_frac"] == 1.0       # vacuously attributed
+        assert check_gates({"incidents": doc},
+                           min_attribution_frac=0.99)[0]
+
+    def test_unattributed_anomaly_is_counted(self):
+        doc = diagnose_records([_rec("anomaly/serve_ttft_ms", 95.0)])
+        assert doc["unattributed"] == 1
+        # no chaos: frac reads 0/1
+        assert doc["attribution_frac"] == 0.0
+
+    def test_gate_fails_when_incidents_section_missing(self):
+        ok, lines = check_gates({}, min_attribution_frac=0.5)
+        assert not ok and "not measured" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# standing incidents (bench-ledger stall)
+
+
+def _ledger_row(kind, n, error=None, stage=None, run=None):
+    row = {"kind": kind, "n": n, "run": run or f"r{n:02d}"}
+    if error:
+        row.update(error=error, stage=stage or "preflight")
+    return row
+
+
+class TestLedgerStanding:
+    def _write(self, tmp_path, rows):
+        with open(os.path.join(tmp_path, "LEDGER.jsonl"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def test_trailing_streak_is_standing(self, tmp_path):
+        self._write(tmp_path, [
+            _ledger_row("sparse", 1),
+            _ledger_row("sparse", 2, error="tpu_unavailable"),
+            _ledger_row("sparse", 3, error="tpu_unavailable"),
+            _ledger_row("sparse", 4, error="tpu_unavailable")])
+        out = ledger_standing_incidents(str(tmp_path))
+        assert len(out) == 1
+        st = out[0]
+        assert st["kind"] == "bench_ledger_stalled"
+        assert st["bench_kind"] == "sparse" and st["streak"] == 3
+        assert "tpu_unavailable@preflight" in st["reasons"]
+        assert "STALLED" in st["summary"]
+
+    def test_recovered_ledger_is_not_standing(self, tmp_path):
+        # errors exist but the LAST run succeeded: not stalled
+        self._write(tmp_path, [
+            _ledger_row("sparse", 1, error="tpu_unavailable"),
+            _ledger_row("sparse", 2, error="tpu_unavailable"),
+            _ledger_row("sparse", 3, error="tpu_unavailable"),
+            _ledger_row("sparse", 4)])
+        assert ledger_standing_incidents(str(tmp_path)) == []
+
+    def test_short_streak_is_not_standing(self, tmp_path):
+        self._write(tmp_path, [
+            _ledger_row("sparse", 1),
+            _ledger_row("sparse", 2, error="tpu_unavailable"),
+            _ledger_row("sparse", 3, error="tpu_unavailable")])
+        assert ledger_standing_incidents(str(tmp_path)) == []
+
+    def test_ledger_found_walking_up_from_logdir(self, tmp_path):
+        self._write(tmp_path, [
+            _ledger_row("mlp", 1, error="oom"),
+            _ledger_row("mlp", 2, error="oom"),
+            _ledger_row("mlp", 3, error="oom")])
+        logdir = tmp_path / "results" / "run" / "logs"
+        logdir.mkdir(parents=True)
+        out = ledger_standing_incidents(str(logdir))
+        assert len(out) == 1 and out[0]["bench_kind"] == "mlp"
+
+    def test_no_ledger_is_empty_never_error(self, tmp_path):
+        assert ledger_standing_incidents(str(tmp_path)) == []
+        assert ledger_standing_incidents(None) == []
+
+
+# ---------------------------------------------------------------------------
+# admin endpoint: /incidentz + the self-describing index
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestAdminIncidentz:
+    def test_incidentz_serves_ring_and_standing(self, tmp_path):
+        with open(os.path.join(tmp_path, "LEDGER.jsonl"), "w") as f:
+            for n in (1, 2, 3):
+                f.write(json.dumps(_ledger_row(
+                    "sparse", n, error="tpu_unavailable")) + "\n")
+        diagnose.get_ring().push(
+            {"anomaly": {"name": "anomaly/serve_ttft_ms"}, "top": None,
+             "suspects": []})
+        srv = AdminServer(0, logdir=str(tmp_path)).start()
+        try:
+            code, doc = _get(srv.port, "/incidentz")
+            assert code == 200 and doc["total"] == 1
+            assert doc["incidents"][0]["anomaly"]["name"] == \
+                "anomaly/serve_ttft_ms"
+            assert doc["standing"][0]["kind"] == "bench_ledger_stalled"
+        finally:
+            srv.close()
+
+    def test_root_index_enumerates_all_with_armed_markers(self):
+        srv = AdminServer(0).start()     # no slo/fleet/control sources
+        try:
+            code, idx = _get(srv.port, "/")
+            assert code == 200
+            eps = idx["endpoints"]
+            for path in ("/statz", "/healthz", "/tracez", "/slo",
+                         "/controlz", "/fleetz", "/memz", "/incidentz"):
+                assert path in eps       # conditional mounts still LISTED
+            assert eps["/statz"] == "armed"
+            assert eps["/incidentz"] == "armed"
+            assert eps["/fleetz"] == "unarmed"
+            assert eps["/controlz"] == "unarmed"
+        finally:
+            srv.close()
+
+    def test_unknown_path_404_with_nearest_hint(self):
+        srv = AdminServer(0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, "/incidents")
+            assert ei.value.code == 404
+            body = json.loads(ei.value.read())
+            assert "/incidentz" in body["hint"]
+            assert "/statz" in body["endpoints"]
+        finally:
+            srv.close()
