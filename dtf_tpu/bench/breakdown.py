@@ -482,6 +482,211 @@ def grad_sync_ab(steps: int = 8, batch: int = 512,
     return out
 
 
+#: Pinned plan_ab acceptance knobs (ISSUE 19): the planner's HBM
+#: prediction must land within MAX_HBM_PRED_REL_ERR of the compile-time
+#: measured peak once a cost card exists, and the planned cell's step
+#: time must stay within STEP_TIME_TOL_PCT of the hand-pinned cell.
+MAX_HBM_PRED_REL_ERR = 0.05
+STEP_TIME_TOL_PCT = 10.0
+
+
+def plan_ab(steps: int = 8, batch: int = 512,
+            bucket_mb: float = 0.1) -> dict:
+    """Hand-pinned gradient path vs ``--plan auto`` A/B (ISSUE 19
+    acceptance): cell A runs the PR-6 pinned flags (the dense path's
+    one-shot ``--grad_comm_dtype int8`` wire, exactly what PR 6
+    shipped); cell B lets the planner derive everything.  Reports per-
+    cell step time and wire bytes (the planned cell's int8_ring wire
+    must ship strictly fewer scatter-leg bytes on a multi-way mesh),
+    plus the planner's predicted-vs-measured peak HBM: the step compile
+    is captured as a train/step CostCard (compile-time memory analysis,
+    available on CPU), the planner re-plans against the card library,
+    and the relative prediction error is gated at MAX_HBM_PRED_REL_ERR.
+    The JSON lands in PLAN_r*.json rounds and scripts/bench_ledger.py
+    folds it as the ``plan`` rig kind."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from dtf_tpu import optim
+    from dtf_tpu.models.mlp import MnistMLP
+    from dtf_tpu.parallel import planner as plan_mod
+    from dtf_tpu.parallel.grad_sync import GradSyncEngine
+    from dtf_tpu.parallel.mesh import local_mesh
+    from dtf_tpu.telemetry import costobs
+    from dtf_tpu.train.trainer import (init_state, make_train_step,
+                                       put_global_batch)
+    from dtf_tpu.utils.timing import block
+
+    mesh = local_mesh("data=-1")
+    model = MnistMLP(init_scale="fan_in")
+    opt = optim.adam(1e-3)
+    rng = np.random.default_rng(0)
+    host_batch = (rng.random((batch, 784)).astype(np.float32),
+                  np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    n_dev = int(mesh.shape["data"])
+
+    def run_cell(grad_sync, comm_dtype, tag):
+        eng = None
+        if grad_sync != "dense":
+            eng = GradSyncEngine(grad_sync, opt, mesh,
+                                 bucket_mb=bucket_mb,
+                                 comm_dtype=comm_dtype).prepare(
+                jax.eval_shape(model.init, jax.random.key(1)))
+        state = init_state(model, opt, seed=1, mesh=mesh, grad_sync=eng)
+        step = make_train_step(model.loss, opt, mesh, mode="explicit",
+                               donate=False, grad_sync=eng,
+                               grad_comm_dtype=(comm_dtype
+                                                if eng is None else None))
+        b = put_global_batch(mesh, host_batch)
+        # AOT capture: the same compile the trainer's warmup observes,
+        # giving the cell a compile-time peak-HBM measurement.
+        lowered = jax.jit(lambda s, bb, k: step(s, bb, k)).lower(
+            state, b, jax.random.key(0)).compile()
+        card = costobs.observe(f"plan_ab/{tag}", ("aot", batch), lowered)
+        for i in range(3):                                # warm
+            state, m = step(state, b, jax.random.key(i))
+        block(state)
+        # Median of per-step wall times: a single mean over the loop is
+        # hostage to one scheduler hiccup on shared CPU rigs, and this
+        # number gates step_time_ok.
+        t_per = []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            state, m = step(state, b, jax.random.key(i + 3))
+            block(state)
+            t_per.append(time.perf_counter() - t0)
+        step_ms = float(np.median(t_per)) * 1e3
+
+        if eng is not None:
+            stats = eng.comm_stats(1)
+        else:
+            from dtf_tpu.parallel import quantize as qz
+            from dtf_tpu.parallel.grad_sync import (comm_dtype_of,
+                                                    wire_bytes_per_elem)
+            n_elems = int(sum(
+                np.prod(l.shape)
+                for l in jax.tree_util.tree_leaves(state["params"])))
+            resolved = comm_dtype_of(comm_dtype)
+            if resolved in ("int8", "int8_ring"):
+                flat = -(-n_elems // n_dev) * n_dev
+                elems = (qz.ring_wire_elems if resolved == "int8_ring"
+                         else qz.wire_elems)
+                scatter = float(elems(flat, n_dev)
+                                * qz.WIRE_BYTES_PER_ELEM["int8"])
+                gather = float(qz.wire_elems(flat, n_dev)
+                               * qz.WIRE_BYTES_PER_ELEM["int8"])
+                stats = {"grad_sync_bytes": scatter + gather,
+                         "wire_bytes": scatter,
+                         "hops": (n_dev - 1 if resolved == "int8_ring"
+                                  else 1)}
+            else:
+                wire = float(n_elems) * wire_bytes_per_elem(resolved)
+                stats = {"grad_sync_bytes": wire, "wire_bytes": wire,
+                         "hops": 1}
+        row = {
+            "grad_sync": grad_sync,
+            "grad_comm_dtype": comm_dtype,
+            "step_ms": round(step_ms, 4),
+            "wire_bytes_per_step": stats["wire_bytes"],
+            "comm_bytes_per_step": stats["grad_sync_bytes"],
+            "hops": int(stats.get("hops", 1)),
+            "measured_peak_hbm_bytes": card.peak_hbm_bytes,
+        }
+        if "quant_error" in m:
+            row["quant_error_rms"] = float(m["quant_error"])
+        return row, card
+
+    out = {"workload": "mnist_mlp_784_100_10",
+           "backend": jax.default_backend(),
+           "data_axis": n_dev, "global_batch": batch,
+           "steps_timed": steps, "bucket_mb": bucket_mb,
+           "max_hbm_prediction_rel_err": MAX_HBM_PRED_REL_ERR,
+           "step_time_tol_pct": STEP_TIME_TOL_PCT}
+    if n_dev == 1:
+        import sys as _sys
+        out["warning"] = ("data axis is 1 — the ring wire degenerates "
+                          "to zero hops; run on a multi-device mesh "
+                          "(e.g. --simulated_devices 8 on CPU)")
+        print(f"# WARNING: {out['warning']}", file=_sys.stderr)
+
+    # Cell A: the PR-6 hand-pinned gradient path (dense + one-shot int8).
+    pinned_row, _ = run_cell("dense", "int8", "pinned")
+    out["pinned"] = pinned_row
+
+    # Cell B: --plan auto.  Plan analytically, run the planned knobs,
+    # then re-plan against the captured cost card — the measurement-
+    # driven pass whose prediction the gate audits.
+    plan0 = plan_mod.make_plan(model, mesh, batch_size=batch,
+                               optimizer=opt,
+                               pinned={"grad_bucket_mb": bucket_mb})
+    auto_row, card = run_cell(plan0.grad_sync, plan0.grad_comm_dtype,
+                              "plan_auto")
+    with tempfile.TemporaryDirectory() as td:
+        obs = costobs.get_observatory()
+        # expose the captured compile under the trainer's card site so
+        # the planner's geometry match finds it
+        costobs.observe("train/step", ("aot", batch),
+                        _ReplayCompiled(card))
+        obs.write_jsonl(td)
+        plan1 = plan_mod.make_plan(model, mesh, batch_size=batch,
+                                   optimizer=opt, logdir=td,
+                                   pinned={"grad_bucket_mb": bucket_mb})
+    auto_row["plan"] = plan1.to_doc()
+    auto_row["predicted_hbm_bytes_analytic"] = plan0.predicted_hbm_bytes
+    auto_row["predicted_hbm_bytes"] = plan1.predicted_hbm_bytes
+    measured = auto_row["measured_peak_hbm_bytes"]
+    rel = (abs(plan1.predicted_hbm_bytes - measured) / measured
+           if measured else None)
+    auto_row["hbm_prediction_rel_err"] = rel
+    out["plan_auto"] = auto_row
+
+    out["wire_bytes_ratio"] = round(
+        auto_row["wire_bytes_per_step"]
+        / max(pinned_row["wire_bytes_per_step"], 1.0), 4)
+    out["wire_reduction"] = round(1.0 - out["wire_bytes_ratio"], 4)
+    out["wire_win"] = (auto_row["wire_bytes_per_step"]
+                       < pinned_row["wire_bytes_per_step"])
+    out["step_time_ratio"] = round(
+        auto_row["step_ms"] / max(pinned_row["step_ms"], 1e-9), 4)
+    out["step_time_ok"] = (out["step_time_ratio"]
+                           <= 1.0 + STEP_TIME_TOL_PCT / 100.0)
+    out["hbm_prediction_ok"] = (rel is not None
+                                and rel <= MAX_HBM_PRED_REL_ERR)
+    out["ok"] = bool(out["wire_win"] and out["step_time_ok"]
+                     and out["hbm_prediction_ok"])
+    return out
+
+
+class _ReplayCompiled:
+    """Adapter replaying a captured CostCard through CostObservatory.
+    observe() under a different (site, geometry) key: quacks like a
+    compiled executable for cost_analysis/memory_analysis only."""
+
+    def __init__(self, card):
+        self._card = card
+
+    def cost_analysis(self):
+        return {"flops": self._card.flops,
+                "bytes accessed": self._card.bytes_accessed}
+
+    def memory_analysis(self):
+        card = self._card
+        parts = sum(p for p in (card.argument_bytes, card.output_bytes,
+                                card.temp_bytes) if p is not None)
+
+        class _M:
+            argument_size_in_bytes = card.argument_bytes
+            output_size_in_bytes = card.output_bytes
+            temp_size_in_bytes = card.temp_bytes
+            generated_code_size_in_bytes = card.generated_code_bytes
+            # back out the alias so the replayed peak reproduces the
+            # card's exactly (parts - alias == card.peak_hbm_bytes)
+            alias_size_in_bytes = parts - (card.peak_hbm_bytes or parts)
+        return _M()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--family", choices=["bert", "gpt"], default="bert")
@@ -500,10 +705,18 @@ def main(argv=None) -> int:
                              "strategy step time, isolated sync+update "
                              "time, per-device optimizer-state bytes and "
                              "wire bytes")
+    parser.add_argument("--plan_ab", action="store_true",
+                        help="hand-pinned flags vs --plan auto A/B "
+                             "(parallel/planner.py): JSON with per-cell "
+                             "step time + wire bytes, the planned "
+                             "int8_ring wire reduction, and the "
+                             "planner's predicted-vs-measured peak HBM "
+                             "(gated at MAX_HBM_PRED_REL_ERR); rounds "
+                             "land in PLAN_r*.json for the ledger")
     parser.add_argument("--ab_steps", type=int, default=8,
-                        help="timed steps per strategy in --grad_sync_ab")
+                        help="timed steps per strategy in the A/Bs")
     parser.add_argument("--ab_batch", type=int, default=512,
-                        help="global batch in --grad_sync_ab")
+                        help="global batch in the A/Bs")
     parser.add_argument("--simulated_devices", type=int, default=0,
                         help="run on N simulated CPU devices (the "
                              "grad_sync A/B needs a multi-way data axis "
@@ -527,6 +740,11 @@ def main(argv=None) -> int:
         print(json.dumps(grad_sync_ab(steps=ns.ab_steps, batch=ns.ab_batch),
                          indent=1, sort_keys=True))
         return 0
+    if ns.plan_ab:
+        import json
+        doc = plan_ab(steps=ns.ab_steps, batch=ns.ab_batch)
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0 if doc["ok"] else 1
     peak = peak_flops_per_chip()
     if ns.attn_sweep:
         rows = attn_sweep(ns.family, ns.batch, ns.seq)

@@ -425,8 +425,10 @@ def main(argv=None) -> int:
     parser.add_argument("--grad_sync", default="zero1",
                         choices=["dense", "zero1", "zero1_overlap"])
     parser.add_argument("--grad_comm_dtype", default="int8",
-                        choices=["f32", "bf16", "int8"],
-                        help="gradient wire format for the quantized leg")
+                        choices=["f32", "bf16", "int8", "int8_ring"],
+                        help="gradient wire format for the quantized leg "
+                             "(int8_ring: per-hop requantizing segmented "
+                             "ring reduce-scatter)")
     parser.add_argument("--matmul_dtype", default="fp32",
                         choices=["fp32", "bf16", "int8", "fp8"],
                         help="forward compute format for the quantized leg")
@@ -448,13 +450,13 @@ def main(argv=None) -> int:
     if ns.trajectory:
         import json
         if (ns.quant_rounding == "stochastic"
-                and ns.grad_comm_dtype != "int8"):
-            # Same rejection as TrainConfig.validate: only the int8 wire
-            # consults the rounding mode, and a report header claiming
+                and ns.grad_comm_dtype not in ("int8", "int8_ring")):
+            # Same rejection as TrainConfig.validate: only the int8 wires
+            # consult the rounding mode, and a report header claiming
             # "rounding=stochastic" over a wire that never rounds would
             # poison the trajectory attribution this harness exists for.
             parser.error("--quant_rounding stochastic only applies to "
-                         "--grad_comm_dtype int8")
+                         "--grad_comm_dtype int8/int8_ring")
         cd = None if ns.grad_comm_dtype == "f32" else ns.grad_comm_dtype
         r = traj_run(steps=ns.traj_steps,
                      batch=16 if ns.batch is None else ns.batch,

@@ -165,11 +165,25 @@ class TrainConfig:
     # mean-preserving pre-scaling, one rounding per value — through the
     # reduce-scatter/pmean; "int8" ships the block-scaled format
     # (parallel/quantize.py: int8 payload + one f32 scale per 256
-    # values, ~4x less wire than f32, ~2x less than bf16);
+    # values, ~4x less wire than f32, ~2x less than bf16); "int8_ring"
+    # is the EQuARX schedule — a segmented ring reduce-scatter that
+    # requantizes the int8 partial sum on EVERY hop, (n-1)/n of the
+    # int8 wire bytes on an n-way axis (comm/hops counts the hops);
     # None/"f32" keeps the exact f32 wire.  Composes with every
     # --grad_sync strategy; requires the explicit step (shard_map owns
     # the collectives).
     grad_comm_dtype: Optional[str] = None
+    # Sharding planner (parallel/planner.py): "auto" derives a
+    # measurement-driven ShardingPlan (grad-sync strategy, wire dtype,
+    # bucket size, activation sharding, remat policy) from the model
+    # template + mesh + HBM budget, predicting per-device HBM/step time
+    # from captured CostCards (analytic fallback) and rejecting
+    # infeasible pairs loudly.  Hand-pinned flags always override the
+    # plan's choices.  None keeps today's fully manual behavior.
+    plan: Optional[str] = None
+    # Per-device HBM budget (GiB) the planner plans against; 0/None =
+    # the detected device capacity (CPU sim pins a synthetic 4 GiB).
+    plan_hbm_gb: float = 0.0
     # int8-wire rounding mode: "nearest" (deterministic) or "stochastic"
     # (unbiased floor(v/s + u) draws seeded from the step rng, so
     # trajectories stay reproducible run-to-run).
@@ -311,10 +325,10 @@ class TrainConfig:
                 f"('dense', 'zero1', 'zero1_overlap'), got "
                 f"{self.grad_sync!r}")
         if self.grad_comm_dtype not in (None, "bf16", "bfloat16", "f32",
-                                        "float32", "int8"):
+                                        "float32", "int8", "int8_ring"):
             raise ValueError(
-                f"--grad_comm_dtype must be 'f32', 'bf16' or 'int8', got "
-                f"{self.grad_comm_dtype!r}")
+                f"--grad_comm_dtype must be 'f32', 'bf16', 'int8' or "
+                f"'int8_ring', got {self.grad_comm_dtype!r}")
         # Literal mirror of parallel.quantize.ROUNDINGS (jax-free import,
         # same pinning rule as the STRATEGIES mirror above).
         if self.quant_rounding not in ("nearest", "stochastic"):
@@ -322,17 +336,26 @@ class TrainConfig:
                 f"--quant_rounding must be 'nearest' or 'stochastic', "
                 f"got {self.quant_rounding!r}")
         if (self.quant_rounding == "stochastic"
-                and self.grad_comm_dtype != "int8"):
-            # Only the block-scaled int8 wire consults the rounding mode;
+                and self.grad_comm_dtype not in ("int8", "int8_ring")):
+            # Only the block-scaled int8 wires consult the rounding mode;
             # silently running nearest under a flag that asked for
             # stochastic would poison trajectory attribution.
             raise ValueError(
                 "--quant_rounding stochastic only applies to the "
-                "--grad_comm_dtype int8 wire (the f32/bf16 wires have no "
-                "quantizer); drop the flag or switch the wire to int8")
+                "--grad_comm_dtype int8/int8_ring wires (the f32/bf16 "
+                "wires have no quantizer); drop the flag or switch the "
+                "wire to int8")
         if self.grad_bucket_mb <= 0:
             raise ValueError(
                 f"--grad_bucket_mb must be > 0, got {self.grad_bucket_mb}")
+        if self.plan not in (None, "auto"):
+            raise ValueError(
+                f"--plan must be 'auto' (or unset for fully manual "
+                f"sharding), got {self.plan!r}")
+        if self.plan_hbm_gb < 0:
+            raise ValueError(
+                f"--plan_hbm_gb must be >= 0 (0 = detected device "
+                f"capacity), got {self.plan_hbm_gb}")
 
 
 def _field_type(cls, f: dataclasses.Field) -> type:

@@ -85,6 +85,18 @@ class BertConfig:
     moe_experts: int = 0
     moe_top_k: int = 1
     moe_aux_weight: float = 0.01
+    # Activation sharding constraint: a NamedSharding pinned onto the
+    # (B, T, D) hidden stream after the embedding and at every layer
+    # boundary (jax.lax.with_sharding_constraint).  Without it GSPMD has
+    # to infer the activation layout between the batch-sharded input and
+    # the tensor-sharded weights and can pick transition points that
+    # force an "involuntary full rematerialization" of the tensor (the
+    # spmd_partitioner warning the multichip dryrun used to print 8x).
+    # The sharding planner (parallel/planner.py) sets this to
+    # batch-over-data-axes automatically under --plan auto; implicit
+    # (jit/GSPMD) step only — inside shard_map the data axes are Manual
+    # and the hidden stream is already per-shard.
+    act_sharding: Optional[Any] = None
     # Fused block kernels (ops/block_kernel.py): the whole attention
     # half-block (LN/qkv/attention/out-proj/residual) and MLP half-block
     # each run as ONE Pallas kernel, keeping the (B,T,3D) qkv and (B,T,F)
@@ -279,11 +291,21 @@ class BertMLM(Module):
 
         return stage
 
+    def _constrain(self, x):
+        """Pin the (B, T, D) hidden stream to cfg.act_sharding (no-op when
+        unset): the planner's activation policy, and the annotation that
+        keeps GSPMD from involuntarily rematerializing the tensor at
+        sharding transitions (BertConfig.act_sharding)."""
+        if self.cfg.act_sharding is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, self.cfg.act_sharding)
+
     def encode(self, params, tokens, *, pad_mask=None):
         """tokens (B, T) int32 -> hidden (B, T, D)."""
         t = tokens.shape[1]
         x = (self.tok.apply(params["tok"], tokens)
              + self.pos.apply(params["pos"], jnp.arange(t)))
+        x = self._constrain(x)
         x = self.ln_emb.apply(params["ln_emb"], x)
         attn_mask = None
         if pad_mask is not None:
@@ -307,7 +329,9 @@ class BertMLM(Module):
             # divide by M to match the non-pipelined per-batch mean.
             return out, moe_aux / self.cfg.pipeline_microbatches
 
-        layer_fn = lambda lp, h: self.layer.apply(lp, h, mask=attn_mask)
+        def layer_fn(lp, h):
+            y, a = self.layer.apply(lp, h, mask=attn_mask)
+            return self._constrain(y), a
         if self.cfg.remat:
             layer_fn = remat(layer_fn, self.cfg.remat_policy)
 

@@ -69,14 +69,22 @@ def reduce_scatter(x: jax.Array, axis: str, *, scatter_axis: int = 0) -> jax.Arr
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
 
 
+def ring_neighbors(n: int, *, shift: int = 1) -> list:
+    """The ``ppermute`` permutation for one hop around an ``n``-device
+    ring — THE forward schedule shared by every hand-scheduled ring here
+    and in parallel/quantize.py (one definition, so the legacy ring and
+    the per-hop-requantizing grad-sync ring can never disagree on
+    direction)."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
 def ring_permute(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     """Send to the next device along a mesh axis ring (ppermute).
 
     Building block for ring attention / pipeline schedules.
     """
-    n = axis_size(axis)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis, perm)
+    return lax.ppermute(x, axis, ring_neighbors(axis_size(axis),
+                                                shift=shift))
 
 
 def all_to_all(x: jax.Array, axis: str, *, split_axis: int, concat_axis: int) -> jax.Array:
@@ -128,6 +136,13 @@ def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
     gradients (see tests/test_quantized_allreduce.py's measured bound and
     convergence A/B); use exact ``pmean`` when that matters more than
     bandwidth.
+
+    The grad-sync engine's ``--grad_comm_dtype int8_ring`` wire is the
+    productionized sibling (quantize.ring_reduce_scatter_quantized):
+    same per-hop requantizing RS schedule, plus stochastic rounding,
+    per-hop error accounting, and the bucket-layout contract.  This
+    whole-tensor helper stays as the legacy ``--grad_compression int8``
+    path and the minimal reference the parity tests pin.
     """
     n = axis_size(axis)
     if n == 1:
@@ -139,7 +154,7 @@ def quantized_ring_all_reduce_mean(x: jax.Array, axis: str) -> jax.Array:
     m = -(-m // _QBLOCK) * _QBLOCK          # per-block scales need full blocks
     buf = jnp.pad(flat, (0, n * m - flat.size)).reshape(n, m)
 
-    fwd = [(i, (i + 1) % n) for i in range(n)]
+    fwd = ring_neighbors(n)
 
     # reduce-scatter: after n-1 hops, rank i owns the full sum of chunk
     # (i+1) mod n.  Each hop ships the partial sum quantized.

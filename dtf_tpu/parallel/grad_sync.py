@@ -44,7 +44,12 @@ pre-scaled payload; ``int8`` ships the block-scaled format from
 :mod:`dtf_tpu.parallel.quantize` (int8 payload + one f32 scale per
 QBLOCK values, ~2x less wire than bf16, ~4x less than f32) with
 ``--quant_rounding nearest|stochastic`` (stochastic draws are seeded
-from the step rng, so trajectories stay reproducible).
+from the step rng, so trajectories stay reproducible); ``int8_ring``
+keeps the same block format but schedules the reduce-scatter as a
+segmented ring that **requantizes the partial sum on every hop**
+(EQuARX proper) — ``(n-1)/n`` of the int8 wire bytes, at ``n-1``
+roundings per value, with the per-hop error ladder measured into
+``comm/quant_error`` and the hop count into ``comm/hops``.
 
 Sharding the update requires the update rule to commute with partitioning
 the flattened parameter vector — true for ELEMENTWISE optimizers
@@ -91,18 +96,26 @@ _PAD_QUANTUM = 128
 
 _COMM_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
                 "f32": jnp.float32, "float32": jnp.float32,
-                "int8": "int8"}
+                "int8": "int8", "int8_ring": "int8_ring"}
 
 #: Canonical wire-format order for the ``comm/wire_dtype_idx`` gauge; the
 #: report CLI carries a literal mirror (pinned by tests/test_grad_sync.py).
-WIRE_DTYPES: Tuple[str, ...] = ("f32", "bf16", "int8")
+WIRE_DTYPES: Tuple[str, ...] = ("f32", "bf16", "int8", "int8_ring")
+
+#: The wire formats that ship the block-scaled int8 payload (the ring
+#: variant re-encodes it per hop — see quantize.ring_reduce_scatter_
+#: quantized); both route scatter through parallel/quantize.py and carry
+#: the ``qerr`` accumulator.
+QUANTIZED_WIRES: Tuple[str, ...] = ("int8", "int8_ring")
 
 
 def comm_dtype_of(name: Optional[str]):
     """Resolve a ``--grad_comm_dtype`` flag value to a wire format: None
-    (exact f32 wire), ``jnp.bfloat16``, or the string ``"int8"`` (the
-    block-scaled format from parallel/quantize.py — not a plain cast, so
-    no jnp dtype).  Raises with the valid spellings."""
+    (exact f32 wire), ``jnp.bfloat16``, or the strings ``"int8"`` /
+    ``"int8_ring"`` (the block-scaled format from parallel/quantize.py —
+    not a plain cast, so no jnp dtype; the ring spelling additionally
+    requantizes every reduce-scatter hop).  Raises with the valid
+    spellings."""
     if name is None:
         return None
     try:
@@ -118,7 +131,7 @@ def wire_dtype_name(resolved) -> str:
     """Inverse of :func:`comm_dtype_of` onto :data:`WIRE_DTYPES`."""
     if resolved is None:
         return "f32"
-    return "int8" if resolved == "int8" else "bf16"
+    return resolved if resolved in QUANTIZED_WIRES else "bf16"
 
 
 def wire_bytes_per_elem(resolved) -> float:
@@ -287,12 +300,13 @@ class GradSyncEngine:
         self.n_shards = int(mesh.shape[self.axis])
         self.bucket_bytes = bucket_mb * (1 << 20)
         self.comm_dtype = comm_dtype_of(comm_dtype)
-        # "int8" is a wire FORMAT (block-scaled payload + scales, not a
-        # cast): the scatter routes through parallel/quantize.py.  The
-        # bucket layout is wire-independent — block alignment happens
-        # inside the collective — so checkpoints reshard across wire
-        # dtypes without a layout conversion.
-        self.quantized = self.comm_dtype == "int8"
+        # "int8"/"int8_ring" are wire FORMATS (block-scaled payload +
+        # scales, not a cast): the scatter routes through
+        # parallel/quantize.py.  The bucket layout is wire-independent —
+        # block alignment happens inside the collective — so checkpoints
+        # reshard across wire dtypes without a layout conversion.
+        self.quantized = self.comm_dtype in QUANTIZED_WIRES
+        self.ring = self.comm_dtype == "int8_ring"
         self.quant_rounding = qz.check_rounding(quant_rounding)
         self.layout: Optional[BucketLayout] = None
 
@@ -466,10 +480,16 @@ class GradSyncEngine:
         total = sum(layout.padded)
         rs_rounds = (grad_accum if (self.strategy == "zero1_overlap"
                                     and grad_accum > 1) else 1)
+        # Hops per reduce-scatter round: the all-to-all wires (f32/bf16/
+        # int8) ship every chunk in one shot; the ring walks n-1 links,
+        # each carrying one chunk — fewer total elements, more hops (the
+        # comm/hops gauge, so the wire win is auditable per topology).
+        hops = (self.n_shards - 1) if self.ring else 1
         if self.quantized:
-            # Exact: per-chunk block round-up (quantize.wire_elems), int8
-            # payload + f32 scale per QBLOCK.
-            wire_total = sum(qz.wire_elems(p, self.n_shards)
+            # Exact: per-chunk block round-up (quantize.wire_elems /
+            # ring_wire_elems), int8 payload + f32 scale per QBLOCK.
+            elems = (qz.ring_wire_elems if self.ring else qz.wire_elems)
+            wire_total = sum(elems(p, self.n_shards)
                              for p in layout.padded)
             wire = float(wire_total
                          * qz.WIRE_BYTES_PER_ELEM["int8"] * rs_rounds)
@@ -478,7 +498,8 @@ class GradSyncEngine:
                          * rs_rounds)
         return {"grad_sync_bytes": wire + float(total * 4),
                 "wire_bytes": wire,
-                "bucket_count": float(len(layout.padded))}
+                "bucket_count": float(len(layout.padded)),
+                "hops": float(hops)}
 
     # -- traced per-device code (inside shard_map) --------------------------
 
@@ -508,10 +529,12 @@ class GradSyncEngine:
             if self.quant_rounding == "stochastic" and rng is None:
                 raise ValueError("stochastic quant_rounding needs the step "
                                  "rng threaded into scatter()")
+            rs = (qz.ring_reduce_scatter_quantized if self.ring
+                  else qz.reduce_scatter_quantized)
             for i, (k, v) in enumerate(layout.flatten(grads).items()):
                 bucket_rng = (jax.random.fold_in(rng, i)
                               if rng is not None else None)
-                out[k], e = qz.reduce_scatter_quantized(
+                out[k], e = rs(
                     v * inv, self.axis, rounding=self.quant_rounding,
                     rng=bucket_rng, return_error=True)
                 qerr = qerr + e

@@ -1,0 +1,477 @@
+"""Measurement-driven sharding planner (``--plan auto``).
+
+The reference assigned placement by hand: every variable pinned to the PS
+job, every op to the local worker, and the operator re-tuned batch size /
+tower count whenever the model changed (tf_distributed.py:34-36).  The
+grown framework kept that manual flavor — ``--grad_sync``, ``--grad_comm_
+dtype``, ``--grad_bucket_mb``, model-level ``remat`` are all hand-pinned
+flags.  This module closes the loop: given a model template, the mesh, and
+a per-device HBM budget, it derives ONE consistent :class:`ShardingPlan`
+(parameter placement rules, gradient-sync strategy + bucket size, wire
+dtype for the gradient allreduce, activation sharding + remat policy) and
+predicts the per-device HBM footprint and step time that plan implies.
+
+Two prediction sources (``PLAN_SOURCES``):
+
+* ``"analytic"`` — closed-form bytes/flops accounting from the model
+  template's shapes (``jax.eval_shape`` of ``model.init``) plus a
+  transformer activation model.  Always available; used to rank the
+  candidate ladder.
+* ``"costcards"`` — when a cost-card library captured by the device cost
+  observatory (telemetry/costobs.py) exists for this geometry, the
+  measured compile-time ``peak_hbm_bytes`` / flops / bytes replace the
+  analytic estimate for the *selected* plan, and step time comes from the
+  chip roofline (utils/profiling.py).  Measurement beats modeling.
+
+Infeasible (model, budget) pairs are rejected LOUDLY: the raised
+:class:`PlanInfeasibleError` names the overflowing component (``"optimizer
+state"``, ``"activations"``, ...) and the budget, so the failure reads as
+a capacity diagnosis rather than a downstream OOM.  Predictions are
+recorded to ``<logdir>/plan.json`` so ``report --explain`` can audit
+predicted-vs-measured after the run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from dtf_tpu.parallel import sharding as sh
+
+# Literal mirror order: plan/source_idx gauge indexes into this tuple.
+PLAN_SOURCES = ("analytic", "costcards")
+
+# File the plan document is recorded to inside the run's logdir (read back
+# by ``report --explain`` for the predicted-vs-measured audit).
+PLAN_FILENAME = "plan.json"
+
+# Candidate ladders, least intrusive first: the planner walks DOWN the
+# mesh's ladder and stops at the first feasible rung, each further rung
+# trading compute (remat) or schedule complexity for HBM headroom.
+# On a >= _ZERO1_MIN_AXIS-way data axis ZeRO-1 IS the least intrusive
+# rung: optimizer state drops to 1/N AND the sharded update was measured
+# faster than dense's full-tree quantized allreduce (bench.breakdown
+# --plan_ab); dense leads only on narrow meshes where the bucket
+# machinery's overhead buys little.
+#   (grad_sync, remat, remat_policy)
+_ZERO1_MIN_AXIS = 4
+_LADDER_NARROW = (
+    ("dense", False, "full"),
+    ("zero1", False, "full"),
+    ("zero1", True, "dots"),
+    ("zero1_overlap", True, "full"),
+)
+_LADDER_WIDE = (
+    ("zero1", False, "full"),
+    ("zero1", True, "dots"),
+    ("zero1_overlap", True, "full"),
+)
+
+# Collective scratch: quantized allreduce stages ~2 bucket-sized buffers
+# (send + recv) regardless of strategy.
+_SCRATCH_BUCKETS = 2.0
+
+
+class PlanInfeasibleError(ValueError):
+    """No rung of the candidate ladder fits the HBM budget.
+
+    The message names the largest component of the *most aggressive*
+    candidate (the best the planner could do), so the operator learns
+    WHAT overflows, not just that something did.
+    """
+
+    def __init__(self, component: str, component_bytes: float,
+                 total_bytes: float, budget_bytes: float):
+        self.component = component
+        self.component_bytes = float(component_bytes)
+        self.total_bytes = float(total_bytes)
+        self.budget_bytes = float(budget_bytes)
+        super().__init__(
+            f"no feasible sharding plan: predicted per-device HBM "
+            f"{total_bytes / 2**30:.2f} GiB exceeds the "
+            f"{budget_bytes / 2**30:.2f} GiB budget even at the most "
+            f"aggressive rung (zero1_overlap + full remat); largest "
+            f"component is {component!r} at "
+            f"{component_bytes / 2**30:.2f} GiB — shrink the model, "
+            f"raise --plan_hbm_gb, or add devices to the data/fsdp axes")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """One consistent answer to "how does this model run on this mesh".
+
+    Everything the trainer needs to configure the gradient path plus the
+    predictions that justify it; JSON round-trips via to_doc/from_doc so
+    checkpoints can carry the plan and restores can detect plan changes.
+    """
+    mesh_axes: tuple            # ((name, size), ...) — the planned mesh
+    hbm_budget_bytes: float
+    source: str                 # one of PLAN_SOURCES
+    grad_sync: str              # grad_sync.STRATEGIES member
+    grad_bucket_mb: float
+    grad_comm_dtype: Optional[str]
+    quant_rounding: str
+    remat: bool
+    remat_policy: str
+    predicted_hbm_bytes: float
+    predicted_step_ms: float    # 0.0 = no roofline/card basis to predict
+    components: tuple           # ((name, bytes), ...) analytic breakdown
+
+    def to_doc(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["mesh_axes"] = [list(p) for p in self.mesh_axes]
+        d["components"] = [list(p) for p in self.components]
+        return d
+
+    @classmethod
+    def from_doc(cls, doc: Mapping[str, Any]) -> "ShardingPlan":
+        d = dict(doc)
+        d["mesh_axes"] = tuple((str(n), int(s)) for n, s in d["mesh_axes"])
+        d["components"] = tuple((str(n), float(b))
+                                for n, b in d["components"])
+        return cls(**d)
+
+    def activation_sharding(self, mesh) -> Any:
+        """NamedSharding for rank-3 (B, T, D) activations: batch dim over
+        the data-like axes and the hidden dim over ``tensor`` when the
+        mesh has one — the layout the partitioner's own preferred
+        transition points agree with, which is what suppresses the
+        "involuntary full rematerialization" warnings (measured 8 -> 0 on
+        the data=2,fsdp=2,tensor=2 dryrun mesh; batch-only still left 4,
+        since the embedding gather and attention want D over tensor)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        axes = sh.data_axes(mesh)
+        tensor = "tensor" if "tensor" in mesh.axis_names else None
+        return NamedSharding(mesh, P(axes or None, None, tensor))
+
+    def summary(self) -> str:
+        wire = self.grad_comm_dtype or "f32"
+        return (f"plan[{self.source}]: {self.grad_sync}/{wire} "
+                f"bucket={self.grad_bucket_mb:g}MB "
+                f"remat={'on(' + self.remat_policy + ')' if self.remat else 'off'} "
+                f"hbm={self.predicted_hbm_bytes / 2**30:.2f}GiB"
+                f"/{self.hbm_budget_bytes / 2**30:.2f}GiB")
+
+
+# ---------------------------------------------------------------------------
+# Analytic component accounting
+# ---------------------------------------------------------------------------
+
+def _leaf_bytes(leaf) -> float:
+    return float(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+
+
+def _sharded_param_bytes(model, mesh, shapes) -> float:
+    """Per-device parameter bytes under the implicit-mode rule table
+    (fsdp rules when the mesh has an fsdp axis, defaults otherwise)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(shapes)
+    axes_fn = getattr(model, "param_axes", None)
+    if axes_fn is None:
+        return sum(_leaf_bytes(l) for l in leaves)
+    rules = sh.fsdp_rules() if "fsdp" in mesh.axis_names else sh.DEFAULT_RULES
+    shardings = sh.apply_rules(axes_fn(), mesh, rules)
+    total = 0.0
+    for leaf, s in zip(leaves, jax.tree_util.tree_leaves(shardings)):
+        local = s.shard_shape(leaf.shape)
+        total += float(np.prod(local)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _param_shapes(model):
+    import jax
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0))
+
+
+def _opt_state_bytes(optimizer, shapes) -> float:
+    """Full (unsharded) optimizer-state bytes for the param template."""
+    import jax
+
+    if optimizer is None:
+        return 0.0
+    try:
+        st = jax.eval_shape(optimizer.init, shapes)
+    except Exception:
+        # optimizers whose init can't be shape-traced: assume adam-like 2x
+        return 2.0 * sum(_leaf_bytes(l)
+                         for l in jax.tree_util.tree_leaves(shapes))
+    return sum(_leaf_bytes(l) for l in jax.tree_util.tree_leaves(st))
+
+
+def _activation_bytes(model, local_batch: int, remat: bool,
+                      remat_policy: str) -> float:
+    """Saved-for-backward activation bytes under the given remat policy.
+
+    Transformer coefficient model when the template exposes the BERT-ish
+    config attrs (dim / num_layers / mlp_dim / max_len); a generic
+    hidden-width fallback otherwise (MLPs).  Coefficients count the f32
+    tensors autodiff keeps live: ~10 D-wide + 2 F-wide residuals per
+    layer without remat, ~4 D-wide (dot outputs) under "dots", layer
+    boundaries only (1 D-wide) under "full".
+    """
+    cfg = getattr(model, "cfg", None)
+    dim = getattr(cfg, "dim", None)
+    if cfg is not None and dim is not None:
+        n_layers = int(getattr(cfg, "num_layers", 1))
+        mlp_dim = int(getattr(cfg, "mlp_dim", 4 * dim))
+        seq = int(getattr(cfg, "max_len", 128))
+        if remat and remat_policy == "full":
+            per_layer = 1.0 * dim
+        elif remat:                       # "dots": keep matmul outputs
+            per_layer = 4.0 * dim
+        else:
+            per_layer = 10.0 * dim + 2.0 * mlp_dim
+        return float(local_batch) * seq * per_layer * n_layers * 4.0
+    hidden = float(getattr(model, "hidden", 0) or
+                   getattr(model, "in_dim", 0) or 1024)
+    return float(local_batch) * hidden * 4.0 * 4.0
+
+
+def _logits_bytes(model, local_batch: int) -> float:
+    cfg = getattr(model, "cfg", None)
+    vocab = getattr(cfg, "vocab_size", None)
+    if cfg is not None and vocab is not None:
+        k = int(getattr(cfg, "mlm_predictions", 0) or
+                getattr(cfg, "max_len", 128))
+        return float(local_batch) * k * vocab * 4.0
+    classes = float(getattr(model, "num_classes", 10))
+    return float(local_batch) * classes * 4.0
+
+
+def _components(model, mesh, *, batch_size: int, grad_sync: str,
+                grad_bucket_mb: float, remat: bool,
+                remat_policy: str, optimizer=None) -> tuple:
+    """Analytic per-device HBM breakdown for one candidate, as
+    ((name, bytes), ...) sorted largest-first."""
+    import jax
+
+    shapes = _param_shapes(model)
+    n = max(1, sh.data_axis_size(mesh))
+    local_batch = max(1, batch_size // n)
+
+    param_b = _sharded_param_bytes(model, mesh, shapes)
+    full_param_b = sum(_leaf_bytes(l)
+                       for l in jax.tree_util.tree_leaves(shapes))
+    opt_b = _opt_state_bytes(optimizer, shapes)
+
+    # Gradients: a full f32 copy of the params lives across the sync;
+    # zero1_overlap accumulates into the 1/N owned shard instead.
+    grad_b = full_param_b / n if grad_sync == "zero1_overlap" else full_param_b
+    # ZeRO-1: optimizer state is partitioned over the sync shards.
+    if grad_sync in ("zero1", "zero1_overlap"):
+        opt_b = opt_b / n
+
+    comps = (
+        ("params", param_b),
+        ("gradients", grad_b),
+        ("optimizer state", opt_b),
+        ("activations", _activation_bytes(model, local_batch, remat,
+                                          remat_policy)),
+        ("logits", _logits_bytes(model, local_batch)),
+        ("collective scratch",
+         _SCRATCH_BUCKETS * grad_bucket_mb * 2.0**20),
+    )
+    return tuple(sorted(comps, key=lambda kv: -kv[1]))
+
+
+# ---------------------------------------------------------------------------
+# Cost-card / roofline measurement basis
+# ---------------------------------------------------------------------------
+
+def _find_step_card(logdir: Optional[str], batch_size: int):
+    """The train/step cost card matching this geometry, if captured."""
+    if not logdir:
+        return None
+    from dtf_tpu.telemetry import costobs
+    try:
+        cards = costobs.read_costcards(logdir)
+    except FileNotFoundError:
+        return None
+    want = ["aot", batch_size]
+    best = None
+    for c in cards:
+        if c.site != "train/step":
+            continue
+        if list(c.geometry) == want or best is None:
+            best = c
+            if list(c.geometry) == want:
+                break
+    return best
+
+
+def _roofline_step_ms(card, mesh) -> float:
+    from dtf_tpu.utils import profiling
+    dev = np.asarray(mesh.devices).flat[0]
+    roof = profiling.chip_roofline(dev)
+    if roof is None or card is None:
+        return 0.0
+    flops = float(card.flops or card.flops_total or 0.0)
+    byts = float(card.bytes_accessed or card.bytes_total or 0.0)
+    if flops <= 0.0 and byts <= 0.0:
+        return 0.0
+    return max(flops / roof.peak_flops, byts / roof.hbm_bytes_per_s) * 1e3
+
+
+def default_hbm_budget(mesh) -> float:
+    """Detected per-device HBM capacity (chip roofline table); the
+    pinned 4 GiB CPU-sim entry keeps tests deterministic off-TPU."""
+    from dtf_tpu.utils import profiling
+    dev = np.asarray(mesh.devices).flat[0]
+    roof = profiling.chip_roofline(dev)
+    if roof is None:
+        return float(profiling.CPU_SIM_ROOFLINE.hbm_capacity_bytes)
+    return float(roof.hbm_capacity_bytes)
+
+
+# ---------------------------------------------------------------------------
+# The planner
+# ---------------------------------------------------------------------------
+
+def _wire_dtype(n_shards: int, pinned: Mapping[str, Any]) -> Optional[str]:
+    if "grad_comm_dtype" in pinned:
+        return pinned["grad_comm_dtype"]
+    # Ring reduce-scatter ships (n-1)/n of the one-shot exchange per
+    # direction; the win over all-to-all int8 only materializes with
+    # enough hops (parallel/quantize.py:ring_wire_elems).
+    if n_shards >= 4:
+        return "int8_ring"
+    if n_shards >= 2:
+        return "int8"
+    return None
+
+
+def make_plan(model, mesh, *, batch_size: int,
+              hbm_budget_bytes: Optional[float] = None,
+              optimizer=None, logdir: Optional[str] = None,
+              pinned: Optional[Mapping[str, Any]] = None) -> ShardingPlan:
+    """Derive the least-intrusive feasible plan for (model, mesh, budget).
+
+    ``pinned`` maps knob name -> user-pinned value (flags the operator
+    set away from their defaults); the planner never overrides a pinned
+    knob — it filters the candidate ladder down to matching rungs and
+    only auto-tunes what was left free.  Raises
+    :class:`PlanInfeasibleError` when nothing fits.
+    """
+    pinned = dict(pinned or {})
+    budget = float(hbm_budget_bytes if hbm_budget_bytes
+                   else default_hbm_budget(mesh))
+    base = (_LADDER_WIDE if sh.data_axis_size(mesh) >= _ZERO1_MIN_AXIS
+            else _LADDER_NARROW)
+    ladder = [c for c in base
+              if pinned.get("grad_sync", c[0]) == c[0]
+              and pinned.get("remat", c[1]) == c[1]
+              and pinned.get("remat_policy", c[2]) == c[2]]
+    if not ladder:
+        # pinned combination not on the ladder: honor it as the only rung
+        ladder = [(pinned.get("grad_sync", "dense"),
+                   bool(pinned.get("remat", False)),
+                   str(pinned.get("remat_policy", "full")))]
+
+    bucket_mb = float(pinned.get("grad_bucket_mb", 4.0))
+    rounding = str(pinned.get("quant_rounding", "nearest"))
+    n = max(1, sh.data_axis_size(mesh))
+
+    chosen = None
+    comps = None
+    for cand in ladder:
+        strat, remat, policy = cand
+        comps = _components(model, mesh, batch_size=batch_size,
+                            grad_sync=strat, grad_bucket_mb=bucket_mb,
+                            remat=remat, remat_policy=policy,
+                            optimizer=optimizer)
+        if sum(b for _, b in comps) <= budget:
+            chosen = cand
+            break
+    if chosen is None:
+        name, biggest = comps[0]
+        raise PlanInfeasibleError(name, biggest,
+                                  sum(b for _, b in comps), budget)
+
+    strat, remat, policy = chosen
+    predicted_hbm = sum(b for _, b in comps)
+    source = "analytic"
+    card = _find_step_card(logdir, batch_size)
+    step_ms = 0.0
+    if card is not None and card.peak_hbm_bytes:
+        # measurement basis: the compile-time memory analysis of the
+        # actual train step beats the closed-form model
+        predicted_hbm = float(card.peak_hbm_bytes)
+        step_ms = _roofline_step_ms(card, mesh)
+        source = "costcards"
+        if predicted_hbm > budget:
+            raise PlanInfeasibleError(comps[0][0], comps[0][1],
+                                      predicted_hbm, budget)
+
+    return ShardingPlan(
+        mesh_axes=tuple((str(a), int(mesh.shape[a]))
+                        for a in mesh.axis_names),
+        hbm_budget_bytes=budget,
+        source=source,
+        grad_sync=strat,
+        grad_bucket_mb=bucket_mb,
+        grad_comm_dtype=_wire_dtype(n, pinned),
+        quant_rounding=rounding,
+        remat=remat,
+        remat_policy=policy,
+        predicted_hbm_bytes=predicted_hbm,
+        predicted_step_ms=step_ms,
+        components=comps,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plan recording + audit (report --explain)
+# ---------------------------------------------------------------------------
+
+def write_plan(logdir: str, plan: ShardingPlan) -> str:
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, PLAN_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(plan.to_doc(), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_plan(logdir: str) -> Optional[ShardingPlan]:
+    path = os.path.join(logdir, PLAN_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return ShardingPlan.from_doc(json.load(f))
+
+
+def audit_lines(logdir: str) -> list:
+    """Predicted-vs-measured audit for ``report --explain``: compares the
+    recorded plan's HBM prediction against the peak the cost observatory
+    measured at compile time.  Empty when the run carried no plan."""
+    plan = read_plan(logdir)
+    if plan is None:
+        return []
+    from dtf_tpu.telemetry import costobs
+    measured = 0.0
+    try:
+        for c in costobs.read_costcards(logdir):
+            if c.site == "train/step" and c.peak_hbm_bytes:
+                measured = max(measured, float(c.peak_hbm_bytes))
+    except FileNotFoundError:
+        pass
+    lines = [f"Plan audit ({logdir})", f"  {plan.summary()}"]
+    lines.append(f"  {'predicted peak HBM':<28} "
+                 f"{plan.predicted_hbm_bytes / 2**20:12.2f} MiB "
+                 f"[{plan.source}]")
+    if measured > 0.0:
+        rel = abs(plan.predicted_hbm_bytes - measured) / measured
+        lines.append(f"  {'measured peak HBM':<28} "
+                     f"{measured / 2**20:12.2f} MiB "
+                     f"(rel err {rel:.1%})")
+    else:
+        lines.append(f"  {'measured peak HBM':<28} "
+                     f"{'(no train/step cost card)':>12}")
+    return lines

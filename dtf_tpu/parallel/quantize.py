@@ -59,10 +59,16 @@ QBLOCK = 256
 ROUNDINGS: Tuple[str, ...] = ("nearest", "stochastic")
 
 #: Effective wire bytes per f32 gradient element, by wire format: int8
-#: pays 1 payload byte + 4/QBLOCK scale bytes.  The telemetry gauges and
-#: the bench A/B both read from here so the accounting cannot drift.
+#: pays 1 payload byte + 4/QBLOCK scale bytes.  ``int8_ring`` ships the
+#: same block format, but fewer ELEMENTS cross the wire (each of the
+#: n-1 hops carries one chunk instead of the all-to-all's n chunks —
+#: see :func:`ring_wire_elems`), so its per-element cost is identical
+#: here and the saving shows up in the element count.  The telemetry
+#: gauges and the bench A/B both read from here so the accounting
+#: cannot drift.
 WIRE_BYTES_PER_ELEM = {"f32": 4.0, "bf16": 2.0,
-                       "int8": 1.0 + 4.0 / QBLOCK}
+                       "int8": 1.0 + 4.0 / QBLOCK,
+                       "int8_ring": 1.0 + 4.0 / QBLOCK}
 
 _TINY = 1e-30   # scale floor: all-zero blocks decode to exact zeros
 
@@ -145,6 +151,17 @@ def wire_elems(length: int, n_shards: int) -> int:
     return n_shards * (-(-chunk // QBLOCK) * QBLOCK)
 
 
+def ring_wire_elems(length: int, n_shards: int) -> int:
+    """Elements shipped by :func:`ring_reduce_scatter_quantized` for the
+    same ``(length,)`` vector: ``n_shards - 1`` hops, each carrying ONE
+    block-padded chunk — ``(n-1)/n`` of :func:`wire_elems`.  This is the
+    multi-hop win the ``comm/wire_bytes`` gauge must show: the all-to-all
+    wire ships every chunk once per device, the ring ships one chunk per
+    hop and the partial sums stay int8 the whole way (EQuARX)."""
+    chunk = length // n_shards
+    return (n_shards - 1) * (-(-chunk // QBLOCK) * QBLOCK)
+
+
 def reduce_scatter_quantized(v: jax.Array, axis: str, *,
                              rounding: str = "nearest",
                              rng: Optional[jax.Array] = None,
@@ -197,6 +214,73 @@ def reduce_scatter_quantized(v: jax.Array, axis: str, *,
     return (out, err) if return_error else out
 
 
+def ring_reduce_scatter_quantized(v: jax.Array, axis: str, *,
+                                  rounding: str = "nearest",
+                                  rng: Optional[jax.Array] = None,
+                                  return_error: bool = False):
+    """Segmented-ring sum-reduce-scatter with **per-hop requantization**
+    (EQuARX, arxiv 2506.17615) — the ``--grad_comm_dtype int8_ring``
+    wire.
+
+    Same contract as :func:`reduce_scatter_quantized` (per-device code;
+    ``v (P,)`` with ``P % axis_size == 0``; rank k returns the f32 SUM
+    of all ranks' chunk k; callers pre-scale by 1/N for a mean), but a
+    different schedule: instead of one all-to-all that ships every chunk
+    once per device (n block-padded chunks on the wire), each rank walks
+    ``n-1`` ``ppermute`` hops around the ring, and EVERY hop re-encodes
+    the running **partial sum** into the block-scaled int8 format before
+    it travels — int8 payload + f32 block scales on every link, never an
+    f32 partial sum.  Total wire: ``(n-1)`` chunks instead of ``n``
+    (:func:`ring_wire_elems`), the multi-hop win on meshes where the
+    reduction actually spans several links.
+
+    The price is ``n-1`` roundings per value instead of one; the error
+    pair (``return_error=True``) accumulates every hop's encode error
+    against that hop's payload energy, so ``comm/quant_error`` reports
+    the TRUE per-hop requantization ladder, not just the first rung.
+    ``stochastic`` rounding folds the hop index into ``rng`` — draws
+    never repeat across hops (or across buckets: the engine already
+    folds the bucket index in), so trajectories stay bitwise
+    reproducible from the step rng."""
+    n = col.axis_size(axis)
+    p = v.shape[0]
+    if p % n:
+        raise ValueError(
+            f"ring_reduce_scatter_quantized: length {p} is not divisible "
+            f"by mesh axis {axis!r} (size {n}); pad the vector upstream "
+            f"(grad_sync's bucket layout does this)")
+    if n == 1:
+        return (v, jnp.zeros((2,), jnp.float32)) if return_error else v
+    chunk = p // n
+    padded = -(-chunk // QBLOCK) * QBLOCK
+    buf = v.reshape(n, chunk)
+    if padded != chunk:
+        buf = jnp.pad(buf, ((0, 0), (0, padded - chunk)))
+    me = lax.axis_index(axis)
+    fwd = col.ring_neighbors(n)
+    err = jnp.zeros((2,), jnp.float32)
+    # hop s: rank me ships its partial sum of chunk (me-1-s) mod n to
+    # rank me+1 and folds in the payload arriving from rank me-1.  After
+    # n-1 hops rank me holds the full sum of chunk me — the tiled
+    # reduce-scatter ownership, so the all-gather leg needs no reindex.
+    for s in range(n - 1):
+        send_idx = (me - 1 - s) % n
+        recv_idx = (me - 2 - s) % n     # = sender (me-1)'s send_idx
+        payload = jnp.take(buf, send_idx, axis=0)
+        hop_rng = (jax.random.fold_in(rng, s) if rng is not None
+                   and rounding == "stochastic" else None)
+        q, scale = encode(payload, rounding, hop_rng)
+        if return_error:
+            e = decode(q, scale) - payload
+            err = err + jnp.stack([jnp.sum(e * e),
+                                   jnp.sum(payload * payload)])
+        q = lax.ppermute(q, axis, fwd)
+        scale = lax.ppermute(scale, axis, fwd)
+        buf = buf.at[recv_idx].add(decode(q, scale))
+    out = jnp.take(buf, me, axis=0)[:chunk]
+    return (out, err) if return_error else out
+
+
 def all_gather_quantized(shard: jax.Array, axis: str) -> jax.Array:
     """Block-quantized all-gather of an f32 shard ``(m,)`` -> full
     ``(n*m,)`` f32 in mesh-axis order (any ``m``; the shard pads to
@@ -242,19 +326,24 @@ def _flatten_tree(tree: Any, quantum: int):
 
 def all_reduce_mean_quantized(tree: Any, axis: str, *,
                               rounding: str = "nearest",
-                              rng: Optional[jax.Array] = None):
+                              rng: Optional[jax.Array] = None,
+                              ring: bool = False):
     """Mean-all-reduce of a gradient pytree with the block-scaled int8
-    wire — the DENSE strategy's ``--grad_comm_dtype int8`` path.
+    wire — the DENSE strategy's ``--grad_comm_dtype int8`` path
+    (``ring=True``: the ``int8_ring`` path, per-hop requantizing
+    reduce-scatter instead of the one-shot all-to-all).
 
     Per-device code: flatten -> pre-scale by 1/N (mean-preserving) ->
     quantized reduce-scatter -> quantized all-gather -> unflatten.  Two
-    roundings per value total (one per wire leg); the gather leg is
+    roundings per value total on the all-to-all wire (one per wire leg;
+    the ring pays one per hop on the scatter leg instead — see
+    :func:`ring_reduce_scatter_quantized`); the gather leg is
     deterministic so all replicas hold bitwise-identical means.  Returns
     ``(mean_tree, error_pair)`` — the error pair is the local scatter-leg
     encode error (psum it across the axis before reporting)."""
     n = col.axis_size(axis)
     flat, unflatten = _flatten_tree(tree, n)
-    shard, err = reduce_scatter_quantized(
-        flat * (1.0 / n), axis, rounding=rounding, rng=rng,
-        return_error=True)
+    rs = ring_reduce_scatter_quantized if ring else reduce_scatter_quantized
+    shard, err = rs(flat * (1.0 / n), axis, rounding=rounding, rng=rng,
+                    return_error=True)
     return unflatten(all_gather_quantized(shard, axis)), err

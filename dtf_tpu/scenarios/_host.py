@@ -277,6 +277,7 @@ def main(spec_json: str, task: int, nproc: int, shared: str,
         log_frequency=spec.log_frequency, seed=spec.seed, logdir=logdir,
         checkpoint_every=spec.checkpoint_every,
         grad_sync=spec.grad_sync, grad_bucket_mb=spec.grad_bucket_mb,
+        grad_comm_dtype=spec.grad_comm_dtype, plan=spec.plan,
         # Elastic relaunch rounds are FRESH processes: they re-read the
         # persistent compile cache instead of re-paying the backend
         # compile (the PR-4 machinery).  Per-TASK dir, not per-cell:

@@ -173,6 +173,10 @@ def _measured(report: dict) -> dict:
         "restarts": metric("supervisor/restarts_total") or 0,
         "faults_fired": metric("chaos/faults_fired_total") or 0,
         "attempts": report.get("attempts"),
+        # gradient wire (ISSUE 19; absent when comm never instrumented):
+        # what max_wire_bytes_per_step gates, plus the ring hop count
+        "wire_bytes_per_step": metric("comm/wire_bytes"),
+        "grad_hops": metric("comm/hops"),
         # serving cells (absent for training cells)
         "goodput_qps": serving.get("goodput_qps"),
         "ttft_ms_p99": serving.get("ttft_ms_p99"),

@@ -88,6 +88,12 @@ class Gate:
     max_skew_ms: float = 0.0
     min_fleet_goodput: float = 0.0
     max_blame_frac: float = 0.0
+    #: Gradient-wire gate (ISSUE 19; 0 = not armed) — ceiling on the
+    #: per-step scatter-leg wire payload (``comm/wire_bytes``).  The
+    #: int8_ring cell pins it between the ring wire and the one-shot
+    #: int8 wire, so a run that silently fell back to a fatter wire
+    #: fails even when it converges; an absent gauge fails too.
+    max_wire_bytes_per_step: float = 0.0
     #: Incident gate (ISSUE 18, telemetry/anomaly.py + diagnose.py;
     #: 0 = not armed) — chaos-bearing cells arm it so the incident
     #: plane is judged END TO END: the injected fault must be DETECTED
@@ -130,6 +136,8 @@ class Gate:
             out["max_blame_frac"] = self.max_blame_frac
         if self.min_attribution_frac > 0:
             out["min_attribution_frac"] = self.min_attribution_frac
+        if self.max_wire_bytes_per_step > 0:
+            out["max_wire_bytes_per_step"] = self.max_wire_bytes_per_step
         return out
 
 
@@ -159,6 +167,14 @@ class ScenarioSpec:
     learning_rate: float = 1e-3
     grad_sync: str = "dense"
     grad_bucket_mb: float = 0.1
+    #: Gradient wire dtype (None = exact f32; "int8_ring" = the EQuARX
+    #: per-hop requantizing ring, ISSUE 19) — forwarded verbatim to
+    #: TrainConfig.grad_comm_dtype.
+    grad_comm_dtype: Optional[str] = None
+    #: "auto" hands the cell's sharding knobs to the planner
+    #: (parallel/planner.py); hand-set spec fields remain the override,
+    #: exactly like CLI flags under ``--plan auto``.
+    plan: Optional[str] = None
     checkpoint_every: int = 5
     max_restarts: int = 2
     log_frequency: int = 5
@@ -472,6 +488,31 @@ def default_matrix() -> List[ScenarioSpec]:
                       min_goodput_qps=12.0, max_ttft_p99_ms=1000.0,
                       max_tpot_p99_ms=45.0, max_control_rollbacks=1,
                       min_attribution_frac=0.99)),
+        ScenarioSpec(
+            # Pod-gradient cell (ISSUE 19): --plan auto on the 8-way
+            # mesh (the planner derives zero1 + no-remat; the cell name
+            # pins the expectation) with the EQuARX int8_ring wire and
+            # a mid-run preemption, so checkpoint restore replays under
+            # a PLANNED config.  Judged on the triple gate PLUS the
+            # wire-bytes ceiling: the bound sits between the ring
+            # scatter leg and the one-shot int8 wire, so a silent
+            # fallback to any fatter wire fails even if the run
+            # converges.  Convergence target pinned for PARITY with the
+            # measured dense/f32 oracle (same cell, no plan, exact
+            # wire): oracle final 0.1337, int8_ring final 0.1338 (per-
+            # hop requant noise ~6e-5 on this trajectory) — the 0.45
+            # target sits far under the early-step cost and holds for
+            # both, so the planned+quantized cell is judged against the
+            # exact path's bar, not a softened one.
+            # measured: goodput 0.10-0.14, 6.1k-7.3k ex/s, wire
+            # 72800 B/step (one-shot int8: 81120; f32: ~318 kB).
+            name="mnist_zero1_int8_ring", workload="mnist",
+            devices=8, steps=40, batch_size=256, learning_rate=1e-3,
+            plan="auto", grad_comm_dtype="int8_ring",
+            chaos="preempt@11,seed=7", max_restarts=2,
+            gate=Gate(max_final_cost=0.45, min_goodput=0.04,
+                      min_examples_per_s=1500.0, max_rollbacks=0,
+                      max_wire_bytes_per_step=76000.0)),
         ScenarioSpec(
             # large-batch cell: LAMB under ZeRO-1 (trust-ratio norms
             # psum'd across shards) on the 8-way mesh, with a nan spike

@@ -64,6 +64,13 @@ METRICS = (
     "comm/bucket_count",
     "comm/optimizer_state_bytes", # measured per-device opt-state HBM
     "comm/grad_sync_s",           # isolated sync+update time (bench A/B)
+    "comm/hops",                  # RS hops per round (int8_ring: n-1)
+    # sharding planner (parallel/planner.py): predicted-vs-measured audit
+    "plan/active",                # 1 iff a --plan auto plan drove the run
+    "plan/predicted_hbm_bytes",   # planner's per-device peak-HBM claim
+    "plan/predicted_step_ms",     # planner's step-time claim (0 = no card)
+    "plan/source_idx",            # index into planner.PLAN_SOURCES
+    "plan/hbm_budget_bytes",      # the budget the plan was solved against
     "checkpoint/save_ms",
     "checkpoint/saves_total",
     "checkpoint/restores_total",

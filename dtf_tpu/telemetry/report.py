@@ -6,6 +6,10 @@
         # step-time regression explainer: phase-by-phase + card-by-card
         # diff of two runs' cost observatories (telemetry/costobs.py),
         # ranked attribution of byte/flop growth per compile site
+    python -m dtf_tpu.telemetry.report <logdir> --explain
+        # single-logdir form: just the sharding-plan audit — the
+        # recorded plan.json's predicted peak HBM vs the peak the cost
+        # observatory measured (parallel/planner.py)
 
 Merges ``telemetry.json`` (goodput books + instrument snapshot),
 ``metrics.csv`` (attempt-deduplicated), ``spans.p*.jsonl``,
@@ -223,6 +227,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_hbm_frac: Optional[float] = None,
                 max_compiles: Optional[float] = None,
                 min_attribution_frac: Optional[float] = None,
+                max_wire_bytes_per_step: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -290,7 +295,14 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       not-measured = FAIL: injected-but-undetected is the detector's
       falsifiability failure, not a calm run.  Without chaos, attributed
       means 'has at least one suspect', and zero anomalies passes
-      vacuously (frac 1.0) — the chaos-off twin's contract.
+      vacuously (frac 1.0) — the chaos-off twin's contract;
+    * ``max_wire_bytes_per_step`` — the GRADIENT-WIRE gate (ISSUE 19):
+      ceiling on the ``comm/wire_bytes`` gauge (per-device scatter-leg
+      payload per step).  The int8_ring scenario cell pins it between
+      the ring wire and the one-shot int8 wire, so a run that silently
+      fell back to a fatter wire (one-shot int8, bf16, f32) fails even
+      if it converges.  No absent-gauge default: a run that never
+      recorded its wire (no grad-sync path armed) FAILS.
     """
     lines: List[str] = []
     ok = True
@@ -385,6 +397,11 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = report.get("incidents", {}).get("attribution_frac")
         gate("min_attribution_frac", None if v is None else float(v),
              min_attribution_frac, at_most=False)
+    if max_wire_bytes_per_step is not None:
+        # no default: an absent gauge = no gradient wire measured = FAIL
+        gate("max_wire_bytes_per_step",
+             _metric_value(report, "comm/wire_bytes"),
+             max_wire_bytes_per_step, at_most=True)
     return ok, lines
 
 
@@ -462,7 +479,7 @@ def render(report: dict, top: int = 10) -> str:
         if idx is not None and 0 <= int(idx) < len(strategies):
             lines.append(f"  {'strategy':<28} {strategies[int(idx)]:>12}")
         # mirror of grad_sync.WIRE_DTYPES (same jax-free pinning rule)
-        wire_dtypes = ("f32", "bf16", "int8")
+        wire_dtypes = ("f32", "bf16", "int8", "int8_ring")
         widx = comm.pop("comm/wire_dtype_idx", None)
         if widx is not None and 0 <= int(widx) < len(wire_dtypes):
             lines.append(f"  {'wire dtype':<28} "
@@ -778,7 +795,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "card (costcards.jsonl) and print a ranked "
                         "attribution — which site/geometry grew, in "
                         "bytes or flops, and whether the growth is "
-                        "memory- or compute-bound")
+                        "memory- or compute-bound; with ONE logdir, "
+                        "print just its sharding-plan audit (plan.json "
+                        "predicted vs measured peak HBM)")
     p.add_argument("--diagnose", action="store_true",
                    help="incident post-mortem (telemetry/diagnose.py): "
                         "correlate every anomaly/* instant against the "
@@ -860,6 +879,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "chaos evidence only a TOP-ranked chaos suspect "
                         "counts; chaos fired with zero anomalies = not "
                         "measured = FAIL (injected-but-undetected)")
+    p.add_argument("--max_wire_bytes_per_step", type=float, default=None,
+                   help="gradient-wire gate: ceiling on the per-step "
+                        "scatter-leg wire payload (comm/wire_bytes; not "
+                        "measured = FAIL) — pins a quantized-ring run to "
+                        "its thin wire so a silent fallback to a fatter "
+                        "dtype fails loud")
     p.add_argument("--request", type=int, default=None, metavar="RID",
                    help="print ONE request's causally-ordered timeline "
                         "(reqtrace events + the engine iterations that "
@@ -875,7 +900,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if ns.explain:
         from dtf_tpu.telemetry import costobs
-        if ns.logdir_b is None or not os.path.isdir(ns.logdir_b):
+        if ns.logdir_b is None:
+            # Single-logdir --explain: just the sharding-plan audit
+            # (parallel/planner.py) — predicted peak HBM vs the peak the
+            # cost observatory measured.  The A/B cost explainer still
+            # takes two runs.
+            from dtf_tpu.parallel import planner as _planner
+            audit = _planner.audit_lines(ns.logdir)
+            if not audit:
+                print("error: --explain with one logdir needs a recorded "
+                      "plan.json (run with --plan auto); the A/B cost "
+                      "explainer takes TWO logdirs "
+                      "(report --explain <logdir_a> <logdir_b>)",
+                      file=sys.stderr)
+                return 2
+            for line in audit:
+                print(line)
+            return 0
+        if not os.path.isdir(ns.logdir_b):
             print("error: --explain takes TWO logdirs "
                   "(report --explain <logdir_a> <logdir_b>)",
                   file=sys.stderr)
@@ -892,6 +934,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             for line in costobs.render_explain(doc, top=ns.top):
                 print(line)
+            # Sharding-plan audit (parallel/planner.py): when either run
+            # recorded a plan.json, show its predicted peak HBM against
+            # the peak the cost observatory measured — the planner's
+            # predictions are auditable, not write-only.
+            from dtf_tpu.parallel import planner as _planner
+            for d in (ns.logdir, ns.logdir_b):
+                audit = _planner.audit_lines(d)
+                if audit:
+                    print()
+                    for line in audit:
+                        print(line)
         return 0
     if ns.logdir_b is not None:
         print("error: a second logdir only makes sense with --explain",
@@ -960,7 +1013,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_blame_frac": ns.max_blame_frac,
                   "max_hbm_frac": ns.max_hbm_frac,
                   "max_compiles": ns.max_compiles,
-                  "min_attribution_frac": ns.min_attribution_frac}
+                  "min_attribution_frac": ns.min_attribution_frac,
+                  "max_wire_bytes_per_step": ns.max_wire_bytes_per_step}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -284,6 +284,18 @@ class CheckpointManager:
                     "noise, not regressions", step,
                     saved_run["grad_comm_dtype"],
                     cur_run["grad_comm_dtype"])
+            if (saved_run.get("plan") != cur_run.get("plan")
+                    and (saved_run.get("plan") is not None
+                         or cur_run.get("plan") is not None)):
+                # A planned<->manual transition (or a re-plan that chose
+                # different knobs) changes the whole gradient path at
+                # once; the attribution line names both sides.
+                log.warning(
+                    "plan restore: checkpoint step %d was saved under "
+                    "plan %s, resuming under %s — the sharding plan "
+                    "changed across the restore", step,
+                    saved_run.get("plan") or "(manual)",
+                    cur_run.get("plan") or "(manual)")
 
     def verify(self, step: int) -> tuple[bool, str]:
         """Check a landed step against its manifest.  (True, reason) means

@@ -500,17 +500,21 @@ def make_train_step(loss_fn: Callable, optimizer: optim_lib.Optimizer,
                     g = jax.tree_util.tree_map(
                         lambda v: quantized_ring_all_reduce_mean(
                             v, data_axes[0]), grads)
-                elif _dense_comm_dtype == "int8":
+                elif _dense_comm_dtype in ("int8", "int8_ring"):
                     # Block-scaled int8 wire for the DENSE strategy
                     # (parallel/quantize.py): quantized reduce-scatter +
                     # quantized all-gather over the whole flattened tree,
                     # mean-preserving 1/N pre-scale, two roundings per
-                    # value.  The local encode error psums into the
-                    # replica-uniform quant_error metric.
+                    # value ("int8_ring" schedules the scatter as the
+                    # per-hop requantizing segmented ring instead — n-1
+                    # roundings, (n-1)/n the wire).  The local encode
+                    # error psums into the replica-uniform quant_error
+                    # metric.
                     from dtf_tpu.parallel import quantize as qz
                     g, qe = qz.all_reduce_mean_quantized(
                         grads, data_axes[0], rounding=quant_rounding,
-                        rng=jax.random.fold_in(rng, _QSALT))
+                        rng=jax.random.fold_in(rng, _QSALT),
+                        ring=_dense_comm_dtype == "int8_ring")
                     aux = dict(aux)
                     aux["quant_error"] = qz.error_ratio(
                         lax.psum(qe, data_axes[0]))
@@ -738,6 +742,58 @@ class Trainer:
         # schedules interleave fwd/bwd and cannot be expressed as jax.grad
         # of a forward pass) expose custom_grads_fn.
         grads_fn = getattr(self.model, "custom_grads_fn", None)
+        # Sharding planner (parallel/planner.py): --plan auto derives the
+        # gradient-path knobs the operator left FREE (strategy, wire
+        # dtype, bucket size, remat, activation sharding) from the model
+        # template + mesh + HBM budget.  Pinned flags — any knob set away
+        # from its TrainConfig default — always win; the planner only
+        # fills in the rest.  Infeasible (model, budget) pairs raise
+        # PlanInfeasibleError here, BEFORE any compile.
+        self._plan = None
+        if self.cfg.plan == "auto":
+            import dataclasses as _dc
+            from dtf_tpu.parallel import planner as _planner
+            _defaults = {f.name: f.default
+                         for f in _dc.fields(type(self.cfg))}
+            pinned = {k: getattr(self.cfg, k)
+                      for k in ("grad_sync", "grad_comm_dtype",
+                                "grad_bucket_mb", "quant_rounding")
+                      if getattr(self.cfg, k) != _defaults.get(k)}
+            _mcfg = getattr(self.model, "cfg", None)
+            if _mcfg is not None and getattr(_mcfg, "remat", False):
+                pinned["remat"] = True
+                pinned["remat_policy"] = getattr(_mcfg, "remat_policy",
+                                                 "full")
+            plan = _planner.make_plan(
+                self.model, mesh, batch_size=self.cfg.batch_size,
+                hbm_budget_bytes=(self.cfg.plan_hbm_gb * 2.0**30
+                                  if self.cfg.plan_hbm_gb else None),
+                optimizer=self.optimizer,
+                logdir=(self.cfg.logdir
+                        if self.cfg.telemetry and self.cfg.logdir
+                        else None),
+                pinned=pinned)
+            self._plan = plan
+            self.cfg = _dc.replace(
+                self.cfg, grad_sync=plan.grad_sync,
+                grad_comm_dtype=plan.grad_comm_dtype,
+                grad_bucket_mb=plan.grad_bucket_mb,
+                quant_rounding=plan.quant_rounding)
+            if _mcfg is not None and hasattr(_mcfg, "remat"):
+                _mcfg.remat = plan.remat
+                _mcfg.remat_policy = plan.remat_policy
+            # Activation sharding constraint (models honoring
+            # act_sharding pin the (B, T, D) batch dim to the data axes,
+            # suppressing SPMD's involuntary full rematerialization).
+            if (_mcfg is not None and hasattr(_mcfg, "act_sharding")
+                    and _mcfg.act_sharding is None):
+                _mcfg.act_sharding = plan.activation_sharding(mesh)
+            import logging as _logging
+            _logging.getLogger("dtf_tpu").info(plan.summary())
+            if self.cfg.telemetry and self.cfg.logdir:
+                # recorded for report --explain's predicted-vs-measured
+                # audit after the run captures cost cards
+                _planner.write_plan(self.cfg.logdir, plan)
         # Gradient-sync strategy (parallel/grad_sync.py): zero1 strategies
         # are hand-scheduled shard_map code, so they run the explicit step
         # — an implicit-mode request auto-switches rather than failing
@@ -819,6 +875,7 @@ class Trainer:
             tel.gauge("comm/grad_sync_bytes").set(stats["grad_sync_bytes"])
             tel.gauge("comm/wire_bytes").set(stats["wire_bytes"])
             tel.gauge("comm/bucket_count").set(stats["bucket_count"])
+            tel.gauge("comm/hops").set(stats["hops"])
         else:
             # Dense: the pmean/all-reduce payload is the full gradient
             # tree at the wire format's bytes-per-element.
@@ -826,25 +883,47 @@ class Trainer:
                 np.prod(l.shape)
                 for l in jax.tree_util.tree_leaves(self.state["params"])))
             resolved = comm_dtype_of(self.cfg.grad_comm_dtype)
-            if resolved == "int8":
+            n_dev = sh.data_axis_size(mesh)
+            if resolved in ("int8", "int8_ring"):
                 # all_reduce_mean_quantized ships TWO quantized legs
                 # (reduce-scatter + all-gather), each with per-chunk
                 # block round-up — mirror zero1's split: wire_bytes is
-                # the gradient scatter leg, grad_sync_bytes adds the
-                # gather leg (here quantized too, unlike zero1's f32
-                # param gather).
+                # the gradient scatter leg (the ring wire ships n-1
+                # chunks instead of n — quantize.ring_wire_elems),
+                # grad_sync_bytes adds the gather leg (here quantized
+                # too, unlike zero1's f32 param gather; the gather is
+                # one-shot on both wires).
                 from dtf_tpu.parallel import quantize as qz
-                n_dev = sh.data_axis_size(mesh)
                 flat = -(-n_elems // n_dev) * n_dev   # _flatten_tree pad
-                leg = float(qz.wire_elems(flat, n_dev)
-                            * qz.WIRE_BYTES_PER_ELEM["int8"])
-                tel.gauge("comm/grad_sync_bytes").set(2.0 * leg)
-                tel.gauge("comm/wire_bytes").set(leg)
+                elems = (qz.ring_wire_elems if resolved == "int8_ring"
+                         else qz.wire_elems)
+                scatter_leg = float(elems(flat, n_dev)
+                                    * qz.WIRE_BYTES_PER_ELEM["int8"])
+                gather_leg = float(qz.wire_elems(flat, n_dev)
+                                   * qz.WIRE_BYTES_PER_ELEM["int8"])
+                tel.gauge("comm/grad_sync_bytes").set(
+                    scatter_leg + gather_leg)
+                tel.gauge("comm/wire_bytes").set(scatter_leg)
             else:
                 wire = float(n_elems) * wire_bytes_per_elem(resolved)
                 tel.gauge("comm/grad_sync_bytes").set(wire)
                 tel.gauge("comm/wire_bytes").set(wire)
             tel.gauge("comm/bucket_count").set(0)
+            tel.gauge("comm/hops").set(
+                n_dev - 1 if resolved == "int8_ring" else 1)
+        # Planner instruments: 0/absent when --plan is off, so the gate
+        # "plan/active == 1" can assert a run actually planned itself.
+        if self._plan is not None:
+            from dtf_tpu.parallel.planner import PLAN_SOURCES
+            tel.gauge("plan/active").set(1)
+            tel.gauge("plan/source_idx").set(
+                PLAN_SOURCES.index(self._plan.source))
+            tel.gauge("plan/predicted_hbm_bytes").set(
+                self._plan.predicted_hbm_bytes)
+            tel.gauge("plan/predicted_step_ms").set(
+                self._plan.predicted_step_ms)
+            tel.gauge("plan/hbm_budget_bytes").set(
+                self._plan.hbm_budget_bytes)
         # Model-structure graph to TensorBoard, once at startup — the
         # reference's writer.add_graph (tf_distributed.py:97).
         self.logger.graph(self.state["params"],
@@ -871,11 +950,16 @@ class Trainer:
                 run_meta={"grad_sync": self.cfg.grad_sync,
                           "data_axis": sh.data_axis_size(mesh),
                           "grad_bucket_mb": self.cfg.grad_bucket_mb,
-                          # canonical spelling ("f32"|"bf16"|"int8"), so
-                          # "bfloat16" vs "bf16" can't fake a wire change
-                          # in the restore warning
+                          # canonical spelling ("f32"|"bf16"|"int8"|
+                          # "int8_ring"), so "bfloat16" vs "bf16" can't
+                          # fake a wire change in the restore warning
                           "grad_comm_dtype": wire_dtype_name(
-                              comm_dtype_of(self.cfg.grad_comm_dtype))})
+                              comm_dtype_of(self.cfg.grad_comm_dtype)),
+                          # planned runs additionally record the plan's
+                          # provenance, so restore_robust logs a planned
+                          # <-> manual (or re-planned) transition
+                          **({"plan": self._plan.summary()}
+                             if self._plan is not None else {})})
             if self.cfg.resume:
                 with tracker.measure("checkpoint"):
                     if self._chaos is not None:

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Perf-regression ledger: fold the loose ``BENCH_r*.json`` /
-``MULTICHIP_r*.json`` / ``DECODE_r*.json`` round files into one
-machine-readable ``LEDGER.jsonl`` — one row per run with rig, commit,
-the rig's headline metric (TFLOP/s for matmul rounds, aggregate
-tokens/s for decode-ladder rounds), MFU (roofline fraction) and, for
-failed rounds, the error + stage.
+``MULTICHIP_r*.json`` / ``DECODE_r*.json`` / ``PLAN_r*.json`` round
+files into one machine-readable ``LEDGER.jsonl`` — one row per run with
+rig, commit, the rig's headline metric (TFLOP/s for matmul rounds,
+aggregate tokens/s for decode-ladder rounds, wire-byte reduction for
+plan_ab rounds), MFU (roofline fraction) and, for failed rounds, the
+error + stage.
 
 The round files alone hide the trajectory: r01-r02 held ~193 TFLOP/s at
 ~98% of roofline, then r03-r05 all died on ``tpu_unavailable`` relay
@@ -167,6 +168,40 @@ def decode_row(path: str, repo: str) -> dict:
     return row
 
 
+def plan_row(path: str, repo: str) -> dict:
+    """PLAN_r*.json: one ``bench.breakdown --plan_ab`` doc (plus an
+    ``n`` round index).  Headline metric = ``wire_reduction`` (fraction
+    of scatter-leg wire bytes the planned cell shaves off the PR-6
+    pinned cell; higher is better); ok = the doc's triple gate (wire
+    win AND step time within tolerance AND HBM prediction within
+    tolerance), and the failing leg lands in ``stage``."""
+    with open(path) as f:
+        doc = json.load(f)
+    run = os.path.splitext(os.path.basename(path))[0]
+    ok = bool(doc.get("ok"))
+    auto = doc.get("plan_auto") or {}
+    row = {
+        "run": run,
+        "kind": "plan",
+        "n": doc.get("n", _run_index(run)),
+        "commit": _added_commit(repo, os.path.basename(path)),
+        "rig": doc.get("rig") or f"plan_{doc.get('data_axis')}dev",
+        "wire_reduction": (float(doc["wire_reduction"])
+                           if doc.get("wire_reduction") is not None
+                           else None),
+        "step_time_ratio": doc.get("step_time_ratio"),
+        "hbm_prediction_rel_err": auto.get("hbm_prediction_rel_err"),
+        "ok": ok,
+        "error": None if ok else "plan_ab_gate_failed",
+        "stage": None if ok else (
+            "wire" if not doc.get("wire_win")
+            else "step_time" if not doc.get("step_time_ok")
+            else "hbm_prediction"),
+    }
+    _fold_cost_columns(row, doc)
+    return row
+
+
 def _run_index(run: str) -> "int | None":
     m = re.search(r"_r(\d+)$", run)
     return int(m.group(1)) if m else None
@@ -180,6 +215,8 @@ def build_ledger(repo: str) -> "list[dict]":
         rows.append(multichip_row(path, repo))
     for path in sorted(glob.glob(os.path.join(repo, "DECODE_r*.json"))):
         rows.append(decode_row(path, repo))
+    for path in sorted(glob.glob(os.path.join(repo, "PLAN_r*.json"))):
+        rows.append(plan_row(path, repo))
     # one stream, ordered (kind, round) so the per-rig trajectory reads
     # top to bottom
     rows.sort(key=lambda r: (r["kind"], r["n"] if r["n"] is not None
@@ -278,7 +315,8 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
     """The regression gate ``bench.py --check-ledger`` runs.
 
     Per rig and kind (bench rows gate TFLOP/s, decode rows gate
-    aggregate tokens/s; multichip rows are pass/fail dryruns): the
+    aggregate tokens/s, plan rows gate the plan_ab wire-byte
+    reduction; multichip rows are pass/fail dryruns): the
     NEWEST green run must hold at least ``(1 - tol) x`` the best of
     the EARLIER green runs on that rig.  A trailing streak of error rows
     (the stalled r03-r05 shape) prints loud as a warning — an outage is
@@ -287,6 +325,8 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
     ok = _gate_kind(rows, "bench", "tflops_per_chip", "TFLOP/s",
                     tol_pct, lines)
     ok = _gate_kind(rows, "decode", "tok_s_aggregate", "tok/s",
+                    tol_pct, lines) and ok
+    ok = _gate_kind(rows, "plan", "wire_reduction", "wire-frac",
                     tol_pct, lines) and ok
     return ok, lines
 

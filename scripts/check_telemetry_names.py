@@ -60,7 +60,13 @@ def check_runtime_guard() -> list:
                   # names only (anomaly/* is a SPAN pattern for the
                   # onset instants, but instruments outside the three
                   # exact counters must fail at registration)
-                  "incident/definitely_not_declared"):
+                  "incident/definitely_not_declared",
+                  # the sharding-planner family (ISSUE 19) and the comm/*
+                  # gradient-wire gauges are exact-name declarations — a
+                  # typo'd plan/comm instrument must fail at
+                  # registration, not silently skip the plan audit
+                  "plan/definitely_not_declared",
+                  "comm/definitely_not_declared"):
         try:
             reg.counter(probe)
         except ValueError:
@@ -99,7 +105,15 @@ def check_runtime_guard() -> list:
                  "cost/cards",                     # exact (cost family)
                  "fleet/replicas_up",              # exact (serving fleet)
                  "control/knob_spec_k",            # pattern control/knob_*
-                 "serve/kv_pool_frac"):            # exact (kv gauges)
+                 "serve/kv_pool_frac",             # exact (kv gauges)
+                 # the pod-gradient path (ISSUE 19): ring-hop accounting
+                 # and the planner's predicted-vs-measured audit gauges
+                 "comm/hops",
+                 "plan/active",
+                 "plan/predicted_hbm_bytes",
+                 "plan/predicted_step_ms",
+                 "plan/source_idx",
+                 "plan/hbm_budget_bytes"):
         try:
             reg.gauge(name)
         except ValueError as exc:

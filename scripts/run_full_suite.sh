@@ -1129,5 +1129,113 @@ PYEOF
     || { FAILS=$((FAILS + 1)); echo "FAILED: chaos-off twin detected anomalies"; }
   rm -rf "$idir"
 fi
+# Pod-gradient lane (DESIGN.md §4.3, ISSUE 19): (1) the sharding
+# planner's A/B acceptance — `breakdown --plan_ab` on the 8-way sim
+# mesh must show --plan auto (zero1 + int8_ring) shipping STRICTLY
+# fewer wire bytes than the PR-6 pinned dense one-shot-int8 cell, with
+# step time no worse (<= 1.10x) and the planner's peak-HBM prediction
+# within 5% of the compile-time measurement — the CLI itself exits 1
+# when any leg fails, and the JSON is re-asserted here leg by leg;
+# (2) the int8_ring wire's per-hop requantization must keep the LM
+# loss trajectory inside the pinned envelope (bench.int8_quality
+# --trajectory); (3) the mnist_zero1_int8_ring scenario cell — a
+# SIGTERM-preempted supervised --plan auto run on 8 devices — must
+# pass its triple gate + the armed wire-bytes ceiling, and the SAME
+# logdir must feed the report CLI: the explicit
+# --max_wire_bytes_per_step gate green at the committed 76 kB ceiling
+# but RED at an absurd 1-byte one (falsifiability twin), and the
+# single-logdir `report --explain` plan audit showing predicted vs
+# measured peak HBM from the recorded plan.json.  Skip with
+# NO_PODGRADIENT_LANE=1.
+if [ "${NO_PODGRADIENT_LANE:-0}" != "1" ]; then
+  echo "=== pod-gradient lane (plan_ab A/B + ring trajectory envelope + chaos'd plan-auto cell) ==="
+  pgdir=$(mktemp -d)
+  # (1) planner A/B: exit 1 unless wire_win && step_time_ok && hbm ok
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.breakdown --plan_ab \
+      --ab_steps 12 --simulated_devices 8 \
+      > "$pgdir/plan_ab.json" 2>"$pgdir/plan_ab.err"
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: breakdown --plan_ab (rc=$rc)"; tail -5 "$pgdir/plan_ab.err"; cat "$pgdir/plan_ab.json"; }
+  python - "$pgdir/plan_ab.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["ok"], (doc["wire_win"], doc["step_time_ratio"],
+                   doc["hbm_prediction_ok"])
+auto, pinned = doc["plan_auto"], doc["pinned"]
+assert doc["wire_win"] and doc["wire_bytes_ratio"] < 1.0, doc["wire_bytes_ratio"]
+assert doc["step_time_ratio"] <= 1.0 + doc["step_time_tol_pct"] / 100.0
+assert auto["grad_sync"] == "zero1", auto["grad_sync"]
+assert auto["grad_comm_dtype"] == "int8_ring", auto["grad_comm_dtype"]
+# hop-aware wire accounting: the ring pays n-1 hops, the one-shot pays 1
+assert auto["hops"] == doc["data_axis"] - 1 and pinned["hops"] == 1, \
+    (auto["hops"], pinned["hops"])
+assert auto["hbm_prediction_rel_err"] <= doc["max_hbm_prediction_rel_err"]
+print(f"plan_ab OK: wire {pinned['wire_bytes_per_step']:.0f} -> "
+      f"{auto['wire_bytes_per_step']:.0f} B/step "
+      f"(-{1 - doc['wire_bytes_ratio']:.1%}), step time ratio "
+      f"{doc['step_time_ratio']:.3f}, HBM prediction rel err "
+      f"{auto['hbm_prediction_rel_err']:.1%}")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: plan_ab leg assertions (rc=$rc)"; }
+  # (2) per-hop requantization quality: trajectory inside the envelope
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.int8_quality --trajectory \
+      --simulated_devices 8 --grad_comm_dtype int8_ring \
+      | tee "$pgdir/traj.log"
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: int8_ring trajectory run (rc=$rc)"; }
+  grep -q "data axis 8" "$pgdir/traj.log" \
+    && grep -q "wire=int8_ring" "$pgdir/traj.log" \
+    && grep -q "within envelope: YES" "$pgdir/traj.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: int8_ring trajectory outside the pinned envelope"; }
+  # (3) the chaos'd plan-auto scenario cell, then the report CLI over
+  # the cell's own logdir
+  JAX_PLATFORMS=cpu python -m dtf_tpu.scenarios \
+      --only mnist_zero1_int8_ring --out "$pgdir/sc" --check \
+      > "$pgdir/sc.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: mnist_zero1_int8_ring cell --check (rc=$rc)"; tail -20 "$pgdir/sc.log"; }
+  grep -q "scenario check: OK" "$pgdir/sc.log" \
+    && grep -q "gate max_wire_bytes_per_step: OK" "$pgdir/sc.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: cell gate lines missing"; }
+  pglogs="$pgdir/sc/work/mnist_zero1_int8_ring/logs"
+  python -m dtf_tpu.telemetry.report "$pglogs" \
+      --max_wire_bytes_per_step 76000 > "$pgdir/gate.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: wire-bytes gate on cell logdir (rc=$rc)"; tail -5 "$pgdir/gate.log"; }
+  grep -q "gate max_wire_bytes_per_step: OK" "$pgdir/gate.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: wire gate line missing"; }
+  # falsifiability: a 1-byte ceiling must FAIL the same logdir
+  python -m dtf_tpu.telemetry.report "$pglogs" \
+      --max_wire_bytes_per_step 1 > /dev/null 2>&1
+  [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: absurd wire ceiling did not fail"; }
+  # the plan audit off the recorded plan.json (single-logdir --explain)
+  python -m dtf_tpu.telemetry.report "$pglogs" --explain \
+      | tee "$pgdir/audit.log"
+  rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: report --explain plan audit (rc=$rc)"; }
+  grep -q "Plan audit" "$pgdir/audit.log" \
+    && grep -q "predicted peak HBM" "$pgdir/audit.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: plan audit lines missing"; }
+  python - "$pgdir" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+doc = json.load(open(os.path.join(d, "sc", "mnist_zero1_int8_ring.json")))
+assert doc["ok"], (doc["gates"], doc.get("error"))
+wire = doc["measured"]["wire_bytes_per_step"]
+# the ring wire: strictly under the one-shot int8 cell's 81120 B/step
+assert 0 < wire < 81120, wire
+logs = os.path.join(d, "sc", "work", "mnist_zero1_int8_ring", "logs")
+plan = json.load(open(os.path.join(logs, "plan.json")))
+assert plan["grad_sync"] == "zero1", plan["grad_sync"]
+assert plan["grad_comm_dtype"] == "int8_ring", plan["grad_comm_dtype"]
+print(f"plan-auto cell OK: wire {wire:.0f} B/step under the 76000 "
+      f"ceiling, plan.json pinned {plan['grad_sync']}+"
+      f"{plan['grad_comm_dtype']} [{plan['source']}]")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: plan-auto cell assertions (rc=$rc)"; }
+  rm -rf "$pgdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

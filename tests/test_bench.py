@@ -644,6 +644,39 @@ class TestBenchLedger:
         ok, lines = bl.check_ledger(rows)
         assert ok, lines
 
+    def test_plan_round_folds_and_gates(self):
+        """PLAN_r*.json (bench.breakdown --plan_ab, ISSUE 19) folds as a
+        kind='plan' row gated on wire_reduction, and the committed round
+        is green."""
+        import os
+        bl = self._ledger_mod()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        row = bl.plan_row(os.path.join(repo, "PLAN_r01.json"), repo)
+        assert row["kind"] == "plan" and row["ok"]
+        assert row["rig"] == "plan_8dev"
+        assert 0 < row["wire_reduction"] < 1
+        assert row["step_time_ratio"] <= 1.10
+        assert row["hbm_prediction_rel_err"] <= 0.05
+        ok, lines = bl.check_ledger([row])
+        assert ok, lines
+        assert any("plan_8dev" in ln for ln in lines)
+
+    def test_plan_gate_failure_names_failing_leg(self, tmp_path):
+        """A plan_ab doc whose triple gate failed folds as an errored
+        row whose stage names the first failing leg."""
+        import json
+        bl = self._ledger_mod()
+        doc = {"n": 2, "data_axis": 8, "ok": False,
+               "wire_win": True, "step_time_ok": False,
+               "wire_reduction": 0.1, "step_time_ratio": 1.4,
+               "plan_auto": {"hbm_prediction_rel_err": 0.0}}
+        p = tmp_path / "PLAN_r02.json"
+        p.write_text(json.dumps(doc))
+        row = bl.plan_row(str(p), str(tmp_path))
+        assert not row["ok"]
+        assert row["error"] == "plan_ab_gate_failed"
+        assert row["stage"] == "step_time"
+
     def test_check_ledger_cli_green_and_regression(self, tmp_path):
         """python bench.py --check-ledger end to end: green on the
         committed ledger, exit 1 when a synthetic regression row is

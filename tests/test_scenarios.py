@@ -110,6 +110,24 @@ class TestMatrices:
             for task in range(c.hosts):
                 FaultPlan.parse(c.chaos, process_index=task)
 
+    def test_int8_ring_cell_contract(self):
+        """ISSUE 19: the pod-gradient cell plans itself (--plan auto),
+        pins the EQuARX ring wire, arms the wire-bytes ceiling, and
+        round-trips through JSON with the new spec fields."""
+        cell = {c.name: c for c in
+                default_matrix()}["mnist_zero1_int8_ring"]
+        assert cell.plan == "auto"
+        assert cell.grad_comm_dtype == "int8_ring"
+        assert cell.devices == 8
+        assert "preempt" in cell.chaos
+        th = cell.gate.thresholds()
+        assert (th["max_wire_bytes_per_step"]
+                == cell.gate.max_wire_bytes_per_step > 0)
+        assert ScenarioSpec.from_json(cell.to_json()) == cell
+        # an unarmed gate stays out of the kwargs (old cells unchanged)
+        assert "max_wire_bytes_per_step" not in Gate(
+            max_final_cost=1.0, min_goodput=0.1).thresholds()
+
     def test_mini_matrix_is_the_lane_pair(self):
         names = [c.name for c in mini_matrix()]
         assert names == ["gpt_baseline", "mnist_host_down_elastic"]

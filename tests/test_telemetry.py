@@ -445,6 +445,24 @@ class TestReportCLI:
         ok, lines = report.check_gates(rep, min_examples_per_s=1.0)
         assert not ok and "not measured" in lines[0]
 
+    def test_wire_bytes_gate(self):
+        """max_wire_bytes_per_step (ISSUE 19): ceiling on the per-step
+        comm/wire_bytes gauge; a fatter wire fails, an absent gauge is
+        not-measured = FAIL (a run that never recorded its wire cannot
+        pass the wire gate)."""
+        from dtf_tpu.telemetry import report
+        rep = {"telemetry": {"metrics": {
+            "comm/wire_bytes": {"value": 72800.0}}}}
+        ok, lines = report.check_gates(rep,
+                                       max_wire_bytes_per_step=76000.0)
+        assert ok and "OK" in lines[0]
+        ok, lines = report.check_gates(rep,
+                                       max_wire_bytes_per_step=70000.0)
+        assert not ok and "FAIL" in lines[0]
+        ok, lines = report.check_gates({},
+                                       max_wire_bytes_per_step=76000.0)
+        assert not ok and "not measured" in lines[0]
+
     def test_threshold_gate_flags_imply_check(self, tmp_path, capsys):
         """The CLI flags arm the same gates and fail the exit code —
         without needing an explicit --check."""
