@@ -53,6 +53,13 @@ AB_MIN_RATIO = 1.5
 #: N-way concurrency holds it).
 FLEET_AB_MIN_RATIO = 1.6
 
+#: Prefix-cache bar (ISSUE 20): with the prefix KV cache on, p50 TTFT
+#: on the shared-prefix chatbot trace must improve by at least this
+#: factor over the identical cache-off run (suffix-only prefill skips
+#: the shared tokens), while p99 strictly improves and every token
+#: stream stays bitwise identical.
+PREFIX_AB_MIN_RATIO = 1.5
+
 
 #: qps_profile shapes: multiplicative modulation of the base rate over
 #: the trace's expected constant-rate makespan ``span = n/qps``.  Every
@@ -134,17 +141,51 @@ def poisson_trace(*, seed: int, n_requests: int, qps: float,
     return trace
 
 
+def shared_prefix_trace(*, seed: int, n_requests: int, qps: float,
+                        n_prefixes: int, prefix_len: int,
+                        suffix_lens: List[int], output_lens: List[int],
+                        vocab_size: int, sampled_temperature: float = 0.8,
+                        ) -> List[Tuple[float, dict]]:
+    """Seeded chatbot-shaped trace for the prefix-cache A/B: a small
+    pool of long shared "system prompts" (the prefixes), each request
+    drawing one of them plus a short fresh user suffix.  Arrivals are
+    the same unit-rate exponential chain :func:`poisson_trace` uses.
+    Requests ALTERNATE greedy and sampled decoding so the cache-on/off
+    token-identity gate exercises both paths on one trace — a prefix
+    cache that only preserves argmax streams is not a cache, it is a
+    different model."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab_size, (prefix_len,)).astype(np.int32)
+                for _ in range(n_prefixes)]
+    trace: List[Tuple[float, dict]] = []
+    t = 0.0
+    for rid in range(n_requests):
+        t += float(rng.exponential(1.0)) / qps
+        pfx = prefixes[int(rng.integers(0, n_prefixes))]
+        sfx_len = int(rng.choice(suffix_lens))
+        sfx = rng.integers(0, vocab_size, (sfx_len,)).astype(np.int32)
+        trace.append((t, {
+            "rid": rid,
+            "prompt": np.concatenate([pfx, sfx]),
+            "max_new_tokens": int(rng.choice(output_lens)),
+            "temperature": 0.0 if rid % 2 == 0 else sampled_temperature,
+        }))
+    return trace
+
+
 def _trace_vocab(model, ns) -> int:
     cap = getattr(ns, "trace_vocab", None)
     return min(model.cfg.vocab_size, cap) if cap else model.cfg.vocab_size
 
 
 def run_point(model, params, *, mode: str, qps: float, ns,
-              spec_k: int = 0) -> Dict:
+              spec_k: int = 0, prefix_cache: bool = False,
+              trace: Optional[List[Tuple[float, dict]]] = None) -> Dict:
     """One sweep point: fresh engine + fresh clock, the seeded trace for
-    this QPS, closed-loop to drain.  Returns ``(summary, engine)`` —
-    the summary carries the offered rate; the engine lets A/B callers
-    (``spec_ab``) read per-rid token streams for identity gates."""
+    this QPS (or a caller-supplied one), closed-loop to drain.  Returns
+    ``(summary, engine)`` — the summary carries the offered rate; the
+    engine lets A/B callers (``spec_ab``, ``prefix_ab``) read per-rid
+    token streams for identity gates."""
     from dtf_tpu.serve import ServingEngine, VirtualClock, WallClock
 
     clock = VirtualClock() if ns.clock == "virtual" else WallClock()
@@ -152,17 +193,19 @@ def run_point(model, params, *, mode: str, qps: float, ns,
         model, params, num_slots=ns.slots, block_size=ns.block_size,
         num_blocks=ns.pool_blocks, mode=mode, seed=ns.seed, clock=clock,
         max_queue=ns.max_queue, top_k=ns.top_k, top_p=ns.top_p,
-        spec_k=spec_k)
-    trace = poisson_trace(
-        seed=ns.seed, n_requests=ns.requests,
-        qps=qps, prompt_lens=ns.prompt_lens_list,
-        output_lens=ns.output_lens_list,
-        vocab_size=_trace_vocab(model, ns), temperature=ns.temperature,
-        qps_profile=getattr(ns, "qps_profile", "constant"))
+        spec_k=spec_k, prefix_cache=prefix_cache)
+    if trace is None:
+        trace = poisson_trace(
+            seed=ns.seed, n_requests=ns.requests,
+            qps=qps, prompt_lens=ns.prompt_lens_list,
+            output_lens=ns.output_lens_list,
+            vocab_size=_trace_vocab(model, ns),
+            temperature=ns.temperature,
+            qps_profile=getattr(ns, "qps_profile", "constant"))
     engine.run(trace)
     out = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)
     out["offered_qps"] = qps
-    out["requests_offered"] = ns.requests
+    out["requests_offered"] = len(trace)
     return out, engine
 
 
@@ -380,6 +423,201 @@ def spec_ab(model, params, ns) -> Dict:
             "spec": on, "no_spec": off,
             "token_identity": identical["ok"],
             "token_identity_detail": identical,
+            "gates": lines, "ok": ok}
+
+
+def _churn_with_cancels(engine, trace, *, seed: int,
+                        cancel_frac: float = 0.4,
+                        max_iterations: int = 1_000_000) -> int:
+    """Drive ``trace`` through a live engine while cancelling a seeded
+    random subset of requests a few iterations after submission — the
+    leak hunt for the prefix cache's refcount/pin lifecycle.  Cancels
+    land in every phase (queued holding prefix pins, mid-prefill
+    reservation, mid-decode on shared blocks).  Returns the number of
+    cancels issued."""
+    rng = np.random.default_rng(seed)
+    pending: Dict[int, int] = {}
+    cancels = 0
+    i = 0
+    it = 0
+    while i < len(trace) or engine.scheduler.has_work():
+        if it >= max_iterations:
+            raise RuntimeError("churn did not drain — wedged scheduler?")
+        now = engine.clock.now()
+        while i < len(trace) and trace[i][0] <= now:
+            t_arr, kw = trace[i]
+            engine.submit(arrival_s=t_arr, **kw)
+            if rng.random() < cancel_frac:
+                pending[kw["rid"]] = int(rng.integers(0, 5))
+            i += 1
+        if not engine.scheduler.has_work():
+            if i >= len(trace):
+                break
+            engine.clock.advance_to(trace[i][0])
+            continue
+        engine.step()
+        it += 1
+        for rid in list(pending):
+            if pending[rid] <= 0:
+                if engine.cancel(rid):
+                    cancels += 1
+                del pending[rid]
+            else:
+                pending[rid] -= 1
+    return cancels
+
+
+def prefix_gates(on: Dict, off: Dict, identical: Dict,
+                 churn: Dict) -> Tuple[bool, List[str]]:
+    """The prefix-cache acceptance gates (ISSUE 20):
+
+    * **token identity** — every commonly-completed request's stream is
+      bitwise identical with the cache on and off, and the comparison
+      must cover BOTH greedy and sampled requests (suffix-only prefill
+      emits the same logits as cold prefill or it does not ship);
+    * **p50 TTFT >= {PREFIX_AB_MIN_RATIO}x** — the cache-off p50 over
+      the cache-on p50 on the same trace (the headline: shared tokens
+      are not recomputed);
+    * **p99 TTFT strictly improves** — the tail moves too, not just the
+      median (a cache that helps the median while starving the tail is
+      a regression in SLO terms);
+    * **prefix hits observed** — ``serve/prefix_hit_blocks_total`` > 0
+      in the cache-on arm, so the win is attributable to the cache;
+    * **zero leaked blocks** — after a churn wave with seeded random
+      cancels on the cache-on engine, every non-trash block is back in
+      the free/cached tiers (refcounts, queued pins and COW forks all
+      unwound), and the cache-off arm leaks nothing either.
+    """
+    lines: List[str] = []
+    ok = True
+
+    def gate(name, passed, detail):
+        nonlocal ok
+        ok = ok and passed
+        lines.append(f"gate {name}: {'OK' if passed else 'FAIL'} — "
+                     f"{detail}")
+
+    gate("prefix_token_identity",
+         identical["ok"] and identical["greedy"] > 0
+         and identical["sampled"] > 0,
+         (f"{identical['common']} common completed stream(s) bitwise "
+          f"identical ({identical['greedy']} greedy, "
+          f"{identical['sampled']} sampled)" if identical["ok"]
+          else f"{len(identical['diverged'])} common stream(s) "
+               f"DIVERGED: rids {identical['diverged'][:8]}")
+         + (f"; completion sets differ (only-on {identical['only_on']}, "
+            f"only-off {identical['only_off']})"
+            if identical["only_on"] or identical["only_off"] else ""))
+    p50_on, p50_off = on.get("ttft_ms_p50"), off.get("ttft_ms_p50")
+    ratio = (None if not p50_on or p50_off is None
+             else p50_off / p50_on)
+    gate("prefix_ttft_p50",
+         ratio is not None and ratio >= PREFIX_AB_MIN_RATIO,
+         f"p50 TTFT {p50_off} ms off / {p50_on} ms on = ratio "
+         + ("n/a" if ratio is None else f"{ratio:.2f}")
+         + f" (bar {PREFIX_AB_MIN_RATIO})")
+    p99_on, p99_off = on.get("ttft_ms_p99"), off.get("ttft_ms_p99")
+    gate("prefix_ttft_p99_improves",
+         p99_on is not None and p99_off is not None and p99_on < p99_off,
+         f"p99 TTFT {p99_on} ms on vs {p99_off} ms off (must strictly "
+         f"improve)")
+    hits = on.get("prefix_hit_blocks", 0)
+    gate("prefix_hits_observed",
+         hits > 0,
+         f"{hits} prefix block(s) hit over {on.get('prefix_lookups', 0)} "
+         f"lookup(s), hit rate {on.get('prefix_hit_rate', 0.0):.3f}")
+    gate("prefix_zero_leaks",
+         churn["leaked_on"] == 0 and churn["leaked_off"] == 0,
+         f"{churn['leaked_on']} block(s) leaked cache-on / "
+         f"{churn['leaked_off']} cache-off after churn with "
+         f"{churn['cancels']} random cancel(s) "
+         f"({churn['cached_blocks']} block(s) parked in the cached "
+         f"tier, which is reclaimable, not leaked)")
+    return ok, lines
+
+
+def prefix_ab(model, params, ns) -> Dict:
+    """Same-trace prefix-cache on/off A/B at the FIRST --qps point:
+    identical shared-prefix chatbot trace, identical engine geometry,
+    the only difference is ``prefix_cache``.  After the measured run
+    each arm eats a second churn wave with seeded random cancels; the
+    leak gate then requires every non-trash block back in the
+    free/cached tiers."""
+    qps = ns.qps_list[0]
+    prefix_len = ns.prefix_len or 5 * ns.block_size
+    trace = shared_prefix_trace(
+        seed=ns.seed, n_requests=ns.requests, qps=qps,
+        n_prefixes=ns.n_prefixes, prefix_len=prefix_len,
+        suffix_lens=ns.prompt_lens_list, output_lens=ns.output_lens_list,
+        vocab_size=_trace_vocab(model, ns))
+
+    def churn_wave(offset: int) -> List[Tuple[float, dict]]:
+        return [(t, {**kw, "rid": kw["rid"] + offset})
+                for t, kw in trace]
+
+    on, eng_on = run_point(model, params, mode="continuous", qps=qps,
+                           ns=ns, prefix_cache=True, trace=trace)
+    off, eng_off = run_point(model, params, mode="continuous", qps=qps,
+                             ns=ns, prefix_cache=False, trace=trace)
+    tokens = []
+    for eng in (eng_on, eng_off):
+        tokens.append({r.rid: list(r.tokens or [])
+                       for r in eng.results.values()
+                       if r.status == "completed"})
+    # Identity over the INTERSECTION of completed sets (same rationale
+    # as spec_ab: near a shed boundary the arms' different clock
+    # trajectories may complete different sets — a scheduling effect,
+    # surfaced in the detail, not a token-identity violation).
+    common = sorted(set(tokens[0]) & set(tokens[1]))
+    diverged = [rid for rid in common if tokens[0][rid] != tokens[1][rid]]
+    identical = {
+        "ok": not diverged, "common": len(common), "diverged": diverged,
+        "greedy": sum(1 for rid in common if rid % 2 == 0),
+        "sampled": sum(1 for rid in common if rid % 2 == 1),
+        "only_on": len(set(tokens[0]) - set(tokens[1])),
+        "only_off": len(set(tokens[1]) - set(tokens[0])),
+    }
+    # churn-with-cancels on BOTH live engines (fresh rids), then the
+    # leak audit: every block outside the trash sentinel must be free
+    # or parked in the reclaimable cached tier
+    cancels = _churn_with_cancels(eng_on, churn_wave(len(trace)),
+                                  seed=ns.seed + 1)
+    cancels += _churn_with_cancels(eng_off, churn_wave(len(trace)),
+                                   seed=ns.seed + 1)
+
+    def leaked(eng) -> int:
+        alloc = eng.scheduler.allocator
+        return alloc.num_blocks - 1 - alloc.free_blocks
+
+    churn = {"cancels": cancels,
+             "leaked_on": leaked(eng_on), "leaked_off": leaked(eng_off),
+             "cached_blocks": eng_on.scheduler.allocator.cached_blocks}
+    ok, lines = prefix_gates(on, off, identical, churn)
+    if ns.logdir:
+        import os
+        os.makedirs(ns.logdir, exist_ok=True)
+        eng_on.write_telemetry(ns.logdir, slo_ttft_ms=ns.slo_ttft_ms)
+    for arm, s in (("cache_on", on), ("cache_off", off)):
+        print(f"  [{arm:>9}] completed {s.get('completed', 0):3d}  "
+              f"ttft p50/p99 {s.get('ttft_ms_p50', float('nan')):7.1f}"
+              f"/{s.get('ttft_ms_p99', float('nan')):7.1f} ms  "
+              f"goodput {s.get('goodput_qps', 0.0):6.2f} qps"
+              + (f"  hit rate {s.get('prefix_hit_rate', 0.0):.3f}"
+                 if s.get("prefix_cache") else ""), flush=True)
+    p50_on = float(on.get("ttft_ms_p50") or 0.0)
+    p50_off = float(off.get("ttft_ms_p50") or 0.0)
+    return {"offered_qps": qps, "clock": ns.clock,
+            "prefix_len": prefix_len, "n_prefixes": ns.n_prefixes,
+            # rig names the arm geometry so a deliberately-different
+            # shape (other block size / prefix depth) never aliases onto
+            # this rig's regression history in the ledger
+            "rig": (f"prefix_bs{ns.block_size}_p{prefix_len}"
+                    f"_n{ns.n_prefixes}"),
+            "ttft_p50_ratio": (p50_off / p50_on) if p50_on > 0 else None,
+            "cache_on": on, "cache_off": off,
+            "token_identity": identical["ok"],
+            "token_identity_detail": identical,
+            "churn": churn, "min_ratio": PREFIX_AB_MIN_RATIO,
             "gates": lines, "ok": ok}
 
 
@@ -813,6 +1051,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "arm's snap-back count (same threshold "
                         "telemetry.report --max_control_rollbacks "
                         "arms on a telemetry.json)")
+    p.add_argument("--prefix_ab", action="store_true",
+                   help="same-trace prefix-KV-cache on/off A/B at the "
+                        "FIRST --qps point on a seeded shared-prefix "
+                        "chatbot trace (requests alternate greedy and "
+                        "sampled); --check gates token identity + p50 "
+                        f"TTFT >= {PREFIX_AB_MIN_RATIO}x + strict p99 "
+                        "improvement + prefix hits > 0 + zero leaked "
+                        "blocks after churn with random cancels")
+    p.add_argument("--n_prefixes", type=int, default=3,
+                   help="with --prefix_ab: size of the shared system-"
+                        "prompt pool requests draw their prefix from")
+    p.add_argument("--prefix_len", type=int, default=0,
+                   help="with --prefix_ab: shared prefix length in "
+                        "tokens (0 = 5 * block_size)")
     p.add_argument("--replicas", type=int, default=None, metavar="N",
                    help="fleet A/B (serve/fleet.py): N replicas vs a "
                         "single replica on the SAME trace over real "
@@ -873,12 +1125,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.knob_ab and (ns.spec_ab or ns.replicas is not None):
         p.error("--knob_ab is its own A/B; run --spec_ab/--replicas "
                 "as separate invocations")
+    if ns.prefix_ab and (ns.spec_ab or ns.knob_ab or ns.chaos
+                         or ns.replicas is not None):
+        p.error("--prefix_ab is its own A/B; run --spec_ab/--knob_ab/"
+                "--chaos/--replicas as separate invocations")
     if (ns.check and not ns.chaos and not ns.spec_ab and not ns.knob_ab
+            and not ns.prefix_ab
             and ns.replicas is None and ns.mode != "both"):
         p.error("--check needs --mode both (it asserts the A/B ratio), "
                 "--chaos (the overload gates), --spec_ab (the "
                 "speculative-decoding gates), --knob_ab (the control-"
-                "plane gates), or --replicas (the fleet gates)")
+                "plane gates), --prefix_ab (the prefix-cache gates), "
+                "or --replicas (the fleet gates)")
 
     import jax
 
@@ -905,6 +1163,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.check:
             if not result["ok"]:
                 print("CHECK FAILED: fleet gates (see above)",
+                      file=sys.stderr)
+                return 1
+            print("CHECK OK")
+        return 0
+    if ns.prefix_ab:
+        result = prefix_ab(model, params, ns)
+        for line in result["gates"]:
+            print(line, flush=True)
+        if ns.json:
+            with open(ns.json, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {ns.json}")
+        if ns.check:
+            if not result["ok"]:
+                print("CHECK FAILED: prefix-cache gates (see above)",
                       file=sys.stderr)
                 return 1
             print("CHECK OK")

@@ -172,8 +172,10 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     slo_ttft_ms = float(ex.get("slo_ttft_ms", 400.0))
     deadline_ms = float(ex.get("deadline_ms", 2500.0))
     slots = int(ex.get("slots", 4))
+    block_size = int(ex.get("block_size", 16))
     qps_profile = str(ex.get("qps_profile", "constant"))
     controller = bool(ex.get("controller", 0))
+    prefix_cache = bool(ex.get("prefix_cache", 0))
 
     # span tracer into the judged logdir: the cell's
     # min_trace_complete_frac gate reads the per-request trace chains
@@ -184,11 +186,25 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
     model = GPT(cfg)
     params = model.init(jax.random.key(spec.seed))
     vocab = int(ex.get("trace_vocab", cfg.vocab_size))
-    trace = poisson_trace(
-        seed=spec.seed, n_requests=n_requests, qps=qps,
-        prompt_lens=[4, 8, 16], output_lens=[2, 8, 16],
-        vocab_size=min(vocab, cfg.vocab_size), deadline_ms=deadline_ms,
-        priorities=[0, 0, 1], qps_profile=qps_profile)
+    if prefix_cache:
+        # the shared-prefix chatbot trace (bench/serve_load.py): a small
+        # pool of long shared system prompts, short fresh suffixes,
+        # greedy/sampled alternating — the workload the prefix cache's
+        # hit-rate gate is judged on
+        from dtf_tpu.bench.serve_load import shared_prefix_trace
+        trace = shared_prefix_trace(
+            seed=spec.seed, n_requests=n_requests, qps=qps,
+            n_prefixes=int(ex.get("n_prefixes", 3)),
+            prefix_len=int(ex.get("prefix_len", 5 * block_size)),
+            suffix_lens=[1, 4, 7], output_lens=[2, 4, 8],
+            vocab_size=min(vocab, cfg.vocab_size))
+    else:
+        trace = poisson_trace(
+            seed=spec.seed, n_requests=n_requests, qps=qps,
+            prompt_lens=[4, 8, 16], output_lens=[2, 8, 16],
+            vocab_size=min(vocab, cfg.vocab_size),
+            deadline_ms=deadline_ms,
+            priorities=[0, 0, 1], qps_profile=qps_profile)
 
     def run_pass(arm_knobs: bool):
         # fresh engine + clock + fault plan per pass (fired chaos
@@ -197,10 +213,11 @@ def _serve_cell(spec, logdir: str, chaos: str) -> int:
         plan = (FaultPlan.parse(chaos, process_index=0) if chaos
                 else None)
         engine = ServingEngine(
-            model, params, num_slots=slots, seed=spec.seed,
-            clock=VirtualClock(), max_queue=256,
+            model, params, num_slots=slots, block_size=block_size,
+            seed=spec.seed, clock=VirtualClock(), max_queue=256,
             brownout=BrownoutController(slo_ttft_ms), chaos=plan,
-            slo=BurnRateMonitor.for_serving(slo_ttft_ms))
+            slo=BurnRateMonitor.for_serving(slo_ttft_ms),
+            prefix_cache=prefix_cache)
         if arm_knobs:
             from dtf_tpu.control import arm_controller
             arm_controller(engine)

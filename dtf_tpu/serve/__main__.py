@@ -52,7 +52,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 
-def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
+def build_trace(ns, vocab_size: int,
+                max_len: Optional[int] = None) -> List[Tuple[float, dict]]:
     """The request trace: JSONL file or a seeded Poisson demo mix."""
     trace: List[Tuple[float, dict]] = []
     if ns.requests:
@@ -79,6 +80,32 @@ def build_trace(ns, vocab_size: int) -> List[Tuple[float, dict]]:
                 }))
         trace.sort(key=lambda e: e[0])
         return trace
+    if getattr(ns, "prefix_cache", False):
+        # shared-prefix chatbot mix: the demo traffic that actually
+        # exercises the cache (a pure Poisson mix shares no chunks, so
+        # /memz would show an armed-but-idle cache)
+        from dtf_tpu.bench.serve_load import shared_prefix_trace
+        suffix_lens = [int(x) for x in ns.prompt_lens.split(",")]
+        output_lens = [int(x) for x in ns.output_lens.split(",")]
+        prefix_len = 5 * ns.block_size
+        if max_len is not None:
+            # admission rejects prompt+output > max_len, so the demo
+            # prefix must leave room for the longest suffix+output mix
+            # (block-aligned: only FULL blocks are shareable)
+            budget = max_len - max(suffix_lens) - max(output_lens)
+            prefix_len = min(prefix_len,
+                             (budget // ns.block_size) * ns.block_size)
+        if prefix_len < ns.block_size:
+            raise SystemExit(
+                "--prefix_cache demo: no room for a shareable prefix — "
+                f"max_len {max_len} minus worst-case suffix+output "
+                f"leaves {prefix_len} < one {ns.block_size}-token block; "
+                "lower --prompt_lens/--output_lens or --block_size")
+        return shared_prefix_trace(
+            seed=ns.seed, n_requests=ns.demo, qps=ns.qps,
+            n_prefixes=3, prefix_len=prefix_len,
+            suffix_lens=suffix_lens, output_lens=output_lens,
+            vocab_size=vocab_size)
     # ONE Poisson trace generator in the repo (the load bench's
     # unit-rate chain, rate-scaling invariant included).
     from dtf_tpu.bench.serve_load import poisson_trace
@@ -152,7 +179,8 @@ def _make_engine(ns, model, params, clock, printer, heartbeat, chaos):
         max_queue=ns.max_queue, aging_s=ns.aging_s, on_token=printer,
         heartbeat=heartbeat, brownout=brownout, chaos=chaos, slo=slo,
         spec_k=ns.spec_k, coalesce_prefill=not ns.no_prefill_coalesce,
-        narrow_decode=not ns.no_narrow)
+        narrow_decode=not ns.no_narrow,
+        prefix_cache=getattr(ns, "prefix_cache", False))
     ctl = None
     if getattr(ns, "controller", False):
         # self-tuning control plane (DESIGN.md §9): registry + standard
@@ -411,7 +439,8 @@ def serve_fleet(ns, model, params) -> int:
         engine_kwargs=dict(
             num_slots=ns.slots, block_size=ns.block_size,
             num_blocks=ns.pool_blocks, max_queue=ns.max_queue,
-            aging_s=ns.aging_s, eos_id=ns.eos_id, spec_k=ns.spec_k))
+            aging_s=ns.aging_s, eos_id=ns.eos_id, spec_k=ns.spec_k,
+            prefix_cache=getattr(ns, "prefix_cache", False)))
     return _run_acceptor(
         ns, acc,
         f"fleet serving on tcp://{acc.address[0]}:{acc.address[1]} "
@@ -512,6 +541,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "self-drafted (n-gram prompt-lookup) tokens "
                         "verified per iteration; greedy tokens stay "
                         "bitwise identical to spec_k=0 (0 = off)")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="share prompt-prefix KV across requests "
+                        "(refcounted blocks + COW fork + suffix-only "
+                        "prefill; DESIGN.md §7.7).  Demo traffic "
+                        "switches to the shared-prefix chatbot mix so "
+                        "the cache actually gets hits")
     p.add_argument("--no_prefill_coalesce", action="store_true",
                    help="disable batched multi-request prefill (the "
                         "determinism A/B's solo baseline)")
@@ -632,7 +667,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return serve_fleet(ns, model, params)
     if ns.listen:
         return serve_listen(ns, model, params, drain_target)
-    trace = build_trace(ns, cfg.vocab_size)
+    trace = build_trace(ns, cfg.vocab_size, max_len=cfg.max_len)
     out = serve_session(ns, model, params, trace, drain_target)
     engine = out["engine"]
     summary = engine.summary(slo_ttft_ms=ns.slo_ttft_ms)

@@ -357,6 +357,120 @@ def build_prefill_batched_fn(model, *, padded_len: int,
     return _cached(model, "prefill_batched", statics, build)
 
 
+def build_prefill_suffix_fn(model, *, padded_len: int, start_len: int,
+                            n_rows: int, top_k: int = 0,
+                            top_p: float = 1.0):
+    """Suffix-only prefill for prefix-cache hits: the request's first
+    ``start_len`` rows (whole blocks) are already resident in the pool
+    — matched by content through the sharing index — so only the
+    ``padded_len - start_len`` suffix rows go through the forward.
+    RoPE/positional rows and the causal mask are offset by the cached
+    length (queries sit at global positions ``start_len..padded_len-1``
+    against a key axis that is the gathered prefix followed by the
+    fresh suffix).
+
+    Bitwise discipline: the layer body is the same ``lax.scan`` over
+    ``GPTBlock.prefill``'s op sequence (dense ``dot_product_attention``
+    over ``expand_kv``'d heads, the mask a row-slice of the full causal
+    mask) that :func:`build_prefill_fn` compiles — the per-row numerics
+    of the suffix rows, the scattered suffix K/V, and the sampled first
+    token are bitwise identical to the cold prefill's (pinned by
+    tests), which is what makes cache-on vs cache-off token identity a
+    structural property instead of a tolerance.
+
+    ``fn(params, pool_k, pool_v, toks (R, S) i32 [suffix tokens],
+    p_lens (R,) i32 [GLOBAL prompt lengths], pre_blocks (R, nb_pre)
+    i32, sfx_blocks (R, nb_sfx) i32, temps (R,) f32, seeds (R,) u32)
+    -> (first_toks (R,) i32, ok (R,) bool, pool_k, pool_v)``
+
+    ``ok[r]`` is the per-row health flag the cold prefill doesn't need:
+    a cold prefill reads nothing from the pool, but a suffix prefill
+    GATHERS shared blocks — if ``kv_poison`` corrupted one between
+    match and prefill, the logits go non-finite and the engine must
+    evict instead of emitting a NaN-derived first token.  Padding rows
+    (R rounded up to a power of two) carry all-zero block rows — their
+    gathers hit the trash block, their k/v lands there too, and their
+    sampled token is discarded.
+
+    Compiled per (padded prompt bucket, cached-prefix length, rows
+    bucket); ``start_len`` must be a positive whole-block multiple
+    strictly below ``padded_len`` (the last real prompt token is never
+    cached — its logits are the first token's source).
+    """
+    from dtf_tpu.nn.attention import causal_mask, dot_product_attention
+    from dtf_tpu.nn.sampling import sample_token_batched
+
+    statics = (padded_len, start_len, n_rows, top_k, float(top_p))
+    cfg = model.cfg
+    s_w = padded_len - start_len
+
+    def build():
+        def prefill(params, pool_k, pool_v, toks, p_lens, pre_blocks,
+                    sfx_blocks, temps, seeds):
+            bs = pool_k.shape[2]
+            pos = jnp.arange(start_len, padded_len)
+            x = model._embed(params, toks, pos)              # (R, S, D)
+            # queries are rows start_len.. of the SAME causal mask the
+            # cold prefill applies over the full padded length
+            mask = causal_mask(padded_len)[:, :, start_len:, :]
+            safe_pre = jnp.maximum(pre_blocks, 0)
+
+            def prefill_layer(cx, inp):
+                lp, pk, pv = inp
+                block = model.block
+                p = lp["attn"]
+                h = block.ln1.apply(lp["ln1"], cx)
+                q, k_s, v_s = block.attn.qkv(p, h)
+                if cfg.rope:
+                    from dtf_tpu.nn.rope import apply_rope
+                    q = apply_rope(q, pos)
+                    k_s = apply_rope(k_s, pos)
+                kvh = k_s.shape[2]
+                hd = k_s.shape[3]
+                # gathered shared-prefix rows (read-only — the suffix
+                # scatter below never touches pre_blocks)
+                cpk = pk[safe_pre].reshape(n_rows, start_len, kvh, hd)
+                cpv = pv[safe_pre].reshape(n_rows, start_len, kvh, hd)
+                k_full = jnp.concatenate([cpk.astype(k_s.dtype), k_s],
+                                         axis=1)
+                v_full = jnp.concatenate([cpv.astype(v_s.dtype), v_s],
+                                         axis=1)
+                out = dot_product_attention(
+                    q, block.attn.expand_kv(k_full),
+                    block.attn.expand_kv(v_full), mask)
+                cx = cx + block.attn.out_proj(p, out)
+                return block._mlp_residual(lp, cx), (k_s, v_s)
+
+            x, (ks, vs) = lax.scan(prefill_layer, x,
+                                   (params["layers"], pool_k, pool_v))
+            # per-row logits at the LAST REAL prompt position, which is
+            # always a suffix row (matches cap at (prompt_len-1)//bs
+            # full blocks)
+            x_last = jnp.take_along_axis(
+                x, (p_lens - 1 - start_len)[:, None, None], axis=1)
+            x_last = model.ln_f.apply(params["ln_f"], x_last)
+            logits = model.tok.attend(params["tok"], x_last)[:, 0, :]
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)
+
+            # (L, R, S, KVH, Dh) -> (L, R, nb_sfx, bs, KVH*Dh) -> blocks
+            l = ks.shape[0]
+            nb_sfx = s_w // bs
+            chunk = lambda a: a.reshape(l, n_rows, nb_sfx, bs, -1)
+            pool_k = pool_k.at[:, sfx_blocks].set(
+                chunk(ks).astype(pool_k.dtype))
+            pool_v = pool_v.at[:, sfx_blocks].set(
+                chunk(vs).astype(pool_v.dtype))
+
+            keys = _sample_keys(seeds, jnp.zeros((n_rows,), jnp.int32))
+            first = sample_token_batched(keys, logits, temperature=temps,
+                                         top_k=top_k, top_p=top_p)
+            return first, ok, pool_k, pool_v
+
+        return jax.jit(prefill, donate_argnums=_donate_pools())
+
+    return _cached(model, "prefill_suffix", statics, build)
+
+
 def _paged_window_logits(model, params, pool_k, pool_v, table, toks,
                          pos0):
     """S tokens per slot against the paged cache in ONE forward pass —

@@ -48,7 +48,8 @@ import numpy as np
 
 from dtf_tpu import telemetry as tel
 from dtf_tpu.serve import decode as dec
-from dtf_tpu.serve.paged_kv import BlockAllocator, KVPool, blocks_for
+from dtf_tpu.serve.paged_kv import (BlockAllocator, KVPool, blocks_for,
+                                    chunk_digests)
 from dtf_tpu.serve.scheduler import Request, Scheduler, WallClock
 from dtf_tpu.telemetry.reqtrace import RequestTracer, mint_trace_id
 
@@ -103,7 +104,8 @@ class ServingEngine:
                  narrow_decode: bool = True,
                  spec_k: int = 0,
                  decode_kernel: Optional[bool] = None,
-                 pool: Optional[KVPool] = None):
+                 pool: Optional[KVPool] = None,
+                 prefix_cache: bool = False):
         t_init = time.perf_counter()
         # Close any open supervisor down-window into the restart bucket
         # (run_supervised marks down at the crash; construction of the
@@ -217,6 +219,17 @@ class ServingEngine:
         #: context used, not pool size.  Off = full-window whole-pool
         #: geometry — the ladder's baseline arm.
         self.narrow_decode = bool(narrow_decode)
+        #: Prefix/prompt KV sharing (serve/paged_kv.py content index):
+        #: submits match their prompt's block-chain digests against
+        #: blocks earlier requests registered, pin the hits, and prefill
+        #: only the uncached suffix — bitwise the cold tokens (pinned),
+        #: cheaper TTFT (the --prefix_ab bench gates the ratio).  Off =
+        #: the engine never registers or matches content, and every
+        #: allocator path degenerates to the plain free list.
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_lookups = 0
+        self.prefix_hit_blocks = 0
+        self.prefix_probed_blocks = 0
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         #: Speculative decoding: the n-gram self-drafter (serve/spec.py)
@@ -351,7 +364,45 @@ class ServingEngine:
             self.reqtrace.event(req, "rejected", now, verdict=verdict)
         elif verdict.startswith("shed"):
             pass                    # booked via the on_shed hook already
+        elif self.prefix_cache:
+            # match + PIN shared blocks NOW, after the "queued" verdict:
+            # an acquired block cannot be reclaimed by allocation
+            # pressure, so the admission walk's fresh-blocks discount
+            # (scheduler._fresh_blocks_needed) stays valid from match to
+            # _assign by construction
+            self._prefix_match(req, now)
         return verdict
+
+    def _prefix_match(self, req: Request, now: float) -> None:
+        """Walk the content index for this prompt's digest chain and pin
+        every matched full block.  The match cap is ``(prompt_len - 1)
+        // block_size`` blocks — the final real prompt token is never
+        served from cache because its logits are the first output
+        token's source, so at least one suffix token always runs
+        through the prefill forward."""
+        bs = self.block_size
+        alloc = self.scheduler.allocator
+        cap = (req.prompt_len - 1) // bs
+        req.prefix_digests = chunk_digests(req.prompt, bs,
+                                           req.prompt_len // bs)
+        matched = alloc.match_chain(req.prefix_digests[:cap]) if cap else []
+        self.prefix_lookups += 1
+        self.prefix_probed_blocks += cap
+        if matched:
+            alloc.acquire(matched)
+            req.prefix_blocks = list(matched)
+            req.cached_prefix_blocks = len(matched)
+            self.prefix_hit_blocks += len(matched)
+        # the pair updates under the registry lock: a concurrent /statz
+        # scrape must never read hit blocks without the lookup that
+        # produced them
+        with tel.get_registry().locked():
+            tel.counter("serve/prefix_lookup_total").inc()
+            if matched:
+                tel.counter("serve/prefix_hit_blocks_total").inc(
+                    len(matched))
+        self.reqtrace.event(req, "prefix_match", now,
+                            hit_blocks=len(matched), probed_blocks=cap)
 
     # -- the iteration ------------------------------------------------------
 
@@ -454,6 +505,52 @@ class ServingEngine:
         self.pool.k = self.pool.k.at[:, b].set(0)
         self.pool.v = self.pool.v.at[:, b].set(0)
 
+    def _invalidate_poisoned(self, blocks) -> None:
+        """Prefix-cache half of a kv-poison eviction: tear the victim's
+        blocks out of the content index (no future submit can match NaN
+        rows; a parked victim block drops to the free list) and strip
+        queued requests' pins on them — a queued holder just loses its
+        discount and cold-prefills when admitted, no tokens were ever
+        derived from the bad rows.  Healthy pins released alongside
+        (the walk frees the whole chain) are still registered, so they
+        park back into the cached tier and stay matchable.  A no-op
+        with the cache off — the decode eviction's event order is
+        bitwise the pre-cache engine's."""
+        if not self.prefix_cache or not blocks:
+            return
+        alloc = self.scheduler.allocator
+        alloc.invalidate_blocks(blocks)
+        poisoned = set(blocks)
+        for q in self.scheduler.queue:
+            if q.prefix_blocks and poisoned.intersection(q.prefix_blocks):
+                alloc.free(q.prefix_blocks)
+                q.prefix_blocks = None
+                q.cached_prefix_blocks = 0
+
+    def _poison_eviction(self, req: Request) -> None:
+        """Shared-block poison detected at SUFFIX PREFILL time: unlike
+        the decode step — where every active sharer's own finite-logits
+        flag trips in the same iteration — this detection runs BEFORE
+        the iteration's decode, and scrubbing (zeroing) the shared
+        blocks here would hand the other sharers finite-but-wrong rows.
+        So the eviction walks the refcount set first: every active
+        request sharing any of the victim's blocks goes with it (digest
+        chains are ancestor-closed, so one intersection pass finds every
+        transitive sharer), THEN each victim's blocks are scrubbed and
+        invalidated.  No surviving stream ever emits a NaN-derived
+        token (pinned)."""
+        victims = [req]
+        if self.prefix_cache and req.blocks:
+            poisoned = set(req.blocks)
+            victims += [r for r in self.scheduler.active()
+                        if r is not req and r.blocks
+                        and poisoned.intersection(r.blocks)]
+        for v in victims:
+            self._scrub_blocks(v.blocks)
+            self._invalidate_poisoned(v.blocks)
+            self._evict(v, "failed", "serve/kv_evictions_total")
+            self._emit(v, -1, True)
+
     def _evict(self, req: Request, status: str, counter: str) -> None:
         """Tear an IN-FLIGHT or queued request out right now: blocks
         free on this iteration (the pool never waits for a dead
@@ -521,16 +618,21 @@ class ServingEngine:
                                 3))
 
     def _post_prefill(self, slot: int, req: Request, first: int,
-                      seed: int, p_pad: int, c0: float) -> None:
-        """Per-request bookkeeping shared by the solo and batched
-        prefill paths: the batch-log entry (mode-independent — the
-        coalescing determinism pin compares it across paths), slot-side
-        state, and the first token's emission.  Clock charges and the
-        rate-estimator feed happen at CALL level before this runs."""
-        tel.counter("serve/prefill_tokens_total").inc(p_pad)
+                      seed: int, p_pad: int, c0: float,
+                      tokens: Optional[int] = None) -> None:
+        """Per-request bookkeeping shared by the solo, batched, and
+        suffix prefill paths: the batch-log entry (mode-independent —
+        the coalescing determinism pin compares it across paths),
+        slot-side state, and the first token's emission.  Clock charges
+        and the rate-estimator feed happen at CALL level before this
+        runs.  ``tokens`` is the count actually forwarded (the suffix
+        path passes only its uncached tokens; default = the whole
+        padded prompt)."""
+        tokens = p_pad if tokens is None else tokens
+        tel.counter("serve/prefill_tokens_total").inc(tokens)
         self.batch_log.append(("prefill", req.rid))
         self.reqtrace.event(req, "prefill", self.clock.now(),
-                            tokens=p_pad,
+                            tokens=tokens,
                             dur_ms=round((self.clock.now() - c0) * 1e3, 3))
         req.pos = req.prompt_len
         self._table[slot] = -1
@@ -540,6 +642,16 @@ class ServingEngine:
         self._temps[slot] = req.temperature
         self._seeds[slot] = seed
         self._counts[slot] = 1
+        if self.prefix_cache and req.prefix_digests:
+            # publish this request's full-content blocks into the
+            # sharing index — BEFORE the first token's emission, so a
+            # one-token request's blocks are registered by the time
+            # _finish releases them (they park in the cached tier
+            # instead of hitting the free list unregistered)
+            n_full = req.prompt_len // self.block_size
+            if n_full:
+                self.scheduler.allocator.register_chain(
+                    req.prefix_digests[:n_full], req.blocks[:n_full])
         self._token_out(req, first, self.clock.now())
 
     def _prefill(self, slot: int, req: Request) -> None:
@@ -627,29 +739,109 @@ class ServingEngine:
             self._post_prefill(slot, req, int(firsts[i]),
                                int(seeds[i]), p_pad, c0)
 
+    def _prefill_suffix(self, group: List[Tuple[int, Request]]) -> None:
+        """R same-(bucket, cached-length) admissions through ONE
+        suffix-only prefill call (decode.build_prefill_suffix_fn): the
+        matched shared blocks sit read-only at the front of each table,
+        only the uncached suffix tokens run through the forward, and
+        only those tokens are charged to the clock and the rate
+        estimator — the TTFT win the --prefix_ab bench gates.  Token
+        streams are bitwise the cold path's (pinned)."""
+        import jax.numpy as jnp
+
+        bs = self.block_size
+        p_pad = group[0][1].padded_prompt_len(bs)
+        start = group[0][1].cached_prefix_blocks * bs
+        nb_pre = start // bs
+        nb_sfx = (p_pad - start) // bs
+        s_w = p_pad - start
+        r = len(group)
+        r_pad = _pow2_bucket(r, max(self.num_slots, r))
+        fn = dec.build_prefill_suffix_fn(
+            self.model, padded_len=p_pad, start_len=start, n_rows=r_pad,
+            top_k=self.top_k, top_p=self.top_p)
+        toks = np.zeros((r_pad, s_w), np.int32)
+        p_lens = np.full((r_pad,), start + 1, np.int32)  # pad rows: row 0
+        pre = np.zeros((r_pad, nb_pre), np.int32)        # pad -> trash
+        sfx = np.zeros((r_pad, nb_sfx), np.int32)
+        temps = np.zeros((r_pad,), np.float32)
+        seeds = np.zeros((r_pad,), np.uint32)
+        for i, (_, req) in enumerate(group):
+            tail = req.prompt[start:]
+            toks[i, :len(tail)] = tail
+            p_lens[i] = req.prompt_len
+            pre[i] = req.blocks[:nb_pre]
+            sfx[i] = req.blocks[nb_pre:nb_pre + nb_sfx]
+            temps[i] = req.temperature
+            seeds[i] = _request_seed(self.seed, req.rid)
+        c0 = self.clock.now()
+        t0 = time.perf_counter()
+        with tel.span("serve/prefill", tokens=int(s_w) * r,
+                      cached=int(start) * r,
+                      rids=sorted(int(req.rid) for _, req in group),
+                      t=round(c0, 6)):
+            firsts, oks, self.pool.k, self.pool.v = fn(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(toks), jnp.asarray(p_lens), jnp.asarray(pre),
+                jnp.asarray(sfx), jnp.asarray(temps), jnp.asarray(seeds))
+            firsts = np.asarray(firsts)
+            oks = np.asarray(oks)
+        self._book(("prefill_suffix", p_pad, start, r_pad,
+                    self.pool.hot_blocks), time.perf_counter() - t0)
+        self.prefill_calls += 1
+        tel.histogram("serve/prefill_batch_size").observe(r)
+        # only the SUFFIX tokens are real prefill work — the cached rows
+        # were paid for by whichever request registered them
+        for _ in group:
+            self.clock.charge("prefill", tokens=s_w)
+        self.scheduler.observe_prefill(s_w * r, self.clock.now() - c0)
+        for i, (slot, req) in enumerate(group):
+            if not bool(oks[i]):
+                # the gathered shared prefix went non-finite between
+                # match and forward (kv_poison): never emit a NaN-
+                # derived first token — evict every sharer (the walk
+                # below; a group-mate sharing the same blocks may
+                # already be gone by the time its row comes up)
+                if req.status == "running":
+                    self._poison_eviction(req)
+                continue
+            self._post_prefill(slot, req, int(firsts[i]), int(seeds[i]),
+                               p_pad, c0, tokens=s_w)
+
     def _prefill_admitted(self,
                           admitted: List[Tuple[int, Request]]) -> None:
         """Dispatch this iteration's admissions to prefill: coalesce
         same-bucket runs into batched calls (admission order is
         preserved — the scheduler's decisions, the batch log, and every
         request's tokens are identical to the solo path, pinned by the
-        determinism A/B), or run each solo when coalescing is off."""
+        determinism A/B), or run each solo when coalescing is off.
+        Prefix-cache hits group by (bucket, cached length) and take the
+        suffix path — with the cache off every request has cached
+        length 0 and the grouping degenerates to the pre-cache one."""
         for slot, req in admitted:
             self._mark_admitted(slot, req)
         i = 0
         while i < len(admitted):
+            start = admitted[i][1].cached_prefix_blocks * self.block_size
             if not self.coalesce_prefill:
-                self._prefill(*admitted[i])
+                if start:
+                    self._prefill_suffix([admitted[i]])
+                else:
+                    self._prefill(*admitted[i])
                 i += 1
                 continue
             p_pad = admitted[i][1].padded_prompt_len(self.block_size)
             j = i + 1
             while (j < len(admitted)
                    and admitted[j][1].padded_prompt_len(self.block_size)
-                   == p_pad):
+                   == p_pad
+                   and admitted[j][1].cached_prefix_blocks
+                   * self.block_size == start):
                 j += 1
             group = admitted[i:j]
-            if len(group) == 1:
+            if start:
+                self._prefill_suffix(group)
+            elif len(group) == 1:
                 self._prefill(*group[0])
             else:
                 self._prefill_batch(group)
@@ -717,8 +909,14 @@ class ServingEngine:
                 # BEFORE they return to the free list: recycled NaN
                 # rows would otherwise poison every later request that
                 # reuses them (the additive visibility mask cannot mask
-                # NaN), permanently degrading the pool.
+                # NaN), permanently degrading the pool.  Shared blocks:
+                # every ACTIVE sharer's gather hit the same NaN rows, so
+                # its own flag trips in this very batch — the extra walk
+                # here only de-indexes the content and strips queued
+                # pins (no-ops with the cache off; event order is the
+                # pre-cache engine's).
                 self._scrub_blocks(req.blocks)
+                self._invalidate_poisoned(req.blocks)
                 self._evict(req, "failed", "serve/kv_evictions_total")
                 self._emit(req, -1, True)
                 continue
@@ -818,6 +1016,7 @@ class ServingEngine:
             slot = req.slot
             if not bool(ok[slot]):
                 self._scrub_blocks(req.blocks)
+                self._invalidate_poisoned(req.blocks)
                 self._evict(req, "failed", "serve/kv_evictions_total")
                 self._emit(req, -1, True)
                 continue
@@ -958,6 +1157,8 @@ class ServingEngine:
             tel.gauge("serve/kv_pool_frac").set(obs["pool_frac"])
             tel.gauge("serve/kv_hot_prefix_blocks").set(
                 obs["hot_prefix_blocks"])
+            tel.gauge("serve/kv_cached_blocks").set(
+                self.scheduler.allocator.cached_blocks)
             tel.gauge("hbm/kv_pool_bytes").set(obs["bytes_in_use"])
         tel.gauge("serve/queue_depth").set(len(self.scheduler.queue))
         tel.gauge("serve/active_requests").set(self.scheduler.num_active())
@@ -1099,6 +1300,16 @@ class ServingEngine:
                "prefill_calls": self.prefill_calls,
                "decode_iterations": sum(
                    1 for e in self.batch_log if e[0] == "decode")}
+        if self.prefix_cache:
+            probed = self.prefix_probed_blocks
+            out["prefix_cache"] = True
+            out["prefix_lookups"] = self.prefix_lookups
+            out["prefix_hit_blocks"] = self.prefix_hit_blocks
+            out["prefix_probed_blocks"] = probed
+            out["prefix_hit_rate"] = (
+                self.prefix_hit_blocks / probed if probed else 0.0)
+            out["kv_cached_blocks"] = (
+                self.scheduler.allocator.cached_blocks)
         if self.spec_k > 0:
             out["spec_k"] = self.spec_k
             out["spec_proposed"] = self.spec_proposed

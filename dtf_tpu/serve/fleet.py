@@ -57,6 +57,7 @@ replicas (and their ``drain.r<k>.jsonl`` namespaces) see.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import json
@@ -73,6 +74,7 @@ import numpy as np
 
 from dtf_tpu import telemetry as tel
 from dtf_tpu.serve.frontend import MAX_LINE_BYTES, parse_request_line
+from dtf_tpu.serve.paged_kv import chunk_digests
 
 log = logging.getLogger("dtf_tpu")
 
@@ -107,6 +109,18 @@ class FleetConfig:
     shed_priority_max: int = 0
     #: grace window for a per-replica drain
     drain_timeout_s: float = 30.0
+    #: prefix-affinity routing: leading chunks of the prompt hashed into
+    #: a signature; a replica whose recent admissions share the longest
+    #: signature prefix gets a small score bonus so same-prefix requests
+    #: co-locate and hit the replica's prefix KV cache.  The bonus is a
+    #: TIEBREAKER: max affinity_chunks * affinity_weight is far below
+    #: the brownout/burn/pressure terms (25/15/10), so affinity never
+    #: routes into a degraded replica.  0 chunks disables.
+    affinity_chunks: int = 4
+    affinity_chunk_tokens: int = 16
+    affinity_weight: float = 1.0
+    #: bound on each replica's hint table (recent admission signatures)
+    affinity_hints: int = 64
 
 
 class Replica:
@@ -132,6 +146,30 @@ class Replica:
         self.beat_count: Optional[int] = None
         self.beat_changed = time.monotonic()
         self.beat_at_detach: Optional[int] = None
+        # prefix-affinity hint table: chain digests of recent admissions'
+        # leading prompt chunks, LRU-bounded (see FleetConfig.affinity_*).
+        # Digests chain over ancestors, so membership of sig[i] implies a
+        # recent admission shared the first i+1 chunks.
+        self.prefix_hints: "collections.OrderedDict" = \
+            collections.OrderedDict()
+
+    def note_prefix(self, sig: Sequence[bytes], cap: int) -> None:
+        """Record an admitted request's prefix signature (acceptor lock
+        held by the caller)."""
+        for d in sig:
+            self.prefix_hints[d] = None
+            self.prefix_hints.move_to_end(d)
+        while len(self.prefix_hints) > cap:
+            self.prefix_hints.popitem(last=False)
+
+    def match_prefix(self, sig: Sequence[bytes]) -> int:
+        """Longest signature prefix shared with a recent admission."""
+        n = 0
+        for d in sig:
+            if d not in self.prefix_hints:
+                break
+            n += 1
+        return n
 
     @property
     def local(self) -> bool:
@@ -498,22 +536,51 @@ class FleetAcceptor:
 
     # -- routing ------------------------------------------------------------
 
-    def _score(self, r: Replica) -> float:
+    def _prefix_sig(self, raw: dict) -> List[bytes]:
+        """Leading-chunk hash chain of the request's prompt — the
+        prefix-affinity routing key.  Fixed chunk size (NOT the
+        replicas' block size: the acceptor may carry no model at all),
+        chained like serve/paged_kv.chunk_digests so a match on chunk i
+        implies a match on every earlier chunk."""
+        cfg = self.cfg
+        if cfg.affinity_chunks <= 0:
+            return []
+        prompt = raw.get("prompt") or []
+        if not isinstance(prompt, (list, tuple)):
+            return []
+        n = min(cfg.affinity_chunks,
+                len(prompt) // cfg.affinity_chunk_tokens)
+        if n <= 0:
+            return []
+        try:
+            return chunk_digests([int(t) for t in prompt],
+                                 cfg.affinity_chunk_tokens, n)
+        except (TypeError, ValueError):
+            return []
+
+    def _score(self, r: Replica,
+               prefix_sig: Sequence[bytes] = ()) -> float:
         s = r.stats or {}
-        return (float(s.get("queue_depth", 0))
+        base = (float(s.get("queue_depth", 0))
                 + 2.0 * float(s.get("active", 0))
                 + 25.0 * float(s.get("brownout_level", 0))
                 + 10.0 * float(s.get("kv_pool_frac", 0.0))
                 + 15.0 * float(s.get("slo_fast_firing", 0))
                 + 2.0 * r.inflight)
+        if prefix_sig:
+            with self._lock:
+                matched = r.match_prefix(prefix_sig)
+            base -= self.cfg.affinity_weight * matched
+        return base
 
-    def _route(self, exclude=()) -> Optional[Replica]:
+    def _route(self, exclude=(),
+               prefix_sig: Sequence[bytes] = ()) -> Optional[Replica]:
         cands = self._up_replicas(exclude)
         if not cands:
             cands = self._up_replicas()
         if not cands:
             return None
-        return min(cands, key=self._score)
+        return min(cands, key=lambda r: self._score(r, prefix_sig))
 
     def _fleet_degraded(self) -> bool:
         up = self._up_replicas()
@@ -660,6 +727,7 @@ class FleetAcceptor:
         tried: set = set()
         forwarded: List[int] = []
         winner: Optional[int] = None
+        prefix_sig = self._prefix_sig(raw)
 
         def reader(leg_id: int, sock: socket.socket) -> None:
             try:
@@ -685,6 +753,8 @@ class FleetAcceptor:
                 r.leg_socks.add(sock)
                 r.inflight += 1
                 r.dispatched += 1
+                if prefix_sig:
+                    r.note_prefix(prefix_sig, self.cfg.affinity_hints)
             threading.Thread(target=reader, args=(leg_id, sock),
                              daemon=True).start()
 
@@ -728,7 +798,7 @@ class FleetAcceptor:
                 tel.counter("fleet/failovers_total").inc()
                 tel.instant("event/fleet_failover", rid=fl["rid"],
                             attempt=fl["failovers"])
-                nxt = self._route(exclude=tried)
+                nxt = self._route(exclude=tried, prefix_sig=prefix_sig)
                 if nxt is None:
                     return False
                 try:
@@ -767,7 +837,7 @@ class FleetAcceptor:
                 return False
             return True
 
-        primary = self._route()
+        primary = self._route(prefix_sig=prefix_sig)
         if primary is None:
             return finish("shed_fleet_no_replicas")
         try:
@@ -791,7 +861,8 @@ class FleetAcceptor:
                         and not forwarded
                         and time.monotonic() >= hedge_at):
                     hedge_at = None
-                    nxt = self._route(exclude=tried)
+                    nxt = self._route(exclude=tried,
+                                      prefix_sig=prefix_sig)
                     if nxt is not None:
                         try:
                             launch(nxt, resubmit=False, skip=0, hedge=True)
@@ -907,6 +978,7 @@ class FleetAcceptor:
                     "failed_legs": r.failed_legs,
                     "beat_count": r.beat_count,
                     "beat_age_s": round(now - r.beat_changed, 3),
+                    "prefix_hints": len(r.prefix_hints),
                     "stats": r.stats,
                 } for r in self.replicas}
             totals = dict(self._totals)

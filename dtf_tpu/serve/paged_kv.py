@@ -52,7 +52,9 @@ invariance gate pins it.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -66,6 +68,32 @@ class PoolExhausted(RuntimeError):
     queued", never as a crash."""
 
 
+def chunk_digests(tokens: Sequence[int], block_size: int,
+                  n_blocks: int) -> List[bytes]:
+    """Hash-chain digests over the first ``n_blocks`` block-sized token
+    chunks of ``tokens``: ``digest[i] = blake2b(digest[i-1] || chunk_i)``.
+    Chunk ``i``'s digest therefore commits to the WHOLE token prefix
+    through block ``i`` — exactly what a KV block's rows depend on (row
+    ``t`` attends positions ``0..t``), so equal digests mean bitwise-
+    reusable block content (the cold prefill executables are padding-
+    length invariant; pinned by tests).  The radix-style index keys on
+    these digests: a walk that stops at the first miss can never match
+    a block whose prefix context diverged."""
+    out: List[bytes] = []
+    prev = b""
+    toks = np.asarray(tokens, np.int32)
+    for i in range(n_blocks):
+        chunk = toks[i * block_size:(i + 1) * block_size]
+        if len(chunk) < block_size:
+            break
+        h = hashlib.blake2b(digest_size=16)
+        h.update(prev)
+        h.update(chunk.tobytes())
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
 class BlockAllocator:
     """Deterministic free-list over physical block ids ``1..num_blocks-1``
     (block 0 is the trash block).
@@ -74,6 +102,22 @@ class BlockAllocator:
     produces the same physical layout, which the scheduler-determinism
     tests pin (and which makes paged-vs-contiguous parity failures
     reproducible instead of heisenbugs).
+
+    **Prefix sharing** (the sharing-aware pool): every live block carries
+    a refcount — fresh allocations start at 1, :meth:`acquire` pins a
+    matched shared block for one more owner, :meth:`free` decrements.  A
+    content-registered block (:meth:`register_chain`) whose refcount
+    drops to 0 does NOT return to the free list: it PARKS in the cached
+    tier (LRU order) and stays matchable through the digest index until
+    allocation pressure reclaims it lazily (:meth:`allocate` drains the
+    free list first, then the cached tier oldest-first).  Cache capacity
+    is therefore exactly the pool's idle headroom: ``free_blocks`` counts
+    free + cached (both are allocatable on demand), so the scheduler's
+    worst-case reservation math — and the leak assertions — see parked
+    blocks as available and mid-flight exhaustion stays impossible by
+    construction.  An engine that never registers content never parks a
+    block, and every path below degenerates bit-for-bit to the plain
+    free-list behavior (the cache-off arm's determinism pin).
     """
 
     def __init__(self, num_blocks: int):
@@ -89,10 +133,22 @@ class BlockAllocator:
         # iteration would reintroduce exactly the pool-size cost term
         # the narrowed data path exists to remove (measured)
         self._used: set = set()
+        # live refcounts (>= 1 for every block in _used; a block is in
+        # exactly one of: _free, _cached, _used)
+        self._ref: Dict[int, int] = {}
+        # parked refcount-0 registered blocks, insertion order = LRU
+        # reclaim order (oldest-parked first)
+        self._cached: "OrderedDict[int, bytes]" = OrderedDict()
+        # content index: chain digest -> physical block (live or parked)
+        self._index: Dict[bytes, int] = {}
+        self._block_key: Dict[int, bytes] = {}
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        # parked cached blocks are allocatable on demand (lazy reclaim),
+        # so they count as free — the reservation math and the leak
+        # assertions both want "blocks nobody is holding"
+        return len(self._free) + len(self._cached)
 
     #: alias used by the leak assertions: the number of free blocks must
     #: return to its initial value after any churn of allocate/free —
@@ -101,20 +157,35 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        return (self.num_blocks - 1) - len(self._free) - len(self._cached)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Parked (refcount-0, content-registered) blocks — the
+        ``serve/kv_cached_blocks`` gauge."""
+        return len(self._cached)
 
     def can_allocate(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.free_blocks
 
     def allocate(self, n: int) -> List[int]:
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
-        if n > len(self._free):
+        if n > self.free_blocks:
             raise PoolExhausted(
-                f"asked for {n} KV blocks, {len(self._free)} free "
+                f"asked for {n} KV blocks, {self.free_blocks} free "
                 f"(pool {self.num_blocks - 1} usable)")
-        out, self._free = self._free[:n], self._free[n:]
+        take = min(n, len(self._free))
+        out, self._free = self._free[:take], self._free[take:]
+        # allocation pressure: reclaim parked cache blocks lazily,
+        # oldest-parked first (LRU) — deterministic, like the free list
+        while len(out) < n:
+            b, _ = self._cached.popitem(last=False)
+            self._unregister(b)
+            out.append(b)
         self._used.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
     def free(self, blocks: List[int]) -> None:
@@ -123,20 +194,121 @@ class BlockAllocator:
         for b in blocks:
             if not (0 < b < self.num_blocks):
                 raise ValueError(f"freeing block {b} outside the pool")
-            if b in self._free:
+            if b not in self._used:
                 raise ValueError(f"double free of block {b}")
-        # keep the free list sorted so allocation order stays canonical
-        self._free = sorted(self._free + list(blocks))
-        self._used.difference_update(blocks)
+        release = []
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] > 0:
+                continue                  # another sharer still holds it
+            del self._ref[b]
+            self._used.discard(b)
+            key = self._block_key.get(b)
+            if key is not None:
+                # registered content: park instead of freeing — stays
+                # matchable until allocation pressure reclaims it
+                self._cached[b] = key
+            else:
+                release.append(b)
+        if release:
+            # keep the free list sorted so allocation order stays
+            # canonical
+            self._free = sorted(self._free + release)
+
+    def acquire(self, blocks: List[int]) -> None:
+        """Pin matched shared blocks for one more owner: live blocks get
+        a refcount bump; parked blocks un-park back into the live set.
+        Must only be handed blocks returned by :meth:`match_chain` (a
+        free-list block here is a bookkeeping bug and raises)."""
+        for b in blocks:
+            if b in self._used:
+                self._ref[b] += 1
+            elif b in self._cached:
+                del self._cached[b]
+                self._used.add(b)
+                self._ref[b] = 1
+            else:
+                raise ValueError(
+                    f"acquiring block {b} that is neither live nor "
+                    f"cached")
+
+    def ref_count(self, block: int) -> int:
+        """Live owners of ``block`` (0 when parked or free)."""
+        return self._ref.get(block, 0)
+
+    def match_chain(self, digests: Sequence[bytes]) -> List[int]:
+        """Walk the digest chain through the index; returns the matched
+        physical blocks for the longest indexed prefix (stops at the
+        first miss — descendants of a missing link are unreachable by
+        construction, the radix property).  Read-only: callers pin the
+        result with :meth:`acquire` before relying on it."""
+        out: List[int] = []
+        for d in digests:
+            b = self._index.get(d)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def register_chain(self, digests: Sequence[bytes],
+                       blocks: Sequence[int]) -> int:
+        """Publish freshly-prefilled full-content blocks into the index
+        (``digests[i]`` describes ``blocks[i]``'s content chain).  A
+        digest already indexed keeps its existing physical block (first
+        writer wins — the racing copy simply stays unregistered and
+        frees normally); a block already registered under another key
+        is skipped.  Returns the number of new registrations."""
+        n = 0
+        for d, b in zip(digests, blocks):
+            if d in self._index or b in self._block_key:
+                continue
+            if b not in self._used:
+                raise ValueError(
+                    f"registering block {b} that is not live")
+            self._index[d] = b
+            self._block_key[b] = d
+            n += 1
+        return n
+
+    def invalidate_blocks(self, blocks) -> None:
+        """Corruption path (kv_poison): tear the given blocks out of the
+        content index so no future request can match poisoned rows.  A
+        parked victim additionally moves to the free list (its content
+        is the only thing that kept it parked); live victims stay owned
+        — their sharers' release walk frees them normally (and, being
+        unregistered now, they fall to the free list, never back into
+        the cached tier)."""
+        release = []
+        for b in blocks:
+            self._unregister(b)
+            if b in self._cached:
+                del self._cached[b]
+                release.append(b)
+        if release:
+            self._free = sorted(self._free + release)
+
+    def _unregister(self, b: int) -> None:
+        key = self._block_key.pop(b, None)
+        if key is not None and self._index.get(key) == b:
+            del self._index[key]
 
     def highest_used(self) -> int:
-        """Largest physical block id currently allocated (0 = none; the
-        trash block is always id 0).  Lowest-id-first allocation keeps
-        live blocks in a low prefix, so ``highest_used() + 1`` is the
-        pool prefix the decode step actually needs resident — the
-        narrowed data path's hot-prefix bound.  O(live blocks) by
-        construction (called every engine iteration)."""
-        return max(self._used, default=0)
+        """Largest physical block id currently allocated OR parked in
+        the cached tier (0 = none; the trash block is always id 0).
+        Lowest-id-first allocation keeps live blocks in a low prefix,
+        so ``highest_used() + 1`` is the pool prefix the decode step
+        actually needs resident — the narrowed data path's hot-prefix
+        bound.  Parked blocks count: their rows are live content a
+        future match maps straight into a request's table, so
+        ``KVPool.ensure_hot`` must keep them resident (migrating one to
+        cold storage would hand a matched request a stale gather —
+        pinned by the churn/cache-hits composition test).  O(live +
+        cached blocks), never O(pool) (called every engine
+        iteration)."""
+        live = max(self._used, default=0)
+        if self._cached:
+            return max(live, max(self._cached))
+        return live
 
 
 def blocks_for(tokens: int, block_size: int) -> int:

@@ -115,6 +115,19 @@ class Request:
     slot: Optional[int] = None
     blocks: Optional[List[int]] = None
     pos: int = 0                       # next KV write position
+    # prefix-cache state (engine-owned; serve/paged_kv.py sharing): the
+    # shared full blocks matched + ACQUIRED at submit time — pinned so
+    # lazy cache reclaim can never invalidate the reservation discount
+    # between submit and admission.  _assign folds them into ``blocks``
+    # (table prefix) and clears the field; release frees whichever of
+    # the two is still held, so a request cancelled at ANY lifecycle
+    # point returns its pins exactly once.
+    prefix_blocks: Optional[List[int]] = None
+    cached_prefix_blocks: int = 0      # = len(prefix_blocks) at match
+    # full-content chain digests of the prompt (prompt_len // block_size
+    # entries), computed once at submit: the match walk reads a prefix
+    # of them, registration after prefill publishes them all
+    prefix_digests: Optional[List[bytes]] = None
     tokens: Optional[List[int]] = None # generated tokens (first included)
     first_token_s: Optional[float] = None
     last_token_s: Optional[float] = None
@@ -385,6 +398,11 @@ class Scheduler:
     def _shed(self, req: Request, reason: str) -> str:
         req.status = "shed"
         req.shed_reason = reason
+        if req.prefix_blocks:
+            # a queued request shed after its submit-time prefix match
+            # must return its pins — shed is terminal
+            self.allocator.free(req.prefix_blocks)
+            req.prefix_blocks = None
         if self.on_shed is not None:
             self.on_shed(req, reason)
         return f"shed_{reason}"
@@ -412,6 +430,14 @@ class Scheduler:
         p_pad = req.padded_prompt_len(self.block_size)
         rows = max(p_pad, req.prompt_len + req.max_new_tokens - 1)
         return blocks_for(rows, self.block_size)
+
+    def _fresh_blocks_needed(self, req: Request) -> int:
+        """Blocks the allocator must actually hand out: the worst-case
+        table minus the matched prefix blocks the request already holds
+        (acquired at submit — resident by construction, so the
+        admission walk must not count them against the pool)."""
+        held = len(req.prefix_blocks) if req.prefix_blocks else 0
+        return self._blocks_needed(req) - held
 
     def submit(self, req: Request, now: float) -> str:
         """Admission control at the front door.  Returns the request's
@@ -466,6 +492,12 @@ class Scheduler:
         if req.blocks:
             self.allocator.free(req.blocks)
             req.blocks = None
+        if req.prefix_blocks:
+            # matched-but-never-assigned holds (cancel/drain while
+            # queued): exactly one of blocks/prefix_blocks is ever set —
+            # _assign consumes prefix_blocks into blocks
+            self.allocator.free(req.prefix_blocks)
+            req.prefix_blocks = None
 
     def cancel(self, req: Request, status: str = "cancelled") -> str:
         """Tear a request out wherever it currently lives — queued (drop
@@ -475,9 +507,10 @@ class Scheduler:
         finished or never here — cancel is idempotent)."""
         if req in self.queue:
             self.queue.remove(req)
+            self.release(req)       # frees submit-time prefix pins
             req.status = status
             return "queued"
-        if req.slot is not None or req.blocks:
+        if req.slot is not None or req.blocks or req.prefix_blocks:
             self.release(req)
             req.status = status
             return "running"
@@ -485,7 +518,12 @@ class Scheduler:
 
     def _assign(self, req: Request) -> Tuple[int, Request]:
         slot = self.slots.index(None)
-        req.blocks = self.allocator.allocate(self._blocks_needed(req))
+        fresh = self.allocator.allocate(self._fresh_blocks_needed(req))
+        # table order: matched shared blocks cover logical blocks
+        # 0..k-1 (read-only — decode writes land at pos >= prompt_len,
+        # always inside the fresh region), fresh blocks the rest
+        req.blocks = list(req.prefix_blocks or []) + fresh
+        req.prefix_blocks = None
         req.slot = slot
         req.status = "running"
         req.tokens = []
@@ -522,7 +560,8 @@ class Scheduler:
                 return out
             while self.queue and self.num_active() < self.num_slots:
                 req = self.queue[0]
-                if not self.allocator.can_allocate(self._blocks_needed(req)):
+                if not self.allocator.can_allocate(
+                        self._fresh_blocks_needed(req)):
                     break
                 self.queue.popleft()
                 out.append(self._assign(req))
@@ -551,7 +590,8 @@ class Scheduler:
             p_pad = req.padded_prompt_len(self.block_size)
             if out and p_pad > budget:
                 break                   # phase separation: drip prefills
-            if not self.allocator.can_allocate(self._blocks_needed(req)):
+            if not self.allocator.can_allocate(
+                    self._fresh_blocks_needed(req)):
                 break                   # blocks come back as decodes finish
             self.queue.remove(req)
             out.append(self._assign(req))

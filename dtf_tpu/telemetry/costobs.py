@@ -373,7 +373,8 @@ class CostObservatory:
             metrics = _registry.get_registry().snapshot()
             summary = self.summary()
         fam = {n: m for n, m in metrics.items()
-               if n.startswith(("hbm/", "cost/", "serve/kv_"))}
+               if n.startswith(("hbm/", "cost/", "serve/kv_",
+                                "serve/prefix_"))}
         return {"cards": cards, "metrics": fam, "summary": summary}
 
     # -- persistence --------------------------------------------------------

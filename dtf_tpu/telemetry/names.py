@@ -149,6 +149,14 @@ METRICS = (
     "serve/kv_blocks_in_use",
     "serve/kv_pool_frac",
     "serve/kv_hot_prefix_blocks",
+    # prefix/prompt KV cache (serve/paged_kv.py sharing index, strict —
+    # no wildcard): lookup/hit counters book as a pair under the
+    # registry lock at submit-time match; kv_cached_blocks gauges the
+    # refcount-0 blocks parked in the LRU cached tier (matchable until
+    # allocation pressure reclaims them)
+    "serve/prefix_lookup_total",
+    "serve/prefix_hit_blocks_total",
+    "serve/kv_cached_blocks",
     # fleet plane (telemetry/fleet.py): sync-point skew attribution,
     # booked by the coordinator as fleet barriers complete.  blame_p<k>
     # counts the barriers host k arrived LAST at (it gated the fleet);

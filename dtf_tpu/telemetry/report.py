@@ -228,6 +228,7 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
                 max_compiles: Optional[float] = None,
                 min_attribution_frac: Optional[float] = None,
                 max_wire_bytes_per_step: Optional[float] = None,
+                min_prefix_hit_rate: Optional[float] = None,
                 ) -> Tuple[bool, List[str]]:
     """Threshold gates over a built report — THE gate implementation the
     ``report --check`` CLI flags, the scenario matrix runner, and the
@@ -303,6 +304,13 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
       fell back to a fatter wire (one-shot int8, bf16, f32) fails even
       if it converges.  No absent-gauge default: a run that never
       recorded its wire (no grad-sync path armed) FAILS.
+    * ``min_prefix_hit_rate`` — the PREFIX-CACHE gate (ISSUE 20): floor
+      on the serving summary's ``prefix_hit_rate`` (matched prefix
+      blocks over probed blocks at admission).  No absent-key default:
+      the engine only writes the key when its prefix cache is armed, so
+      an absent rate means the run this gate was pinned for served
+      cold — a config regression, and it FAILS (same falsifiability
+      rule as ``max_control_rollbacks``).
     """
     lines: List[str] = []
     ok = True
@@ -357,6 +365,11 @@ def check_gates(report: dict, *, min_goodput: Optional[float] = None,
         v = serving.get("tpot_ms_p99")
         gate("max_tpot_p99_ms", None if v is None else float(v),
              max_tpot_p99_ms, at_most=True)
+    if min_prefix_hit_rate is not None:
+        # absent = prefix cache never armed on this run = FAIL
+        v = serving.get("prefix_hit_rate")
+        gate("min_prefix_hit_rate", None if v is None else float(v),
+             min_prefix_hit_rate, at_most=False)
     if min_trace_complete_frac is not None:
         v = report.get("request_traces", {}).get("complete_frac")
         gate("min_trace_complete_frac", None if v is None else float(v),
@@ -518,7 +531,10 @@ def render(report: dict, top: int = 10) -> str:
                      "spec_accepted", "spec_acceptance",
                      "kv_blocks_peak", "kv_blocks_total",
                      "kv_blocks_in_use", "kv_pool_frac_peak",
-                     "kv_hot_prefix_blocks")
+                     "kv_hot_prefix_blocks", "kv_cached_blocks",
+                     "prefix_cache", "prefix_lookups",
+                     "prefix_probed_blocks", "prefix_hit_blocks",
+                     "prefix_hit_rate")
             for k in order:
                 if k in serving and serving[k] is not None:
                     v = serving[k]
@@ -843,6 +859,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="serving gate: p99 TPOT ceiling in ms (the "
                         "streaming-cadence gate the spec-decode lane "
                         "arms)")
+    p.add_argument("--min_prefix_hit_rate", type=float, default=None,
+                   help="prefix-cache gate: floor on the serving "
+                        "summary's prefix_hit_rate (matched/probed "
+                        "blocks at admission; the key ABSENT = prefix "
+                        "cache never armed = FAIL)")
     p.add_argument("--min_trace_complete_frac", type=float, default=None,
                    help="observability gate: floor on the fraction of "
                         "completed requests with a gap-free "
@@ -1014,7 +1035,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "max_hbm_frac": ns.max_hbm_frac,
                   "max_compiles": ns.max_compiles,
                   "min_attribution_frac": ns.min_attribution_frac,
-                  "max_wire_bytes_per_step": ns.max_wire_bytes_per_step}
+                  "max_wire_bytes_per_step": ns.max_wire_bytes_per_step,
+                  "min_prefix_hit_rate": ns.min_prefix_hit_rate}
     armed = {k: v for k, v in thresholds.items() if v is not None}
     if ns.check or armed:
         # check_goodput already fails on a missing/empty telemetry.json

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Perf-regression ledger: fold the loose ``BENCH_r*.json`` /
-``MULTICHIP_r*.json`` / ``DECODE_r*.json`` / ``PLAN_r*.json`` round
-files into one machine-readable ``LEDGER.jsonl`` — one row per run with
-rig, commit, the rig's headline metric (TFLOP/s for matmul rounds,
-aggregate tokens/s for decode-ladder rounds, wire-byte reduction for
-plan_ab rounds), MFU (roofline fraction) and, for failed rounds, the
-error + stage.
+``MULTICHIP_r*.json`` / ``DECODE_r*.json`` / ``PLAN_r*.json`` /
+``PREFIX_r*.json`` round files into one machine-readable
+``LEDGER.jsonl`` — one row per run with rig, commit, the rig's headline
+metric (TFLOP/s for matmul rounds, aggregate tokens/s for decode-ladder
+rounds, wire-byte reduction for plan_ab rounds, cold/warm TTFT p50
+ratio for prefix_ab rounds), MFU (roofline fraction) and, for failed
+rounds, the error + stage.
 
 The round files alone hide the trajectory: r01-r02 held ~193 TFLOP/s at
 ~98% of roofline, then r03-r05 all died on ``tpu_unavailable`` relay
@@ -202,6 +203,52 @@ def plan_row(path: str, repo: str) -> dict:
     return row
 
 
+def prefix_row(path: str, repo: str) -> dict:
+    """PREFIX_r*.json: one ``serve_load --prefix_ab --json`` doc (plus
+    an ``n`` round index).  Headline metric = ``ttft_p50_ratio`` (cold
+    p50 TTFT over cache-on p50 TTFT on the SAME trace; higher is
+    better, 1.0 = the cache bought nothing); ok = the doc's five-gate
+    verdict (p50 ratio >= bar AND p99 strictly improves AND tokens
+    bitwise identical AND hits observed AND zero leaked blocks after
+    churn-with-cancels), and the first failing gate lands in
+    ``stage``."""
+    with open(path) as f:
+        doc = json.load(f)
+    run = os.path.splitext(os.path.basename(path))[0]
+    ok = bool(doc.get("ok"))
+    on = doc.get("cache_on") or {}
+    churn = doc.get("churn") or {}
+    stage = None
+    if not ok:
+        for line in doc.get("gates") or []:
+            if "FAIL" in line:
+                # "gate prefix_ttft_p50: FAIL — ..." -> "prefix_ttft_p50"
+                stage = line.split(":", 1)[0].replace("gate ", "").strip()
+                break
+        stage = stage or "prefix_ab_gate_failed"
+    row = {
+        "run": run,
+        "kind": "prefix",
+        "n": doc.get("n", _run_index(run)),
+        "commit": _added_commit(repo, os.path.basename(path)),
+        "rig": doc.get("rig") or (
+            f"prefix_bs{on.get('kv_block_size')}_p{doc.get('prefix_len')}"),
+        "ttft_p50_ratio": (float(doc["ttft_p50_ratio"])
+                           if doc.get("ttft_p50_ratio") is not None
+                           else None),
+        "prefix_hit_rate": on.get("prefix_hit_rate"),
+        "kv_cached_blocks": on.get("kv_cached_blocks"),
+        "leaked_blocks": (None if "leaked_on" not in churn
+                          else int(churn.get("leaked_on") or 0)
+                          + int(churn.get("leaked_off") or 0)),
+        "ok": ok,
+        "error": None if ok else "prefix_ab_gate_failed",
+        "stage": stage,
+    }
+    _fold_cost_columns(row, doc)
+    return row
+
+
 def _run_index(run: str) -> "int | None":
     m = re.search(r"_r(\d+)$", run)
     return int(m.group(1)) if m else None
@@ -217,6 +264,8 @@ def build_ledger(repo: str) -> "list[dict]":
         rows.append(decode_row(path, repo))
     for path in sorted(glob.glob(os.path.join(repo, "PLAN_r*.json"))):
         rows.append(plan_row(path, repo))
+    for path in sorted(glob.glob(os.path.join(repo, "PREFIX_r*.json"))):
+        rows.append(prefix_row(path, repo))
     # one stream, ordered (kind, round) so the per-rig trajectory reads
     # top to bottom
     rows.sort(key=lambda r: (r["kind"], r["n"] if r["n"] is not None
@@ -315,8 +364,9 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
     """The regression gate ``bench.py --check-ledger`` runs.
 
     Per rig and kind (bench rows gate TFLOP/s, decode rows gate
-    aggregate tokens/s, plan rows gate the plan_ab wire-byte
-    reduction; multichip rows are pass/fail dryruns): the
+    aggregate tokens/s, plan rows gate the plan_ab wire-byte reduction,
+    prefix rows gate the prefix-cache TTFT p50 speedup ratio; multichip
+    rows are pass/fail dryruns): the
     NEWEST green run must hold at least ``(1 - tol) x`` the best of
     the EARLIER green runs on that rig.  A trailing streak of error rows
     (the stalled r03-r05 shape) prints loud as a warning — an outage is
@@ -327,6 +377,8 @@ def check_ledger(rows: "list[dict]", tol_pct: float = 10.0
     ok = _gate_kind(rows, "decode", "tok_s_aggregate", "tok/s",
                     tol_pct, lines) and ok
     ok = _gate_kind(rows, "plan", "wire_reduction", "wire-frac",
+                    tol_pct, lines) and ok
+    ok = _gate_kind(rows, "prefix", "ttft_p50_ratio", "x",
                     tol_pct, lines) and ok
     return ok, lines
 

@@ -52,6 +52,10 @@ def check_runtime_guard() -> list:
                   "cost/definitely_not_declared",
                   "hbm/definitely_not_declared",
                   "serve/kv_definitely_not_declared",
+                  # the prefix-cache family (ISSUE 20) is exact-name
+                  # declarations, no wildcard — a typo'd hit counter
+                  # would silently zero the hit-rate gate
+                  "serve/prefix_definitely_not_declared",
                   # the control/* family (ISSUE 17) mixes exact counters
                   # with the control/knob_* gauge pattern — a name
                   # outside both must be rejected
@@ -92,6 +96,9 @@ def check_runtime_guard() -> list:
                  "anomaly/detected_total",
                  "incident/recorded_total",
                  "incident/attributed_total",
+                 # the prefix-cache family (ISSUE 20): exact names
+                 "serve/prefix_lookup_total",
+                 "serve/prefix_hit_blocks_total",
                  "cost/compiles_total"):           # exact (cost family)
         try:
             reg.counter(name)
@@ -106,6 +113,7 @@ def check_runtime_guard() -> list:
                  "fleet/replicas_up",              # exact (serving fleet)
                  "control/knob_spec_k",            # pattern control/knob_*
                  "serve/kv_pool_frac",             # exact (kv gauges)
+                 "serve/kv_cached_blocks",         # exact (ISSUE 20)
                  # the pod-gradient path (ISSUE 19): ring-hop accounting
                  # and the planner's predicted-vs-measured audit gauges
                  "comm/hops",

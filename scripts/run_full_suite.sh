@@ -1237,5 +1237,121 @@ PYEOF
   [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: plan-auto cell assertions (rc=$rc)"; }
   rm -rf "$pgdir"
 fi
+# Prefix-cache lane (DESIGN.md §7.7, ISSUE 20): (1) the same-trace
+# cache-on/off A/B (serve_load --prefix_ab --check) — TTFT p50 >= 1.5x,
+# p99 strictly improves, tokens bitwise identical (greedy AND sampled),
+# hits observed, zero leaked blocks after churn-with-random-cancels —
+# the CLI itself exits 1 when any gate fails and the JSON is
+# re-asserted here; (2) a wall-clock --prefix_cache serve with
+# /memz scraped MID-run: the cached-tier gauge must show parked blocks
+# while the run is live; (3) the report CLI over the A/B's cache-on
+# logdir: --min_prefix_hit_rate green at the committed floor, RED at an
+# absurd one, and RED over a cache-OFF logdir (absence = served cold =
+# FAIL, the falsifiability twin pair).  Skip with NO_PREFIX_LANE=1.
+if [ "${NO_PREFIX_LANE:-0}" != "1" ]; then
+  echo "=== prefix-cache lane (cache on/off A/B + /memz cached-tier scrape + hit-rate gates) ==="
+  pcdir=$(mktemp -d)
+  # (1) the five-gate A/B on the virtual-clock CPU rig (the PREFIX_r*
+  # round geometry: block 8, 40-token shared prefixes, 3 prefix pool)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.bench.serve_load --prefix_ab \
+      --block_size 8 --requests 24 --qps 8 --clock virtual \
+      --prompt_lens 1,4,7 --output_lens 2,4,8 --check \
+      --json "$pcdir/ab.json" --logdir "$pcdir/on" \
+      > "$pcdir/ab.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: serve_load --prefix_ab --check (rc=$rc)"; tail -10 "$pcdir/ab.log"; }
+  python - "$pcdir/ab.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["ok"], doc["gates"]
+on, off, churn = doc["cache_on"], doc["cache_off"], doc["churn"]
+assert doc["ttft_p50_ratio"] >= doc["min_ratio"], doc["ttft_p50_ratio"]
+assert on["ttft_ms_p99"] < off["ttft_ms_p99"]
+ident = doc["token_identity_detail"]
+assert doc["token_identity"] and ident["greedy"] > 0 and ident["sampled"] > 0
+assert on["prefix_hit_blocks"] > 0 and on["prefix_hit_rate"] > 0
+assert churn["leaked_on"] == 0 and churn["leaked_off"] == 0, churn
+print(f"prefix_ab OK: ttft p50 {off['ttft_ms_p50']:.1f} -> "
+      f"{on['ttft_ms_p50']:.1f} ms ({doc['ttft_p50_ratio']:.2f}x), "
+      f"hit rate {on['prefix_hit_rate']:.3f}, "
+      f"{ident['greedy']}+{ident['sampled']} greedy+sampled streams "
+      f"identical, 0 leaks after {churn['cancels']} cancels")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: prefix_ab leg assertions (rc=$rc)"; }
+  # (2) wall-clock --prefix_cache serve, /memz scraped mid-run: the
+  # cached tier must be visibly populated while the engine is live
+  JAX_PLATFORMS=cpu python - "$pcdir" <<'PYEOF'
+import json, os, socket, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dtf_tpu.serve", "--preset", "tiny",
+     "--demo", "48", "--qps", "20", "--clock", "wall", "--seed", "7",
+     "--block_size", "8", "--prompt_lens", "1,4,7",
+     "--output_lens", "2,4,8", "--prefix_cache",
+     "--admin_port", str(port), "--logdir", os.path.join(d, "wall")],
+    stdout=open(os.path.join(d, "wall.log"), "w"),
+    stderr=subprocess.STDOUT, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+cut = None
+try:
+    deadline = time.time() + 240
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/memz", timeout=5) as r:
+                doc = json.loads(r.read())
+        except OSError:
+            time.sleep(0.2); continue
+        m = doc.get("metrics", {})
+        # wait for parked blocks AND a hit — the first scrape can land
+        # before any stream has finished and released its prefix pins
+        if (m.get("serve/kv_cached_blocks", {}).get("value", 0) > 0
+                and m.get("serve/prefix_hit_blocks_total",
+                          {}).get("value", 0) > 0):
+            cut = m
+            break
+        time.sleep(0.2)
+finally:
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        proc.kill(); proc.wait(); rc = -1
+assert rc == 0, f"prefix_cache serve exited {rc}"
+assert cut is not None, "/memz never showed a populated cached tier mid-run"
+cached = cut["serve/kv_cached_blocks"]["value"]
+hits = cut["serve/prefix_hit_blocks_total"]["value"]
+looks = cut["serve/prefix_lookup_total"]["value"]
+print(f"memz scrape OK: {cached:.0f} cached block(s) parked mid-run, "
+      f"{hits:.0f} hit block(s) over {looks:.0f} lookup(s)")
+PYEOF
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: prefix /memz scrape (rc=$rc)"; tail -8 "$pcdir/wall.log" 2>/dev/null; }
+  # (3) report gates over the A/B's cache-on logdir: green at the
+  # committed floor...
+  python -m dtf_tpu.telemetry.report "$pcdir/on" \
+      --min_prefix_hit_rate 0.5 > "$pcdir/gate.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: min_prefix_hit_rate gate on cache-on logdir (rc=$rc)"; tail -5 "$pcdir/gate.log"; }
+  grep -q "gate min_prefix_hit_rate: OK" "$pcdir/gate.log" \
+    || { FAILS=$((FAILS + 1)); echo "FAILED: hit-rate gate line missing"; }
+  # ...RED at an absurd floor on the SAME logdir...
+  python -m dtf_tpu.telemetry.report "$pcdir/on" \
+      --min_prefix_hit_rate 0.999 > /dev/null 2>&1
+  [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: absurd min_prefix_hit_rate did not fail"; }
+  # ...and RED over a cache-OFF logdir (no prefix_hit_rate key at all:
+  # absence means the run served cold, which the armed gate must FAIL)
+  JAX_PLATFORMS=cpu python -m dtf_tpu.serve --preset tiny --demo 8 \
+      --qps 20 --clock virtual --seed 7 --block_size 8 \
+      --prompt_lens 1,4,7 --output_lens 2,4,8 \
+      --logdir "$pcdir/cold" > "$pcdir/cold.log" 2>&1
+  rc=$?
+  [ "$rc" -ne 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: cache-off twin run (rc=$rc)"; tail -5 "$pcdir/cold.log"; }
+  python -m dtf_tpu.telemetry.report "$pcdir/cold" \
+      --min_prefix_hit_rate 0.5 > /dev/null 2>&1
+  [ $? -eq 0 ] && { FAILS=$((FAILS + 1)); echo "FAILED: armed hit-rate gate passed a cache-off logdir"; }
+  rm -rf "$pcdir"
+fi
 echo "=== full suite done; failed files: $FAILS ==="
 exit $([ "$FAILS" -eq 0 ] && echo 0 || echo 1)

@@ -677,6 +677,46 @@ class TestBenchLedger:
         assert row["error"] == "plan_ab_gate_failed"
         assert row["stage"] == "step_time"
 
+    def test_prefix_round_folds_and_gates(self):
+        """PREFIX_r*.json (serve_load --prefix_ab, ISSUE 20) folds as a
+        kind='prefix' row gated on the cold/warm TTFT p50 ratio, and
+        the committed round is green."""
+        import os
+        bl = self._ledger_mod()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        row = bl.prefix_row(os.path.join(repo, "PREFIX_r01.json"), repo)
+        assert row["kind"] == "prefix" and row["ok"]
+        assert row["rig"] == "prefix_bs8_p40_n3"
+        assert row["ttft_p50_ratio"] >= 1.5        # the A/B's own bar
+        assert row["prefix_hit_rate"] > 0
+        assert row["leaked_blocks"] == 0
+        ok, lines = bl.check_ledger([row])
+        assert ok, lines
+        assert any("prefix_bs8_p40_n3" in ln for ln in lines)
+        # a later round that loses the speedup reads as a REGRESSION
+        worse = dict(row, run="PREFIX_r02", n=2, ttft_p50_ratio=1.6)
+        ok, lines = bl.check_ledger([row, worse])
+        assert not ok
+        assert any("REGRESSION" in ln for ln in lines)
+
+    def test_prefix_gate_failure_names_failing_gate(self, tmp_path):
+        """A prefix_ab doc whose five-gate verdict failed folds as an
+        errored row whose stage names the first failing gate line."""
+        import json
+        bl = self._ledger_mod()
+        doc = {"n": 3, "ok": False, "ttft_p50_ratio": 1.1,
+               "rig": "prefix_bs8_p40_n3",
+               "cache_on": {"prefix_hit_rate": 0.9, "kv_cached_blocks": 4},
+               "churn": {"leaked_on": 0, "leaked_off": 0},
+               "gates": ["gate prefix_token_identity: OK — fine",
+                         "gate prefix_ttft_p50: FAIL — ratio 1.1 < 1.5"]}
+        p = tmp_path / "PREFIX_r03.json"
+        p.write_text(json.dumps(doc))
+        row = bl.prefix_row(str(p), str(tmp_path))
+        assert not row["ok"]
+        assert row["error"] == "prefix_ab_gate_failed"
+        assert row["stage"] == "prefix_ttft_p50"
+
     def test_check_ledger_cli_green_and_regression(self, tmp_path):
         """python bench.py --check-ledger end to end: green on the
         committed ledger, exit 1 when a synthetic regression row is

@@ -1,0 +1,431 @@
+"""Prefix/prompt KV caching (DESIGN.md §7.7, ISSUE 20): the
+sharing-aware paged pool and everything stacked on it.
+
+The ISSUE-level pins live here:
+
+* **warm == cold, bitwise** — a prompt served from matched prefix
+  blocks (suffix-only prefill) must emit tokens IDENTICAL to the same
+  prompt cold-prefilled, greedy AND sampled, solo and coalesced;
+* **sharing is leak-free under churn** — waves of shared-prefix traffic
+  with seeded random cancels return every non-trash block to the
+  free/cached tiers, and the §7.5 hot-prefix narrowing counts a shared
+  block once (parked blocks stay inside the resident prefix);
+* **poison on a SHARED block evicts every sharer** — no surviving
+  stream ever emits a NaN-derived token, queued pin-holders lose their
+  discount and cold-prefill, and the scrubbed blocks recycle cleanly;
+* **the hit-rate gate is falsifiable** — `min_prefix_hit_rate` through
+  the one `check_gates` path FAILS on a summary that lacks the key
+  (absent = the run served cold = config regression) and at an absurd
+  threshold on a real summary.
+"""
+
+import numpy as np
+import pytest
+
+from dtf_tpu.serve import ServingEngine, VirtualClock
+from dtf_tpu.serve.paged_kv import BlockAllocator, chunk_digests
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    """One model object for the whole module (compiled-step cache is
+    keyed on the model instance — same idiom as test_serve.py)."""
+    import jax
+
+    from dtf_tpu.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _mk_engine(model, params, **kw):
+    kw.setdefault("clock", VirtualClock())
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("blocks_per_slot", 8)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(model, params, **kw)
+
+
+def _shared_trace(n, *, prefix, seed=0, start_rid=0, qps=200.0,
+                  sampled_temperature=0.8, o_lens=(4, 6, 8)):
+    """Shared-prefix arrivals: every prompt = ``prefix`` + a seeded
+    random suffix; even rids greedy, odd rids sampled (the parity pin
+    must cover both decode paths)."""
+    rng = np.random.default_rng(seed)
+    trace, t = [], 0.0
+    for i in range(n):
+        rid = start_rid + i
+        t += float(rng.exponential(1.0)) / qps
+        sfx = rng.integers(0, 128, (int(rng.integers(1, 6)),))
+        trace.append((t, {
+            "rid": rid,
+            "prompt": np.concatenate([prefix, sfx]).astype(np.int32),
+            "max_new_tokens": int(rng.choice(o_lens)),
+            "temperature": 0.0 if rid % 2 == 0 else sampled_temperature,
+        }))
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# sharing-aware allocator (pure Python, no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestSharingAllocator:
+    def _digests(self, tokens, bs=4):
+        return chunk_digests(tokens, bs, len(tokens) // bs)
+
+    def test_refcount_zero_parks_then_lru_reclaims(self):
+        """A registered block parks in the cached tier on release (still
+        matchable), and allocation pressure drains the FREE list first,
+        then the cached tier oldest-parked first — de-indexing on
+        reclaim."""
+        a = BlockAllocator(6)                      # usable ids 1..5
+        d = self._digests(list(range(12)))         # 3-link chain
+        b = a.allocate(3)
+        assert a.register_chain(d, b) == 3
+        a.free(b)
+        assert a.cached_blocks == 3 and a.used_blocks == 0
+        assert a.free_blocks == 5                  # parked counts as free
+        assert a.match_chain(d) == b               # still matchable
+        # pressure: 2 true-free blocks first, then the OLDEST parked
+        got = a.allocate(3)
+        assert got == [4, 5, b[0]]
+        assert a.cached_blocks == 2
+        assert a.match_chain(d) == []              # chain head de-indexed
+
+    def test_acquire_pins_live_and_unparks_cached(self):
+        a = BlockAllocator(6)
+        d = self._digests(list(range(8)))
+        b = a.allocate(2)
+        a.register_chain(d, b)
+        a.acquire(b)                               # second owner
+        assert a.ref_count(b[0]) == 2
+        a.free(b)                                  # first owner leaves
+        assert a.ref_count(b[0]) == 1 and a.cached_blocks == 0
+        a.free(b)                                  # last owner: parks
+        assert a.ref_count(b[0]) == 0 and a.cached_blocks == 2
+        a.acquire(b)                               # un-park
+        assert a.ref_count(b[0]) == 1 and a.cached_blocks == 0
+        a.free(b)
+        with pytest.raises(ValueError, match="neither live nor cached"):
+            a.acquire([5])                         # free-list block = bug
+
+    def test_match_chain_stops_at_first_miss(self):
+        """The radix property: digests chain over the whole prefix, so
+        a diverging FIRST chunk unmatches every later one even when the
+        later chunks' raw tokens are identical."""
+        a = BlockAllocator(8)
+        toks = list(range(12))
+        b = a.allocate(3)
+        a.register_chain(self._digests(toks), b)
+        assert a.match_chain(self._digests(toks)) == b
+        assert a.match_chain(self._digests(toks[:8])) == b[:2]
+        diverged = [99] + toks[1:]                 # same chunks 2..3
+        assert a.match_chain(self._digests(diverged)) == []
+        # a hole mid-chain ends the walk even if a descendant is indexed
+        assert a.match_chain([b"nope", self._digests(toks)[1]]) == []
+
+    def test_register_first_writer_wins_and_live_guard(self):
+        a = BlockAllocator(8)
+        d = self._digests(list(range(8)))
+        b1 = a.allocate(2)
+        assert a.register_chain(d, b1) == 2
+        b2 = a.allocate(2)                         # racing copy
+        assert a.register_chain(d, b2) == 0        # keeps b1
+        assert a.match_chain(d) == b1
+        a.free(b2)
+        assert a.cached_blocks == 0                # unregistered: truly freed
+        with pytest.raises(ValueError, match="not live"):
+            a.register_chain(self._digests(list(range(50, 54))), [b2[0]])
+
+    def test_invalidate_blocks_poison_path(self):
+        """De-index poisoned content: a parked victim falls to the free
+        list (content was all that parked it), a LIVE victim stays owned
+        and frees normally — to the free list, not back into the cached
+        tier."""
+        a = BlockAllocator(8)
+        d = self._digests(list(range(12)))
+        b = a.allocate(3)
+        a.register_chain(d, b)
+        a.free([b[2]])                             # park just the tail
+        assert a.cached_blocks == 1
+        a.invalidate_blocks(b)
+        assert a.cached_blocks == 0
+        assert a.match_chain(d) == []
+        assert a.ref_count(b[0]) == 1              # live head still owned
+        before = a.free_blocks
+        a.free(b[:2])
+        assert a.cached_blocks == 0                # no re-park after poison
+        assert a.free_blocks == before + 2
+
+    def test_highest_used_spans_cached_tier(self):
+        """Satellite pin (hot-prefix narrowing composition): parked
+        blocks are live content a future match maps straight into a
+        table, so the narrowed decode's resident-prefix bound must keep
+        covering them — and a SHARED block counts once, not once per
+        owner."""
+        a = BlockAllocator(8)
+        d = self._digests(list(range(12)))
+        b = a.allocate(3)                          # ids 1..3
+        a.register_chain(d, b)
+        a.acquire(b)                               # 2 owners, same blocks
+        assert a.highest_used() == 3               # counted once
+        a.free(b)
+        a.free(b)                                  # all owners gone: parked
+        assert a.used_blocks == 0
+        assert a.highest_used() == 3               # parked still resident
+        a.invalidate_blocks(b)
+        assert a.highest_used() == 0
+
+    def test_cache_off_degenerates_to_plain_free_list(self):
+        """An allocator that never registers content behaves bit-for-bit
+        like the pre-cache free list (the cache-off determinism pin at
+        the unit level)."""
+        a = BlockAllocator(8)
+        assert a.allocate(3) == [1, 2, 3]
+        a.free([2])
+        assert a.cached_blocks == 0
+        assert a.allocate(2) == [2, 4]
+        assert a.free_blocks == a.num_blocks - 1 - a.used_blocks
+
+
+# ---------------------------------------------------------------------------
+# engine: warm-vs-cold parity, churn, shared-block poison (jax)
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixEngine:
+    def test_warm_tokens_bitwise_cold_coalesced_and_solo(self, tiny_model):
+        """THE tentpole pin: the same shared-prefix trace through a
+        cache-on engine (suffix-only prefill over matched blocks) and a
+        cache-off engine (cold prefill) emits bitwise-identical streams
+        — greedy and sampled rids, batched and solo prefill — and the
+        warm arm actually hit (hits > 0, not a vacuous pass)."""
+        model, params = tiny_model
+        rng = np.random.default_rng(3)
+        prefix = rng.integers(0, 128, (8,))        # 2 full blocks @ bs=4
+        trace = _shared_trace(10, prefix=prefix, seed=3)
+        cold = _mk_engine(model, params, prefix_cache=False).run(trace)
+        for coalesce in (True, False):
+            eng = _mk_engine(model, params, coalesce_prefill=coalesce)
+            warm = eng.run(trace)
+            for rid, ref in cold.items():
+                assert warm[rid].status == ref.status == "completed"
+                assert warm[rid].tokens == ref.tokens, (
+                    f"rid {rid} (coalesce={coalesce}, "
+                    f"{'greedy' if rid % 2 == 0 else 'sampled'}) diverged")
+            s = eng.summary()
+            assert s["prefix_hit_blocks"] > 0
+            assert s["prefix_hit_rate"] > 0
+            assert s["prefix_lookups"] == len(trace)
+
+    def test_churn_with_cancels_leak_free_and_narrow_composes(
+            self, tiny_model):
+        """Satellite pin: waves of shared-prefix traffic with seeded
+        random mid-flight cancels leave zero leaked blocks (parked
+        cached blocks are reclaimable, not leaked), repeat visitors
+        still hit, and the §7.5 narrowed decode's resident prefix keeps
+        covering the parked tier (no migration under a live share)."""
+        from dtf_tpu.bench.serve_load import _churn_with_cancels
+        model, params = tiny_model
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(0, 128, (8,))
+        eng = _mk_engine(model, params)
+        alloc = eng.scheduler.allocator
+        cancelled = 0
+        for wave in range(3):
+            trace = _shared_trace(8, prefix=prefix, seed=5,
+                                  start_rid=wave * 8)
+            cancelled += _churn_with_cancels(eng, trace, seed=100 + wave)
+            # hot-prefix narrowing composes: the resident prefix covers
+            # every used AND parked block, counted by physical id
+            assert eng.pool.hot_blocks >= alloc.highest_used() + 1
+        assert cancelled > 0, "churn never cancelled anything"
+        # leak audit: every non-trash block is free or parked
+        assert alloc.num_blocks - 1 - alloc.free_blocks == 0
+        assert alloc.cached_blocks > 0            # the tier was exercised
+        assert eng.summary()["prefix_hit_blocks"] > 0
+
+    def test_poison_on_shared_block_evicts_every_active_sharer(
+            self, tiny_model):
+        """Satellite pin: kv_poison landing on blocks shared by several
+        ACTIVE streams evicts them ALL (each slot's own finite-logits
+        flag trips in the same iteration) — no survivor emits a
+        NaN-derived token — and a follow-up wave with the same prompts
+        cold-prefills cleanly to the reference streams (scrubbed,
+        de-indexed, recycled)."""
+        import jax.numpy as jnp
+
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        rng = np.random.default_rng(21)
+        prefix = rng.integers(0, 128, (8,))
+        prompts = [np.concatenate([prefix,
+                                   rng.integers(0, 128, (1 + i,))])
+                   .astype(np.int32) for i in range(3)]
+        refs = [np.asarray(model.generate(
+            params, jnp.asarray(p)[None], 8,
+            temperature=0.0))[0, len(p):].tolist() for p in prompts]
+        # rid 0 cold-prefills and registers; 1..2 arrive after its
+        # prefill, match the shared chain, and decode alongside it
+        trace = [(0.0 if i == 0 else 0.01,
+                  dict(rid=i, prompt=p, max_new_tokens=8))
+                 for i, p in enumerate(prompts)]
+        plan = FaultPlan.parse("kv_poison@6", process_index=0)
+        eng = _mk_engine(model, params, chaos=plan)
+        res = eng.run(trace)
+        assert [res[i].status for i in range(3)] == ["failed"] * 3, \
+            {i: res[i].status for i in range(3)}
+        for i in range(3):
+            # nothing NaN-derived ever reached the stream: every token
+            # emitted BEFORE the poison matches the clean reference
+            got = res[i].tokens or []
+            assert got == refs[i][:len(got)], f"sharer {i} emitted garbage"
+        # recovery wave: same prompts, cold prefill, clean completions
+        res2 = eng.run([(0.0, dict(rid=10 + i, prompt=p,
+                                   max_new_tokens=8))
+                        for i, p in enumerate(prompts)])
+        for i in range(3):
+            assert res2[10 + i].status == "completed"
+            assert res2[10 + i].tokens == refs[i], f"recycled NaN hit {i}"
+        alloc = eng.scheduler.allocator
+        assert alloc.num_blocks - 1 - alloc.free_blocks == 0
+
+    def test_poison_strips_queued_pins_then_cold_prefills(self, tiny_model):
+        """Satellite pin, queued half: a QUEUED request holding submit-
+        time pins on the poisoned chain just loses its admission
+        discount — it cold-prefills when admitted and completes with
+        the reference stream (its tokens were never derived from the
+        bad rows)."""
+        import jax.numpy as jnp
+
+        from dtf_tpu.resilience.chaos import FaultPlan
+        model, params = tiny_model
+        rng = np.random.default_rng(33)
+        prefix = rng.integers(0, 128, (8,))
+        prompts = [np.concatenate([prefix,
+                                   rng.integers(0, 128, (2,))])
+                   .astype(np.int32) for _ in range(3)]
+        refs = [np.asarray(model.generate(
+            params, jnp.asarray(p)[None], 8,
+            temperature=0.0))[0, len(p):].tolist() for p in prompts]
+        plan = FaultPlan.parse("kv_poison@6", process_index=0)
+        # 2 slots: rid 2 queues behind the two active sharers, pinned
+        eng = _mk_engine(model, params, num_slots=2, chaos=plan)
+        res = eng.run([(0.0 if i == 0 else 0.01,
+                        dict(rid=i, prompt=p, max_new_tokens=8))
+                       for i, p in enumerate(prompts)])
+        assert res[0].status == "failed"
+        assert res[1].status == "failed"
+        assert res[2].status == "completed"
+        assert res[2].tokens == refs[2], "queued pin-holder got bad rows"
+        alloc = eng.scheduler.allocator
+        assert alloc.num_blocks - 1 - alloc.free_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# gate plumbing: Gate -> thresholds -> check_gates (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixHitRateGate:
+    def _report(self, **serving):
+        return {"telemetry": {"serving": serving}}
+
+    def test_gate_threshold_plumbing(self):
+        from dtf_tpu.scenarios.spec import Gate
+        g = Gate(max_final_cost=None, min_goodput=0.01,
+                 min_goodput_qps=1.0, min_prefix_hit_rate=0.8)
+        assert g.thresholds()["min_prefix_hit_rate"] == 0.8
+        g0 = Gate(max_final_cost=None, min_goodput=0.01,
+                  min_goodput_qps=1.0)
+        assert "min_prefix_hit_rate" not in g0.thresholds()
+
+    def test_check_gates_pass_fail_and_absence_fails(self):
+        """Falsifiability: absent key = the run served cold = FAIL (the
+        same rule as max_control_rollbacks — a cell whose engine lost
+        its prefix_cache flag must not pass vacuously), and an absurd
+        threshold fails on a REAL summary (the gate measures)."""
+        from dtf_tpu.telemetry.report import check_gates
+        warm = self._report(prefix_hit_rate=0.9375, goodput_qps=5.0)
+        ok, lines = check_gates(warm, min_prefix_hit_rate=0.8)
+        assert ok and any("min_prefix_hit_rate: OK" in ln for ln in lines)
+        assert not check_gates(warm, min_prefix_hit_rate=0.999)[0]
+        cold = self._report(goodput_qps=5.0)       # no prefix keys at all
+        ok, lines = check_gates(cold, min_prefix_hit_rate=0.8)
+        assert not ok
+        assert any("min_prefix_hit_rate" in ln and "FAIL" in ln
+                   for ln in lines)
+        # unarmed: a cold summary is fine (the gate is opt-in per cell)
+        assert check_gates(cold)[0]
+
+    def test_default_matrix_carries_the_cell(self):
+        from dtf_tpu.scenarios.spec import default_matrix
+        cells = {s.name: s for s in default_matrix()}
+        cell = cells["serve_prefix_cache"]
+        assert dict(cell.extra)["prefix_cache"] == 1
+        assert cell.gate.min_prefix_hit_rate >= 0.8
+        assert cell.gate.min_goodput_qps > 0      # serve-cell contract
+        # no other cell arms the gate by accident (absence must FAIL,
+        # so arming it on a cache-off cell would break that cell)
+        for name, s in cells.items():
+            if name != "serve_prefix_cache":
+                assert s.gate.min_prefix_hit_rate == 0, name
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix-affinity routing (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAffinity:
+    def _acc(self, n=2, **cfg_kw):
+        from dtf_tpu.serve.fleet import FleetAcceptor, FleetConfig, Replica
+        reps = [Replica(i, ("127.0.0.1", 0)) for i in range(n)]
+        return FleetAcceptor(reps, config=FleetConfig(**cfg_kw)), reps
+
+    def test_hint_table_is_bounded_lru(self):
+        from dtf_tpu.serve.fleet import Replica
+        r = Replica(0, ("127.0.0.1", 0))
+        sigs = [chunk_digests(list(range(i, i + 16)), 16, 1)
+                for i in range(6)]
+        for s in sigs:
+            r.note_prefix(s, cap=4)
+        assert len(r.prefix_hints) == 4            # oldest two evicted
+        assert r.match_prefix(sigs[0]) == 0
+        assert r.match_prefix(sigs[5]) == 1
+        # re-noting renews LRU position
+        r.note_prefix(sigs[2], cap=4)
+        r.note_prefix(chunk_digests(list(range(100, 116)), 16, 1), cap=4)
+        assert r.match_prefix(sigs[2]) == 1        # renewed, survived
+
+    def test_affinity_prefers_warm_replica_but_never_overrides_health(self):
+        """The routing bonus is a TIEBREAKER: equal-health replicas
+        route to the one whose recent admissions share the prompt's
+        leading chunks, but a browned-out warm replica still loses to a
+        healthy cold one (max 4 x affinity_weight vs the 25/15/10
+        health terms)."""
+        acc, (r0, r1) = self._acc()
+        prompt = list(range(64))                   # 4 x 16-token chunks
+        sig = acc._prefix_sig({"prompt": prompt})
+        assert len(sig) == 4
+        r1.note_prefix(sig, cap=64)
+        assert acc._score(r1, sig) < acc._score(r0, sig)
+        assert acc._route(prefix_sig=sig) is r1
+        # health dominates: brownout on the warm replica flips the route
+        r1.stats = {"brownout_level": 1}
+        assert acc._route(prefix_sig=sig) is r0
+        # and with no signature the bonus never applies
+        assert acc._score(r0) == acc._score(r1) - 25.0
+
+    def test_sig_guards_and_partial_match(self):
+        acc, (r0, _) = self._acc()
+        assert acc._prefix_sig({"prompt": None}) == []
+        assert acc._prefix_sig({"prompt": "not tokens"}) == []
+        assert acc._prefix_sig({"prompt": list(range(8))}) == []  # < 1 chunk
+        long_sig = acc._prefix_sig({"prompt": list(range(64))})
+        r0.note_prefix(long_sig[:2], cap=64)
+        assert r0.match_prefix(long_sig) == 2      # longest shared prefix
